@@ -1,0 +1,22 @@
+"""opensearch_tpu — a TPU-native distributed search engine.
+
+A ground-up re-design of the OpenSearch capability surface (reference:
+/root/reference, Apache-2.0 OpenSearch core 3.0.0-dev) for TPU hardware:
+
+- The data plane is array-oriented: an index shard is a set of immutable,
+  blocked, HBM-resident arrays (CSR postings with precomputed BM25 impacts,
+  doc-value columns, dense vectors).  A query compiles to a jit'd
+  gather -> scatter-add -> top_k program on device (eager sparse scoring in
+  the style of BM25S, arXiv:2407.03618) instead of Lucene's branchy
+  doc-at-a-time WAND loop (reference:
+  server/src/main/java/org/opensearch/search/internal/ContextIndexSearcher.java:318).
+- The control plane (cluster state, routing, translog, recovery, REST) is
+  host-side Python, mirroring the reference's layer split of transport (L5)
+  under actions (L6) (see SURVEY.md §1).
+- Distribution is jax.sharding over a device Mesh: cross-shard top-k /
+  aggregation merge is an ICI all-gather + on-device reduce rather than the
+  reference's hand-rolled scatter-gather over Netty RPC
+  (action/search/AbstractSearchAsyncAction.java:223).
+"""
+
+from opensearch_tpu.version import __version__  # noqa: F401

@@ -1,0 +1,1 @@
+from opensearch_tpu.analysis.registry import AnalysisRegistry, Analyzer, Token  # noqa: F401

@@ -1,0 +1,125 @@
+"""Exception hierarchy, analog of OpenSearchException and friends
+(reference: server/src/main/java/org/opensearch/OpenSearchException.java).
+
+Every exception carries an HTTP status so the REST layer can serialize it the
+way the reference's RestController does (rest/RestController.java:250) —
+``{"error": {"type": ..., "reason": ...}, "status": N}``.
+"""
+
+from __future__ import annotations
+
+
+class OpenSearchTpuError(Exception):
+    status = 500
+
+    def __init__(self, reason: str = "", **metadata):
+        super().__init__(reason)
+        self.reason = reason
+        self.metadata = metadata
+
+    @property
+    def error_type(self) -> str:
+        # CamelCase -> snake_case, mirroring the reference's error type names.
+        name = type(self).__name__
+        out = []
+        for i, ch in enumerate(name):
+            if ch.isupper() and i > 0:
+                out.append("_")
+            out.append(ch.lower())
+        return "".join(out)
+
+    def to_xcontent(self) -> dict:
+        return {
+            "error": {
+                "type": self.error_type,
+                "reason": self.reason,
+                **({"metadata": self.metadata} if self.metadata else {}),
+            },
+            "status": self.status,
+        }
+
+
+class ResourceNotFoundError(OpenSearchTpuError):
+    status = 404
+
+
+class IndexNotFoundError(ResourceNotFoundError):
+    def __init__(self, index: str):
+        super().__init__(f"no such index [{index}]", index=index)
+
+
+class DocumentMissingError(ResourceNotFoundError):
+    def __init__(self, index: str, doc_id: str):
+        super().__init__(f"[{doc_id}]: document missing", index=index)
+
+
+class ResourceAlreadyExistsError(OpenSearchTpuError):
+    status = 400
+
+
+class IndexAlreadyExistsError(ResourceAlreadyExistsError):
+    def __init__(self, index: str):
+        super().__init__(f"index [{index}] already exists", index=index)
+
+
+class ValidationError(OpenSearchTpuError):
+    """Bad request payloads (action/ValidateActions analog)."""
+
+    status = 400
+
+
+class ParsingError(ValidationError):
+    """Malformed query DSL / mapping / settings JSON
+    (core/common/ParsingException analog)."""
+
+
+class MapperParsingError(ValidationError):
+    """Document does not fit the mapping
+    (index/mapper/MapperParsingException analog)."""
+
+
+class IllegalArgumentError(ValidationError):
+    pass
+
+
+class VersionConflictError(OpenSearchTpuError):
+    """Optimistic concurrency failure (index/engine/VersionConflictEngineException)."""
+
+    status = 409
+
+    def __init__(self, doc_id: str, expected, actual):
+        super().__init__(
+            f"[{doc_id}]: version conflict, required [{expected}], current [{actual}]"
+        )
+
+
+class CircuitBreakingError(OpenSearchTpuError):
+    """Memory budget exceeded (common/breaker/CircuitBreakingException)."""
+
+    status = 429
+
+    def __init__(self, breaker: str, wanted: int, limit: int):
+        super().__init__(
+            f"[{breaker}] data for would be [{wanted}] bytes, larger than limit [{limit}]",
+            breaker=breaker,
+            bytes_wanted=wanted,
+            limit=limit,
+        )
+
+
+class TaskCancelledError(OpenSearchTpuError):
+    status = 400
+
+
+class EngineClosedError(OpenSearchTpuError):
+    status = 500
+
+
+class ShardNotFoundError(ResourceNotFoundError):
+    pass
+
+
+class NodeDisconnectedError(OpenSearchTpuError):
+    """Transport-level peer failure (transport/NodeDisconnectedException)."""
+
+    status = 500

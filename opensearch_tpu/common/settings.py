@@ -1,0 +1,297 @@
+"""Typed, validated, dynamically-updatable settings registry.
+
+Analog of the reference's Setting/ClusterSettings/IndexScopedSettings system
+(common/settings/Setting.java:107, ClusterSettings.java,
+IndexScopedSettings.java): every knob is a typed ``Setting`` with a scope, a
+default (possibly computed from other settings), an optional validator, and a
+``dynamic`` flag; registries reject unknown keys and notify update consumers
+on live changes.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Any, Callable, Generic, Iterable, Optional, TypeVar
+
+from opensearch_tpu.common.errors import IllegalArgumentError
+
+T = TypeVar("T")
+
+
+class Scope(enum.Enum):
+    NODE = "node"
+    CLUSTER = "cluster"
+    INDEX = "index"
+
+
+_TIME_UNITS = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+_BYTE_UNITS = {
+    "b": 1,
+    "kb": 1024,
+    "mb": 1024**2,
+    "gb": 1024**3,
+    "tb": 1024**4,
+}
+
+
+def parse_time(value) -> float:
+    """'30s' / '500ms' / '1m' -> seconds (common/unit/TimeValue analog)."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    s = str(value).strip().lower()
+    if s == "-1":
+        return -1.0
+    for suffix in sorted(_TIME_UNITS, key=len, reverse=True):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * _TIME_UNITS[suffix]
+    return float(s)
+
+
+def parse_bytes(value) -> int:
+    """'512mb' -> bytes (core/common/unit/ByteSizeValue analog)."""
+    if isinstance(value, (int, float)):
+        return int(value)
+    s = str(value).strip().lower()
+    if s == "-1":
+        return -1
+    for suffix in sorted(_BYTE_UNITS, key=len, reverse=True):
+        if s.endswith(suffix):
+            return int(float(s[: -len(suffix)]) * _BYTE_UNITS[suffix])
+    return int(s)
+
+
+def _parse_bool(value) -> bool:
+    if isinstance(value, bool):
+        return value
+    s = str(value).strip().lower()
+    if s in ("true", "1", "yes", "on"):
+        return True
+    if s in ("false", "0", "no", "off"):
+        return False
+    raise IllegalArgumentError(f"cannot parse boolean [{value}]")
+
+
+class Setting(Generic[T]):
+    def __init__(
+        self,
+        key: str,
+        default: Any,
+        parser: Callable[[Any], T] = lambda x: x,
+        *,
+        scope: Scope = Scope.NODE,
+        dynamic: bool = False,
+        validator: Optional[Callable[[T], None]] = None,
+    ):
+        self.key = key
+        self._default = default
+        self.parser = parser
+        self.scope = scope
+        self.dynamic = dynamic
+        self.validator = validator
+
+    def default(self, settings: "Settings") -> T:
+        raw = self._default(settings) if callable(self._default) else self._default
+        return self.parse(raw)
+
+    def parse(self, raw: Any) -> T:
+        try:
+            value = self.parser(raw)
+        except (TypeError, ValueError) as e:
+            raise IllegalArgumentError(
+                f"failed to parse value [{raw}] for setting [{self.key}]"
+            ) from e
+        if self.validator is not None:
+            self.validator(value)
+        return value
+
+    def get(self, settings: "Settings") -> T:
+        if settings.has(self.key):
+            return self.parse(settings.get_raw(self.key))
+        return self.default(settings)
+
+    # -- constructors mirroring Setting.intSetting / boolSetting / ... -----
+
+    @staticmethod
+    def int_setting(key, default, *, min_value=None, max_value=None, **kw) -> "Setting[int]":
+        def validate(v: int):
+            if min_value is not None and v < min_value:
+                raise IllegalArgumentError(f"[{key}] must be >= {min_value}, got {v}")
+            if max_value is not None and v > max_value:
+                raise IllegalArgumentError(f"[{key}] must be <= {max_value}, got {v}")
+
+        return Setting(key, default, int, validator=validate, **kw)
+
+    @staticmethod
+    def float_setting(key, default, *, min_value=None, **kw) -> "Setting[float]":
+        def validate(v: float):
+            if min_value is not None and v < min_value:
+                raise IllegalArgumentError(f"[{key}] must be >= {min_value}, got {v}")
+
+        return Setting(key, default, float, validator=validate, **kw)
+
+    @staticmethod
+    def bool_setting(key, default, **kw) -> "Setting[bool]":
+        return Setting(key, default, _parse_bool, **kw)
+
+    @staticmethod
+    def str_setting(key, default, *, choices: Optional[Iterable[str]] = None, **kw):
+        def validate(v: str):
+            if choices is not None and v not in set(choices):
+                raise IllegalArgumentError(f"[{key}] must be one of {sorted(set(choices))}, got [{v}]")
+
+        return Setting(key, default, str, validator=validate, **kw)
+
+    @staticmethod
+    def time_setting(key, default, **kw) -> "Setting[float]":
+        return Setting(key, default, parse_time, **kw)
+
+    @staticmethod
+    def byte_size_setting(key, default, **kw) -> "Setting[int]":
+        return Setting(key, default, parse_bytes, **kw)
+
+
+class Settings:
+    """Immutable flat key->raw-value map (common/settings/Settings.java).
+
+    Nested dicts are flattened to dotted keys on construction, matching the
+    reference's behavior of accepting both in yml/JSON bodies.
+    """
+
+    EMPTY: "Settings"
+
+    def __init__(self, values: Optional[dict] = None):
+        self._values: dict[str, Any] = {}
+        if values:
+            self._flatten("", values)
+
+    def _flatten(self, prefix: str, obj: dict):
+        for k, v in obj.items():
+            key = f"{prefix}{k}"
+            if isinstance(v, dict):
+                self._flatten(key + ".", v)
+            else:
+                self._values[key] = v
+
+    def has(self, key: str) -> bool:
+        return key in self._values
+
+    def get_raw(self, key: str, default=None):
+        return self._values.get(key, default)
+
+    def keys(self):
+        return self._values.keys()
+
+    def as_dict(self) -> dict:
+        return dict(self._values)
+
+    def as_nested_dict(self) -> dict:
+        root: dict = {}
+        for key, v in sorted(self._values.items()):
+            parts = key.split(".")
+            node = root
+            ok = True
+            for p in parts[:-1]:
+                nxt = node.setdefault(p, {})
+                if not isinstance(nxt, dict):
+                    ok = False
+                    break
+                node = nxt
+            if ok:
+                node[parts[-1]] = v
+            else:
+                root[key] = v
+        return root
+
+    def merged_with(self, other: "Settings | dict") -> "Settings":
+        if isinstance(other, dict):
+            other = Settings(other)
+        s = Settings()
+        s._values = {**self._values, **other._values}
+        return s
+
+    def filtered(self, predicate) -> "Settings":
+        s = Settings()
+        s._values = {k: v for k, v in self._values.items() if predicate(k)}
+        return s
+
+    def __eq__(self, other):
+        return isinstance(other, Settings) and self._values == other._values
+
+    def __repr__(self):
+        return f"Settings({self._values!r})"
+
+
+Settings.EMPTY = Settings()
+
+
+class SettingsRegistry:
+    """Registry + live-update dispatch (ClusterSettings / IndexScopedSettings).
+
+    ``apply_update`` validates that every key is registered and dynamic, then
+    calls the consumers registered via ``add_settings_update_consumer``
+    (the reference wires these at e.g. search/SearchService.java:360).
+    """
+
+    def __init__(self, settings: Settings, registered: Iterable[Setting]):
+        self._lock = threading.RLock()
+        self._registered: dict[str, Setting] = {}
+        for s in registered:
+            self.register(s)
+        self._settings = settings
+        self._consumers: list[tuple[Setting, Callable[[Any], None]]] = []
+
+    def register(self, setting: Setting):
+        with self._lock:
+            if setting.key in self._registered:
+                raise IllegalArgumentError(f"setting [{setting.key}] already registered")
+            self._registered[setting.key] = setting
+
+    @property
+    def settings(self) -> Settings:
+        return self._settings
+
+    def get(self, setting: Setting[T]) -> T:
+        return setting.get(self._settings)
+
+    def get_by_key(self, key: str):
+        setting = self._registered.get(key)
+        if setting is None:
+            raise IllegalArgumentError(f"unknown setting [{key}]")
+        return setting.get(self._settings)
+
+    def add_settings_update_consumer(self, setting: Setting[T], consumer: Callable[[T], None]):
+        with self._lock:
+            if setting.key not in self._registered:
+                raise IllegalArgumentError(f"setting [{setting.key}] not registered")
+            self._consumers.append((setting, consumer))
+
+    def validate(self, updates: dict, *, allow_static: bool = False):
+        for key, raw in updates.items():
+            setting = self._registered.get(key)
+            if setting is None:
+                raise IllegalArgumentError(
+                    f"unknown setting [{key}], please check that any required plugins"
+                    " are installed, or check the breaking changes documentation"
+                )
+            if not setting.dynamic and not allow_static:
+                raise IllegalArgumentError(f"final or non-dynamic setting [{key}], not updateable")
+            if raw is not None:
+                setting.parse(raw)
+
+    def apply_update(self, updates: dict):
+        """Apply dynamic updates; ``None`` values reset the key to default."""
+        with self._lock:
+            self.validate(updates)
+            new = dict(self._settings.as_dict())
+            for key, raw in updates.items():
+                if raw is None:
+                    new.pop(key, None)
+                else:
+                    new[key] = raw
+            old = self._settings
+            self._settings = Settings(new)
+            for setting, consumer in self._consumers:
+                if setting.key in updates:
+                    consumer(setting.get(self._settings))
+            return old

@@ -1,0 +1,2 @@
+from opensearch_tpu.mapping.mapper import DocumentMapper, ParsedDocument  # noqa: F401
+from opensearch_tpu.mapping.types import FieldType, build_field_type  # noqa: F401

@@ -1,0 +1,223 @@
+"""DocumentMapper: JSON source -> typed per-field values ready for the
+segment writer.
+
+Analog of DocumentMapper/DocumentParser (index/mapper/DocumentMapper.java:247,
+DocumentParser.java): walks the JSON tree, resolves dotted paths against the
+mapping, applies dynamic mapping for unseen fields, supports multi-fields
+(``fields.keyword`` sub-fields) and arrays (multi-valued fields).
+
+Output is a ``ParsedDocument`` holding, per field:
+- ``tokens``:  [(term, position)] destined for the inverted index
+- ``longs`` / ``doubles`` / ``ordinals``: doc-value scalars (first value wins
+  the column slot; all values are indexed as terms)
+- ``vectors``: dense float vectors
+- ``geo_points``: (lat, lon) pairs
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Optional
+
+from opensearch_tpu.analysis import AnalysisRegistry
+from opensearch_tpu.common.errors import MapperParsingError
+from opensearch_tpu.mapping.types import (
+    FieldType,
+    TextFieldType,
+    build_field_type,
+)
+
+POSITION_GAP = 100  # position increment between array elements (Lucene default)
+
+
+@dataclass
+class ParsedDocument:
+    doc_id: str
+    source: dict
+    routing: Optional[str] = None
+    tokens: dict[str, list[tuple[str, int]]] = dc_field(default_factory=dict)
+    longs: dict[str, int] = dc_field(default_factory=dict)
+    doubles: dict[str, float] = dc_field(default_factory=dict)
+    ordinals: dict[str, str] = dc_field(default_factory=dict)
+    vectors: dict[str, list[float]] = dc_field(default_factory=dict)
+    geo_points: dict[str, tuple[float, float]] = dc_field(default_factory=dict)
+    field_lengths: dict[str, int] = dc_field(default_factory=dict)  # for BM25 norms
+
+
+def _dynamic_type_for(value: Any) -> Optional[dict]:
+    """Dynamic mapping inference (DocumentParser dynamic templates default)."""
+    if isinstance(value, bool):
+        return {"type": "boolean"}
+    if isinstance(value, int):
+        return {"type": "long"}
+    if isinstance(value, float):
+        return {"type": "float"}
+    if isinstance(value, str):
+        # Reference default: text with a .keyword sub-field (ignore_above 256).
+        return {"type": "text", "fields": {"keyword": {"type": "keyword", "ignore_above": 256}}}
+    return None
+
+
+class DocumentMapper:
+    """Holds the field-type lookup for one index and parses documents.
+
+    Thread-safe for concurrent parse + dynamic mapping update (the engine may
+    index from several threads, like the reference's write threadpool).
+    """
+
+    def __init__(self, mapping: Optional[dict] = None, analysis_settings: Optional[dict] = None):
+        self._lock = threading.RLock()
+        self.analyzers = AnalysisRegistry(analysis_settings)
+        self._fields: dict[str, FieldType] = {}
+        self._field_configs: dict[str, dict] = {}
+        self.dynamic = True
+        if mapping:
+            self.merge(mapping)
+
+    # --- mapping management ---------------------------------------------
+
+    def merge(self, mapping: dict):
+        """Merge a mapping update (PutMappingRequest analog).  Conflicting
+        type changes are rejected like MapperService.merge does."""
+        with self._lock:
+            dynamic = mapping.get("dynamic", self.dynamic)
+            self.dynamic = dynamic if isinstance(dynamic, bool) else str(dynamic).lower() != "false"
+            props = mapping.get("properties", mapping if "properties" not in mapping else {})
+            self._merge_props("", props)
+
+    def _merge_props(self, prefix: str, props: dict):
+        for name, config in props.items():
+            path = f"{prefix}{name}"
+            if "properties" in config and "type" not in config:
+                self._merge_props(path + ".", config["properties"])
+                continue
+            existing = self._fields.get(path)
+            ft = build_field_type(path, config)
+            if existing is not None and existing.type_name != ft.type_name:
+                raise MapperParsingError(
+                    f"mapper [{path}] cannot be changed from type [{existing.type_name}]"
+                    f" to [{ft.type_name}]"
+                )
+            self._fields[path] = ft
+            self._field_configs[path] = config
+            for sub_name, sub_config in (config.get("fields") or {}).items():
+                sub_path = f"{path}.{sub_name}"
+                self._fields[sub_path] = build_field_type(sub_path, sub_config)
+
+    def field_type(self, path: str) -> Optional[FieldType]:
+        return self._fields.get(path)
+
+    def field_types(self) -> dict[str, FieldType]:
+        with self._lock:
+            return dict(self._fields)
+
+    def to_mapping(self) -> dict:
+        """Render the current mapping back to JSON (GetMappings analog)."""
+        with self._lock:
+            root: dict = {}
+            for path, config in sorted(self._field_configs.items()):
+                parts = path.split(".")
+                node = root
+                for p in parts[:-1]:
+                    node = node.setdefault(p, {}).setdefault("properties", {})
+                node[parts[-1]] = dict(config)
+            return {"properties": root}
+
+    # --- parsing ---------------------------------------------------------
+
+    def parse(self, doc_id: str, source: dict, routing: Optional[str] = None) -> ParsedDocument:
+        doc = ParsedDocument(doc_id=doc_id, source=source, routing=routing)
+        self._parse_object("", source, doc)
+        return doc
+
+    def _parse_object(self, prefix: str, obj: dict, doc: ParsedDocument):
+        for key, value in obj.items():
+            path = f"{prefix}{key}"
+            if isinstance(value, dict) and self._fields.get(path) is None:
+                self._parse_object(path + ".", value, doc)
+                continue
+            values = value if isinstance(value, list) else [value]
+            ft = self._resolve(path, values)
+            if ft is None:
+                continue
+            # A numeric array IS the single value for vector and geo fields.
+            if ft.dv_kind in ("vector", "geo_point") and isinstance(value, list):
+                values = [value]
+            self._index_values(ft, values, doc)
+            # multi-fields share the same raw values
+            for sub_path, sub_ft in self._subfields(path):
+                self._index_values(sub_ft, values, doc)
+
+    def _subfields(self, path: str):
+        prefix = path + "."
+        return [
+            (p, ft)
+            for p, ft in self._fields.items()
+            if p.startswith(prefix)
+            and "." not in p[len(prefix):]
+            and p not in self._field_configs  # only multi-field children
+        ]
+
+    def _resolve(self, path: str, values: list) -> Optional[FieldType]:
+        with self._lock:
+            ft = self._fields.get(path)
+            if ft is not None:
+                return ft
+            if not self.dynamic:
+                return None
+            sample = next((v for v in values if v is not None), None)
+            if sample is None:
+                return None
+            if isinstance(sample, dict):
+                return None  # handled by recursion
+            config = _dynamic_type_for(sample)
+            if config is None:
+                return None
+            self._merge_props("", _nest(path, config))
+            return self._fields[path]
+
+    def _index_values(self, ft: FieldType, values: list, doc: ParsedDocument):
+        pos_base = 0
+        n_tokens = doc.field_lengths.get(ft.name, 0)
+        toks = doc.tokens.setdefault(ft.name, [])
+        if toks:
+            pos_base = toks[-1][1] + POSITION_GAP
+        for v in values:
+            if v is None:
+                continue
+            if ft.index_enabled and ft.indexed:
+                terms = ft.index_terms(v, self.analyzers)
+                for term, pos in terms:
+                    toks.append((term, pos_base + pos))
+                if terms:
+                    pos_base = toks[-1][1] + POSITION_GAP
+                if isinstance(ft, TextFieldType):
+                    n_tokens += len(terms)
+            if ft.doc_values_enabled:
+                dv = ft.doc_value(v)
+                if dv is None:
+                    continue
+                kind = ft.dv_kind
+                if kind == "long":
+                    doc.longs.setdefault(ft.name, dv)
+                elif kind == "double":
+                    doc.doubles.setdefault(ft.name, dv)
+                elif kind == "ordinal":
+                    doc.ordinals.setdefault(ft.name, dv)
+                elif kind == "vector":
+                    doc.vectors.setdefault(ft.name, dv)
+                elif kind == "geo_point":
+                    doc.geo_points.setdefault(ft.name, dv)
+        if not toks:
+            doc.tokens.pop(ft.name, None)
+        if isinstance(ft, TextFieldType):
+            doc.field_lengths[ft.name] = n_tokens
+
+
+def _nest(path: str, config: dict) -> dict:
+    parts = path.split(".")
+    out: dict = {parts[-1]: config}
+    for p in reversed(parts[:-1]):
+        out = {p: {"properties": out}}
+    return out

@@ -1,0 +1,390 @@
+"""Field types: how a JSON value becomes index terms + doc-value columns and
+how query-time literals are converted for comparison.
+
+Analog of the reference's MappedFieldType hierarchy
+(index/mapper/MappedFieldType.java and the ~30 concrete mappers in
+index/mapper/).  The TPU twist: every field type declares which *columnar*
+representation its doc values take (int64 / float64 / ordinal), because
+filters, sorts and aggregations execute as dense vectorized ops over those
+columns on device, not via per-doc iterators.
+
+Doc-value column kinds:
+- ``long``    -> int64 column (longs, dates as epoch millis, booleans as 0/1, ips)
+- ``double``  -> float64 column
+- ``ordinal`` -> int32 ordinal column + per-segment sorted term dict (keywords)
+- ``none``    -> no column (text fields: inverted index only, like Lucene
+                 text fields without fielddata)
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import ipaddress
+import math
+from typing import Any, Optional
+
+from opensearch_tpu.common.errors import IllegalArgumentError, MapperParsingError
+
+
+def parse_date_millis(value: Any) -> int:
+    """Parse a date literal to epoch millis.
+
+    Supports epoch_millis (int), ISO-8601 date/date-time (the reference's
+    default ``strict_date_optional_time||epoch_millis`` format,
+    index/mapper/DateFieldMapper.java), and date-only strings.
+    """
+    if isinstance(value, bool):
+        raise MapperParsingError(f"cannot parse date from boolean [{value}]")
+    if isinstance(value, (int, float)):
+        return int(value)
+    s = str(value).strip()
+    if s.isdigit() or (s.startswith("-") and s[1:].isdigit()):
+        return int(s)
+    txt = s.replace("Z", "+00:00")
+    try:
+        if "T" in txt or " " in txt:
+            dt = _dt.datetime.fromisoformat(txt)
+        else:
+            dt = _dt.datetime.fromisoformat(txt + "T00:00:00")
+    except ValueError as e:
+        raise MapperParsingError(f"failed to parse date field [{value}]") from e
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=_dt.timezone.utc)
+    return int(dt.timestamp() * 1000)
+
+
+def format_date_millis(millis: int) -> str:
+    dt = _dt.datetime.fromtimestamp(millis / 1000.0, tz=_dt.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
+
+
+def parse_ip_long(value: Any) -> int:
+    """IPs are stored as a single int64 doc value.  IPv4 fits exactly; IPv6 is
+    reduced to its top 64 bits (range semantics preserved within each family).
+    """
+    addr = ipaddress.ip_address(str(value))
+    as_int = int(addr)
+    if addr.version == 4:
+        return as_int
+    return (as_int >> 64) | (1 << 62)  # bias v6 above all v4
+
+
+_LONG_RANGE = {
+    "long": (-(2**63), 2**63 - 1),
+    "integer": (-(2**31), 2**31 - 1),
+    "short": (-(2**15), 2**15 - 1),
+    "byte": (-128, 127),
+}
+
+
+class FieldType:
+    """Base field type.  Subclasses override the class attrs + converters."""
+
+    type_name = "base"
+    dv_kind = "none"  # long | double | ordinal | none
+    indexed = True  # produces inverted-index terms
+
+    def __init__(self, name: str, params: Optional[dict] = None):
+        self.name = name
+        self.params = params or {}
+        self.boost = float(self.params.get("boost", 1.0))
+        self.doc_values_enabled = bool(self.params.get("doc_values", True))
+        self.index_enabled = bool(self.params.get("index", True))
+        self.store = bool(self.params.get("store", False))
+
+    # --- indexing --------------------------------------------------------
+
+    def index_terms(self, value: Any, analyzers) -> list[tuple[str, int]]:
+        """Value -> [(term, position)] for the inverted index."""
+        raise NotImplementedError
+
+    def doc_value(self, value: Any):
+        """Value -> column scalar (int for long-kind, float for double-kind,
+        str for ordinal-kind)."""
+        return None
+
+    # --- query time ------------------------------------------------------
+
+    def term_for_query(self, value: Any) -> str:
+        """Literal in a term query -> indexed term string."""
+        return str(value)
+
+    def range_bound(self, value: Any):
+        """Literal in a range query -> comparable column scalar."""
+        raise IllegalArgumentError(f"field [{self.name}] of type [{self.type_name}] does not support range queries")
+
+    def to_mapping(self) -> dict:
+        return {"type": self.type_name, **{k: v for k, v in self.params.items()}}
+
+
+class TextFieldType(FieldType):
+    type_name = "text"
+    dv_kind = "none"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.analyzer_name = self.params.get("analyzer", "standard")
+        self.search_analyzer_name = self.params.get("search_analyzer", self.analyzer_name)
+
+    def index_terms(self, value, analyzers):
+        if value is None:
+            return []
+        analyzer = analyzers.get(self.analyzer_name)
+        return [(t.term, t.position) for t in analyzer.analyze(str(value))]
+
+    def search_terms(self, value, analyzers) -> list[str]:
+        analyzer = analyzers.get(self.search_analyzer_name)
+        return analyzer.terms(str(value))
+
+
+class KeywordFieldType(FieldType):
+    type_name = "keyword"
+    dv_kind = "ordinal"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.ignore_above = int(self.params.get("ignore_above", 2**31 - 1))
+
+    def index_terms(self, value, analyzers):
+        if value is None:
+            return []
+        s = str(value)
+        if len(s) > self.ignore_above:
+            return []
+        return [(s, 0)]
+
+    def doc_value(self, value):
+        if value is None:
+            return None
+        s = str(value)
+        return None if len(s) > self.ignore_above else s
+
+    def range_bound(self, value):
+        return str(value)
+
+
+class _NumericFieldType(FieldType):
+    def _coerce(self, value):
+        raise NotImplementedError
+
+    def index_terms(self, value, analyzers):
+        # Numerics are matched via doc-value columns (the Lucene points
+        # analog), not postings; term/terms queries on them compare columns.
+        return []
+
+    def doc_value(self, value):
+        return None if value is None else self._coerce(value)
+
+    def term_for_query(self, value):
+        return self._coerce(value)
+
+    def range_bound(self, value):
+        return self._coerce(value)
+
+
+class LongFieldType(_NumericFieldType):
+    type_name = "long"
+    dv_kind = "long"
+
+    def _coerce(self, value):
+        if isinstance(value, bool):
+            raise MapperParsingError(f"cannot coerce boolean to [{self.type_name}] for field [{self.name}]")
+        try:
+            f = float(value)
+        except (TypeError, ValueError) as e:
+            raise MapperParsingError(f"failed to parse field [{self.name}] of type [{self.type_name}]: [{value}]") from e
+        if math.isnan(f) or math.isinf(f):
+            raise MapperParsingError(f"[{self.name}] cannot index [{value}]")
+        v = int(f)
+        lo, hi = _LONG_RANGE.get(self.type_name, _LONG_RANGE["long"])
+        if not (lo <= v <= hi):
+            raise MapperParsingError(f"value [{value}] out of range for [{self.type_name}] field [{self.name}]")
+        return v
+
+
+class IntegerFieldType(LongFieldType):
+    type_name = "integer"
+
+
+class ShortFieldType(LongFieldType):
+    type_name = "short"
+
+
+class ByteFieldType(LongFieldType):
+    type_name = "byte"
+
+
+class DoubleFieldType(_NumericFieldType):
+    type_name = "double"
+    dv_kind = "double"
+
+    def _coerce(self, value):
+        if isinstance(value, bool):
+            raise MapperParsingError(f"cannot coerce boolean to [{self.type_name}] for field [{self.name}]")
+        try:
+            return float(value)
+        except (TypeError, ValueError) as e:
+            raise MapperParsingError(f"failed to parse field [{self.name}] of type [{self.type_name}]: [{value}]") from e
+
+
+class FloatFieldType(DoubleFieldType):
+    type_name = "float"
+
+
+class HalfFloatFieldType(DoubleFieldType):
+    type_name = "half_float"
+
+
+class ScaledFloatFieldType(_NumericFieldType):
+    """reference: modules/mapper-extras ScaledFloatFieldMapper — stored as
+    long = round(value * scaling_factor)."""
+
+    type_name = "scaled_float"
+    dv_kind = "long"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.scaling_factor = float(self.params.get("scaling_factor", 1.0))
+
+    def _coerce(self, value):
+        return round(float(value) * self.scaling_factor)
+
+
+class BooleanFieldType(FieldType):
+    type_name = "boolean"
+    dv_kind = "long"
+
+    def _coerce(self, value) -> int:
+        if isinstance(value, bool):
+            return int(value)
+        s = str(value).strip().lower()
+        if s == "true":
+            return 1
+        if s in ("false", ""):
+            return 0
+        raise MapperParsingError(f"failed to parse boolean field [{self.name}]: [{value}]")
+
+    def index_terms(self, value, analyzers):
+        if value is None:
+            return []
+        return [("T" if self._coerce(value) else "F", 0)]
+
+    def doc_value(self, value):
+        return None if value is None else self._coerce(value)
+
+    def term_for_query(self, value):
+        return "T" if self._coerce(value) else "F"
+
+    def range_bound(self, value):
+        return self._coerce(value)
+
+
+class DateFieldType(FieldType):
+    type_name = "date"
+    dv_kind = "long"
+
+    def index_terms(self, value, analyzers):
+        return []
+
+    def doc_value(self, value):
+        return None if value is None else parse_date_millis(value)
+
+    def term_for_query(self, value):
+        return parse_date_millis(value)
+
+    def range_bound(self, value):
+        return parse_date_millis(value)
+
+
+class IpFieldType(FieldType):
+    type_name = "ip"
+    dv_kind = "long"
+
+    def index_terms(self, value, analyzers):
+        if value is None:
+            return []
+        return [(str(ipaddress.ip_address(str(value))), 0)]
+
+    def doc_value(self, value):
+        return None if value is None else parse_ip_long(value)
+
+    def range_bound(self, value):
+        # CIDR bounds are handled by the query layer expanding to a range.
+        return parse_ip_long(value)
+
+
+class DenseVectorFieldType(FieldType):
+    """k-NN vector field (the out-of-tree opensearch-knn plugin's
+    ``knn_vector``; we accept both ``dense_vector`` and ``knn_vector``)."""
+
+    type_name = "dense_vector"
+    dv_kind = "vector"
+    indexed = False
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.dims = int(self.params.get("dims") or self.params.get("dimension") or 0)
+        if self.dims <= 0:
+            raise MapperParsingError(f"dense_vector field [{name}] requires [dims]")
+        space = self.params.get("space_type") or self.params.get("similarity") or "l2"
+        self.space_type = {"l2_norm": "l2", "dot_product": "innerproduct", "cosine": "cosinesimil"}.get(space, space)
+
+    def index_terms(self, value, analyzers):
+        return []
+
+    def doc_value(self, value):
+        if value is None:
+            return None
+        vec = [float(x) for x in value]
+        if len(vec) != self.dims:
+            raise MapperParsingError(
+                f"vector length [{len(vec)}] does not match [dims]=[{self.dims}] for field [{self.name}]"
+            )
+        return vec
+
+
+class GeoPointFieldType(FieldType):
+    """Stored as two float64 columns (lat, lon); distance filters/aggs are
+    vectorized haversine over the columns (reference: GeoPointFieldMapper)."""
+
+    type_name = "geo_point"
+    dv_kind = "geo_point"
+
+    def index_terms(self, value, analyzers):
+        return []
+
+    def doc_value(self, value):
+        if value is None:
+            return None
+        if isinstance(value, dict):
+            return (float(value["lat"]), float(value["lon"]))
+        if isinstance(value, str):
+            if "," in value:
+                lat, lon = value.split(",")
+                return (float(lat), float(lon))
+            raise MapperParsingError(f"geohash not supported for field [{self.name}]")
+        if isinstance(value, (list, tuple)):  # GeoJSON order [lon, lat]
+            return (float(value[1]), float(value[0]))
+        raise MapperParsingError(f"cannot parse geo_point [{value}]")
+
+
+FIELD_TYPES = {
+    cls.type_name: cls
+    for cls in [
+        TextFieldType, KeywordFieldType, LongFieldType, IntegerFieldType,
+        ShortFieldType, ByteFieldType, DoubleFieldType, FloatFieldType,
+        HalfFloatFieldType, ScaledFloatFieldType, BooleanFieldType,
+        DateFieldType, IpFieldType, DenseVectorFieldType, GeoPointFieldType,
+    ]
+}
+FIELD_TYPES["knn_vector"] = DenseVectorFieldType
+
+
+def build_field_type(name: str, config: dict) -> FieldType:
+    type_name = config.get("type")
+    if type_name is None:
+        raise MapperParsingError(f"no type specified for field [{name}]")
+    cls = FIELD_TYPES.get(type_name)
+    if cls is None:
+        raise MapperParsingError(f"No handler for type [{type_name}] declared on field [{name}]")
+    return cls(name, {k: v for k, v in config.items() if k not in ("type", "fields", "properties")})

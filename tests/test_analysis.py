@@ -1,0 +1,108 @@
+import pytest
+
+from opensearch_tpu.analysis import AnalysisRegistry
+from opensearch_tpu.analysis.porter import stem
+from opensearch_tpu.common.errors import IllegalArgumentError
+
+
+@pytest.fixture
+def registry():
+    return AnalysisRegistry()
+
+
+def test_standard_analyzer_lowercases_and_splits(registry):
+    assert registry.get("standard").terms("The Quick-Brown FOX, 42 jumps!") == [
+        "the", "quick", "brown", "fox", "42", "jumps",
+    ]
+
+
+def test_standard_positions_and_offsets(registry):
+    tokens = registry.get("standard").analyze("hello brave world")
+    assert [(t.term, t.position) for t in tokens] == [
+        ("hello", 0), ("brave", 1), ("world", 2),
+    ]
+    assert tokens[1].start_offset == 6 and tokens[1].end_offset == 11
+
+
+def test_whitespace_keeps_punctuation(registry):
+    assert registry.get("whitespace").terms("Hello, world!") == ["Hello,", "world!"]
+
+
+def test_keyword_analyzer_single_token(registry):
+    assert registry.get("keyword").terms("New York City") == ["New York City"]
+
+
+def test_simple_analyzer_drops_digits(registry):
+    assert registry.get("simple").terms("abc 123 def") == ["abc", "def"]
+
+
+def test_english_analyzer_stems_and_stops(registry):
+    terms = registry.get("english").terms("The running dogs are jumping quickly")
+    assert "the" not in terms and "are" not in terms
+    assert "run" in terms and "dog" in terms and "jump" in terms
+
+
+def test_porter_stemmer_classic_cases():
+    cases = {
+        "caresses": "caress", "ponies": "poni", "ties": "ti", "caress": "caress",
+        "cats": "cat", "feed": "feed", "agreed": "agre", "plastered": "plaster",
+        "motoring": "motor", "sing": "sing", "conflated": "conflat",
+        "troubled": "troubl", "sized": "size", "hopping": "hop", "falling": "fall",
+        "happy": "happi", "relational": "relat", "conditional": "condit",
+        "vietnamization": "vietnam", "predication": "predic",
+        "electrical": "electr", "hopefulness": "hope", "goodness": "good",
+        "formalize": "formal", "triplicate": "triplic", "formative": "form",
+        "revival": "reviv", "allowance": "allow", "inference": "infer",
+        "adjustment": "adjust", "probate": "probat", "cease": "ceas",
+        "controll": "control", "roll": "roll",
+    }
+    for word, expected in cases.items():
+        assert stem(word) == expected, f"{word} -> {stem(word)} != {expected}"
+
+
+def test_custom_analyzer_from_settings():
+    reg = AnalysisRegistry(
+        {
+            "filter": {"my_stop": {"type": "stop", "stopwords": ["foo"]}},
+            "analyzer": {
+                "my_analyzer": {
+                    "type": "custom",
+                    "tokenizer": "whitespace",
+                    "filter": ["lowercase", "my_stop"],
+                }
+            },
+        }
+    )
+    assert reg.get("my_analyzer").terms("FOO Bar baz") == ["bar", "baz"]
+
+
+def test_html_strip_char_filter():
+    reg = AnalysisRegistry(
+        {
+            "analyzer": {
+                "html": {
+                    "type": "custom",
+                    "tokenizer": "standard",
+                    "filter": ["lowercase"],
+                    "char_filter": ["html_strip"],
+                }
+            }
+        }
+    )
+    assert reg.get("html").terms("<p>Hello <b>World</b></p>") == ["hello", "world"]
+
+
+def test_unknown_analyzer_raises(registry):
+    with pytest.raises(IllegalArgumentError):
+        registry.get("nope")
+
+
+def test_shingle_filter():
+    reg = AnalysisRegistry(
+        {
+            "analyzer": {
+                "sh": {"type": "custom", "tokenizer": "whitespace", "filter": ["shingle"]}
+            }
+        }
+    )
+    assert set(reg.get("sh").terms("a b c")) == {"a", "b", "c", "a b", "b c"}

@@ -1,0 +1,118 @@
+import pytest
+
+from opensearch_tpu.common.errors import MapperParsingError
+from opensearch_tpu.mapping import DocumentMapper
+from opensearch_tpu.mapping.types import parse_date_millis, parse_ip_long
+
+
+MAPPING = {
+    "properties": {
+        "title": {"type": "text", "analyzer": "standard"},
+        "tags": {"type": "keyword"},
+        "views": {"type": "long"},
+        "rating": {"type": "double"},
+        "published": {"type": "date"},
+        "active": {"type": "boolean"},
+        "addr": {"type": "ip"},
+        "embedding": {"type": "dense_vector", "dims": 4},
+        "author": {"properties": {"name": {"type": "keyword"}}},
+    }
+}
+
+
+@pytest.fixture
+def mapper():
+    return DocumentMapper(MAPPING)
+
+
+def test_text_field_tokenized(mapper):
+    doc = mapper.parse("1", {"title": "Hello Brave World"})
+    assert [t for t, _ in doc.tokens["title"]] == ["hello", "brave", "world"]
+    assert doc.field_lengths["title"] == 3
+
+
+def test_keyword_not_tokenized(mapper):
+    doc = mapper.parse("1", {"tags": "New York"})
+    assert doc.tokens["tags"] == [("New York", 0)]
+    assert doc.ordinals["tags"] == "New York"
+
+
+def test_numeric_date_bool_ip_doc_values(mapper):
+    doc = mapper.parse(
+        "1",
+        {"views": 42, "rating": 4.5, "published": "2024-01-15", "active": True, "addr": "10.0.0.1"},
+    )
+    assert doc.longs["views"] == 42
+    assert doc.doubles["rating"] == 4.5
+    assert doc.longs["published"] == parse_date_millis("2024-01-15")
+    assert doc.longs["active"] == 1
+    assert doc.longs["addr"] == parse_ip_long("10.0.0.1")
+
+
+def test_nested_object_path(mapper):
+    doc = mapper.parse("1", {"author": {"name": "kafka"}})
+    assert doc.ordinals["author.name"] == "kafka"
+
+
+def test_array_values_multi_token_with_position_gap(mapper):
+    doc = mapper.parse("1", {"title": ["foo bar", "baz"]})
+    terms = [t for t, _ in doc.tokens["title"]]
+    assert terms == ["foo", "bar", "baz"]
+    positions = [p for _, p in doc.tokens["title"]]
+    assert positions[2] - positions[1] >= 100  # array position gap
+
+
+def test_dense_vector_dims_checked(mapper):
+    doc = mapper.parse("1", {"embedding": [1, 2, 3, 4]})
+    assert doc.vectors["embedding"] == [1.0, 2.0, 3.0, 4.0]
+    with pytest.raises(MapperParsingError):
+        mapper.parse("2", {"embedding": [1, 2]})
+
+
+def test_dynamic_mapping_string_gets_keyword_subfield():
+    mapper = DocumentMapper()
+    doc = mapper.parse("1", {"city": "San Francisco", "count": 3, "score": 1.5, "flag": False})
+    assert [t for t, _ in doc.tokens["city"]] == ["san", "francisco"]
+    assert doc.ordinals["city.keyword"] == "San Francisco"
+    assert doc.longs["count"] == 3
+    assert doc.doubles["score"] == 1.5
+    assert doc.longs["flag"] == 0
+    m = mapper.to_mapping()["properties"]
+    assert m["city"]["type"] == "text"
+    assert m["count"]["type"] == "long"
+
+
+def test_dynamic_false_ignores_unknown():
+    mapper = DocumentMapper({"dynamic": False, "properties": {"a": {"type": "long"}}})
+    doc = mapper.parse("1", {"a": 1, "unknown": "x"})
+    assert doc.longs["a"] == 1
+    assert "unknown" not in doc.tokens and "unknown" not in doc.ordinals
+
+
+def test_type_conflict_rejected(mapper):
+    with pytest.raises(MapperParsingError):
+        mapper.merge({"properties": {"views": {"type": "text"}}})
+
+
+def test_out_of_range_integer():
+    mapper = DocumentMapper({"properties": {"n": {"type": "short"}}})
+    with pytest.raises(MapperParsingError):
+        mapper.parse("1", {"n": 1 << 20})
+
+
+def test_ignore_above_keyword():
+    mapper = DocumentMapper({"properties": {"k": {"type": "keyword", "ignore_above": 3}}})
+    doc = mapper.parse("1", {"k": "toolong"})
+    assert "k" not in doc.tokens and "k" not in doc.ordinals
+
+
+def test_date_formats():
+    assert parse_date_millis("2024-01-15T10:30:00Z") == parse_date_millis("2024-01-15T10:30:00+00:00")
+    assert parse_date_millis(1700000000000) == 1700000000000
+    assert parse_date_millis("2024-01-15") % 86400000 == 0
+
+
+def test_multifield_roundtrip_mapping(mapper):
+    mapper2 = DocumentMapper(mapper.to_mapping())
+    doc = mapper2.parse("1", {"tags": "x", "views": 1})
+    assert doc.ordinals["tags"] == "x"

@@ -1,0 +1,75 @@
+import pytest
+
+from opensearch_tpu.common.errors import IllegalArgumentError
+from opensearch_tpu.common.settings import (
+    Setting,
+    Settings,
+    SettingsRegistry,
+    parse_bytes,
+    parse_time,
+)
+
+
+def test_parse_time_units():
+    assert parse_time("500ms") == 0.5
+    assert parse_time("30s") == 30.0
+    assert parse_time("2m") == 120.0
+    assert parse_time("1h") == 3600.0
+    assert parse_time(5) == 5.0
+    assert parse_time("-1") == -1.0
+
+
+def test_parse_bytes_units():
+    assert parse_bytes("1kb") == 1024
+    assert parse_bytes("512mb") == 512 * 1024**2
+    assert parse_bytes("2gb") == 2 * 1024**3
+    assert parse_bytes(100) == 100
+
+
+def test_settings_flatten_and_nest():
+    s = Settings({"index": {"number_of_shards": 4, "refresh_interval": "1s"}})
+    assert s.get_raw("index.number_of_shards") == 4
+    assert s.as_nested_dict() == {
+        "index": {"number_of_shards": 4, "refresh_interval": "1s"}
+    }
+
+
+def test_typed_setting_defaults_and_validation():
+    shards = Setting.int_setting("index.number_of_shards", 1, min_value=1, max_value=1024)
+    assert shards.get(Settings.EMPTY) == 1
+    assert shards.get(Settings({"index.number_of_shards": "8"})) == 8
+    with pytest.raises(IllegalArgumentError):
+        shards.get(Settings({"index.number_of_shards": 0}))
+
+
+def test_computed_default():
+    replicas = Setting.int_setting("index.number_of_replicas", 1)
+    derived = Setting(
+        "index.auto_expand_floor",
+        lambda s: replicas.get(s) + 1,
+        int,
+    )
+    assert derived.get(Settings({"index.number_of_replicas": 3})) == 4
+
+
+def test_registry_rejects_unknown_and_non_dynamic():
+    static = Setting.int_setting("node.workers", 4)
+    dyn = Setting.bool_setting("cluster.routing.allocation.enable", True, dynamic=True)
+    reg = SettingsRegistry(Settings.EMPTY, [static, dyn])
+    with pytest.raises(IllegalArgumentError):
+        reg.apply_update({"bogus.key": 1})
+    with pytest.raises(IllegalArgumentError):
+        reg.apply_update({"node.workers": 8})
+    reg.apply_update({"cluster.routing.allocation.enable": "false"})
+    assert reg.get(dyn) is False
+
+
+def test_registry_update_consumer_fires():
+    dyn = Setting.time_setting("index.refresh_interval", "1s", dynamic=True)
+    reg = SettingsRegistry(Settings.EMPTY, [dyn])
+    seen = []
+    reg.add_settings_update_consumer(dyn, seen.append)
+    reg.apply_update({"index.refresh_interval": "5s"})
+    assert seen == [5.0]
+    reg.apply_update({"index.refresh_interval": None})  # reset to default
+    assert seen == [5.0, 1.0]
