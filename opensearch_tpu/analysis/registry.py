@@ -57,17 +57,55 @@ def _keyword_tokenizer(text: str) -> list[Token]:
     return [Token(text, 0, 0, len(text))] if text else []
 
 
-def _ngram_tokenizer(min_gram: int, max_gram: int) -> Callable[[str], list[Token]]:
+def _ngram_tokenizer(min_gram: int, max_gram: int, edge: bool = False) -> Callable[[str], list[Token]]:
     def tokenize(text: str) -> list[Token]:
         out = []
         pos = 0
         for n in range(min_gram, max_gram + 1):
-            for i in range(0, len(text) - n + 1):
+            if n > len(text):
+                break
+            upper = 1 if edge else len(text) - n + 1
+            for i in range(0, max(0, upper)):
                 out.append(Token(text[i : i + n], pos, i, i + n))
                 pos += 1
         return out
 
     return tokenize
+
+
+def _pattern_split_tokenizer(pattern: str) -> Callable[[str], list[Token]]:
+    """OpenSearch ``pattern`` tokenizer: the pattern is the *separator*."""
+    sep = re.compile(pattern)
+
+    def tokenize(text: str) -> list[Token]:
+        out = []
+        pos = 0
+        last = 0
+        for m in sep.finditer(text):
+            if m.start() > last:
+                out.append(Token(text[last : m.start()], pos, last, m.start()))
+                pos += 1
+            last = m.end()
+        if last < len(text):
+            out.append(Token(text[last:], pos, last, len(text)))
+        return out
+
+    return tokenize
+
+
+def _build_tokenizer(name: str, tcfg: dict) -> Callable[[str], list[Token]]:
+    ttype = tcfg.get("type", name)
+    if ttype in ("ngram", "nGram", "edge_ngram", "edgeNGram"):
+        return _ngram_tokenizer(
+            int(tcfg.get("min_gram", 1)),
+            int(tcfg.get("max_gram", 2)),
+            edge=ttype in ("edge_ngram", "edgeNGram"),
+        )
+    if ttype == "pattern":
+        return _pattern_split_tokenizer(tcfg.get("pattern", r"\W+"))
+    if ttype in TOKENIZERS:
+        return TOKENIZERS[ttype]
+    raise IllegalArgumentError(f"unknown tokenizer type [{ttype}]")
 
 
 TOKENIZERS: dict[str, Callable] = {
@@ -206,6 +244,9 @@ class AnalysisRegistry:
     def __init__(self, analysis_settings: Optional[dict] = None):
         self._analyzers = _builtin_analyzers()
         cfg = analysis_settings or {}
+        custom_tokenizers: dict[str, Callable] = {}
+        for name, tcfg in (cfg.get("tokenizer") or {}).items():
+            custom_tokenizers[name] = _build_tokenizer(name, tcfg)
         custom_filters: dict[str, Callable] = {}
         for name, fcfg in (cfg.get("filter") or {}).items():
             ftype = fcfg.get("type", name)
@@ -221,8 +262,9 @@ class AnalysisRegistry:
                     continue
                 raise IllegalArgumentError(f"unknown analyzer type [{atype}]")
             tok_name = acfg.get("tokenizer", "standard")
-            tokenizer = TOKENIZERS.get(tok_name)
+            tokenizer = custom_tokenizers.get(tok_name) or TOKENIZERS.get(tok_name)
             if tokenizer is None and tok_name == "ngram":
+                # legacy shorthand: ngram params inline on the analyzer config
                 tokenizer = _ngram_tokenizer(
                     int(acfg.get("min_gram", 1)), int(acfg.get("max_gram", 2))
                 )

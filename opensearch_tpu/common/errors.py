@@ -78,6 +78,16 @@ class MapperParsingError(ValidationError):
     (index/mapper/MapperParsingException analog)."""
 
 
+class StrictDynamicMappingError(MapperParsingError):
+    """Unmapped field under ``dynamic: strict``
+    (index/mapper/StrictDynamicMappingException analog)."""
+
+    def __init__(self, path: str):
+        super().__init__(
+            f"mapping set to strict, dynamic introduction of [{path}] is not allowed"
+        )
+
+
 class IllegalArgumentError(ValidationError):
     pass
 

@@ -8,10 +8,14 @@ mapping, applies dynamic mapping for unseen fields, supports multi-fields
 
 Output is a ``ParsedDocument`` holding, per field:
 - ``tokens``:  [(term, position)] destined for the inverted index
-- ``longs`` / ``doubles`` / ``ordinals``: doc-value scalars (first value wins
-  the column slot; all values are indexed as terms)
-- ``vectors``: dense float vectors
+- ``longs`` / ``doubles`` / ``ordinals``: multi-valued doc-value lists
+  (the SortedNumericDocValues / SortedSetDocValues analog — every value
+  lands in the column, matching Lucene array-field semantics)
+- ``vectors``: dense float vectors (single-valued, like Lucene KnnVectorField)
 - ``geo_points``: (lat, lon) pairs
+
+Metadata slots (``_seq_no`` / ``_version`` analog, assigned by the engine):
+``seq_no`` and ``version`` fields on ParsedDocument.
 """
 
 from __future__ import annotations
@@ -21,7 +25,7 @@ from dataclasses import dataclass, field as dc_field
 from typing import Any, Optional
 
 from opensearch_tpu.analysis import AnalysisRegistry
-from opensearch_tpu.common.errors import MapperParsingError
+from opensearch_tpu.common.errors import MapperParsingError, StrictDynamicMappingError
 from opensearch_tpu.mapping.types import (
     FieldType,
     TextFieldType,
@@ -30,18 +34,27 @@ from opensearch_tpu.mapping.types import (
 
 POSITION_GAP = 100  # position increment between array elements (Lucene default)
 
+# Mapping keys that are configuration, not field definitions
+# (index/mapper/RootObjectMapper + metadata mappers).
+_MAPPING_META_KEYS = frozenset(
+    {"dynamic", "_source", "_routing", "_meta", "date_detection",
+     "numeric_detection", "dynamic_templates", "_id", "enabled"}
+)
+
 
 @dataclass
 class ParsedDocument:
     doc_id: str
     source: dict
     routing: Optional[str] = None
+    seq_no: int = -1  # _seq_no metadata slot, assigned by the engine
+    version: int = 1  # _version metadata slot, assigned by the engine
     tokens: dict[str, list[tuple[str, int]]] = dc_field(default_factory=dict)
-    longs: dict[str, int] = dc_field(default_factory=dict)
-    doubles: dict[str, float] = dc_field(default_factory=dict)
-    ordinals: dict[str, str] = dc_field(default_factory=dict)
+    longs: dict[str, list[int]] = dc_field(default_factory=dict)
+    doubles: dict[str, list[float]] = dc_field(default_factory=dict)
+    ordinals: dict[str, list[str]] = dc_field(default_factory=dict)
     vectors: dict[str, list[float]] = dc_field(default_factory=dict)
-    geo_points: dict[str, tuple[float, float]] = dc_field(default_factory=dict)
+    geo_points: dict[str, list[tuple[float, float]]] = dc_field(default_factory=dict)
     field_lengths: dict[str, int] = dc_field(default_factory=dict)  # for BM25 norms
 
 
@@ -71,7 +84,7 @@ class DocumentMapper:
         self.analyzers = AnalysisRegistry(analysis_settings)
         self._fields: dict[str, FieldType] = {}
         self._field_configs: dict[str, dict] = {}
-        self.dynamic = True
+        self.dynamic = "true"  # "true" | "false" | "strict"
         if mapping:
             self.merge(mapping)
 
@@ -81,10 +94,46 @@ class DocumentMapper:
         """Merge a mapping update (PutMappingRequest analog).  Conflicting
         type changes are rejected like MapperService.merge does."""
         with self._lock:
+            # Validate everything before mutating any state: a rejected merge
+            # must leave the mapper unchanged (MapperService.merge is atomic).
             dynamic = mapping.get("dynamic", self.dynamic)
-            self.dynamic = dynamic if isinstance(dynamic, bool) else str(dynamic).lower() != "false"
-            props = mapping.get("properties", mapping if "properties" not in mapping else {})
-            self._merge_props("", props)
+            if isinstance(dynamic, bool):
+                new_dynamic = "true" if dynamic else "false"
+            else:
+                new_dynamic = str(dynamic).lower()
+                if new_dynamic not in ("true", "false", "strict"):
+                    raise MapperParsingError(
+                        f"dynamic must be one of [true, false, strict], got [{dynamic}]"
+                    )
+            if "properties" in mapping:
+                props = mapping["properties"]
+                unknown = [
+                    k for k in mapping
+                    if k != "properties" and k not in _MAPPING_META_KEYS
+                ]
+                if unknown:
+                    raise MapperParsingError(
+                        f"unsupported mapping parameters {sorted(unknown)}"
+                    )
+            else:
+                # Bare field dict shorthand — only valid if every remaining
+                # value is itself a field config object.
+                props = {k: v for k, v in mapping.items() if k not in _MAPPING_META_KEYS}
+                if not all(isinstance(v, dict) for v in props.values()):
+                    raise MapperParsingError(
+                        "malformed mapping: expected [properties] to be an object of field definitions"
+                    )
+            if not isinstance(props, dict):
+                raise MapperParsingError("malformed mapping: [properties] must be an object")
+            fields_snapshot = dict(self._fields)
+            configs_snapshot = dict(self._field_configs)
+            try:
+                self._merge_props("", props)
+            except Exception:
+                self._fields = fields_snapshot
+                self._field_configs = configs_snapshot
+                raise
+            self.dynamic = new_dynamic
 
     def _merge_props(self, prefix: str, props: dict):
         for name, config in props.items():
@@ -122,7 +171,10 @@ class DocumentMapper:
                 for p in parts[:-1]:
                     node = node.setdefault(p, {}).setdefault("properties", {})
                 node[parts[-1]] = dict(config)
-            return {"properties": root}
+            out = {"properties": root}
+            if self.dynamic != "true":
+                out["dynamic"] = self.dynamic
+            return out
 
     # --- parsing ---------------------------------------------------------
 
@@ -138,6 +190,16 @@ class DocumentMapper:
                 self._parse_object(path + ".", value, doc)
                 continue
             values = value if isinstance(value, list) else [value]
+            # Arrays of objects flatten into the same dotted paths
+            # (DocumentParser flattens object arrays; sub-fields accumulate
+            # multi-valued data across elements).
+            if self._fields.get(path) is None and any(isinstance(v, dict) for v in values):
+                for v in values:
+                    if isinstance(v, dict):
+                        self._parse_object(path + ".", v, doc)
+                values = [v for v in values if not isinstance(v, dict)]
+                if not values:
+                    continue
             ft = self._resolve(path, values)
             if ft is None:
                 continue
@@ -164,10 +226,12 @@ class DocumentMapper:
             ft = self._fields.get(path)
             if ft is not None:
                 return ft
-            if not self.dynamic:
-                return None
             sample = next((v for v in values if v is not None), None)
             if sample is None:
+                return None
+            if self.dynamic == "strict":
+                raise StrictDynamicMappingError(path)
+            if self.dynamic == "false":
                 return None
             if isinstance(sample, dict):
                 return None  # handled by recursion
@@ -200,15 +264,15 @@ class DocumentMapper:
                     continue
                 kind = ft.dv_kind
                 if kind == "long":
-                    doc.longs.setdefault(ft.name, dv)
+                    doc.longs.setdefault(ft.name, []).append(dv)
                 elif kind == "double":
-                    doc.doubles.setdefault(ft.name, dv)
+                    doc.doubles.setdefault(ft.name, []).append(dv)
                 elif kind == "ordinal":
-                    doc.ordinals.setdefault(ft.name, dv)
+                    doc.ordinals.setdefault(ft.name, []).append(dv)
                 elif kind == "vector":
-                    doc.vectors.setdefault(ft.name, dv)
+                    doc.vectors[ft.name] = dv  # single-valued (KnnVectorField)
                 elif kind == "geo_point":
-                    doc.geo_points.setdefault(ft.name, dv)
+                    doc.geo_points.setdefault(ft.name, []).append(dv)
         if not toks:
             doc.tokens.pop(ft.name, None)
         if isinstance(ft, TextFieldType):

@@ -60,13 +60,19 @@ def format_date_millis(millis: int) -> str:
 
 def parse_ip_long(value: Any) -> int:
     """IPs are stored as a single int64 doc value.  IPv4 fits exactly; IPv6 is
-    reduced to its top 64 bits (range semantics preserved within each family).
+    reduced to its top 62 bits then biased above all v4 values, so the mapping
+    is monotone (order-preserving) within and across families and always fits
+    a signed int64.  Bottom 66 bits of a v6 address are not distinguished by
+    range comparisons (exact term matches go through the inverted index, which
+    keeps the canonical string).
     """
     addr = ipaddress.ip_address(str(value))
     as_int = int(addr)
     if addr.version == 4:
         return as_int
-    return (as_int >> 64) | (1 << 62)  # bias v6 above all v4
+    # top 62 bits -> [0, 2^62); adding the 2^62 bias keeps the result in
+    # [2^62, 2^63), strictly above every v4 value and monotone in the address.
+    return (as_int >> 66) + (1 << 62)
 
 
 _LONG_RANGE = {

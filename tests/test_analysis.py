@@ -106,3 +106,41 @@ def test_shingle_filter():
         }
     )
     assert set(reg.get("sh").terms("a b c")) == {"a", "b", "c", "a b", "b c"}
+
+
+def test_custom_tokenizer_section():
+    # ADVICE: settings.analysis.tokenizer must be honoured
+    from opensearch_tpu.analysis import AnalysisRegistry
+
+    reg = AnalysisRegistry({
+        "tokenizer": {"my_ngram": {"type": "ngram", "min_gram": 2, "max_gram": 2}},
+        "analyzer": {"a": {"type": "custom", "tokenizer": "my_ngram"}},
+    })
+    assert reg.get("a").terms("abc") == ["ab", "bc"]
+
+
+def test_edge_ngram_and_pattern_tokenizers():
+    from opensearch_tpu.analysis import AnalysisRegistry
+
+    reg = AnalysisRegistry({
+        "tokenizer": {
+            "edge": {"type": "edge_ngram", "min_gram": 1, "max_gram": 3},
+            "csv": {"type": "pattern", "pattern": ","},
+        },
+        "analyzer": {
+            "e": {"type": "custom", "tokenizer": "edge"},
+            "c": {"type": "custom", "tokenizer": "csv"},
+        },
+    })
+    assert reg.get("e").terms("abcd") == ["a", "ab", "abc"]
+    assert reg.get("c").terms("x,y,z") == ["x", "y", "z"]
+
+
+def test_edge_ngram_short_input_no_duplicates():
+    from opensearch_tpu.analysis import AnalysisRegistry
+
+    reg = AnalysisRegistry({
+        "tokenizer": {"edge": {"type": "edge_ngram", "min_gram": 1, "max_gram": 3}},
+        "analyzer": {"e": {"type": "custom", "tokenizer": "edge"}},
+    })
+    assert reg.get("e").terms("ab") == ["a", "ab"]

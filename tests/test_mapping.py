@@ -34,7 +34,7 @@ def test_text_field_tokenized(mapper):
 def test_keyword_not_tokenized(mapper):
     doc = mapper.parse("1", {"tags": "New York"})
     assert doc.tokens["tags"] == [("New York", 0)]
-    assert doc.ordinals["tags"] == "New York"
+    assert doc.ordinals["tags"] == ["New York"]
 
 
 def test_numeric_date_bool_ip_doc_values(mapper):
@@ -42,16 +42,16 @@ def test_numeric_date_bool_ip_doc_values(mapper):
         "1",
         {"views": 42, "rating": 4.5, "published": "2024-01-15", "active": True, "addr": "10.0.0.1"},
     )
-    assert doc.longs["views"] == 42
-    assert doc.doubles["rating"] == 4.5
-    assert doc.longs["published"] == parse_date_millis("2024-01-15")
-    assert doc.longs["active"] == 1
-    assert doc.longs["addr"] == parse_ip_long("10.0.0.1")
+    assert doc.longs["views"] == [42]
+    assert doc.doubles["rating"] == [4.5]
+    assert doc.longs["published"] == [parse_date_millis("2024-01-15")]
+    assert doc.longs["active"] == [1]
+    assert doc.longs["addr"] == [parse_ip_long("10.0.0.1")]
 
 
 def test_nested_object_path(mapper):
     doc = mapper.parse("1", {"author": {"name": "kafka"}})
-    assert doc.ordinals["author.name"] == "kafka"
+    assert doc.ordinals["author.name"] == ["kafka"]
 
 
 def test_array_values_multi_token_with_position_gap(mapper):
@@ -73,10 +73,10 @@ def test_dynamic_mapping_string_gets_keyword_subfield():
     mapper = DocumentMapper()
     doc = mapper.parse("1", {"city": "San Francisco", "count": 3, "score": 1.5, "flag": False})
     assert [t for t, _ in doc.tokens["city"]] == ["san", "francisco"]
-    assert doc.ordinals["city.keyword"] == "San Francisco"
-    assert doc.longs["count"] == 3
-    assert doc.doubles["score"] == 1.5
-    assert doc.longs["flag"] == 0
+    assert doc.ordinals["city.keyword"] == ["San Francisco"]
+    assert doc.longs["count"] == [3]
+    assert doc.doubles["score"] == [1.5]
+    assert doc.longs["flag"] == [0]
     m = mapper.to_mapping()["properties"]
     assert m["city"]["type"] == "text"
     assert m["count"]["type"] == "long"
@@ -85,7 +85,7 @@ def test_dynamic_mapping_string_gets_keyword_subfield():
 def test_dynamic_false_ignores_unknown():
     mapper = DocumentMapper({"dynamic": False, "properties": {"a": {"type": "long"}}})
     doc = mapper.parse("1", {"a": 1, "unknown": "x"})
-    assert doc.longs["a"] == 1
+    assert doc.longs["a"] == [1]
     assert "unknown" not in doc.tokens and "unknown" not in doc.ordinals
 
 
@@ -115,4 +115,65 @@ def test_date_formats():
 def test_multifield_roundtrip_mapping(mapper):
     mapper2 = DocumentMapper(mapper.to_mapping())
     doc = mapper2.parse("1", {"tags": "x", "views": 1})
-    assert doc.ordinals["tags"] == "x"
+    assert doc.ordinals["tags"] == ["x"]
+
+
+def test_object_array_flattened(mapper):
+    # ADVICE: {"comments": [{"author": "a"}, ...]} must index sub-fields
+    mapper.merge({"properties": {"comments": {"properties": {"author": {"type": "keyword"}}}}})
+    doc = mapper.parse("1", {"comments": [{"author": "a"}, {"author": "b"}]})
+    assert doc.ordinals["comments.author"] == ["a", "b"]
+
+
+def test_multi_valued_doc_values(mapper):
+    doc = mapper.parse("1", {"views": [1, 2, 3], "tags": ["x", "y"]})
+    assert doc.longs["views"] == [1, 2, 3]
+    assert doc.ordinals["tags"] == ["x", "y"]
+
+
+def test_dynamic_strict_rejects_unknown():
+    from opensearch_tpu.common.errors import StrictDynamicMappingError
+
+    mapper = DocumentMapper({"dynamic": "strict", "properties": {"a": {"type": "long"}}})
+    mapper.parse("1", {"a": 1})
+    with pytest.raises(StrictDynamicMappingError):
+        mapper.parse("2", {"a": 1, "unknown": "x"})
+
+
+def test_meta_only_mapping_does_not_crash():
+    # ADVICE: {"dynamic": false} without properties must not TypeError
+    mapper = DocumentMapper({"dynamic": False})
+    doc = mapper.parse("1", {"anything": "x"})
+    assert not doc.tokens
+
+
+def test_malformed_mapping_raises():
+    with pytest.raises(MapperParsingError):
+        DocumentMapper({"properties": {"a": {"type": "long"}}, "bogus": 42})
+
+
+def test_ip_long_order_preserving():
+    # ADVICE: v6 encoding must be monotone and fit int64
+    vals = ["::", "::1", "4000::", "8000::", "ffff::1", "ffff:ffff::"]
+    enc = [parse_ip_long(v) for v in vals]
+    assert enc[0] < enc[2] < enc[3] < enc[4] <= enc[5]
+    assert all(-(2**63) <= e < 2**63 for e in enc)
+    assert parse_ip_long("255.255.255.255") < parse_ip_long("::")
+    assert parse_ip_long("4000::") != parse_ip_long("::")
+
+
+def test_failed_merge_is_atomic(mapper):
+    # A rejected merge must not change dynamic mode or add fields
+    with pytest.raises(MapperParsingError):
+        mapper.merge({"dynamic": "strict", "bogus": 42, "properties": {"new_f": {"type": "long"}}})
+    assert mapper.dynamic == "true"
+    assert mapper.field_type("new_f") is None
+    with pytest.raises(MapperParsingError):
+        mapper.merge({"properties": {"ok_f": {"type": "long"}, "views": {"type": "text"}}})
+    assert mapper.field_type("ok_f") is None  # partial merge rolled back
+
+
+def test_to_mapping_preserves_dynamic_mode():
+    m = DocumentMapper({"dynamic": "strict", "properties": {"a": {"type": "long"}}})
+    m2 = DocumentMapper(m.to_mapping())
+    assert m2.dynamic == "strict"
