@@ -263,13 +263,10 @@ class AnalysisRegistry:
                 raise IllegalArgumentError(f"unknown analyzer type [{atype}]")
             tok_name = acfg.get("tokenizer", "standard")
             tokenizer = custom_tokenizers.get(tok_name) or TOKENIZERS.get(tok_name)
-            if tokenizer is None and tok_name == "ngram":
-                # legacy shorthand: ngram params inline on the analyzer config
-                tokenizer = _ngram_tokenizer(
-                    int(acfg.get("min_gram", 1)), int(acfg.get("max_gram", 2))
-                )
             if tokenizer is None:
-                raise IllegalArgumentError(f"unknown tokenizer [{tok_name}]")
+                # built-in parameterized tokenizer named directly on the
+                # analyzer (ngram/edge_ngram/pattern), params inline
+                tokenizer = _build_tokenizer(tok_name, {"type": tok_name, **acfg})
             filters = []
             for fname in acfg.get("filter", []):
                 if fname in custom_filters:

@@ -1,0 +1,13 @@
+"""Central JAX configuration for the framework.
+
+Import this module before any `jax` use inside opensearch_tpu.  It enables
+x64 so int64 doc-value columns (date millis, longs — ref
+server/src/main/java/org/opensearch/index/mapper/NumberFieldMapper.java,
+DateFieldMapper) keep full precision on device.  XLA emulates s64 on TPU
+with int32 pairs; the hot scoring kernels below explicitly use
+int32/float32 so the MXU path is unaffected.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,1 @@
+from opensearch_tpu.index.segment import Segment, SegmentWriter  # noqa: F401

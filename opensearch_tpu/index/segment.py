@@ -1,0 +1,409 @@
+"""Immutable, array-oriented index segments — the TPU-native analog of a
+Lucene segment.
+
+Where Lucene stores postings as compressed blocks decoded doc-at-a-time
+inside ``Weight.bulkScorer`` (ref server/src/main/java/org/opensearch/
+search/internal/ContextIndexSearcher.java:318), a TPU segment is a set of
+flat device-stageable arrays:
+
+- per indexed field, CSR postings ``[term_offsets, doc_ids, tfs]`` plus a
+  positions CSR (for phrase queries) and per-doc field lengths (BM25 norms
+  — ref index/similarity/, Lucene BM25Similarity);
+- per doc-value field, a multi-valued CSR column (SortedNumericDocValues /
+  SortedSetDocValues analog — ref index/fielddata/) with an expanded
+  ``value_docs`` row-id array so range masks and aggregations are single
+  scatter ops on device, plus dense min/max columns for sorting;
+- dense vectors as a ``[n_docs, dim]`` matrix (KnnVectorField analog);
+- stored ``_source`` bytes host-side (ref index/mapper/SourceFieldMapper);
+- a mutable live-docs bitmap for deletes (Lucene liveDocs analog).
+
+All device arrays are padded to power-of-two sizes so XLA compile caches
+are shared across segments of similar size (static shapes; see
+/opt/skills/guides/pallas_guide.md on shape bucketing).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field as dc_field
+from typing import Optional
+
+import numpy as np
+
+from opensearch_tpu.mapping.mapper import ParsedDocument
+
+# Sentinels for missing values in dense sort columns.
+LONG_MISSING_MAX = np.iinfo(np.int64).max
+LONG_MISSING_MIN = np.iinfo(np.int64).min
+
+
+def pad_pow2(n: int, minimum: int = 8) -> int:
+    """Next power of two >= max(n, minimum)."""
+    m = max(int(n), minimum)
+    return 1 << (m - 1).bit_length()
+
+
+@dataclass
+class PostingsField:
+    """CSR inverted index for one field.
+
+    ``offsets[t]:offsets[t+1]`` is term t's posting range in ``doc_ids`` /
+    ``tfs``; ``pos_offsets[p]:pos_offsets[p+1]`` is posting entry p's range
+    in ``positions``.  ``doc_lens`` is the per-doc token count (1.0 for
+    fields without norms, like Lucene omitNorms keyword fields).
+    """
+
+    terms: dict[str, int]            # term -> term id (sorted order)
+    df: np.ndarray                   # int32 [T] doc freq
+    offsets: np.ndarray              # int32 [T+1]
+    doc_ids: np.ndarray              # int32 [P]
+    tfs: np.ndarray                  # float32 [P]
+    pos_offsets: np.ndarray          # int32 [P+1]
+    positions: np.ndarray            # int32 [sum positions]
+    doc_lens: np.ndarray             # float32 [n_docs]
+    total_len: float                 # sum of doc_lens over docs with field
+    docs_with_field: int
+    has_norms: bool
+
+    def term_id(self, term: str) -> int:
+        return self.terms.get(term, -1)
+
+
+@dataclass
+class NumericDV:
+    """Multi-valued numeric doc-value column (SortedNumericDocValues)."""
+
+    kind: str                        # "long" | "double"
+    offsets: np.ndarray              # int32 [n_docs+1]
+    values: np.ndarray               # int64 | float64 [V], sorted per doc
+    value_docs: np.ndarray           # int32 [V] owning doc per value
+    minv: np.ndarray                 # dense per-doc min (sentinel if missing)
+    maxv: np.ndarray                 # dense per-doc max
+    exists: np.ndarray               # bool [n_docs]
+
+
+@dataclass
+class OrdinalDV:
+    """Multi-valued ordinal column (SortedSetDocValues analog).  Ordinals
+    are per-segment, assigned in sorted term order so ordinal comparisons
+    are term-order comparisons."""
+
+    ord_terms: list[str]             # ordinal -> term
+    term_to_ord: dict[str, int]
+    offsets: np.ndarray              # int32 [n_docs+1]
+    ords: np.ndarray                 # int32 [V], sorted per doc
+    value_docs: np.ndarray           # int32 [V]
+    min_ord: np.ndarray              # int32 [n_docs] (-1 if missing)
+    max_ord: np.ndarray              # int32 [n_docs]
+    exists: np.ndarray               # bool [n_docs]
+
+
+@dataclass
+class VectorDV:
+    values: np.ndarray               # float32 [n_docs, dim]
+    exists: np.ndarray               # bool [n_docs]
+    dim: int
+    similarity: str                  # l2_norm | cosine | dot_product
+
+
+@dataclass
+class GeoDV:
+    offsets: np.ndarray              # int32 [n_docs+1]
+    lats: np.ndarray                 # float32 [V]
+    lons: np.ndarray                 # float32 [V]
+    value_docs: np.ndarray           # int32 [V]
+    exists: np.ndarray               # bool [n_docs]
+
+
+class Segment:
+    """One immutable segment.  Mutable pieces: ``live`` (deletes) only."""
+
+    def __init__(self, seg_id: str, n_docs: int):
+        self.seg_id = seg_id
+        self.n_docs = n_docs
+        self.doc_ids: list[str] = []
+        self.id_to_local: dict[str, int] = {}
+        self.sources: list[bytes] = []
+        self.seq_nos = np.zeros(n_docs, dtype=np.int64)
+        self.versions = np.ones(n_docs, dtype=np.int64)
+        self.postings: dict[str, PostingsField] = {}
+        self.numeric_dv: dict[str, NumericDV] = {}
+        self.ordinal_dv: dict[str, OrdinalDV] = {}
+        self.vector_dv: dict[str, VectorDV] = {}
+        self.geo_dv: dict[str, GeoDV] = {}
+        self.live = np.ones(n_docs, dtype=bool)
+        self._device: Optional["DeviceSegment"] = None
+        self._live_dirty = True
+
+    # -- stats used for cross-segment collection statistics ---------------
+
+    def live_count(self) -> int:
+        return int(self.live.sum())
+
+    def delete_local(self, local_id: int):
+        self.live[local_id] = False
+        self._live_dirty = True
+
+    def source(self, local_id: int) -> dict:
+        return json.loads(self.sources[local_id])
+
+    def device(self) -> "DeviceSegment":
+        if self._device is None:
+            self._device = DeviceSegment(self)
+        if self._live_dirty:
+            self._device.refresh_live(self.live)
+            self._live_dirty = False
+        return self._device
+
+
+class DeviceSegment:
+    """jnp-staged view of a Segment, padded to power-of-two shapes.
+
+    Padding scheme: ``n_pad >= n_docs + 1`` so slot ``n_docs`` is a dead
+    scatter target for padded postings/value entries; ``live`` is False on
+    all padding slots so they can never reach the top-k.
+    """
+
+    def __init__(self, seg: Segment):
+        import opensearch_tpu.common.jaxenv  # noqa: F401
+        import jax.numpy as jnp
+
+        self.seg = seg
+        self.n_docs = seg.n_docs
+        self.n_pad = pad_pow2(seg.n_docs + 1)
+        n_pad = self.n_pad
+
+        def pad1(a: np.ndarray, size: int, fill) -> np.ndarray:
+            out = np.full(size, fill, dtype=a.dtype)
+            out[: len(a)] = a
+            return out
+
+        self.postings: dict[str, dict] = {}
+        for name, pf in seg.postings.items():
+            p_pad = pad_pow2(len(pf.doc_ids))
+            # offsets padded by repeating the final cumulative value so
+            # padded term ids decode as empty ranges and the array shape
+            # stays bucketed (compile-cache sharing across segments).
+            t_pad = pad_pow2(len(pf.offsets))
+            self.postings[name] = {
+                "offsets": jnp.asarray(pad1(pf.offsets, t_pad, pf.offsets[-1])),
+                "doc_ids": jnp.asarray(pad1(pf.doc_ids, p_pad, self.n_docs)),
+                "tfs": jnp.asarray(pad1(pf.tfs, p_pad, 0.0)),
+                "doc_lens": jnp.asarray(pad1(pf.doc_lens, n_pad, 1.0)),
+            }
+        self.numeric: dict[str, dict] = {}
+        for name, dv in seg.numeric_dv.items():
+            v_pad = pad_pow2(len(dv.values))
+            vals = dv.values
+            self.numeric[name] = {
+                "values": jnp.asarray(pad1(vals, v_pad, 0)),
+                "value_docs": jnp.asarray(pad1(dv.value_docs, v_pad, self.n_docs)),
+                "minv": jnp.asarray(pad1(dv.minv, n_pad, LONG_MISSING_MAX if dv.kind == "long" else np.inf)),
+                "maxv": jnp.asarray(pad1(dv.maxv, n_pad, LONG_MISSING_MIN if dv.kind == "long" else -np.inf)),
+                "exists": jnp.asarray(pad1(dv.exists, n_pad, False)),
+            }
+        self.ordinal: dict[str, dict] = {}
+        for name, dv in seg.ordinal_dv.items():
+            v_pad = pad_pow2(len(dv.ords))
+            self.ordinal[name] = {
+                "ords": jnp.asarray(pad1(dv.ords, v_pad, -1)),
+                "value_docs": jnp.asarray(pad1(dv.value_docs, v_pad, self.n_docs)),
+                "min_ord": jnp.asarray(pad1(dv.min_ord, n_pad, -1)),
+                "max_ord": jnp.asarray(pad1(dv.max_ord, n_pad, -1)),
+                "exists": jnp.asarray(pad1(dv.exists, n_pad, False)),
+                "n_ords": len(dv.ord_terms),
+            }
+        self.vector: dict[str, dict] = {}
+        for name, dv in seg.vector_dv.items():
+            vals = np.zeros((n_pad, dv.dim), dtype=np.float32)
+            vals[: len(dv.values)] = dv.values
+            self.vector[name] = {
+                "values": jnp.asarray(vals),
+                "exists": jnp.asarray(pad1(dv.exists, n_pad, False)),
+            }
+        self.live = None
+        self.refresh_live(seg.live)
+
+    def refresh_live(self, live: np.ndarray):
+        import jax.numpy as jnp
+
+        padded = np.zeros(self.n_pad, dtype=bool)
+        padded[: len(live)] = live
+        self.live = jnp.asarray(padded)
+
+
+class SegmentWriter:
+    """Builds an immutable Segment from a batch of ParsedDocuments — the
+    invert step Lucene does inside IndexWriter.addDocuments (ref
+    index/engine/InternalEngine.java:1186), done columnar in one pass."""
+
+    def build(self, docs: list[ParsedDocument], seg_id: str,
+              norms_fields: Optional[dict[str, bool]] = None,
+              vector_meta: Optional[dict[str, dict]] = None) -> Segment:
+        n = len(docs)
+        seg = Segment(seg_id, n)
+        norms_fields = norms_fields or {}
+        vector_meta = vector_meta or {}
+
+        # term -> list index accumulation per field
+        inv: dict[str, dict[str, list[tuple[int, int, list[int]]]]] = {}
+        field_doc_lens: dict[str, np.ndarray] = {}
+        longs: dict[str, list[list[int]]] = {}
+        doubles: dict[str, list[list[float]]] = {}
+        ordinals: dict[str, list[list[str]]] = {}
+        vectors: dict[str, dict[int, list[float]]] = {}
+        geos: dict[str, list[list[tuple[float, float]]]] = {}
+
+        for i, doc in enumerate(docs):
+            seg.doc_ids.append(doc.doc_id)
+            seg.id_to_local[doc.doc_id] = i
+            seg.sources.append(json.dumps(doc.source, separators=(",", ":")).encode())
+            seg.seq_nos[i] = doc.seq_no
+            seg.versions[i] = doc.version
+            for fname, toks in doc.tokens.items():
+                per_term: dict[str, tuple[int, list[int]]] = {}
+                for term, pos in toks:
+                    if term in per_term:
+                        tf, plist = per_term[term]
+                        per_term[term] = (tf + 1, plist)
+                        plist.append(pos)
+                    else:
+                        per_term[term] = (1, [pos])
+                finv = inv.setdefault(fname, {})
+                for term, (tf, plist) in per_term.items():
+                    finv.setdefault(term, []).append((i, tf, plist))
+            for fname, length in doc.field_lengths.items():
+                arr = field_doc_lens.setdefault(fname, np.zeros(n, dtype=np.float32))
+                arr[i] = length
+            for fname, vals in doc.longs.items():
+                longs.setdefault(fname, [[] for _ in range(n)])[i].extend(vals)
+            for fname, vals in doc.doubles.items():
+                doubles.setdefault(fname, [[] for _ in range(n)])[i].extend(vals)
+            for fname, vals in doc.ordinals.items():
+                ordinals.setdefault(fname, [[] for _ in range(n)])[i].extend(vals)
+            for fname, vec in doc.vectors.items():
+                vectors.setdefault(fname, {})[i] = vec
+            for fname, pts in doc.geo_points.items():
+                geos.setdefault(fname, [[] for _ in range(n)])[i].extend(pts)
+
+        for fname, finv in inv.items():
+            seg.postings[fname] = self._build_postings(
+                fname, finv, n, field_doc_lens.get(fname),
+                has_norms=norms_fields.get(fname, fname in field_doc_lens))
+
+        for fname, per_doc in longs.items():
+            seg.numeric_dv[fname] = self._build_numeric(per_doc, n, "long")
+        for fname, per_doc in doubles.items():
+            seg.numeric_dv[fname] = self._build_numeric(per_doc, n, "double")
+        for fname, per_doc in ordinals.items():
+            seg.ordinal_dv[fname] = self._build_ordinal(per_doc, n)
+        for fname, per_doc in vectors.items():
+            meta = vector_meta.get(fname, {})
+            dim = meta.get("dims") or len(next(iter(per_doc.values())))
+            vals = np.zeros((n, dim), dtype=np.float32)
+            exists = np.zeros(n, dtype=bool)
+            for i, vec in per_doc.items():
+                vals[i] = np.asarray(vec, dtype=np.float32)
+                exists[i] = True
+            seg.vector_dv[fname] = VectorDV(
+                values=vals, exists=exists, dim=dim,
+                similarity=meta.get("similarity", "l2_norm"))
+        for fname, per_doc in geos.items():
+            seg.geo_dv[fname] = self._build_geo(per_doc, n)
+        return seg
+
+    @staticmethod
+    def _build_postings(fname, finv, n_docs, doc_lens, has_norms) -> PostingsField:
+        terms_sorted = sorted(finv)
+        term_ids = {t: i for i, t in enumerate(terms_sorted)}
+        T = len(terms_sorted)
+        df = np.zeros(T, dtype=np.int32)
+        offsets = np.zeros(T + 1, dtype=np.int32)
+        doc_list, tf_list, pos_off, pos_all = [], [], [0], []
+        for t_idx, term in enumerate(terms_sorted):
+            entries = finv[term]  # already ascending doc id (insert order)
+            df[t_idx] = len(entries)
+            for d, tf, plist in entries:
+                doc_list.append(d)
+                tf_list.append(tf)
+                pos_all.extend(plist)
+                pos_off.append(len(pos_all))
+            offsets[t_idx + 1] = len(doc_list)
+        if doc_lens is None:
+            doc_lens = np.ones(n_docs, dtype=np.float32)
+        docs_with = int((doc_lens > 0).sum()) if has_norms else n_docs
+        if not has_norms:
+            doc_lens = np.ones(n_docs, dtype=np.float32)
+        return PostingsField(
+            terms=term_ids, df=df, offsets=offsets,
+            doc_ids=np.asarray(doc_list, dtype=np.int32),
+            tfs=np.asarray(tf_list, dtype=np.float32),
+            pos_offsets=np.asarray(pos_off, dtype=np.int32),
+            positions=np.asarray(pos_all, dtype=np.int32),
+            doc_lens=doc_lens.astype(np.float32),
+            total_len=float(doc_lens[doc_lens > 0].sum()) if has_norms else float(n_docs),
+            docs_with_field=docs_with, has_norms=has_norms)
+
+    @staticmethod
+    def _build_numeric(per_doc: list[list], n_docs: int, kind: str) -> NumericDV:
+        dtype = np.int64 if kind == "long" else np.float64
+        miss_min = LONG_MISSING_MAX if kind == "long" else np.inf
+        miss_max = LONG_MISSING_MIN if kind == "long" else -np.inf
+        offsets = np.zeros(n_docs + 1, dtype=np.int32)
+        values, value_docs = [], []
+        minv = np.full(n_docs, miss_min, dtype=dtype)
+        maxv = np.full(n_docs, miss_max, dtype=dtype)
+        exists = np.zeros(n_docs, dtype=bool)
+        for i, vals in enumerate(per_doc):
+            vals = sorted(vals)
+            values.extend(vals)
+            value_docs.extend([i] * len(vals))
+            offsets[i + 1] = len(values)
+            if vals:
+                minv[i], maxv[i] = vals[0], vals[-1]
+                exists[i] = True
+        return NumericDV(kind=kind, offsets=offsets,
+                         values=np.asarray(values, dtype=dtype),
+                         value_docs=np.asarray(value_docs, dtype=np.int32),
+                         minv=minv, maxv=maxv, exists=exists)
+
+    @staticmethod
+    def _build_ordinal(per_doc: list[list[str]], n_docs: int) -> OrdinalDV:
+        uniq = sorted({t for vals in per_doc for t in vals})
+        term_to_ord = {t: i for i, t in enumerate(uniq)}
+        offsets = np.zeros(n_docs + 1, dtype=np.int32)
+        ords, value_docs = [], []
+        min_ord = np.full(n_docs, -1, dtype=np.int32)
+        max_ord = np.full(n_docs, -1, dtype=np.int32)
+        exists = np.zeros(n_docs, dtype=bool)
+        for i, vals in enumerate(per_doc):
+            o = sorted(term_to_ord[t] for t in vals)
+            ords.extend(o)
+            value_docs.extend([i] * len(o))
+            offsets[i + 1] = len(ords)
+            if o:
+                min_ord[i], max_ord[i] = o[0], o[-1]
+                exists[i] = True
+        return OrdinalDV(ord_terms=uniq, term_to_ord=term_to_ord,
+                         offsets=offsets,
+                         ords=np.asarray(ords, dtype=np.int32),
+                         value_docs=np.asarray(value_docs, dtype=np.int32),
+                         min_ord=min_ord, max_ord=max_ord, exists=exists)
+
+    @staticmethod
+    def _build_geo(per_doc, n_docs) -> GeoDV:
+        offsets = np.zeros(n_docs + 1, dtype=np.int32)
+        lats, lons, value_docs = [], [], []
+        exists = np.zeros(n_docs, dtype=bool)
+        for i, pts in enumerate(per_doc):
+            for lat, lon in pts:
+                lats.append(lat)
+                lons.append(lon)
+                value_docs.append(i)
+            offsets[i + 1] = len(lats)
+            exists[i] = bool(pts)
+        return GeoDV(offsets=offsets,
+                     lats=np.asarray(lats, dtype=np.float32),
+                     lons=np.asarray(lons, dtype=np.float32),
+                     value_docs=np.asarray(value_docs, dtype=np.int32),
+                     exists=exists)

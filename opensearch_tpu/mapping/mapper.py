@@ -125,34 +125,36 @@ class DocumentMapper:
                     )
             if not isinstance(props, dict):
                 raise MapperParsingError("malformed mapping: [properties] must be an object")
-            fields_snapshot = dict(self._fields)
-            configs_snapshot = dict(self._field_configs)
-            try:
-                self._merge_props("", props)
-            except Exception:
-                self._fields = fields_snapshot
-                self._field_configs = configs_snapshot
-                raise
+            # Copy-on-write: build the merged lookup aside and swap it in
+            # atomically, so concurrent parse() (which reads _fields without
+            # the lock) sees either the old or the new mapping, never a
+            # partially-applied one (MapperService.merge is atomic).
+            new_fields = dict(self._fields)
+            new_configs = dict(self._field_configs)
+            self._merge_props("", props, new_fields, new_configs)
+            self._fields = new_fields
+            self._field_configs = new_configs
             self.dynamic = new_dynamic
 
-    def _merge_props(self, prefix: str, props: dict):
+    def _merge_props(self, prefix: str, props: dict,
+                     fields: dict, configs: dict):
         for name, config in props.items():
             path = f"{prefix}{name}"
             if "properties" in config and "type" not in config:
-                self._merge_props(path + ".", config["properties"])
+                self._merge_props(path + ".", config["properties"], fields, configs)
                 continue
-            existing = self._fields.get(path)
+            existing = fields.get(path)
             ft = build_field_type(path, config)
             if existing is not None and existing.type_name != ft.type_name:
                 raise MapperParsingError(
                     f"mapper [{path}] cannot be changed from type [{existing.type_name}]"
                     f" to [{ft.type_name}]"
                 )
-            self._fields[path] = ft
-            self._field_configs[path] = config
+            fields[path] = ft
+            configs[path] = config
             for sub_name, sub_config in (config.get("fields") or {}).items():
                 sub_path = f"{path}.{sub_name}"
-                self._fields[sub_path] = build_field_type(sub_path, sub_config)
+                fields[sub_path] = build_field_type(sub_path, sub_config)
 
     def field_type(self, path: str) -> Optional[FieldType]:
         return self._fields.get(path)
@@ -226,11 +228,13 @@ class DocumentMapper:
             ft = self._fields.get(path)
             if ft is not None:
                 return ft
+            # Strict mode rejects the mere introduction of an unmapped field,
+            # even with a null/empty value (DocumentParser strict semantics).
+            if self.dynamic == "strict":
+                raise StrictDynamicMappingError(path)
             sample = next((v for v in values if v is not None), None)
             if sample is None:
                 return None
-            if self.dynamic == "strict":
-                raise StrictDynamicMappingError(path)
             if self.dynamic == "false":
                 return None
             if isinstance(sample, dict):
@@ -238,7 +242,11 @@ class DocumentMapper:
             config = _dynamic_type_for(sample)
             if config is None:
                 return None
-            self._merge_props("", _nest(path, config))
+            new_fields = dict(self._fields)
+            new_configs = dict(self._field_configs)
+            self._merge_props("", _nest(path, config), new_fields, new_configs)
+            self._fields = new_fields
+            self._field_configs = new_configs
             return self._fields[path]
 
     def _index_values(self, ft: FieldType, values: list, doc: ParsedDocument):
@@ -270,7 +278,13 @@ class DocumentMapper:
                 elif kind == "ordinal":
                     doc.ordinals.setdefault(ft.name, []).append(dv)
                 elif kind == "vector":
-                    doc.vectors[ft.name] = dv  # single-valued (KnnVectorField)
+                    if ft.name in doc.vectors:
+                        # Lucene KnnVectorField rejects multi-valued vectors
+                        raise MapperParsingError(
+                            f"[{ft.name}] of type [dense_vector] doesn't "
+                            "support indexing multiple values per document"
+                        )
+                    doc.vectors[ft.name] = dv
                 elif kind == "geo_point":
                     doc.geo_points.setdefault(ft.name, []).append(dv)
         if not toks:

@@ -1,0 +1,1 @@
+import opensearch_tpu.common.jaxenv  # noqa: F401  (x64 before any jax use)

@@ -1,0 +1,103 @@
+"""BM25 scoring as batched XLA programs.
+
+The reference's hot loop is doc-at-a-time WAND/MaxScore inside Lucene's
+``Weight.bulkScorer`` (ref server/src/main/java/org/opensearch/search/
+internal/ContextIndexSearcher.java:318).  On TPU the same work is a
+data-parallel program over the whole segment:
+
+    CSR gather of the query terms' postings  ->  BM25 per posting
+    ->  scatter-add into a dense per-doc score vector  ->  lax.top_k
+
+No pruning is needed: scoring *every* posting of the query terms is a
+handful of fused HBM-bandwidth-bound ops, and ``top_k`` replaces the
+priority queue.  This is the BM25S formulation (see PAPERS.md) with
+query-time idf so scores stay consistent across segments (Lucene computes
+collection-wide stats in IndexSearcher, not per segment).
+
+All functions here are pure jnp and shape-static; the search executor
+composes and ``jit``s them with bucketed shapes.
+"""
+
+from __future__ import annotations
+
+import math
+
+import opensearch_tpu.common.jaxenv  # noqa: F401
+
+import jax.numpy as jnp
+from jax import lax
+
+K1_DEFAULT = 1.2
+B_DEFAULT = 0.75
+
+
+def idf(df: int, n_docs: int) -> float:
+    """Lucene BM25Similarity idf: ln(1 + (N - df + 0.5) / (df + 0.5))."""
+    return math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
+
+
+def gather_postings(offsets, doc_ids, tfs, term_ids, term_active, *,
+                    budget: int, pad_doc: int):
+    """Flatten the postings of up to T terms into fixed-size arrays.
+
+    The CSR rows selected by ``term_ids`` are laid end-to-end into a
+    ``budget``-sized flat space via searchsorted over cumulative lengths —
+    fully on-device, shape-static.
+
+    Contract: the caller must choose ``budget >= sum(df[term_ids])``
+    (the executor computes this from host-side df stats and rounds up to a
+    power-of-two bucket); entries beyond ``budget`` would be silently
+    dropped otherwise.
+
+    Returns (docs[B], tfs[B], slot[B], valid[B]): ``slot`` is the index
+    into ``term_ids`` that produced each entry.
+    """
+    starts = offsets[term_ids]
+    lens = jnp.where(term_active, offsets[term_ids + 1] - starts, 0)
+    cum = jnp.cumsum(lens)
+    total = cum[-1]
+    i = jnp.arange(budget, dtype=jnp.int32)
+    slot = jnp.searchsorted(cum, i, side="right").astype(jnp.int32)
+    slot = jnp.minimum(slot, term_ids.shape[0] - 1)
+    prev = jnp.where(slot > 0, cum[slot - 1], 0)
+    valid = i < total
+    idx = jnp.where(valid, starts[slot] + i - prev, 0)
+    d = jnp.where(valid, doc_ids[idx], pad_doc)
+    tf = jnp.where(valid, tfs[idx], 0.0)
+    return d, tf, slot, valid
+
+
+def bm25_scores(offsets, doc_ids, tfs, doc_lens, term_ids, term_active,
+                idfs, weights, avgdl, *, n_pad: int, budget: int,
+                k1: float = K1_DEFAULT, b: float = B_DEFAULT):
+    """Dense per-doc BM25 scores for a bag of weighted terms.
+
+    ``idfs``/``weights`` are per query term (weights carry boosts and
+    should-clause accumulation).  Returns float32 [n_pad]; score > 0 iff
+    the doc matched at least one term.
+    """
+    d, tf, slot, valid = gather_postings(
+        offsets, doc_ids, tfs, term_ids, term_active,
+        budget=budget, pad_doc=n_pad - 1)
+    dl = doc_lens[d]
+    norm = k1 * (1.0 - b + b * dl / avgdl)
+    contrib = idfs[slot] * weights[slot] * tf / (tf + norm)
+    contrib = jnp.where(valid, contrib, 0.0)
+    return jnp.zeros(n_pad, jnp.float32).at[d].add(contrib)
+
+
+def match_count(offsets, doc_ids, tfs, term_ids, term_active, *,
+                n_pad: int, budget: int):
+    """Per-doc count of DISTINCT matched query terms (for conjunctions and
+    minimum_should_match).  tf >= 1 per posting entry, so counting entries
+    per (term, doc) pair counts terms."""
+    d, _tf, _slot, valid = gather_postings(
+        offsets, doc_ids, tfs, term_ids, term_active,
+        budget=budget, pad_doc=n_pad - 1)
+    return jnp.zeros(n_pad, jnp.int32).at[d].add(valid.astype(jnp.int32))
+
+
+def topk(scores, k: int):
+    """Top-k by score; XLA's top_k breaks ties by lower index, which is
+    exactly Lucene's ascending-doc-id tie-break."""
+    return lax.top_k(scores, k)

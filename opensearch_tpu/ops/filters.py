@@ -1,0 +1,54 @@
+"""Filter masks over doc-value columns as scatter ops.
+
+The reference evaluates filters doc-at-a-time through Lucene's
+``ConstantScoreScorer``; here a filter is a dense boolean mask [n_pad]
+computed in one vectorized pass over the column's expanded values
+(``values``/``value_docs`` from the multi-valued CSR — see
+index/segment.py).  Multi-valued semantics match SortedNumericDocValues:
+a doc matches if ANY of its values matches.
+"""
+
+from __future__ import annotations
+
+import opensearch_tpu.common.jaxenv  # noqa: F401
+
+import jax.numpy as jnp
+
+
+def _scatter_any(ok, value_docs, n_pad: int):
+    return jnp.zeros(n_pad, bool).at[value_docs].max(ok)
+
+
+def range_mask(values, value_docs, lo, hi, *, include_lo: bool,
+               include_hi: bool, n_pad: int):
+    """Docs with any value in the interval.  lo/hi may be -inf/+inf
+    (pass dtype min/max for int columns)."""
+    ok_lo = values >= lo if include_lo else values > lo
+    ok_hi = values <= hi if include_hi else values < hi
+    return _scatter_any(ok_lo & ok_hi, value_docs, n_pad)
+
+
+def term_mask(values, value_docs, value, *, n_pad: int):
+    """Docs with any value equal to ``value`` (term filter over a numeric
+    or ordinal column)."""
+    return _scatter_any(values == value, value_docs, n_pad)
+
+
+def terms_mask(values, value_docs, query_values, *, n_pad: int):
+    """Docs with any value in ``query_values`` [Q] (terms filter).
+    O(V*Q) compare — fine for the typical small Q; large Q should go
+    through sorted-membership instead."""
+    ok = (values[:, None] == query_values[None, :]).any(axis=1)
+    return _scatter_any(ok, value_docs, n_pad)
+
+
+def postings_mask(offsets, doc_ids, tfs, term_ids, term_active, *,
+                  n_pad: int, budget: int):
+    """Docs containing any of the given indexed terms (term/terms filter
+    over an indexed field without doc values)."""
+    from opensearch_tpu.ops.bm25 import gather_postings
+
+    d, _tf, _slot, valid = gather_postings(
+        offsets, doc_ids, tfs, term_ids, term_active,
+        budget=budget, pad_doc=n_pad - 1)
+    return jnp.zeros(n_pad, bool).at[d].max(valid)
