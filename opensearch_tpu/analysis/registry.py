@@ -266,7 +266,7 @@ class AnalysisRegistry:
             if tokenizer is None:
                 # built-in parameterized tokenizer named directly on the
                 # analyzer (ngram/edge_ngram/pattern), params inline
-                tokenizer = _build_tokenizer(tok_name, {"type": tok_name, **acfg})
+                tokenizer = _build_tokenizer(tok_name, {**acfg, "type": tok_name})
             filters = []
             for fname in acfg.get("filter", []):
                 if fname in custom_filters:

@@ -62,8 +62,12 @@ class PostingsField:
     positions: np.ndarray            # int32 [sum positions]
     doc_lens: np.ndarray             # float32 [n_docs]
     total_len: float                 # sum of doc_lens over docs with field
-    docs_with_field: int
+    docs_with_field: int             # docs with >=1 term (Lucene docCount)
     has_norms: bool
+    # docs where the field was present at all — a zero-token text value
+    # still writes a "norm entry" (Lucene FieldExistsQuery over norms
+    # matches it even though docCount does not count it).
+    present: np.ndarray = None       # bool [n_docs]
 
     def term_id(self, term: str) -> int:
         return self.terms.get(term, -1)
@@ -185,11 +189,20 @@ class DeviceSegment:
             # padded term ids decode as empty ranges and the array shape
             # stays bucketed (compile-cache sharing across segments).
             t_pad = pad_pow2(len(pf.offsets))
+            pos_pad = pad_pow2(len(pf.positions))
             self.postings[name] = {
                 "offsets": jnp.asarray(pad1(pf.offsets, t_pad, pf.offsets[-1])),
                 "doc_ids": jnp.asarray(pad1(pf.doc_ids, p_pad, self.n_docs)),
                 "tfs": jnp.asarray(pad1(pf.tfs, p_pad, 0.0)),
                 "doc_lens": jnp.asarray(pad1(pf.doc_lens, n_pad, 1.0)),
+                # positions CSR for phrase matching (pos_offsets is per
+                # posting entry, so a term's positions are one contiguous
+                # slice of ``positions``).
+                "pos_offsets": jnp.asarray(
+                    pad1(pf.pos_offsets, pad_pow2(len(pf.pos_offsets)),
+                         pf.pos_offsets[-1] if len(pf.pos_offsets) else 0)),
+                "positions": jnp.asarray(pad1(pf.positions, pos_pad, 0)),
+                "field_exists": jnp.asarray(pad1(pf.present, n_pad, False)),
             }
         self.numeric: dict[str, dict] = {}
         for name, dv in seg.numeric_dv.items():
@@ -219,6 +232,15 @@ class DeviceSegment:
             vals[: len(dv.values)] = dv.values
             self.vector[name] = {
                 "values": jnp.asarray(vals),
+                "exists": jnp.asarray(pad1(dv.exists, n_pad, False)),
+            }
+        self.geo: dict[str, dict] = {}
+        for name, dv in seg.geo_dv.items():
+            v_pad = pad_pow2(len(dv.lats))
+            self.geo[name] = {
+                "lats": jnp.asarray(pad1(dv.lats, v_pad, 0.0)),
+                "lons": jnp.asarray(pad1(dv.lons, v_pad, 0.0)),
+                "value_docs": jnp.asarray(pad1(dv.value_docs, v_pad, self.n_docs)),
                 "exists": jnp.asarray(pad1(dv.exists, n_pad, False)),
             }
         self.live = None
@@ -286,10 +308,17 @@ class SegmentWriter:
             for fname, pts in doc.geo_points.items():
                 geos.setdefault(fname, [[] for _ in range(n)])[i].extend(pts)
 
-        for fname, finv in inv.items():
+        field_present: dict[str, np.ndarray] = {}
+        for i, doc in enumerate(docs):
+            for fname in doc.field_lengths:
+                field_present.setdefault(
+                    fname, np.zeros(n, dtype=bool))[i] = True
+
+        for fname in set(inv) | set(field_present):
             seg.postings[fname] = self._build_postings(
-                fname, finv, n, field_doc_lens.get(fname),
-                has_norms=norms_fields.get(fname, fname in field_doc_lens))
+                fname, inv.get(fname, {}), n, field_doc_lens.get(fname),
+                has_norms=norms_fields.get(fname, fname in field_doc_lens),
+                present=field_present.get(fname))
 
         for fname, per_doc in longs.items():
             seg.numeric_dv[fname] = self._build_numeric(per_doc, n, "long")
@@ -313,12 +342,14 @@ class SegmentWriter:
         return seg
 
     @staticmethod
-    def _build_postings(fname, finv, n_docs, doc_lens, has_norms) -> PostingsField:
+    def _build_postings(fname, finv, n_docs, doc_lens, has_norms,
+                        present=None) -> PostingsField:
         terms_sorted = sorted(finv)
         term_ids = {t: i for i, t in enumerate(terms_sorted)}
         T = len(terms_sorted)
         df = np.zeros(T, dtype=np.int32)
         offsets = np.zeros(T + 1, dtype=np.int32)
+        has_terms = np.zeros(n_docs, dtype=bool)
         doc_list, tf_list, pos_off, pos_all = [], [], [0], []
         for t_idx, term in enumerate(terms_sorted):
             entries = finv[term]  # already ascending doc id (insert order)
@@ -328,12 +359,15 @@ class SegmentWriter:
                 tf_list.append(tf)
                 pos_all.extend(plist)
                 pos_off.append(len(pos_all))
+                has_terms[d] = True
             offsets[t_idx + 1] = len(doc_list)
         if doc_lens is None:
             doc_lens = np.ones(n_docs, dtype=np.float32)
         docs_with = int((doc_lens > 0).sum()) if has_norms else n_docs
         if not has_norms:
             doc_lens = np.ones(n_docs, dtype=np.float32)
+        if present is None:
+            present = has_terms
         return PostingsField(
             terms=term_ids, df=df, offsets=offsets,
             doc_ids=np.asarray(doc_list, dtype=np.int32),
@@ -342,7 +376,8 @@ class SegmentWriter:
             positions=np.asarray(pos_all, dtype=np.int32),
             doc_lens=doc_lens.astype(np.float32),
             total_len=float(doc_lens[doc_lens > 0].sum()) if has_norms else float(n_docs),
-            docs_with_field=docs_with, has_norms=has_norms)
+            docs_with_field=docs_with, has_norms=has_norms,
+            present=present)
 
     @staticmethod
     def _build_numeric(per_doc: list[list], n_docs: int, kind: str) -> NumericDV:

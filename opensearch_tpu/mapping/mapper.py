@@ -252,6 +252,7 @@ class DocumentMapper:
     def _index_values(self, ft: FieldType, values: list, doc: ParsedDocument):
         pos_base = 0
         n_tokens = doc.field_lengths.get(ft.name, 0)
+        saw_value = any(v is not None for v in values)
         toks = doc.tokens.setdefault(ft.name, [])
         if toks:
             pos_base = toks[-1][1] + POSITION_GAP
@@ -289,7 +290,10 @@ class DocumentMapper:
                     doc.geo_points.setdefault(ft.name, []).append(dv)
         if not toks:
             doc.tokens.pop(ft.name, None)
-        if isinstance(ft, TextFieldType):
+        # field_lengths presence == "this doc has the field" (the norms-entry
+        # analog: Lucene writes a norm even for zero-token values, so exists
+        # must match them — but a null value writes nothing).
+        if isinstance(ft, TextFieldType) and (saw_value or ft.name in doc.field_lengths):
             doc.field_lengths[ft.name] = n_tokens
 
 

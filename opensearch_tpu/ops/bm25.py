@@ -86,6 +86,27 @@ def bm25_scores(offsets, doc_ids, tfs, doc_lens, term_ids, term_active,
     return jnp.zeros(n_pad, jnp.float32).at[d].add(contrib)
 
 
+def bm25_score_count(offsets, doc_ids, tfs, doc_lens, term_ids, term_active,
+                     idfs, weights, avgdl, *, n_pad: int, budget: int,
+                     scored: bool, k1: float = K1_DEFAULT,
+                     b: float = B_DEFAULT):
+    """One gather, two scatters: dense per-doc BM25 scores AND per-doc count
+    of matched query-term slots (for AND / minimum_should_match semantics).
+    With ``scored=False`` the score scatter is skipped (filter context)."""
+    d, tf, slot, valid = gather_postings(
+        offsets, doc_ids, tfs, term_ids, term_active,
+        budget=budget, pad_doc=n_pad - 1)
+    count = jnp.zeros(n_pad, jnp.int32).at[d].add(valid.astype(jnp.int32))
+    if not scored:
+        return jnp.zeros(n_pad, jnp.float32), count
+    dl = doc_lens[d]
+    norm = k1 * (1.0 - b + b * dl / avgdl)
+    contrib = idfs[slot] * weights[slot] * tf / (tf + norm)
+    scores = jnp.zeros(n_pad, jnp.float32).at[d].add(
+        jnp.where(valid, contrib, 0.0))
+    return scores, count
+
+
 def match_count(offsets, doc_ids, tfs, term_ids, term_active, *,
                 n_pad: int, budget: int):
     """Per-doc count of DISTINCT matched query terms (for conjunctions and

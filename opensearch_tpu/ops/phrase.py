@@ -1,0 +1,76 @@
+"""Exact-phrase matching as a batched device program.
+
+Lucene's ``PhraseQuery`` walks postings doc-at-a-time advancing position
+iterators in lockstep (``ExactPhraseMatcher``).  The TPU formulation is
+set-membership over (doc, position) keys:
+
+- every occurrence of phrase term j is encoded as ``doc * POS_BASE +
+  position`` — the key arrays are sorted by construction (postings are
+  doc-ascending, positions ascending within a doc);
+- an occurrence of the anchor term (position offset 0) starts a phrase iff
+  for every other term j the key ``doc * POS_BASE + pos + off_j`` exists in
+  term j's key set (binary search via ``searchsorted``);
+- phrase frequency per doc is a scatter-add of surviving anchors, then BM25
+  scores it with idf = sum of the terms' idfs (Lucene PhraseWeight).
+"""
+
+from __future__ import annotations
+
+import opensearch_tpu.common.jaxenv  # noqa: F401
+
+import jax.numpy as jnp
+
+POS_BASE = 1 << 22  # > any token position (position_increment_gap padded)
+KEY_PAD = jnp.iinfo(jnp.int64).max
+
+
+def gather_term_positions(offsets, pos_offsets, positions, doc_ids, t_id,
+                          active, *, budget: int, pad_doc: int):
+    """All (doc, position) occurrences of one term, as fixed-size arrays.
+
+    Returns (docs[B], pos[B], valid[B]).  ``budget`` must cover the term's
+    total position count in this segment (host-known, bucketed pow2).
+    """
+    e0 = offsets[t_id]
+    e1 = jnp.where(active, offsets[t_id + 1], e0)
+    p0 = pos_offsets[e0]
+    p1 = pos_offsets[e1]
+    i = jnp.arange(budget, dtype=jnp.int32)
+    valid = i < (p1 - p0)
+    pidx = jnp.where(valid, p0 + i, 0)
+    pos = positions[pidx]
+    # owning posting entry: pos_offsets[e] <= pidx < pos_offsets[e+1]
+    entry = jnp.searchsorted(pos_offsets, pidx, side="right").astype(jnp.int32) - 1
+    entry = jnp.clip(entry, 0, doc_ids.shape[0] - 1)
+    docs = jnp.where(valid, doc_ids[entry], pad_doc)
+    return docs, pos, valid
+
+
+def phrase_freqs(postings, term_ids, term_active, offsets_in_phrase, *,
+                 budgets: tuple[int, ...], n_pad: int):
+    """Per-doc exact-phrase frequency.
+
+    ``postings`` is the staged dict (offsets/pos_offsets/positions/doc_ids);
+    ``term_ids[j]`` / ``offsets_in_phrase[j]`` describe phrase slot j
+    (analyzer positions, so stopword gaps are honored); ``budgets[j]`` is the
+    static gather budget for slot j.  Slot 0 is the anchor.
+    """
+    docs0, pos0, ok = gather_term_positions(
+        postings["offsets"], postings["pos_offsets"], postings["positions"],
+        postings["doc_ids"], term_ids[0], term_active[0],
+        budget=budgets[0], pad_doc=n_pad - 1)
+    base0 = offsets_in_phrase[0]
+    for j in range(1, len(budgets)):
+        docs_j, pos_j, valid_j = gather_term_positions(
+            postings["offsets"], postings["pos_offsets"], postings["positions"],
+            postings["doc_ids"], term_ids[j], term_active[j],
+            budget=budgets[j], pad_doc=n_pad - 1)
+        keys_j = jnp.where(valid_j,
+                           docs_j.astype(jnp.int64) * POS_BASE + pos_j,
+                           KEY_PAD)
+        target = (docs0.astype(jnp.int64) * POS_BASE + pos0
+                  + (offsets_in_phrase[j] - base0))
+        loc = jnp.searchsorted(keys_j, target)
+        loc = jnp.clip(loc, 0, budgets[j] - 1)
+        ok = ok & (keys_j[loc] == target)
+    return jnp.zeros(n_pad, jnp.float32).at[docs0].add(ok.astype(jnp.float32))

@@ -1,0 +1,1 @@
+from opensearch_tpu.search.query_dsl import parse_query  # noqa: F401

@@ -1,0 +1,507 @@
+"""Query tree -> (plan, bindings) against a shard's mapping + collection
+statistics.
+
+Analog of ``QueryBuilder.toQuery(QueryShardContext)``
+(ref index/query/QueryShardContext.java:95) plus the Lucene Weight
+construction it triggers: idf/avgdl are computed here from CROSS-SEGMENT
+stats (Lucene computes them in IndexSearcher.termStatistics over the whole
+reader, not per leaf), so scores are consistent across segments.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import math
+import re
+from dataclasses import dataclass
+
+import numpy as np
+
+from opensearch_tpu.common.errors import IllegalArgumentError, ParsingError
+from opensearch_tpu.mapping.types import (
+    DenseVectorFieldType,
+    KeywordFieldType,
+    TextFieldType,
+    parse_ip_long,
+)
+from opensearch_tpu.ops import bm25 as bm25_ops
+from opensearch_tpu.search import query_dsl as dsl
+from opensearch_tpu.search import plan as P
+
+_I64_MIN = -(2**63)
+_I64_MAX = 2**63 - 1
+
+
+@dataclass
+class FieldStats:
+    doc_count: int
+    total_len: float
+
+    @property
+    def avgdl(self) -> float:
+        return self.total_len / self.doc_count if self.doc_count else 1.0
+
+
+class ShardContext:
+    """Per-searcher compile context: mapping + collection statistics over
+    the segment set (IndexSearcher.collectionStatistics analog)."""
+
+    def __init__(self, segments, mapper):
+        self.segments = segments
+        self.mapper = mapper
+        self._fstats: dict[str, FieldStats] = {}
+        self._sorted_terms: dict[tuple[int, str], list[str]] = {}
+
+    def field_type(self, field: str):
+        return self.mapper.field_type(field)
+
+    def field_stats(self, field: str) -> FieldStats:
+        st = self._fstats.get(field)
+        if st is None:
+            doc_count = 0
+            total_len = 0.0
+            for seg in self.segments:
+                pf = seg.postings.get(field)
+                if pf is not None:
+                    doc_count += pf.docs_with_field
+                    total_len += pf.total_len
+            st = FieldStats(doc_count, total_len)
+            self._fstats[field] = st
+        return st
+
+    def df(self, field: str, term: str) -> int:
+        total = 0
+        for seg in self.segments:
+            pf = seg.postings.get(field)
+            if pf is not None:
+                tid = pf.term_id(term)
+                if tid >= 0:
+                    total += int(pf.df[tid])
+        return total
+
+    def sorted_terms(self, seg, field: str) -> list[str]:
+        key = (id(seg), field)
+        out = self._sorted_terms.get(key)
+        if out is None:
+            out = list(seg.postings[field].terms)
+            self._sorted_terms[key] = out
+        return out
+
+    def text_fields(self) -> list[str]:
+        return [f for f, ft in self.mapper.field_types().items()
+                if isinstance(ft, TextFieldType)]
+
+
+def calc_min_should_match(optional: int, spec) -> int:
+    """Lucene ``Queries.calculateMinShouldMatch`` subset: int, "-int",
+    "N%", "-N%" (conditional "N<P" specs unsupported)."""
+    if spec is None:
+        return 0
+    s = str(spec).strip()
+    if "<" in s:
+        raise IllegalArgumentError(
+            f"conditional minimum_should_match [{s}] is not supported")
+    if s.endswith("%"):
+        pct = int(s[:-1])
+        if pct < 0:
+            result = optional + int(math.floor(optional * pct / 100.0))
+        else:
+            result = int(math.floor(optional * pct / 100.0))
+    else:
+        n = int(s)
+        result = n if n >= 0 else optional + n
+    return max(0, min(optional, result))
+
+
+def _idfs_for(ctx: ShardContext, field: str, terms: list[str]) -> np.ndarray:
+    stats = ctx.field_stats(field)
+    return np.asarray(
+        [bm25_ops.idf(ctx.df(field, t), stats.doc_count) for t in terms],
+        dtype=np.float32)
+
+
+def _term_bag(ctx, field, terms, required, boost, scored):
+    idfs = _idfs_for(ctx, field, terms)
+    bind = {"terms": tuple(terms), "idfs": idfs,
+            "weights": np.full(len(terms), boost, np.float32),
+            "avgdl": ctx.field_stats(field).avgdl, "required": required}
+    return P.TermBagPlan(field=field, scored=scored), bind
+
+
+def _none():
+    return P.MatchNonePlan(), {}
+
+
+def _require_ft(ctx, field, qname):
+    ft = ctx.field_type(field)
+    if ft is None:
+        return None
+    if not ft.index_enabled and ft.dv_kind == "none":
+        raise IllegalArgumentError(
+            f"Cannot search on field [{field}] since it is not indexed")
+    return ft
+
+
+def _ip_cidr_bind(value: str, boost: float) -> dict:
+    net = ipaddress.ip_network(str(value), strict=False)
+    return {"lo": parse_ip_long(net.network_address),
+            "hi": parse_ip_long(net.broadcast_address), "boost": boost}
+
+
+def compile_query(q: dsl.Query, ctx: ShardContext, scored: bool = True):
+    """Returns (plan, bind)."""
+    fn = _COMPILERS.get(type(q))
+    if fn is None:
+        raise IllegalArgumentError(
+            f"query type [{type(q).__name__}] is not supported")
+    return fn(q, ctx, scored)
+
+
+def _c_match_all(q, ctx, scored):
+    return P.MatchAllPlan(), {"boost": q.boost}
+
+
+def _c_match_none(q, ctx, scored):
+    return _none()
+
+
+def _c_term(q, ctx, scored):
+    ft = _require_ft(ctx, q.field, "term")
+    if ft is None:
+        return _none()
+    if ft.type_name == "ip":
+        if "/" in str(q.value):
+            return (P.NumericRangePlan(field=q.field, kind="long"),
+                    _ip_cidr_bind(q.value, q.boost))
+        term = str(ipaddress.ip_address(str(q.value)))
+        return _term_bag(ctx, q.field, [term], 1, q.boost, scored)
+    if ft.dv_kind in ("long", "double") and ft.type_name != "boolean":
+        return (P.NumericTermsPlan(field=q.field, kind=ft.dv_kind),
+                {"values": [ft.term_for_query(q.value)], "boost": q.boost})
+    term = ft.term_for_query(q.value)
+    return _term_bag(ctx, q.field, [term], 1, q.boost, scored)
+
+
+def _c_terms(q, ctx, scored):
+    ft = _require_ft(ctx, q.field, "terms")
+    if ft is None or not q.values:
+        return _none()
+    if ft.type_name == "ip":
+        cidrs = [v for v in q.values if "/" in str(v)]
+        exact = [str(ipaddress.ip_address(str(v))) for v in q.values
+                 if "/" not in str(v)]
+        if cidrs:
+            children, binds = [], []
+            if exact:
+                p = P.PostingsMaskPlan(field=q.field)
+                children.append(p)
+                binds.append({"terms": tuple(exact), "boost": 1.0})
+            for c in cidrs:
+                net = ipaddress.ip_network(str(c), strict=False)
+                children.append(P.NumericRangePlan(field=q.field, kind="long"))
+                binds.append({"lo": parse_ip_long(net.network_address),
+                              "hi": parse_ip_long(net.broadcast_address),
+                              "boost": 1.0})
+            inner = P.BoolPlan(should=tuple(children))
+            return (P.ConstScorePlan(child=inner),
+                    {"boost": q.boost,
+                     "child": {"boost": 1.0, "required": 1,
+                               "children": tuple(binds)}})
+        return (P.PostingsMaskPlan(field=q.field),
+                {"terms": tuple(exact), "boost": q.boost})
+    if ft.dv_kind in ("long", "double") and ft.type_name != "boolean":
+        return (P.NumericTermsPlan(field=q.field, kind=ft.dv_kind),
+                {"values": [ft.term_for_query(v) for v in q.values],
+                 "boost": q.boost})
+    terms = [ft.term_for_query(v) for v in q.values]
+    return (P.PostingsMaskPlan(field=q.field),
+            {"terms": tuple(terms), "boost": q.boost})
+
+
+def _c_match(q, ctx, scored):
+    ft = _require_ft(ctx, q.field, "match")
+    if ft is None:
+        return _none()
+    if not isinstance(ft, TextFieldType):
+        return _c_term(dsl.TermQuery(field=q.field, value=q.query,
+                                     boost=q.boost), ctx, scored)
+    terms = ft.search_terms(q.query, ctx.mapper.analyzers)
+    if not terms:
+        return _none()
+    if q.fuzziness is not None:
+        children, binds = [], []
+        for t in terms:
+            children.append(P.ExpandTermsPlan(field=q.field, mode="fuzzy"))
+            binds.append({"pattern": t, "fuzzy_dist": _auto_fuzzy(q.fuzziness, t),
+                          "prefix_length": 0, "boost": q.boost})
+        required = (len(terms) if q.operator == "and"
+                    else max(1, calc_min_should_match(
+                        len(terms), q.minimum_should_match)))
+        # fuzzy clauses are constant-score masks; combine as bool
+        plan = P.BoolPlan(should=tuple(children))
+        return plan, {"boost": 1.0, "required": required,
+                      "children": tuple(binds)}
+    if q.operator == "and":
+        required = len(terms)
+    else:
+        required = max(1, calc_min_should_match(len(terms),
+                                                q.minimum_should_match))
+    return _term_bag(ctx, q.field, terms, required, q.boost, scored)
+
+
+def _auto_fuzzy(fuzziness, term: str) -> int:
+    s = str(fuzziness).upper()
+    if s.startswith("AUTO"):
+        n = len(term)
+        return 0 if n < 3 else (1 if n <= 5 else 2)
+    return int(float(s))
+
+
+def _c_match_phrase(q, ctx, scored):
+    ft = _require_ft(ctx, q.field, "match_phrase")
+    if ft is None:
+        return _none()
+    if not isinstance(ft, TextFieldType):
+        return _c_term(dsl.TermQuery(field=q.field, value=q.query,
+                                     boost=q.boost), ctx, scored)
+    analyzer = ctx.mapper.analyzers.get(ft.search_analyzer_name)
+    toks = analyzer.analyze(str(q.query))
+    if not toks:
+        return _none()
+    if len(toks) == 1:
+        return _term_bag(ctx, q.field, [toks[0].term], 1, q.boost, scored)
+    if q.slop:
+        raise IllegalArgumentError("match_phrase slop > 0 is not supported yet")
+    terms = [t.term for t in toks]
+    positions = [t.position for t in toks]
+    stats = ctx.field_stats(q.field)
+    idf_sum = float(np.sum(_idfs_for(ctx, q.field, terms)))
+    bind = {"terms": tuple(terms), "positions": tuple(positions),
+            "idf_sum": idf_sum, "boost": q.boost, "avgdl": stats.avgdl}
+    return P.PhrasePlan(field=q.field, scored=scored), bind
+
+
+def _c_multi_match(q, ctx, scored):
+    children, binds = [], []
+    for field, fboost in q.fields:
+        if ctx.field_type(field) is None:
+            continue
+        if q.type == "phrase":
+            sub = dsl.MatchPhraseQuery(field=field, query=q.query,
+                                       boost=q.boost * fboost)
+            p, b = _c_match_phrase(sub, ctx, scored)
+        else:
+            sub = dsl.MatchQuery(field=field, query=q.query,
+                                 operator=q.operator,
+                                 minimum_should_match=q.minimum_should_match,
+                                 boost=q.boost * fboost)
+            p, b = _c_match(sub, ctx, scored)
+        if not isinstance(p, P.MatchNonePlan):
+            children.append(p)
+            binds.append(b)
+    if not children:
+        return _none()
+    if len(children) == 1:
+        return children[0], binds[0]
+    plan = P.DisMaxPlan(children=tuple(children))
+    return plan, {"boost": 1.0, "tie_breaker": q.tie_breaker,
+                  "children": tuple(binds)}
+
+
+def _c_bool(q, ctx, scored):
+    groups = {}
+    for name, qs, sub_scored in (("must", q.must, scored),
+                                 ("should", q.should, scored),
+                                 ("must_not", q.must_not, False),
+                                 ("filter", q.filter, False)):
+        plans, binds = [], []
+        for sub in qs:
+            p, b = compile_query(sub, ctx, sub_scored)
+            plans.append(p)
+            binds.append(b)
+        groups[name] = (tuple(plans), tuple(binds))
+    n_should = len(groups["should"][0])
+    if q.minimum_should_match is not None:
+        required = calc_min_should_match(n_should, q.minimum_should_match)
+    else:
+        required = 0 if (q.must or q.filter) else (1 if n_should else 0)
+    plan = P.BoolPlan(must=groups["must"][0], should=groups["should"][0],
+                      must_not=groups["must_not"][0],
+                      filter=groups["filter"][0])
+    bind = {"boost": q.boost, "required": required,
+            "children": (groups["must"][1] + groups["should"][1]
+                         + groups["must_not"][1] + groups["filter"][1])}
+    return plan, bind
+
+
+def _c_range(q, ctx, scored):
+    ft = _require_ft(ctx, q.field, "range")
+    if ft is None:
+        return _none()
+    if isinstance(ft, TextFieldType):
+        raise IllegalArgumentError(
+            f"range query on [text] field [{q.field}] is not supported")
+    if isinstance(ft, KeywordFieldType):
+        lo, lo_incl = (q.gte, True) if q.gte is not None else (q.gt, False)
+        hi, hi_incl = (q.lte, True) if q.lte is not None else (q.lt, False)
+        bind = {"lo": None if lo is None else str(lo), "lo_incl": lo_incl,
+                "hi": None if hi is None else str(hi), "hi_incl": hi_incl,
+                "boost": q.boost}
+        return P.OrdinalRangePlan(field=q.field), bind
+    kind = "double" if ft.dv_kind == "double" else "long"
+    if kind == "long":
+        lo = _I64_MIN if q.gte is None and q.gt is None else (
+            ft.range_bound(q.gte) if q.gte is not None
+            else ft.range_bound(q.gt) + 1)
+        hi = _I64_MAX if q.lte is None and q.lt is None else (
+            ft.range_bound(q.lte) if q.lte is not None
+            else ft.range_bound(q.lt) - 1)
+        return (P.NumericRangePlan(field=q.field, kind="long"),
+                {"lo": lo, "hi": hi, "boost": q.boost})
+    lo, lo_incl = (-np.inf, True)
+    if q.gte is not None:
+        lo, lo_incl = float(ft.range_bound(q.gte)), True
+    elif q.gt is not None:
+        lo, lo_incl = float(ft.range_bound(q.gt)), False
+    hi, hi_incl = (np.inf, True)
+    if q.lte is not None:
+        hi, hi_incl = float(ft.range_bound(q.lte)), True
+    elif q.lt is not None:
+        hi, hi_incl = float(ft.range_bound(q.lt)), False
+    return (P.NumericRangePlan(field=q.field, kind="double",
+                               include_lo=lo_incl, include_hi=hi_incl),
+            {"lo": lo, "hi": hi, "boost": q.boost})
+
+
+def _c_exists(q, ctx, scored):
+    ft = ctx.field_type(q.field)
+    if ft is None:
+        return _none()
+    src = {"long": "numeric", "double": "numeric", "ordinal": "ordinal",
+           "vector": "vector", "geo_point": "geo", "none": "norms"}[ft.dv_kind]
+    if src != "norms" and not ft.doc_values_enabled:
+        raise IllegalArgumentError(
+            f"exists on field [{q.field}] requires doc_values")
+    return P.ExistsPlan(field=q.field, src=src), {"boost": q.boost}
+
+
+def _c_ids(q, ctx, scored):
+    wanted = set(map(str, q.values))
+
+    def mask_fn(seg, dseg):
+        m = np.zeros(dseg.n_pad, bool)
+        for did in wanted:
+            loc = seg.id_to_local.get(did)
+            if loc is not None:
+                m[loc] = True
+        return m
+
+    return P.MaskPlan(label="ids"), {"mask_fn": mask_fn, "boost": q.boost}
+
+
+_MAX_CODEPOINT = chr(0x10FFFF)
+
+
+def _c_prefix(q, ctx, scored):
+    ft = _require_ft(ctx, q.field, "prefix")
+    if ft is None:
+        return _none()
+    value = str(q.value)
+    return (P.TermRangeMaskPlan(field=q.field),
+            {"lo": value, "hi": value + _MAX_CODEPOINT, "boost": q.boost})
+
+
+def _c_wildcard(q, ctx, scored):
+    ft = _require_ft(ctx, q.field, "wildcard")
+    if ft is None:
+        return _none()
+    return (P.ExpandTermsPlan(field=q.field, mode="wildcard"),
+            {"pattern": str(q.value), "fuzzy_dist": 0, "prefix_length": 0,
+             "boost": q.boost})
+
+
+def _c_regexp(q, ctx, scored):
+    ft = _require_ft(ctx, q.field, "regexp")
+    if ft is None:
+        return _none()
+    return (P.ExpandTermsPlan(field=q.field, mode="regexp"),
+            {"pattern": str(q.value), "fuzzy_dist": 0, "prefix_length": 0,
+             "boost": q.boost})
+
+
+def _c_fuzzy(q, ctx, scored):
+    ft = _require_ft(ctx, q.field, "fuzzy")
+    if ft is None:
+        return _none()
+    return (P.ExpandTermsPlan(field=q.field, mode="fuzzy"),
+            {"pattern": str(q.value),
+             "fuzzy_dist": _auto_fuzzy(q.fuzziness, str(q.value)),
+             "prefix_length": q.prefix_length, "boost": q.boost})
+
+
+def _c_constant_score(q, ctx, scored):
+    child_plan, child_bind = compile_query(q.query, ctx, scored=False)
+    return (P.ConstScorePlan(child=child_plan),
+            {"boost": q.boost, "child": child_bind})
+
+
+def _c_dis_max(q, ctx, scored):
+    plans, binds = [], []
+    for sub in q.queries:
+        p, b = compile_query(sub, ctx, scored)
+        plans.append(p)
+        binds.append(b)
+    if not plans:
+        return _none()
+    return (P.DisMaxPlan(children=tuple(plans)),
+            {"boost": q.boost, "tie_breaker": q.tie_breaker,
+             "children": tuple(binds)})
+
+
+def _c_simple_query_string(q, ctx, scored):
+    fields = q.fields
+    if not fields or fields == [("*", 1.0)]:
+        fields = [(f, 1.0) for f in ctx.text_fields()]
+    tokens = [t for t in re.split(r"\s+", q.query.strip()) if t]
+    sub_queries = []
+    for tok in tokens:
+        negate = tok.startswith("-")
+        tok = tok.lstrip("+-").strip('"')
+        if not tok:
+            continue
+        mm = dsl.MultiMatchQuery(fields=fields, query=tok)
+        sub_queries.append((negate, mm))
+    if not sub_queries:
+        return P.MatchAllPlan(), {"boost": q.boost}
+    must, must_not, should = [], [], []
+    for negate, mm in sub_queries:
+        if negate:
+            must_not.append(mm)
+        elif q.default_operator == "and":
+            must.append(mm)
+        else:
+            should.append(mm)
+    return _c_bool(dsl.BoolQuery(must=must, must_not=must_not, should=should,
+                                 boost=q.boost), ctx, scored)
+
+
+_COMPILERS = {
+    dsl.MatchAllQuery: _c_match_all,
+    dsl.MatchNoneQuery: _c_match_none,
+    dsl.TermQuery: _c_term,
+    dsl.TermsQuery: _c_terms,
+    dsl.MatchQuery: _c_match,
+    dsl.MatchPhraseQuery: _c_match_phrase,
+    dsl.MultiMatchQuery: _c_multi_match,
+    dsl.BoolQuery: _c_bool,
+    dsl.RangeQuery: _c_range,
+    dsl.ExistsQuery: _c_exists,
+    dsl.IdsQuery: _c_ids,
+    dsl.PrefixQuery: _c_prefix,
+    dsl.WildcardQuery: _c_wildcard,
+    dsl.RegexpQuery: _c_regexp,
+    dsl.FuzzyQuery: _c_fuzzy,
+    dsl.ConstantScoreQuery: _c_constant_score,
+    dsl.DisMaxQuery: _c_dis_max,
+    dsl.SimpleQueryStringQuery: _c_simple_query_string,
+}

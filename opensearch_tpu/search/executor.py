@@ -1,0 +1,374 @@
+"""Shard-side query phase: run a compiled plan over every segment, merge
+top-k across segments, fetch sources.
+
+Analog of ``SearchService.executeQueryPhase`` -> ``QueryPhase.execute``
+(search/query/QueryPhase.java:133) and the per-leaf loop in
+``ContextIndexSearcher.searchLeaf`` (search/internal/
+ContextIndexSearcher.java:292).  Where Lucene iterates doc-at-a-time per
+leaf, here each segment is one batched XLA program producing dense scores;
+the per-shard "reduce" over segments is a host-side k-way merge with
+Lucene's tie-break (score desc, then index order = (segment, local doc)).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Optional
+
+import numpy as np
+
+import opensearch_tpu.common.jaxenv  # noqa: F401
+import jax.numpy as jnp
+
+from opensearch_tpu.common.errors import IllegalArgumentError
+from opensearch_tpu.index.segment import (
+    LONG_MISSING_MAX,
+    LONG_MISSING_MIN,
+    DeviceSegment,
+    Segment,
+)
+from opensearch_tpu.search import plan as P
+from opensearch_tpu.search.compiler import ShardContext, compile_query
+from opensearch_tpu.search.fetch import filter_source
+from opensearch_tpu.search.query_dsl import parse_query
+
+_F32 = np.float32
+_I32 = np.int32
+
+
+def _dummy_for(group: str, field: str, dseg: DeviceSegment, mapper):
+    """Shape-consistent empty arrays for a field absent from this segment
+    (all-inactive: matches nothing, scores nothing)."""
+    n_pad = dseg.n_pad
+    dead = n_pad - 1
+    if group == "postings":
+        return {
+            "offsets": jnp.zeros(8, jnp.int32),
+            "doc_ids": jnp.full(8, dead, jnp.int32),
+            "tfs": jnp.zeros(8, jnp.float32),
+            "doc_lens": jnp.zeros(n_pad, jnp.float32),
+            "pos_offsets": jnp.zeros(8, jnp.int32),
+            "positions": jnp.zeros(8, jnp.int32),
+            "field_exists": jnp.zeros(n_pad, bool),
+        }
+    if group == "numeric":
+        ft = mapper.field_type(field)
+        dtype = jnp.float64 if (ft is not None and ft.dv_kind == "double") else jnp.int64
+        sentinel_min = np.inf if dtype == jnp.float64 else LONG_MISSING_MAX
+        sentinel_max = -np.inf if dtype == jnp.float64 else LONG_MISSING_MIN
+        return {
+            "values": jnp.zeros(8, dtype),
+            "value_docs": jnp.full(8, dead, jnp.int32),
+            "minv": jnp.full(n_pad, sentinel_min, dtype),
+            "maxv": jnp.full(n_pad, sentinel_max, dtype),
+            "exists": jnp.zeros(n_pad, bool),
+        }
+    if group == "ordinal":
+        return {
+            "ords": jnp.full(8, -1, jnp.int32),
+            "value_docs": jnp.full(8, dead, jnp.int32),
+            "min_ord": jnp.full(n_pad, -1, jnp.int32),
+            "max_ord": jnp.full(n_pad, -1, jnp.int32),
+            "exists": jnp.zeros(n_pad, bool),
+        }
+    if group == "vector":
+        ft = mapper.field_type(field)
+        dim = getattr(ft, "dims", 1) or 1
+        return {
+            "values": jnp.zeros((n_pad, dim), jnp.float32),
+            "exists": jnp.zeros(n_pad, bool),
+        }
+    if group == "geo":
+        return {
+            "lats": jnp.zeros(8, jnp.float32),
+            "lons": jnp.zeros(8, jnp.float32),
+            "value_docs": jnp.full(8, dead, jnp.int32),
+            "exists": jnp.zeros(n_pad, bool),
+        }
+    raise IllegalArgumentError(f"unknown array group [{group}]")
+
+
+def build_arrays(dseg: DeviceSegment, needed, mapper):
+    """Assemble the ``A`` pytree a plan reads: live mask + requested field
+    array groups (absent fields get all-inactive dummies)."""
+    A = {"live": dseg.live}
+    sources = {"postings": dseg.postings, "numeric": dseg.numeric,
+               "ordinal": dseg.ordinal, "vector": dseg.vector,
+               "geo": dseg.geo}
+    cache = getattr(dseg, "_dummy_cache", None)
+    if cache is None:
+        cache = {}
+        dseg._dummy_cache = cache
+    for group, field in sorted(needed):
+        entry = sources[group].get(field)
+        if entry is None:
+            entry = cache.get((group, field))
+            if entry is None:
+                entry = _dummy_for(group, field, dseg, mapper)
+                cache[(group, field)] = entry
+        A.setdefault(group, {})[field] = {
+            k: v for k, v in entry.items() if k != "n_ords"}
+    return A
+
+
+def _parse_sort(spec) -> Optional[list[dict]]:
+    """Normalize the request ``sort`` into [{field, order, missing}].
+    Returns None for the plain score-sorted path."""
+    if spec is None:
+        return None
+    if not isinstance(spec, list):
+        spec = [spec]
+    out = []
+    for s in spec:
+        if isinstance(s, str):
+            field, order = s, ("desc" if s == "_score" else "asc")
+            out.append({"field": field, "order": order, "missing": "_last"})
+        elif isinstance(s, dict):
+            if len(s) != 1:
+                raise IllegalArgumentError(f"malformed sort clause {s}")
+            field, opts = next(iter(s.items()))
+            if isinstance(opts, str):
+                out.append({"field": field, "order": opts, "missing": "_last"})
+            else:
+                out.append({"field": field,
+                            "order": opts.get("order",
+                                              "desc" if field == "_score" else "asc"),
+                            "missing": opts.get("missing", "_last")})
+        else:
+            raise IllegalArgumentError(f"malformed sort clause {s}")
+    if len(out) == 1 and out[0]["field"] == "_score" and out[0]["order"] == "desc":
+        return None
+    return out
+
+
+class ShardSearcher:
+    """Immutable point-in-time view over a shard's segments (the
+    Engine.Searcher / reader-context analog, ref search/SearchService.java:986)."""
+
+    def __init__(self, segments: list[Segment], mapper, index_name: str = "index",
+                 shard_id: int = 0):
+        self.segments = [s for s in segments if s.n_docs > 0]
+        self.mapper = mapper
+        self.index_name = index_name
+        self.shard_id = shard_id
+        self.ctx = ShardContext(self.segments, mapper)
+
+    # -- public API -------------------------------------------------------
+
+    def doc_count(self) -> int:
+        return sum(s.live_count() for s in self.segments)
+
+    def count(self, query_json: Optional[dict] = None) -> int:
+        if not self.segments:
+            return 0
+        plan, bind = compile_query(parse_query(query_json), self.ctx, scored=False)
+        needed = plan.arrays()
+        total = 0
+        for seg, dseg, scores, matched in self._run_full(plan, bind, needed, None):
+            total += int(np.asarray(matched).sum())
+        return total
+
+    def search(self, body: Optional[dict] = None) -> dict:
+        body = body or {}
+        t0 = time.monotonic()
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        q = parse_query(body.get("query"))
+        sort_specs = _parse_sort(body.get("sort"))
+        min_score = body.get("min_score")
+        source_spec = body.get("_source")
+
+        plan, bind = compile_query(q, self.ctx, scored=True)
+        needed = plan.arrays()
+        k_want = from_ + size
+
+        if not self.segments:
+            rows, total, max_score = [], 0, None
+        elif sort_specs is None:
+            rows, total, max_score = self._topk(plan, bind, needed, k_want,
+                                                min_score)
+        else:
+            rows, total, max_score = self._field_sorted(plan, bind, needed,
+                                                        k_want, sort_specs,
+                                                        min_score)
+        rows = rows[from_: from_ + size]
+
+        hits = []
+        for row in rows:
+            seg = self.segments[row["seg"]]
+            local = row["local"]
+            hit = {"_index": self.index_name, "_id": seg.doc_ids[local],
+                   "_score": row.get("score")}
+            src = filter_source(seg.source(local), source_spec)
+            if src is not None:
+                hit["_source"] = src
+            if "sort" in row:
+                hit["sort"] = row["sort"]
+            hits.append(hit)
+
+        took = int((time.monotonic() - t0) * 1000)
+        return {
+            "took": took,
+            "timed_out": False,
+            "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
+            "hits": {
+                "total": {"value": int(total), "relation": "eq"},
+                "max_score": max_score,
+                "hits": hits,
+            },
+        }
+
+    # -- internals --------------------------------------------------------
+
+    def _run_full(self, plan, bind, needed, min_score):
+        ms = jnp.asarray(np.float32(-np.inf if min_score is None else min_score))
+        for seg in self.segments:
+            dseg = seg.device()
+            A = build_arrays(dseg, needed, self.mapper)
+            dims, ins = plan.prepare(bind, seg, dseg, self.ctx)
+            scores, matched = P.run_full(plan, dims, A, ins, ms)
+            yield seg, dseg, scores, matched
+
+    def _topk(self, plan, bind, needed, k_want, min_score):
+        all_scores, all_seg, all_local = [], [], []
+        total = 0
+        max_score = -np.inf
+        ms = jnp.asarray(np.float32(-np.inf if min_score is None else min_score))
+        for si, seg in enumerate(self.segments):
+            dseg = seg.device()
+            A = build_arrays(dseg, needed, self.mapper)
+            dims, ins = plan.prepare(bind, seg, dseg, self.ctx)
+            k = min(k_want, dseg.n_pad)
+            vals, idx, tot, mx = P.run_topk(plan, dims, k, A, ins, ms)
+            vals = np.asarray(vals)
+            idx = np.asarray(idx)
+            keep = vals > -np.inf
+            all_scores.append(vals[keep])
+            all_local.append(idx[keep])
+            all_seg.append(np.full(int(keep.sum()), si, dtype=_I32))
+            total += int(tot)
+            max_score = max(max_score, float(mx))
+        if not all_scores:
+            return [], 0, None
+        scores = np.concatenate(all_scores)
+        segi = np.concatenate(all_seg)
+        local = np.concatenate(all_local)
+        order = np.lexsort((local, segi, -scores))[:k_want]
+        rows = [{"seg": int(segi[i]), "local": int(local[i]),
+                 "score": float(scores[i])} for i in order]
+        return rows, total, (None if max_score == -np.inf else float(max_score))
+
+    def _sort_key_columns(self, seg, spec, scores_np):
+        """Per-doc sort key for one segment + one sort clause.  Returns
+        (keys ndarray or list, is_numeric)."""
+        field, order = spec["field"], spec["order"]
+        if field == "_score":
+            return scores_np.astype(np.float64), True
+        if field == "_doc":
+            return np.arange(seg.n_docs, dtype=np.int64), True
+        ft = self.mapper.field_type(field)
+        if ft is None:
+            raise IllegalArgumentError(f"No mapping found for [{field}] in order to sort on")
+        if ft.dv_kind in ("long", "double"):
+            dv = seg.numeric_dv.get(field)
+            if dv is None:
+                sentinel = _missing_sentinel(ft.dv_kind, order, spec["missing"])
+                return np.full(seg.n_docs, sentinel,
+                               np.int64 if ft.dv_kind == "long" else np.float64), True
+            keys = (dv.minv if order == "asc" else dv.maxv).copy()
+            missing = ~dv.exists
+            keys[missing] = _missing_sentinel(ft.dv_kind, order, spec["missing"])
+            return keys, True
+        if ft.dv_kind == "ordinal":
+            dv = seg.ordinal_dv.get(field)
+            out = []
+            for i in range(seg.n_docs):
+                if dv is None or not dv.exists[i]:
+                    out.append(None)
+                else:
+                    o = dv.min_ord[i] if order == "asc" else dv.max_ord[i]
+                    out.append(dv.ord_terms[o])
+            return out, False
+        raise IllegalArgumentError(
+            f"sorting on field [{field}] of type [{ft.type_name}] is not supported")
+
+    def _field_sorted(self, plan, bind, needed, k_want, sort_specs, min_score):
+        rows = []
+        total = 0
+        for si, (seg, dseg, scores, matched) in enumerate(
+                self._run_full(plan, bind, needed, min_score)):
+            matched_np = np.asarray(matched)[: seg.n_docs]
+            scores_np = np.asarray(scores)[: seg.n_docs]
+            total += int(matched_np.sum())
+            idxs = np.nonzero(matched_np)[0]
+            if len(idxs) == 0:
+                continue
+            key_cols = [self._sort_key_columns(seg, spec, scores_np)
+                        for spec in sort_specs]
+            for i in idxs:
+                keyvals = []
+                for (col, _num), spec in zip(key_cols, sort_specs):
+                    keyvals.append(col[int(i)])
+                rows.append({"seg": si, "local": int(i), "sort": keyvals,
+                             "score": float(scores_np[i])})
+        cmp = _sort_comparator(sort_specs)
+        rows.sort(key=functools.cmp_to_key(cmp))
+        out = []
+        for row in rows[:k_want]:
+            out.append({"seg": row["seg"], "local": row["local"],
+                        "score": None,
+                        "sort": [_sort_value(v) for v in row["sort"]]})
+        return out, total, None
+
+
+def _missing_sentinel(kind, order, missing):
+    if missing not in ("_last", "_first"):
+        return int(missing) if kind == "long" else float(missing)
+    last = missing == "_last"
+    if kind == "long":
+        big, small = LONG_MISSING_MAX, LONG_MISSING_MIN
+    else:
+        big, small = np.inf, -np.inf
+    if order == "asc":
+        return big if last else small
+    return small if last else big
+
+
+def _cmp_values(a, b, order: str, missing: str) -> int:
+    if a is None or b is None:
+        if a is None and b is None:
+            return 0
+        none_first = (missing == "_first")
+        if a is None:
+            return -1 if none_first else 1
+        return 1 if none_first else -1
+    if a == b:
+        return 0
+    lt = a < b
+    if order == "desc":
+        lt = not lt
+    return -1 if lt else 1
+
+
+def _sort_comparator(specs):
+    def cmp(r1, r2):
+        for i, spec in enumerate(specs):
+            c = _cmp_values(r1["sort"][i], r2["sort"][i], spec["order"],
+                           spec["missing"])
+            if c:
+                return c
+        if r1["seg"] != r2["seg"]:
+            return -1 if r1["seg"] < r2["seg"] else 1
+        return -1 if r1["local"] < r2["local"] else (0 if r1["local"] == r2["local"] else 1)
+    return cmp
+
+
+def _sort_value(v):
+    if v is None:
+        return None
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    return v

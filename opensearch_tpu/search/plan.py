@@ -1,0 +1,628 @@
+"""Query compilation: Query tree -> (plan, bindings) -> jit'd per-segment
+XLA program.
+
+Analog of the reference's two-step ``QueryBuilder.rewrite`` +
+``toQuery(QueryShardContext)`` (index/query/QueryShardContext.java:95) and
+the Lucene ``Weight``/``Scorer`` machinery it produces.  The TPU twist:
+
+- a *plan node* is a frozen, hashable dataclass holding only static
+  STRUCTURE (field names, clause layout, scoring flags).  It is a jit
+  static argument, so each distinct query SHAPE compiles once; all queries
+  of that shape (any terms, bounds, boosts) reuse the compiled program;
+- per-query compile-time data (term strings, idfs, bounds, boosts) lives
+  in a parallel *bindings tree* mirroring the plan tree, consumed host-side
+  by ``prepare`` which emits the dynamic ``ins`` pytree per segment;
+- per-segment static sizes (gather budgets, padded term counts) travel as
+  the ``dims`` tuple pytree, also static (bucketed pow2 so segments of
+  similar size share programs);
+- every node evaluates to ``(scores f32 [n_pad], matched bool [n_pad])``;
+  scores are zero wherever unmatched, so boolean composition is masked
+  arithmetic, not iterator intersection (Lucene ConjunctionDISI analog).
+"""
+
+from __future__ import annotations
+
+import bisect
+import fnmatch
+import re
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import opensearch_tpu.common.jaxenv  # noqa: F401
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from opensearch_tpu.index.segment import LONG_MISSING_MAX, pad_pow2
+from opensearch_tpu.ops import bm25 as bm25_ops
+from opensearch_tpu.ops import filters as filter_ops
+from opensearch_tpu.ops import phrase as phrase_ops
+
+_I32 = np.int32
+_F32 = np.float32
+
+
+def _scalar(x, dtype):
+    return jnp.asarray(np.asarray(x, dtype=dtype))
+
+
+def _pad_np(arr, size, fill, dtype):
+    out = np.full(size, fill, dtype=dtype)
+    a = np.asarray(arr, dtype=dtype)
+    out[: len(a)] = a
+    return jnp.asarray(out)
+
+
+# ---------------------------------------------------------------------------
+# Plan nodes.  All frozen + hashable: static query structure only.
+# Each implements:
+#   arrays() -> frozenset[(group, field)]         device arrays needed
+#   prepare(bind, seg, dseg, ctx) -> (dims, ins)  host-side, per segment
+#   eval(A, dims, ins) -> (scores, matched)       traced, pure jnp
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Plan:
+    def arrays(self) -> frozenset:
+        return frozenset()
+
+
+@dataclass(frozen=True)
+class MatchAllPlan(Plan):
+    def prepare(self, bind, seg, dseg, ctx):
+        return (), (_scalar(bind["boost"], _F32),)
+
+    def eval(self, A, dims, ins):
+        (boost,) = ins
+        n_pad = A["live"].shape[0]
+        return jnp.full(n_pad, boost, jnp.float32), jnp.ones(n_pad, bool)
+
+
+@dataclass(frozen=True)
+class MatchNonePlan(Plan):
+    def prepare(self, bind, seg, dseg, ctx):
+        return (), ()
+
+    def eval(self, A, dims, ins):
+        n_pad = A["live"].shape[0]
+        return jnp.zeros(n_pad, jnp.float32), jnp.zeros(n_pad, bool)
+
+
+@dataclass(frozen=True)
+class TermBagPlan(Plan):
+    """Weighted bag of terms over one field's postings: term / match /
+    terms-as-should.  BM25-scored (Lucene TermQuery / BooleanQuery of term
+    clauses).  bind: {terms, idfs, weights, required}; ``required`` is the
+    per-doc matched-clause count needed (1 = OR, n_terms = AND,
+    minimum_should_match otherwise)."""
+
+    field: str = ""
+    scored: bool = True
+
+    def arrays(self):
+        return frozenset({("postings", self.field)})
+
+    def prepare(self, bind, seg, dseg, ctx):
+        terms = bind["terms"]
+        pf = seg.postings.get(self.field)
+        t_pad = pad_pow2(len(terms), minimum=1)
+        tids = np.zeros(t_pad, dtype=_I32)
+        active = np.zeros(t_pad, dtype=bool)
+        budget = 0
+        for i, t in enumerate(terms):
+            tid = pf.term_id(t) if pf is not None else -1
+            if tid >= 0:
+                tids[i] = tid
+                active[i] = True
+                budget += int(pf.df[tid])
+        ins = (jnp.asarray(tids), jnp.asarray(active),
+               _pad_np(bind["idfs"], t_pad, 0.0, _F32),
+               _pad_np(bind["weights"], t_pad, 0.0, _F32),
+               _scalar(bind["avgdl"], _F32),
+               _scalar(bind["required"], _I32))
+        return (t_pad, pad_pow2(budget)), ins
+
+    def eval(self, A, dims, ins):
+        t_pad, budget = dims
+        tids, active, idfs, weights, avgdl, required = ins
+        p = A["postings"][self.field]
+        n_pad = A["live"].shape[0]
+        scores, count = bm25_ops.bm25_score_count(
+            p["offsets"], p["doc_ids"], p["tfs"], p["doc_lens"],
+            tids, active, idfs, weights, avgdl,
+            n_pad=n_pad, budget=budget, scored=self.scored)
+        matched = count >= required
+        return jnp.where(matched, scores, 0.0), matched
+
+
+@dataclass(frozen=True)
+class PhrasePlan(Plan):
+    """Exact phrase over one field (match_phrase, slop=0).  bind: {terms,
+    positions, idf_sum, boost, avgdl}."""
+
+    field: str = ""
+    scored: bool = True
+
+    def arrays(self):
+        return frozenset({("postings", self.field)})
+
+    def prepare(self, bind, seg, dseg, ctx):
+        terms = bind["terms"]
+        pf = seg.postings.get(self.field)
+        m = len(terms)
+        tids = np.zeros(m, dtype=_I32)
+        active = np.zeros(m, dtype=bool)
+        budgets = []
+        for j, t in enumerate(terms):
+            tid = pf.term_id(t) if pf is not None else -1
+            count = 0
+            if tid >= 0:
+                tids[j] = tid
+                active[j] = True
+                e0, e1 = int(pf.offsets[tid]), int(pf.offsets[tid + 1])
+                count = int(pf.pos_offsets[e1] - pf.pos_offsets[e0])
+            budgets.append(pad_pow2(count))
+        ins = (jnp.asarray(tids), jnp.asarray(active),
+               jnp.asarray(np.asarray(bind["positions"], _I32)),
+               _scalar(bind["idf_sum"], _F32),
+               _scalar(bind["boost"], _F32),
+               _scalar(bind["avgdl"], _F32))
+        return (tuple(budgets),), ins
+
+    def eval(self, A, dims, ins):
+        (budgets,) = dims
+        tids, active, positions, idf_sum, boost, avgdl = ins
+        p = A["postings"][self.field]
+        n_pad = A["live"].shape[0]
+        tf = phrase_ops.phrase_freqs(
+            p, tids, active, positions, budgets=budgets, n_pad=n_pad)
+        matched = tf > 0
+        if not self.scored:
+            return jnp.zeros(n_pad, jnp.float32), matched
+        dl = p["doc_lens"]
+        norm = bm25_ops.K1_DEFAULT * (1.0 - bm25_ops.B_DEFAULT
+                                      + bm25_ops.B_DEFAULT * dl / avgdl)
+        scores = idf_sum * boost * tf / (tf + norm)
+        return jnp.where(matched, scores, 0.0), matched
+
+
+@dataclass(frozen=True)
+class NumericTermsPlan(Plan):
+    """term/terms over a numeric/date column: constant score (the reference
+    compiles these to point/doc-values queries under ConstantScore).
+    bind: {values, boost}."""
+
+    field: str = ""
+    kind: str = "long"               # long | double
+
+    def arrays(self):
+        return frozenset({("numeric", self.field)})
+
+    def prepare(self, bind, seg, dseg, ctx):
+        vals = bind["values"]
+        q_pad = pad_pow2(len(vals), minimum=1)
+        dtype = np.int64 if self.kind == "long" else np.float64
+        fill = LONG_MISSING_MAX if self.kind == "long" else np.nan
+        qv = _pad_np(vals, q_pad, fill, dtype)
+        qvalid = _pad_np(np.ones(len(vals), bool), q_pad, False, bool)
+        return (q_pad,), (qv, qvalid, _scalar(bind["boost"], _F32))
+
+    def eval(self, A, dims, ins):
+        qv, qvalid, boost = ins
+        col = A["numeric"][self.field]
+        n_pad = A["live"].shape[0]
+        ok = (col["values"][:, None] == qv[None, :]) & qvalid[None, :]
+        matched = jnp.zeros(n_pad, bool).at[col["value_docs"]].max(ok.any(axis=1))
+        return jnp.where(matched, boost, 0.0).astype(jnp.float32), matched
+
+
+@dataclass(frozen=True)
+class NumericRangePlan(Plan):
+    """bind: {lo, hi, boost} (inclusivity resolved into the bounds at
+    compile time for longs; kept as static flags for doubles)."""
+
+    field: str = ""
+    kind: str = "long"               # long | double
+    include_lo: bool = True
+    include_hi: bool = True
+
+    def arrays(self):
+        return frozenset({("numeric", self.field)})
+
+    def prepare(self, bind, seg, dseg, ctx):
+        dtype = np.int64 if self.kind == "long" else np.float64
+        return (), (_scalar(bind["lo"], dtype), _scalar(bind["hi"], dtype),
+                    _scalar(bind["boost"], _F32))
+
+    def eval(self, A, dims, ins):
+        lo, hi, boost = ins
+        col = A["numeric"][self.field]
+        n_pad = A["live"].shape[0]
+        matched = filter_ops.range_mask(
+            col["values"], col["value_docs"], lo, hi,
+            include_lo=self.include_lo, include_hi=self.include_hi,
+            n_pad=n_pad)
+        return jnp.where(matched, boost, 0.0).astype(jnp.float32), matched
+
+
+@dataclass(frozen=True)
+class OrdinalRangePlan(Plan):
+    """Keyword range: per-segment ordinal bounds resolved host-side by
+    binary search over the sorted term dictionary; the device compares
+    ordinals (ordinal order == term order by construction).
+    bind: {lo, lo_incl, hi, hi_incl, boost}."""
+
+    field: str = ""
+
+    def arrays(self):
+        return frozenset({("ordinal", self.field)})
+
+    def prepare(self, bind, seg, dseg, ctx):
+        dv = seg.ordinal_dv.get(self.field)
+        terms = dv.ord_terms if dv is not None else []
+        lo, hi = bind["lo"], bind["hi"]
+        lo_ord = 0
+        hi_ord = len(terms)
+        if lo is not None:
+            lo_ord = (bisect.bisect_left(terms, lo) if bind["lo_incl"]
+                      else bisect.bisect_right(terms, lo))
+        if hi is not None:
+            hi_ord = (bisect.bisect_right(terms, hi) if bind["hi_incl"]
+                      else bisect.bisect_left(terms, hi))
+        return (), (_scalar(lo_ord, _I32), _scalar(hi_ord, _I32),
+                    _scalar(bind["boost"], _F32))
+
+    def eval(self, A, dims, ins):
+        lo_ord, hi_ord, boost = ins
+        col = A["ordinal"][self.field]
+        n_pad = A["live"].shape[0]
+        matched = filter_ops.range_mask(
+            col["ords"], col["value_docs"], lo_ord, hi_ord,
+            include_lo=True, include_hi=False, n_pad=n_pad)
+        return jnp.where(matched, boost, 0.0).astype(jnp.float32), matched
+
+
+@dataclass(frozen=True)
+class PostingsMaskPlan(Plan):
+    """Constant-score docs-containing-any-of-these-terms (terms query on a
+    keyword/text field — Lucene TermInSetQuery).  bind: {terms, boost}."""
+
+    field: str = ""
+
+    def arrays(self):
+        return frozenset({("postings", self.field)})
+
+    def prepare(self, bind, seg, dseg, ctx):
+        terms = bind["terms"]
+        pf = seg.postings.get(self.field)
+        t_pad = pad_pow2(len(terms), minimum=1)
+        tids = np.zeros(t_pad, dtype=_I32)
+        active = np.zeros(t_pad, dtype=bool)
+        budget = 0
+        for i, t in enumerate(terms):
+            tid = pf.term_id(t) if pf is not None else -1
+            if tid >= 0:
+                tids[i] = tid
+                active[i] = True
+                budget += int(pf.df[tid])
+        return ((t_pad, pad_pow2(budget)),
+                (jnp.asarray(tids), jnp.asarray(active),
+                 _scalar(bind["boost"], _F32)))
+
+    def eval(self, A, dims, ins):
+        t_pad, budget = dims
+        tids, active, boost = ins
+        p = A["postings"][self.field]
+        n_pad = A["live"].shape[0]
+        matched = filter_ops.postings_mask(
+            p["offsets"], p["doc_ids"], p["tfs"], tids, active,
+            n_pad=n_pad, budget=budget)
+        return jnp.where(matched, boost, 0.0).astype(jnp.float32), matched
+
+
+@dataclass(frozen=True)
+class TermRangeMaskPlan(Plan):
+    """Constant-score docs containing any term in a CONTIGUOUS term-id
+    range — a prefix is a range of the sorted term dict (Lucene
+    PrefixQuery's automaton walk collapses to two binary searches).
+    bind: {lo, hi, boost} (string bounds, [lo, hi))."""
+
+    field: str = ""
+
+    def arrays(self):
+        return frozenset({("postings", self.field)})
+
+    def prepare(self, bind, seg, dseg, ctx):
+        pf = seg.postings.get(self.field)
+        lo_tid = hi_tid = 0
+        budget = 0
+        if pf is not None:
+            sterms = ctx.sorted_terms(seg, self.field)
+            lo_tid = bisect.bisect_left(sterms, bind["lo"])
+            hi_tid = bisect.bisect_left(sterms, bind["hi"])
+            budget = int(pf.offsets[hi_tid] - pf.offsets[lo_tid])
+        return ((pad_pow2(budget),),
+                (_scalar(lo_tid, _I32), _scalar(hi_tid, _I32),
+                 _scalar(bind["boost"], _F32)))
+
+    def eval(self, A, dims, ins):
+        (budget,) = dims
+        lo_tid, hi_tid, boost = ins
+        p = A["postings"][self.field]
+        n_pad = A["live"].shape[0]
+        o_lo = p["offsets"][lo_tid]
+        o_hi = p["offsets"][hi_tid]
+        i = jnp.arange(budget, dtype=jnp.int32)
+        valid = i < (o_hi - o_lo)
+        idx = jnp.where(valid, o_lo + i, 0)
+        d = jnp.where(valid, p["doc_ids"][idx], n_pad - 1)
+        matched = jnp.zeros(n_pad, bool).at[d].max(valid)
+        return jnp.where(matched, boost, 0.0).astype(jnp.float32), matched
+
+
+@dataclass(frozen=True)
+class ExpandTermsPlan(Plan):
+    """wildcard / regexp / fuzzy: terms enumerated host-side per segment
+    against the sorted dictionary, then a constant-score postings mask
+    (Lucene MultiTermQuery CONSTANT_SCORE rewrite).
+    bind: {pattern, fuzzy_dist, prefix_length, boost}."""
+
+    field: str = ""
+    mode: str = "wildcard"           # wildcard | regexp | fuzzy
+
+    def arrays(self):
+        return frozenset({("postings", self.field)})
+
+    def _expand(self, bind, sterms: list[str]) -> list[int]:
+        pat = bind["pattern"]
+        if self.mode == "wildcard":
+            rx = re.compile(fnmatch.translate(pat))
+            return [i for i, t in enumerate(sterms) if rx.match(t)]
+        if self.mode == "regexp":
+            rx = re.compile(pat)
+            return [i for i, t in enumerate(sterms) if rx.fullmatch(t)]
+        out = []
+        pre = pat[: bind["prefix_length"]]
+        for i, t in enumerate(sterms):
+            if pre and not t.startswith(pre):
+                continue
+            if _edit_distance_le(pat, t, bind["fuzzy_dist"]):
+                out.append(i)
+        return out
+
+    def prepare(self, bind, seg, dseg, ctx):
+        pf = seg.postings.get(self.field)
+        tids_list: list[int] = []
+        budget = 0
+        if pf is not None:
+            sterms = ctx.sorted_terms(seg, self.field)
+            tids_list = self._expand(bind, sterms)
+            budget = int(sum(int(pf.df[t]) for t in tids_list))
+        t_pad = pad_pow2(len(tids_list), minimum=1)
+        return ((t_pad, pad_pow2(budget)),
+                (_pad_np(tids_list, t_pad, 0, _I32),
+                 _pad_np(np.ones(len(tids_list), bool), t_pad, False, bool),
+                 _scalar(bind["boost"], _F32)))
+
+    eval = PostingsMaskPlan.eval
+
+
+@dataclass(frozen=True)
+class ExistsPlan(Plan):
+    field: str = ""
+    src: str = "numeric"             # numeric | ordinal | vector | geo | norms
+
+    def arrays(self):
+        group = "postings" if self.src == "norms" else self.src
+        return frozenset({(group, self.field)})
+
+    def prepare(self, bind, seg, dseg, ctx):
+        return (), (_scalar(bind["boost"], _F32),)
+
+    def eval(self, A, dims, ins):
+        (boost,) = ins
+        if self.src == "norms":
+            # the norms-entry analog: matches zero-token values too
+            matched = A["postings"][self.field]["field_exists"]
+        else:
+            matched = A[self.src][self.field]["exists"]
+        return jnp.where(matched, boost, 0.0).astype(jnp.float32), matched
+
+
+@dataclass(frozen=True)
+class MaskPlan(Plan):
+    """Host-precomputed per-segment boolean mask (ids query).
+    bind: {mask_fn: (seg, dseg) -> np.bool_[n_pad], boost}."""
+
+    label: str = "ids"
+
+    def prepare(self, bind, seg, dseg, ctx):
+        mask = bind["mask_fn"](seg, dseg)
+        return (), (jnp.asarray(mask), _scalar(bind["boost"], _F32))
+
+    def eval(self, A, dims, ins):
+        mask, boost = ins
+        return jnp.where(mask, boost, 0.0).astype(jnp.float32), mask
+
+
+@dataclass(frozen=True)
+class ScoredMaskPlan(Plan):
+    """Precomputed per-segment (scores, matched) — knn pre-pass results are
+    injected into the tree through this node.
+    bind: {fn: (seg, dseg) -> (scores, mask)}."""
+
+    label: str = "knn"
+
+    def prepare(self, bind, seg, dseg, ctx):
+        scores, mask = bind["fn"](seg, dseg)
+        return (), (jnp.asarray(scores), jnp.asarray(mask))
+
+    def eval(self, A, dims, ins):
+        scores, mask = ins
+        return jnp.where(mask, scores, 0.0).astype(jnp.float32), mask
+
+
+def _prepare_children(children, binds, seg, dseg, ctx):
+    dims, ins = [], []
+    for c, b in zip(children, binds):
+        d, i = c.prepare(b, seg, dseg, ctx)
+        dims.append(d)
+        ins.append(i)
+    return tuple(dims), tuple(ins)
+
+
+@dataclass(frozen=True)
+class BoolPlan(Plan):
+    """bind: {boost, required, children: tuple of child binds} where
+    ``required`` is the resolved minimum matching should-clause count."""
+
+    must: tuple = ()
+    should: tuple = ()
+    must_not: tuple = ()
+    filter: tuple = ()
+
+    def _children(self):
+        return (*self.must, *self.should, *self.must_not, *self.filter)
+
+    def arrays(self):
+        out = frozenset()
+        for c in self._children():
+            out |= c.arrays()
+        return out
+
+    def prepare(self, bind, seg, dseg, ctx):
+        cdims, cins = _prepare_children(
+            self._children(), bind["children"], seg, dseg, ctx)
+        return cdims, (cins, _scalar(bind["boost"], _F32),
+                       _scalar(bind["required"], _I32))
+
+    def eval(self, A, dims, ins):
+        cins, boost, required = ins
+        n_pad = A["live"].shape[0]
+        outs = [c.eval(A, dims[i], cins[i])
+                for i, c in enumerate(self._children())]
+        nm, ns, nn = len(self.must), len(self.should), len(self.must_not)
+        matched = jnp.ones(n_pad, bool)
+        scores = jnp.zeros(n_pad, jnp.float32)
+        for s, m in outs[:nm]:                      # must
+            matched &= m
+            scores += s
+        for _s, m in outs[nm + ns + nn:]:           # filter
+            matched &= m
+        for _s, m in outs[nm + ns: nm + ns + nn]:   # must_not
+            matched &= ~m
+        if ns:
+            cnt = jnp.zeros(n_pad, jnp.int32)
+            for s, m in outs[nm: nm + ns]:          # should
+                cnt += m.astype(jnp.int32)
+                scores += s
+            matched &= cnt >= required
+        scores = jnp.where(matched, scores * boost, 0.0)
+        return scores, matched
+
+
+@dataclass(frozen=True)
+class DisMaxPlan(Plan):
+    """bind: {boost, tie_breaker, children}."""
+
+    children: tuple = ()
+
+    def arrays(self):
+        out = frozenset()
+        for c in self.children:
+            out |= c.arrays()
+        return out
+
+    def prepare(self, bind, seg, dseg, ctx):
+        cdims, cins = _prepare_children(
+            self.children, bind["children"], seg, dseg, ctx)
+        return cdims, (cins, _scalar(bind["boost"], _F32),
+                       _scalar(bind["tie_breaker"], _F32))
+
+    def eval(self, A, dims, ins):
+        cins, boost, tie = ins
+        n_pad = A["live"].shape[0]
+        best = jnp.zeros(n_pad, jnp.float32)
+        total = jnp.zeros(n_pad, jnp.float32)
+        matched = jnp.zeros(n_pad, bool)
+        for i, c in enumerate(self.children):
+            s, m = c.eval(A, dims[i], cins[i])
+            best = jnp.maximum(best, s)
+            total += s
+            matched |= m
+        scores = best + tie * (total - best)
+        return jnp.where(matched, scores * boost, 0.0), matched
+
+
+@dataclass(frozen=True)
+class ConstScorePlan(Plan):
+    """bind: {boost, child}."""
+
+    child: Optional[Plan] = None
+
+    def arrays(self):
+        return self.child.arrays()
+
+    def prepare(self, bind, seg, dseg, ctx):
+        cdims, cins = self.child.prepare(bind["child"], seg, dseg, ctx)
+        return cdims, (cins, _scalar(bind["boost"], _F32))
+
+    def eval(self, A, dims, ins):
+        cins, boost = ins
+        _s, matched = self.child.eval(A, dims, cins)
+        return jnp.where(matched, boost, 0.0).astype(jnp.float32), matched
+
+
+def _edit_distance_le(a: str, b: str, k: int) -> bool:
+    """Banded Levenshtein: True iff edit_distance(a, b) <= k."""
+    if abs(len(a) - len(b)) > k:
+        return False
+    if k == 0:
+        return a == b
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i] + [0] * len(b)
+        lo = max(1, i - k)
+        hi = min(len(b), i + k)
+        if lo > 1:
+            cur[lo - 1] = k + 1
+        for j in range(lo, hi + 1):
+            cost = 0 if ca == b[j - 1] else 1
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+        for j in range(hi + 1, len(b) + 1):
+            cur[j] = k + 1
+        prev = cur
+        if min(prev) > k:
+            return False
+    return prev[len(b)] <= k
+
+
+# ---------------------------------------------------------------------------
+# jit entry points.  plan/dims/k are static; A/ins are traced.
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def run_topk(plan: Plan, dims, k: int, A, ins, min_score):
+    """(top_scores[k], top_local_ids[k], total_matched, max_score).
+    top_k's lower-index tie-break == Lucene's ascending-doc-id tie-break.
+    ``min_score`` (-inf when unset) excludes docs from hits AND total,
+    matching MinimumScoreCollector semantics."""
+    scores, matched = plan.eval(A, dims, ins)
+    matched = matched & A["live"] & (scores >= min_score)
+    key = jnp.where(matched, scores, -jnp.inf)
+    vals, idx = lax.top_k(key, k)
+    return vals, idx, matched.sum(), jnp.max(key)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def run_full(plan: Plan, dims, A, ins, min_score):
+    """(scores[n_pad] zeroed-unmatched, matched[n_pad]) — for aggs, sorts,
+    counts."""
+    scores, matched = plan.eval(A, dims, ins)
+    matched = matched & A["live"] & (scores >= min_score)
+    return jnp.where(matched, scores, 0.0), matched

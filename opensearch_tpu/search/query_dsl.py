@@ -1,0 +1,386 @@
+"""Query DSL: JSON -> typed query tree.
+
+Analog of the reference's ``index/query/*QueryBuilder`` classes (47 builders,
+server/src/main/java/org/opensearch/index/query/; parsed via
+``AbstractQueryBuilder.parseInnerQueryBuilder``).  Parsing is independent of
+any shard: the tree is compiled against a shard's segments by
+``opensearch_tpu.search.plan`` (the ``toQuery(QueryShardContext)`` analog,
+ref index/query/QueryShardContext.java:95).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Optional
+
+from opensearch_tpu.common.errors import ParsingError
+
+
+@dataclass
+class Query:
+    boost: float = 1.0
+
+
+@dataclass
+class MatchAllQuery(Query):
+    pass
+
+
+@dataclass
+class MatchNoneQuery(Query):
+    pass
+
+
+@dataclass
+class TermQuery(Query):
+    field: str = ""
+    value: Any = None
+
+
+@dataclass
+class TermsQuery(Query):
+    field: str = ""
+    values: list = dc_field(default_factory=list)
+
+
+@dataclass
+class MatchQuery(Query):
+    field: str = ""
+    query: Any = None
+    operator: str = "or"            # or | and
+    minimum_should_match: Optional[str] = None
+    fuzziness: Optional[str] = None
+
+
+@dataclass
+class MatchPhraseQuery(Query):
+    field: str = ""
+    query: Any = None
+    slop: int = 0
+
+
+@dataclass
+class MultiMatchQuery(Query):
+    fields: list = dc_field(default_factory=list)   # [(field, boost)]
+    query: Any = None
+    type: str = "best_fields"        # best_fields | most_fields | phrase
+    operator: str = "or"
+    tie_breaker: float = 0.0
+    minimum_should_match: Optional[str] = None
+
+
+@dataclass
+class BoolQuery(Query):
+    must: list = dc_field(default_factory=list)
+    should: list = dc_field(default_factory=list)
+    must_not: list = dc_field(default_factory=list)
+    filter: list = dc_field(default_factory=list)
+    minimum_should_match: Optional[str] = None
+
+
+@dataclass
+class RangeQuery(Query):
+    field: str = ""
+    gte: Any = None
+    gt: Any = None
+    lte: Any = None
+    lt: Any = None
+    fmt: Optional[str] = None
+    time_zone: Optional[str] = None
+
+
+@dataclass
+class ExistsQuery(Query):
+    field: str = ""
+
+
+@dataclass
+class IdsQuery(Query):
+    values: list = dc_field(default_factory=list)
+
+
+@dataclass
+class PrefixQuery(Query):
+    field: str = ""
+    value: str = ""
+
+
+@dataclass
+class WildcardQuery(Query):
+    field: str = ""
+    value: str = ""
+
+
+@dataclass
+class RegexpQuery(Query):
+    field: str = ""
+    value: str = ""
+
+
+@dataclass
+class FuzzyQuery(Query):
+    field: str = ""
+    value: str = ""
+    fuzziness: str = "AUTO"
+    prefix_length: int = 0
+
+
+@dataclass
+class ConstantScoreQuery(Query):
+    query: Optional[Query] = None
+
+
+@dataclass
+class DisMaxQuery(Query):
+    queries: list = dc_field(default_factory=list)
+    tie_breaker: float = 0.0
+
+
+@dataclass
+class KnnQuery(Query):
+    field: str = ""
+    vector: list = dc_field(default_factory=list)
+    k: int = 10
+    filter: Optional[Query] = None
+
+
+@dataclass
+class ScriptScoreQuery(Query):
+    query: Optional[Query] = None
+    script: dict = dc_field(default_factory=dict)
+
+
+@dataclass
+class SimpleQueryStringQuery(Query):
+    query: str = ""
+    fields: list = dc_field(default_factory=list)
+    default_operator: str = "or"
+
+
+def _field_kv(body: dict, qname: str) -> tuple[str, Any]:
+    if len(body) != 1:
+        raise ParsingError(f"[{qname}] query must reference exactly one field, got {sorted(body)}")
+    return next(iter(body.items()))
+
+
+def _as_list(v) -> list:
+    return v if isinstance(v, list) else [v]
+
+
+def _boost(body) -> float:
+    return float(body.get("boost", 1.0)) if isinstance(body, dict) else 1.0
+
+
+def _parse_fields_with_boosts(fields: list) -> list[tuple[str, float]]:
+    out = []
+    for f in fields:
+        if "^" in f:
+            name, _, b = f.partition("^")
+            out.append((name, float(b)))
+        else:
+            out.append((f, 1.0))
+    return out
+
+
+def parse_query(obj: Optional[dict]) -> Query:
+    """Parse one query object ``{"<type>": {...}}`` into a Query tree."""
+    if obj is None:
+        return MatchAllQuery()
+    if not isinstance(obj, dict):
+        raise ParsingError(f"malformed query, expected an object but got [{obj}]")
+    if not obj:
+        return MatchAllQuery()
+    if len(obj) != 1:
+        raise ParsingError(
+            f"malformed query, expected one top-level key but got {sorted(obj)}")
+    qname, body = next(iter(obj.items()))
+    parser = _PARSERS.get(qname)
+    if parser is None:
+        raise ParsingError(f"unknown query [{qname}]")
+    return parser(body)
+
+
+def _parse_match_all(body):
+    return MatchAllQuery(boost=_boost(body))
+
+
+def _parse_match_none(body):
+    return MatchNoneQuery()
+
+
+def _parse_term(body):
+    field, v = _field_kv(body, "term")
+    if isinstance(v, dict):
+        return TermQuery(field=field, value=v.get("value"), boost=_boost(v))
+    return TermQuery(field=field, value=v)
+
+
+def _parse_terms(body):
+    rest = {k: v for k, v in body.items() if k != "boost"}
+    field, vals = _field_kv(rest, "terms")
+    if not isinstance(vals, list):
+        raise ParsingError("[terms] query requires an array of values")
+    return TermsQuery(field=field, values=vals, boost=_boost(body))
+
+
+def _parse_match(body):
+    field, v = _field_kv(body, "match")
+    if isinstance(v, dict):
+        return MatchQuery(
+            field=field, query=v.get("query"),
+            operator=str(v.get("operator", "or")).lower(),
+            minimum_should_match=(
+                None if v.get("minimum_should_match") is None
+                else str(v.get("minimum_should_match"))),
+            fuzziness=v.get("fuzziness"),
+            boost=_boost(v))
+    return MatchQuery(field=field, query=v)
+
+
+def _parse_match_phrase(body):
+    field, v = _field_kv(body, "match_phrase")
+    if isinstance(v, dict):
+        return MatchPhraseQuery(field=field, query=v.get("query"),
+                                slop=int(v.get("slop", 0)), boost=_boost(v))
+    return MatchPhraseQuery(field=field, query=v)
+
+
+def _parse_multi_match(body):
+    typ = str(body.get("type", "best_fields"))
+    tie = body.get("tie_breaker")
+    return MultiMatchQuery(
+        fields=_parse_fields_with_boosts(body.get("fields", [])),
+        query=body.get("query"),
+        type=typ,
+        operator=str(body.get("operator", "or")).lower(),
+        tie_breaker=float(tie) if tie is not None else (1.0 if typ == "most_fields" else 0.0),
+        minimum_should_match=(
+            None if body.get("minimum_should_match") is None
+            else str(body.get("minimum_should_match"))),
+        boost=_boost(body))
+
+
+def _parse_bool(body):
+    msm = body.get("minimum_should_match")
+    return BoolQuery(
+        must=[parse_query(q) for q in _as_list(body.get("must", []))],
+        should=[parse_query(q) for q in _as_list(body.get("should", []))],
+        must_not=[parse_query(q) for q in _as_list(body.get("must_not", []))],
+        filter=[parse_query(q) for q in _as_list(body.get("filter", []))],
+        minimum_should_match=None if msm is None else str(msm),
+        boost=_boost(body))
+
+
+def _parse_range(body):
+    field, v = _field_kv(body, "range")
+    if not isinstance(v, dict):
+        raise ParsingError("[range] query requires bounds object")
+    known = {"gte", "gt", "lte", "lt", "from", "to", "include_lower",
+             "include_upper", "boost", "format", "time_zone", "relation"}
+    unknown = set(v) - known
+    if unknown:
+        raise ParsingError(f"[range] query does not support {sorted(unknown)}")
+    gte, gt, lte, lt = v.get("gte"), v.get("gt"), v.get("lte"), v.get("lt")
+    # legacy from/to form
+    if "from" in v:
+        if v.get("include_lower", True):
+            gte = v["from"]
+        else:
+            gt = v["from"]
+    if "to" in v:
+        if v.get("include_upper", True):
+            lte = v["to"]
+        else:
+            lt = v["to"]
+    return RangeQuery(field=field, gte=gte, gt=gt, lte=lte, lt=lt,
+                      fmt=v.get("format"), time_zone=v.get("time_zone"),
+                      boost=_boost(v))
+
+
+def _parse_exists(body):
+    return ExistsQuery(field=body["field"], boost=_boost(body))
+
+
+def _parse_ids(body):
+    return IdsQuery(values=list(body.get("values", [])), boost=_boost(body))
+
+
+def _term_like(cls, qname):
+    def parse(body):
+        field, v = _field_kv(body, qname)
+        if isinstance(v, dict):
+            return cls(field=field, value=v.get("value"), boost=_boost(v))
+        return cls(field=field, value=v)
+    return parse
+
+
+def _parse_fuzzy(body):
+    field, v = _field_kv(body, "fuzzy")
+    if isinstance(v, dict):
+        return FuzzyQuery(field=field, value=str(v.get("value")),
+                          fuzziness=str(v.get("fuzziness", "AUTO")),
+                          prefix_length=int(v.get("prefix_length", 0)),
+                          boost=_boost(v))
+    return FuzzyQuery(field=field, value=str(v))
+
+
+def _parse_constant_score(body):
+    return ConstantScoreQuery(query=parse_query(body.get("filter")), boost=_boost(body))
+
+
+def _parse_dis_max(body):
+    return DisMaxQuery(queries=[parse_query(q) for q in body.get("queries", [])],
+                       tie_breaker=float(body.get("tie_breaker", 0.0)),
+                       boost=_boost(body))
+
+
+def _parse_knn(body):
+    # Accept both the opensearch-knn plugin shape {field: {vector, k}} and a
+    # flat {field, query_vector, k} shape.
+    if "field" in body and ("query_vector" in body or "vector" in body):
+        return KnnQuery(field=body["field"],
+                        vector=list(body.get("query_vector") or body.get("vector")),
+                        k=int(body.get("k", 10)),
+                        filter=parse_query(body["filter"]) if body.get("filter") else None,
+                        boost=_boost(body))
+    field, v = _field_kv({k: v for k, v in body.items() if k != "boost"}, "knn")
+    return KnnQuery(field=field, vector=list(v["vector"]), k=int(v.get("k", 10)),
+                    filter=parse_query(v["filter"]) if v.get("filter") else None,
+                    boost=_boost(v))
+
+
+def _parse_script_score(body):
+    return ScriptScoreQuery(query=parse_query(body.get("query")),
+                            script=body.get("script", {}), boost=_boost(body))
+
+
+def _parse_simple_query_string(body):
+    return SimpleQueryStringQuery(
+        query=str(body.get("query", "")),
+        fields=_parse_fields_with_boosts(body.get("fields", ["*"])),
+        default_operator=str(body.get("default_operator", "or")).lower(),
+        boost=_boost(body))
+
+
+_PARSERS = {
+    "match_all": _parse_match_all,
+    "match_none": _parse_match_none,
+    "term": _parse_term,
+    "terms": _parse_terms,
+    "match": _parse_match,
+    "match_phrase": _parse_match_phrase,
+    "multi_match": _parse_multi_match,
+    "bool": _parse_bool,
+    "range": _parse_range,
+    "exists": _parse_exists,
+    "ids": _parse_ids,
+    "prefix": _term_like(PrefixQuery, "prefix"),
+    "wildcard": _term_like(WildcardQuery, "wildcard"),
+    "regexp": _term_like(RegexpQuery, "regexp"),
+    "fuzzy": _parse_fuzzy,
+    "constant_score": _parse_constant_score,
+    "dis_max": _parse_dis_max,
+    "knn": _parse_knn,
+    "script_score": _parse_script_score,
+    "simple_query_string": _parse_simple_query_string,
+}

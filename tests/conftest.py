@@ -1,14 +1,24 @@
 """Test harness configuration.
 
 Tests run on a virtual 8-device CPU mesh (the driver validates the real
-multi-chip path separately via __graft_entry__.dryrun_multichip).  These env
-vars must be set before jax is imported anywhere.
+multi-chip path separately via __graft_entry__.dryrun_multichip).
+
+jax may already have been imported by the environment's sitecustomize with
+JAX_PLATFORMS pointing at the real accelerator, so setting env vars here is
+NOT enough: use jax.config.update, which takes effect as long as no backend
+has been initialized yet.  XLA_FLAGS is read at backend-client creation, so
+setting it here still works.
 """
 
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,528 @@
+"""Query-phase correctness: device results vs an independent pure-Python
+oracle (the AbstractQueryTestCase-style correctness bar from SURVEY §4:
+top-k must match scalar BM25 bit-for-bit with Lucene's tie-break)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.segment import SegmentWriter
+from opensearch_tpu.mapping.mapper import DocumentMapper
+from opensearch_tpu.mapping.types import parse_date_millis
+from opensearch_tpu.search.executor import ShardSearcher
+
+K1, B = 1.2, 0.75
+
+MAPPING = {
+    "properties": {
+        "title": {"type": "text"},
+        "body": {"type": "text"},
+        "tags": {"type": "keyword"},
+        "price": {"type": "long"},
+        "rating": {"type": "double"},
+        "ts": {"type": "date"},
+        "active": {"type": "boolean"},
+    }
+}
+
+VOCAB = ("alpha bravo charlie delta echo foxtrot golf hotel india juliet "
+         "kilo lima mike november oscar papa quebec romeo sierra tango").split()
+TAGS = ["red", "green", "blue", "yellow", "purple"]
+
+
+def build_corpus(n_docs=240, n_segments=3, seed=7):
+    rng = np.random.default_rng(seed)
+    mapper = DocumentMapper(MAPPING)
+    writer = SegmentWriter()
+    segments = []
+    parsed_by_seg = []
+    per_seg = n_docs // n_segments
+    doc_no = 0
+    for si in range(n_segments):
+        parsed = []
+        for _ in range(per_seg):
+            title = " ".join(rng.choice(VOCAB, size=rng.integers(2, 6)))
+            body = " ".join(rng.choice(VOCAB, size=rng.integers(5, 30)))
+            src = {
+                "title": title,
+                "body": body,
+                "tags": list(rng.choice(TAGS, size=rng.integers(1, 4), replace=False)),
+                "price": int(rng.integers(0, 1000)),
+                "rating": float(np.round(rng.uniform(0, 5), 2)),
+                "ts": f"2023-{rng.integers(1, 13):02d}-{rng.integers(1, 28):02d}",
+                "active": bool(rng.integers(0, 2)),
+            }
+            if rng.uniform() < 0.1:
+                del src["price"]          # some docs missing the field
+            doc = mapper.parse(str(doc_no), src)
+            doc.seq_no = doc_no
+            parsed.append(doc)
+            doc_no += 1
+        segments.append(writer.build(parsed, f"seg_{si}"))
+        parsed_by_seg.append(parsed)
+    return mapper, segments, parsed_by_seg
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    mapper, segments, parsed = build_corpus()
+    searcher = ShardSearcher(segments, mapper, index_name="test")
+    oracle = Oracle(parsed, mapper)
+    return searcher, oracle
+
+
+# ---------------------------------------------------------------------------
+# Oracle: independent scalar implementation of Lucene BM25 + query semantics.
+# ---------------------------------------------------------------------------
+
+
+class Oracle:
+    def __init__(self, parsed_by_seg, mapper):
+        self.segs = parsed_by_seg
+        self.mapper = mapper
+
+    def docs(self):
+        for si, seg in enumerate(self.segs):
+            for li, doc in enumerate(seg):
+                yield (si, li), doc
+
+    def field_stats(self, field):
+        doc_count, total_len = 0, 0.0
+        ft = self.mapper.field_type(field)
+        is_text = ft is not None and ft.type_name == "text"
+        for _, doc in self.docs():
+            if is_text:
+                n = doc.field_lengths.get(field, 0)
+                if n > 0:
+                    doc_count += 1
+                    total_len += n
+            else:
+                if any(t == field for t in doc.tokens) and doc.tokens.get(field):
+                    doc_count += 1
+                    total_len += 1.0
+        return doc_count, (total_len / doc_count if doc_count else 1.0)
+
+    def df(self, field, term):
+        n = 0
+        for _, doc in self.docs():
+            if any(t == term for t, _ in doc.tokens.get(field, [])):
+                n += 1
+        return n
+
+    def idf(self, field, term):
+        doc_count, _ = self.field_stats(field)
+        df = self.df(field, term)
+        return math.log(1.0 + (doc_count - df + 0.5) / (df + 0.5))
+
+    def doc_len(self, doc, field):
+        ft = self.mapper.field_type(field)
+        if ft is not None and ft.type_name == "text":
+            return float(doc.field_lengths.get(field, 0))
+        return 1.0
+
+    def tf(self, doc, field, term):
+        return sum(1 for t, _ in doc.tokens.get(field, []) if t == term)
+
+    def score_bag(self, field, terms, required=1, boost=1.0):
+        """OR/AND bag of BM25-scored terms -> {(si,li): score}."""
+        _, avgdl = self.field_stats(field)
+        idfs = {t: self.idf(field, t) for t in set(terms)}
+        out = {}
+        for key, doc in self.docs():
+            matched = 0
+            score = 0.0
+            for t in terms:
+                tf = self.tf(doc, field, t)
+                if tf > 0:
+                    matched += 1
+                    dl = self.doc_len(doc, field)
+                    norm = K1 * (1 - B + B * dl / avgdl)
+                    score += boost * idfs[t] * tf / (tf + norm)
+            if matched >= required:
+                out[key] = score
+        return out
+
+    def eval(self, q, scored=True):
+        """query json -> {(si,li): score}"""
+        name, body = next(iter(q.items()))
+        fn = getattr(self, f"_q_{name}")
+        return fn(body, scored)
+
+    def _q_match_all(self, body, scored):
+        boost = body.get("boost", 1.0)
+        return {key: boost for key, _ in self.docs()}
+
+    def _q_term(self, body, scored):
+        field, v = next(iter(body.items()))
+        boost, value = 1.0, v
+        if isinstance(v, dict):
+            boost, value = v.get("boost", 1.0), v.get("value")
+        ft = self.mapper.field_type(field)
+        if ft.dv_kind in ("long", "double") and ft.type_name != "boolean":
+            want = ft.term_for_query(value)
+            vals_attr = "longs" if ft.dv_kind == "long" else "doubles"
+            return {key: boost for key, doc in self.docs()
+                    if want in getattr(doc, vals_attr).get(field, [])}
+        return self.score_bag(field, [ft.term_for_query(value)], 1, boost)
+
+    def _q_terms(self, body, scored):
+        boost = body.get("boost", 1.0)
+        field, vals = next(iter((k, v) for k, v in body.items() if k != "boost"))
+        ft = self.mapper.field_type(field)
+        if ft.dv_kind in ("long", "double") and ft.type_name != "boolean":
+            want = {ft.term_for_query(v) for v in vals}
+            attr = "longs" if ft.dv_kind == "long" else "doubles"
+            return {key: boost for key, doc in self.docs()
+                    if want & set(getattr(doc, attr).get(field, []))}
+        want = {ft.term_for_query(v) for v in vals}
+        out = {}
+        for key, doc in self.docs():
+            if want & {t for t, _ in doc.tokens.get(field, [])}:
+                out[key] = boost
+        return out
+
+    def _q_match(self, body, scored):
+        field, v = next(iter(body.items()))
+        boost, operator, msm = 1.0, "or", None
+        if isinstance(v, dict):
+            boost = v.get("boost", 1.0)
+            operator = v.get("operator", "or").lower()
+            msm = v.get("minimum_should_match")
+            text = v["query"]
+        else:
+            text = v
+        ft = self.mapper.field_type(field)
+        if ft.type_name != "text":
+            return self._q_term({field: {"value": text, "boost": boost}}, scored)
+        terms = ft.search_terms(text, self.mapper.analyzers)
+        if not terms:
+            return {}
+        if operator == "and":
+            required = len(terms)
+        elif msm is not None:
+            required = max(1, int(msm))
+        else:
+            required = 1
+        return self.score_bag(field, terms, required, boost)
+
+    def _q_match_phrase(self, body, scored):
+        field, v = next(iter(body.items()))
+        boost = 1.0
+        if isinstance(v, dict):
+            boost, text = v.get("boost", 1.0), v["query"]
+        else:
+            text = v
+        ft = self.mapper.field_type(field)
+        analyzer = self.mapper.analyzers.get(ft.search_analyzer_name)
+        toks = analyzer.analyze(str(text))
+        if len(toks) == 1:
+            return self.score_bag(field, [toks[0].term], 1, boost)
+        _, avgdl = self.field_stats(field)
+        idf_sum = sum(self.idf(field, t.term) for t in toks)
+        out = {}
+        for key, doc in self.docs():
+            positions = {}
+            for t, p in doc.tokens.get(field, []):
+                positions.setdefault(t, set()).add(p)
+            first = positions.get(toks[0].term, set())
+            count = 0
+            for p0 in first:
+                if all((p0 + t.position - toks[0].position) in positions.get(t.term, set())
+                       for t in toks[1:]):
+                    count += 1
+            if count > 0:
+                dl = self.doc_len(doc, field)
+                norm = K1 * (1 - B + B * dl / avgdl)
+                out[key] = boost * idf_sum * count / (count + norm)
+        return out
+
+    def _q_bool(self, body, scored):
+        must = [self.eval(q, scored) for q in _aslist(body.get("must", []))]
+        should = [self.eval(q, scored) for q in _aslist(body.get("should", []))]
+        must_not = [self.eval(q, False) for q in _aslist(body.get("must_not", []))]
+        filt = [self.eval(q, False) for q in _aslist(body.get("filter", []))]
+        boost = body.get("boost", 1.0)
+        msm = body.get("minimum_should_match")
+        if msm is not None:
+            required = int(msm)
+        else:
+            required = 0 if (body.get("must") or body.get("filter")) else (
+                1 if should else 0)
+        out = {}
+        for key, _ in self.docs():
+            if any(key not in m for m in must):
+                continue
+            if any(key not in f for f in filt):
+                continue
+            if any(key in n for n in must_not):
+                continue
+            s_cnt = sum(1 for s in should if key in s)
+            if should and s_cnt < required:
+                continue
+            score = sum(m[key] for m in must) + sum(s.get(key, 0.0) for s in should)
+            out[key] = score * boost
+        return out
+
+    def _q_range(self, body, scored):
+        field, v = next(iter(body.items()))
+        ft = self.mapper.field_type(field)
+        boost = v.get("boost", 1.0)
+        out = {}
+        if ft.dv_kind == "ordinal":
+            for key, doc in self.docs():
+                for val in doc.ordinals.get(field, []):
+                    ok = True
+                    if v.get("gte") is not None and not (val >= v["gte"]):
+                        ok = False
+                    if v.get("gt") is not None and not (val > v["gt"]):
+                        ok = False
+                    if v.get("lte") is not None and not (val <= v["lte"]):
+                        ok = False
+                    if v.get("lt") is not None and not (val < v["lt"]):
+                        ok = False
+                    if ok:
+                        out[key] = boost
+                        break
+            return out
+        attr = "longs" if ft.dv_kind == "long" else "doubles"
+        bounds = {k: ft.range_bound(v[k]) for k in ("gte", "gt", "lte", "lt")
+                  if v.get(k) is not None}
+        for key, doc in self.docs():
+            for val in getattr(doc, attr).get(field, []):
+                ok = True
+                if "gte" in bounds and not (val >= bounds["gte"]):
+                    ok = False
+                if "gt" in bounds and not (val > bounds["gt"]):
+                    ok = False
+                if "lte" in bounds and not (val <= bounds["lte"]):
+                    ok = False
+                if "lt" in bounds and not (val < bounds["lt"]):
+                    ok = False
+                if ok:
+                    out[key] = boost
+                    break
+        return out
+
+    def _q_exists(self, body, scored):
+        field = body["field"]
+        boost = body.get("boost", 1.0)
+        ft = self.mapper.field_type(field)
+        out = {}
+        for key, doc in self.docs():
+            if ft.dv_kind == "long" and doc.longs.get(field):
+                out[key] = boost
+            elif ft.dv_kind == "double" and doc.doubles.get(field):
+                out[key] = boost
+            elif ft.dv_kind == "ordinal" and doc.ordinals.get(field):
+                out[key] = boost
+            elif ft.dv_kind == "none" and doc.field_lengths.get(field, 0) > 0:
+                out[key] = boost
+        return out
+
+    def _q_ids(self, body, scored):
+        wanted = set(map(str, body["values"]))
+        return {key: 1.0 for key, doc in self.docs() if doc.doc_id in wanted}
+
+    def _q_prefix(self, body, scored):
+        field, v = next(iter(body.items()))
+        value = v["value"] if isinstance(v, dict) else v
+        boost = v.get("boost", 1.0) if isinstance(v, dict) else 1.0
+        out = {}
+        for key, doc in self.docs():
+            if any(t.startswith(value) for t, _ in doc.tokens.get(field, [])):
+                out[key] = boost
+        return out
+
+    def _q_wildcard(self, body, scored):
+        import fnmatch
+        field, v = next(iter(body.items()))
+        value = v["value"] if isinstance(v, dict) else v
+        out = {}
+        for key, doc in self.docs():
+            if any(fnmatch.fnmatchcase(t, value)
+                   for t, _ in doc.tokens.get(field, [])):
+                out[key] = 1.0
+        return out
+
+    def _q_constant_score(self, body, scored):
+        inner = self.eval(body["filter"], False)
+        boost = body.get("boost", 1.0)
+        return {k: boost for k in inner}
+
+    def _q_dis_max(self, body, scored):
+        subs = [self.eval(q, scored) for q in body["queries"]]
+        tie = body.get("tie_breaker", 0.0)
+        out = {}
+        keys = set().union(*[set(s) for s in subs]) if subs else set()
+        for key in keys:
+            vals = [s.get(key, 0.0) for s in subs]
+            best = max(vals)
+            out[key] = best + tie * (sum(vals) - best)
+        return out
+
+
+def _aslist(x):
+    return x if isinstance(x, list) else [x]
+
+
+def check(searcher, oracle, query, size=30, places=4):
+    """Device top-k must equal oracle top-k: ids in order + scores."""
+    resp = searcher.search({"query": query, "size": size})
+    expected = oracle.eval(query)
+    exp_rows = sorted(expected.items(), key=lambda kv: (-kv[1], kv[0]))[:size]
+    got = resp["hits"]["hits"]
+    assert resp["hits"]["total"]["value"] == len(expected), query
+    assert len(got) == min(size, len(exp_rows))
+    for hit, ((si, li), score) in zip(got, exp_rows):
+        exp_id = oracle.segs[si][li].doc_id
+        assert hit["_id"] == exp_id, (
+            f"id mismatch for {query}: got {hit['_id']} want {exp_id} "
+            f"(scores {hit['_score']} vs {score})")
+        assert hit["_score"] == pytest.approx(score, rel=10**-places), query
+    return resp
+
+
+QUERIES = [
+    {"match_all": {}},
+    {"match_all": {"boost": 2.5}},
+    {"term": {"tags": "red"}},
+    {"term": {"tags": {"value": "blue", "boost": 3.0}}},
+    {"term": {"price": 500}},
+    {"term": {"active": True}},
+    {"terms": {"tags": ["red", "green"]}},
+    {"terms": {"price": [1, 2, 3, 500]}},
+    {"match": {"title": "alpha bravo"}},
+    {"match": {"title": {"query": "alpha bravo charlie", "operator": "and"}}},
+    {"match": {"body": {"query": "echo foxtrot golf hotel",
+                        "minimum_should_match": 3}}},
+    {"match": {"title": {"query": "delta", "boost": 0.5}}},
+    {"match_phrase": {"body": "alpha bravo"}},
+    {"match_phrase": {"title": "charlie delta echo"}},
+    {"range": {"price": {"gte": 200, "lt": 700}}},
+    {"range": {"rating": {"gt": 1.5, "lte": 4.0}}},
+    {"range": {"ts": {"gte": "2023-04-01", "lt": "2023-09-01"}}},
+    {"range": {"tags": {"gte": "green", "lte": "red"}}},
+    {"exists": {"field": "price"}},
+    {"exists": {"field": "title"}},
+    {"prefix": {"tags": {"value": "g"}}},
+    {"wildcard": {"tags": {"value": "*e*"}}},
+    {"constant_score": {"filter": {"term": {"tags": "red"}}, "boost": 4.0}},
+    {"dis_max": {"queries": [{"match": {"title": "alpha"}},
+                             {"match": {"body": "alpha"}}],
+                 "tie_breaker": 0.3}},
+    {"bool": {"must": [{"match": {"title": "alpha"}}],
+              "filter": [{"range": {"price": {"gte": 100}}}]}},
+    {"bool": {"should": [{"match": {"title": "bravo"}},
+                         {"match": {"body": "charlie"}}]}},
+    {"bool": {"must": [{"match": {"body": "delta"}}],
+              "must_not": [{"term": {"tags": "red"}}]}},
+    {"bool": {"should": [{"term": {"tags": "red"}},
+                         {"term": {"tags": "green"}},
+                         {"term": {"tags": "blue"}}],
+              "minimum_should_match": 2}},
+]
+
+
+@pytest.mark.parametrize("query", QUERIES, ids=[str(q)[:60] for q in QUERIES])
+def test_query_vs_oracle(corpus, query):
+    searcher, oracle = corpus
+    check(searcher, oracle, query)
+
+
+def test_ids_query(corpus):
+    searcher, oracle = corpus
+    resp = searcher.search({"query": {"ids": {"values": ["3", "77", "150"]}},
+                            "size": 10})
+    assert sorted(h["_id"] for h in resp["hits"]["hits"]) == ["150", "3", "77"]
+
+
+def test_pagination(corpus):
+    searcher, oracle = corpus
+    q = {"match": {"body": "alpha"}}
+    full = searcher.search({"query": q, "size": 20})["hits"]["hits"]
+    page = searcher.search({"query": q, "size": 5, "from": 5})["hits"]["hits"]
+    assert [h["_id"] for h in page] == [h["_id"] for h in full[5:10]]
+
+
+def test_sort_by_field(corpus):
+    searcher, oracle = corpus
+    resp = searcher.search({
+        "query": {"match_all": {}},
+        "sort": [{"price": {"order": "asc"}}, {"ts": {"order": "desc"}}],
+        "size": 25,
+    })
+    hits = resp["hits"]["hits"]
+    expected = []
+    for (si, li), doc in oracle.docs():
+        price = doc.longs.get("price", [None])
+        ts = doc.longs.get("ts", [None])
+        expected.append((price[0] if price[0] is not None else float("inf"),
+                         -(ts[0] or 0), si, li, doc.doc_id))
+    expected.sort()
+    assert [h["_id"] for h in hits] == [e[4] for e in expected[:25]]
+    assert hits[0]["sort"][0] == expected[0][0]
+    assert hits[0]["_score"] is None
+
+
+def test_sort_by_keyword(corpus):
+    searcher, oracle = corpus
+    resp = searcher.search({
+        "query": {"match_all": {}},
+        "sort": [{"tags": {"order": "asc"}}],
+        "size": 10,
+    })
+    firsts = [h["sort"][0] for h in resp["hits"]["hits"]]
+    assert firsts == sorted(firsts)
+
+
+def test_source_filtering(corpus):
+    searcher, _ = corpus
+    resp = searcher.search({"query": {"match_all": {}}, "size": 1,
+                            "_source": ["title", "price"]})
+    src = resp["hits"]["hits"][0].get("_source", {})
+    assert set(src) <= {"title", "price"}
+    resp = searcher.search({"query": {"match_all": {}}, "size": 1,
+                            "_source": False})
+    assert "_source" not in resp["hits"]["hits"][0]
+
+
+def test_count(corpus):
+    searcher, oracle = corpus
+    q = {"term": {"tags": "red"}}
+    assert searcher.count(q) == len(oracle.eval(q))
+
+
+def test_min_score_restricts_total(corpus):
+    searcher, oracle = corpus
+    q = {"match": {"body": "alpha"}}
+    scores = sorted(oracle.eval(q).values(), reverse=True)
+    cutoff = scores[len(scores) // 2]
+    resp = searcher.search({"query": q, "size": 3, "min_score": cutoff})
+    expected_total = sum(1 for s in scores if s >= cutoff)
+    assert resp["hits"]["total"]["value"] == expected_total
+    assert all(h["_score"] >= cutoff for h in resp["hits"]["hits"])
+
+
+def test_exists_matches_zero_token_text():
+    mapper = DocumentMapper({"properties": {"body": {"type": "text"}}})
+    writer = SegmentWriter()
+    docs = [mapper.parse("0", {"body": ""}),        # present, zero tokens
+            mapper.parse("1", {"body": "hello"}),
+            mapper.parse("2", {}),                   # absent
+            mapper.parse("3", {"body": None})]       # null -> absent
+    seg = writer.build(docs, "s0")
+    searcher = ShardSearcher([seg], mapper)
+    resp = searcher.search({"query": {"exists": {"field": "body"}}, "size": 10})
+    assert sorted(h["_id"] for h in resp["hits"]["hits"]) == ["0", "1"]
+
+
+def test_deletes_respected(corpus):
+    mapper, segments, parsed = build_corpus(n_docs=60, n_segments=2, seed=11)
+    victim = segments[0].doc_ids[5]
+    segments[0].delete_local(5)
+    searcher = ShardSearcher(segments, mapper)
+    resp = searcher.search({"query": {"match_all": {}}, "size": 100})
+    ids = {h["_id"] for h in resp["hits"]["hits"]}
+    assert victim not in ids
+    assert resp["hits"]["total"]["value"] == 59
