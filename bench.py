@@ -1,0 +1,138 @@
+"""BM25 match-query benchmark (BASELINE.md config #1, msmarco-style).
+
+Builds a synthetic corpus with a zipf vocabulary, indexes it into one
+array segment, then measures end-to-end query QPS + latency through the
+full search path (DSL parse -> compile -> jit'd score/top-k -> merge ->
+fetch).  Prints ONE JSON line to stdout.
+
+vs_baseline: ratio against an assumed 500 QPS for single-node Lucene-CPU
+BM25 match queries on a comparable corpus (the reference publishes no
+numbers — BASELINE.md; 500 QPS is the commonly observed order of magnitude
+for top-10 two-term disjunctions on one node).
+
+Env knobs: OSTPU_BENCH_DOCS (default 100000), OSTPU_BENCH_QUERIES (200).
+Runs on whatever jax's default backend is (TPU under the driver; set
+JAX_PLATFORMS=cpu upstream for a smoke run).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+VOCAB_SIZE = 30_000
+AVG_LEN = 40
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_corpus(n_docs: int, seed: int = 42):
+    """Vectorized synthetic corpus -> one Segment (numpy CSR build, no
+    per-token Python loop; the analysis stage is benched separately)."""
+    from opensearch_tpu.index.segment import PostingsField, Segment
+
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(AVG_LEN // 2, AVG_LEN * 3 // 2, size=n_docs)
+    total = int(lens.sum())
+    # zipf-ish ranked term ids, clipped to vocab
+    terms = (rng.zipf(1.3, size=total) - 1).clip(0, VOCAB_SIZE - 1).astype(np.int32)
+    doc_of = np.repeat(np.arange(n_docs, dtype=np.int32), lens)
+
+    t0 = time.monotonic()
+    order = np.lexsort((doc_of, terms))
+    st, sd = terms[order], doc_of[order]
+    # unique (term, doc) pairs -> postings entries with tf counts
+    key = st.astype(np.int64) * n_docs + sd
+    uniq, counts = np.unique(key, return_counts=True)
+    p_terms = (uniq // n_docs).astype(np.int32)
+    p_docs = (uniq % n_docs).astype(np.int32)
+    tfs = counts.astype(np.float32)
+    present_terms, term_starts = np.unique(p_terms, return_index=True)
+    T = VOCAB_SIZE
+    offsets = np.zeros(T + 1, dtype=np.int32)
+    df = np.zeros(T, dtype=np.int32)
+    df_present = np.diff(np.append(term_starts, len(p_terms)))
+    df[present_terms] = df_present
+    offsets[1:] = np.cumsum(df)
+
+    seg = Segment("bench_0", n_docs)
+    seg.doc_ids = [str(i) for i in range(n_docs)]
+    seg.id_to_local = {str(i): i for i in range(n_docs)}
+    seg.sources = [b"{}"] * n_docs
+    doc_lens = lens.astype(np.float32)
+    seg.postings["body"] = PostingsField(
+        terms={f"t{t}": t for t in range(T)}, df=df, offsets=offsets,
+        doc_ids=p_docs, tfs=tfs,
+        pos_offsets=np.zeros(len(p_docs) + 1, dtype=np.int32),
+        positions=np.zeros(0, dtype=np.int32),
+        doc_lens=doc_lens, total_len=float(doc_lens.sum()),
+        docs_with_field=n_docs, has_norms=True,
+        present=np.ones(n_docs, dtype=bool))
+    build_s = time.monotonic() - t0
+    return seg, build_s
+
+
+def main():
+    n_docs = int(os.environ.get("OSTPU_BENCH_DOCS", 100_000))
+    n_queries = int(os.environ.get("OSTPU_BENCH_QUERIES", 200))
+
+    import jax
+    platform = jax.default_backend()
+    log(f"platform={platform} devices={len(jax.devices())}")
+
+    from opensearch_tpu.mapping.mapper import DocumentMapper
+    from opensearch_tpu.search.executor import ShardSearcher
+
+    t0 = time.monotonic()
+    seg, invert_s = build_corpus(n_docs)
+    log(f"corpus: {n_docs} docs, {len(seg.postings['body'].doc_ids)} postings, "
+        f"invert {invert_s:.2f}s")
+    mapper = DocumentMapper({"properties": {"body": {"type": "text"}}})
+    searcher = ShardSearcher([seg], mapper, index_name="bench")
+
+    rng = np.random.default_rng(7)
+    queries = []
+    for _ in range(n_queries):
+        a, b = (rng.zipf(1.3, size=2) - 1).clip(0, VOCAB_SIZE - 1)
+        queries.append({"query": {"match": {"body": f"t{a} t{b}"}}, "size": 10})
+
+    # warmup: compile every (query-shape, budget-bucket) once + stage arrays
+    t0 = time.monotonic()
+    for q in queries:
+        searcher.search(q)
+    warm_s = time.monotonic() - t0
+    log(f"warmup (compiles + staging): {warm_s:.1f}s")
+
+    lat = []
+    t0 = time.monotonic()
+    for q in queries:
+        qt = time.monotonic()
+        r = searcher.search(q)
+        lat.append(time.monotonic() - qt)
+    wall = time.monotonic() - t0
+    qps = len(queries) / wall
+    lat_ms = np.asarray(lat) * 1e3
+    p50 = float(np.percentile(lat_ms, 50))
+    p99 = float(np.percentile(lat_ms, 99))
+    log(f"qps={qps:.1f} p50={p50:.2f}ms p99={p99:.2f}ms")
+
+    print(json.dumps({
+        "metric": "bm25_match_qps",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(qps / 500.0, 3),
+        "p50_ms": round(p50, 3),
+        "p99_ms": round(p99, 3),
+        "n_docs": n_docs,
+        "platform": platform,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,164 @@
+"""On-disk segment format.
+
+Analog of the Lucene codec + ``index/store/Store.java``: one ``.npz`` of
+flat arrays + one ``.json`` of dictionaries/metadata + one ``.src`` blob of
+concatenated _source bytes per segment.  Arrays are written exactly as the
+in-memory Segment holds them (the device staging re-pads on load), and the
+live-docs bitmap is rewritten in place on delete-commit like Lucene's
+``.liv`` files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+from opensearch_tpu.index.segment import (
+    GeoDV,
+    NumericDV,
+    OrdinalDV,
+    PostingsField,
+    Segment,
+    VectorDV,
+)
+
+
+class CorruptIndexError(OpenSearchTpuError):
+    status = 500
+
+
+def save_segment(seg: Segment, dirpath: str):
+    os.makedirs(dirpath, exist_ok=True)
+    arrays: dict[str, np.ndarray] = {
+        "seq_nos": seg.seq_nos, "versions": seg.versions, "live": seg.live,
+    }
+    meta = {"seg_id": seg.seg_id, "n_docs": seg.n_docs,
+            "doc_ids": seg.doc_ids,
+            "postings": {}, "numeric": {}, "ordinal": {}, "vector": {},
+            "geo": {}}
+
+    src_offsets = np.zeros(len(seg.sources) + 1, dtype=np.int64)
+    for i, b in enumerate(seg.sources):
+        src_offsets[i + 1] = src_offsets[i] + len(b)
+    arrays["src_offsets"] = src_offsets
+
+    for f, pf in seg.postings.items():
+        meta["postings"][f] = {
+            "terms": list(pf.terms), "total_len": pf.total_len,
+            "docs_with_field": pf.docs_with_field, "has_norms": pf.has_norms,
+        }
+        for k in ("df", "offsets", "doc_ids", "tfs", "pos_offsets",
+                  "positions", "doc_lens", "present"):
+            arrays[f"p|{f}|{k}"] = getattr(pf, k)
+    for f, dv in seg.numeric_dv.items():
+        meta["numeric"][f] = {"kind": dv.kind}
+        for k in ("offsets", "values", "value_docs", "minv", "maxv", "exists"):
+            arrays[f"n|{f}|{k}"] = getattr(dv, k)
+    for f, dv in seg.ordinal_dv.items():
+        meta["ordinal"][f] = {"ord_terms": dv.ord_terms}
+        for k in ("offsets", "ords", "value_docs", "min_ord", "max_ord",
+                  "exists"):
+            arrays[f"o|{f}|{k}"] = getattr(dv, k)
+    for f, dv in seg.vector_dv.items():
+        meta["vector"][f] = {"dim": dv.dim, "similarity": dv.similarity}
+        arrays[f"v|{f}|values"] = dv.values
+        arrays[f"v|{f}|exists"] = dv.exists
+    for f, dv in seg.geo_dv.items():
+        meta["geo"][f] = {}
+        for k in ("offsets", "lats", "lons", "value_docs", "exists"):
+            arrays[f"g|{f}|{k}"] = getattr(dv, k)
+
+    base = os.path.join(dirpath, seg.seg_id)
+    with open(base + ".src.tmp", "wb") as f:
+        for b in seg.sources:
+            f.write(b)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(base + ".src.tmp", base + ".src")
+    with open(base + ".npz.tmp", "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(base + ".npz.tmp", base + ".npz")
+    with open(base + ".json.tmp", "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(base + ".json.tmp", base + ".json")
+
+
+def save_live(seg: Segment, dirpath: str):
+    """Rewrite only the live-docs bitmap (Lucene .liv analog)."""
+    base = os.path.join(dirpath, seg.seg_id)
+    with open(base + ".liv.tmp", "wb") as f:
+        np.save(f, seg.live)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(base + ".liv.tmp", base + ".liv")
+
+
+def load_segment(dirpath: str, seg_id: str) -> Segment:
+    base = os.path.join(dirpath, seg_id)
+    try:
+        with open(base + ".json") as f:
+            meta = json.load(f)
+        z = np.load(base + ".npz")
+        with open(base + ".src", "rb") as f:
+            src_blob = f.read()
+    except (OSError, ValueError) as e:
+        raise CorruptIndexError(f"cannot read segment [{seg_id}]: {e}") from e
+
+    seg = Segment(seg_id, meta["n_docs"])
+    seg.doc_ids = list(meta["doc_ids"])
+    seg.id_to_local = {d: i for i, d in enumerate(seg.doc_ids)}
+    seg.seq_nos = z["seq_nos"]
+    seg.versions = z["versions"]
+    seg.live = z["live"].copy()
+    src_offsets = z["src_offsets"]
+    seg.sources = [src_blob[src_offsets[i]: src_offsets[i + 1]]
+                   for i in range(meta["n_docs"])]
+    if os.path.exists(base + ".liv"):
+        seg.live = np.load(base + ".liv").copy()
+
+    for f, m in meta["postings"].items():
+        seg.postings[f] = PostingsField(
+            terms={t: i for i, t in enumerate(m["terms"])},
+            df=z[f"p|{f}|df"], offsets=z[f"p|{f}|offsets"],
+            doc_ids=z[f"p|{f}|doc_ids"], tfs=z[f"p|{f}|tfs"],
+            pos_offsets=z[f"p|{f}|pos_offsets"],
+            positions=z[f"p|{f}|positions"], doc_lens=z[f"p|{f}|doc_lens"],
+            total_len=m["total_len"], docs_with_field=m["docs_with_field"],
+            has_norms=m["has_norms"], present=z[f"p|{f}|present"])
+    for f, m in meta["numeric"].items():
+        seg.numeric_dv[f] = NumericDV(
+            kind=m["kind"], offsets=z[f"n|{f}|offsets"],
+            values=z[f"n|{f}|values"], value_docs=z[f"n|{f}|value_docs"],
+            minv=z[f"n|{f}|minv"], maxv=z[f"n|{f}|maxv"],
+            exists=z[f"n|{f}|exists"])
+    for f, m in meta["ordinal"].items():
+        seg.ordinal_dv[f] = OrdinalDV(
+            ord_terms=list(m["ord_terms"]),
+            term_to_ord={t: i for i, t in enumerate(m["ord_terms"])},
+            offsets=z[f"o|{f}|offsets"], ords=z[f"o|{f}|ords"],
+            value_docs=z[f"o|{f}|value_docs"], min_ord=z[f"o|{f}|min_ord"],
+            max_ord=z[f"o|{f}|max_ord"], exists=z[f"o|{f}|exists"])
+    for f, m in meta["vector"].items():
+        seg.vector_dv[f] = VectorDV(
+            values=z[f"v|{f}|values"], exists=z[f"v|{f}|exists"],
+            dim=m["dim"], similarity=m["similarity"])
+    for f, m in meta["geo"].items():
+        seg.geo_dv[f] = GeoDV(
+            offsets=z[f"g|{f}|offsets"], lats=z[f"g|{f}|lats"],
+            lons=z[f"g|{f}|lons"], value_docs=z[f"g|{f}|value_docs"],
+            exists=z[f"g|{f}|exists"])
+    return seg
+
+
+def delete_segment_files(dirpath: str, seg_id: str):
+    for ext in (".npz", ".json", ".src", ".liv"):
+        p = os.path.join(dirpath, seg_id + ext)
+        if os.path.exists(p):
+            os.remove(p)

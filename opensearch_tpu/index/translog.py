@@ -1,0 +1,160 @@
+"""Per-shard write-ahead log.
+
+Analog of ``index/translog/Translog.java`` (add :541, ensureSynced :821,
+rollGeneration :1703) and ``TranslogWriter``/``Checkpoint``: operations are
+appended to a generation file before being acknowledged, fsynced per the
+durability policy, and replayed on recovery for every op newer than the
+last commit's max seq-no.
+
+Format: one op per line — ``<crc32 hex 8>`` + JSON payload.  A checkpoint
+file records the current generation and the minimum generation still
+needed (everything below was committed into segments).  Torn tails (a
+partial last line after kill -9) are detected by the CRC and discarded,
+like the reference's checksummed operation framing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Iterator, Optional
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+
+
+class TranslogCorruptedError(OpenSearchTpuError):
+    status = 500
+
+
+class Translog:
+    CHECKPOINT = "translog.ckp"
+
+    def __init__(self, path: str, durability: str = "request"):
+        """durability: ``request`` = fsync on every sync() call (the caller
+        syncs before acking), ``async`` = fsync only on roll/close (the
+        engine's async fsync interval syncs periodically)."""
+        self.path = path
+        self.durability = durability
+        os.makedirs(path, exist_ok=True)
+        ckp = self._read_checkpoint()
+        if ckp is None:
+            self.generation = 1
+            self.min_generation = 1
+            self._write_checkpoint()
+        else:
+            self.generation = ckp["generation"]
+            self.min_generation = ckp["min_generation"]
+        self._file = open(self._gen_path(self.generation), "ab")
+        self._ops_since_sync = 0
+
+    # -- paths / checkpoint ----------------------------------------------
+
+    def _gen_path(self, gen: int) -> str:
+        return os.path.join(self.path, f"translog-{gen}.log")
+
+    def _read_checkpoint(self) -> Optional[dict]:
+        p = os.path.join(self.path, self.CHECKPOINT)
+        if not os.path.exists(p):
+            return None
+        with open(p) as f:
+            return json.load(f)
+
+    def _write_checkpoint(self):
+        p = os.path.join(self.path, self.CHECKPOINT)
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"generation": self.generation,
+                       "min_generation": self.min_generation}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+
+    # -- write path -------------------------------------------------------
+
+    @staticmethod
+    def encode(op: dict) -> bytes:
+        """Serialize an op up front so callers can fail BEFORE mutating any
+        engine state (write-path atomicity)."""
+        return json.dumps(op, separators=(",", ":")).encode()
+
+    def add(self, op: dict):
+        """Append one operation (no fsync — call sync() before acking)."""
+        self.add_encoded(self.encode(op))
+
+    def add_encoded(self, payload: bytes):
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        self._file.write(f"{crc:08x}".encode() + payload + b"\n")
+        self._ops_since_sync += 1
+
+    def sync(self):
+        """Durability barrier (ensureSynced analog)."""
+        self._file.flush()
+        os.fsync(self._file.fileno())
+        self._ops_since_sync = 0
+
+    def roll_generation(self):
+        """Start a new generation file (pre-commit, rollGeneration analog)."""
+        self.sync()
+        self._file.close()
+        self.generation += 1
+        self._file = open(self._gen_path(self.generation), "ab")
+        self._write_checkpoint()
+
+    def trim(self, min_generation: int):
+        """Delete generations below ``min_generation`` (post-commit)."""
+        min_generation = min(min_generation, self.generation)
+        for gen in range(self.min_generation, min_generation):
+            p = self._gen_path(gen)
+            if os.path.exists(p):
+                os.remove(p)
+        self.min_generation = min_generation
+        self._write_checkpoint()
+
+    def close(self):
+        if not self._file.closed:
+            self.sync()
+            self._file.close()
+
+    # -- recovery ---------------------------------------------------------
+
+    def read_ops(self, min_seq_no: int = -1) -> Iterator[dict]:
+        """Replay all retained ops with seq_no > min_seq_no, oldest first.
+        A corrupt NON-tail line raises; a corrupt tail (torn final write)
+        is discarded silently, matching reference recovery semantics."""
+        for gen in range(self.min_generation, self.generation + 1):
+            p = self._gen_path(gen)
+            if not os.path.exists(p):
+                continue
+            if gen == self.generation and not self._file.closed:
+                self._file.flush()
+            with open(p, "rb") as f:
+                lines = f.read().split(b"\n")
+            for i, line in enumerate(lines):
+                if not line:
+                    continue
+                is_tail = (gen == self.generation and i >= len(lines) - 2)
+                if len(line) < 8:
+                    if is_tail:
+                        break
+                    raise TranslogCorruptedError(
+                        f"translog generation [{gen}] line [{i}] truncated")
+                crc_hex, payload = line[:8], line[8:]
+                try:
+                    expected = int(crc_hex, 16)
+                except ValueError:
+                    if is_tail:
+                        break
+                    raise TranslogCorruptedError(
+                        f"translog generation [{gen}] line [{i}] bad header")
+                if (zlib.crc32(payload) & 0xFFFFFFFF) != expected:
+                    if is_tail:
+                        break
+                    raise TranslogCorruptedError(
+                        f"translog generation [{gen}] line [{i}] checksum mismatch")
+                op = json.loads(payload)
+                if op.get("seq_no", -1) > min_seq_no:
+                    yield op
+
+    def ops_count(self) -> int:
+        return sum(1 for _ in self.read_ops())

@@ -1,0 +1,160 @@
+"""Cross-shard search over a device mesh: the scatter-gather phase as XLA
+collectives.
+
+Analog of the reference's coordinator fan-out + reduce
+(action/search/AbstractSearchAsyncAction.java:223 run/performPhaseOnShard,
+SearchPhaseController.sortDocs:175 merge) — but where the reference sends
+per-shard RPCs and heap-merges topdocs on one coordinator node, here every
+shard is a mesh device, scoring runs data-parallel on all shards at once,
+and the merge is an ``all_gather`` of each shard's local top-k followed by
+a redundant on-device re-top-k (riding ICI, no host round-trip).
+
+Search-engine parallelism axes (SURVEY §2.3): corpus sharding == data
+parallelism over docs ("shards" mesh axis); replica groups for read
+throughput would be an outer mesh axis whose devices hold identical arrays
+— no TP/PP analog exists because scoring is embarrassingly parallel over
+docs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import opensearch_tpu.common.jaxenv  # noqa: F401
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from opensearch_tpu.ops import bm25 as bm25_ops
+
+
+def make_mesh(n_devices: int, axis: str = "shards") -> Mesh:
+    devs = jax.devices()[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def stack_shards(shard_list: list[dict]) -> dict:
+    """Stack per-shard array dicts (identical bucketed shapes) along a new
+    leading 'shards' axis, ready to place on the mesh."""
+    out = {}
+    for key in shard_list[0]:
+        out[key] = np.stack([np.asarray(s[key]) for s in shard_list])
+    return out
+
+
+def put_on_mesh(stacked: dict, mesh: Mesh, axis: str = "shards") -> dict:
+    sharding = NamedSharding(mesh, P(axis))
+    return {k: jax.device_put(v, sharding) for k, v in stacked.items()}
+
+
+def prepare_match_query(segments: list, field: str, terms: list[str]):
+    """Host-side prep: per-shard postings staged to COMMON bucketed shapes
+    + per-shard term ids + GLOBAL collection stats (idf/avgdl summed over
+    shards, so sharded scores match single-shard scores exactly — the
+    DFS_QUERY_THEN_FETCH global-stats guarantee, ref search/dfs/DfsPhase.java).
+
+    Returns (stacked dict [S, ...], meta dict with n_pad/budget/k-free dims).
+    """
+    from opensearch_tpu.index.segment import pad_pow2
+
+    n_pad = pad_pow2(max(s.n_docs for s in segments) + 1)
+    t_pad = pad_pow2(max(len(s.postings[field].offsets) for s in segments
+                         if field in s.postings))
+    p_pad = pad_pow2(max(len(s.postings[field].doc_ids) for s in segments
+                         if field in s.postings))
+    q_pad = pad_pow2(len(terms))
+
+    doc_count = sum(s.postings[field].docs_with_field
+                    for s in segments if field in s.postings)
+    total_len = sum(s.postings[field].total_len
+                    for s in segments if field in s.postings)
+    avgdl = total_len / doc_count if doc_count else 1.0
+    dfs = []
+    for t in terms:
+        df = 0
+        for s in segments:
+            pf = s.postings.get(field)
+            if pf is not None:
+                tid = pf.term_id(t)
+                if tid >= 0:
+                    df += int(pf.df[tid])
+        dfs.append(df)
+    idfs = np.zeros(q_pad, np.float32)
+    for i, df in enumerate(dfs):
+        idfs[i] = bm25_ops.idf(df, doc_count)
+
+    shards = []
+    budget = 8
+    for s in segments:
+        pf = s.postings.get(field)
+        sh = {
+            "offsets": np.zeros(t_pad, np.int32),
+            "doc_ids": np.full(p_pad, n_pad - 1, np.int32),
+            "tfs": np.zeros(p_pad, np.float32),
+            "doc_lens": np.ones(n_pad, np.float32),
+            "tids": np.zeros(q_pad, np.int32),
+            "active": np.zeros(q_pad, bool),
+            "idfs": idfs,
+            "weights": np.where(np.arange(q_pad) < len(terms), 1.0, 0.0
+                                ).astype(np.float32),
+            "avgdl": np.float32(avgdl),
+        }
+        if pf is not None:
+            sh["offsets"][: len(pf.offsets)] = pf.offsets
+            sh["offsets"][len(pf.offsets):] = pf.offsets[-1]
+            sh["doc_ids"][: len(pf.doc_ids)] = pf.doc_ids
+            sh["tfs"][: len(pf.tfs)] = pf.tfs
+            sh["doc_lens"][: len(pf.doc_lens)] = pf.doc_lens
+            local_budget = 0
+            for i, t in enumerate(terms):
+                tid = pf.term_id(t)
+                if tid >= 0:
+                    sh["tids"][i] = tid
+                    sh["active"][i] = True
+                    local_budget += int(pf.df[tid])
+            budget = max(budget, pad_pow2(local_budget))
+        shards.append(sh)
+    return stack_shards(shards), {"n_pad": n_pad, "budget": budget}
+
+
+def sharded_bm25_topk(mesh: Mesh, *, n_pad: int, budget: int, k: int,
+                      axis: str = "shards"):
+    """Build the jitted one-step distributed query: every device scores its
+    own shard's postings block and the global top-k is reduced with an
+    all-gather over the mesh axis.
+
+    Inputs (per call): shard-stacked arrays [S, ...] for offsets/doc_ids/
+    tfs/doc_lens/term_ids/active/idfs and scalars replicated [S] for
+    avgdl.  Returns (scores[k], global_doc_ids[k]) replicated on all
+    devices; global doc id = shard * n_pad + local id, so ties break by
+    (score desc, shard asc, local doc asc) — the coordinator merge order.
+    """
+
+    def local_step(offsets, doc_ids, tfs, doc_lens, tids, active, idfs,
+                   weights, avgdl):
+        # shard_map hands each device a [1, ...] block — drop the axis
+        scores, _count = bm25_ops.bm25_score_count(
+            offsets[0], doc_ids[0], tfs[0], doc_lens[0], tids[0], active[0],
+            idfs[0], weights[0], avgdl[0],
+            n_pad=n_pad, budget=budget, scored=True)
+        vals, idx = lax.top_k(scores, k)
+        shard = lax.axis_index(axis)
+        gids = shard.astype(jnp.int64) * n_pad + idx
+        all_vals = lax.all_gather(vals, axis)     # [S, k] on every device
+        all_gids = lax.all_gather(gids, axis)
+        fv, fi = lax.top_k(all_vals.reshape(-1), k)
+        return fv, all_gids.reshape(-1)[fi]
+
+    spec = P(axis)
+    # check_vma=False: the outputs ARE replicated (all_gather + identical
+    # re-top-k on every device) but the varying-mesh-axes checker cannot
+    # infer that statically.
+    fn = shard_map(local_step, mesh=mesh,
+                   in_specs=(spec,) * 9,
+                   out_specs=(P(), P()),
+                   check_vma=False)
+    return jax.jit(fn)

@@ -94,7 +94,10 @@ class ShardContext:
 
 def calc_min_should_match(optional: int, spec) -> int:
     """Lucene ``Queries.calculateMinShouldMatch`` subset: int, "-int",
-    "N%", "-N%" (conditional "N<P" specs unsupported)."""
+    "N%", "-N%" (conditional "N<P" specs unsupported).  Percentages
+    truncate toward zero (Java int cast).  May return a value LARGER than
+    ``optional`` — the caller must then match nothing (Lucene rewrites to
+    MatchNoDocsQuery)."""
     if spec is None:
         return 0
     s = str(spec).strip()
@@ -103,14 +106,12 @@ def calc_min_should_match(optional: int, spec) -> int:
             f"conditional minimum_should_match [{s}] is not supported")
     if s.endswith("%"):
         pct = int(s[:-1])
-        if pct < 0:
-            result = optional + int(math.floor(optional * pct / 100.0))
-        else:
-            result = int(math.floor(optional * pct / 100.0))
+        result = (optional + int(optional * pct / 100.0) if pct < 0
+                  else int(optional * pct / 100.0))
     else:
         n = int(s)
         result = n if n >= 0 else optional + n
-    return max(0, min(optional, result))
+    return max(0, result)
 
 
 def _idfs_for(ctx: ShardContext, field: str, terms: list[str]) -> np.ndarray:
@@ -246,6 +247,8 @@ def _c_match(q, ctx, scored):
     else:
         required = max(1, calc_min_should_match(len(terms),
                                                 q.minimum_should_match))
+    if required > len(terms):
+        return _none()
     return _term_bag(ctx, q.field, terms, required, q.boost, scored)
 
 
@@ -282,6 +285,9 @@ def _c_match_phrase(q, ctx, scored):
 
 
 def _c_multi_match(q, ctx, scored):
+    if q.type not in ("best_fields", "most_fields", "phrase"):
+        raise IllegalArgumentError(
+            f"multi_match type [{q.type}] is not supported")
     children, binds = [], []
     for field, fboost in q.fields:
         if ctx.field_type(field) is None:
@@ -323,6 +329,8 @@ def _c_bool(q, ctx, scored):
     n_should = len(groups["should"][0])
     if q.minimum_should_match is not None:
         required = calc_min_should_match(n_should, q.minimum_should_match)
+        if required > n_should:
+            return _none()   # Lucene rewrites to MatchNoDocsQuery
     else:
         required = 0 if (q.must or q.filter) else (1 if n_should else 0)
     plan = P.BoolPlan(must=groups["must"][0], should=groups["should"][0],
@@ -458,19 +466,25 @@ def _c_dis_max(q, ctx, scored):
              "children": tuple(binds)})
 
 
+_SQS_TOKEN = re.compile(r'([+-]?)"([^"]*)"|([+-]?)(\S+)')
+
+
 def _c_simple_query_string(q, ctx, scored):
     fields = q.fields
     if not fields or fields == [("*", 1.0)]:
         fields = [(f, 1.0) for f in ctx.text_fields()]
-    tokens = [t for t in re.split(r"\s+", q.query.strip()) if t]
     sub_queries = []
-    for tok in tokens:
-        negate = tok.startswith("-")
-        tok = tok.lstrip("+-").strip('"')
-        if not tok:
+    for m in _SQS_TOKEN.finditer(q.query.strip()):
+        if m.group(2) is not None:       # quoted -> phrase operator
+            sign, text, is_phrase = m.group(1), m.group(2), True
+        else:
+            sign, text, is_phrase = m.group(3), m.group(4), False
+            text = text.lstrip("+-")
+        if not text.strip():
             continue
-        mm = dsl.MultiMatchQuery(fields=fields, query=tok)
-        sub_queries.append((negate, mm))
+        mm = dsl.MultiMatchQuery(fields=fields, query=text,
+                                 type="phrase" if is_phrase else "best_fields")
+        sub_queries.append((sign == "-", mm))
     if not sub_queries:
         return P.MatchAllPlan(), {"boost": q.boost}
     must, must_not, should = [], [], []

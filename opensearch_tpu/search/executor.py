@@ -179,7 +179,11 @@ class ShardSearcher:
         min_score = body.get("min_score")
         source_spec = body.get("_source")
 
-        plan, bind = compile_query(q, self.ctx, scored=True)
+        # field-sorted queries that never reference _score skip BM25 scoring
+        needs_scores = (sort_specs is None
+                        or any(s["field"] == "_score" for s in sort_specs)
+                        or min_score is not None)
+        plan, bind = compile_query(q, self.ctx, scored=needs_scores)
         needed = plan.arrays()
         k_want = from_ + size
 

@@ -1,0 +1,87 @@
+"""Distributed scatter-gather search on the virtual 8-device CPU mesh:
+8-shard results must be identical to 1-shard results on the same corpus
+(VERDICT round-1 item 8's 'done' bar)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from opensearch_tpu.index.segment import SegmentWriter
+from opensearch_tpu.mapping.mapper import DocumentMapper
+from opensearch_tpu.parallel import dist_search
+from opensearch_tpu.search.executor import ShardSearcher
+
+MAPPING = {"properties": {"body": {"type": "text"}}}
+VOCAB = ("alpha bravo charlie delta echo foxtrot golf hotel india juliet "
+         "kilo lima").split()
+
+
+def build_sharded_corpus(n_shards=8, docs_per_shard=40, seed=3):
+    rng = np.random.default_rng(seed)
+    mapper = DocumentMapper(MAPPING)
+    writer = SegmentWriter()
+    segments = []
+    doc_no = 0
+    for si in range(n_shards):
+        parsed = []
+        for _ in range(docs_per_shard):
+            body = " ".join(rng.choice(VOCAB, size=rng.integers(4, 20)))
+            d = mapper.parse(str(doc_no), {"body": body})
+            d.seq_no = doc_no
+            parsed.append(d)
+            doc_no += 1
+        segments.append(writer.build(parsed, f"shard_{si}"))
+    return mapper, segments
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_sharded_topk_matches_single_shard():
+    mapper, segments = build_sharded_corpus()
+    terms = ["alpha", "echo"]
+    k = 10
+
+    mesh = dist_search.make_mesh(8)
+    stacked, meta = dist_search.prepare_match_query(segments, "body", terms)
+    on_mesh = dist_search.put_on_mesh(stacked, mesh)
+    step = dist_search.sharded_bm25_topk(mesh, n_pad=meta["n_pad"],
+                                         budget=meta["budget"], k=k)
+    vals, gids = step(on_mesh["offsets"], on_mesh["doc_ids"], on_mesh["tfs"],
+                      on_mesh["doc_lens"], on_mesh["tids"], on_mesh["active"],
+                      on_mesh["idfs"], on_mesh["weights"], on_mesh["avgdl"])
+    vals = np.asarray(vals)
+    gids = np.asarray(gids)
+
+    # reference: the same 8 segments searched as one shard (global stats
+    # are identical by construction)
+    searcher = ShardSearcher(segments, mapper)
+    resp = searcher.search({"query": {"match": {"body": "alpha echo"}},
+                            "size": k})
+    ref = resp["hits"]["hits"]
+
+    n_pad = meta["n_pad"]
+    got_ids = []
+    for gid in gids:
+        shard, local = divmod(int(gid), n_pad)
+        got_ids.append(segments[shard].doc_ids[local])
+    assert got_ids == [h["_id"] for h in ref]
+    np.testing.assert_allclose(vals, [h["_score"] for h in ref], rtol=1e-5)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_sharded_topk_term_missing_on_some_shards():
+    mapper, segments = build_sharded_corpus(docs_per_shard=12, seed=9)
+    mesh = dist_search.make_mesh(8)
+    stacked, meta = dist_search.prepare_match_query(segments, "body",
+                                                    ["juliet"])
+    on_mesh = dist_search.put_on_mesh(stacked, mesh)
+    step = dist_search.sharded_bm25_topk(mesh, n_pad=meta["n_pad"],
+                                         budget=meta["budget"], k=5)
+    vals, gids = step(on_mesh["offsets"], on_mesh["doc_ids"], on_mesh["tfs"],
+                      on_mesh["doc_lens"], on_mesh["tids"], on_mesh["active"],
+                      on_mesh["idfs"], on_mesh["weights"], on_mesh["avgdl"])
+    searcher = ShardSearcher(segments, mapper)
+    resp = searcher.search({"query": {"match": {"body": "juliet"}}, "size": 5})
+    exp_scores = [h["_score"] for h in resp["hits"]["hits"]]
+    got = [v for v in np.asarray(vals) if v > 0]
+    np.testing.assert_allclose(got, exp_scores, rtol=1e-5)

@@ -1,0 +1,206 @@
+"""Engine lifecycle: index→refresh→search→delete cycles, realtime GET,
+versioning/optimistic concurrency, translog crash recovery, flush/commit,
+force-merge (InternalEngine + Translog analogs, ref
+index/engine/InternalEngine.java:845, index/translog/Translog.java:541)."""
+
+import json
+import os
+
+import pytest
+
+from opensearch_tpu.common.errors import VersionConflictError
+from opensearch_tpu.index.engine import InternalEngine
+from opensearch_tpu.mapping.mapper import DocumentMapper
+
+MAPPING = {"properties": {
+    "title": {"type": "text"},
+    "n": {"type": "long"},
+    "tag": {"type": "keyword"},
+}}
+
+
+def new_engine(path, durability="request"):
+    return InternalEngine(str(path), DocumentMapper(MAPPING),
+                          index_name="idx", durability=durability)
+
+
+def search_ids(engine, query=None):
+    s = engine.acquire_searcher()
+    resp = s.search({"query": query or {"match_all": {}}, "size": 100})
+    return sorted(h["_id"] for h in resp["hits"]["hits"])
+
+
+def test_index_refresh_search_cycle(tmp_path):
+    eng = new_engine(tmp_path)
+    r = eng.index("1", {"title": "hello world", "n": 1})
+    assert (r.result, r.version, r.seq_no) == ("created", 1, 0)
+    # NRT semantics: invisible to search before refresh, visible to GET
+    assert search_ids(eng) == []
+    assert eng.get("1")["_source"]["title"] == "hello world"
+    assert eng.get("1", realtime=False) is None
+    eng.refresh()
+    assert search_ids(eng) == ["1"]
+    assert eng.get("1", realtime=False)["found"]
+    eng.close()
+
+
+def test_update_and_delete_cycle(tmp_path):
+    eng = new_engine(tmp_path)
+    eng.index("1", {"title": "old text", "n": 1})
+    eng.refresh()
+    r = eng.index("1", {"title": "new text", "n": 2})
+    assert (r.result, r.version) == ("updated", 2)
+    # pre-refresh: search still sees the old doc, GET sees the new one
+    assert search_ids(eng, {"match": {"title": "old"}}) == ["1"]
+    assert eng.get("1")["_source"]["title"] == "new text"
+    eng.refresh()
+    assert search_ids(eng, {"match": {"title": "old"}}) == []
+    assert search_ids(eng, {"match": {"title": "new"}}) == ["1"]
+
+    r = eng.delete("1")
+    assert (r.result, r.version) == ("deleted", 3)
+    assert eng.get("1") is None
+    assert search_ids(eng) == ["1"]     # unrefreshed delete still visible
+    eng.refresh()
+    assert search_ids(eng) == []
+    assert eng.delete("1").result == "not_found"
+    assert eng.doc_count() == 0
+    eng.close()
+
+
+def test_versioning_conflicts(tmp_path):
+    eng = new_engine(tmp_path)
+    r = eng.index("1", {"n": 1})
+    with pytest.raises(VersionConflictError):
+        eng.index("1", {"n": 2}, if_seq_no=99, if_primary_term=1)
+    r2 = eng.index("1", {"n": 2}, if_seq_no=r.seq_no, if_primary_term=1)
+    assert r2.version == 2
+    with pytest.raises(VersionConflictError):
+        eng.index("1", {"n": 3}, version=1)       # internal: must match current
+    # external versioning: must strictly increase
+    eng.index("2", {"n": 1}, version=10, version_type="external")
+    with pytest.raises(VersionConflictError):
+        eng.index("2", {"n": 2}, version=10, version_type="external")
+    r3 = eng.index("2", {"n": 2}, version=20, version_type="external")
+    assert r3.version == 20
+    with pytest.raises(VersionConflictError):
+        eng.delete("2", if_seq_no=0, if_primary_term=1)
+    eng.close()
+
+
+def test_kill9_recovery_from_translog(tmp_path):
+    eng = new_engine(tmp_path)
+    for i in range(20):
+        eng.index(str(i), {"title": f"doc number {i}", "n": i})
+    eng.delete("5")
+    eng.index("7", {"title": "updated doc", "n": 700})
+    eng.ensure_synced()
+    # kill -9: drop the engine without close/flush
+    del eng
+
+    eng2 = new_engine(tmp_path)
+    assert eng2.doc_count() == 19
+    assert eng2.get("5") is None
+    assert eng2.get("7")["_source"]["n"] == 700
+    assert eng2.get("7")["_version"] == 2
+    assert eng2.max_seq_no == 21
+    eng2.refresh()
+    assert len(search_ids(eng2)) == 19
+    # new writes continue from the recovered seq_no
+    r = eng2.index("new", {"n": 1})
+    assert r.seq_no == 22
+    eng2.close()
+
+
+def test_torn_translog_tail_discarded(tmp_path):
+    eng = new_engine(tmp_path)
+    eng.index("1", {"n": 1})
+    eng.index("2", {"n": 2})
+    eng.ensure_synced()
+    gen = eng.translog.generation
+    del eng
+    # simulate a torn final write (kill -9 mid-append)
+    log = tmp_path / "translog" / f"translog-{gen}.log"
+    with open(log, "ab") as f:
+        f.write(b'deadbeef{"op":"index","id":"3"')   # no newline, bad crc
+    eng2 = new_engine(tmp_path)
+    assert eng2.doc_count() == 2
+    assert eng2.get("3") is None
+    eng2.close()
+
+
+def test_flush_commit_and_reopen(tmp_path):
+    eng = new_engine(tmp_path)
+    for i in range(10):
+        eng.index(str(i), {"title": "flushed doc", "n": i})
+    commit = eng.flush()
+    assert commit["max_seq_no"] == 9
+    assert len(commit["segments"]) == 1
+    # translog trimmed: no ops to replay
+    assert eng.translog.ops_count() == 0
+    eng.index("10", {"title": "post flush", "n": 10})
+    eng.ensure_synced()
+    del eng
+
+    eng2 = new_engine(tmp_path)
+    assert eng2.doc_count() == 11            # 10 from segments + 1 replayed
+    eng2.refresh()
+    assert len(search_ids(eng2)) == 11
+    eng2.close()
+
+
+def test_delete_survives_flush_cycle(tmp_path):
+    eng = new_engine(tmp_path)
+    eng.index("a", {"n": 1})
+    eng.index("b", {"n": 2})
+    eng.flush()
+    eng.delete("a")
+    eng.flush()                               # persists the live bitmap
+    del eng
+    eng2 = new_engine(tmp_path)
+    assert eng2.doc_count() == 1
+    assert eng2.get("a") is None
+    assert eng2.get("b")["found"]
+    eng2.close()
+
+
+def test_force_merge(tmp_path):
+    eng = new_engine(tmp_path)
+    for i in range(30):
+        eng.index(str(i), {"title": f"merge doc {i}", "n": i, "tag": "t"})
+        if i % 10 == 9:
+            eng.refresh()
+    eng.delete("3")
+    eng.refresh()
+    assert len(eng.segments) == 3
+    before = search_ids(eng, {"term": {"tag": "t"}})
+    n = eng.force_merge(1)
+    assert n == 1
+    after = search_ids(eng, {"term": {"tag": "t"}})
+    assert before == after
+    assert eng.doc_count() == 29
+    eng.close()
+
+
+def test_merge_cleans_persisted_files(tmp_path):
+    eng = new_engine(tmp_path)
+    for i in range(10):
+        eng.index(str(i), {"n": i})
+        if i % 5 == 4:
+            eng.flush()
+    assert len(os.listdir(tmp_path / "segments")) > 3
+    eng.force_merge(1)
+    eng.flush()
+    del eng
+    eng2 = new_engine(tmp_path)
+    assert eng2.doc_count() == 10
+    eng2.close()
+
+
+def test_sequence_numbers_monotonic(tmp_path):
+    eng = new_engine(tmp_path)
+    seqs = [eng.index(str(i), {"n": i}).seq_no for i in range(5)]
+    seqs.append(eng.delete("0").seq_no)
+    assert seqs == list(range(6))
+    assert eng.stats()["seq_no"]["max_seq_no"] == 5
+    eng.close()
