@@ -76,6 +76,9 @@ class InternalEngine:
         self._seq_no = -1
         self._persisted_segments: set[str] = set()
         self._live_dirty: set[str] = set()
+        # files superseded by a merge: deleted only AFTER the next commit
+        # point lands (Lucene keeps old files until commit)
+        self._obsolete_files: set[str] = set()
         self._seg_counter = 0
         self._searcher: Optional[ShardSearcher] = None
         self._writer = SegmentWriter()
@@ -97,12 +100,22 @@ class InternalEngine:
                 commit = json.load(f)
             committed_seq = commit["max_seq_no"]
             self._seg_counter = commit.get("seg_counter", 0)
+            seg_dir = os.path.join(self.data_path, "segments")
             for seg_id in commit["segments"]:
-                seg = load_segment(os.path.join(self.data_path, "segments"),
-                                   seg_id)
+                seg = load_segment(seg_dir, seg_id)
                 self.segments.append(seg)
                 self._persisted_segments.add(seg_id)
             self._seq_no = committed_seq
+            # GC segment files the commit doesn't reference (a crash
+            # between commit write and obsolete-file deletion leaks them)
+            if os.path.isdir(seg_dir):
+                referenced = set(commit["segments"])
+                for fname in os.listdir(seg_dir):
+                    seg_id = fname.rsplit(".", 1)[0]
+                    if seg_id.endswith(".src"):
+                        seg_id = seg_id[:-4]
+                    if seg_id not in referenced:
+                        os.remove(os.path.join(seg_dir, fname))
         for op in self.translog.read_ops(committed_seq):
             self._replay(op)
 
@@ -309,8 +322,11 @@ class InternalEngine:
         docs in the new segment (0 if none was created)."""
         with self._lock:
             self._ensure_open()
+            by_seg: dict[int, tuple[Segment, list[int]]] = {}
             for seg, local in self._pending_deletes:
-                seg.delete_local(local)
+                by_seg.setdefault(id(seg), (seg, []))[1].append(local)
+            for seg, locals_ in by_seg.values():
+                seg.apply_deletes(locals_)     # copy-on-write live bitmap
                 self._live_dirty.add(seg.seg_id)
             self._pending_deletes.clear()
             hot_docs = [d for d in self._hot if d is not None]
@@ -365,6 +381,11 @@ class InternalEngine:
                 os.fsync(f.fileno())
             os.replace(tmp, os.path.join(self.data_path, self.COMMIT_FILE))
             self.translog.trim(self.translog.generation)
+            # the new commit no longer references merged-away segments —
+            # their files are safe to delete now
+            for seg_id in self._obsolete_files:
+                delete_segment_files(seg_dir, seg_id)
+            self._obsolete_files.clear()
             return commit
 
     def force_merge(self, max_num_segments: int = 1) -> int:
@@ -395,10 +416,11 @@ class InternalEngine:
                     self.segments.append(self._writer.build(
                         live_docs[i: i + per], seg_id,
                         vector_meta=self._vector_meta()))
-            seg_dir = os.path.join(self.data_path, "segments")
             for seg in old:
                 if seg.seg_id in self._persisted_segments:
-                    delete_segment_files(seg_dir, seg.seg_id)
+                    # defer file deletion until the next commit point no
+                    # longer references them (crash-safe)
+                    self._obsolete_files.add(seg.seg_id)
                     self._persisted_segments.discard(seg.seg_id)
                 self._live_dirty.discard(seg.seg_id)
             self._searcher = None

@@ -137,7 +137,6 @@ class Segment:
         self.geo_dv: dict[str, GeoDV] = {}
         self.live = np.ones(n_docs, dtype=bool)
         self._device: Optional["DeviceSegment"] = None
-        self._live_dirty = True
 
     # -- stats used for cross-segment collection statistics ---------------
 
@@ -145,8 +144,14 @@ class Segment:
         return int(self.live.sum())
 
     def delete_local(self, local_id: int):
-        self.live[local_id] = False
-        self._live_dirty = True
+        self.apply_deletes([local_id])
+
+    def apply_deletes(self, local_ids):
+        """Copy-on-write: searchers that snapshotted the previous ``live``
+        array keep their point-in-time view (Lucene reader semantics)."""
+        live = self.live.copy()
+        live[np.asarray(local_ids, dtype=np.int64)] = False
+        self.live = live
 
     def source(self, local_id: int) -> dict:
         return json.loads(self.sources[local_id])
@@ -154,9 +159,6 @@ class Segment:
     def device(self) -> "DeviceSegment":
         if self._device is None:
             self._device = DeviceSegment(self)
-        if self._live_dirty:
-            self._device.refresh_live(self.live)
-            self._live_dirty = False
         return self._device
 
 
@@ -243,15 +245,25 @@ class DeviceSegment:
                 "value_docs": jnp.asarray(pad1(dv.value_docs, v_pad, self.n_docs)),
                 "exists": jnp.asarray(pad1(dv.exists, n_pad, False)),
             }
-        self.live = None
-        self.refresh_live(seg.live)
+        self._live_cache: dict[int, object] = {}
+        self.live = self.live_jnp(seg.live)
 
-    def refresh_live(self, live: np.ndarray):
+    def live_jnp(self, live_np: np.ndarray):
+        """Staged live mask for a SNAPSHOT of the live bitmap (keyed by
+        array identity — apply_deletes replaces the array, so old
+        snapshots keep resolving to their own staged copy)."""
         import jax.numpy as jnp
 
-        padded = np.zeros(self.n_pad, dtype=bool)
-        padded[: len(live)] = live
-        self.live = jnp.asarray(padded)
+        key = id(live_np)
+        cached = self._live_cache.get(key)
+        if cached is None:
+            padded = np.zeros(self.n_pad, dtype=bool)
+            padded[: len(live_np)] = live_np
+            cached = jnp.asarray(padded)
+            if len(self._live_cache) >= 4:
+                self._live_cache.pop(next(iter(self._live_cache)))
+            self._live_cache[key] = cached
+        return cached
 
 
 class SegmentWriter:
@@ -412,7 +424,9 @@ class SegmentWriter:
         max_ord = np.full(n_docs, -1, dtype=np.int32)
         exists = np.zeros(n_docs, dtype=bool)
         for i, vals in enumerate(per_doc):
-            o = sorted(term_to_ord[t] for t in vals)
+            # SortedSetDocValues semantics: per-doc ordinals are DEDUPED
+            # (unlike SortedNumeric, which keeps duplicate values)
+            o = sorted({term_to_ord[t] for t in vals})
             ords.extend(o)
             value_docs.extend([i] * len(o))
             offsets[i + 1] = len(ords)

@@ -45,8 +45,44 @@ class Translog:
         else:
             self.generation = ckp["generation"]
             self.min_generation = ckp["min_generation"]
+        # a torn tail (kill -9 mid-append) must be truncated BEFORE we
+        # append again, or the next op would merge with the garbage bytes
+        # into one bad-CRC line and a later recovery would drop it
+        self._truncate_torn_tail(self._gen_path(self.generation))
         self._file = open(self._gen_path(self.generation), "ab")
         self._ops_since_sync = 0
+
+    @staticmethod
+    def _truncate_torn_tail(path: str):
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            data = f.read()
+        good_end = 0
+        pos = 0
+        while pos < len(data):
+            nl = data.find(b"\n", pos)
+            if nl < 0:
+                break                    # unterminated tail
+            line = data[pos:nl]
+            if len(line) >= 8:
+                try:
+                    expected = int(line[:8], 16)
+                except ValueError:
+                    break
+                if (zlib.crc32(line[8:]) & 0xFFFFFFFF) != expected:
+                    break
+                good_end = nl + 1
+            elif line:
+                break
+            else:
+                good_end = nl + 1        # blank line, keep walking
+            pos = nl + 1
+        if good_end < len(data):
+            with open(path, "r+b") as f:
+                f.truncate(good_end)
+                f.flush()
+                os.fsync(f.fileno())
 
     # -- paths / checkpoint ----------------------------------------------
 

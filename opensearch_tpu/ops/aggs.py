@@ -1,0 +1,95 @@
+"""Aggregation kernels: bucket counting and metrics as scatter-adds over
+doc-value columns.
+
+Analog of the reference's per-shard collect phase
+(search/aggregations/BucketCollector.java:46 driving LeafBucketCollector
+doc-at-a-time).  Here a bucket agg is one vectorized pass over a column's
+expanded (value, owning-doc) arrays: bucket keys resolve via searchsorted
+or direct ordinals, consecutive duplicate (doc, bucket) pairs are masked
+out (docs count once per bucket, Lucene's sorted-values dedup), and counts
+are a scatter-add.  Metric sub-aggs ride the same pass: per-doc partial
+sums scatter into buckets through the bucket entries.
+"""
+
+from __future__ import annotations
+
+import opensearch_tpu.common.jaxenv  # noqa: F401
+
+import jax.numpy as jnp
+
+
+def _first_occurrence(docs, buckets):
+    """Mask of entries that are the first (doc, bucket) occurrence in the
+    (sorted-per-doc) expanded arrays."""
+    prev_same = jnp.concatenate([
+        jnp.zeros(1, bool),
+        (docs[1:] == docs[:-1]) & (buckets[1:] == buckets[:-1])])
+    return ~prev_same
+
+
+def ordinal_counts(ords, value_docs, matched, *, n_buckets_pad: int):
+    """Per-ordinal doc counts over matched docs (terms agg on a keyword
+    column; ordinals pre-deduped per doc at segment build)."""
+    ok = matched[value_docs] & (ords >= 0)
+    tgt = jnp.where(ok, ords, n_buckets_pad - 1)
+    return jnp.zeros(n_buckets_pad, jnp.int64).at[tgt].add(
+        ok.astype(jnp.int64))
+
+
+def bucketed_counts(values, value_docs, matched, edges, *,
+                    n_buckets_pad: int):
+    """Histogram doc counts: bucket b covers [edges[b], edges[b+1]).
+    ``edges`` must be ascending; values outside [edges[0], edges[-1]) are
+    dropped.  Docs count once per bucket even with several values in it."""
+    b = jnp.searchsorted(edges, values, side="right").astype(jnp.int32) - 1
+    ok = (matched[value_docs] & (b >= 0) & (b < edges.shape[0] - 1))
+    ok &= _first_occurrence(value_docs, b)
+    tgt = jnp.where(ok, b, n_buckets_pad - 1)
+    return jnp.zeros(n_buckets_pad, jnp.int64).at[tgt].add(
+        ok.astype(jnp.int64))
+
+
+def masked_metrics(values, value_docs, matched):
+    """(sum, value_count, min, max) over every value of matched docs
+    (SortedNumeric keeps duplicates — they all count)."""
+    ok = matched[value_docs]
+    fvals = values.astype(jnp.float64)
+    s = jnp.where(ok, fvals, 0.0).sum()
+    c = ok.sum()
+    mn = jnp.where(ok, fvals, jnp.inf).min()
+    mx = jnp.where(ok, fvals, -jnp.inf).max()
+    return s, c, mn, mx
+
+
+def per_doc_partials(values, value_docs, matched, *, n_pad: int):
+    """Per-doc (sum, count, min, max) of a numeric column — the building
+    block for metric sub-aggregations under bucket aggs."""
+    ok = matched[value_docs]
+    fvals = values.astype(jnp.float64)
+    tgt = jnp.where(ok, value_docs, n_pad - 1)
+    zero = jnp.zeros(n_pad, jnp.float64)
+    s = zero.at[tgt].add(jnp.where(ok, fvals, 0.0))
+    c = jnp.zeros(n_pad, jnp.int64).at[tgt].add(ok.astype(jnp.int64))
+    mn = jnp.full(n_pad, jnp.inf).at[tgt].min(jnp.where(ok, fvals, jnp.inf))
+    mx = jnp.full(n_pad, -jnp.inf).at[tgt].max(
+        jnp.where(ok, fvals, -jnp.inf))
+    return s, c, mn, mx
+
+
+def scatter_partials_to_buckets(bucket_entries_docs, bucket_entries_b,
+                                entry_ok, per_doc, *, n_buckets_pad: int):
+    """Second-level scatter: per-doc metric partials -> per-bucket partials
+    through the bucket-entry (doc, bucket) pairs (docs in several buckets
+    contribute to each)."""
+    s_doc, c_doc, mn_doc, mx_doc = per_doc
+    tgt = jnp.where(entry_ok, bucket_entries_b, n_buckets_pad - 1)
+    d = bucket_entries_docs
+    s = jnp.zeros(n_buckets_pad, jnp.float64).at[tgt].add(
+        jnp.where(entry_ok, s_doc[d], 0.0))
+    c = jnp.zeros(n_buckets_pad, jnp.int64).at[tgt].add(
+        jnp.where(entry_ok, c_doc[d], 0))
+    mn = jnp.full(n_buckets_pad, jnp.inf).at[tgt].min(
+        jnp.where(entry_ok, mn_doc[d], jnp.inf))
+    mx = jnp.full(n_buckets_pad, -jnp.inf).at[tgt].max(
+        jnp.where(entry_ok, mx_doc[d], -jnp.inf))
+    return s, c, mn, mx

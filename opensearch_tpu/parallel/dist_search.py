@@ -62,10 +62,10 @@ def prepare_match_query(segments: list, field: str, terms: list[str]):
     from opensearch_tpu.index.segment import pad_pow2
 
     n_pad = pad_pow2(max(s.n_docs for s in segments) + 1)
-    t_pad = pad_pow2(max(len(s.postings[field].offsets) for s in segments
-                         if field in s.postings))
-    p_pad = pad_pow2(max(len(s.postings[field].doc_ids) for s in segments
-                         if field in s.postings))
+    t_pad = pad_pow2(max((len(s.postings[field].offsets) for s in segments
+                          if field in s.postings), default=8))
+    p_pad = pad_pow2(max((len(s.postings[field].doc_ids) for s in segments
+                          if field in s.postings), default=8))
     q_pad = pad_pow2(len(terms))
 
     doc_count = sum(s.postings[field].docs_with_field

@@ -1,0 +1,679 @@
+"""Aggregations: request parsing, per-segment collection, cross-segment
+reduce, response formatting.
+
+Analog of the reference's two-phase model (per-shard collect via
+``BucketCollector`` -> coordinator ``InternalAggregations.reduce``; ref
+search/aggregations/BucketCollector.java:46,
+bucket/histogram/DateHistogramAggregator.java,
+bucket/terms/GlobalOrdinalsStringTermsAggregator.java).  Collection is
+array-oriented: bucket counts and metric partials are scatter-adds over
+doc-value columns (ops/aggs.py); the reduce merges per-segment partials on
+host exactly like the coordinator reduce merges per-shard ones — so the
+same code path later serves the cross-shard merge.
+
+Composition model: every bucket agg that selects a doc subset (filter,
+filters, range, missing, global) recurses with a narrowed matched mask, so
+arbitrary nesting works; terms/histogram support metric sub-aggs computed
+in the same pass via two-level scatters.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import re
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+import opensearch_tpu.common.jaxenv  # noqa: F401
+import jax.numpy as jnp
+
+from opensearch_tpu.common.errors import IllegalArgumentError, ParsingError
+from opensearch_tpu.index.segment import pad_pow2
+from opensearch_tpu.mapping.types import format_date_millis, parse_date_millis
+from opensearch_tpu.ops import aggs as agg_ops
+
+MAX_BUCKETS = 65536          # search.max_buckets default
+_METRIC_TYPES = {"min", "max", "sum", "avg", "value_count", "stats",
+                 "cardinality", "percentiles"}
+_BUCKET_TYPES = {"terms", "histogram", "date_histogram", "range",
+                 "date_range", "filter", "filters", "global", "missing"}
+
+
+@dataclass
+class AggRequest:
+    name: str
+    type: str
+    params: dict
+    subs: list = dc_field(default_factory=list)
+
+
+def parse_aggs(aggs_json: dict) -> list[AggRequest]:
+    out = []
+    for name, body in (aggs_json or {}).items():
+        subs_json = body.get("aggs") or body.get("aggregations") or {}
+        types = [k for k in body if k not in ("aggs", "aggregations", "meta")]
+        if len(types) != 1:
+            raise ParsingError(
+                f"aggregation [{name}] must have exactly one type, got {types}")
+        typ = types[0]
+        if typ not in _METRIC_TYPES | _BUCKET_TYPES:
+            raise ParsingError(f"unknown aggregation type [{typ}]")
+        subs = parse_aggs(subs_json)
+        if typ in _METRIC_TYPES and subs:
+            raise ParsingError(
+                f"metric aggregation [{name}] cannot have sub-aggregations")
+        out.append(AggRequest(name, typ, body[typ], subs))
+    return out
+
+
+_DURATION = re.compile(r"^(\d+)(ms|s|m|h|d)$")
+_DUR_MS = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000, "d": 86_400_000}
+_CAL_FIXED_MS = {"second": 1000, "1s": 1000, "minute": 60_000, "1m": 60_000,
+                 "hour": 3_600_000, "1h": 3_600_000, "day": 86_400_000,
+                 "1d": 86_400_000, "week": 7 * 86_400_000, "1w": 7 * 86_400_000}
+
+
+def _parse_duration_ms(s: str) -> int:
+    m = _DURATION.match(str(s))
+    if not m:
+        raise IllegalArgumentError(f"failed to parse interval [{s}]")
+    return int(m.group(1)) * _DUR_MS[m.group(2)]
+
+
+def _floor_month(dt: _dt.datetime, months: int) -> _dt.datetime:
+    total = dt.year * 12 + (dt.month - 1)
+    total = (total // months) * months
+    return _dt.datetime(total // 12, total % 12 + 1, 1, tzinfo=_dt.timezone.utc)
+
+
+def _add_months(dt: _dt.datetime, months: int) -> _dt.datetime:
+    total = dt.year * 12 + (dt.month - 1) + months
+    return _dt.datetime(total // 12, total % 12 + 1, 1, tzinfo=_dt.timezone.utc)
+
+
+def build_date_edges(lo: int, hi: int, calendar=None, fixed=None,
+                     offset: int = 0) -> np.ndarray:
+    """Ascending bucket edges (epoch millis) covering [lo, hi], aligned to
+    the interval (Rounding.java analog, UTC only)."""
+    if calendar in ("month", "1M", "quarter", "1q", "year", "1y"):
+        months = {"month": 1, "1M": 1, "quarter": 3, "1q": 3,
+                  "year": 12, "1y": 12}[calendar]
+        start = _floor_month(
+            _dt.datetime.fromtimestamp(lo / 1000, tz=_dt.timezone.utc), months)
+        edges = [start]
+        while edges[-1].timestamp() * 1000 <= hi:
+            edges.append(_add_months(edges[-1], months))
+        arr = np.asarray([int(e.timestamp() * 1000) for e in edges],
+                         dtype=np.int64)
+    else:
+        if calendar is not None:
+            ms = _CAL_FIXED_MS.get(calendar)
+            if ms is None:
+                raise IllegalArgumentError(
+                    f"unknown calendar_interval [{calendar}]")
+        else:
+            ms = _parse_duration_ms(fixed)
+        if calendar in ("week", "1w"):
+            offset = (offset + 4 * 86_400_000) % ms   # epoch was a Thursday
+        first = (lo - offset) // ms * ms + offset
+        if first > lo:
+            first -= ms
+        n = (hi - first) // ms + 2
+        if n > MAX_BUCKETS:
+            raise IllegalArgumentError(
+                f"trying to create too many buckets ({n} > {MAX_BUCKETS})")
+        arr = first + ms * np.arange(n, dtype=np.int64)
+    if len(arr) - 1 > MAX_BUCKETS:
+        raise IllegalArgumentError(
+            f"trying to create too many buckets ({len(arr) - 1} > {MAX_BUCKETS})")
+    return arr
+
+
+def _fmt_date(millis: int, fmt: str | None) -> str:
+    if not fmt:
+        return format_date_millis(int(millis))
+    py = (fmt.replace("yyyy", "%Y").replace("MM", "%m").replace("dd", "%d")
+          .replace("HH", "%H").replace("mm", "%M").replace("ss", "%S"))
+    dt = _dt.datetime.fromtimestamp(millis / 1000, tz=_dt.timezone.utc)
+    return dt.strftime(py)
+
+
+class AggregationExecutor:
+    """Runs an agg tree over per-segment matched masks.
+
+    ``seg_views`` is [(seg, dseg, matched_jnp)] — the query phase's
+    matched masks, one per segment.
+    """
+
+    def __init__(self, ctx):
+        self.ctx = ctx               # compiler.ShardContext
+
+    def run(self, aggs_json: dict, seg_views: list) -> dict:
+        reqs = parse_aggs(aggs_json)
+        return {r.name: self._run_one(r, seg_views) for r in reqs}
+
+    # -- helpers ----------------------------------------------------------
+
+    def _field_type(self, req, caller):
+        field = req.params.get("field")
+        if field is None:
+            raise ParsingError(f"[{caller}] aggregation requires a [field]")
+        return field, self.ctx.field_type(field)
+
+    def _numeric_column(self, seg, field):
+        return seg.numeric_dv.get(field)
+
+    def _dev_numeric(self, dseg, field):
+        return dseg.numeric.get(field)
+
+    # -- dispatch ---------------------------------------------------------
+
+    def _run_one(self, req, seg_views):
+        fn = getattr(self, f"_agg_{req.type}", None)
+        if fn is None:
+            raise ParsingError(f"unknown aggregation type [{req.type}]")
+        return fn(req, seg_views)
+
+    # -- metrics ----------------------------------------------------------
+
+    def _collect_metric_partials(self, field, seg_views):
+        s = 0.0
+        c = 0
+        mn, mx = np.inf, -np.inf
+        for seg, dseg, matched in seg_views:
+            col = self._dev_numeric(dseg, field)
+            if col is None:
+                continue
+            ss, cc, mnn, mxx = agg_ops.masked_metrics(
+                col["values"], col["value_docs"], matched)
+            s += float(ss)
+            c += int(cc)
+            mn = min(mn, float(mnn))
+            mx = max(mx, float(mxx))
+        return s, c, mn, mx
+
+    def _agg_min(self, req, seg_views):
+        field, _ = self._field_type(req, "min")
+        s, c, mn, mx = self._collect_metric_partials(field, seg_views)
+        return {"value": mn if c else None}
+
+    def _agg_max(self, req, seg_views):
+        field, _ = self._field_type(req, "max")
+        s, c, mn, mx = self._collect_metric_partials(field, seg_views)
+        return {"value": mx if c else None}
+
+    def _agg_sum(self, req, seg_views):
+        field, _ = self._field_type(req, "sum")
+        s, c, mn, mx = self._collect_metric_partials(field, seg_views)
+        return {"value": s}
+
+    def _agg_avg(self, req, seg_views):
+        field, _ = self._field_type(req, "avg")
+        s, c, mn, mx = self._collect_metric_partials(field, seg_views)
+        return {"value": (s / c) if c else None}
+
+    def _agg_value_count(self, req, seg_views):
+        field, ft = self._field_type(req, "value_count")
+        if ft is not None and ft.dv_kind == "ordinal":
+            total = 0
+            for seg, dseg, matched in seg_views:
+                col = dseg.ordinal.get(field)
+                if col is None:
+                    continue
+                ok = matched[col["value_docs"]] & (col["ords"] >= 0)
+                total += int(ok.sum())
+            return {"value": total}
+        s, c, mn, mx = self._collect_metric_partials(field, seg_views)
+        return {"value": c}
+
+    def _agg_stats(self, req, seg_views):
+        field, _ = self._field_type(req, "stats")
+        s, c, mn, mx = self._collect_metric_partials(field, seg_views)
+        return {"count": c, "min": mn if c else None, "max": mx if c else None,
+                "avg": (s / c) if c else None, "sum": s}
+
+    def _agg_cardinality(self, req, seg_views):
+        """Exact distinct count (the reference's HLL++ is approximate; we
+        can afford exact via per-segment term/value sets)."""
+        field, ft = self._field_type(req, "cardinality")
+        distinct = set()
+        for seg, dseg, matched in seg_views:
+            m = np.asarray(matched)
+            if ft is not None and ft.dv_kind == "ordinal":
+                dv = seg.ordinal_dv.get(field)
+                if dv is None:
+                    continue
+                ok = m[dv.value_docs] if len(dv.value_docs) else np.zeros(0, bool)
+                for o in np.unique(dv.ords[ok]):
+                    distinct.add(dv.ord_terms[o])
+            else:
+                dv = seg.numeric_dv.get(field)
+                if dv is None:
+                    continue
+                ok = m[dv.value_docs] if len(dv.value_docs) else np.zeros(0, bool)
+                distinct.update(np.unique(dv.values[ok]).tolist())
+        return {"value": len(distinct)}
+
+    def _agg_percentiles(self, req, seg_views):
+        field, _ = self._field_type(req, "percentiles")
+        percents = req.params.get("percents",
+                                  [1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0])
+        chunks = []
+        for seg, dseg, matched in seg_views:
+            dv = seg.numeric_dv.get(field)
+            if dv is None or not len(dv.value_docs):
+                continue
+            ok = np.asarray(matched)[dv.value_docs]
+            chunks.append(dv.values[ok].astype(np.float64))
+        if not chunks:
+            return {"values": {f"{p}": None for p in percents}}
+        allv = np.concatenate(chunks)
+        return {"values": {f"{float(p)}": float(np.percentile(allv, p))
+                           for p in percents}}
+
+    # -- terms ------------------------------------------------------------
+
+    def _agg_terms(self, req, seg_views):
+        field, ft = self._field_type(req, "terms")
+        size = int(req.params.get("size", 10))
+        min_doc_count = int(req.params.get("min_doc_count", 1))
+        order = req.params.get("order", {"_count": "desc"})
+        if ft is None:
+            return {"doc_count_error_upper_bound": 0, "sum_other_doc_count": 0,
+                    "buckets": []}
+        if ft.dv_kind == "ordinal":
+            merged, sub_parts = self._terms_ordinal(field, seg_views, req.subs)
+        else:
+            merged, sub_parts = self._terms_numeric(field, seg_views, req.subs)
+
+        items = [(k, c) for k, c in merged.items() if c >= min_doc_count]
+        items.sort(key=self._terms_order_key(order))
+        total_in_buckets = sum(c for _k, c in items)
+        items = items[:size]
+        buckets = []
+        for key, count in items:
+            b = {"key": self._term_key(key, ft), "doc_count": int(count)}
+            kas = self._term_key_as_string(key, ft)
+            if kas is not None:
+                b["key_as_string"] = kas
+            for sub in req.subs:
+                b[sub.name] = self._finish_sub_metric(sub, sub_parts.get(
+                    (sub.name, key), (0.0, 0, np.inf, -np.inf)))
+            buckets.append(b)
+        return {"doc_count_error_upper_bound": 0,
+                "sum_other_doc_count": int(total_in_buckets
+                                           - sum(b["doc_count"] for b in buckets)),
+                "buckets": buckets}
+
+    @staticmethod
+    def _terms_order_key(order):
+        if isinstance(order, list):
+            order = order[0] if order else {"_count": "desc"}
+        ((what, direction),) = order.items()
+        desc = str(direction).lower() == "desc"
+        if what == "_count":
+            return lambda kv: ((-kv[1] if desc else kv[1]), kv[0])
+        if what in ("_key", "_term"):
+            # python can't negate strings: rely on sort stability via reverse
+            import functools
+
+            def cmp(a, b):
+                if a[0] == b[0]:
+                    return 0
+                lt = a[0] < b[0]
+                if desc:
+                    lt = not lt
+                return -1 if lt else 1
+            return functools.cmp_to_key(cmp)
+        raise IllegalArgumentError(f"terms order [{what}] is not supported")
+
+    @staticmethod
+    def _term_key(key, ft):
+        if ft.type_name == "boolean":
+            return int(key)
+        if ft.dv_kind == "long":
+            return int(key)
+        if ft.dv_kind == "double":
+            return float(key)
+        return key
+
+    @staticmethod
+    def _term_key_as_string(key, ft):
+        if ft.type_name == "boolean":
+            return "true" if key else "false"
+        if ft.type_name == "date":
+            return format_date_millis(int(key))
+        return None
+
+    def _terms_ordinal(self, field, seg_views, subs):
+        merged: dict = {}
+        sub_parts: dict = {}
+        for seg, dseg, matched in seg_views:
+            dv = seg.ordinal_dv.get(field)
+            col = dseg.ordinal.get(field)
+            if dv is None or col is None:
+                continue
+            n_pad_b = pad_pow2(len(dv.ord_terms) + 1)
+            counts = np.asarray(agg_ops.ordinal_counts(
+                col["ords"], col["value_docs"], matched,
+                n_buckets_pad=n_pad_b))
+            nz = np.nonzero(counts[: len(dv.ord_terms)])[0]
+            for o in nz:
+                term = dv.ord_terms[o]
+                merged[term] = merged.get(term, 0) + int(counts[o])
+            for sub in subs:
+                sf, sft = self._field_type(sub, sub.type)
+                scol = self._dev_numeric(dseg, sf)
+                if scol is None:
+                    continue
+                entry_ok = matched[col["value_docs"]] & (col["ords"] >= 0)
+                per_doc = agg_ops.per_doc_partials(
+                    scol["values"], scol["value_docs"], matched,
+                    n_pad=dseg.n_pad)
+                s, c, mn, mx = agg_ops.scatter_partials_to_buckets(
+                    col["value_docs"], col["ords"], entry_ok, per_doc,
+                    n_buckets_pad=n_pad_b)
+                s, c = np.asarray(s), np.asarray(c)
+                mn, mx = np.asarray(mn), np.asarray(mx)
+                for o in nz:
+                    term = dv.ord_terms[o]
+                    key = (sub.name, term)
+                    ps, pc, pmn, pmx = sub_parts.get(key,
+                                                     (0.0, 0, np.inf, -np.inf))
+                    sub_parts[key] = (ps + float(s[o]), pc + int(c[o]),
+                                      min(pmn, float(mn[o])),
+                                      max(pmx, float(mx[o])))
+        return merged, sub_parts
+
+    def _terms_numeric(self, field, seg_views, subs):
+        merged: dict = {}
+        sub_parts: dict = {}
+        for seg, dseg, matched in seg_views:
+            dv = seg.numeric_dv.get(field)
+            if dv is None or not len(dv.value_docs):
+                continue
+            m = np.asarray(matched)
+            ok = m[dv.value_docs]
+            vals, docs = dv.values[ok], dv.value_docs[ok]
+            # docs count once per distinct value
+            pairs = np.unique(np.stack([vals.astype(np.float64),
+                                        docs.astype(np.float64)]), axis=1)
+            uniq_vals, counts = np.unique(pairs[0], return_counts=True)
+            for v, c in zip(uniq_vals, counts):
+                key = v if dv.kind == "double" else int(v)
+                merged[key] = merged.get(key, 0) + int(c)
+            for sub in subs:
+                sf, _sft = self._field_type(sub, sub.type)
+                sdv = seg.numeric_dv.get(sf)
+                if sdv is None:
+                    continue
+                per_doc_sum = np.zeros(seg.n_docs)
+                per_doc_cnt = np.zeros(seg.n_docs, np.int64)
+                per_doc_min = np.full(seg.n_docs, np.inf)
+                per_doc_max = np.full(seg.n_docs, -np.inf)
+                sok = m[sdv.value_docs] if len(sdv.value_docs) else np.zeros(0, bool)
+                np.add.at(per_doc_sum, sdv.value_docs[sok],
+                          sdv.values[sok].astype(np.float64))
+                np.add.at(per_doc_cnt, sdv.value_docs[sok], 1)
+                np.minimum.at(per_doc_min, sdv.value_docs[sok],
+                              sdv.values[sok].astype(np.float64))
+                np.maximum.at(per_doc_max, sdv.value_docs[sok],
+                              sdv.values[sok].astype(np.float64))
+                for v, d in zip(pairs[0], pairs[1].astype(np.int64)):
+                    key0 = v if dv.kind == "double" else int(v)
+                    key = (sub.name, key0)
+                    ps, pc, pmn, pmx = sub_parts.get(key,
+                                                     (0.0, 0, np.inf, -np.inf))
+                    sub_parts[key] = (ps + per_doc_sum[d],
+                                      pc + int(per_doc_cnt[d]),
+                                      min(pmn, per_doc_min[d]),
+                                      max(pmx, per_doc_max[d]))
+        return merged, sub_parts
+
+    def _finish_sub_metric(self, sub, parts):
+        s, c, mn, mx = parts
+        if sub.type == "sum":
+            return {"value": s}
+        if sub.type == "min":
+            return {"value": mn if c else None}
+        if sub.type == "max":
+            return {"value": mx if c else None}
+        if sub.type == "avg":
+            return {"value": (s / c) if c else None}
+        if sub.type == "value_count":
+            return {"value": c}
+        if sub.type == "stats":
+            return {"count": c, "min": mn if c else None,
+                    "max": mx if c else None, "avg": (s / c) if c else None,
+                    "sum": s}
+        raise IllegalArgumentError(
+            f"sub-aggregation type [{sub.type}] under terms/histogram "
+            "is not supported")
+
+    # -- histograms -------------------------------------------------------
+
+    def _agg_histogram(self, req, seg_views):
+        field, ft = self._field_type(req, "histogram")
+        interval = float(req.params["interval"])
+        if interval <= 0:
+            raise IllegalArgumentError("[interval] must be > 0")
+        offset = float(req.params.get("offset", 0))
+        s, c, mn, mx = self._collect_metric_partials(field, seg_views)
+        if not c:
+            return {"buckets": []}
+        first = np.floor((mn - offset) / interval) * interval + offset
+        n = int((mx - first) // interval) + 2
+        if n > MAX_BUCKETS:
+            raise IllegalArgumentError(
+                f"trying to create too many buckets ({n} > {MAX_BUCKETS})")
+        edges = first + interval * np.arange(n, dtype=np.float64)
+        return self._histogram_collect(req, field, seg_views, edges,
+                                       keys=edges[:-1].tolist(),
+                                       min_doc_count=int(
+                                           req.params.get("min_doc_count", 0)))
+
+    def _agg_date_histogram(self, req, seg_views):
+        field, ft = self._field_type(req, "date_histogram")
+        calendar = req.params.get("calendar_interval")
+        fixed = req.params.get("fixed_interval") or req.params.get("interval")
+        if calendar is None and fixed is None:
+            raise ParsingError(
+                "date_histogram requires calendar_interval or fixed_interval")
+        offset = req.params.get("offset", 0)
+        if isinstance(offset, str) and offset:
+            offset = _parse_duration_ms(offset.lstrip("+-")) * (
+                -1 if offset.startswith("-") else 1)
+        s, c, mn, mx = self._collect_metric_partials(field, seg_views)
+        if not c:
+            return {"buckets": []}
+        edges = build_date_edges(int(mn), int(mx), calendar=calendar,
+                                 fixed=None if calendar else fixed,
+                                 offset=int(offset))
+        fmt = req.params.get("format")
+        keys = edges[:-1].tolist()
+        return self._histogram_collect(
+            req, field, seg_views, edges, keys=keys,
+            min_doc_count=int(req.params.get("min_doc_count", 0)),
+            date_fmt=fmt or "")
+
+    def _histogram_collect(self, req, field, seg_views, edges, keys,
+                           min_doc_count, date_fmt=None):
+        n_buckets = len(keys)
+        n_pad_b = pad_pow2(n_buckets + 1)
+        totals = np.zeros(n_buckets, np.int64)
+        sub_parts = {sub.name: [np.zeros(n_buckets), np.zeros(n_buckets, np.int64),
+                                np.full(n_buckets, np.inf),
+                                np.full(n_buckets, -np.inf)]
+                     for sub in req.subs}
+        edges_j = jnp.asarray(edges)
+        for seg, dseg, matched in seg_views:
+            col = self._dev_numeric(dseg, field)
+            if col is None:
+                continue
+            counts = np.asarray(agg_ops.bucketed_counts(
+                col["values"], col["value_docs"], matched, edges_j,
+                n_buckets_pad=n_pad_b))
+            totals += counts[:n_buckets]
+            for sub in req.subs:
+                sf, _ = self._field_type(sub, sub.type)
+                scol = self._dev_numeric(dseg, sf)
+                if scol is None:
+                    continue
+                b = jnp.searchsorted(edges_j, col["values"],
+                                     side="right").astype(jnp.int32) - 1
+                entry_ok = (matched[col["value_docs"]] & (b >= 0)
+                            & (b < len(edges) - 1))
+                entry_ok &= agg_ops._first_occurrence(col["value_docs"], b)
+                per_doc = agg_ops.per_doc_partials(
+                    scol["values"], scol["value_docs"], matched,
+                    n_pad=dseg.n_pad)
+                s, c, mn, mx = agg_ops.scatter_partials_to_buckets(
+                    col["value_docs"], b, entry_ok, per_doc,
+                    n_buckets_pad=n_pad_b)
+                acc = sub_parts[sub.name]
+                acc[0] += np.asarray(s)[:n_buckets]
+                acc[1] += np.asarray(c)[:n_buckets]
+                acc[2] = np.minimum(acc[2], np.asarray(mn)[:n_buckets])
+                acc[3] = np.maximum(acc[3], np.asarray(mx)[:n_buckets])
+        buckets = []
+        for i, key in enumerate(keys):
+            if totals[i] < min_doc_count:
+                continue
+            b = {"key": int(key) if date_fmt is not None else float(key),
+                 "doc_count": int(totals[i])}
+            if date_fmt is not None:
+                b["key_as_string"] = _fmt_date(int(key), date_fmt or None)
+            for sub in req.subs:
+                acc = sub_parts[sub.name]
+                b[sub.name] = self._finish_sub_metric(
+                    sub, (float(acc[0][i]), int(acc[1][i]),
+                          float(acc[2][i]), float(acc[3][i])))
+            buckets.append(b)
+        return {"buckets": buckets}
+
+    # -- mask-composition buckets ----------------------------------------
+
+    def _narrow(self, seg_views, mask_fn):
+        """New seg_views with matched &= mask_fn(seg, dseg)."""
+        out = []
+        for seg, dseg, matched in seg_views:
+            out.append((seg, dseg, matched & mask_fn(seg, dseg)))
+        return out
+
+    def _filter_mask_fn(self, query_json):
+        from opensearch_tpu.search.compiler import compile_query
+        from opensearch_tpu.search.executor import build_arrays
+        from opensearch_tpu.search.plan import run_full
+        from opensearch_tpu.search.query_dsl import parse_query
+
+        plan, bind = compile_query(parse_query(query_json), self.ctx,
+                                   scored=False)
+        needed = plan.arrays()
+        neg_inf = jnp.asarray(np.float32(-np.inf))
+
+        def mask_fn(seg, dseg):
+            A = build_arrays(dseg, needed, self.ctx.mapper,
+                             live=self.ctx.live_jnp(seg, dseg))
+            dims, ins = plan.prepare(bind, seg, dseg, self.ctx)
+            _scores, matched = run_full(plan, dims, A, ins, neg_inf)
+            return matched
+        return mask_fn
+
+    def _agg_filter(self, req, seg_views):
+        narrowed = self._narrow(seg_views, self._filter_mask_fn(req.params))
+        out = {"doc_count": sum(int(m.sum()) for _s, _d, m in narrowed)}
+        for sub in req.subs:
+            out[sub.name] = self._run_one(sub, narrowed)
+        return out
+
+    def _agg_filters(self, req, seg_views):
+        filters = req.params.get("filters")
+        if not isinstance(filters, dict):
+            raise ParsingError("[filters] aggregation requires keyed filters")
+        buckets = {}
+        for key, query_json in filters.items():
+            narrowed = self._narrow(seg_views, self._filter_mask_fn(query_json))
+            b = {"doc_count": sum(int(m.sum()) for _s, _d, m in narrowed)}
+            for sub in req.subs:
+                b[sub.name] = self._run_one(sub, narrowed)
+            buckets[key] = b
+        return {"buckets": buckets}
+
+    def _agg_global(self, req, seg_views):
+        widened = [(seg, dseg, self.ctx.live_jnp(seg, dseg))
+                   for seg, dseg, _m in seg_views]
+        out = {"doc_count": sum(int(m.sum()) for _s, _d, m in widened)}
+        for sub in req.subs:
+            out[sub.name] = self._run_one(sub, widened)
+        return out
+
+    def _agg_missing(self, req, seg_views):
+        field, ft = self._field_type(req, "missing")
+        from opensearch_tpu.search.query_dsl import ExistsQuery
+        from opensearch_tpu.search.compiler import compile_query
+        from opensearch_tpu.search.executor import build_arrays
+        from opensearch_tpu.search.plan import run_full
+
+        plan, bind = compile_query(ExistsQuery(field=field), self.ctx,
+                                   scored=False)
+        needed = plan.arrays()
+        neg_inf = jnp.asarray(np.float32(-np.inf))
+
+        def mask_fn(seg, dseg):
+            A = build_arrays(dseg, needed, self.ctx.mapper,
+                             live=self.ctx.live_jnp(seg, dseg))
+            dims, ins = plan.prepare(bind, seg, dseg, self.ctx)
+            _s, exists = run_full(plan, dims, A, ins, neg_inf)
+            return ~exists & self.ctx.live_jnp(seg, dseg)
+        narrowed = self._narrow(seg_views, mask_fn)
+        out = {"doc_count": sum(int(m.sum()) for _s, _d, m in narrowed)}
+        for sub in req.subs:
+            out[sub.name] = self._run_one(sub, narrowed)
+        return out
+
+    def _agg_range(self, req, seg_views, is_date=False):
+        field, ft = self._field_type(req, "range")
+        ranges = req.params.get("ranges")
+        if not ranges:
+            raise ParsingError("[range] aggregation requires [ranges]")
+        buckets = []
+        for r in ranges:
+            frm = r.get("from")
+            to = r.get("to")
+            if is_date:
+                frm_v = parse_date_millis(frm) if frm is not None else None
+                to_v = parse_date_millis(to) if to is not None else None
+            else:
+                frm_v = float(frm) if frm is not None else None
+                to_v = float(to) if to is not None else None
+
+            def mask_fn(seg, dseg, frm_v=frm_v, to_v=to_v):
+                col = self._dev_numeric(dseg, field)
+                if col is None:
+                    return jnp.zeros(dseg.n_pad, bool)
+                from opensearch_tpu.ops.filters import range_mask
+                lo = -np.inf if frm_v is None else frm_v
+                hi = np.inf if to_v is None else to_v
+                vals = col["values"].astype(jnp.float64)
+                return range_mask(vals, col["value_docs"], lo, hi,
+                                  include_lo=True, include_hi=False,
+                                  n_pad=dseg.n_pad)
+            narrowed = self._narrow(seg_views, mask_fn)
+            key = r.get("key")
+            if key is None:
+                key = (f"{'*' if frm is None else frm}-"
+                       f"{'*' if to is None else to}")
+            b = {"key": key, "doc_count":
+                 sum(int(m.sum()) for _s, _d, m in narrowed)}
+            if frm is not None:
+                b["from"] = frm_v
+            if to is not None:
+                b["to"] = to_v
+            for sub in req.subs:
+                b[sub.name] = self._run_one(sub, narrowed)
+            buckets.append(b)
+        return {"buckets": buckets}
+
+    def _agg_date_range(self, req, seg_views):
+        return self._agg_range(req, seg_views, is_date=True)

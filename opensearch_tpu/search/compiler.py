@@ -49,8 +49,14 @@ class ShardContext:
     def __init__(self, segments, mapper):
         self.segments = segments
         self.mapper = mapper
+        # point-in-time live-bitmap snapshot (apply_deletes replaces the
+        # array, so this context keeps seeing the state at acquire time)
+        self.lives = {id(s): s.live for s in segments}
         self._fstats: dict[str, FieldStats] = {}
         self._sorted_terms: dict[tuple[int, str], list[str]] = {}
+
+    def live_jnp(self, seg, dseg):
+        return dseg.live_jnp(self.lives[id(seg)])
 
     def field_type(self, field: str):
         return self.mapper.field_type(field)
@@ -499,6 +505,74 @@ def _c_simple_query_string(q, ctx, scored):
                                  boost=q.boost), ctx, scored)
 
 
+def _c_knn(q, ctx, scored):
+    """knn query: exact brute-force pre-pass over every segment's vector
+    column (matmul + top-k, ops/knn.py), global per-shard k winners
+    injected into the plan tree as a ScoredMaskPlan.  Optional ``filter``
+    restricts candidates BEFORE the k cut (the plugin's filtered-knn
+    semantics)."""
+    import jax.numpy as jnp
+
+    from opensearch_tpu.ops.knn import knn_topk
+
+    ft = ctx.field_type(q.field)
+    if ft is None:
+        return _none()
+    if ft.dv_kind != "vector":
+        raise IllegalArgumentError(
+            f"[knn] query requires a knn_vector/dense_vector field, "
+            f"[{q.field}] is [{ft.type_name}]")
+    qvec = np.asarray(q.vector, np.float32)
+    if qvec.shape != (ft.dims,):
+        raise IllegalArgumentError(
+            f"query vector has dimension {qvec.shape[0]} but field "
+            f"[{q.field}] expects {ft.dims}")
+    space = {"l2": "l2", "cosinesimil": "cosinesimil",
+             "innerproduct": "innerproduct"}.get(ft.space_type, "l2")
+
+    filter_state = None
+    if q.filter is not None:
+        filter_state = compile_query(q.filter, ctx, scored=False)
+
+    qvec_j = jnp.asarray(qvec)
+    candidates = []          # (score, seg_order, local)
+    for seg_order, seg in enumerate(ctx.segments):
+        dseg = seg.device()
+        vcol = dseg.vector.get(q.field)
+        if vcol is None:
+            continue
+        valid = vcol["exists"] & ctx.live_jnp(seg, dseg)
+        if filter_state is not None:
+            from opensearch_tpu.search.executor import build_arrays
+            fplan, fbind = filter_state
+            A = build_arrays(dseg, fplan.arrays(), ctx.mapper)
+            dims, ins = fplan.prepare(fbind, seg, dseg, ctx)
+            _s, fmask = P.run_full(fplan, dims, A, ins,
+                                   jnp.asarray(np.float32(-np.inf)))
+            valid = valid & fmask
+        kk = min(q.k, dseg.n_pad)
+        vals, idx = knn_topk(vcol["values"], valid, qvec_j, space=space, k=kk)
+        vals, idx = np.asarray(vals), np.asarray(idx)
+        keep = vals > -np.inf
+        for v, i in zip(vals[keep], idx[keep]):
+            candidates.append((float(v), seg_order, int(i)))
+    candidates.sort(key=lambda t: (-t[0], t[1], t[2]))
+    winners: dict[int, list[tuple[int, float]]] = {}
+    for score, seg_order, local in candidates[: q.k]:
+        winners.setdefault(seg_order, []).append((local, score * q.boost))
+    seg_order_by_id = {id(s): i for i, s in enumerate(ctx.segments)}
+
+    def fn(seg, dseg):
+        scores = np.zeros(dseg.n_pad, np.float32)
+        mask = np.zeros(dseg.n_pad, bool)
+        for local, score in winners.get(seg_order_by_id.get(id(seg), -1), []):
+            scores[local] = score
+            mask[local] = True
+        return scores, mask
+
+    return P.ScoredMaskPlan(label="knn"), {"fn": fn}
+
+
 _COMPILERS = {
     dsl.MatchAllQuery: _c_match_all,
     dsl.MatchNoneQuery: _c_match_none,
@@ -518,4 +592,5 @@ _COMPILERS = {
     dsl.ConstantScoreQuery: _c_constant_score,
     dsl.DisMaxQuery: _c_dis_max,
     dsl.SimpleQueryStringQuery: _c_simple_query_string,
+    dsl.KnnQuery: _c_knn,
 }

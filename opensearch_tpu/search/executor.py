@@ -89,10 +89,12 @@ def _dummy_for(group: str, field: str, dseg: DeviceSegment, mapper):
     raise IllegalArgumentError(f"unknown array group [{group}]")
 
 
-def build_arrays(dseg: DeviceSegment, needed, mapper):
+def build_arrays(dseg: DeviceSegment, needed, mapper, live=None):
     """Assemble the ``A`` pytree a plan reads: live mask + requested field
-    array groups (absent fields get all-inactive dummies)."""
-    A = {"live": dseg.live}
+    array groups (absent fields get all-inactive dummies).  ``live`` is the
+    caller's point-in-time staged live mask (defaults to the segment's
+    construction-time state)."""
+    A = {"live": dseg.live if live is None else live}
     sources = {"postings": dseg.postings, "numeric": dseg.numeric,
                "ordinal": dseg.ordinal, "vector": dseg.vector,
                "geo": dseg.geo}
@@ -187,16 +189,33 @@ class ShardSearcher:
         needed = plan.arrays()
         k_want = from_ + size
 
+        aggs_json = body.get("aggs") or body.get("aggregations")
+        # with aggs, the full-scores pass runs ONCE and feeds both the
+        # top-k and the aggregations (no second device execution)
+        views = (list(self._run_full(plan, bind, needed, min_score))
+                 if aggs_json and self.segments else None)
+
         if not self.segments:
             rows, total, max_score = [], 0, None
         elif sort_specs is None:
-            rows, total, max_score = self._topk(plan, bind, needed, k_want,
-                                                min_score)
+            if views is not None:
+                rows, total, max_score = self._topk_from_views(views, k_want)
+            else:
+                rows, total, max_score = self._topk(plan, bind, needed,
+                                                    k_want, min_score)
         else:
             rows, total, max_score = self._field_sorted(plan, bind, needed,
                                                         k_want, sort_specs,
-                                                        min_score)
+                                                        min_score, views)
         rows = rows[from_: from_ + size]
+
+        aggregations = None
+        if aggs_json:
+            from opensearch_tpu.search.aggs import AggregationExecutor
+            seg_views = [(seg, dseg, matched)
+                         for seg, dseg, _s, matched in (views or [])]
+            aggregations = AggregationExecutor(self.ctx).run(aggs_json,
+                                                             seg_views)
 
         hits = []
         for row in rows:
@@ -212,7 +231,7 @@ class ShardSearcher:
             hits.append(hit)
 
         took = int((time.monotonic() - t0) * 1000)
-        return {
+        resp = {
             "took": took,
             "timed_out": False,
             "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
@@ -222,6 +241,9 @@ class ShardSearcher:
                 "hits": hits,
             },
         }
+        if aggregations is not None:
+            resp["aggregations"] = aggregations
+        return resp
 
     # -- internals --------------------------------------------------------
 
@@ -229,39 +251,69 @@ class ShardSearcher:
         ms = jnp.asarray(np.float32(-np.inf if min_score is None else min_score))
         for seg in self.segments:
             dseg = seg.device()
-            A = build_arrays(dseg, needed, self.mapper)
+            A = build_arrays(dseg, needed, self.mapper,
+                             live=self.ctx.live_jnp(seg, dseg))
             dims, ins = plan.prepare(bind, seg, dseg, self.ctx)
             scores, matched = P.run_full(plan, dims, A, ins, ms)
             yield seg, dseg, scores, matched
 
+    def _merge_topk(self, per_seg, k_want, total, max_score):
+        if not per_seg:
+            return [], 0, None
+        scores = np.concatenate([p[0] for p in per_seg])
+        segi = np.concatenate([p[1] for p in per_seg])
+        local = np.concatenate([p[2] for p in per_seg])
+        order = np.lexsort((local, segi, -scores))[:k_want]
+        rows = [{"seg": int(segi[i]), "local": int(local[i]),
+                 "score": float(scores[i])} for i in order]
+        return rows, total, (None if max_score == -np.inf else float(max_score))
+
     def _topk(self, plan, bind, needed, k_want, min_score):
-        all_scores, all_seg, all_local = [], [], []
+        if k_want == 0:            # size=0: counts only (aggs-style request)
+            total = sum(int(np.asarray(m).sum()) for _s, _d, _sc, m
+                        in self._run_full(plan, bind, needed, min_score))
+            return [], total, None
+        per_seg = []
         total = 0
         max_score = -np.inf
         ms = jnp.asarray(np.float32(-np.inf if min_score is None else min_score))
         for si, seg in enumerate(self.segments):
             dseg = seg.device()
-            A = build_arrays(dseg, needed, self.mapper)
+            A = build_arrays(dseg, needed, self.mapper,
+                             live=self.ctx.live_jnp(seg, dseg))
             dims, ins = plan.prepare(bind, seg, dseg, self.ctx)
             k = min(k_want, dseg.n_pad)
             vals, idx, tot, mx = P.run_topk(plan, dims, k, A, ins, ms)
             vals = np.asarray(vals)
             idx = np.asarray(idx)
             keep = vals > -np.inf
-            all_scores.append(vals[keep])
-            all_local.append(idx[keep])
-            all_seg.append(np.full(int(keep.sum()), si, dtype=_I32))
+            per_seg.append((vals[keep], np.full(int(keep.sum()), si, _I32),
+                            idx[keep]))
             total += int(tot)
             max_score = max(max_score, float(mx))
-        if not all_scores:
-            return [], 0, None
-        scores = np.concatenate(all_scores)
-        segi = np.concatenate(all_seg)
-        local = np.concatenate(all_local)
-        order = np.lexsort((local, segi, -scores))[:k_want]
-        rows = [{"seg": int(segi[i]), "local": int(local[i]),
-                 "score": float(scores[i])} for i in order]
-        return rows, total, (None if max_score == -np.inf else float(max_score))
+        return self._merge_topk(per_seg, k_want, total, max_score)
+
+    def _topk_from_views(self, views, k_want):
+        """Top-k out of an already-run full-scores pass (aggs requests)."""
+        per_seg = []
+        total = 0
+        max_score = -np.inf
+        for si, (seg, dseg, scores, matched) in enumerate(views):
+            if k_want == 0:
+                total += int(np.asarray(matched).sum())
+                continue
+            k = min(k_want, dseg.n_pad)
+            vals, idx, tot, mx = P.topk_from_scores(scores, k, matched)
+            vals = np.asarray(vals)
+            idx = np.asarray(idx)
+            keep = vals > -np.inf
+            per_seg.append((vals[keep], np.full(int(keep.sum()), si, _I32),
+                            idx[keep]))
+            total += int(tot)
+            max_score = max(max_score, float(mx))
+        if k_want == 0:
+            return [], total, None
+        return self._merge_topk(per_seg, k_want, total, max_score)
 
     def _sort_key_columns(self, seg, spec, scores_np):
         """Per-doc sort key for one segment + one sort clause.  Returns
@@ -297,11 +349,13 @@ class ShardSearcher:
         raise IllegalArgumentError(
             f"sorting on field [{field}] of type [{ft.type_name}] is not supported")
 
-    def _field_sorted(self, plan, bind, needed, k_want, sort_specs, min_score):
+    def _field_sorted(self, plan, bind, needed, k_want, sort_specs, min_score,
+                      views=None):
         rows = []
         total = 0
-        for si, (seg, dseg, scores, matched) in enumerate(
-                self._run_full(plan, bind, needed, min_score)):
+        if views is None:
+            views = self._run_full(plan, bind, needed, min_score)
+        for si, (seg, dseg, scores, matched) in enumerate(views):
             matched_np = np.asarray(matched)[: seg.n_docs]
             scores_np = np.asarray(scores)[: seg.n_docs]
             total += int(matched_np.sum())

@@ -619,6 +619,15 @@ def run_topk(plan: Plan, dims, k: int, A, ins, min_score):
     return vals, idx, matched.sum(), jnp.max(key)
 
 
+@partial(jax.jit, static_argnums=(1,))
+def topk_from_scores(scores, k: int, matched):
+    """Top-k over an already-computed (scores, matched) pair — used when a
+    full-scores pass already ran for aggregations."""
+    key = jnp.where(matched, scores, -jnp.inf)
+    vals, idx = lax.top_k(key, k)
+    return vals, idx, matched.sum(), jnp.max(key)
+
+
 @partial(jax.jit, static_argnums=(0, 1))
 def run_full(plan: Plan, dims, A, ins, min_score):
     """(scores[n_pad] zeroed-unmatched, matched[n_pad]) — for aggs, sorts,

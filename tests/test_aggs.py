@@ -1,0 +1,255 @@
+"""Aggregation correctness vs plain-Python oracles (nyc_taxis-style
+terms/date_histogram/metrics must return oracle-identical buckets —
+VERDICT round-1 item 6's 'done' bar)."""
+
+import datetime as dt
+import math
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.segment import SegmentWriter
+from opensearch_tpu.mapping.mapper import DocumentMapper
+from opensearch_tpu.mapping.types import parse_date_millis
+from opensearch_tpu.search.executor import ShardSearcher
+
+MAPPING = {"properties": {
+    "color": {"type": "keyword"},
+    "n": {"type": "long"},
+    "price": {"type": "double"},
+    "day": {"type": "date"},
+    "flag": {"type": "boolean"},
+    "body": {"type": "text"},
+}}
+
+COLORS = ["red", "green", "blue", "cyan"]
+
+
+def build(n_docs=150, n_segments=3, seed=5):
+    rng = np.random.default_rng(seed)
+    mapper = DocumentMapper(MAPPING)
+    writer = SegmentWriter()
+    segments, raws = [], []
+    per = n_docs // n_segments
+    doc_no = 0
+    for si in range(n_segments):
+        parsed = []
+        for _ in range(per):
+            src = {
+                "color": list(rng.choice(COLORS, size=rng.integers(1, 3),
+                                         replace=False)),
+                "n": int(rng.integers(0, 50)),
+                "price": float(np.round(rng.uniform(1, 100), 2)),
+                "day": f"2023-{rng.integers(1, 7):02d}-{rng.integers(1, 28):02d}",
+                "flag": bool(rng.integers(0, 2)),
+                "body": "match me" if rng.uniform() < 0.5 else "skip this",
+            }
+            if rng.uniform() < 0.15:
+                del src["price"]
+            raws.append(src)
+            parsed.append(mapper.parse(str(doc_no), src))
+            doc_no += 1
+        segments.append(writer.build(parsed, f"s{si}"))
+    return ShardSearcher(segments, mapper), raws
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    return build()
+
+
+def agg_resp(searcher, aggs, query=None, size=0):
+    body = {"aggs": aggs, "size": size}
+    if query:
+        body["query"] = query
+    return searcher.search(body)["aggregations"]
+
+
+def test_terms_keyword(corpus):
+    searcher, raws = corpus
+    out = agg_resp(searcher, {"by_color": {"terms": {"field": "color"}}})
+    expected = {}
+    for src in raws:
+        for c in set(src["color"]):
+            expected[c] = expected.get(c, 0) + 1
+    buckets = out["by_color"]["buckets"]
+    exp_sorted = sorted(expected.items(), key=lambda kv: (-kv[1], kv[0]))
+    assert [(b["key"], b["doc_count"]) for b in buckets] == exp_sorted[:10]
+    assert out["by_color"]["sum_other_doc_count"] == (
+        sum(expected.values()) - sum(b["doc_count"] for b in buckets))
+
+
+def test_terms_keyword_key_order_and_size(corpus):
+    searcher, raws = corpus
+    out = agg_resp(searcher, {"a": {"terms": {
+        "field": "color", "size": 2, "order": {"_key": "asc"}}}})
+    keys = [b["key"] for b in out["a"]["buckets"]]
+    assert keys == sorted(set(c for src in raws for c in src["color"]))[:2]
+
+
+def test_terms_long_and_boolean(corpus):
+    searcher, raws = corpus
+    out = agg_resp(searcher, {
+        "by_n": {"terms": {"field": "n", "size": 5}},
+        "by_flag": {"terms": {"field": "flag"}}})
+    expected_n = {}
+    for src in raws:
+        expected_n[src["n"]] = expected_n.get(src["n"], 0) + 1
+    exp_sorted = sorted(expected_n.items(), key=lambda kv: (-kv[1], kv[0]))[:5]
+    assert [(b["key"], b["doc_count"]) for b in out["by_n"]["buckets"]] == exp_sorted
+    flags = {b["key_as_string"]: b["doc_count"] for b in out["by_flag"]["buckets"]}
+    assert flags["true"] == sum(1 for s in raws if s["flag"])
+    assert flags["false"] == sum(1 for s in raws if not s["flag"])
+
+
+def test_metrics(corpus):
+    searcher, raws = corpus
+    out = agg_resp(searcher, {
+        "mx": {"max": {"field": "price"}},
+        "mn": {"min": {"field": "price"}},
+        "sm": {"sum": {"field": "price"}},
+        "av": {"avg": {"field": "price"}},
+        "vc": {"value_count": {"field": "price"}},
+        "st": {"stats": {"field": "n"}},
+        "card": {"cardinality": {"field": "color"}},
+        "pct": {"percentiles": {"field": "n", "percents": [50]}},
+    })
+    prices = [s["price"] for s in raws if "price" in s]
+    ns = [s["n"] for s in raws]
+    assert out["mx"]["value"] == pytest.approx(max(prices))
+    assert out["mn"]["value"] == pytest.approx(min(prices))
+    assert out["sm"]["value"] == pytest.approx(sum(prices), rel=1e-9)
+    assert out["av"]["value"] == pytest.approx(sum(prices) / len(prices))
+    assert out["vc"]["value"] == len(prices)
+    assert out["st"] == {"count": len(ns), "min": min(ns), "max": max(ns),
+                         "avg": pytest.approx(sum(ns) / len(ns)),
+                         "sum": pytest.approx(sum(ns))}
+    assert out["card"]["value"] == len(set(c for s in raws for c in s["color"]))
+    assert out["pct"]["values"]["50.0"] == pytest.approx(
+        float(np.percentile(np.asarray(ns, float), 50)))
+
+
+def test_terms_with_sub_metrics(corpus):
+    searcher, raws = corpus
+    out = agg_resp(searcher, {"by_color": {
+        "terms": {"field": "color", "size": 10},
+        "aggs": {"avg_n": {"avg": {"field": "n"}},
+                 "sum_price": {"sum": {"field": "price"}}}}})
+    for b in out["by_color"]["buckets"]:
+        docs = [s for s in raws if b["key"] in s["color"]]
+        assert b["doc_count"] == len(docs)
+        assert b["avg_n"]["value"] == pytest.approx(
+            sum(s["n"] for s in docs) / len(docs))
+        assert b["sum_price"]["value"] == pytest.approx(
+            sum(s.get("price", 0) for s in docs), rel=1e-9)
+
+
+def test_date_histogram_month(corpus):
+    searcher, raws = corpus
+    out = agg_resp(searcher, {"per_month": {
+        "date_histogram": {"field": "day", "calendar_interval": "month"},
+        "aggs": {"stats_n": {"stats": {"field": "n"}}}}})
+    expected = {}
+    for s in raws:
+        month = s["day"][:7]
+        expected.setdefault(month, []).append(s["n"])
+    buckets = out["per_month"]["buckets"]
+    got = {b["key_as_string"][:7]: b for b in buckets}
+    assert set(got) == set(expected)
+    for month, ns in expected.items():
+        b = got[month]
+        assert b["doc_count"] == len(ns)
+        assert b["stats_n"]["sum"] == pytest.approx(sum(ns))
+        assert b["stats_n"]["min"] == min(ns)
+    # keys are millis at month boundaries, ascending
+    keys = [b["key"] for b in buckets]
+    assert keys == sorted(keys)
+
+
+def test_date_histogram_fixed_interval(corpus):
+    searcher, raws = corpus
+    out = agg_resp(searcher, {"weekly": {"date_histogram": {
+        "field": "day", "fixed_interval": "7d"}}})
+    total = sum(b["doc_count"] for b in out["weekly"]["buckets"])
+    assert total == len(raws)
+    keys = [b["key"] for b in out["weekly"]["buckets"]]
+    assert all((k2 - k1) % (7 * 86400000) == 0 for k1, k2 in zip(keys, keys[1:]))
+
+
+def test_histogram_numeric(corpus):
+    searcher, raws = corpus
+    out = agg_resp(searcher, {"h": {"histogram": {"field": "n", "interval": 10}}})
+    expected = {}
+    for s in raws:
+        b = (s["n"] // 10) * 10
+        expected[float(b)] = expected.get(float(b), 0) + 1
+    got = {b["key"]: b["doc_count"] for b in out["h"]["buckets"]
+           if b["doc_count"]}
+    assert got == expected
+
+
+def test_filter_and_filters(corpus):
+    searcher, raws = corpus
+    out = agg_resp(searcher, {
+        "cheap": {"filter": {"range": {"n": {"lt": 25}}},
+                  "aggs": {"colors": {"terms": {"field": "color"}}}},
+        "split": {"filters": {"filters": {
+            "low": {"range": {"n": {"lt": 25}}},
+            "high": {"range": {"n": {"gte": 25}}}}}},
+    })
+    low = [s for s in raws if s["n"] < 25]
+    assert out["cheap"]["doc_count"] == len(low)
+    exp_colors = {}
+    for s in low:
+        for c in set(s["color"]):
+            exp_colors[c] = exp_colors.get(c, 0) + 1
+    got = {b["key"]: b["doc_count"] for b in out["cheap"]["colors"]["buckets"]}
+    assert got == exp_colors
+    assert out["split"]["buckets"]["low"]["doc_count"] == len(low)
+    assert out["split"]["buckets"]["high"]["doc_count"] == len(raws) - len(low)
+
+
+def test_range_agg(corpus):
+    searcher, raws = corpus
+    out = agg_resp(searcher, {"r": {
+        "range": {"field": "n", "ranges": [
+            {"to": 20}, {"from": 20, "to": 40, "key": "mid"}, {"from": 40}]},
+        "aggs": {"avg_price": {"avg": {"field": "price"}}}}})
+    b0, b1, b2 = out["r"]["buckets"]
+    assert b0["doc_count"] == sum(1 for s in raws if s["n"] < 20)
+    assert b1["key"] == "mid"
+    assert b1["doc_count"] == sum(1 for s in raws if 20 <= s["n"] < 40)
+    assert b2["doc_count"] == sum(1 for s in raws if s["n"] >= 40)
+    mid = [s for s in raws if 20 <= s["n"] < 40 and "price" in s]
+    assert b1["avg_price"]["value"] == pytest.approx(
+        sum(s["price"] for s in mid) / len(mid))
+
+
+def test_global_and_missing(corpus):
+    searcher, raws = corpus
+    out = agg_resp(searcher,
+                   {"all": {"global": {},
+                            "aggs": {"c": {"value_count": {"field": "n"}}}},
+                    "no_price": {"missing": {"field": "price"}}},
+                   query={"match": {"body": "match"}})
+    assert out["all"]["doc_count"] == len(raws)
+    assert out["all"]["c"]["value"] == len(raws)
+    matched = [s for s in raws if "match" in s["body"]]
+    assert out["no_price"]["doc_count"] == sum(
+        1 for s in matched if "price" not in s)
+
+
+def test_aggs_respect_query(corpus):
+    searcher, raws = corpus
+    out = agg_resp(searcher, {"s": {"sum": {"field": "n"}}},
+                   query={"match": {"body": "match"}})
+    expected = sum(s["n"] for s in raws if "match" in s["body"])
+    assert out["s"]["value"] == pytest.approx(expected)
+
+
+def test_aggs_with_hits(corpus):
+    searcher, raws = corpus
+    resp = searcher.search({"query": {"match_all": {}}, "size": 5,
+                            "aggs": {"mx": {"max": {"field": "n"}}}})
+    assert len(resp["hits"]["hits"]) == 5
+    assert resp["aggregations"]["mx"]["value"] == max(s["n"] for s in raws)

@@ -197,6 +197,62 @@ def test_merge_cleans_persisted_files(tmp_path):
     eng2.close()
 
 
+def test_force_merge_crash_before_flush_keeps_data(tmp_path):
+    """Merged-away segment files must survive until the NEXT commit —
+    a crash right after force_merge recovers the pre-merge state."""
+    eng = new_engine(tmp_path)
+    for i in range(10):
+        eng.index(str(i), {"n": i})
+    eng.flush()
+    eng.force_merge(1)
+    del eng                                   # crash: no flush after merge
+    eng2 = new_engine(tmp_path)
+    assert eng2.doc_count() == 10
+    eng2.refresh()
+    assert len(search_ids(eng2)) == 10
+    eng2.flush()                              # now the old files may go
+    eng2.close()
+
+
+def test_torn_tail_truncated_before_reopen_append(tmp_path):
+    """A torn tail must be truncated at open, or the next append merges
+    with the garbage and an acked op is lost on the following recovery."""
+    eng = new_engine(tmp_path)
+    eng.index("1", {"n": 1})
+    eng.ensure_synced()
+    gen = eng.translog.generation
+    del eng
+    log = tmp_path / "translog" / f"translog-{gen}.log"
+    with open(log, "ab") as f:
+        f.write(b'deadbeef{"op":"index","id":"torn"')
+    eng2 = new_engine(tmp_path)
+    eng2.index("2", {"n": 2})                 # appended after truncation
+    eng2.ensure_synced()
+    del eng2
+    eng3 = new_engine(tmp_path)
+    assert eng3.doc_count() == 2
+    assert eng3.get("2")["found"]
+    eng3.close()
+
+
+def test_searcher_is_point_in_time(tmp_path):
+    """An acquired searcher must not see deletes applied by a later
+    refresh (Lucene reader snapshot semantics)."""
+    eng = new_engine(tmp_path)
+    for i in range(5):
+        eng.index(str(i), {"n": i})
+    eng.refresh()
+    old = eng.acquire_searcher()
+    assert len(old.search({"size": 10})["hits"]["hits"]) == 5
+    eng.delete("2")
+    eng.refresh()
+    # old snapshot unchanged; new searcher sees the delete
+    assert len(old.search({"size": 10})["hits"]["hits"]) == 5
+    new = eng.acquire_searcher()
+    assert len(new.search({"size": 10})["hits"]["hits"]) == 4
+    eng.close()
+
+
 def test_sequence_numbers_monotonic(tmp_path):
     eng = new_engine(tmp_path)
     seqs = [eng.index(str(i), {"n": i}).seq_no for i in range(5)]

@@ -1,0 +1,112 @@
+"""k-NN correctness: exact brute-force must have recall@k == 1.0 vs a
+numpy oracle for every space type, including filtered knn and hybrid
+bool composition (VERDICT round-1 item 7's 'done' bar)."""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.segment import SegmentWriter
+from opensearch_tpu.mapping.mapper import DocumentMapper
+from opensearch_tpu.search.executor import ShardSearcher
+
+DIM = 16
+
+
+def build(space, n_docs=120, n_segments=3, seed=21):
+    rng = np.random.default_rng(seed)
+    mapper = DocumentMapper({"properties": {
+        "vec": {"type": "knn_vector", "dimension": DIM, "space_type": space},
+        "group": {"type": "keyword"},
+        "body": {"type": "text"},
+    }})
+    writer = SegmentWriter()
+    segments, vectors, groups = [], [], []
+    per = n_docs // n_segments
+    doc_no = 0
+    for si in range(n_segments):
+        parsed = []
+        for _ in range(per):
+            v = rng.normal(size=DIM).astype(np.float32)
+            g = ["even", "odd"][doc_no % 2]
+            vectors.append(v)
+            groups.append(g)
+            parsed.append(mapper.parse(str(doc_no), {
+                "vec": v.tolist(), "group": g, "body": "common text"}))
+            doc_no += 1
+        segments.append(writer.build(parsed, f"s{si}"))
+    return ShardSearcher(segments, mapper), np.stack(vectors), groups
+
+
+def oracle_scores(vectors, q, space):
+    dots = vectors @ q
+    if space == "l2":
+        d2 = ((vectors - q) ** 2).sum(axis=1)
+        return 1.0 / (1.0 + d2)
+    if space == "cosinesimil":
+        cos = dots / (np.linalg.norm(vectors, axis=1) * np.linalg.norm(q))
+        return (1.0 + cos) / 2.0
+    return np.where(dots >= 0, dots + 1.0, 1.0 / (1.0 - dots))
+
+
+@pytest.mark.parametrize("space", ["l2", "cosinesimil", "innerproduct"])
+def test_knn_exact_recall(space):
+    searcher, vectors, _ = build(space)
+    rng = np.random.default_rng(1)
+    for _ in range(3):
+        q = rng.normal(size=DIM).astype(np.float32)
+        resp = searcher.search({"query": {"knn": {"vec": {
+            "vector": q.tolist(), "k": 10}}}, "size": 10})
+        exp = oracle_scores(vectors.astype(np.float64), q.astype(np.float64),
+                            space)
+        order = np.argsort(-exp, kind="stable")[:10]
+        got_ids = [h["_id"] for h in resp["hits"]["hits"]]
+        assert got_ids == [str(i) for i in order]       # recall@10 == 1.0
+        for h, i in zip(resp["hits"]["hits"], order):
+            assert h["_score"] == pytest.approx(exp[i], rel=1e-4)
+
+
+def test_knn_filtered():
+    searcher, vectors, groups = build("l2")
+    q = np.zeros(DIM, np.float32)
+    resp = searcher.search({"query": {"knn": {"vec": {
+        "vector": q.tolist(), "k": 5,
+        "filter": {"term": {"group": "even"}}}}}, "size": 5})
+    exp = oracle_scores(vectors, q, "l2")
+    even = [i for i, g in enumerate(groups) if g == "even"]
+    order = sorted(even, key=lambda i: -exp[i])[:5]
+    assert [h["_id"] for h in resp["hits"]["hits"]] == [str(i) for i in order]
+
+
+def test_knn_k_limits_matches():
+    searcher, vectors, _ = build("l2")
+    resp = searcher.search({"query": {"knn": {"vec": {
+        "vector": np.zeros(DIM).tolist(), "k": 7}}}, "size": 50})
+    assert resp["hits"]["total"]["value"] == 7
+
+
+def test_knn_hybrid_bool():
+    """BM25 + knn in one bool: scores sum for docs matching both."""
+    searcher, vectors, _ = build("l2")
+    q = np.zeros(DIM, np.float32)
+    resp = searcher.search({"query": {"bool": {
+        "should": [
+            {"match": {"body": "common"}},
+            {"knn": {"vec": {"vector": q.tolist(), "k": 3}}},
+        ]}}, "size": 120})
+    exp = oracle_scores(vectors, q, "l2")
+    top3 = set(np.argsort(-exp, kind="stable")[:3])
+    base = {h["_id"]: h["_score"] for h in resp["hits"]["hits"]}
+    assert resp["hits"]["total"]["value"] == 120
+    some_plain = next(h for h in resp["hits"]["hits"]
+                      if int(h["_id"]) not in top3)
+    for i in top3:
+        assert base[str(i)] == pytest.approx(
+            some_plain["_score"] + exp[i], rel=1e-4)
+
+
+def test_knn_dim_mismatch_rejected():
+    from opensearch_tpu.common.errors import IllegalArgumentError
+    searcher, _, _ = build("l2")
+    with pytest.raises(IllegalArgumentError):
+        searcher.search({"query": {"knn": {"vec": {
+            "vector": [1.0, 2.0], "k": 3}}}})
