@@ -251,19 +251,21 @@ class DeviceSegment:
     def live_jnp(self, live_np: np.ndarray):
         """Staged live mask for a SNAPSHOT of the live bitmap (keyed by
         array identity — apply_deletes replaces the array, so old
-        snapshots keep resolving to their own staged copy)."""
+        snapshots keep resolving to their own staged copy).  The cache
+        holds a strong reference to the keyed numpy array: id() keys are
+        only valid while the object is alive."""
         import jax.numpy as jnp
 
         key = id(live_np)
         cached = self._live_cache.get(key)
-        if cached is None:
+        if cached is None or cached[0] is not live_np:
             padded = np.zeros(self.n_pad, dtype=bool)
             padded[: len(live_np)] = live_np
-            cached = jnp.asarray(padded)
+            cached = (live_np, jnp.asarray(padded))
             if len(self._live_cache) >= 4:
                 self._live_cache.pop(next(iter(self._live_cache)))
             self._live_cache[key] = cached
-        return cached
+        return cached[1]
 
 
 class SegmentWriter:

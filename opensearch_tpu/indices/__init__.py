@@ -1,0 +1,1 @@
+from opensearch_tpu.indices.service import IndexService, IndicesService  # noqa: F401

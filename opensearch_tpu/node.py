@@ -1,0 +1,76 @@
+"""Node: service wiring + lifecycle + CLI entry point.
+
+Analog of ``node/Node.java`` (ctor wiring at :400, start at :1249) and
+``bootstrap/OpenSearch.main`` — at single-node scope: settings, indices
+service, REST controller, HTTP transport.
+
+Run: ``python -m opensearch_tpu.node --port 9200 --data-path ./data``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import uuid
+
+from opensearch_tpu.indices.service import IndicesService
+from opensearch_tpu.rest.controller import RestController
+from opensearch_tpu.rest.http_server import HttpServer
+
+
+class Node:
+    def __init__(self, data_path: str, name: str = "node-1",
+                 cluster_name: str = "opensearch-tpu",
+                 host: str = "127.0.0.1", port: int = 9200):
+        self.name = name
+        self.cluster_name = cluster_name
+        self.node_id = uuid.uuid4().hex[:22]
+        self.cluster_uuid = uuid.uuid4().hex[:22]
+        self.data_path = data_path
+        os.makedirs(data_path, exist_ok=True)
+        self.indices = IndicesService(os.path.join(data_path, "indices"))
+        self.rest = RestController(self)
+        self.http = HttpServer(self.rest, host=host, port=port)
+
+    @property
+    def port(self) -> int:
+        return self.http.port
+
+    def start(self):
+        self.http.start()
+        return self
+
+    def stop(self):
+        self.http.stop()
+        self.indices.close()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="opensearch-tpu")
+    ap.add_argument("--port", type=int, default=9200)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--data-path", default="./data")
+    ap.add_argument("--name", default="node-1")
+    ap.add_argument("--cluster-name", default="opensearch-tpu")
+    args = ap.parse_args(argv)
+
+    node = Node(args.data_path, name=args.name,
+                cluster_name=args.cluster_name, host=args.host,
+                port=args.port).start()
+    print(f"[{args.name}] listening on http://{args.host}:{node.port} "
+          f"(data: {args.data_path})", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    try:
+        stop.wait()
+    finally:
+        node.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,2 @@
+from opensearch_tpu.rest.controller import RestController  # noqa: F401
+from opensearch_tpu.rest.http_server import HttpServer  # noqa: F401

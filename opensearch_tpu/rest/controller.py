@@ -1,0 +1,574 @@
+"""REST route table + handlers — the API surface.
+
+Analog of ``rest/RestController.java:250`` (dispatch) and the
+``rest/action/**`` handler classes, driven by the same path shapes the
+rest-api-spec JSON contract defines.  Transport-agnostic: the HTTP server
+calls ``dispatch(method, path, params, body)`` and gets (status, dict).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+from typing import Callable, Optional
+
+from opensearch_tpu.common.errors import (
+    DocumentMissingError,
+    OpenSearchTpuError,
+    ParsingError,
+    ValidationError,
+)
+from opensearch_tpu.version import __version__ as VERSION
+
+
+class RestRequest:
+    def __init__(self, method: str, path: str, params: dict,
+                 body: Optional[bytes]):
+        self.method = method
+        self.path = path
+        self.params = params or {}
+        self.raw_body = body or b""
+        self.path_params: dict[str, str] = {}
+
+    def json(self, default=None):
+        if not self.raw_body:
+            return default
+        try:
+            return json.loads(self.raw_body)
+        except json.JSONDecodeError as e:
+            raise ParsingError(f"request body is not valid JSON: {e}")
+
+    def param(self, name: str, default=None):
+        return self.params.get(name, self.path_params.get(name, default))
+
+    def flag(self, name: str) -> bool:
+        v = self.params.get(name)
+        return v is not None and str(v).lower() in ("", "true", "1")
+
+
+class Route:
+    def __init__(self, method: str, pattern: str, handler: Callable):
+        self.method = method
+        parts = []
+        self.names: list[str] = []
+        for seg in pattern.strip("/").split("/"):
+            if seg.startswith("{"):
+                self.names.append(seg[1:-1])
+                parts.append(r"([^/]+)")
+            else:
+                parts.append(re.escape(seg))
+        self.rx = re.compile("^/" + "/".join(parts) + "$")
+        self.handler = handler
+
+
+class RestController:
+    def __init__(self, node):
+        self.node = node
+        self.routes: list[Route] = []
+        self._register_all()
+
+    def register(self, method: str, pattern: str, handler: Callable):
+        self.routes.append(Route(method, pattern, handler))
+
+    def dispatch(self, method: str, path: str, params: dict,
+                 body: Optional[bytes]) -> tuple[int, dict]:
+        req = RestRequest(method, path, params, body)
+        try:
+            for route in self.routes:
+                if route.method != method:
+                    continue
+                m = route.rx.match(path.rstrip("/") or "/")
+                if m:
+                    req.path_params = dict(zip(route.names, m.groups()))
+                    return route.handler(req)
+            # method-mismatch vs not-found distinction
+            if any(r.rx.match(path.rstrip("/") or "/") for r in self.routes):
+                return 405, {"error": f"Incorrect HTTP method for uri [{path}]"
+                                      f" and method [{method}]", "status": 405}
+            return 400, {"error": {
+                "type": "illegal_argument_exception",
+                "reason": f"no handler found for uri [{path}] and method "
+                          f"[{method}]"}, "status": 400}
+        except OpenSearchTpuError as e:
+            return e.status, e.to_xcontent()
+        except Exception as e:  # noqa: BLE001 — the REST boundary
+            return 500, {"error": {"type": "internal_server_error",
+                                   "reason": f"{type(e).__name__}: {e}"},
+                         "status": 500}
+
+    # ------------------------------------------------------------------
+
+    def _register_all(self):
+        r = self.register
+        r("GET", "/", self.h_root)
+        r("GET", "/_cluster/health", self.h_cluster_health)
+        r("GET", "/_cluster/state", self.h_cluster_state)
+        r("GET", "/_cluster/stats", self.h_cluster_stats)
+        r("GET", "/_nodes", self.h_nodes_info)
+        r("GET", "/_nodes/stats", self.h_nodes_stats)
+        r("GET", "/_cat/indices", self.h_cat_indices)
+        r("GET", "/_cat/health", self.h_cat_health)
+        r("GET", "/_cat/count", self.h_cat_count)
+        r("GET", "/_cat/shards", self.h_cat_shards)
+        r("POST", "/_bulk", self.h_bulk)
+        r("PUT", "/_bulk", self.h_bulk)
+        r("POST", "/{index}/_bulk", self.h_bulk)
+        r("PUT", "/{index}/_bulk", self.h_bulk)
+        r("GET", "/_search", self.h_search)
+        r("POST", "/_search", self.h_search)
+        r("GET", "/_count", self.h_count)
+        r("POST", "/_count", self.h_count)
+        r("GET", "/_mapping", self.h_get_mapping_all)
+        r("GET", "/_refresh", self.h_refresh)
+        r("POST", "/_refresh", self.h_refresh)
+
+        r("PUT", "/{index}", self.h_create_index)
+        r("DELETE", "/{index}", self.h_delete_index)
+        r("GET", "/{index}", self.h_get_index)
+        r("HEAD", "/{index}", self.h_index_exists)
+        r("GET", "/{index}/_mapping", self.h_get_mapping)
+        r("PUT", "/{index}/_mapping", self.h_put_mapping)
+        r("GET", "/{index}/_settings", self.h_get_settings)
+        r("GET", "/{index}/_stats", self.h_index_stats)
+        r("POST", "/{index}/_refresh", self.h_refresh)
+        r("GET", "/{index}/_refresh", self.h_refresh)
+        r("POST", "/{index}/_flush", self.h_flush)
+        r("POST", "/{index}/_forcemerge", self.h_forcemerge)
+        r("GET", "/{index}/_count", self.h_count)
+        r("POST", "/{index}/_count", self.h_count)
+        r("GET", "/{index}/_search", self.h_search)
+        r("POST", "/{index}/_search", self.h_search)
+        r("POST", "/{index}/_doc", self.h_index_doc_auto)
+        r("PUT", "/{index}/_doc/{id}", self.h_index_doc)
+        r("POST", "/{index}/_doc/{id}", self.h_index_doc)
+        r("GET", "/{index}/_doc/{id}", self.h_get_doc)
+        r("HEAD", "/{index}/_doc/{id}", self.h_doc_exists)
+        r("DELETE", "/{index}/_doc/{id}", self.h_delete_doc)
+        r("GET", "/{index}/_source/{id}", self.h_get_source)
+        r("PUT", "/{index}/_create/{id}", self.h_create_doc)
+        r("POST", "/{index}/_create/{id}", self.h_create_doc)
+        r("POST", "/{index}/_update/{id}", self.h_update_doc)
+        r("POST", "/_mget", self.h_mget)
+        r("POST", "/{index}/_mget", self.h_mget)
+        r("GET", "/{index}/_mget", self.h_mget)
+
+    # -- info / cluster ----------------------------------------------------
+
+    def h_root(self, req):
+        return 200, {
+            "name": self.node.name,
+            "cluster_name": self.node.cluster_name,
+            "cluster_uuid": self.node.cluster_uuid,
+            "version": {"number": VERSION,
+                        "distribution": "opensearch-tpu"},
+            "tagline": "The OpenSearch Project: https://opensearch.org/",
+        }
+
+    def h_cluster_health(self, req):
+        indices = self.node.indices.indices
+        unassigned = sum(s.num_replicas * s.num_shards
+                         for s in indices.values())
+        active = sum(s.num_shards for s in indices.values())
+        status = "yellow" if unassigned else "green"
+        return 200, {
+            "cluster_name": self.node.cluster_name,
+            "status": status,
+            "timed_out": False,
+            "number_of_nodes": 1,
+            "number_of_data_nodes": 1,
+            "active_primary_shards": active,
+            "active_shards": active,
+            "relocating_shards": 0,
+            "initializing_shards": 0,
+            "unassigned_shards": unassigned,
+            "delayed_unassigned_shards": 0,
+            "number_of_pending_tasks": 0,
+            "number_of_in_flight_fetch": 0,
+            "task_max_waiting_in_queue_millis": 0,
+            "active_shards_percent_as_number": 100.0,
+        }
+
+    def h_cluster_state(self, req):
+        return 200, {
+            "cluster_name": self.node.cluster_name,
+            "cluster_uuid": self.node.cluster_uuid,
+            "metadata": {"indices": {
+                name: {**svc.get_settings(), **svc.get_mapping()}
+                for name, svc in self.node.indices.indices.items()}},
+        }
+
+    def h_cluster_stats(self, req):
+        indices = self.node.indices.indices
+        return 200, {
+            "cluster_name": self.node.cluster_name,
+            "indices": {"count": len(indices),
+                        "docs": {"count": sum(s.doc_count()
+                                              for s in indices.values())}},
+            "nodes": {"count": {"total": 1, "data": 1}},
+        }
+
+    def h_nodes_info(self, req):
+        return 200, {"cluster_name": self.node.cluster_name, "nodes": {
+            self.node.node_id: {"name": self.node.name,
+                                "version": VERSION,
+                                "roles": ["cluster_manager", "data"]}}}
+
+    def h_nodes_stats(self, req):
+        indices = self.node.indices.indices
+        return 200, {"cluster_name": self.node.cluster_name, "nodes": {
+            self.node.node_id: {
+                "name": self.node.name,
+                "indices": {"docs": {"count": sum(
+                    s.doc_count() for s in indices.values())}},
+            }}}
+
+    def h_cat_indices(self, req):
+        rows = []
+        for name, svc in sorted(self.node.indices.indices.items()):
+            rows.append({"health": "green", "status": "open", "index": name,
+                         "uuid": svc.uuid, "pri": str(svc.num_shards),
+                         "rep": str(svc.num_replicas),
+                         "docs.count": str(svc.doc_count())})
+        return 200, rows
+
+    def h_cat_health(self, req):
+        h = self.h_cluster_health(req)[1]
+        return 200, [{"cluster": h["cluster_name"], "status": h["status"],
+                      "node.total": "1", "shards": str(h["active_shards"])}]
+
+    def h_cat_count(self, req):
+        total = sum(s.doc_count() for s in self.node.indices.indices.values())
+        return 200, [{"epoch": str(int(time.time())), "count": str(total)}]
+
+    def h_cat_shards(self, req):
+        rows = []
+        for name, svc in sorted(self.node.indices.indices.items()):
+            for engine in svc.shards:
+                rows.append({"index": name, "shard": str(engine.shard_id),
+                             "prirep": "p", "state": "STARTED",
+                             "docs": str(engine.doc_count())})
+        return 200, rows
+
+    # -- index admin -------------------------------------------------------
+
+    def h_create_index(self, req):
+        name = req.path_params["index"]
+        self.node.indices.create(name, req.json({}))
+        return 200, {"acknowledged": True, "shards_acknowledged": True,
+                     "index": name}
+
+    def h_delete_index(self, req):
+        for svc in self.node.indices.resolve(req.path_params["index"]):
+            self.node.indices.delete(svc.name)
+        return 200, {"acknowledged": True}
+
+    def h_get_index(self, req):
+        svc = self.node.indices.get(req.path_params["index"])
+        return 200, {svc.name: {**svc.get_mapping(), **svc.get_settings()}}
+
+    def h_index_exists(self, req):
+        if self.node.indices.exists(req.path_params["index"]):
+            return 200, {}
+        return 404, {}
+
+    def h_get_mapping(self, req):
+        svc = self.node.indices.get(req.path_params["index"])
+        return 200, {svc.name: svc.get_mapping()}
+
+    def h_get_mapping_all(self, req):
+        return 200, {name: svc.get_mapping()
+                     for name, svc in self.node.indices.indices.items()}
+
+    def h_put_mapping(self, req):
+        svc = self.node.indices.get(req.path_params["index"])
+        svc.put_mapping(req.json({}))
+        return 200, {"acknowledged": True}
+
+    def h_get_settings(self, req):
+        svc = self.node.indices.get(req.path_params["index"])
+        return 200, {svc.name: svc.get_settings()}
+
+    def h_index_stats(self, req):
+        svc = self.node.indices.get(req.path_params["index"])
+        stats = svc.stats()
+        return 200, {"_all": {"primaries": stats, "total": stats},
+                     "indices": {svc.name: {"primaries": stats,
+                                            "total": stats}}}
+
+    def h_refresh(self, req):
+        services = self._target_indices(req)
+        for svc in services:
+            svc.refresh()
+        n = sum(s.num_shards for s in services)
+        return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+    def h_flush(self, req):
+        svc = self.node.indices.get(req.path_params["index"])
+        svc.flush()
+        return 200, {"_shards": {"total": svc.num_shards,
+                                 "successful": svc.num_shards, "failed": 0}}
+
+    def h_forcemerge(self, req):
+        svc = self.node.indices.get(req.path_params["index"])
+        svc.force_merge(int(req.param("max_num_segments", 1)))
+        return 200, {"_shards": {"total": svc.num_shards,
+                                 "successful": svc.num_shards, "failed": 0}}
+
+    # -- documents ---------------------------------------------------------
+
+    def _maybe_refresh(self, svc, req):
+        refresh = req.param("refresh")
+        if refresh is not None and str(refresh).lower() in ("", "true",
+                                                            "wait_for"):
+            svc.refresh()
+
+    def h_index_doc(self, req, doc_id=None, op_type=None):
+        name = req.path_params["index"]
+        svc = self.node.indices.get_or_create(name)
+        doc_id = doc_id or req.path_params.get("id")
+        source = req.json()
+        if not isinstance(source, dict):
+            raise ParsingError("request body is required and must be a JSON "
+                               "object")
+        kw = {}
+        if req.param("if_seq_no") is not None:
+            kw["if_seq_no"] = int(req.param("if_seq_no"))
+        if req.param("if_primary_term") is not None:
+            kw["if_primary_term"] = int(req.param("if_primary_term"))
+        if req.param("version") is not None:
+            kw["version"] = int(req.param("version"))
+            kw["version_type"] = req.param("version_type", "internal")
+        if (op_type or req.param("op_type")) == "create" and doc_id is not None:
+            if svc.get_doc(doc_id, req.param("routing")) is not None:
+                from opensearch_tpu.common.errors import VersionConflictError
+                raise VersionConflictError(doc_id, "document to be absent",
+                                           "exists")
+        r = svc.index_doc(doc_id, source, routing=req.param("routing"), **kw)
+        self._maybe_refresh(svc, req)
+        status = 201 if r.result == "created" else 200
+        return status, {"_index": name, "_id": r.doc_id,
+                        "_version": r.version, "_seq_no": r.seq_no,
+                        "_primary_term": 1, "result": r.result,
+                        "_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def h_index_doc_auto(self, req):
+        return self.h_index_doc(req, doc_id=None)
+
+    def h_create_doc(self, req):
+        return self.h_index_doc(req, op_type="create")
+
+    def h_get_doc(self, req):
+        name = req.path_params["index"]
+        svc = self.node.indices.get(name)
+        doc = svc.get_doc(req.path_params["id"], req.param("routing"),
+                          realtime=req.param("realtime", "true") != "false")
+        if doc is None:
+            return 404, {"_index": name, "_id": req.path_params["id"],
+                         "found": False}
+        return 200, {"_index": name, **doc}
+
+    def h_doc_exists(self, req):
+        svc = self.node.indices.get(req.path_params["index"])
+        doc = svc.get_doc(req.path_params["id"], req.param("routing"))
+        return (200, {}) if doc is not None else (404, {})
+
+    def h_get_source(self, req):
+        name = req.path_params["index"]
+        svc = self.node.indices.get(name)
+        doc = svc.get_doc(req.path_params["id"], req.param("routing"))
+        if doc is None:
+            raise DocumentMissingError(name, req.path_params["id"])
+        return 200, doc["_source"]
+
+    def h_delete_doc(self, req):
+        name = req.path_params["index"]
+        svc = self.node.indices.get(name)
+        kw = {}
+        if req.param("if_seq_no") is not None:
+            kw["if_seq_no"] = int(req.param("if_seq_no"))
+        if req.param("if_primary_term") is not None:
+            kw["if_primary_term"] = int(req.param("if_primary_term"))
+        r = svc.delete_doc(req.path_params["id"],
+                           routing=req.param("routing"), **kw)
+        self._maybe_refresh(svc, req)
+        if r.result == "not_found":
+            return 404, {"_index": name, "_id": r.doc_id,
+                         "result": "not_found"}
+        return 200, {"_index": name, "_id": r.doc_id, "_version": r.version,
+                     "_seq_no": r.seq_no, "result": "deleted",
+                     "_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    def h_update_doc(self, req):
+        name = req.path_params["index"]
+        svc = self.node.indices.get_or_create(name)
+        body = req.json({})
+        doc_id = req.path_params["id"]
+        cur = svc.get_doc(doc_id, req.param("routing"))
+        if cur is None:
+            if "upsert" in body:
+                merged = body["upsert"]
+            elif body.get("doc_as_upsert") and "doc" in body:
+                merged = body["doc"]
+            else:
+                raise DocumentMissingError(name, doc_id)
+        else:
+            if "doc" not in body:
+                raise ValidationError("[_update] requires a [doc] or "
+                                      "[upsert] section")
+            merged = dict(cur["_source"])
+            merged.update(body["doc"])
+        r = svc.index_doc(doc_id, merged, routing=req.param("routing"))
+        self._maybe_refresh(svc, req)
+        return 200, {"_index": name, "_id": r.doc_id, "_version": r.version,
+                     "_seq_no": r.seq_no, "result": "updated"}
+
+    def h_mget(self, req):
+        body = req.json({})
+        default_index = req.path_params.get("index")
+        docs_out = []
+        for spec in body.get("docs", []) or [
+                {"_id": i} for i in body.get("ids", [])]:
+            name = spec.get("_index", default_index)
+            if name is None:
+                raise ValidationError("_mget requires an index per doc")
+            try:
+                svc = self.node.indices.get(name)
+                doc = svc.get_doc(spec["_id"], spec.get("routing"))
+            except OpenSearchTpuError:
+                doc = None
+            if doc is None:
+                docs_out.append({"_index": name, "_id": spec["_id"],
+                                 "found": False})
+            else:
+                docs_out.append({"_index": name, **doc})
+        return 200, {"docs": docs_out}
+
+    # -- bulk --------------------------------------------------------------
+
+    def h_bulk(self, req):
+        default_index = req.path_params.get("index")
+        lines = req.raw_body.split(b"\n")
+        ops_by_index: dict[str, list] = {}
+        order: list[tuple[str, int]] = []
+        i = 0
+        while i < len(lines):
+            line = lines[i].strip()
+            i += 1
+            if not line:
+                continue
+            try:
+                action_line = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ParsingError(f"malformed action/metadata line: {e}")
+            if len(action_line) != 1:
+                raise ParsingError("action/metadata line must contain a "
+                                   "single action")
+            action, meta = next(iter(action_line.items()))
+            if action not in ("index", "create", "delete", "update"):
+                raise ParsingError(f"unknown bulk action [{action}]")
+            name = meta.get("_index", default_index)
+            if name is None:
+                raise ValidationError("bulk item requires _index")
+            source = None
+            if action != "delete":
+                if i >= len(lines):
+                    raise ParsingError("bulk request ends with an action "
+                                       "line and no source")
+                try:
+                    source = json.loads(lines[i])
+                except json.JSONDecodeError as e:
+                    raise ParsingError(f"malformed bulk source line: {e}")
+                i += 1
+            bucket = ops_by_index.setdefault(name, [])
+            order.append((name, len(bucket)))
+            bucket.append((action, meta.get("_id"), source,
+                           {"routing": meta.get("routing",
+                                                meta.get("_routing"))}))
+        results_by_index = {}
+        t0 = time.monotonic()
+        for name, ops in ops_by_index.items():
+            svc = self.node.indices.get_or_create(name)
+            results_by_index[name] = svc.bulk(ops)
+            if req.param("refresh") in ("", "true", "wait_for"):
+                svc.refresh()
+        items = [results_by_index[name][j] for name, j in order]
+        errors = any(next(iter(it.values())).get("error") for it in items)
+        return 200, {"took": int((time.monotonic() - t0) * 1000),
+                     "errors": errors, "items": items}
+
+    # -- search ------------------------------------------------------------
+
+    def _target_indices(self, req) -> list:
+        expr = req.path_params.get("index")
+        if expr is None:
+            return list(self.node.indices.indices.values())
+        return self.node.indices.resolve(expr)
+
+    def h_search(self, req):
+        body = req.json({}) or {}
+        # URI-search support: ?q=field:value
+        q = req.param("q")
+        if q:
+            if ":" in q:
+                field, _, text = q.partition(":")
+                body.setdefault("query", {"match": {field: text}})
+            else:
+                body.setdefault("query", {"simple_query_string": {"query": q}})
+        if req.param("size") is not None:
+            body["size"] = int(req.param("size"))
+        if req.param("from") is not None:
+            body["from"] = int(req.param("from"))
+        services = self._target_indices(req)
+        if not services:
+            # allow_no_indices=true default: empty result, not an error
+            return 200, {"took": 0, "timed_out": False,
+                         "_shards": {"total": 0, "successful": 0,
+                                     "skipped": 0, "failed": 0},
+                         "hits": {"total": {"value": 0, "relation": "eq"},
+                                  "max_score": None, "hits": []}}
+        if len(services) == 1:
+            return 200, services[0].search(body)
+        if body.get("aggs") or body.get("aggregations"):
+            raise ValidationError(
+                "aggregations across multiple indices are not supported yet"
+                " — target a single index")
+        return 200, self._multi_index_search(services, body)
+
+    def _multi_index_search(self, services, body):
+        """Coordinator merge over several indices (scores are per-index,
+        like cross-index query_then_fetch in the reference)."""
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        sub = dict(body)
+        sub["from"] = 0
+        sub["size"] = from_ + size
+        responses = [svc.search(sub) for svc in services]
+        all_hits = []
+        for resp in responses:
+            all_hits.extend(resp["hits"]["hits"])
+        if body.get("sort") is None:
+            all_hits.sort(key=lambda h: (-(h["_score"] or 0), h["_index"]))
+        total = sum(r["hits"]["total"]["value"] for r in responses)
+        max_score = max((r["hits"]["max_score"] or float("-inf")
+                         for r in responses), default=None)
+        shards = sum(r["_shards"]["total"] for r in responses)
+        return {
+            "took": max(r["took"] for r in responses),
+            "timed_out": False,
+            "_shards": {"total": shards, "successful": shards, "skipped": 0,
+                        "failed": 0},
+            "hits": {"total": {"value": total, "relation": "eq"},
+                     "max_score": (None if max_score in (None, float("-inf"))
+                                   else max_score),
+                     "hits": all_hits[from_: from_ + size]},
+        }
+
+    def h_count(self, req):
+        body = req.json({}) or {}
+        services = self._target_indices(req)
+        total = sum(svc.count(body.get("query")) for svc in services)
+        return 200, {"count": total,
+                     "_shards": {"total": len(services),
+                                 "successful": len(services), "skipped": 0,
+                                 "failed": 0}}

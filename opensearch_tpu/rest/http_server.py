@@ -1,0 +1,80 @@
+"""Threaded HTTP front-end for the REST controller.
+
+Analog of the netty4 HTTP transport (modules/transport-netty4/...
+Netty4HttpServerTransport.java) at the fidelity this slice needs: a
+thread-per-connection stdlib server handing parsed (method, path, params,
+body) to ``RestController.dispatch``.  _cat endpoints render text tables
+unless ``format=json`` (rest/action/cat/ behavior).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, urlsplit
+
+
+def _cat_table(rows: list[dict], want_header: bool) -> bytes:
+    if not rows:
+        return b""
+    cols = list(rows[0])
+    widths = {c: max(len(c) if want_header else 0,
+                     *(len(str(r.get(c, ""))) for r in rows)) for c in cols}
+    out = []
+    if want_header:
+        out.append(" ".join(c.ljust(widths[c]) for c in cols).rstrip())
+    for r in rows:
+        out.append(" ".join(str(r.get(c, "")).ljust(widths[c])
+                            for c in cols).rstrip())
+    return ("\n".join(out) + "\n").encode()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "opensearch-tpu"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _handle(self):
+        split = urlsplit(self.path)
+        params = dict(parse_qsl(split.query, keep_blank_values=True))
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        status, payload = self.server.controller.dispatch(
+            self.command, split.path, params, body)
+        is_cat = split.path.startswith("/_cat") and params.get("format") != "json"
+        if is_cat and isinstance(payload, list):
+            data = _cat_table(payload, want_header="v" in params)
+            ctype = "text/plain; charset=UTF-8"
+        else:
+            data = (json.dumps(payload) + "\n").encode()
+            ctype = "application/json; charset=UTF-8"
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(data)
+
+    do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _handle
+
+
+class HttpServer:
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 9200):
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.controller = controller
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="http-server", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

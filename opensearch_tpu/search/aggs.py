@@ -159,7 +159,15 @@ class AggregationExecutor:
         field = req.params.get("field")
         if field is None:
             raise ParsingError(f"[{caller}] aggregation requires a [field]")
-        return field, self.ctx.field_type(field)
+        ft = self.ctx.field_type(field)
+        if ft is not None and ft.dv_kind == "none":
+            raise IllegalArgumentError(
+                f"Text fields are not optimised for operations that require "
+                f"per-document field data like aggregations and sorting, so "
+                f"these operations are disabled by default. Please use a "
+                f"keyword field instead. Alternatively, set fielddata=true "
+                f"on [{field}]")
+        return field, ft
 
     def _numeric_column(self, seg, field):
         return seg.numeric_dv.get(field)
@@ -396,12 +404,14 @@ class AggregationExecutor:
             m = np.asarray(matched)
             ok = m[dv.value_docs]
             vals, docs = dv.values[ok], dv.value_docs[ok]
-            # docs count once per distinct value
-            pairs = np.unique(np.stack([vals.astype(np.float64),
-                                        docs.astype(np.float64)]), axis=1)
+            # docs count once per distinct value; keep the native dtype for
+            # the dedup — a float64 cast would collapse longs above 2^53
+            pair_dtype = np.int64 if dv.kind == "long" else np.float64
+            pairs = np.unique(np.stack([vals.astype(pair_dtype),
+                                        docs.astype(pair_dtype)]), axis=1)
             uniq_vals, counts = np.unique(pairs[0], return_counts=True)
             for v, c in zip(uniq_vals, counts):
-                key = v if dv.kind == "double" else int(v)
+                key = float(v) if dv.kind == "double" else int(v)
                 merged[key] = merged.get(key, 0) + int(c)
             for sub in subs:
                 sf, _sft = self._field_type(sub, sub.type)

@@ -1,0 +1,272 @@
+"""End-to-end REST slice over real HTTP: index lifecycle, _bulk, CRUD,
+_search (+aggs, sort), _count, _cluster/health, _cat (the reference's
+rest-api-spec YAML-test shapes, VERDICT round-1 item 5)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = Node(str(tmp_path_factory.mktemp("node")), port=0).start()
+    yield n
+    n.stop()
+
+
+def call(node, method, path, body=None, ndjson=None, raw=False):
+    url = f"http://127.0.0.1:{node.port}{path}"
+    data = None
+    headers = {}
+    if ndjson is not None:
+        data = ("\n".join(json.dumps(l) for l in ndjson) + "\n").encode()
+        headers["Content-Type"] = "application/x-ndjson"
+    elif body is not None:
+        data = json.dumps(body).encode()
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            payload = resp.read()
+            return resp.status, (payload if raw else
+                                 json.loads(payload) if payload else {})
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, (payload if raw else
+                        json.loads(payload) if payload else {})
+
+
+def test_root_and_health(node):
+    status, body = call(node, "GET", "/")
+    assert status == 200 and body["version"]["distribution"] == "opensearch-tpu"
+    status, body = call(node, "GET", "/_cluster/health")
+    assert status == 200 and body["status"] in ("green", "yellow")
+
+
+def test_index_lifecycle(node):
+    status, body = call(node, "PUT", "/books", {
+        "settings": {"number_of_shards": 2},
+        "mappings": {"properties": {
+            "title": {"type": "text"},
+            "year": {"type": "integer"},
+            "genre": {"type": "keyword"}}}})
+    assert status == 200 and body["acknowledged"]
+    status, _ = call(node, "HEAD", "/books")
+    assert status == 200
+    status, body = call(node, "PUT", "/books", {})
+    assert status == 400 and "exists" in json.dumps(body)
+    status, body = call(node, "GET", "/books/_mapping")
+    assert body["books"]["mappings"]["properties"]["title"]["type"] == "text"
+    status, body = call(node, "GET", "/books/_settings")
+    assert body["books"]["settings"]["index"]["number_of_shards"] == "2"
+
+
+def test_doc_crud(node):
+    call(node, "PUT", "/crud", {})
+    status, body = call(node, "PUT", "/crud/_doc/1", {"x": 1})
+    assert status == 201 and body["result"] == "created"
+    status, body = call(node, "PUT", "/crud/_doc/1", {"x": 2})
+    assert status == 200 and body["result"] == "updated" and body["_version"] == 2
+    status, body = call(node, "GET", "/crud/_doc/1")
+    assert status == 200 and body["_source"] == {"x": 2}
+    status, body = call(node, "GET", "/crud/_source/1")
+    assert body == {"x": 2}
+    # op_type=create conflicts on existing
+    status, body = call(node, "PUT", "/crud/_create/1", {"x": 3})
+    assert status == 409
+    # optimistic concurrency
+    status, body = call(node, "PUT", "/crud/_doc/1?if_seq_no=999&if_primary_term=1",
+                        {"x": 9})
+    assert status == 409
+    # update API
+    status, body = call(node, "POST", "/crud/_update/1", {"doc": {"y": 5}})
+    assert status == 200
+    _, body = call(node, "GET", "/crud/_doc/1")
+    assert body["_source"] == {"x": 2, "y": 5}
+    status, body = call(node, "DELETE", "/crud/_doc/1")
+    assert status == 200 and body["result"] == "deleted"
+    status, body = call(node, "GET", "/crud/_doc/1")
+    assert status == 404 and body["found"] is False
+    status, body = call(node, "GET", "/crud/_doc/nope")
+    assert status == 404
+
+
+def test_bulk_and_search(node):
+    call(node, "PUT", "/library", {"mappings": {"properties": {
+        "title": {"type": "text"}, "year": {"type": "integer"},
+        "genre": {"type": "keyword"}}}})
+    lines = []
+    docs = [
+        {"title": "the old man and the sea", "year": 1952, "genre": "fiction"},
+        {"title": "war and peace", "year": 1869, "genre": "fiction"},
+        {"title": "a brief history of time", "year": 1988, "genre": "science"},
+        {"title": "the selfish gene", "year": 1976, "genre": "science"},
+        {"title": "sea of tranquility", "year": 2022, "genre": "fiction"},
+    ]
+    for i, d in enumerate(docs):
+        lines.append({"index": {"_index": "library", "_id": str(i)}})
+        lines.append(d)
+    lines.append({"delete": {"_index": "library", "_id": "99"}})
+    status, body = call(node, "POST", "/_bulk?refresh=true", ndjson=lines)
+    assert status == 200
+    assert body["errors"] is False or body["items"][-1]["delete"]["status"] == 404
+    assert [it["index"]["status"] for it in body["items"][:5]] == [201] * 5
+
+    status, body = call(node, "POST", "/library/_search", {
+        "query": {"match": {"title": "sea"}}})
+    assert status == 200
+    ids = {h["_id"] for h in body["hits"]["hits"]}
+    assert ids == {"0", "4"}
+
+    status, body = call(node, "POST", "/library/_search", {
+        "size": 0,
+        "aggs": {"genres": {"terms": {"field": "genre"}},
+                 "years": {"stats": {"field": "year"}}}})
+    genres = {b["key"]: b["doc_count"]
+              for b in body["aggregations"]["genres"]["buckets"]}
+    assert genres == {"fiction": 3, "science": 2}
+    assert body["aggregations"]["years"]["min"] == 1869
+
+    status, body = call(node, "GET", "/library/_search?q=title:gene")
+    assert body["hits"]["total"]["value"] == 1
+
+    status, body = call(node, "POST", "/library/_search", {
+        "sort": [{"year": "asc"}], "size": 2})
+    assert [h["_id"] for h in body["hits"]["hits"]] == ["1", "0"]
+
+    status, body = call(node, "POST", "/library/_count",
+                        {"query": {"term": {"genre": "science"}}})
+    assert body["count"] == 2
+
+
+def test_bulk_partial_errors(node):
+    lines = [
+        {"index": {"_index": "mixed", "_id": "1"}},
+        {"n": 1},
+        {"index": {"_index": "mixed", "_id": "2"}},
+        {"n": "not-a-number-for-long-field"},
+    ]
+    call(node, "PUT", "/mixed",
+         {"mappings": {"properties": {"n": {"type": "long"}}}})
+    status, body = call(node, "POST", "/_bulk?refresh=true", ndjson=lines)
+    assert status == 200
+    assert body["errors"] is True
+    assert body["items"][0]["index"]["status"] == 201
+    assert body["items"][1]["index"]["status"] == 400
+    assert "error" in body["items"][1]["index"]
+
+
+def test_multi_index_search(node):
+    call(node, "PUT", "/multi_a", {})
+    call(node, "PUT", "/multi_b", {})
+    call(node, "PUT", "/multi_a/_doc/1?refresh=true", {"t": "apple pie"})
+    call(node, "PUT", "/multi_b/_doc/2?refresh=true", {"t": "apple juice"})
+    status, body = call(node, "POST", "/multi_a,multi_b/_search",
+                        {"query": {"match": {"t": "apple"}}})
+    assert body["hits"]["total"]["value"] == 2
+    idx = {h["_index"] for h in body["hits"]["hits"]}
+    assert idx == {"multi_a", "multi_b"}
+    status, body = call(node, "POST", "/multi_*/_search",
+                        {"query": {"match_all": {}}})
+    assert body["hits"]["total"]["value"] == 2
+
+
+def test_mget(node):
+    call(node, "PUT", "/mg", {})
+    call(node, "PUT", "/mg/_doc/a", {"v": 1})
+    call(node, "PUT", "/mg/_doc/b", {"v": 2})
+    status, body = call(node, "POST", "/_mget", {"docs": [
+        {"_index": "mg", "_id": "a"}, {"_index": "mg", "_id": "zz"}]})
+    assert body["docs"][0]["_source"] == {"v": 1}
+    assert body["docs"][1]["found"] is False
+
+
+def test_cat_and_stats(node):
+    status, text = call(node, "GET", "/_cat/indices?v", raw=True)
+    assert status == 200
+    assert b"health" in text and b"library" in text
+    status, body = call(node, "GET", "/_cat/indices?format=json")
+    assert isinstance(body, list) and any(r["index"] == "library" for r in body)
+    status, body = call(node, "GET", "/library/_stats")
+    assert body["_all"]["primaries"]["docs"]["count"] == 5
+    status, body = call(node, "GET", "/_nodes/stats")
+    assert status == 200
+
+
+def test_error_shapes(node):
+    status, body = call(node, "GET", "/missing_index/_search", {})
+    assert status == 404
+    assert body["error"]["type"] == "index_not_found_error"
+    status, body = call(node, "POST", "/library/_search",
+                        {"query": {"bogus": {}}})
+    assert status == 400
+    assert body["error"]["type"] == "parsing_error"
+    status, body = call(node, "DELETE", "/")
+    assert status in (400, 405)
+
+
+def test_forcemerge_and_flush(node):
+    for i in range(6):
+        call(node, "PUT", f"/fm/_doc/{i}?refresh=true", {"n": i})
+    status, body = call(node, "POST", "/fm/_forcemerge?max_num_segments=1")
+    assert status == 200
+    status, body = call(node, "POST", "/fm/_flush")
+    assert status == 200
+    status, body = call(node, "GET", "/fm/_count")
+    assert body["count"] == 6
+
+
+def test_persistence_across_restart(tmp_path):
+    n1 = Node(str(tmp_path), port=0).start()
+    call(n1, "PUT", "/persist",
+         {"mappings": {"properties": {"k": {"type": "keyword"}}}})
+    call(n1, "PUT", "/persist/_doc/1?refresh=true", {"k": "v"})
+    call(n1, "POST", "/persist/_flush")
+    call(n1, "PUT", "/persist/_doc/2", {"k": "w"})   # translog only
+    n1.stop()
+
+    n2 = Node(str(tmp_path), port=0).start()
+    status, body = call(n2, "GET", "/persist/_doc/1")
+    assert status == 200 and body["_source"] == {"k": "v"}
+    status, body = call(n2, "GET", "/persist/_doc/2")
+    assert status == 200 and body["_source"] == {"k": "w"}
+    call(n2, "POST", "/persist/_refresh")
+    status, body = call(n2, "GET", "/persist/_count")
+    assert body["count"] == 2
+    n2.stop()
+
+
+def test_dynamic_mapping_survives_flush_and_restart(tmp_path):
+    """Dynamically-added fields must be queryable after flush + restart
+    (the translog can no longer re-derive them once trimmed)."""
+    n1 = Node(str(tmp_path), port=0).start()
+    call(n1, "PUT", "/dyn", {})
+    call(n1, "PUT", "/dyn/_doc/1?refresh=true", {"price": 42, "tag": "x"})
+    call(n1, "POST", "/dyn/_flush")
+    n1.stop()
+
+    n2 = Node(str(tmp_path), port=0).start()
+    status, body = call(n2, "GET", "/dyn/_mapping")
+    props = body["dyn"]["mappings"]["properties"]
+    assert props["price"]["type"] == "long"
+    status, body = call(n2, "POST", "/dyn/_search",
+                        {"query": {"range": {"price": {"gte": 40}}}})
+    assert body["hits"]["total"]["value"] == 1
+    status, body = call(n2, "POST", "/dyn/_search",
+                        {"query": {"term": {"tag.keyword": "x"}}})
+    assert body["hits"]["total"]["value"] == 1
+    n2.stop()
+
+
+def test_search_empty_node_and_no_match_wildcard(tmp_path):
+    n = Node(str(tmp_path), port=0).start()
+    status, body = call(n, "POST", "/_search", {"query": {"match_all": {}}})
+    assert status == 200 and body["hits"]["total"]["value"] == 0
+    status, body = call(n, "POST", "/nomatch-*/_search", {})
+    assert status == 200 and body["hits"]["hits"] == []
+    n.stop()
