@@ -1,0 +1,2 @@
+from opensearch_tpu.cluster.state import ClusterState  # noqa: F401
+from opensearch_tpu.cluster.coordination import Coordinator  # noqa: F401

@@ -1,0 +1,410 @@
+"""Cluster coordination: pre-vote, term-based election, joins, two-phase
+state publication, leader/follower failure detection.
+
+Analog of ``cluster/coordination/Coordinator.java`` (startElection :499,
+handleJoinRequest :575, becomeLeader :697, publish :1246) +
+``PreVoteCollector`` / ``JoinHelper`` / ``Publication`` /
+``LeaderChecker`` / ``FollowersChecker`` — the Zen2 protocol at its
+correctness core:
+
+- a candidate pre-votes (am I electable? is my state fresh enough?) then
+  increments its term and solicits joins; a majority of the voting
+  configuration makes it leader for that term;
+- the leader publishes state as phase-1 PUBLISH (followers validate the
+  term, persist as *accepted*, ack) and phase-2 COMMIT once a majority
+  acked — committed states apply on every node;
+- a node that sees a higher term steps down to candidate;
+- followers check the leader (and the leader its followers) with periodic
+  pings; repeated failures trigger elections / node removal.
+
+The voting configuration is the initial master-eligible node set (static;
+the reference's dynamic reconfiguration is orthogonal to the protocol
+spine).  All timers are injectable so tests can drive the protocol
+deterministically (the DisruptableMockTransport technique, SURVEY §4.3).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from enum import Enum
+from typing import Callable, Optional
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+from opensearch_tpu.cluster.state import ClusterState, allocate_shards
+from opensearch_tpu.transport.service import TransportService
+
+PREVOTE = "internal:cluster/coordination/prevote"
+JOIN = "internal:cluster/coordination/join"
+PUBLISH = "internal:cluster/coordination/publish"
+COMMIT = "internal:cluster/coordination/commit"
+LEADER_CHECK = "internal:cluster/coordination/leader_check"
+FOLLOWER_CHECK = "internal:cluster/coordination/follower_check"
+
+
+class CoordinationError(OpenSearchTpuError):
+    status = 500
+
+
+class FailedToCommitError(CoordinationError):
+    pass
+
+
+class Mode(Enum):
+    CANDIDATE = "CANDIDATE"
+    LEADER = "LEADER"
+    FOLLOWER = "FOLLOWER"
+
+
+class Coordinator:
+    def __init__(self, node_id: str, transport: TransportService,
+                 voting_nodes: list[str], node_info: Optional[dict] = None,
+                 on_apply: Optional[Callable[[ClusterState], None]] = None,
+                 check_interval: float = 1.0, check_retries: int = 3):
+        self.node_id = node_id
+        self.transport = transport
+        self.voting_nodes = sorted(voting_nodes)
+        self.node_info = node_info or {"name": node_id}
+        self.on_apply = on_apply
+        self.check_interval = check_interval
+        self.check_retries = check_retries
+
+        self.mode = Mode.CANDIDATE
+        self.current_term = 0
+        self.last_join_term = 0         # highest term we voted (joined) in
+        self.accepted: ClusterState = ClusterState()
+        self.committed: ClusterState = ClusterState()
+        self._lock = threading.RLock()
+        # serializes compute+publish end-to-end (MasterService single
+        # thread analog) — without it two concurrent updates both build
+        # version+1 and the loser's failed quorum demotes a healthy leader
+        self._update_lock = threading.Lock()
+        self._check_failures: dict[str, int] = {}
+        self._stopped = False
+        self._timer: Optional[threading.Timer] = None
+
+        t = transport
+        t.register_handler(PREVOTE, self._on_prevote)
+        t.register_handler(JOIN, self._on_join)
+        t.register_handler(PUBLISH, self._on_publish)
+        t.register_handler(COMMIT, self._on_commit)
+        t.register_handler(LEADER_CHECK, self._on_leader_check)
+        t.register_handler(FOLLOWER_CHECK, self._on_follower_check)
+
+    # -- helpers ----------------------------------------------------------
+
+    def _majority(self) -> int:
+        return len(self.voting_nodes) // 2 + 1
+
+    def is_leader(self) -> bool:
+        return self.mode == Mode.LEADER
+
+    def state(self) -> ClusterState:
+        with self._lock:
+            return self.committed
+
+    # -- election ---------------------------------------------------------
+
+    def start_election(self) -> bool:
+        """Pre-vote, then solicit joins for term+1.  Returns True if this
+        node became leader."""
+        with self._lock:
+            if self._stopped or self.mode == Mode.LEADER:
+                return self.mode == Mode.LEADER
+            my_term = self.current_term
+            my_version = self.accepted.version
+        grants = 1
+        for peer in self.voting_nodes:
+            if peer == self.node_id:
+                continue
+            try:
+                r = self.transport.send_request(
+                    peer, PREVOTE,
+                    {"term": my_term, "version": my_version,
+                     "source": self.node_id}, timeout=2.0)
+                if r.get("granted"):
+                    grants += 1
+            except OpenSearchTpuError:
+                continue
+        if grants < self._majority():
+            return False
+
+        with self._lock:
+            new_term = self.current_term + 1
+            self.current_term = new_term
+            self.last_join_term = new_term   # vote for ourselves
+            state_term = self.accepted.term
+            state_version = self.accepted.version
+        joins = 1
+        joiners: dict[str, dict] = {}
+        for peer in self.voting_nodes:
+            if peer == self.node_id:
+                continue
+            try:
+                r = self.transport.send_request(
+                    peer, JOIN, {"term": new_term, "source": self.node_id,
+                                 "state_term": state_term,
+                                 "state_version": state_version},
+                    timeout=2.0)
+                if r.get("joined"):
+                    joins += 1
+                    joiners[peer] = r.get("info") or {"name": peer}
+            except OpenSearchTpuError:
+                continue
+        if joins < self._majority():
+            return False
+        return self._become_leader(new_term, joiners)
+
+    def _become_leader(self, term: int, joiners: dict[str, dict]) -> bool:
+        with self._lock:
+            if self.current_term != term or self._stopped:
+                return False
+            self.mode = Mode.LEADER
+            self._check_failures.clear()
+            base = (self.accepted
+                    if self.accepted.is_newer_than(self.committed)
+                    else self.committed)
+            nodes = dict(base.nodes)
+            nodes[self.node_id] = self.node_info
+            nodes.update(joiners)
+            first = base.with_(term=term, version=base.version + 1,
+                               master_node=self.node_id, nodes=nodes)
+        try:
+            self.publish(first)
+        except FailedToCommitError:
+            with self._lock:
+                self.mode = Mode.CANDIDATE
+            return False
+        self._schedule_checks()
+        return True
+
+    def _on_prevote(self, payload: dict) -> dict:
+        with self._lock:
+            # freshness is judged against our ACCEPTED state: a committed
+            # version exists on a majority as *accepted*, so gating on
+            # accepted is what makes committed states survive elections
+            ours = (self.accepted.term, self.accepted.version)
+            theirs = (payload["term"], payload["version"])
+            granted = theirs >= ours and (self.mode != Mode.FOLLOWER
+                                          or not self._leader_alive())
+            return {"granted": bool(granted)}
+
+    def _leader_alive(self) -> bool:
+        return (self.committed.master_node is not None
+                and self._check_failures.get(
+                    self.committed.master_node, 0) < self.check_retries)
+
+    def _on_join(self, payload: dict) -> dict:
+        with self._lock:
+            term = payload["term"]
+            if term <= self.last_join_term:
+                return {"joined": False, "term": self.current_term}
+            # same accepted-state gate as the prevote: never vote for a
+            # candidate whose state is older than what we accepted — a
+            # committed state lives on a majority as accepted, so a stale
+            # candidate cannot reach quorum (leader completeness)
+            theirs = (payload.get("state_term", 0),
+                      payload.get("state_version", 0))
+            if theirs < (self.accepted.term, self.accepted.version):
+                return {"joined": False, "term": self.current_term}
+            self.last_join_term = term
+            if term > self.current_term:
+                self.current_term = term
+                if self.mode == Mode.LEADER:
+                    self.mode = Mode.CANDIDATE
+            return {"joined": True, "info": self.node_info}
+
+    # -- node membership (leader side) ------------------------------------
+
+    def add_node(self, node_id: str, info: dict):
+        """Leader: admit a (data) node into the cluster state."""
+        def update(state: ClusterState) -> ClusterState:
+            nodes = dict(state.nodes)
+            nodes[node_id] = info
+            return allocate_shards(state.with_(nodes=nodes))
+        self.submit_state_update(update)
+
+    def remove_node(self, node_id: str):
+        def update(state: ClusterState) -> ClusterState:
+            if node_id not in state.nodes:
+                return state
+            nodes = dict(state.nodes)
+            del nodes[node_id]
+            return allocate_shards(state.with_(nodes=nodes))
+        self.submit_state_update(update)
+
+    # -- publication ------------------------------------------------------
+
+    def submit_state_update(self, fn: Callable[[ClusterState], ClusterState]):
+        """Leader-only, serialized (MasterService.runTasks analog)."""
+        with self._update_lock:
+            with self._lock:
+                if self.mode != Mode.LEADER:
+                    raise CoordinationError(
+                        f"[{self.node_id}] is not the elected cluster manager")
+                new_state = fn(self.committed)
+                if new_state is self.committed:
+                    return self.committed
+                new_state = new_state.with_(
+                    term=self.current_term,
+                    version=self.committed.version + 1,
+                    master_node=self.node_id)
+            self.publish(new_state)
+        return new_state
+
+    def publish(self, state: ClusterState):
+        """Two-phase: PUBLISH to every node in the state, COMMIT after a
+        majority of VOTING nodes acked (Publication.java)."""
+        payload = state.to_payload()
+        targets = [n for n in state.nodes if n != self.node_id]
+        ok_nodes = []
+        local = self._on_publish({"state": payload})   # accept locally first
+        acks = (1 if (local.get("accepted")
+                      and self.node_id in self.voting_nodes) else 0)
+        for peer in targets:
+            try:
+                r = self.transport.send_request(peer, PUBLISH,
+                                                {"state": payload},
+                                                timeout=5.0)
+                if r.get("accepted"):
+                    ok_nodes.append(peer)
+                    if peer in self.voting_nodes:
+                        acks += 1
+            except OpenSearchTpuError:
+                continue
+        if acks < self._majority():
+            with self._lock:
+                self.mode = Mode.CANDIDATE
+            raise FailedToCommitError(
+                f"publication of term {state.term} version {state.version} "
+                f"got {acks}/{self._majority()} votes")
+        self._on_commit({"term": state.term, "version": state.version})
+        for peer in ok_nodes:
+            try:
+                self.transport.send_request(
+                    peer, COMMIT,
+                    {"term": state.term, "version": state.version},
+                    timeout=5.0)
+            except OpenSearchTpuError:
+                continue
+
+    def _on_publish(self, payload: dict) -> dict:
+        state = ClusterState.from_payload(payload["state"])
+        with self._lock:
+            if state.term < self.current_term:
+                return {"accepted": False, "term": self.current_term}
+            if (state.term, state.version) <= (self.accepted.term,
+                                               self.accepted.version):
+                return {"accepted": False, "term": self.current_term}
+            self.current_term = max(self.current_term, state.term)
+            self.accepted = state
+            if state.master_node != self.node_id:
+                self.mode = Mode.FOLLOWER
+                self._check_failures.clear()
+            return {"accepted": True}
+
+    def _on_commit(self, payload: dict) -> dict:
+        with self._lock:
+            if (self.accepted.term == payload["term"]
+                    and self.accepted.version == payload["version"]
+                    and self.accepted.is_newer_than(self.committed)):
+                self.committed = self.accepted
+                apply_cb = self.on_apply
+                state = self.committed
+            else:
+                return {"applied": False}
+        if apply_cb is not None:
+            apply_cb(state)
+        return {"applied": True}
+
+    # -- failure detection ------------------------------------------------
+
+    def _on_leader_check(self, payload: dict) -> dict:
+        # follower asks: are you still my leader?
+        with self._lock:
+            return {"leader": self.mode == Mode.LEADER,
+                    "term": self.current_term}
+
+    def _on_follower_check(self, payload: dict) -> dict:
+        # leader asks follower: still following me in this term?
+        with self._lock:
+            ok = (payload["term"] == self.current_term
+                  and self.mode == Mode.FOLLOWER)
+            return {"ok": ok}
+
+    def run_checks_once(self):
+        """One failure-detection round (scheduled repeatedly in production,
+        callable directly in deterministic tests)."""
+        with self._lock:
+            mode = self.mode
+            state = self.committed
+            term = self.current_term
+        if mode == Mode.LEADER:
+            for peer in [n for n in state.nodes if n != self.node_id]:
+                try:
+                    r = self.transport.send_request(
+                        peer, FOLLOWER_CHECK, {"term": term}, timeout=2.0)
+                    ok = r.get("ok")
+                except OpenSearchTpuError:
+                    ok = False
+                if ok:
+                    self._check_failures.pop(peer, None)
+                else:
+                    n = self._check_failures.get(peer, 0) + 1
+                    self._check_failures[peer] = n
+                    if n >= self.check_retries:
+                        self._check_failures.pop(peer, None)
+                        try:
+                            self.remove_node(peer)
+                        except CoordinationError:
+                            pass
+        elif mode == Mode.FOLLOWER and state.master_node:
+            leader = state.master_node
+            try:
+                r = self.transport.send_request(leader, LEADER_CHECK, {},
+                                                timeout=2.0)
+                ok = r.get("leader")
+            except OpenSearchTpuError:
+                ok = False
+            if ok:
+                self._check_failures.pop(leader, None)
+            else:
+                n = self._check_failures.get(leader, 0) + 1
+                self._check_failures[leader] = n
+                if n >= self.check_retries:
+                    with self._lock:
+                        self.mode = Mode.CANDIDATE
+                    self.start_election()
+        elif mode == Mode.CANDIDATE:
+            self.start_election()
+
+    def _schedule_checks(self):
+        if self._stopped:
+            return
+        with self._lock:
+            if self._timer is not None:
+                return
+        self._tick()
+
+    def _tick(self):
+        if self._stopped:
+            return
+        try:
+            self.run_checks_once()
+        except Exception:
+            pass
+        jitter = self.check_interval * (1.0 + random.random() * 0.2)
+        self._timer = threading.Timer(jitter, self._tick)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def start(self):
+        """Begin periodic failure detection + candidate elections."""
+        self._schedule_checks()
+
+    def stop(self):
+        self._stopped = True
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None

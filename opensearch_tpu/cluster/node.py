@@ -1,0 +1,351 @@
+"""ClusterNode: a full cluster member — coordinator + transport +
+shard-subset indices + distributed document/search actions.
+
+Analog of the action layer (L6) on the cluster runtime (L4):
+
+- index admin ops proxy to the elected cluster-manager, which mutates the
+  cluster state and publishes (TransportCreateIndexAction ->
+  MetadataCreateIndexService -> MasterService, call stack SURVEY §3.4);
+- applied states create/remove LOCAL shards per the routing table
+  (indices/cluster/IndicesClusterStateService.java);
+- document ops route by murmur3 to the owning node
+  (TransportBulkAction :213 grouping / OperationRouting);
+- search scatter-gathers: shards grouped per node, one RPC each, host
+  merge of top-k (AbstractSearchAsyncAction :223 + SearchPhaseController
+  merge).  Per-shard scoring stats, like the reference's default
+  query_then_fetch.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from typing import Optional
+
+from opensearch_tpu.search.executor import _parse_sort, _sort_comparator
+
+from opensearch_tpu.common.errors import (
+    IndexNotFoundError,
+    OpenSearchTpuError,
+    ShardNotFoundError,
+)
+from opensearch_tpu.cluster.coordination import CoordinationError, Coordinator
+from opensearch_tpu.cluster.state import ClusterState, allocate_shards
+from opensearch_tpu.indices.service import IndexService
+from opensearch_tpu.transport.service import TransportService
+
+A_CREATE_INDEX = "cluster:admin/index/create"
+A_DELETE_INDEX = "cluster:admin/index/delete"
+A_WRITE_SHARD = "indices:data/write/shard"
+A_GET_DOC = "indices:data/read/get"
+A_SEARCH_SHARDS = "indices:data/read/search[shards]"
+A_REFRESH = "indices:admin/refresh"
+
+
+class NoMasterError(CoordinationError):
+    status = 503
+
+
+class ClusterNode:
+    def __init__(self, node_id: str, data_path: str,
+                 transport: TransportService, voting_nodes: list[str]):
+        self.node_id = node_id
+        self.data_path = data_path
+        os.makedirs(data_path, exist_ok=True)
+        self.transport = transport
+        self.indices: dict[str, IndexService] = {}
+        self._lock = threading.RLock()
+        self.coordinator = Coordinator(
+            node_id, transport, voting_nodes,
+            node_info={"name": node_id}, on_apply=self._apply_state)
+        t = transport
+        t.register_handler(A_CREATE_INDEX, self._h_create_index)
+        t.register_handler(A_DELETE_INDEX, self._h_delete_index)
+        t.register_handler(A_WRITE_SHARD, self._h_write_shard)
+        t.register_handler(A_GET_DOC, self._h_get_doc)
+        t.register_handler(A_SEARCH_SHARDS, self._h_search_shards)
+        t.register_handler(A_REFRESH, self._h_refresh)
+
+    # -- state application (IndicesClusterStateService analog) ------------
+
+    def _apply_state(self, state: ClusterState):
+        with self._lock:
+            for index, meta in state.indices.items():
+                routing = state.routing.get(index, [])
+                mine = [s for s, owner in enumerate(routing)
+                        if owner == self.node_id]
+                svc = self.indices.get(index)
+                if svc is None:
+                    if mine:
+                        self.indices[index] = IndexService(
+                            index, os.path.join(self.data_path, index),
+                            dict(meta.get("settings") or {}),
+                            meta.get("mappings"), local_shard_ids=mine)
+                else:
+                    want = set(mine)
+                    have = set(svc.local_shards)
+                    for s in want - have:
+                        svc.add_local_shard(s)
+                    for s in have - want:
+                        svc.remove_local_shard(s)
+            for index in list(self.indices):
+                if index not in state.indices:
+                    self.indices[index].close()
+                    del self.indices[index]
+
+    # -- master proxying ---------------------------------------------------
+
+    def _master(self) -> str:
+        master = self.coordinator.state().master_node
+        if master is None:
+            raise NoMasterError("no elected cluster manager")
+        return master
+
+    def _on_master(self, action: str, payload: dict) -> dict:
+        master = self._master()
+        if master == self.node_id:
+            handler = {A_CREATE_INDEX: self._h_create_index,
+                       A_DELETE_INDEX: self._h_delete_index}[action]
+            return handler(payload)
+        return self.transport.send_request(master, action, payload,
+                                           timeout=10.0)
+
+    # -- admin API ---------------------------------------------------------
+
+    def create_index(self, name: str, body: Optional[dict] = None) -> dict:
+        return self._on_master(A_CREATE_INDEX,
+                               {"index": name, "body": body or {}})
+
+    def delete_index(self, name: str) -> dict:
+        return self._on_master(A_DELETE_INDEX, {"index": name})
+
+    def _h_create_index(self, payload: dict) -> dict:
+        from opensearch_tpu.common.errors import IndexAlreadyExistsError
+
+        name = payload["index"]
+        body = payload.get("body") or {}
+        settings = dict(body.get("settings") or {})
+        if "index" in settings:
+            settings.update(settings.pop("index"))
+
+        def update(state: ClusterState) -> ClusterState:
+            if name in state.indices:
+                raise IndexAlreadyExistsError(name)
+            indices = dict(state.indices)
+            indices[name] = {"settings": settings,
+                             "mappings": body.get("mappings")}
+            return allocate_shards(state.with_(indices=indices))
+        self.coordinator.submit_state_update(update)
+        return {"acknowledged": True, "index": name}
+
+    def _h_delete_index(self, payload: dict) -> dict:
+        name = payload["index"]
+
+        def update(state: ClusterState) -> ClusterState:
+            if name not in state.indices:
+                raise IndexNotFoundError(name)
+            indices = dict(state.indices)
+            del indices[name]
+            routing = dict(state.routing)
+            routing.pop(name, None)
+            return state.with_(indices=indices, routing=routing)
+        self.coordinator.submit_state_update(update)
+        return {"acknowledged": True}
+
+    # -- document API ------------------------------------------------------
+
+    def _owner(self, index: str, shard: int) -> str:
+        state = self.coordinator.state()
+        routing = state.routing.get(index)
+        if routing is None:
+            raise IndexNotFoundError(index)
+        return routing[shard]
+
+    def _shard_for(self, index: str, doc_id: str,
+                   routing: Optional[str] = None) -> int:
+        from opensearch_tpu.indices.service import shard_id_for
+        state = self.coordinator.state()
+        meta = state.indices.get(index)
+        if meta is None:
+            raise IndexNotFoundError(index)
+        n = int((meta.get("settings") or {}).get("number_of_shards", 1))
+        return shard_id_for(doc_id, routing, n)
+
+    def index_doc(self, index: str, doc_id: str, source: dict,
+                  routing: Optional[str] = None) -> dict:
+        shard = self._shard_for(index, doc_id, routing)
+        payload = {"index": index, "shard": shard, "op": "index",
+                   "id": str(doc_id), "source": source, "routing": routing}
+        owner = self._owner(index, shard)
+        if owner == self.node_id:
+            return self._h_write_shard(payload)
+        return self.transport.send_request(owner, A_WRITE_SHARD, payload,
+                                           timeout=10.0)
+
+    def delete_doc(self, index: str, doc_id: str,
+                   routing: Optional[str] = None) -> dict:
+        shard = self._shard_for(index, doc_id, routing)
+        payload = {"index": index, "shard": shard, "op": "delete",
+                   "id": str(doc_id), "routing": routing}
+        owner = self._owner(index, shard)
+        if owner == self.node_id:
+            return self._h_write_shard(payload)
+        return self.transport.send_request(owner, A_WRITE_SHARD, payload,
+                                           timeout=10.0)
+
+    def get_doc(self, index: str, doc_id: str,
+                routing: Optional[str] = None) -> Optional[dict]:
+        shard = self._shard_for(index, doc_id, routing)
+        owner = self._owner(index, shard)
+        payload = {"index": index, "shard": shard, "id": str(doc_id)}
+        if owner == self.node_id:
+            resp = self._h_get_doc(payload)
+        else:
+            resp = self.transport.send_request(owner, A_GET_DOC, payload,
+                                               timeout=10.0)
+        return resp.get("doc")
+
+    def _h_write_shard(self, payload: dict) -> dict:
+        svc = self.indices.get(payload["index"])
+        if svc is None:
+            raise ShardNotFoundError(
+                f"[{payload['index']}][{payload['shard']}] not on this node")
+        engine = svc.engine_for(payload["shard"])
+        if payload["op"] == "index":
+            r = engine.index(payload["id"], payload["source"],
+                             routing=payload.get("routing"))
+        else:
+            r = engine.delete(payload["id"])
+        engine.ensure_synced()
+        return {"_index": payload["index"], "_id": r.doc_id,
+                "_version": r.version, "_seq_no": r.seq_no,
+                "result": r.result, "_shard": payload["shard"]}
+
+    def _h_get_doc(self, payload: dict) -> dict:
+        svc = self.indices.get(payload["index"])
+        if svc is None:
+            raise ShardNotFoundError(
+                f"[{payload['index']}][{payload['shard']}] not on this node")
+        doc = svc.engine_for(payload["shard"]).get(payload["id"])
+        return {"doc": doc}
+
+    # -- refresh -----------------------------------------------------------
+
+    def refresh(self, index: str):
+        state = self.coordinator.state()
+        if index not in state.indices:
+            raise IndexNotFoundError(index)
+        nodes = set(state.routing.get(index, []))
+        for node in nodes:
+            payload = {"index": index}
+            if node == self.node_id:
+                self._h_refresh(payload)
+            else:
+                self.transport.send_request(node, A_REFRESH, payload,
+                                            timeout=10.0)
+
+    def _h_refresh(self, payload: dict) -> dict:
+        svc = self.indices.get(payload["index"])
+        if svc is not None:
+            svc.refresh()
+        return {"ok": True}
+
+    # -- search (scatter-gather) -------------------------------------------
+
+    def search(self, index: str, body: Optional[dict] = None) -> dict:
+        """Coordinator side: group the index's shards by owning node, one
+        RPC per node, merge top-k on this node."""
+        body = body or {}
+        state = self.coordinator.state()
+        routing = state.routing.get(index)
+        if routing is None:
+            raise IndexNotFoundError(index)
+        by_node: dict[str, list[int]] = {}
+        for shard, owner in enumerate(routing):
+            by_node.setdefault(owner, []).append(shard)
+
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        sub = dict(body)
+        sub["from"] = 0
+        sub["size"] = from_ + size
+
+        responses = []
+        futures = []
+        for node, shards in by_node.items():
+            payload = {"index": index, "shards": shards, "body": sub}
+            if node == self.node_id:
+                responses.append(self._h_search_shards(payload))
+            else:
+                futures.append(self.transport.submit_request(
+                    node, A_SEARCH_SHARDS, payload))
+        for fut in futures:
+            responses.append(fut.result(timeout=30.0))
+
+        all_hits = []
+        total = 0
+        max_score = None
+        rows = []
+        for node_idx, resp in enumerate(responses):
+            r = resp["resp"]
+            for pos, h in enumerate(r["hits"]["hits"]):
+                rows.append((h, node_idx, pos))
+            total += r["hits"]["total"]["value"]
+            ms = r["hits"]["max_score"]
+            if ms is not None and (max_score is None or ms > max_score):
+                max_score = ms
+        sort_specs = _parse_sort(body.get("sort"))
+        if sort_specs is None:
+            rows.sort(key=lambda t: (-(t[0]["_score"] or 0.0), t[1], t[2]))
+        else:
+            # merge per-node sorted lists by their sort keys (the
+            # SearchPhaseController.sortDocs merge)
+            cmp = _sort_comparator(sort_specs)
+            rows.sort(key=functools.cmp_to_key(
+                lambda a, b: cmp({"sort": a[0].get("sort", []),
+                                  "seg": a[1], "local": a[2]},
+                                 {"sort": b[0].get("sort", []),
+                                  "seg": b[1], "local": b[2]})))
+        all_hits = [h for h, _n, _p in rows]
+        n_shards = len(routing)
+        return {
+            "took": max((resp["resp"]["took"] for resp in responses),
+                        default=0),
+            "timed_out": False,
+            "_shards": {"total": n_shards, "successful": n_shards,
+                        "skipped": 0, "failed": 0},
+            "hits": {"total": {"value": total, "relation": "eq"},
+                     "max_score": max_score,
+                     "hits": all_hits[from_: from_ + size]},
+        }
+
+    def _h_search_shards(self, payload: dict) -> dict:
+        svc = self.indices.get(payload["index"])
+        if svc is None:
+            raise ShardNotFoundError(
+                f"[{payload['index']}] has no shards on this node")
+        from opensearch_tpu.search.executor import ShardSearcher
+        segs = []
+        for shard_id in payload["shards"]:
+            engine = svc.engine_for(shard_id)
+            segs.extend(engine.acquire_searcher().segments)
+        searcher = ShardSearcher(segs, svc.mapper, index_name=svc.name)
+        return {"resp": searcher.search(payload.get("body") or {})}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start_election(self) -> bool:
+        return self.coordinator.start_election()
+
+    def start(self):
+        self.coordinator.start()
+        return self
+
+    def stop(self):
+        self.coordinator.stop()
+        with self._lock:
+            for svc in self.indices.values():
+                svc.close()
+            self.indices.clear()
+        self.transport.close()

@@ -1,0 +1,87 @@
+"""Cluster state: the immutable, versioned snapshot every node applies.
+
+Analog of ``cluster/ClusterState.java``: term + version ordering,
+discovery nodes, index metadata, and a routing table assigning each
+(index, shard) a primary node.  States travel as generic-value payloads
+over the transport (full states; structural diffs are an optimization the
+reference adds via cluster/Diff.java — semantics are identical).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class ClusterState:
+    cluster_name: str = "opensearch-tpu"
+    term: int = 0
+    version: int = 0
+    master_node: Optional[str] = None
+    # node_id -> {"name": ..., "address": ...}
+    nodes: dict = field(default_factory=dict)
+    # index -> {"settings": ..., "mappings": ...}
+    indices: dict = field(default_factory=dict)
+    # index -> [node_id per shard]
+    routing: dict = field(default_factory=dict)
+
+    def is_newer_than(self, other: "ClusterState") -> bool:
+        return (self.term, self.version) > (other.term, other.version)
+
+    def with_(self, **kw) -> "ClusterState":
+        return replace(self, **kw)
+
+    def to_payload(self) -> dict:
+        return {
+            "cluster_name": self.cluster_name,
+            "term": self.term,
+            "version": self.version,
+            "master_node": self.master_node,
+            "nodes": self.nodes,
+            "indices": self.indices,
+            "routing": self.routing,
+        }
+
+    @staticmethod
+    def from_payload(p: dict) -> "ClusterState":
+        return ClusterState(
+            cluster_name=p.get("cluster_name", "opensearch-tpu"),
+            term=int(p.get("term", 0)),
+            version=int(p.get("version", 0)),
+            master_node=p.get("master_node"),
+            nodes=dict(p.get("nodes") or {}),
+            indices=dict(p.get("indices") or {}),
+            routing={k: list(v) for k, v in (p.get("routing") or {}).items()},
+        )
+
+
+def allocate_shards(state: ClusterState) -> ClusterState:
+    """Round-robin primary allocation over data nodes — the
+    BalancedShardsAllocator's job at the fidelity this needs: every shard
+    gets exactly one assigned node, spread evenly, stable for already-
+    assigned shards whose node is still in the cluster."""
+    node_ids = sorted(state.nodes)
+    if not node_ids:
+        return state
+    counts = {n: 0 for n in node_ids}
+    routing = {}
+    for index, meta in state.indices.items():
+        n_shards = int((meta.get("settings") or {}).get("number_of_shards", 1))
+        old = state.routing.get(index, [])
+        assigned = []
+        for s in range(n_shards):
+            prev = old[s] if s < len(old) else None
+            if prev in counts:
+                assigned.append(prev)
+                counts[prev] += 1
+            else:
+                assigned.append(None)
+        routing[index] = assigned
+    for index, assigned in routing.items():
+        for s, node in enumerate(assigned):
+            if node is None:
+                target = min(sorted(counts), key=lambda n: counts[n])
+                assigned[s] = target
+                counts[target] += 1
+    return state.with_(routing=routing)

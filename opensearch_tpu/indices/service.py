@@ -73,11 +73,19 @@ def murmur3_32(data: bytes, seed: int = 0) -> int:
 _INDEX_NAME = re.compile(r"^[a-z0-9][a-z0-9_\-.+]*$")
 
 
+def shard_id_for(doc_id: str, routing: Optional[str], num_shards: int) -> int:
+    """THE routing decision — every layer (coordinator + data node) must
+    agree on it, so it lives in exactly one place."""
+    key = (routing if routing is not None else str(doc_id)).encode()
+    return murmur3_32(key) % num_shards
+
+
 class IndexService:
     """One index: mapper + N shard engines + searcher cache."""
 
     def __init__(self, name: str, data_path: str, settings: dict,
-                 mappings: Optional[dict], persist_meta=None):
+                 mappings: Optional[dict], persist_meta=None,
+                 local_shard_ids: Optional[list[int]] = None):
         self.name = name
         self.data_path = data_path
         self.settings = settings
@@ -90,22 +98,55 @@ class IndexService:
         self.creation_date = int(time.time() * 1000)
         self.uuid = uuid.uuid4().hex[:22]
         self.mapper = DocumentMapper(mappings or {})
-        durability = settings.get("translog", {}).get("durability", "request")
-        self.shards = [
-            InternalEngine(os.path.join(data_path, str(s)), self.mapper,
-                           index_name=name, shard_id=s,
-                           durability=durability)
-            for s in range(self.num_shards)
-        ]
+        self._durability = settings.get("translog", {}).get("durability",
+                                                            "request")
+        # in cluster mode a node hosts only the shards routed to it
+        # (IndicesClusterStateService analog); standalone hosts all
+        if local_shard_ids is None:
+            local_shard_ids = list(range(self.num_shards))
+        self.local_shards: dict[int, InternalEngine] = {
+            s: self._open_shard(s) for s in sorted(local_shard_ids)}
         self._lock = threading.RLock()
         self._searcher: Optional[ShardSearcher] = None
 
+    def _open_shard(self, shard_id: int) -> InternalEngine:
+        return InternalEngine(os.path.join(self.data_path, str(shard_id)),
+                              self.mapper, index_name=self.name,
+                              shard_id=shard_id,
+                              durability=self._durability)
+
+    @property
+    def shards(self) -> list[InternalEngine]:
+        return list(self.local_shards.values())
+
+    def add_local_shard(self, shard_id: int):
+        with self._lock:
+            if shard_id not in self.local_shards:
+                self.local_shards[shard_id] = self._open_shard(shard_id)
+                self._searcher = None
+
+    def remove_local_shard(self, shard_id: int):
+        with self._lock:
+            engine = self.local_shards.pop(shard_id, None)
+            if engine is not None:
+                engine.close()
+                self._searcher = None
+
     # -- routing ----------------------------------------------------------
 
+    def route_shard(self, doc_id: str, routing: Optional[str] = None) -> int:
+        return shard_id_for(doc_id, routing, self.num_shards)
+
+    def engine_for(self, shard_id: int) -> InternalEngine:
+        engine = self.local_shards.get(shard_id)
+        if engine is None:
+            from opensearch_tpu.common.errors import ShardNotFoundError
+            raise ShardNotFoundError(
+                f"shard [{self.name}][{shard_id}] is not on this node")
+        return engine
+
     def route(self, doc_id: str, routing: Optional[str] = None) -> InternalEngine:
-        key = (routing if routing is not None else str(doc_id)).encode()
-        shard = murmur3_32(key) % self.num_shards
-        return self.shards[shard]
+        return self.engine_for(self.route_shard(doc_id, routing))
 
     # -- document ops -----------------------------------------------------
 

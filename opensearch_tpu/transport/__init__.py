@@ -1,0 +1,6 @@
+from opensearch_tpu.transport.wire import StreamInput, StreamOutput  # noqa: F401
+from opensearch_tpu.transport.service import (  # noqa: F401
+    LocalTransport,
+    TcpTransport,
+    TransportService,
+)

@@ -1,0 +1,359 @@
+"""Node-to-node RPC: action registry, request/response correlation,
+pluggable transports.
+
+Analog of ``transport/TransportService.java`` (sendRequest :150,
+registerRequestHandler :1172) over a TcpHeader-style frame
+(transport/TcpHeader.java:47-61: marker + length + requestId + status +
+version), with two transports:
+
+- ``TcpTransport``: real sockets (the netty4 analog), length-prefixed
+  frames, one reader thread per connection, reconnect-per-send on broken
+  pipes;
+- ``LocalTransport``: in-process hub for multi-node-in-one-process tests
+  with MockTransportService-style drop/delay/disconnect rules (ref
+  test/framework .../test/transport/MockTransportService.java).
+
+Payloads are generic-value dicts (wire.py), so every action speaks the
+same versioned binary format.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+import uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Optional
+
+from opensearch_tpu.common.errors import (
+    NodeDisconnectedError,
+    OpenSearchTpuError,
+)
+from opensearch_tpu.transport.wire import StreamInput, StreamOutput
+from opensearch_tpu.version import TRANSPORT_PROTOCOL_VERSION
+
+MARKER = b"OT"
+STATUS_RESPONSE = 0x01
+STATUS_ERROR = 0x02
+
+
+class ReceiveTimeoutError(OpenSearchTpuError):
+    status = 500
+
+
+class RemoteTransportError(OpenSearchTpuError):
+    status = 500
+
+
+def encode_frame(req_id: int, status: int, action: str,
+                 payload: dict) -> bytes:
+    out = StreamOutput()
+    out.write_vint(TRANSPORT_PROTOCOL_VERSION)
+    out.write_string(action)
+    out.write_value(payload)
+    body = out.bytes()
+    return (MARKER + struct.pack(">IQB", len(body) + 9, req_id, status)
+            + body)
+
+
+def decode_frame(body: bytes):
+    inp = StreamInput(body)
+    version = inp.read_vint()
+    inp.version = version
+    action = inp.read_string()
+    payload = inp.read_value()
+    return version, action, payload
+
+
+class TransportService:
+    def __init__(self, node_id: str, transport: "Transport"):
+        self.node_id = node_id
+        self.transport = transport
+        self._handlers: dict[str, Callable[[dict], dict]] = {}
+        self._pending: dict[int, Future] = {}
+        self._req_counter = 0
+        self._lock = threading.Lock()
+        self._executor = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix=f"transport-{node_id}")
+        transport.bind(self)
+
+    # -- registration -----------------------------------------------------
+
+    def register_handler(self, action: str, fn: Callable[[dict], dict]):
+        self._handlers[action] = fn
+
+    # -- outbound ---------------------------------------------------------
+
+    def submit_request(self, target: str, action: str,
+                       payload: Optional[dict] = None) -> Future:
+        with self._lock:
+            self._req_counter += 1
+            req_id = self._req_counter
+            fut: Future = Future()
+            self._pending[req_id] = fut
+        try:
+            self.transport.send(self.node_id, target,
+                                encode_frame(req_id, 0, action,
+                                             payload or {}))
+        except Exception as e:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            fut.set_exception(
+                NodeDisconnectedError(f"[{target}] send failed: {e}"))
+        return fut
+
+    def send_request(self, target: str, action: str,
+                     payload: Optional[dict] = None,
+                     timeout: float = 10.0) -> dict:
+        fut = self.submit_request(target, action, payload)
+        try:
+            return fut.result(timeout=timeout)
+        except TimeoutError:
+            # drop the correlation slot or every lost response leaks one
+            with self._lock:
+                for req_id, pending in list(self._pending.items()):
+                    if pending is fut:
+                        del self._pending[req_id]
+                        break
+            raise ReceiveTimeoutError(
+                f"[{target}][{action}] request timed out after {timeout}s")
+
+    # -- inbound ----------------------------------------------------------
+
+    def handle_frame(self, source: str, frame: bytes):
+        """Called by the transport with one decoded frame body (after the
+        length prefix)."""
+        req_id, status = struct.unpack(">QB", frame[:9])
+        _version, action, payload = decode_frame(frame[9:])
+        if status & STATUS_RESPONSE:
+            with self._lock:
+                fut = self._pending.pop(req_id, None)
+            if fut is None:
+                return
+            if status & STATUS_ERROR:
+                fut.set_exception(RemoteTransportError(
+                    f"[{source}][{payload.get('action', action)}] "
+                    f"{payload.get('type')}: {payload.get('reason')}"))
+            else:
+                fut.set_result(payload)
+            return
+        try:
+            self._executor.submit(self._run_handler, source, req_id, action,
+                                  payload)
+        except RuntimeError:
+            pass   # executor shut down: frame raced our close()
+
+    def _run_handler(self, source: str, req_id: int, action: str,
+                     payload: dict):
+        handler = self._handlers.get(action)
+        try:
+            if handler is None:
+                raise OpenSearchTpuError(
+                    f"no handler for action [{action}]")
+            result = handler(payload)
+            frame = encode_frame(req_id, STATUS_RESPONSE, action,
+                                 result or {})
+        except OpenSearchTpuError as e:
+            frame = encode_frame(req_id, STATUS_RESPONSE | STATUS_ERROR,
+                                 action, {"type": e.error_type,
+                                          "reason": e.reason,
+                                          "action": action})
+        except Exception as e:  # noqa: BLE001 — rpc boundary
+            frame = encode_frame(req_id, STATUS_RESPONSE | STATUS_ERROR,
+                                 action, {"type": "internal_error",
+                                          "reason": str(e),
+                                          "action": action})
+        try:
+            self.transport.send(self.node_id, source, frame)
+        except Exception:
+            pass   # peer vanished; their request will time out
+
+    def close(self):
+        self.transport.close(self.node_id)
+        self._executor.shutdown(wait=False, cancel_futures=True)
+        with self._lock:
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(
+                        NodeDisconnectedError("transport closed"))
+            self._pending.clear()
+
+
+class Transport:
+    def bind(self, service: TransportService):
+        raise NotImplementedError
+
+    def send(self, source: str, target: str, frame: bytes):
+        raise NotImplementedError
+
+    def close(self, node_id: str):
+        raise NotImplementedError
+
+
+class LocalTransport(Transport):
+    """In-process hub: every node's TransportService registers here;
+    sends are direct calls on the receiver (on the receiver's executor).
+    Rules make it the disruption-testing harness."""
+
+    class Hub:
+        def __init__(self):
+            self.nodes: dict[str, TransportService] = {}
+            self.rules: list[Callable[[str, str, bytes], Optional[float]]] = []
+            self.lock = threading.Lock()
+
+        def add_rule(self, rule):
+            """rule(source, target, frame) -> None=pass, float=delay
+            seconds, raise=drop."""
+            self.rules.append(rule)
+
+        def clear_rules(self):
+            self.rules.clear()
+
+        def disconnect(self, node_id: str):
+            def rule(src, dst, frame):
+                if src == node_id or dst == node_id:
+                    raise NodeDisconnectedError(f"[{node_id}] partitioned")
+            self.add_rule(rule)
+
+    def __init__(self, hub: "LocalTransport.Hub"):
+        self.hub = hub
+        self.service: Optional[TransportService] = None
+
+    def bind(self, service: TransportService):
+        self.service = service
+        with self.hub.lock:
+            self.hub.nodes[service.node_id] = service
+
+    def send(self, source: str, target: str, frame: bytes):
+        delay = 0.0
+        for rule in list(self.hub.rules):
+            d = rule(source, target, frame)
+            if d:
+                delay = max(delay, float(d))
+        svc = self.hub.nodes.get(target)
+        if svc is None:
+            raise NodeDisconnectedError(f"unknown node [{target}]")
+
+        def deliver():
+            if delay:
+                time.sleep(delay)
+            svc.handle_frame(source, frame[6:])   # strip marker+len
+        threading.Thread(target=deliver, daemon=True).start()
+
+    def close(self, node_id: str):
+        with self.hub.lock:
+            self.hub.nodes.pop(node_id, None)
+
+
+class TcpTransport(Transport):
+    """Real sockets with the frame format above.  Nodes are addressed as
+    host:port; an address book maps node ids to addresses."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.service: Optional[TransportService] = None
+        self._server = socket.create_server((host, port))
+        self.port = self._server.getsockname()[1]
+        self.address_book: dict[str, tuple[str, int]] = {}
+        self._conns: dict[str, socket.socket] = {}
+        self._lock = threading.Lock()            # guards the maps only
+        self._target_locks: dict[str, threading.Lock] = {}
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"tcp-accept-{self.port}")
+
+    def bind(self, service: TransportService):
+        self.service = service
+        self._accept_thread.start()
+
+    def add_node(self, node_id: str, host: str, port: int):
+        self.address_book[node_id] = (host, port)
+
+    # -- server side ------------------------------------------------------
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _addr = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._read_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _read_loop(self, conn: socket.socket):
+        try:
+            while self._running:
+                header = self._read_exact(conn, 6)
+                if header is None or header[:2] != MARKER:
+                    return
+                (length,) = struct.unpack(">I", header[2:6])
+                body = self._read_exact(conn, length)
+                if body is None:
+                    return
+                # frames carry the source node id prefixed by the sender
+                inp = StreamInput(body)
+                source = inp.read_string()
+                self.service.handle_frame(source, body[inp._pos:])
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _read_exact(conn, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    # -- client side ------------------------------------------------------
+
+    def _connect(self, target: str) -> socket.socket:
+        addr = self.address_book.get(target)
+        if addr is None:
+            raise NodeDisconnectedError(f"unknown node [{target}]")
+        return socket.create_connection(addr, timeout=5)
+
+    def send(self, source: str, target: str, frame: bytes):
+        # re-prefix: MARKER + len(source + original body) + source + body
+        body = frame[6:]
+        out = StreamOutput()
+        out.write_string(source)
+        prefixed = out.bytes() + body
+        wire = MARKER + struct.pack(">I", len(prefixed)) + prefixed
+        # per-target locking: a dead peer's connect timeout must not
+        # head-of-line-block traffic to healthy peers
+        with self._lock:
+            tlock = self._target_locks.setdefault(target, threading.Lock())
+        with tlock:
+            with self._lock:
+                conn = self._conns.get(target)
+            for _attempt in (1, 2):
+                if conn is None:
+                    conn = self._connect(target)
+                    with self._lock:
+                        self._conns[target] = conn
+                try:
+                    conn.sendall(wire)
+                    return
+                except OSError:
+                    conn.close()
+                    with self._lock:
+                        self._conns.pop(target, None)
+                    conn = None
+            raise NodeDisconnectedError(f"[{target}] connection failed")
+
+    def close(self, node_id: str):
+        self._running = False
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self._lock:
+            for conn in self._conns.values():
+                conn.close()
+            self._conns.clear()

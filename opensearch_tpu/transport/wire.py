@@ -1,0 +1,189 @@
+"""Versioned binary wire serialization.
+
+Analog of ``libs/core`` ``StreamOutput``/``StreamInput``/``Writeable``
+(libs/core/src/main/java/org/opensearch/core/common/io/stream/
+Writeable.java:46): length-delimited primitives with vint compression,
+UTF-8 strings, and a tagged generic-value encoding that covers the JSON
+value domain (the reference's ``writeGenericValue``).  Messages carry a
+protocol version so readers can gate fields by version exactly like the
+reference's ``if (in.getVersion().onOrAfter(...))`` pattern.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+
+
+class WireFormatError(OpenSearchTpuError):
+    status = 500
+
+
+class StreamOutput:
+    def __init__(self):
+        self._parts: list[bytes] = []
+
+    def bytes(self) -> bytes:
+        return b"".join(self._parts)
+
+    def write_byte(self, b: int):
+        self._parts.append(bytes([b & 0xFF]))
+
+    def write_vint(self, value: int):
+        """Unsigned LEB128 (the reference's writeVInt)."""
+        if value < 0:
+            raise WireFormatError(f"vint cannot encode negative [{value}]")
+        out = bytearray()
+        while value >= 0x80:
+            out.append((value & 0x7F) | 0x80)
+            value >>= 7
+        out.append(value)
+        self._parts.append(bytes(out))
+
+    def write_zlong(self, value: int):
+        """Zigzag-encoded signed long (writeZLong).  Python ints are
+        arbitrary precision, so (v << 1) ^ (v >> 63) is non-negative for
+        any 64-bit value without masking."""
+        self.write_vint((value << 1) ^ (value >> 63))
+
+    def write_long(self, value: int):
+        self._parts.append(struct.pack(">q", value))
+
+    def write_double(self, value: float):
+        self._parts.append(struct.pack(">d", value))
+
+    def write_bool(self, value: bool):
+        self.write_byte(1 if value else 0)
+
+    def write_bytes(self, data: bytes):
+        self.write_vint(len(data))
+        self._parts.append(data)
+
+    def write_string(self, s: str):
+        self.write_bytes(s.encode("utf-8"))
+
+    def write_optional_string(self, s):
+        if s is None:
+            self.write_bool(False)
+        else:
+            self.write_bool(True)
+            self.write_string(s)
+
+    def write_string_list(self, items):
+        self.write_vint(len(items))
+        for s in items:
+            self.write_string(s)
+
+    # tagged generic value (writeGenericValue analog)
+
+    def write_value(self, v):
+        if v is None:
+            self.write_byte(0)
+        elif isinstance(v, bool):
+            self.write_byte(1)
+            self.write_bool(v)
+        elif isinstance(v, int):
+            self.write_byte(2)
+            self.write_zlong(v)
+        elif isinstance(v, float):
+            self.write_byte(3)
+            self.write_double(v)
+        elif isinstance(v, str):
+            self.write_byte(4)
+            self.write_string(v)
+        elif isinstance(v, bytes):
+            self.write_byte(5)
+            self.write_bytes(v)
+        elif isinstance(v, (list, tuple)):
+            self.write_byte(6)
+            self.write_vint(len(v))
+            for item in v:
+                self.write_value(item)
+        elif isinstance(v, dict):
+            self.write_byte(7)
+            self.write_vint(len(v))
+            for k, item in v.items():
+                self.write_string(str(k))
+                self.write_value(item)
+        else:
+            raise WireFormatError(
+                f"cannot serialize value of type [{type(v).__name__}]")
+
+
+class StreamInput:
+    def __init__(self, data: bytes, version: int = 1):
+        self._data = data
+        self._pos = 0
+        self.version = version
+
+    def _take(self, n: int) -> bytes:
+        if self._pos + n > len(self._data):
+            raise WireFormatError("stream truncated")
+        out = self._data[self._pos: self._pos + n]
+        self._pos += n
+        return out
+
+    def read_byte(self) -> int:
+        return self._take(1)[0]
+
+    def read_vint(self) -> int:
+        shift = 0
+        value = 0
+        while True:
+            b = self.read_byte()
+            value |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return value
+            shift += 7
+            if shift > 70:
+                raise WireFormatError("vint too long")
+
+    def read_zlong(self) -> int:
+        v = self.read_vint()
+        return (v >> 1) ^ -(v & 1)
+
+    def read_long(self) -> int:
+        return struct.unpack(">q", self._take(8))[0]
+
+    def read_double(self) -> float:
+        return struct.unpack(">d", self._take(8))[0]
+
+    def read_bool(self) -> bool:
+        return self.read_byte() != 0
+
+    def read_bytes(self) -> bytes:
+        return self._take(self.read_vint())
+
+    def read_string(self) -> str:
+        return self.read_bytes().decode("utf-8")
+
+    def read_optional_string(self):
+        return self.read_string() if self.read_bool() else None
+
+    def read_string_list(self) -> list[str]:
+        return [self.read_string() for _ in range(self.read_vint())]
+
+    def read_value(self):
+        tag = self.read_byte()
+        if tag == 0:
+            return None
+        if tag == 1:
+            return self.read_bool()
+        if tag == 2:
+            return self.read_zlong()
+        if tag == 3:
+            return self.read_double()
+        if tag == 4:
+            return self.read_string()
+        if tag == 5:
+            return self.read_bytes()
+        if tag == 6:
+            return [self.read_value() for _ in range(self.read_vint())]
+        if tag == 7:
+            return {self.read_string(): self.read_value()
+                    for _ in range(self.read_vint())}
+        raise WireFormatError(f"unknown value tag [{tag}]")
+
+    def remaining(self) -> int:
+        return len(self._data) - self._pos

@@ -1,0 +1,156 @@
+"""Multi-node-in-one-process cluster: create index -> shards spread over
+nodes -> route writes -> distributed GET/search from ANY node -> node
+loss -> reallocation (the InternalTestCluster technique, SURVEY §4.2)."""
+
+import time
+
+import pytest
+
+from opensearch_tpu.cluster.node import ClusterNode, NoMasterError
+from opensearch_tpu.transport.service import LocalTransport, TransportService
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    hub = LocalTransport.Hub()
+    ids = ["n0", "n1", "n2"]
+    nodes = {}
+    for nid in ids:
+        svc = TransportService(nid, LocalTransport(hub))
+        nodes[nid] = ClusterNode(nid, str(tmp_path / nid), svc, ids)
+    assert nodes["n0"].start_election()
+    wait_until(lambda: all(
+        nodes[i].coordinator.state().master_node == "n0" for i in ids))
+    yield hub, ids, nodes
+    for n in nodes.values():
+        n.stop()
+
+
+def wait_until(pred, timeout=8.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_create_index_spreads_shards(cluster):
+    hub, ids, nodes = cluster
+    # create via a NON-master node: proxied to the leader
+    resp = nodes["n2"].create_index("logs", {
+        "settings": {"number_of_shards": 6},
+        "mappings": {"properties": {"msg": {"type": "text"},
+                                    "level": {"type": "keyword"}}}})
+    assert resp["acknowledged"]
+    assert wait_until(lambda: all(
+        "logs" in nodes[i].coordinator.state().indices for i in ids))
+    routing = nodes["n0"].coordinator.state().routing["logs"]
+    assert len(routing) == 6
+    assert set(routing) == set(ids)          # all nodes host shards
+    # each node instantiated exactly its own shards
+    assert wait_until(lambda: all("logs" in nodes[i].indices for i in ids))
+    for nid in ids:
+        mine = {s for s, o in enumerate(routing) if o == nid}
+        assert set(nodes[nid].indices["logs"].local_shards) == mine
+
+
+def test_distributed_write_get_search(cluster):
+    hub, ids, nodes = cluster
+    nodes["n0"].create_index("docs", {
+        "settings": {"number_of_shards": 5},
+        "mappings": {"properties": {"body": {"type": "text"},
+                                    "n": {"type": "long"}}}})
+    wait_until(lambda: all("docs" in nodes[i].indices for i in ids))
+    for i in range(40):
+        # write through rotating coordinators — routing must converge
+        r = nodes[ids[i % 3]].index_doc("docs", str(i),
+                                        {"body": f"event {i}", "n": i})
+        assert r["result"] == "created"
+    # realtime GET from any node
+    for nid in ids:
+        doc = nodes[nid].get_doc("docs", "17")
+        assert doc["_source"]["n"] == 17
+    assert nodes["n1"].get_doc("docs", "999") is None
+
+    nodes["n2"].refresh("docs")
+    for nid in ids:
+        resp = nodes[nid].search("docs", {
+            "query": {"range": {"n": {"gte": 10, "lt": 20}}}, "size": 50})
+        assert resp["hits"]["total"]["value"] == 10
+        got = sorted(int(h["_id"]) for h in resp["hits"]["hits"])
+        assert got == list(range(10, 20))
+    resp = nodes["n0"].search("docs", {"query": {"match": {"body": "event"}},
+                                       "size": 3})
+    assert resp["hits"]["total"]["value"] == 40
+    assert len(resp["hits"]["hits"]) == 3
+
+
+def test_distributed_sorted_search_merges_by_key(cluster):
+    hub, ids, nodes = cluster
+    nodes["n0"].create_index("sorted", {
+        "settings": {"number_of_shards": 6},
+        "mappings": {"properties": {"ts": {"type": "long"}}}})
+    wait_until(lambda: all("sorted" in nodes[i].indices for i in ids))
+    import random
+    rnd = random.Random(3)
+    values = rnd.sample(range(1000), 30)
+    for i, v in enumerate(values):
+        nodes["n0"].index_doc("sorted", str(i), {"ts": v})
+    nodes["n0"].refresh("sorted")
+    resp = nodes["n1"].search("sorted", {
+        "sort": [{"ts": "desc"}], "size": 10})
+    got = [h["sort"][0] for h in resp["hits"]["hits"]]
+    assert got == sorted(values, reverse=True)[:10]
+    # pagination across the merge
+    page2 = nodes["n2"].search("sorted", {
+        "sort": [{"ts": "desc"}], "size": 10, "from": 10})
+    got2 = [h["sort"][0] for h in page2["hits"]["hits"]]
+    assert got2 == sorted(values, reverse=True)[10:20]
+
+
+def test_delete_doc_and_index(cluster):
+    hub, ids, nodes = cluster
+    nodes["n0"].create_index("tmp", {"settings": {"number_of_shards": 2}})
+    wait_until(lambda: all(
+        "tmp" in nodes[i].coordinator.state().indices for i in ids))
+    nodes["n1"].index_doc("tmp", "1", {"x": 1})
+    assert nodes["n2"].delete_doc("tmp", "1")["result"] == "deleted"
+    assert nodes["n0"].get_doc("tmp", "1") is None
+    nodes["n2"].delete_index("tmp")
+    assert wait_until(lambda: all(
+        "tmp" not in nodes[i].coordinator.state().indices for i in ids))
+    assert wait_until(lambda: all("tmp" not in nodes[i].indices for i in ids))
+
+
+def test_node_loss_reallocates_shards(cluster):
+    hub, ids, nodes = cluster
+    nodes["n0"].create_index("ha", {"settings": {"number_of_shards": 6}})
+    wait_until(lambda: all("ha" in nodes[i].indices for i in ids))
+    hub.disconnect("n2")
+    # leader detects the dead follower and reroutes its shards
+    for _ in range(4):
+        nodes["n0"].coordinator.run_checks_once()
+    assert wait_until(lambda: "n2" not in
+                      nodes["n0"].coordinator.state().nodes)
+    routing = nodes["n0"].coordinator.state().routing["ha"]
+    assert set(routing) <= {"n0", "n1"}
+    # surviving nodes picked up the reassigned shards
+    assert wait_until(lambda: sum(
+        len(nodes[i].indices["ha"].local_shards) for i in ("n0", "n1")) == 6)
+    # writes to every shard still succeed
+    for i in range(12):
+        r = nodes["n0"].index_doc("ha", str(i), {"v": i})
+        assert r["result"] == "created"
+
+
+def test_no_master_rejects_admin(tmp_path):
+    hub = LocalTransport.Hub()
+    svc = TransportService("solo", LocalTransport(hub))
+    node = ClusterNode("solo", str(tmp_path / "solo"), svc,
+                       ["solo", "ghost1", "ghost2"])
+    # cannot win an election without a quorum of the voting config
+    assert node.start_election() is False
+    with pytest.raises(NoMasterError):
+        node.create_index("x", {})
+    node.stop()

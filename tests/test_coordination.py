@@ -1,0 +1,173 @@
+"""Coordination protocol: elections, two-phase publication, quorum loss,
+leader failover — driven deterministically over the in-process transport
+(the CoordinatorTests / DisruptableMockTransport technique, SURVEY §4.3)."""
+
+import time
+
+import pytest
+
+from opensearch_tpu.cluster.coordination import (
+    Coordinator,
+    CoordinationError,
+    FailedToCommitError,
+    Mode,
+)
+from opensearch_tpu.cluster.state import ClusterState, allocate_shards
+from opensearch_tpu.transport.service import LocalTransport, TransportService
+
+
+def make_cluster(n=3, check_retries=2):
+    hub = LocalTransport.Hub()
+    ids = [f"node_{i}" for i in range(n)]
+    coords = {}
+    applied = {i: [] for i in ids}
+    for node_id in ids:
+        svc = TransportService(node_id, LocalTransport(hub))
+        coords[node_id] = Coordinator(
+            node_id, svc, voting_nodes=ids,
+            node_info={"name": node_id},
+            on_apply=lambda s, nid=node_id: applied[nid].append(s),
+            check_retries=check_retries)
+    return hub, ids, coords, applied
+
+
+def teardown(coords):
+    for c in coords.values():
+        c.stop()
+        c.transport.close()
+
+
+def wait_until(pred, timeout=8.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_election_and_publication():
+    hub, ids, coords, applied = make_cluster()
+    assert coords["node_0"].start_election() is True
+    assert coords["node_0"].mode == Mode.LEADER
+    # first publication reached everyone: all followers, same state
+    assert wait_until(lambda: all(
+        coords[i].state().master_node == "node_0" for i in ids))
+    assert coords["node_1"].mode == Mode.FOLLOWER
+    assert coords["node_2"].mode == Mode.FOLLOWER
+    st = coords["node_0"].state()
+    assert set(st.nodes) == set(ids)
+    assert all(applied[i] for i in ids)
+    teardown(coords)
+
+
+def test_state_update_propagates():
+    hub, ids, coords, applied = make_cluster()
+    coords["node_0"].start_election()
+    wait_until(lambda: all(coords[i].state().version >= 1 for i in ids))
+
+    def add_index(state):
+        indices = dict(state.indices)
+        indices["logs"] = {"settings": {"number_of_shards": 4}}
+        return allocate_shards(state.with_(indices=indices))
+    coords["node_0"].submit_state_update(add_index)
+    assert wait_until(lambda: all(
+        "logs" in coords[i].state().indices for i in ids))
+    routing = coords["node_0"].state().routing["logs"]
+    assert len(routing) == 4
+    assert set(routing) <= set(ids)          # spread over nodes
+    teardown(coords)
+
+
+def test_non_leader_cannot_update():
+    hub, ids, coords, applied = make_cluster()
+    coords["node_0"].start_election()
+    wait_until(lambda: coords["node_1"].mode == Mode.FOLLOWER)
+    with pytest.raises(CoordinationError):
+        coords["node_1"].submit_state_update(lambda s: s.with_())
+    teardown(coords)
+
+
+def test_publication_fails_without_quorum():
+    hub, ids, coords, applied = make_cluster()
+    coords["node_0"].start_election()
+    wait_until(lambda: all(coords[i].state().version >= 1 for i in ids))
+    hub.disconnect("node_1")
+    hub.disconnect("node_2")
+    with pytest.raises(FailedToCommitError):
+        coords["node_0"].submit_state_update(
+            lambda s: s.with_(indices={"x": {"settings": {}}}))
+    assert coords["node_0"].mode == Mode.CANDIDATE   # stepped down
+    teardown(coords)
+
+
+def test_competing_candidates_one_leader_per_term():
+    hub, ids, coords, applied = make_cluster()
+    r0 = coords["node_0"].start_election()
+    r1 = coords["node_1"].start_election()
+    leaders = [i for i in ids if coords[i].mode == Mode.LEADER]
+    # at most one leader; and if both claimed, terms differ — settle by
+    # running another round from the loser
+    assert len(leaders) >= 1
+    terms = {coords[i].current_term for i in leaders}
+    assert len(terms) == len(leaders)
+    teardown(coords)
+
+
+def test_leader_failover():
+    hub, ids, coords, applied = make_cluster(check_retries=2)
+    coords["node_0"].start_election()
+    wait_until(lambda: all(coords[i].state().master_node == "node_0"
+                           for i in ids))
+    hub.disconnect("node_0")
+    # followers detect the dead leader and elect a new one
+    for _ in range(4):
+        coords["node_1"].run_checks_once()
+        coords["node_2"].run_checks_once()
+    assert wait_until(lambda: any(
+        coords[i].mode == Mode.LEADER for i in ("node_1", "node_2")), 5.0)
+    new_leader = next(i for i in ("node_1", "node_2")
+                      if coords[i].mode == Mode.LEADER)
+    assert coords[new_leader].state().master_node == new_leader
+    assert coords[new_leader].current_term > 1
+    teardown(coords)
+
+
+def test_committed_state_survives_failover():
+    hub, ids, coords, applied = make_cluster(check_retries=1)
+    coords["node_0"].start_election()
+    wait_until(lambda: all(coords[i].state().version >= 1 for i in ids))
+    coords["node_0"].submit_state_update(
+        lambda s: s.with_(indices={"keepme": {"settings": {}}}))
+    assert wait_until(lambda: all(
+        "keepme" in coords[i].state().indices for i in ids))
+    hub.disconnect("node_0")
+    # both followers must detect the dead leader before a pre-vote can
+    # be granted (leader-liveness gates grants — election safety)
+    for _ in range(3):
+        coords["node_2"].run_checks_once()
+        coords["node_1"].run_checks_once()
+    assert wait_until(lambda: any(
+        coords[i].mode == Mode.LEADER for i in ("node_1", "node_2")), 5.0)
+    new_leader = next(i for i in ("node_1", "node_2")
+                      if coords[i].mode == Mode.LEADER)
+    assert "keepme" in coords[new_leader].state().indices
+    teardown(coords)
+
+
+def test_allocate_shards_stability():
+    st = ClusterState(nodes={"a": {}, "b": {}},
+                      indices={"i": {"settings": {"number_of_shards": 4}}})
+    st = allocate_shards(st)
+    before = list(st.routing["i"])
+    # add a node: existing assignments stay put
+    st2 = allocate_shards(st.with_(nodes={"a": {}, "b": {}, "c": {}}))
+    assert st2.routing["i"] == before
+    # remove node b: only b's shards move
+    st3 = allocate_shards(st.with_(nodes={"a": {}}))
+    for old, new in zip(before, st3.routing["i"]):
+        if old == "a":
+            assert new == "a"
+        else:
+            assert new == "a"
+    teardown({})
