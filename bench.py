@@ -207,11 +207,12 @@ def main_parent():
         log("skipping default-backend attempt (probe failed)")
     attempts.append(("cpu", {"JAX_PLATFORMS": "cpu",
                              "OSTPU_BENCH_FORCE_CPU": "1"}, cpu_to))
-    last_json, last_err = None, "no attempt ran"
+    final_json, last_err = None, "no attempt ran"
     for name, extra, to in attempts:
         env = dict(os.environ)
         env.update(extra)
         log(f"--- bench attempt backend={name} timeout={to:.0f}s")
+        final_json = None  # only the LAST attempt's self-report may win
         try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--child"],
@@ -222,14 +223,14 @@ def main_parent():
             continue
         lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
         if lines:
-            last_json = lines[-1]
+            final_json = lines[-1]
         if r.returncode == 0 and lines:
             print(lines[-1])
             return
         last_err = f"backend={name}: rc={r.returncode}"
         log(last_err)
-    if last_json is not None:  # a child got far enough to self-report
-        print(last_json)
+    if final_json is not None:  # the final attempt got far enough to report
+        print(final_json)
     else:
         print(json.dumps({
             "metric": "bm25_match_qps", "value": 0.0, "unit": "qps",

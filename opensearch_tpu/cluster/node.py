@@ -18,17 +18,17 @@ Analog of the action layer (L6) on the cluster runtime (L4):
 
 from __future__ import annotations
 
-import functools
 import os
 import threading
 from typing import Optional
 
-from opensearch_tpu.search.executor import _parse_sort, _sort_comparator
+from opensearch_tpu.search.executor import merge_hit_rows
 
 from opensearch_tpu.common.errors import (
     IndexNotFoundError,
     OpenSearchTpuError,
     ShardNotFoundError,
+    ValidationError,
 )
 from opensearch_tpu.cluster.coordination import CoordinationError, Coordinator
 from opensearch_tpu.cluster.state import ClusterState, allocate_shards
@@ -265,6 +265,18 @@ class ClusterNode:
         for shard, owner in enumerate(routing):
             by_node.setdefault(owner, []).append(shard)
 
+        aggs_requested = bool(body.get("aggs") or body.get("aggregations"))
+        if aggs_requested and len(by_node) > 1:
+            # Finished per-node aggregation JSON is not mergeable (exact
+            # cardinality/percentiles lose their inputs) — reject loudly
+            # rather than silently dropping the aggs, matching the REST
+            # controller's multi-index behavior.  Cross-node partial
+            # reduce lands with mergeable sketch aggregations.
+            raise ValidationError(
+                "aggregations over shards on multiple nodes are not "
+                "supported yet — shrink the index to one node or drop "
+                "the aggs clause")
+
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
         sub = dict(body)
@@ -295,21 +307,9 @@ class ClusterNode:
             ms = r["hits"]["max_score"]
             if ms is not None and (max_score is None or ms > max_score):
                 max_score = ms
-        sort_specs = _parse_sort(body.get("sort"))
-        if sort_specs is None:
-            rows.sort(key=lambda t: (-(t[0]["_score"] or 0.0), t[1], t[2]))
-        else:
-            # merge per-node sorted lists by their sort keys (the
-            # SearchPhaseController.sortDocs merge)
-            cmp = _sort_comparator(sort_specs)
-            rows.sort(key=functools.cmp_to_key(
-                lambda a, b: cmp({"sort": a[0].get("sort", []),
-                                  "seg": a[1], "local": a[2]},
-                                 {"sort": b[0].get("sort", []),
-                                  "seg": b[1], "local": b[2]})))
-        all_hits = [h for h, _n, _p in rows]
+        all_hits = merge_hit_rows(rows, body.get("sort"))
         n_shards = len(routing)
-        return {
+        out = {
             "took": max((resp["resp"]["took"] for resp in responses),
                         default=0),
             "timed_out": False,
@@ -319,6 +319,10 @@ class ClusterNode:
                      "max_score": max_score,
                      "hits": all_hits[from_: from_ + size]},
         }
+        if aggs_requested and len(responses) == 1:
+            # single data node computed the full aggregation — passthrough
+            out["aggregations"] = responses[0]["resp"].get("aggregations")
+        return out
 
     def _h_search_shards(self, payload: dict) -> dict:
         svc = self.indices.get(payload["index"])

@@ -381,6 +381,14 @@ class InternalEngine:
                 os.fsync(f.fileno())
             os.replace(tmp, os.path.join(self.data_path, self.COMMIT_FILE))
             self.translog.trim(self.translog.generation)
+            # Delete tombstones at or below the committed max seq-no are
+            # durable in the persisted live bitmaps now — prune them so a
+            # delete-heavy workload doesn't grow the version map forever
+            # (the reference's GC-deletes keyed on checkpoint advancement).
+            committed_seq = commit["max_seq_no"]
+            self._version_map = {
+                k: v for k, v in self._version_map.items()
+                if not (v.deleted and v.seq_no <= committed_seq)}
             # the new commit no longer references merged-away segments —
             # their files are safe to delete now
             for seg_id in self._obsolete_files:

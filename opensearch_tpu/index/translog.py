@@ -47,37 +47,74 @@ class Translog:
             self.min_generation = ckp["min_generation"]
         # a torn tail (kill -9 mid-append) must be truncated BEFORE we
         # append again, or the next op would merge with the garbage bytes
-        # into one bad-CRC line and a later recovery would drop it
-        self._truncate_torn_tail(self._gen_path(self.generation))
+        # into one bad-CRC line and a later recovery would drop it.
+        # synced_offset = bytes of the active generation known durable
+        # (below it corruption means acked data loss -> raise; at/past it
+        # the ops were never acked, so truncation is always safe).
+        synced = 0
+        if ckp is not None and ckp.get("generation") == self.generation:
+            synced = int(ckp.get("synced_offset", 0))
+        self._truncate_torn_tail(self._gen_path(self.generation), synced)
         self._file = open(self._gen_path(self.generation), "ab")
+        self._synced_offset = min(synced,
+                                  os.path.getsize(
+                                      self._gen_path(self.generation)))
         self._ops_since_sync = 0
 
     @staticmethod
-    def _truncate_torn_tail(path: str):
+    def _truncate_torn_tail(path: str, synced_offset: int = 0):
+        """Truncate a torn tail so the generation can be appended to again.
+
+        Corruption BELOW ``synced_offset`` (the fsync high-water mark from
+        the checkpoint) followed by a later valid record means acked ops
+        would be silently discarded by truncation — raise instead
+        (reference: TranslogCorruptedException for non-tail corruption).
+        Corruption at/past the synced offset was never acked: out-of-order
+        page writeback can persist a later unacked op but not an earlier
+        one, so truncating from the first bad byte is always safe there."""
         if not os.path.exists(path):
             return
+
+        def line_ok(line: bytes) -> bool:
+            if len(line) < 8:
+                return False
+            try:
+                expected = int(line[:8], 16)
+            except ValueError:
+                return False
+            return (zlib.crc32(line[8:]) & 0xFFFFFFFF) == expected
+
         with open(path, "rb") as f:
             data = f.read()
         good_end = 0
+        first_bad = None
         pos = 0
         while pos < len(data):
             nl = data.find(b"\n", pos)
-            if nl < 0:
-                break                    # unterminated tail
-            line = data[pos:nl]
-            if len(line) >= 8:
-                try:
-                    expected = int(line[:8], 16)
-                except ValueError:
-                    break
-                if (zlib.crc32(line[8:]) & 0xFFFFFFFF) != expected:
-                    break
-                good_end = nl + 1
-            elif line:
-                break
+            line = data[pos: nl if nl >= 0 else len(data)]
+            terminated = nl >= 0
+            if not line and terminated:   # blank line, keep walking
+                if first_bad is None:
+                    good_end = nl + 1
+                pos = nl + 1
+                continue
+            if terminated and line_ok(line):
+                if first_bad is not None and first_bad < synced_offset:
+                    raise TranslogCorruptedError(
+                        f"translog [{path}] has a valid record after "
+                        f"corrupt data at byte [{first_bad}] (< synced "
+                        f"offset {synced_offset}) — acked ops are "
+                        "corrupt, refusing to truncate them away")
+                if first_bad is None:
+                    good_end = nl + 1
+                # else: unacked bad region followed by unacked valid ops —
+                # truncate from first_bad; the valid-but-unacked ops after
+                # it are discarded (never acknowledged, safe to lose)
             else:
-                good_end = nl + 1        # blank line, keep walking
-            pos = nl + 1
+                # bad or unterminated line: candidate torn tail
+                if first_bad is None:
+                    first_bad = pos
+            pos = nl + 1 if terminated else len(data)
         if good_end < len(data):
             with open(path, "r+b") as f:
                 f.truncate(good_end)
@@ -101,7 +138,9 @@ class Translog:
         tmp = p + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"generation": self.generation,
-                       "min_generation": self.min_generation}, f)
+                       "min_generation": self.min_generation,
+                       "synced_offset": getattr(self, "_synced_offset", 0)},
+                      f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, p)
@@ -124,10 +163,15 @@ class Translog:
         self._ops_since_sync += 1
 
     def sync(self):
-        """Durability barrier (ensureSynced analog)."""
+        """Durability barrier (ensureSynced analog).  Advances the fsync
+        high-water mark in the checkpoint, like the reference's per-sync
+        Checkpoint file — recovery uses it to tell acked-data corruption
+        (fatal) from unacked-tail garbage (truncatable)."""
         self._file.flush()
         os.fsync(self._file.fileno())
+        self._synced_offset = self._file.tell()
         self._ops_since_sync = 0
+        self._write_checkpoint()
 
     def roll_generation(self):
         """Start a new generation file (pre-commit, rollGeneration analog)."""
@@ -135,6 +179,7 @@ class Translog:
         self._file.close()
         self.generation += 1
         self._file = open(self._gen_path(self.generation), "ab")
+        self._synced_offset = 0
         self._write_checkpoint()
 
     def trim(self, min_generation: int):

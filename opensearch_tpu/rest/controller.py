@@ -544,11 +544,13 @@ class RestController:
         sub["from"] = 0
         sub["size"] = from_ + size
         responses = [svc.search(sub) for svc in services]
-        all_hits = []
-        for resp in responses:
-            all_hits.extend(resp["hits"]["hits"])
-        if body.get("sort") is None:
-            all_hits.sort(key=lambda h: (-(h["_score"] or 0), h["_index"]))
+        rows = []
+        for resp_idx, resp in enumerate(responses):
+            for pos, h in enumerate(resp["hits"]["hits"]):
+                rows.append((h, resp_idx, pos))
+        from opensearch_tpu.search.executor import merge_hit_rows
+
+        all_hits = merge_hit_rows(rows, body.get("sort"))
         total = sum(r["hits"]["total"]["value"] for r in responses)
         max_score = max((r["hits"]["max_score"] or float("-inf")
                          for r in responses), default=None)

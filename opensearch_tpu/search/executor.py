@@ -422,6 +422,34 @@ def _sort_comparator(specs):
     return cmp
 
 
+def merge_hit_rows(rows, sort_json):
+    """Coordinator-side merge of per-source sorted hit lists — the
+    SearchPhaseController.sortDocs analog shared by the cluster
+    scatter-gather and the REST multi-index merge.
+
+    ``rows``: list of ``(hit, source_ordinal, position)`` where hits from
+    each source arrive already sorted and position is the hit's rank
+    within its source.  Without a sort clause, merges by
+    (score desc, source, position); with one, merges by the hits' sort
+    keys with (source, position) as the tie-break.  Returns hits in
+    merged order.
+    """
+    import functools
+
+    specs = _parse_sort(sort_json)
+    if specs is None:
+        rows = sorted(rows, key=lambda t: (-(t[0]["_score"] or 0.0),
+                                           t[1], t[2]))
+    else:
+        cmp = _sort_comparator(specs)
+        rows = sorted(rows, key=functools.cmp_to_key(
+            lambda a, b: cmp({"sort": a[0].get("sort", []),
+                              "seg": a[1], "local": a[2]},
+                             {"sort": b[0].get("sort", []),
+                              "seg": b[1], "local": b[2]})))
+    return [h for h, _s, _p in rows]
+
+
 def _sort_value(v):
     if v is None:
         return None

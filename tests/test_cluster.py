@@ -154,3 +154,36 @@ def test_no_master_rejects_admin(tmp_path):
     with pytest.raises(NoMasterError):
         node.create_index("x", {})
     node.stop()
+
+
+def test_cluster_search_aggs_single_node_passthrough(cluster):
+    """Aggs on an index whose shards all live on one node flow through the
+    coordinator merge instead of being silently dropped (round-2 advisor
+    finding)."""
+    hub, ids, nodes = cluster
+    nodes["n0"].create_index("agg1", {"settings": {"number_of_shards": 1}})
+    wait_until(lambda: any("agg1" in nodes[i].indices for i in ids))
+    for i in range(6):
+        nodes["n0"].index_doc("agg1", str(i), {"v": i % 2})
+    nodes["n0"].refresh("agg1")
+    resp = nodes["n1"].search("agg1", {
+        "size": 0, "aggs": {"vals": {"terms": {"field": "v"}}}})
+    assert "aggregations" in resp
+    buckets = resp["aggregations"]["vals"]["buckets"]
+    assert sorted(b["doc_count"] for b in buckets) == [3, 3]
+
+
+def test_cluster_search_aggs_multi_node_rejected(cluster):
+    """Cross-node agg reduce is not implemented yet: must error loudly,
+    never silently drop the aggregations clause."""
+    from opensearch_tpu.common.errors import ValidationError
+
+    hub, ids, nodes = cluster
+    nodes["n0"].create_index("agg6", {"settings": {"number_of_shards": 6}})
+    wait_until(lambda: all("agg6" in nodes[i].indices for i in ids))
+    for i in range(12):
+        nodes["n0"].index_doc("agg6", str(i), {"v": i % 3})
+    nodes["n0"].refresh("agg6")
+    with pytest.raises(ValidationError):
+        nodes["n0"].search("agg6", {
+            "size": 0, "aggs": {"vals": {"terms": {"field": "v"}}}})

@@ -260,3 +260,81 @@ def test_sequence_numbers_monotonic(tmp_path):
     assert seqs == list(range(6))
     assert eng.stats()["seq_no"]["max_seq_no"] == 5
     eng.close()
+
+
+def test_mid_file_translog_corruption_raises(tmp_path):
+    """Corruption BEFORE valid, fsynced records must raise at open — never
+    silently truncate acked ops (reference: TranslogCorruptedException)."""
+    import pytest
+
+    from opensearch_tpu.index.translog import TranslogCorruptedError
+
+    eng = new_engine(tmp_path)
+    eng.index("1", {"n": 1})
+    eng.index("2", {"n": 2})
+    eng.ensure_synced()
+    gen = eng.translog.generation
+    del eng
+    log = tmp_path / "translog" / f"translog-{gen}.log"
+    data = log.read_bytes()
+    lines = data.split(b"\n")
+    assert len(lines) >= 3          # two records + trailing empty
+    # flip a byte inside the FIRST record's payload: corruption followed
+    # by a valid record is mid-file, not a torn tail
+    first = bytearray(lines[0])
+    first[-1] ^= 0xFF
+    lines[0] = bytes(first)
+    log.write_bytes(b"\n".join(lines))
+    with pytest.raises(TranslogCorruptedError):
+        new_engine(tmp_path)
+
+
+def test_delete_tombstones_pruned_on_flush(tmp_path):
+    """Delete tombstones must not outlive the commit that made the
+    deletes durable (GC-deletes analog) or delete-heavy workloads grow
+    the version map without bound."""
+    eng = new_engine(tmp_path)
+    for i in range(20):
+        eng.index(str(i), {"n": i})
+    for i in range(15):
+        eng.delete(str(i))
+    eng.refresh()
+    tombstones = sum(1 for v in eng._version_map.values() if v.deleted)
+    assert tombstones == 15         # retained until the flush commit
+    eng.flush()
+    tombstones = sum(1 for v in eng._version_map.values() if v.deleted)
+    assert tombstones == 0
+    # deleted docs stay deleted after the prune + reopen
+    assert eng.get("3") is None or eng.get("3").get("found") is False
+    eng.close()
+    eng2 = new_engine(tmp_path)
+    eng2.refresh()
+    assert len(search_ids(eng2)) == 5
+    eng2.close()
+
+
+def test_unacked_garbage_then_valid_record_truncated(tmp_path):
+    """Out-of-order page writeback can persist a later UNACKED op but not
+    an earlier one.  Corruption at/past the fsync high-water mark is
+    unacked garbage — truncate it (and any unacked valid ops after it),
+    never raise."""
+    import zlib
+
+    from opensearch_tpu.index.translog import Translog
+
+    tl = Translog(str(tmp_path / "tl"))
+    tl.add({"op": "index", "id": "1", "seq_no": 0})
+    tl.sync()                               # high-water mark: op 1 acked
+    path = tl._gen_path(tl.generation)
+    tl._file.close()
+    # simulate: two unacked appends, the first lost to a torn page, the
+    # second (with a VALID crc) persisted
+    payload = b'{"op":"index","id":"3","seq_no":2}'
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    with open(path, "ab") as f:
+        f.write(b"deadbeefGARBAGE\n")
+        f.write(f"{crc:08x}".encode() + payload + b"\n")
+    tl2 = Translog(str(tmp_path / "tl"))    # must truncate, not raise
+    ops = list(tl2.read_ops())
+    assert [o["id"] for o in ops] == ["1"]
+    tl2.close()

@@ -270,3 +270,26 @@ def test_search_empty_node_and_no_match_wildcard(tmp_path):
     status, body = call(n, "POST", "/nomatch-*/_search", {})
     assert status == 200 and body["hits"]["hits"] == []
     n.stop()
+
+
+def test_multi_index_search_with_sort_merges_globally(node):
+    """Explicit sort across indices must merge by sort key, not
+    concatenate per-index sorted lists (round-2 advisor finding)."""
+    call(node, "PUT", "/msort_a",
+         {"mappings": {"properties": {"k": {"type": "long"}}}})
+    call(node, "PUT", "/msort_b",
+         {"mappings": {"properties": {"k": {"type": "long"}}}})
+    call(node, "PUT", "/msort_a/_doc/a3?refresh=true", {"k": 3})
+    call(node, "PUT", "/msort_a/_doc/a5?refresh=true", {"k": 5})
+    call(node, "PUT", "/msort_b/_doc/b1?refresh=true", {"k": 1})
+    call(node, "PUT", "/msort_b/_doc/b2?refresh=true", {"k": 2})
+    status, body = call(node, "POST", "/msort_a,msort_b/_search",
+                        {"query": {"match_all": {}},
+                         "sort": [{"k": "asc"}]})
+    assert status == 200
+    ks = [h["sort"][0] for h in body["hits"]["hits"]]
+    assert ks == [1, 2, 3, 5]
+    status, body = call(node, "POST", "/msort_a,msort_b/_search",
+                        {"query": {"match_all": {}},
+                         "sort": [{"k": "desc"}], "size": 2})
+    assert [h["sort"][0] for h in body["hits"]["hits"]] == [5, 3]
