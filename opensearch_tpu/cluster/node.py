@@ -26,12 +26,14 @@ from opensearch_tpu.search.executor import merge_hit_rows
 
 from opensearch_tpu.common.errors import (
     IndexNotFoundError,
+    NodeDisconnectedError,
     OpenSearchTpuError,
     ShardNotFoundError,
     ValidationError,
 )
 from opensearch_tpu.cluster.coordination import CoordinationError, Coordinator
-from opensearch_tpu.cluster.state import ClusterState, allocate_shards
+from opensearch_tpu.cluster.state import (ClusterState, allocate_shards,
+                                          copies_of)
 from opensearch_tpu.indices.service import IndexService
 from opensearch_tpu.transport.service import TransportService
 
@@ -41,6 +43,14 @@ A_WRITE_SHARD = "indices:data/write/shard"
 A_GET_DOC = "indices:data/read/get"
 A_SEARCH_SHARDS = "indices:data/read/search[shards]"
 A_REFRESH = "indices:admin/refresh"
+# replication + recovery (ReplicationOperation / SegmentReplication /
+# PeerRecovery action families)
+A_REPLICATE_OP = "indices:data/write/shard[r]"
+A_PUBLISH_CKPT = "indices:admin/replication/checkpoint"
+A_FETCH_SEGMENTS = "indices:admin/replication/segments"
+A_START_RECOVERY = "internal:index/shard/recovery/start"
+A_FAIL_COPY = "internal:cluster/shard/failure"
+A_SHARD_RECOVERED = "internal:cluster/shard/started"
 
 
 class NoMasterError(CoordinationError):
@@ -59,6 +69,12 @@ class ClusterNode:
         self.coordinator = Coordinator(
             node_id, transport, voting_nodes,
             node_info={"name": node_id}, on_apply=self._apply_state)
+        # (index, shard) -> "primary" | "replica" as applied locally
+        self._roles: dict[tuple, str] = {}
+        # (index, shard) replica copies that completed peer recovery in
+        # THIS process (an engine reopened after restart must re-recover)
+        self._recovered: set[tuple] = set()
+        self._recovering: set[tuple] = set()
         t = transport
         t.register_handler(A_CREATE_INDEX, self._h_create_index)
         t.register_handler(A_DELETE_INDEX, self._h_delete_index)
@@ -66,22 +82,36 @@ class ClusterNode:
         t.register_handler(A_GET_DOC, self._h_get_doc)
         t.register_handler(A_SEARCH_SHARDS, self._h_search_shards)
         t.register_handler(A_REFRESH, self._h_refresh)
+        t.register_handler(A_REPLICATE_OP, self._h_replicate_op)
+        t.register_handler(A_PUBLISH_CKPT, self._h_publish_ckpt)
+        t.register_handler(A_FETCH_SEGMENTS, self._h_fetch_segments)
+        t.register_handler(A_START_RECOVERY, self._h_start_recovery)
+        t.register_handler(A_FAIL_COPY, self._h_fail_copy)
+        t.register_handler(A_SHARD_RECOVERED, self._h_shard_recovered)
 
     # -- state application (IndicesClusterStateService analog) ------------
 
     def _apply_state(self, state: ClusterState):
+        to_promote: list[tuple] = []
+        to_recover: list[tuple] = []
         with self._lock:
             for index, meta in state.indices.items():
                 routing = state.routing.get(index, [])
-                mine = [s for s, owner in enumerate(routing)
-                        if owner == self.node_id]
+                mine: dict[int, str] = {}
+                for s, entry in enumerate(routing):
+                    if entry.get("primary") == self.node_id:
+                        mine[s] = "primary"
+                    elif self.node_id in (entry.get("replicas") or []):
+                        mine[s] = "replica"
                 svc = self.indices.get(index)
                 if svc is None:
                     if mine:
-                        self.indices[index] = IndexService(
+                        svc = IndexService(
                             index, os.path.join(self.data_path, index),
                             dict(meta.get("settings") or {}),
-                            meta.get("mappings"), local_shard_ids=mine)
+                            meta.get("mappings"),
+                            local_shard_ids=sorted(mine))
+                        self.indices[index] = svc
                 else:
                     want = set(mine)
                     have = set(svc.local_shards)
@@ -89,10 +119,130 @@ class ClusterNode:
                         svc.add_local_shard(s)
                     for s in have - want:
                         svc.remove_local_shard(s)
+                        self._roles.pop((index, s), None)
+                        self._recovered.discard((index, s))
+                for s, role in mine.items():
+                    entry = routing[s]
+                    prev = self._roles.get((index, s))
+                    self._roles[(index, s)] = role
+                    if role == "primary":
+                        if prev == "replica":
+                            # failover promotion: replay buffered ops
+                            # under the bumped term (fencing)
+                            to_promote.append(
+                                (index, s, entry["primary_term"]))
+                        self._recovered.add((index, s))
+                    elif role == "replica":
+                        if ((index, s) not in self._recovered
+                                and (index, s) not in self._recovering
+                                and entry.get("primary")):
+                            self._recovering.add((index, s))
+                            to_recover.append(
+                                (index, s, entry["primary"]))
             for index in list(self.indices):
                 if index not in state.indices:
                     self.indices[index].close()
                     del self.indices[index]
+                    for key in [k for k in self._roles if k[0] == index]:
+                        del self._roles[key]
+                        self._recovered.discard(key)
+        for index, s, term in to_promote:
+            try:
+                self.indices[index].engine_for(s).promote_to_primary(term)
+            except OpenSearchTpuError:
+                pass
+        for index, s, primary in to_recover:
+            threading.Thread(
+                target=self._run_recovery, args=(index, s, primary),
+                daemon=True,
+                name=f"recovery-{self.node_id}-{index}-{s}").start()
+
+    # -- peer recovery (replica side) -------------------------------------
+
+    def _run_recovery(self, index: str, shard: int, primary: str):
+        """Bootstrap this node's replica copy from the primary: segment
+        file copy (phase 1; phase-2 op replay is subsumed by the live
+        A_REPLICATE_OP stream that started when the copy was assigned),
+        then report recovered so the master adds us to the in-sync set
+        (ref indices/recovery/RecoverySourceHandler.java:105,
+        ReplicationTracker.markAllocationIdAsInSync:1533)."""
+        try:
+            resp = self.transport.send_request(
+                primary, A_START_RECOVERY,
+                {"index": index, "shard": shard}, timeout=30.0)
+            svc = self.indices.get(index)
+            if svc is None:
+                return
+            engine = svc.engine_for(shard)
+            engine.install_checkpoint(resp["ckpt"], resp["blobs"])
+            svc.invalidate_searcher()
+            master = self._master()
+            payload = {"index": index, "shard": shard,
+                       "node": self.node_id}
+            if master == self.node_id:
+                self._h_shard_recovered(payload)
+            else:
+                self.transport.send_request(master, A_SHARD_RECOVERED,
+                                            payload, timeout=10.0)
+            with self._lock:
+                self._recovered.add((index, shard))
+        except OpenSearchTpuError:
+            pass   # next cluster-state application retries
+        finally:
+            with self._lock:
+                self._recovering.discard((index, shard))
+
+    def _h_start_recovery(self, payload: dict) -> dict:
+        """Primary side: refresh so every acked op is segment-covered,
+        then ship the full segment set."""
+        svc = self.indices.get(payload["index"])
+        if svc is None:
+            raise ShardNotFoundError(
+                f"[{payload['index']}][{payload['shard']}] not on this node")
+        engine = svc.engine_for(payload["shard"])
+        engine.refresh()
+        ckpt = engine.checkpoint_info()
+        return {"ckpt": ckpt, "blobs": engine.segments_blobs(ckpt["segments"])}
+
+    def _h_shard_recovered(self, payload: dict) -> dict:
+        index, shard, node = (payload["index"], payload["shard"],
+                              payload["node"])
+
+        def update(state: ClusterState) -> ClusterState:
+            routing = {k: [dict(e) for e in v]
+                       for k, v in state.routing.items()}
+            entries = routing.get(index)
+            if entries is None or shard >= len(entries):
+                return state
+            e = entries[shard]
+            if node in (e.get("replicas") or []) and node not in e["in_sync"]:
+                e["in_sync"] = list(e["in_sync"]) + [node]
+                return state.with_(routing=routing)
+            return state
+        self.coordinator.submit_state_update(update)
+        return {"acknowledged": True}
+
+    def _h_fail_copy(self, payload: dict) -> dict:
+        """Master: drop a failed replica copy from the shard group and
+        re-allocate a replacement (ReplicationOperation's fail-shard call
+        to the cluster manager)."""
+        index, shard, node = (payload["index"], payload["shard"],
+                              payload["node"])
+
+        def update(state: ClusterState) -> ClusterState:
+            routing = {k: [dict(e) for e in v]
+                       for k, v in state.routing.items()}
+            entries = routing.get(index)
+            if entries is None or shard >= len(entries):
+                return state
+            e = entries[shard]
+            if node not in (e.get("replicas") or []):
+                return state
+            e["replicas"] = [r for r in e["replicas"] if r != node]
+            e["in_sync"] = [n for n in e["in_sync"] if n != node]
+            return allocate_shards(state.with_(routing=routing))
+        self.coordinator.submit_state_update(update)
+        return {"acknowledged": True}
 
     # -- master proxying ---------------------------------------------------
 
@@ -155,12 +305,20 @@ class ClusterNode:
 
     # -- document API ------------------------------------------------------
 
-    def _owner(self, index: str, shard: int) -> str:
+    def _entry(self, index: str, shard: int) -> dict:
         state = self.coordinator.state()
         routing = state.routing.get(index)
         if routing is None:
             raise IndexNotFoundError(index)
         return routing[shard]
+
+    def _owner(self, index: str, shard: int) -> str:
+        """The primary copy's node — all writes route here."""
+        primary = self._entry(index, shard).get("primary")
+        if primary is None:
+            raise ShardNotFoundError(
+                f"[{index}][{shard}] has no assigned primary")
+        return primary
 
     def _shard_for(self, index: str, doc_id: str,
                    routing: Optional[str] = None) -> int:
@@ -197,30 +355,91 @@ class ClusterNode:
     def get_doc(self, index: str, doc_id: str,
                 routing: Optional[str] = None) -> Optional[dict]:
         shard = self._shard_for(index, doc_id, routing)
-        owner = self._owner(index, shard)
+        entry = self._entry(index, shard)
         payload = {"index": index, "shard": shard, "id": str(doc_id)}
-        if owner == self.node_id:
+        # prefer the local copy (replica realtime GET reads the op buffer,
+        # the adaptive-replica-selection degenerate case) — but only an
+        # IN-SYNC one: a replica still in peer recovery is empty
+        if (self.node_id in copies_of(entry)
+                and self.node_id in (entry.get("in_sync") or [])):
             resp = self._h_get_doc(payload)
         else:
-            resp = self.transport.send_request(owner, A_GET_DOC, payload,
-                                               timeout=10.0)
+            resp = self.transport.send_request(
+                self._owner(index, shard), A_GET_DOC, payload, timeout=10.0)
         return resp.get("doc")
 
     def _h_write_shard(self, payload: dict) -> dict:
-        svc = self.indices.get(payload["index"])
+        """Primary write: execute locally, then fan the op out to every
+        assigned replica and wait — an in-sync replica that fails is
+        reported to the master, which drops it from the group
+        (ReplicationOperation.execute:139 / performOnReplicas:221)."""
+        index, shard = payload["index"], payload["shard"]
+        svc = self.indices.get(index)
         if svc is None:
             raise ShardNotFoundError(
-                f"[{payload['index']}][{payload['shard']}] not on this node")
-        engine = svc.engine_for(payload["shard"])
+                f"[{index}][{shard}] not on this node")
+        engine = svc.engine_for(shard)
+        entry = self._entry(index, shard)
         if payload["op"] == "index":
             r = engine.index(payload["id"], payload["source"],
                              routing=payload.get("routing"))
         else:
             r = engine.delete(payload["id"])
         engine.ensure_synced()
-        return {"_index": payload["index"], "_id": r.doc_id,
+        replicas = list(entry.get("replicas") or [])
+        if replicas:
+            rep_op = {"op": payload["op"], "id": r.doc_id,
+                      "source": payload.get("source"),
+                      "routing": payload.get("routing"),
+                      "seq_no": r.seq_no, "version": r.version,
+                      "primary_term": int(entry.get("primary_term", 1))}
+            rep_payload = {"index": index, "shard": shard, "rep_op": rep_op}
+            futures = [(rep, self.transport.submit_request(
+                rep, A_REPLICATE_OP, rep_payload)) for rep in replicas]
+            in_sync = set(entry.get("in_sync") or [])
+            for rep, fut in futures:
+                try:
+                    fut.result(timeout=10.0)
+                except Exception:
+                    if rep in in_sync:
+                        # the copy must leave the in-sync set BEFORE we ack,
+                        # or a later promotion could elect a copy missing
+                        # this acked op; if the master is unreachable the
+                        # write fails rather than acking unsafely
+                        # (ReplicationOperation's fail-shard-then-respond)
+                        if not self._report_failed_copy(index, shard, rep):
+                            raise NodeDisconnectedError(
+                                f"replica [{rep}] failed and the failure "
+                                "could not be reported to the cluster "
+                                "manager — write not acknowledged")
+                    # non-in-sync copies are still recovering: best effort
+        return {"_index": index, "_id": r.doc_id,
                 "_version": r.version, "_seq_no": r.seq_no,
-                "result": r.result, "_shard": payload["shard"]}
+                "result": r.result, "_shard": shard}
+
+    def _report_failed_copy(self, index: str, shard: int,
+                            node: str) -> bool:
+        try:
+            master = self._master()
+            payload = {"index": index, "shard": shard, "node": node}
+            if master == self.node_id:
+                self._h_fail_copy(payload)
+            else:
+                self.transport.send_request(master, A_FAIL_COPY, payload,
+                                            timeout=10.0)
+            return True
+        except OpenSearchTpuError:
+            return False   # master unreachable
+
+    def _h_replicate_op(self, payload: dict) -> dict:
+        svc = self.indices.get(payload["index"])
+        if svc is None:
+            raise ShardNotFoundError(
+                f"[{payload['index']}][{payload['shard']}] not on this node")
+        engine = svc.engine_for(payload["shard"])
+        engine.apply_replica_op(payload["rep_op"])
+        engine.ensure_synced()
+        return {"acknowledged": True}
 
     def _h_get_doc(self, payload: dict) -> dict:
         svc = self.indices.get(payload["index"])
@@ -236,20 +455,77 @@ class ClusterNode:
         state = self.coordinator.state()
         if index not in state.indices:
             raise IndexNotFoundError(index)
-        nodes = set(state.routing.get(index, []))
-        for node in nodes:
+        nodes = {e["primary"] for e in state.routing.get(index, [])
+                 if e.get("primary")}
+        for node in sorted(nodes):
             payload = {"index": index}
             if node == self.node_id:
                 self._h_refresh(payload)
             else:
                 self.transport.send_request(node, A_REFRESH, payload,
-                                            timeout=10.0)
+                                            timeout=30.0)
 
     def _h_refresh(self, payload: dict) -> dict:
-        svc = self.indices.get(payload["index"])
-        if svc is not None:
-            svc.refresh()
+        """Refresh local primaries, then publish the new segment-set
+        checkpoint to each replica (segrep: the refresh IS the
+        replication trigger, ref RemoteStoreRefreshListener/
+        SegmentReplicationTargetService.onNewCheckpoint:208)."""
+        index = payload["index"]
+        svc = self.indices.get(index)
+        if svc is None:
+            return {"ok": True}
+        svc.refresh()
+        for shard in list(svc.local_shards):
+            if self._roles.get((index, shard)) != "primary":
+                continue
+            try:
+                entry = self._entry(index, shard)
+            except OpenSearchTpuError:
+                continue
+            replicas = entry.get("replicas") or []
+            if not replicas:
+                continue
+            ckpt = svc.engine_for(shard).checkpoint_info()
+            payload2 = {"index": index, "shard": shard, "ckpt": ckpt}
+            futures = [self.transport.submit_request(rep, A_PUBLISH_CKPT,
+                                                     payload2)
+                       for rep in replicas]
+            for fut in futures:
+                try:
+                    fut.result(timeout=30.0)
+                except Exception:
+                    pass   # replica will catch up on the next checkpoint
         return {"ok": True}
+
+    def _h_publish_ckpt(self, payload: dict) -> dict:
+        """Replica: diff the checkpoint against local segments, pull the
+        missing ones from the primary, install."""
+        index, shard, ckpt = payload["index"], payload["shard"], payload["ckpt"]
+        svc = self.indices.get(index)
+        if svc is None:
+            raise ShardNotFoundError(f"[{index}][{shard}] not on this node")
+        engine = svc.engine_for(shard)
+        have = {s.seg_id for s in engine.segments}
+        missing = [sid for sid in ckpt["segments"] if sid not in have]
+        blobs = {}
+        if missing:
+            primary = self._entry(index, shard).get("primary")
+            resp = self.transport.send_request(
+                primary, A_FETCH_SEGMENTS,
+                {"index": index, "shard": shard, "seg_ids": missing},
+                timeout=30.0)
+            blobs = resp["blobs"]
+        engine.install_checkpoint(ckpt, blobs)
+        svc.invalidate_searcher()
+        return {"acknowledged": True}
+
+    def _h_fetch_segments(self, payload: dict) -> dict:
+        svc = self.indices.get(payload["index"])
+        if svc is None:
+            raise ShardNotFoundError(
+                f"[{payload['index']}][{payload['shard']}] not on this node")
+        engine = svc.engine_for(payload["shard"])
+        return {"blobs": engine.segments_blobs(payload["seg_ids"])}
 
     # -- search (scatter-gather) -------------------------------------------
 
@@ -261,9 +537,19 @@ class ClusterNode:
         routing = state.routing.get(index)
         if routing is None:
             raise IndexNotFoundError(index)
+        # one copy per shard: prefer a local IN-SYNC copy (a replica still
+        # in peer recovery is empty), else the primary (degenerate
+        # adaptive replica selection, ref node/ResponseCollectorService.java)
         by_node: dict[str, list[int]] = {}
-        for shard, owner in enumerate(routing):
-            by_node.setdefault(owner, []).append(shard)
+        for shard, entry in enumerate(routing):
+            copies = copies_of(entry)
+            if not copies:
+                raise ShardNotFoundError(f"[{index}][{shard}] unassigned")
+            in_sync = entry.get("in_sync") or []
+            target = (self.node_id
+                      if self.node_id in copies and self.node_id in in_sync
+                      else copies[0])
+            by_node.setdefault(target, []).append(shard)
 
         aggs_requested = bool(body.get("aggs") or body.get("aggregations"))
         if aggs_requested and len(by_node) > 1:

@@ -2,15 +2,32 @@
 
 Analog of ``cluster/ClusterState.java``: term + version ordering,
 discovery nodes, index metadata, and a routing table assigning each
-(index, shard) a primary node.  States travel as generic-value payloads
-over the transport (full states; structural diffs are an optimization the
+(index, shard) a shard GROUP — primary + replicas + in-sync set +
+primary term (the RoutingTable/ShardRouting + ReplicationTracker
+in-sync-allocation-ids analog, ref index/seqno/ReplicationTracker.java:100
+and cluster/routing/).  States travel as generic-value payloads over the
+transport (full states; structural diffs are an optimization the
 reference adds via cluster/Diff.java — semantics are identical).
+
+Shard-group entry shape::
+
+    {"primary": node_id | None,
+     "replicas": [node_id, ...],
+     "in_sync": [node_id, ...],     # copies safe to promote / must ack
+     "primary_term": int}           # bumped on every promotion (fencing)
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field, replace
 from typing import Optional
+
+
+def copies_of(entry: dict) -> list:
+    """All nodes holding a copy of the shard, primary first."""
+    out = [entry["primary"]] if entry.get("primary") else []
+    out.extend(entry.get("replicas") or [])
+    return out
 
 
 @dataclass(frozen=True)
@@ -23,7 +40,7 @@ class ClusterState:
     nodes: dict = field(default_factory=dict)
     # index -> {"settings": ..., "mappings": ...}
     indices: dict = field(default_factory=dict)
-    # index -> [node_id per shard]
+    # index -> [shard-group entry per shard] (see module docstring)
     routing: dict = field(default_factory=dict)
 
     def is_newer_than(self, other: "ClusterState") -> bool:
@@ -52,36 +69,88 @@ class ClusterState:
             master_node=p.get("master_node"),
             nodes=dict(p.get("nodes") or {}),
             indices=dict(p.get("indices") or {}),
-            routing={k: list(v) for k, v in (p.get("routing") or {}).items()},
+            routing={k: [dict(e) for e in v]
+                     for k, v in (p.get("routing") or {}).items()},
         )
 
 
 def allocate_shards(state: ClusterState) -> ClusterState:
-    """Round-robin primary allocation over data nodes — the
-    BalancedShardsAllocator's job at the fidelity this needs: every shard
-    gets exactly one assigned node, spread evenly, stable for already-
-    assigned shards whose node is still in the cluster."""
+    """Shard-group allocation over data nodes — the BalancedShardsAllocator
+    + in-sync-promotion logic at the fidelity this needs:
+
+    - stable: copies on still-alive nodes stay put;
+    - a lost primary is replaced by an IN-SYNC replica (safe promotion)
+      or, failing that, a stale replica (best effort — last resort, like
+      the reference's allocate_stale_primary reroute command), bumping the
+      primary term either way so stale primaries are fenced;
+    - replica slots are (re)filled on the least-loaded nodes that don't
+      already hold a copy of the shard; new replicas start OUTSIDE the
+      in-sync set and join it when peer recovery completes
+      (ReplicationTracker.markAllocationIdAsInSync analog);
+    - a fresh primary with no surviving copy starts empty with an
+      in-sync set of just itself.
+    """
     node_ids = sorted(state.nodes)
     if not node_ids:
         return state
     counts = {n: 0 for n in node_ids}
-    routing = {}
+    routing: dict = {}
+    # pass 1: retain what survives, decide promotions
     for index, meta in state.indices.items():
-        n_shards = int((meta.get("settings") or {}).get("number_of_shards", 1))
+        settings = meta.get("settings") or {}
+        n_shards = int(settings.get("number_of_shards", 1))
+        want_repl = min(int(settings.get("number_of_replicas", 0)),
+                        len(node_ids) - 1)
         old = state.routing.get(index, [])
-        assigned = []
+        entries = []
         for s in range(n_shards):
-            prev = old[s] if s < len(old) else None
-            if prev in counts:
-                assigned.append(prev)
-                counts[prev] += 1
-            else:
-                assigned.append(None)
-        routing[index] = assigned
-    for index, assigned in routing.items():
-        for s, node in enumerate(assigned):
-            if node is None:
+            o = old[s] if s < len(old) and isinstance(old[s], dict) else None
+            primary = o["primary"] if o else None
+            replicas = [r for r in (o.get("replicas") or []) if r in counts] \
+                if o else []
+            in_sync = [n for n in (o.get("in_sync") or []) if n in counts] \
+                if o else []
+            term = int(o.get("primary_term", 1)) if o else 1
+            if primary not in counts:
+                promo = next((r for r in replicas if r in in_sync), None)
+                if promo is None and replicas:
+                    promo = replicas[0]        # stale promotion, last resort
+                    in_sync = []               # its history is authoritative now
+                primary = promo                # may still be None
+                if promo is not None:
+                    replicas.remove(promo)
+                    term += 1
+            entries.append({"primary": primary, "replicas": replicas,
+                            "in_sync": in_sync, "primary_term": term,
+                            "_want": want_repl})
+        routing[index] = entries
+    for entries in routing.values():
+        for e in entries:
+            if e["primary"] is not None:
+                counts[e["primary"]] += 1
+            for r in e["replicas"]:
+                counts[r] += 1
+    # pass 2: fill holes on least-loaded distinct nodes
+    for entries in routing.values():
+        for e in entries:
+            if e["primary"] is None:
                 target = min(sorted(counts), key=lambda n: counts[n])
-                assigned[s] = target
+                e["primary"] = target
                 counts[target] += 1
+                e["in_sync"] = []              # fresh shard: no history
+            holders = set(copies_of(e))
+            while len(e["replicas"]) < e["_want"]:
+                cands = [n for n in sorted(counts) if n not in holders]
+                if not cands:
+                    break
+                target = min(cands, key=lambda n: counts[n])
+                e["replicas"].append(target)
+                holders.add(target)
+                counts[target] += 1
+            del e["_want"]
+            # the primary is always in-sync; drop in-sync entries that no
+            # longer hold a copy
+            e["in_sync"] = ([e["primary"]]
+                            + [n for n in e["in_sync"]
+                               if n != e["primary"] and n in holders])
     return state.with_(routing=routing)

@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import uuid
 from dataclasses import dataclass
 from typing import Optional
 
@@ -31,6 +32,8 @@ from opensearch_tpu.index.store import (
     load_segment,
     save_live,
     save_segment,
+    segment_from_blobs,
+    segment_to_blobs,
 )
 from opensearch_tpu.index.translog import Translog
 from opensearch_tpu.mapping.mapper import DocumentMapper, ParsedDocument
@@ -74,12 +77,20 @@ class InternalEngine:
         self._version_map: dict[str, VersionEntry] = {}
         self._pending_deletes: list[tuple[Segment, int]] = []
         self._seq_no = -1
+        # replica mode: primary-replicated ops not yet covered by an
+        # installed segment checkpoint, keyed by seq_no
+        self._replica_ops: dict[int, dict] = {}
         self._persisted_segments: set[str] = set()
         self._live_dirty: set[str] = set()
         # files superseded by a merge: deleted only AFTER the next commit
         # point lands (Lucene keeps old files until commit)
         self._obsolete_files: set[str] = set()
         self._seg_counter = 0
+        # engine-unique segment-id prefix: segments INSTALLED from another
+        # engine (segment replication / recovery) keep their foreign ids,
+        # so locally-built ids must never collide with them — a promoted
+        # replica builds segments alongside ids minted by the old primary
+        self._engine_uid = uuid.uuid4().hex[:6]
         self._searcher: Optional[ShardSearcher] = None
         self._writer = SegmentWriter()
 
@@ -274,6 +285,111 @@ class InternalEngine:
         """Durability barrier before acking (Translog.ensureSynced analog)."""
         self.translog.sync()
 
+    # -- replica mode (segment replication, NRTReplicationEngine analog) --
+    #
+    # A replica does NOT index: replicated ops land in the translog (for
+    # durability + realtime GET + promotion replay) and become searchable
+    # only when the primary publishes a refresh checkpoint and the replica
+    # installs the copied segments (ref index/engine/NRTReplicationEngine.java,
+    # indices/replication/SegmentReplicationTargetService.java:208).
+
+    def apply_replica_op(self, op: dict):
+        """Apply one primary-replicated op: translog append + version-map
+        entry + op buffer.  Fenced by primary term (a stale primary's ops
+        are rejected, ref IndexShard.applyIndexOperationOnReplica:954)."""
+        with self._lock:
+            self._ensure_open()
+            term = int(op.get("primary_term", 1))
+            if term < self.primary_term:
+                raise VersionConflictError(
+                    str(op.get("id")), f"primary term >= {self.primary_term}",
+                    f"stale primary term {term}")
+            self.primary_term = term
+            seq = int(op["seq_no"])
+            encoded = self.translog.encode(op)
+            self.translog.add_encoded(encoded)
+            self._replica_ops[seq] = op
+            cur = self._version_map.get(op["id"])
+            if cur is None or cur.seq_no < seq:
+                self._version_map[str(op["id"])] = VersionEntry(
+                    seq_no=seq, version=int(op["version"]),
+                    deleted=op["op"] == "delete", hot_idx=-1)
+            self._seq_no = max(self._seq_no, seq)
+
+    def checkpoint_info(self) -> dict:
+        """Current segment-set checkpoint the primary publishes after a
+        refresh (ReplicationCheckpoint analog): segment ids + per-segment
+        live bitmaps (deletes travel with the checkpoint) + seq/term."""
+        with self._lock:
+            self._ensure_open()
+            return {"segments": [s.seg_id for s in self.segments],
+                    "live": {s.seg_id: s.live.tobytes()
+                             for s in self.segments},
+                    "max_seq_no": self._seq_no,
+                    "primary_term": self.primary_term}
+
+    def segments_blobs(self, seg_ids: list) -> dict:
+        """Serialize the requested segments for wire copy (recovery
+        phase-1 / segrep file transfer)."""
+        with self._lock:
+            self._ensure_open()
+            by_id = {s.seg_id: s for s in self.segments}
+            return {sid: segment_to_blobs(by_id[sid]) for sid in seg_ids
+                    if sid in by_id}
+
+    def install_checkpoint(self, ckpt: dict, blobs: dict):
+        """Replica side: adopt the primary's segment set.  Missing
+        segments come from ``blobs``; live bitmaps are overwritten from
+        the checkpoint; buffered ops and version-map entries now covered
+        by segments are dropped."""
+        with self._lock:
+            self._ensure_open()
+            term = int(ckpt.get("primary_term", 1))
+            if term < self.primary_term:
+                raise VersionConflictError(
+                    "<checkpoint>", f"primary term >= {self.primary_term}",
+                    f"stale primary term {term}")
+            self.primary_term = term
+            have = {s.seg_id: s for s in self.segments}
+            new_segments = []
+            for sid in ckpt["segments"]:
+                seg = have.get(sid)
+                if seg is None:
+                    seg = segment_from_blobs(blobs[sid])
+                live = np.frombuffer(ckpt["live"][sid], dtype=bool)
+                if (sid in self._persisted_segments
+                        and not np.array_equal(seg.live, live)):
+                    # deletes travel with the checkpoint: an already-
+                    # persisted segment needs its .liv rewritten on the
+                    # next flush or a restart resurrects deleted docs
+                    self._live_dirty.add(sid)
+                seg.live = live.copy()
+                new_segments.append(seg)
+            self.segments = new_segments
+            covered = int(ckpt["max_seq_no"])
+            self._seq_no = max(self._seq_no, covered)
+            self._replica_ops = {s: op for s, op in self._replica_ops.items()
+                                 if s > covered}
+            self._version_map = {k: v for k, v in self._version_map.items()
+                                 if v.seq_no > covered}
+            self._searcher = None
+
+    def promote_to_primary(self, term: int):
+        """Replica -> primary on failover: replay buffered (not yet
+        segment-covered) ops through the indexing path so they become
+        searchable, under the new primary term (the reference's promotion
+        + translog replay, ref IndexShard routing-change promotion)."""
+        with self._lock:
+            self._ensure_open()
+            self.primary_term = max(int(term), self.primary_term)
+            ops = sorted(self._replica_ops.values(),
+                         key=lambda o: o["seq_no"])
+            self._replica_ops.clear()
+            for op in ops:
+                self._version_map.pop(str(op["id"]), None)
+            for op in ops:
+                self._replay(op)
+
     # -- read path --------------------------------------------------------
 
     def get(self, doc_id: str, realtime: bool = True) -> Optional[dict]:
@@ -292,6 +408,13 @@ class InternalEngine:
                         return {"_id": doc_id, "_version": e.version,
                                 "_seq_no": e.seq_no, "_source": doc.source,
                                 "found": True}
+                    rop = self._replica_ops.get(e.seq_no)
+                    if rop is not None and rop["id"] == doc_id:
+                        # replica realtime GET from the buffered op (the
+                        # reference reads the translog, ShardGetService)
+                        return {"_id": doc_id, "_version": e.version,
+                                "_seq_no": e.seq_no,
+                                "_source": rop["source"], "found": True}
                 # falls through: doc lives in a segment
             # pending (unrefreshed) deletes stay visible to non-realtime
             # reads, exactly like an unrefreshed Lucene reader
@@ -332,18 +455,21 @@ class InternalEngine:
             hot_docs = [d for d in self._hot if d is not None]
             created = 0
             if hot_docs:
-                seg_id = f"seg_{self._seg_counter}"
+                seg_id = f"seg_{self._engine_uid}_{self._seg_counter}"
                 self._seg_counter += 1
                 seg = self._writer.build(hot_docs, seg_id,
                                          vector_meta=self._vector_meta())
                 self.segments.append(seg)
                 created = seg.n_docs
             self._hot.clear()
-            # entries now resolvable from segments; keep only tombstones
+            # entries now resolvable from segments; keep tombstones
             # (deleted-doc versions must survive until trimmed, like the
-            # reference's tombstone retention)
+            # reference's tombstone retention) and entries backed only by
+            # the replica op buffer (no local segment holds them until a
+            # checkpoint installs)
             self._version_map = {k: v for k, v in self._version_map.items()
-                                 if v.deleted}
+                                 if v.deleted
+                                 or v.seq_no in self._replica_ops}
             self._searcher = None
             return created
 
@@ -419,7 +545,7 @@ class InternalEngine:
             if live_docs:
                 per = max(1, -(-len(live_docs) // max_num_segments))
                 for i in range(0, len(live_docs), per):
-                    seg_id = f"seg_{self._seg_counter}"
+                    seg_id = f"seg_{self._engine_uid}_{self._seg_counter}"
                     self._seg_counter += 1
                     self.segments.append(self._writer.build(
                         live_docs[i: i + per], seg_id,

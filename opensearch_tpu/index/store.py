@@ -30,8 +30,10 @@ class CorruptIndexError(OpenSearchTpuError):
     status = 500
 
 
-def save_segment(seg: Segment, dirpath: str):
-    os.makedirs(dirpath, exist_ok=True)
+def _segment_encode(seg: Segment):
+    """Split a Segment into (arrays, meta, src_bytes) — shared by the
+    on-disk writer and the wire serializer (segment replication file copy,
+    ref indices/replication/SegmentReplicationTargetService.java:208)."""
     arrays: dict[str, np.ndarray] = {
         "seq_nos": seg.seq_nos, "versions": seg.versions, "live": seg.live,
     }
@@ -70,11 +72,15 @@ def save_segment(seg: Segment, dirpath: str):
         meta["geo"][f] = {}
         for k in ("offsets", "lats", "lons", "value_docs", "exists"):
             arrays[f"g|{f}|{k}"] = getattr(dv, k)
+    return arrays, meta, b"".join(seg.sources)
 
+
+def save_segment(seg: Segment, dirpath: str):
+    os.makedirs(dirpath, exist_ok=True)
+    arrays, meta, src_bytes = _segment_encode(seg)
     base = os.path.join(dirpath, seg.seg_id)
     with open(base + ".src.tmp", "wb") as f:
-        for b in seg.sources:
-            f.write(b)
+        f.write(src_bytes)
         f.flush()
         os.fsync(f.fileno())
     os.replace(base + ".src.tmp", base + ".src")
@@ -110,7 +116,37 @@ def load_segment(dirpath: str, seg_id: str) -> Segment:
             src_blob = f.read()
     except (OSError, ValueError) as e:
         raise CorruptIndexError(f"cannot read segment [{seg_id}]: {e}") from e
+    seg = _segment_decode(seg_id, meta, z, src_blob)
+    if os.path.exists(base + ".liv"):
+        seg.live = np.load(base + ".liv").copy()
+    return seg
 
+
+def segment_to_blobs(seg: Segment) -> dict:
+    """Serialize a segment to wire-shippable blobs {json, npz, src} — the
+    'file copy' unit of segment replication and peer recovery phase 1
+    (ref indices/recovery/RecoverySourceHandler.java:105)."""
+    import io
+
+    arrays, meta, src_bytes = _segment_encode(seg)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return {"json": json.dumps(meta).encode(), "npz": buf.getvalue(),
+            "src": src_bytes}
+
+
+def segment_from_blobs(blobs: dict) -> Segment:
+    import io
+
+    try:
+        meta = json.loads(blobs["json"].decode())
+        z = np.load(io.BytesIO(blobs["npz"]))
+    except (KeyError, ValueError) as e:
+        raise CorruptIndexError(f"cannot decode segment blobs: {e}") from e
+    return _segment_decode(meta["seg_id"], meta, z, blobs["src"])
+
+
+def _segment_decode(seg_id: str, meta: dict, z, src_blob: bytes) -> Segment:
     seg = Segment(seg_id, meta["n_docs"])
     seg.doc_ids = list(meta["doc_ids"])
     seg.id_to_local = {d: i for i, d in enumerate(seg.doc_ids)}
@@ -120,9 +156,6 @@ def load_segment(dirpath: str, seg_id: str) -> Segment:
     src_offsets = z["src_offsets"]
     seg.sources = [src_blob[src_offsets[i]: src_offsets[i + 1]]
                    for i in range(meta["n_docs"])]
-    if os.path.exists(base + ".liv"):
-        seg.live = np.load(base + ".liv").copy()
-
     for f, m in meta["postings"].items():
         seg.postings[f] = PostingsField(
             terms={t: i for i, t in enumerate(m["terms"])},

@@ -56,9 +56,7 @@ class Translog:
             synced = int(ckp.get("synced_offset", 0))
         self._truncate_torn_tail(self._gen_path(self.generation), synced)
         self._file = open(self._gen_path(self.generation), "ab")
-        self._synced_offset = min(synced,
-                                  os.path.getsize(
-                                      self._gen_path(self.generation)))
+        self._synced_offset = synced
         self._ops_since_sync = 0
 
     @staticmethod
@@ -73,6 +71,10 @@ class Translog:
         page writeback can persist a later unacked op but not an earlier
         one, so truncating from the first bad byte is always safe there."""
         if not os.path.exists(path):
+            if synced_offset > 0:
+                raise TranslogCorruptedError(
+                    f"translog [{path}] is missing but its checkpoint "
+                    f"records {synced_offset} fsynced bytes")
             return
 
         def line_ok(line: bytes) -> bool:
@@ -99,22 +101,26 @@ class Translog:
                 pos = nl + 1
                 continue
             if terminated and line_ok(line):
-                if first_bad is not None and first_bad < synced_offset:
-                    raise TranslogCorruptedError(
-                        f"translog [{path}] has a valid record after "
-                        f"corrupt data at byte [{first_bad}] (< synced "
-                        f"offset {synced_offset}) — acked ops are "
-                        "corrupt, refusing to truncate them away")
                 if first_bad is None:
                     good_end = nl + 1
-                # else: unacked bad region followed by unacked valid ops —
-                # truncate from first_bad; the valid-but-unacked ops after
-                # it are discarded (never acknowledged, safe to lose)
+                # else: bad region followed by valid ops — handled below
+                # (fatal iff the bad region starts below the fsync mark)
             else:
                 # bad or unterminated line: candidate torn tail
                 if first_bad is None:
                     first_bad = pos
             pos = nl + 1 if terminated else len(data)
+        if len(data) < synced_offset:
+            raise TranslogCorruptedError(
+                f"translog [{path}] is shorter ({len(data)}) than its fsync "
+                f"high-water mark ({synced_offset}) — acked ops are missing")
+        if first_bad is not None and first_bad < synced_offset:
+            # corruption inside the acked region — whether or not valid
+            # records follow, truncating would silently drop fsynced ops
+            raise TranslogCorruptedError(
+                f"translog [{path}] is corrupt at byte [{first_bad}] below "
+                f"the fsync high-water mark ({synced_offset}) — acked ops "
+                "are corrupt, refusing to truncate them away")
         if good_end < len(data):
             with open(path, "r+b") as f:
                 f.truncate(good_end)

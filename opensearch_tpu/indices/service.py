@@ -239,6 +239,11 @@ class IndexService:
             engine.refresh()
         self._dirty()
 
+    def invalidate_searcher(self):
+        """Drop the cached node-local searcher (segments changed outside
+        the write path, e.g. a replica installed a checkpoint)."""
+        self._dirty()
+
     def save_meta(self):
         """Persist the CURRENT mapping (incl. dynamically-added fields) —
         after a flush the translog can no longer re-derive them on replay."""

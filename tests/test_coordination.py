@@ -75,7 +75,7 @@ def test_state_update_propagates():
         "logs" in coords[i].state().indices for i in ids))
     routing = coords["node_0"].state().routing["logs"]
     assert len(routing) == 4
-    assert set(routing) <= set(ids)          # spread over nodes
+    assert {e["primary"] for e in routing} <= set(ids)   # spread over nodes
     teardown(coords)
 
 
@@ -159,15 +159,41 @@ def test_allocate_shards_stability():
     st = ClusterState(nodes={"a": {}, "b": {}},
                       indices={"i": {"settings": {"number_of_shards": 4}}})
     st = allocate_shards(st)
-    before = list(st.routing["i"])
-    # add a node: existing assignments stay put
+    before = [e["primary"] for e in st.routing["i"]]
+    # add a node: existing primary assignments stay put
     st2 = allocate_shards(st.with_(nodes={"a": {}, "b": {}, "c": {}}))
-    assert st2.routing["i"] == before
-    # remove node b: only b's shards move
+    assert [e["primary"] for e in st2.routing["i"]] == before
+    # remove node b: only b's shards move (all land on a)
     st3 = allocate_shards(st.with_(nodes={"a": {}}))
-    for old, new in zip(before, st3.routing["i"]):
-        if old == "a":
-            assert new == "a"
-        else:
-            assert new == "a"
+    assert [e["primary"] for e in st3.routing["i"]] == ["a"] * 4
+    teardown({})
+
+
+def test_allocate_shards_replicas_and_promotion():
+    st = ClusterState(nodes={"a": {}, "b": {}, "c": {}},
+                      indices={"i": {"settings": {"number_of_shards": 2,
+                                                  "number_of_replicas": 1}}})
+    st = allocate_shards(st)
+    for e in st.routing["i"]:
+        assert e["primary"] is not None
+        assert len(e["replicas"]) == 1
+        assert e["replicas"][0] != e["primary"]
+        assert e["in_sync"] == [e["primary"]]   # replicas join via recovery
+        assert e["primary_term"] == 1
+    # mark replicas in-sync (recovery completed)
+    routing = {"i": [dict(e, in_sync=[e["primary"]] + e["replicas"])
+                     for e in st.routing["i"]]}
+    st = st.with_(routing=routing)
+    # kill the primary of shard 0: its in-sync replica is promoted with a
+    # term bump and a replacement replica is allocated elsewhere
+    dead = st.routing["i"][0]["primary"]
+    survivor = st.routing["i"][0]["replicas"][0]
+    alive = {n: {} for n in ("a", "b", "c") if n != dead}
+    st2 = allocate_shards(st.with_(nodes=alive))
+    e = st2.routing["i"][0]
+    assert e["primary"] == survivor
+    assert e["primary_term"] == 2
+    assert e["in_sync"][0] == survivor
+    assert len(e["replicas"]) == 1 and e["replicas"][0] != survivor
+    assert e["replicas"][0] not in e["in_sync"]  # fresh copy must recover
     teardown({})

@@ -338,3 +338,49 @@ def test_unacked_garbage_then_valid_record_truncated(tmp_path):
     ops = list(tl2.read_ops())
     assert [o["id"] for o in ops] == ["1"]
     tl2.close()
+
+
+def test_replica_op_stale_primary_term_fenced(tmp_path):
+    """Ops from a deposed primary (lower term) must be rejected — the
+    operation-permit/primary-term fencing analog."""
+    import pytest
+
+    from opensearch_tpu.common.errors import VersionConflictError
+
+    eng = new_engine(tmp_path)
+    eng.apply_replica_op({"op": "index", "id": "a", "source": {"n": 1},
+                          "routing": None, "seq_no": 0, "version": 1,
+                          "primary_term": 2})
+    with pytest.raises(VersionConflictError):
+        eng.apply_replica_op({"op": "index", "id": "b", "source": {"n": 2},
+                              "routing": None, "seq_no": 1, "version": 1,
+                              "primary_term": 1})
+    # realtime GET from the replica op buffer
+    doc = eng.get("a")
+    assert doc["found"] and doc["_source"] == {"n": 1}
+    # promotion replays the buffered op into the indexing path
+    eng.promote_to_primary(term=3)
+    eng.refresh()
+    assert len(search_ids(eng)) == 1
+    assert eng.primary_term == 3
+    eng.close()
+
+
+def test_corrupt_last_acked_record_raises(tmp_path):
+    """Even with NO valid record after it, corruption below the fsync
+    high-water mark is acked-data loss and must raise, not truncate."""
+    import pytest
+
+    from opensearch_tpu.index.translog import (Translog,
+                                               TranslogCorruptedError)
+
+    tl = Translog(str(tmp_path / "tl"))
+    tl.add({"op": "index", "id": "1", "seq_no": 0})
+    tl.sync()
+    path = tl._gen_path(tl.generation)
+    tl._file.close()
+    data = bytearray(open(path, "rb").read())
+    data[10] ^= 0xFF                       # corrupt the acked record
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(TranslogCorruptedError):
+        Translog(str(tmp_path / "tl"))
