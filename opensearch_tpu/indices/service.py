@@ -108,6 +108,7 @@ class IndexService:
             s: self._open_shard(s) for s in sorted(local_shard_ids)}
         self._lock = threading.RLock()
         self._searcher: Optional[ShardSearcher] = None
+        self._mesh_searcher = None
 
     def _open_shard(self, shard_id: int) -> InternalEngine:
         return InternalEngine(os.path.join(self.data_path, str(shard_id)),
@@ -124,6 +125,7 @@ class IndexService:
             if shard_id not in self.local_shards:
                 self.local_shards[shard_id] = self._open_shard(shard_id)
                 self._searcher = None
+                self._mesh_searcher = None
 
     def remove_local_shard(self, shard_id: int):
         with self._lock:
@@ -131,6 +133,7 @@ class IndexService:
             if engine is not None:
                 engine.close()
                 self._searcher = None
+                self._mesh_searcher = None
 
     # -- routing ----------------------------------------------------------
 
@@ -275,11 +278,52 @@ class IndexService:
             return self._searcher
 
     def search(self, body: Optional[dict] = None) -> dict:
-        resp = self.searcher().search(body or {})
+        body = body or {}
+        if self._use_mesh(body):
+            resp = self._mesh_search(body)
+        else:
+            resp = self.searcher().search(body)
         resp["_shards"] = {"total": self.num_shards,
                            "successful": self.num_shards,
                            "skipped": 0, "failed": 0}
         return resp
+
+    # -- device-mesh search path (index.search.mesh: true) ----------------
+
+    def _use_mesh(self, body: dict) -> bool:
+        """Route through the device-collective scatter-gather when the
+        index opted in, shards fit the mesh, and the request is a scored
+        top-k (sort/aggs reduce on the host path for now).  Semantics
+        match the multi-node cluster path: per-shard scoring stats
+        (query_then_fetch), vs the merged-searcher host path's global
+        stats."""
+        flag = self.settings.get("search.mesh")
+        if flag in (None, False, "false"):
+            return False
+        if len(self.local_shards) < 2:
+            return False
+        if (body.get("aggs") or body.get("aggregations")
+                or body.get("sort") is not None):
+            return False
+        import jax
+
+        return len(jax.devices()) >= len(self.local_shards)
+
+    def _mesh_search(self, body: dict) -> dict:
+        from opensearch_tpu.parallel.dist_search import MeshSearcher
+
+        with self._lock:
+            shards = [self.local_shards[s].acquire_searcher()
+                      for s in sorted(self.local_shards)]
+            if (self._mesh_searcher is None
+                    or len(self._mesh_searcher.shards) != len(shards)):
+                self._mesh_searcher = MeshSearcher(shards)
+            else:
+                # keep the per-device staging + compiled merge caches
+                # across refreshes; only the searcher snapshots change
+                self._mesh_searcher.update_shards(shards)
+            ms = self._mesh_searcher
+        return ms.search(body)
 
     def count(self, query: Optional[dict] = None) -> int:
         return self.searcher().count(query)

@@ -19,6 +19,7 @@ docs.
 from __future__ import annotations
 
 from functools import partial
+from typing import Optional
 
 import numpy as np
 
@@ -119,6 +120,198 @@ def prepare_match_query(segments: list, field: str, terms: list[str]):
             budget = max(budget, pad_pow2(local_budget))
         shards.append(sh)
     return stack_shards(shards), {"n_pad": n_pad, "budget": budget}
+
+
+def sharded_topk_merge(mesh: Mesh, k: int, axis: str = "shards"):
+    """The coordinator reduce as an ICI collective: every device holds its
+    shard's local top-k (vals[k] desc, rows already tie-broken locally);
+    all-gather + redundant re-top-k yields the global top-k replicated on
+    every device — replacing SearchPhaseController.sortDocs:175's host
+    heap merge.  Returns (vals[k], flat_idx[k]) where flat_idx indexes the
+    shard-major [S*k] concatenation (shard = flat_idx // k), so ties break
+    (score desc, shard asc, local rank asc) exactly like the host merge."""
+
+    def local(vals):
+        av = lax.all_gather(vals[0], axis)          # [S, k] on every device
+        fv, fi = lax.top_k(av.reshape(-1), k)
+        return fv, fi
+
+    return jax.jit(shard_map(local, mesh=mesh, in_specs=(P(axis),),
+                             out_specs=(P(), P()), check_vma=False))
+
+
+class MeshSearcher:
+    """Distributed search over shards resident on a device mesh: ANY
+    compiled plan (bool/range/match/phrase/knn/...) runs per shard on that
+    shard's own device, and the cross-shard top-k merge is an all-gather
+    collective riding ICI — the device-resident scatter-gather of SURVEY
+    §2.3 (scoring stats are per-shard, like the reference's default
+    query_then_fetch).
+
+    One mesh device per shard; shards may have heterogeneous sizes and
+    segment counts (each compiles its own bucketed program) — only the
+    [S, k] merge is a single SPMD program.
+    """
+
+    def __init__(self, shard_searchers: list, mesh: Optional[Mesh] = None,
+                 axis: str = "shards"):
+        self.shards = shard_searchers
+        self.axis = axis
+        self.mesh = mesh if mesh is not None else make_mesh(
+            len(shard_searchers), axis)
+        self.devices = list(self.mesh.devices.flat)
+        if len(self.devices) < len(self.shards):
+            raise ValueError(
+                f"mesh has {len(self.devices)} devices for "
+                f"{len(self.shards)} shards")
+        self._merge_cache: dict[int, object] = {}
+        # per-(device, segment) staging cache (seg.device() would pin to
+        # the default device; mesh copies are staged per device) — kept
+        # across refreshes, pruned in update_shards
+        self._dsegs: dict = {}
+
+    def update_shards(self, shard_searchers: list):
+        """Swap in fresh per-shard searcher snapshots (after a refresh),
+        keeping the device staging and compiled-merge caches — only
+        segments that no longer exist anywhere are dropped."""
+        if len(shard_searchers) > len(self.devices):
+            raise ValueError(
+                f"mesh has {len(self.devices)} devices for "
+                f"{len(shard_searchers)} shards")
+        self.shards = shard_searchers
+        alive = {seg.seg_id for s in shard_searchers for seg in s.segments}
+        self._dsegs = {key: d for key, d in self._dsegs.items()
+                       if key[1] in alive}
+
+    def _dseg(self, shard_i: int, seg):
+        from opensearch_tpu.index.segment import DeviceSegment
+
+        d = self._dsegs.get((shard_i, seg.seg_id))
+        if d is None:
+            with jax.default_device(self.devices[shard_i]):
+                d = DeviceSegment(seg)
+            self._dsegs[(shard_i, seg.seg_id)] = d
+        return d
+
+    def search(self, body: Optional[dict] = None) -> dict:
+        """Scored top-k search (sort/aggs stay on the host path)."""
+        import time as _time
+
+        from opensearch_tpu.search.compiler import compile_query
+        from opensearch_tpu.search.executor import build_arrays
+        from opensearch_tpu.search.fetch import filter_source
+        from opensearch_tpu.search.query_dsl import parse_query
+        from opensearch_tpu.search import plan as planmod
+
+        body = body or {}
+        t0 = _time.monotonic()
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        k = max(from_ + size, 1)
+        q = parse_query(body.get("query"))
+        min_score = body.get("min_score")
+        ms = np.float32(-np.inf if min_score is None else min_score)
+
+        S = len(self.shards)
+        # Phase 1: DISPATCH every shard's program to its device, keeping
+        # only jnp handles — no host sync inside the loop, so the S
+        # devices execute concurrently (jax async dispatch).
+        shard_vals, shard_rows, totals = [], [], []
+        for si, shard in enumerate(self.shards):
+            dev = self.devices[si]
+            with jax.default_device(dev):
+                if not shard.segments:
+                    shard_vals.append(
+                        jnp.full((1, k), -jnp.inf, jnp.float32))
+                    shard_rows.append((jnp.zeros(k, jnp.int32),
+                                       jnp.zeros(k, jnp.int32)))
+                    totals.append(jnp.int32(0))
+                    continue
+                plan, bind = compile_query(q, shard.ctx, scored=True)
+                needed = plan.arrays()
+                seg_vals, seg_ids, seg_locals = [], [], []
+                total = jnp.int32(0)
+                for gi, seg in enumerate(shard.segments):
+                    dseg = self._dseg(si, seg)
+                    A = build_arrays(dseg, needed, shard.mapper,
+                                     live=shard.ctx.live_jnp(seg, dseg))
+                    dims, ins = plan.prepare(bind, seg, dseg, shard.ctx)
+                    kk = min(k, dseg.n_pad)
+                    vals, idx, tot, _mx = planmod.run_topk(plan, dims, kk,
+                                                           A, ins, ms)
+                    if kk < k:                       # pad to common k
+                        pad = k - kk
+                        vals = jnp.concatenate(
+                            [vals, jnp.full(pad, -jnp.inf, vals.dtype)])
+                        idx = jnp.concatenate(
+                            [idx, jnp.zeros(pad, idx.dtype)])
+                    seg_vals.append(vals)
+                    seg_ids.append(jnp.full(k, gi, jnp.int32))
+                    seg_locals.append(idx)
+                    total = total + tot
+                # shard-local merge of per-segment top-k: flat concat is
+                # segment-major, so top_k's lowest-index tie-break
+                # reproduces the (score desc, seg asc, doc asc) Lucene
+                # merge order
+                cat_v = jnp.concatenate(seg_vals)
+                row_v, pick = lax.top_k(cat_v, k)
+                row_s = jnp.concatenate(seg_ids)[pick]
+                row_l = jnp.concatenate(seg_locals)[pick]
+                shard_vals.append(row_v.reshape(1, k))
+                shard_rows.append((row_s, row_l))
+                totals.append(total)
+
+        # Phase 2: device-collective merge over the mesh (the flagship
+        # reduce riding ICI)
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        vals_g = jax.make_array_from_single_device_arrays(
+            (S, k), sharding, shard_vals)
+        merge = self._merge_cache.get(k)
+        if merge is None:
+            merge = sharded_topk_merge(self.mesh, k, self.axis)
+            self._merge_cache[k] = merge
+        fv, fi = merge(vals_g)
+
+        # Phase 3: host-side fetch of the k winners (first host sync)
+        fv = np.asarray(fv)
+        fi = np.asarray(fi)
+        rows_np = [(np.asarray(s_), np.asarray(l_))
+                   for s_, l_ in shard_rows]
+        total = int(sum(int(t) for t in totals))
+
+        hits = []
+        source_spec = body.get("_source")
+        max_score = None
+        if size > 0 or from_ > 0:
+            for val, flat in zip(fv, fi):
+                if val == -np.inf:
+                    break
+                shard_i, pos = divmod(int(flat), k)
+                seg_i = int(rows_np[shard_i][0][pos])
+                local = int(rows_np[shard_i][1][pos])
+                shard = self.shards[shard_i]
+                seg = shard.segments[seg_i]
+                hit = {"_index": shard.index_name,
+                       "_id": seg.doc_ids[local],
+                       "_score": float(val), "_shard": shard.shard_id}
+                src = filter_source(seg.source(local), source_spec)
+                if src is not None:
+                    hit["_source"] = src
+                hits.append(hit)
+            if hits:
+                max_score = hits[0]["_score"]
+            hits = hits[from_: from_ + size]
+        # size=0: count-only request — null max_score, like the host path
+
+        return {
+            "took": int((_time.monotonic() - t0) * 1000),
+            "timed_out": False,
+            "_shards": {"total": S, "successful": S, "skipped": 0,
+                        "failed": 0},
+            "hits": {"total": {"value": total, "relation": "eq"},
+                     "max_score": max_score,
+                     "hits": hits},
+        }
 
 
 def sharded_bm25_topk(mesh: Mesh, *, n_pad: int, budget: int, k: int,

@@ -293,3 +293,41 @@ def test_multi_index_search_with_sort_merges_globally(node):
                         {"query": {"match_all": {}},
                          "sort": [{"k": "desc"}], "size": 2})
     assert [h["sort"][0] for h in body["hits"]["hits"]] == [5, 3]
+
+
+def test_mesh_search_path_matches_host_merge(node):
+    """index.search.mesh routes REST _search through the device-collective
+    merge; results must match a host scatter-gather over the same
+    per-shard searchers bit-for-bit."""
+    call(node, "PUT", "/meshidx", {
+        "settings": {"number_of_shards": 4, "search.mesh": True},
+        "mappings": {"properties": {"t": {"type": "text"},
+                                    "n": {"type": "long"}}}})
+    lines = []
+    for i in range(60):
+        lines.append({"index": {"_index": "meshidx", "_id": str(i)}})
+        lines.append({"t": f"word{i % 7} common", "n": i})
+    call(node, "POST", "/_bulk?refresh=true", ndjson=lines)
+
+    body = {"query": {"bool": {
+        "must": [{"match": {"t": "common"}}],
+        "filter": [{"range": {"n": {"gte": 10, "lt": 50}}}]}},
+        "size": 12}
+    status, resp = call(node, "POST", "/meshidx/_search", body)
+    assert status == 200
+    assert resp["hits"]["total"]["value"] == 40
+
+    # host-side oracle over the same per-shard searchers
+    from opensearch_tpu.search.executor import merge_hit_rows
+    svc = node.indices.get("meshidx")
+    assert svc._use_mesh(body)        # the request really takes the mesh path
+    rows, total = [], 0
+    for si, s in enumerate(sorted(svc.local_shards)):
+        r = svc.local_shards[s].acquire_searcher().search(dict(body, size=12))
+        total += r["hits"]["total"]["value"]
+        rows.extend((h, si, pos)
+                    for pos, h in enumerate(r["hits"]["hits"]))
+    want = [(h["_id"], h["_score"]) for h in merge_hit_rows(rows, None)[:12]]
+    got = [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
+    assert got == want
+    assert total == 40
