@@ -334,6 +334,20 @@ class DenseVectorFieldType(FieldType):
             raise MapperParsingError(f"dense_vector field [{name}] requires [dims]")
         space = self.params.get("space_type") or self.params.get("similarity") or "l2"
         self.space_type = {"l2_norm": "l2", "dot_product": "innerproduct", "cosine": "cosinesimil"}.get(space, space)
+        # ANN method definition (the opensearch-knn plugin's mapping shape:
+        # {"name": "ivf"|"ivf_pq", "parameters": {nlist, nprobe, m}});
+        # absent -> exact brute force
+        method = self.params.get("method")
+        if method is not None:
+            name = (method.get("name") or "").lower()
+            if name not in ("ivf", "ivf_pq", "flat", "exact"):
+                raise MapperParsingError(
+                    f"unknown knn method [{name}] for field "
+                    f"[{self.name}] — supported: ivf, ivf_pq, flat")
+            self.method = {"name": name,
+                           **(method.get("parameters") or {})}
+        else:
+            self.method = None
 
     def index_terms(self, value, analyzers):
         return []

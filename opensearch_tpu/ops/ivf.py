@@ -1,0 +1,271 @@
+"""IVF (inverted-file) approximate k-NN: k-means coarse quantizer trained
+on device + cluster-probed exact scoring, optionally over PQ codes.
+
+The reference ecosystem's ANN engines (FAISS IVF/IVFPQ via the
+opensearch-knn plugin JNI, SPI at server/src/main/java/org/opensearch/
+plugins/SearchPlugin.java:151) are C++ with hand-written SIMD; graph-based
+HNSW is TPU-hostile (pointer chasing).  The TPU-native formulation keeps
+everything as dense matmul + gather:
+
+- training: Lloyd's iterations are one [n, d] x [d, c] matmul (MXU) for
+  assignment + one scatter-add for the centroid update, all jitted;
+- storage: vectors are re-laid-out as [nlist, c_pad, d] — cluster-major,
+  padded to the max cluster size — so a probe is a static-shape gather,
+  not a variable-length postings walk;
+- search: query -> top-nprobe centroids ([nlist] matmul + top_k) ->
+  gather [nprobe, c_pad, d] -> scored like the exact kernel -> top_k.
+  Static nprobe/c_pad keep the whole program XLA-compilable;
+- IVF-PQ: per-subspace codebooks ([m, 256, dsub]) turn each probe into a
+  LUT build (one small matmul) + table gather, trading recall for an
+  8-32x smaller resident set (BASELINE config #3's IVF-PQ class).
+
+Score translations match ops/knn.py (the opensearch-knn space contract),
+so ANN hits are drop-in comparable with exact ones.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import numpy as np
+
+import opensearch_tpu.common.jaxenv  # noqa: F401
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from opensearch_tpu.index.segment import pad_pow2
+
+
+@partial(jax.jit, static_argnames=("n_clusters",))
+def _kmeans_step(vectors, valid, centroids, *, n_clusters: int):
+    """One Lloyd iteration: assign (matmul + argmin) and update
+    (scatter-add mean).  Empty clusters keep their previous centroid."""
+    v2 = jnp.sum(vectors * vectors, axis=1, keepdims=True)      # [n, 1]
+    c2 = jnp.sum(centroids * centroids, axis=1)[None, :]        # [1, c]
+    d2 = v2 - 2.0 * (vectors @ centroids.T) + c2                # [n, c] MXU
+    assign = jnp.argmin(jnp.where(valid[:, None], d2, jnp.inf), axis=1)
+    assign = jnp.where(valid, assign, n_clusters)               # dead slot
+    sums = jax.ops.segment_sum(
+        jnp.where(valid[:, None], vectors, 0.0), assign,
+        num_segments=n_clusters + 1)[:n_clusters]
+    counts = jax.ops.segment_sum(
+        valid.astype(jnp.float32), assign,
+        num_segments=n_clusters + 1)[:n_clusters]
+    new = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None],
+                    centroids)
+    return new, assign
+
+
+def train_kmeans(vectors: np.ndarray, valid: np.ndarray, n_clusters: int,
+                 iters: int = 10, seed: int = 17):
+    """k-means on device; returns (centroids [c, d] f32, assign [n] i32).
+    Init = random valid points (k-means++ would add host loops for little
+    gain at these cluster counts)."""
+    rng = np.random.default_rng(seed)
+    valid_idx = np.flatnonzero(valid)
+    if len(valid_idx) == 0:
+        raise ValueError("no valid vectors to train on")
+    pick = rng.choice(valid_idx, size=n_clusters,
+                      replace=len(valid_idx) < n_clusters)
+    centroids = jnp.asarray(vectors[pick], jnp.float32)
+    v = jnp.asarray(vectors, jnp.float32)
+    m = jnp.asarray(valid, bool)
+    assign = None
+    for _ in range(iters):
+        centroids, assign = _kmeans_step(v, m, centroids,
+                                         n_clusters=n_clusters)
+    return np.asarray(centroids), np.asarray(assign)
+
+
+@dataclass
+class IvfIndex:
+    """Cluster-major vector layout for static-shape probing."""
+
+    centroids: np.ndarray        # [nlist, d] f32
+    grouped: np.ndarray          # [nlist, c_pad, d] f32
+    grouped_ids: np.ndarray      # [nlist, c_pad] i32 (doc local ids; -1 pad)
+    grouped_valid: np.ndarray    # [nlist, c_pad] bool
+    nlist: int
+    c_pad: int
+
+    @staticmethod
+    def build(vectors: np.ndarray, valid: np.ndarray, nlist: int,
+              iters: int = 10, seed: int = 17) -> "IvfIndex":
+        n, d = vectors.shape
+        nlist = max(1, min(nlist, int(valid.sum())))
+        centroids, assign = train_kmeans(vectors, valid, nlist, iters, seed)
+        order = np.argsort(assign[valid], kind="stable")
+        ids = np.flatnonzero(valid)[order]
+        clusters = assign[ids]
+        counts = np.bincount(clusters, minlength=nlist)
+        c_pad = pad_pow2(max(int(counts.max()), 1))
+        grouped = np.zeros((nlist, c_pad, d), np.float32)
+        grouped_ids = np.full((nlist, c_pad), -1, np.int32)
+        grouped_valid = np.zeros((nlist, c_pad), bool)
+        starts = np.zeros(nlist + 1, np.int64)
+        starts[1:] = np.cumsum(counts)
+        for c in range(nlist):
+            rows = ids[starts[c]: starts[c + 1]]
+            grouped[c, : len(rows)] = vectors[rows]
+            grouped_ids[c, : len(rows)] = rows
+            grouped_valid[c, : len(rows)] = True
+        return IvfIndex(centroids=centroids, grouped=grouped,
+                        grouped_ids=grouped_ids,
+                        grouped_valid=grouped_valid,
+                        nlist=nlist, c_pad=c_pad)
+
+    def device(self):
+        return (jnp.asarray(self.centroids), jnp.asarray(self.grouped),
+                jnp.asarray(self.grouped_ids),
+                jnp.asarray(self.grouped_valid))
+
+
+def _space_scores(dots, v2, q, space: str):
+    """Shared opensearch-knn score translation given dot products and
+    per-vector squared norms."""
+    if space == "l2":
+        d2 = jnp.maximum(v2 - 2.0 * dots + jnp.dot(q, q), 0.0)
+        return 1.0 / (1.0 + d2)
+    if space == "cosinesimil":
+        qn = jnp.sqrt(jnp.dot(q, q))
+        cos = dots / jnp.maximum(jnp.sqrt(v2) * qn, 1e-30)
+        return (1.0 + cos) / 2.0
+    if space == "innerproduct":
+        return jnp.where(dots >= 0, dots + 1.0, 1.0 / (1.0 - dots))
+    raise ValueError(f"unknown space [{space}]")
+
+
+@partial(jax.jit, static_argnames=("space", "k", "nprobe"))
+def ivf_search(centroids, grouped, grouped_ids, grouped_valid, query,
+               live, *, space: str, k: int, nprobe: int):
+    """Single query -> (scores [k], local doc ids [k]; -1/-inf padding).
+
+    ``live`` is the segment's [n_docs_pad] live mask, applied post-gather
+    so deletes need no IVF rebuild (the filter-during-search the FAISS
+    integration does with pre-filter bitsets).
+    """
+    q = query.astype(jnp.float32)
+    # coarse: nearest nprobe centroids by l2 (standard IVF contract)
+    c2 = jnp.sum(centroids * centroids, axis=1)
+    cd = c2 - 2.0 * (centroids @ q)                       # + q2 const
+    _, probes = lax.top_k(-cd, nprobe)                    # [nprobe]
+    pv = grouped[probes]                                  # [P, c_pad, d]
+    pids = grouped_ids[probes]                            # [P, c_pad]
+    pvalid = grouped_valid[probes]
+    flat_v = pv.reshape(-1, pv.shape[-1])                 # [P*c_pad, d]
+    flat_ids = pids.reshape(-1)
+    dots = flat_v @ q
+    v2 = jnp.sum(flat_v * flat_v, axis=1)
+    scores = _space_scores(dots, v2, q, space)
+    ok = (pvalid.reshape(-1)
+          & live[jnp.clip(flat_ids, 0, live.shape[0] - 1)]
+          & (flat_ids >= 0))
+    scores = jnp.where(ok, scores, -jnp.inf)
+    vals, idx = lax.top_k(scores, k)
+    return vals, jnp.where(vals > -jnp.inf, flat_ids[idx], -1)
+
+
+@partial(jax.jit, static_argnames=("space", "k", "nprobe"))
+def ivf_search_batch(centroids, grouped, grouped_ids, grouped_valid,
+                     queries, live, *, space: str, k: int, nprobe: int):
+    """Batched queries [Q, d] -> (scores [Q, k], ids [Q, k])."""
+    fn = partial(ivf_search, space=space, k=k, nprobe=nprobe)
+    return jax.vmap(
+        lambda q: fn(centroids, grouped, grouped_ids, grouped_valid, q,
+                     live))(queries)
+
+
+# ---------------------------------------------------------------------------
+# IVF-PQ: product-quantized residual codes inside each cluster.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class IvfPqIndex:
+    """IVF coarse quantizer + PQ codes of the residuals (vector -
+    centroid), FAISS IVFPQ layout re-expressed as dense arrays."""
+
+    centroids: np.ndarray        # [nlist, d]
+    codebooks: np.ndarray        # [m, 256, dsub]
+    grouped_codes: np.ndarray    # [nlist, c_pad, m] uint8
+    grouped_ids: np.ndarray      # [nlist, c_pad] i32
+    grouped_valid: np.ndarray    # [nlist, c_pad] bool
+    nlist: int
+    c_pad: int
+    m: int
+    dsub: int
+
+    @staticmethod
+    def build(vectors: np.ndarray, valid: np.ndarray, nlist: int,
+              m: int = 8, iters: int = 10, pq_iters: int = 8,
+              seed: int = 17) -> "IvfPqIndex":
+        n, d = vectors.shape
+        if d % m != 0:
+            raise ValueError(f"dim [{d}] not divisible by m [{m}]")
+        dsub = d // m
+        flat = IvfIndex.build(vectors, valid, nlist, iters, seed)
+        nlist, c_pad = flat.nlist, flat.c_pad
+        # residuals of every stored vector against its cluster centroid
+        res = flat.grouped - flat.centroids[:, None, :]   # [nlist,c_pad,d]
+        res_flat = res.reshape(-1, d)
+        vmask = flat.grouped_valid.reshape(-1)
+        codebooks = np.zeros((m, 256, dsub), np.float32)
+        codes = np.zeros((nlist * c_pad, m), np.uint8)
+        for sub in range(m):
+            block = res_flat[:, sub * dsub: (sub + 1) * dsub]
+            cb, assign = train_kmeans(block, vmask,
+                                      min(256, max(1, int(vmask.sum()))),
+                                      pq_iters, seed + sub)
+            codebooks[sub, : cb.shape[0]] = cb
+            codes[:, sub] = np.where(vmask, assign, 0).astype(np.uint8)
+        return IvfPqIndex(
+            centroids=flat.centroids, codebooks=codebooks,
+            grouped_codes=codes.reshape(nlist, c_pad, m),
+            grouped_ids=flat.grouped_ids, grouped_valid=flat.grouped_valid,
+            nlist=nlist, c_pad=c_pad, m=m, dsub=dsub)
+
+    def device(self):
+        return (jnp.asarray(self.centroids), jnp.asarray(self.codebooks),
+                jnp.asarray(self.grouped_codes),
+                jnp.asarray(self.grouped_ids),
+                jnp.asarray(self.grouped_valid))
+
+
+@partial(jax.jit, static_argnames=("k", "nprobe"))
+def ivfpq_search_l2(centroids, codebooks, grouped_codes, grouped_ids,
+                    grouped_valid, query, live, *, k: int, nprobe: int):
+    """ADC (asymmetric distance) IVF-PQ search, l2 space.
+
+    Per probe: residual query r = q - centroid; LUT[m, 256] =
+    ||r_sub - codeword||^2 via one [m*256, dsub] matmul; per-vector
+    distance = sum_m LUT[m, code_m] (table gather).  Returns opensearch
+    l2 scores 1/(1+d2).
+    """
+    q = query.astype(jnp.float32)
+    m, _, dsub = codebooks.shape
+    c2 = jnp.sum(centroids * centroids, axis=1)
+    cd = c2 - 2.0 * (centroids @ q)
+    _, probes = lax.top_k(-cd, nprobe)                    # [P]
+
+    def one_probe(ci):
+        r = q - centroids[ci]                             # [d]
+        rs = r.reshape(m, 1, dsub)                        # [m, 1, dsub]
+        # LUT: squared distance from each sub-residual to each codeword
+        diff = codebooks - rs                             # [m, 256, dsub]
+        lut = jnp.sum(diff * diff, axis=-1)               # [m, 256]
+        codes = grouped_codes[ci].astype(jnp.int32)       # [c_pad, m]
+        # out[i, j] = lut[j, codes[i, j]] == lut.T[codes[i, j], j]
+        d2 = jnp.sum(jnp.take_along_axis(
+            lut.T, codes, axis=0), axis=1)                # [c_pad]
+        ids = grouped_ids[ci]
+        ok = (grouped_valid[ci]
+              & live[jnp.clip(ids, 0, live.shape[0] - 1)] & (ids >= 0))
+        return jnp.where(ok, 1.0 / (1.0 + d2), -jnp.inf), ids
+
+    scores, ids = jax.vmap(one_probe)(probes)             # [P, c_pad]
+    flat_s = scores.reshape(-1)
+    flat_i = ids.reshape(-1)
+    vals, idx = lax.top_k(flat_s, k)
+    return vals, jnp.where(vals > -jnp.inf, flat_i[idx], -1)

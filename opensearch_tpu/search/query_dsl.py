@@ -142,6 +142,9 @@ class KnnQuery(Query):
     vector: list = dc_field(default_factory=list)
     k: int = 10
     filter: Optional[Query] = None
+    # per-request ANN overrides, e.g. {"nprobe": 16} (method_parameters
+    # in the opensearch-knn request shape)
+    method_parameters: Optional[dict] = None
 
 
 @dataclass
