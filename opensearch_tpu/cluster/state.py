@@ -112,6 +112,7 @@ def allocate_shards(state: ClusterState) -> ClusterState:
                 if o else []
             term = int(o.get("primary_term", 1)) if o else 1
             if primary not in counts:
+                lost_primary = primary is not None
                 promo = next((r for r in replicas if r in in_sync), None)
                 if promo is None and replicas:
                     promo = replicas[0]        # stale promotion, last resort
@@ -119,6 +120,12 @@ def allocate_shards(state: ClusterState) -> ClusterState:
                 primary = promo                # may still be None
                 if promo is not None:
                     replicas.remove(promo)
+                if lost_primary:
+                    # bump on EVERY primary change — including the
+                    # no-surviving-copy path (a fresh empty primary gets
+                    # assigned in pass 2): a rejoining old primary must
+                    # not share a term with the new lineage, or replica
+                    # term fencing cannot tell the two apart
                     term += 1
             entries.append({"primary": primary, "replicas": replicas,
                             "in_sync": in_sync, "primary_term": term,

@@ -512,9 +512,15 @@ class InternalEngine:
             # delete-heavy workload doesn't grow the version map forever
             # (the reference's GC-deletes keyed on checkpoint advancement).
             committed_seq = commit["max_seq_no"]
+            # ...but never prune a tombstone still backed only by the
+            # replica op buffer: until a checkpoint installs, no local
+            # segment live-bitmap reflects the delete, and dropping the
+            # entry would let a replica realtime GET resurrect the doc
+            # from an older installed segment (mirrors refresh() above).
             self._version_map = {
                 k: v for k, v in self._version_map.items()
-                if not (v.deleted and v.seq_no <= committed_seq)}
+                if not (v.deleted and v.seq_no <= committed_seq
+                        and v.seq_no not in self._replica_ops)}
             # the new commit no longer references merged-away segments —
             # their files are safe to delete now
             for seg_id in self._obsolete_files:

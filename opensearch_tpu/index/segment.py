@@ -137,6 +137,37 @@ class Segment:
         self.geo_dv: dict[str, GeoDV] = {}
         self.live = np.ones(n_docs, dtype=bool)
         self._device: Optional["DeviceSegment"] = None
+        # trained ANN structures, lazily built per (field, method) — the
+        # segment is immutable so one training pass serves every query
+        # (the k-NN plugin trains at graph-build/flush time; ref
+        # plugins/SearchPlugin.java:151 SPI)
+        self._ann: dict[tuple, object] = {}
+
+    def ann_index(self, field: str, method: dict):
+        """Build-or-fetch the trained IVF/IVF-PQ structure for ``field``.
+
+        Keyed by the method signature so a changed mapping retrains; the
+        padded cluster-major layout is what the device search kernels
+        consume (ops/ivf.py)."""
+        from opensearch_tpu.ops.ivf import IvfIndex, IvfPqIndex
+
+        dv = self.vector_dv.get(field)
+        if dv is None or not dv.exists.any():
+            return None
+        name = method.get("name", "ivf")
+        # default nlist ~ sqrt(n) (FAISS guidance), clamped to >=1
+        nlist = int(method.get("nlist")
+                    or max(1, int(np.sqrt(max(int(dv.exists.sum()), 1)))))
+        m = int(method.get("m", 8))
+        key = (field, name, nlist, m)
+        idx = self._ann.get(key)
+        if idx is None:
+            if name == "ivf_pq" and dv.values.shape[1] % m == 0:
+                idx = IvfPqIndex.build(dv.values, dv.exists, nlist, m=m)
+            else:
+                idx = IvfIndex.build(dv.values, dv.exists, nlist)
+            self._ann[key] = idx
+        return idx
 
     # -- stats used for cross-segment collection statistics ---------------
 
@@ -246,7 +277,20 @@ class DeviceSegment:
                 "exists": jnp.asarray(pad1(dv.exists, n_pad, False)),
             }
         self._live_cache: dict[int, object] = {}
+        self._ann_staged: dict[int, tuple] = {}
         self.live = self.live_jnp(seg.live)
+
+    def ann_staged(self, idx) -> tuple:
+        """Device-staged arrays for a trained ANN index (strong-keyed by
+        the host object so a retrain restages)."""
+        key = id(idx)
+        cached = self._ann_staged.get(key)
+        if cached is None or cached[0] is not idx:
+            cached = (idx, idx.device())
+            if len(self._ann_staged) >= 4:
+                self._ann_staged.pop(next(iter(self._ann_staged)))
+            self._ann_staged[key] = cached
+        return cached[1]
 
     def live_jnp(self, live_np: np.ndarray):
         """Staged live mask for a SNAPSHOT of the live bitmap (keyed by

@@ -173,6 +173,9 @@ class Translog:
         high-water mark in the checkpoint, like the reference's per-sync
         Checkpoint file — recovery uses it to tell acked-data corruption
         (fatal) from unacked-tail garbage (truncatable)."""
+        if self._ops_since_sync == 0 and \
+                self._synced_offset == self._file.tell():
+            return   # already durable: skip the double fsync per op
         self._file.flush()
         os.fsync(self._file.fileno())
         self._synced_offset = self._file.tell()

@@ -506,13 +506,19 @@ def _c_simple_query_string(q, ctx, scored):
 
 
 def _c_knn(q, ctx, scored):
-    """knn query: exact brute-force pre-pass over every segment's vector
-    column (matmul + top-k, ops/knn.py), global per-shard k winners
-    injected into the plan tree as a ScoredMaskPlan.  Optional ``filter``
-    restricts candidates BEFORE the k cut (the plugin's filtered-knn
-    semantics)."""
+    """knn query: per-segment vector search — exact brute force (matmul +
+    top-k, ops/knn.py) or ANN when the field mapping declares a ``method``
+    of ``ivf``/``ivf_pq`` (cluster-probed search, ops/ivf.py; trained
+    structure cached per immutable segment) — with the global per-shard k
+    winners injected into the plan tree as a ScoredMaskPlan.  Optional
+    ``filter`` restricts candidates BEFORE the k cut (the plugin's
+    filtered-knn semantics; ANN falls back to exact under a filter, like
+    the plugin's filtered exact-search rescue).  All segment programs are
+    dispatched asynchronously; the host syncs ONCE per query.
+    """
     import jax.numpy as jnp
 
+    from opensearch_tpu.ops.ivf import IvfPqIndex, ivf_search, ivfpq_search_l2
     from opensearch_tpu.ops.knn import knn_topk
 
     ft = ctx.field_type(q.field)
@@ -530,18 +536,30 @@ def _c_knn(q, ctx, scored):
     space = {"l2": "l2", "cosinesimil": "cosinesimil",
              "innerproduct": "innerproduct"}.get(ft.space_type, "l2")
 
+    method = dict(getattr(ft, "method", None) or {})
+    # method_parameters is a SEARCH-TIME knob: only nprobe may be
+    # overridden per request — structural params (name/nlist/m) define
+    # the trained structure and honoring them here would retrain k-means
+    # on the query path per distinct value
+    if q.method_parameters and "nprobe" in q.method_parameters:
+        method["nprobe"] = int(q.method_parameters["nprobe"])
+    ann_name = method.get("name")
+    use_ann = ann_name in ("ivf", "ivf_pq")
+
     filter_state = None
     if q.filter is not None:
         filter_state = compile_query(q.filter, ctx, scored=False)
 
     qvec_j = jnp.asarray(qvec)
-    candidates = []          # (score, seg_order, local)
+    # phase 1: dispatch every segment's device program, keep DEVICE arrays
+    pending = []             # (seg_order, vals_dev, idx_dev)
     for seg_order, seg in enumerate(ctx.segments):
         dseg = seg.device()
         vcol = dseg.vector.get(q.field)
         if vcol is None:
             continue
-        valid = vcol["exists"] & ctx.live_jnp(seg, dseg)
+        live = ctx.live_jnp(seg, dseg)
+        valid = vcol["exists"] & live
         if filter_state is not None:
             from opensearch_tpu.search.executor import build_arrays
             fplan, fbind = filter_state
@@ -551,9 +569,35 @@ def _c_knn(q, ctx, scored):
                                    jnp.asarray(np.float32(-np.inf)))
             valid = valid & fmask
         kk = min(q.k, dseg.n_pad)
-        vals, idx = knn_topk(vcol["values"], valid, qvec_j, space=space, k=kk)
+        ann = (seg.ann_index(q.field, method)
+               if use_ann and filter_state is None else None)
+        if ann is not None:
+            nprobe = min(int(method.get("nprobe", 0))
+                         or max(1, ann.nlist // 8), ann.nlist)
+            # the probed candidate pool is nprobe*c_pad rows — top_k past
+            # that is a compile error
+            kk = min(kk, nprobe * ann.c_pad)
+            staged = dseg.ann_staged(ann)
+            if isinstance(ann, IvfPqIndex) and space == "l2":
+                vals, idx = ivfpq_search_l2(*staged, qvec_j, valid,
+                                            k=kk, nprobe=nprobe)
+            else:
+                # IvfIndex, or IVF-PQ in a non-l2 space (ADC tables are
+                # l2-residual based; probe the flat layout instead)
+                if isinstance(ann, IvfPqIndex):
+                    ann = seg.ann_index(q.field, {**method, "name": "ivf"})
+                    staged = dseg.ann_staged(ann)
+                vals, idx = ivf_search(*staged, qvec_j, valid,
+                                       space=space, k=kk, nprobe=nprobe)
+        else:
+            vals, idx = knn_topk(vcol["values"], valid, qvec_j,
+                                 space=space, k=kk)
+        pending.append((seg_order, vals, idx))
+    # phase 2: one host sync for all segments' top-k
+    candidates = []          # (score, seg_order, local)
+    for seg_order, vals, idx in pending:
         vals, idx = np.asarray(vals), np.asarray(idx)
-        keep = vals > -np.inf
+        keep = (vals > -np.inf) & (idx >= 0)
         for v, i in zip(vals[keep], idx[keep]):
             candidates.append((float(v), seg_order, int(i)))
     candidates.sort(key=lambda t: (-t[0], t[1], t[2]))

@@ -345,10 +345,12 @@ def _parse_knn(body):
                         vector=list(body.get("query_vector") or body.get("vector")),
                         k=int(body.get("k", 10)),
                         filter=parse_query(body["filter"]) if body.get("filter") else None,
+                        method_parameters=body.get("method_parameters"),
                         boost=_boost(body))
     field, v = _field_kv({k: v for k, v in body.items() if k != "boost"}, "knn")
     return KnnQuery(field=field, vector=list(v["vector"]), k=int(v.get("k", 10)),
                     filter=parse_query(v["filter"]) if v.get("filter") else None,
+                    method_parameters=v.get("method_parameters"),
                     boost=_boost(v))
 
 

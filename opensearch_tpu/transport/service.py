@@ -45,6 +45,7 @@ class ReceiveTimeoutError(OpenSearchTpuError):
 
 class RemoteTransportError(OpenSearchTpuError):
     status = 500
+    remote_type: "str | None" = None   # error_type raised on the remote side
 
 
 def encode_frame(req_id: int, status: int, action: str,
@@ -133,9 +134,11 @@ class TransportService:
             if fut is None:
                 return
             if status & STATUS_ERROR:
-                fut.set_exception(RemoteTransportError(
+                err = RemoteTransportError(
                     f"[{source}][{payload.get('action', action)}] "
-                    f"{payload.get('type')}: {payload.get('reason')}"))
+                    f"{payload.get('type')}: {payload.get('reason')}")
+                err.remote_type = payload.get("type")
+                fut.set_exception(err)
             else:
                 fut.set_result(payload)
             return

@@ -110,3 +110,92 @@ def test_knn_dim_mismatch_rejected():
     with pytest.raises(IllegalArgumentError):
         searcher.search({"query": {"knn": {"vec": {
             "vector": [1.0, 2.0], "k": 3}}}})
+
+
+# ---------------------------------------------------------------------------
+# ANN (IVF / IVF-PQ) wired through the knn query path (VERDICT r3 item 2)
+# ---------------------------------------------------------------------------
+
+
+def build_ann(method, n_docs=600, n_segments=2, dim=32, seed=5,
+              space="l2"):
+    """Clustered synthetic corpus (GloVe-like: gaussian blobs) mapped with
+    an ANN method — recall against brute force is meaningful only when the
+    data actually has cluster structure."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=4.0, size=(12, dim))
+    mapper = DocumentMapper({"properties": {
+        "vec": {"type": "knn_vector", "dimension": dim,
+                "space_type": space, "method": method},
+    }})
+    writer = SegmentWriter()
+    segments, vectors = [], []
+    per = n_docs // n_segments
+    doc_no = 0
+    for si in range(n_segments):
+        parsed = []
+        for _ in range(per):
+            c = centers[rng.integers(len(centers))]
+            v = (c + rng.normal(scale=0.6, size=dim)).astype(np.float32)
+            vectors.append(v)
+            parsed.append(mapper.parse(str(doc_no), {"vec": v.tolist()}))
+            doc_no += 1
+        segments.append(writer.build(parsed, f"a{si}"))
+    return ShardSearcher(segments, mapper), np.stack(vectors)
+
+
+@pytest.mark.parametrize("method", [
+    {"name": "ivf", "parameters": {"nlist": 16, "nprobe": 8}},
+    {"name": "ivf_pq", "parameters": {"nlist": 16, "nprobe": 8, "m": 8}},
+])
+def test_knn_ann_recall(method):
+    searcher, vectors = build_ann(method)
+    rng = np.random.default_rng(3)
+    hits_sum = total = 0
+    for _ in range(10):
+        qv = vectors[rng.integers(len(vectors))] + \
+            rng.normal(scale=0.1, size=vectors.shape[1]).astype(np.float32)
+        resp = searcher.search({"query": {"knn": {"vec": {
+            "vector": qv.tolist(), "k": 10}}}, "size": 10})
+        exp = oracle_scores(vectors.astype(np.float64),
+                            qv.astype(np.float64), "l2")
+        truth = {str(i) for i in np.argsort(-exp, kind="stable")[:10]}
+        got = {h["_id"] for h in resp["hits"]["hits"]}
+        hits_sum += len(truth & got)
+        total += 10
+    assert hits_sum / total >= 0.9          # recall@10 over 10 queries
+
+
+def test_knn_ann_nprobe_full_is_exact():
+    """nprobe == nlist probes every cluster -> identical to brute force."""
+    method = {"name": "ivf", "parameters": {"nlist": 8, "nprobe": 8}}
+    searcher, vectors = build_ann(method, n_docs=300, n_segments=1)
+    q = vectors[7] * 0.9
+    resp = searcher.search({"query": {"knn": {"vec": {
+        "vector": q.tolist(), "k": 10}}}, "size": 10})
+    exp = oracle_scores(vectors.astype(np.float64), q.astype(np.float64),
+                        "l2")
+    order = np.argsort(-exp, kind="stable")[:10]
+    assert [h["_id"] for h in resp["hits"]["hits"]] == [str(i) for i in order]
+
+
+def test_knn_ann_request_override_and_deletes():
+    """method_parameters overrides nprobe per request; deleted docs never
+    surface from the probed clusters (live mask applied post-gather)."""
+    method = {"name": "ivf", "parameters": {"nlist": 8, "nprobe": 8}}
+    searcher, vectors = build_ann(method, n_docs=200, n_segments=1)
+    q = vectors[11]
+    resp = searcher.search({"query": {"knn": {"vec": {
+        "vector": q.tolist(), "k": 3,
+        "method_parameters": {"nprobe": 1}}}}, "size": 3})
+    assert len(resp["hits"]["hits"]) == 3
+    top = resp["hits"]["hits"][0]["_id"]
+    seg = searcher.segments[0]
+    seg.delete_local(seg.id_to_local[top])
+    # searchers are point-in-time (Lucene reader semantics): reopen to see
+    # the delete; the trained IVF structure is reused, not rebuilt
+    reopened = ShardSearcher(searcher.segments, searcher.mapper)
+    assert seg._ann                       # cache survived the reopen
+    resp2 = reopened.search({"query": {"knn": {"vec": {
+        "vector": q.tolist(), "k": 3}}}, "size": 3})
+    assert top not in {h["_id"] for h in resp2["hits"]["hits"]}
