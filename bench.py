@@ -137,33 +137,54 @@ def main():
         a, b = (rng.zipf(1.3, size=2) - 1).clip(0, VOCAB_SIZE - 1)
         queries.append({"query": {"match": {"body": f"t{a} t{b}"}}, "size": 10})
 
-    # warmup: compile every (query-shape, budget-bucket) once + stage arrays
+    batch = int(os.environ.get("OSTPU_BENCH_BATCH", 64))
+
+    # warmup: compile every (query-shape, budget-bucket) once + stage
+    # arrays, for BOTH paths.  Programs land in the persistent XLA cache
+    # (common/jaxenv.py), so a re-run after a timeout starts warm.
     t0 = time.monotonic()
-    for q in queries:
+    for i in range(0, len(queries), batch):
+        searcher.msearch(queries[i: i + batch])
+        log(f"warmup batch {i // batch}: {time.monotonic() - t0:.1f}s")
+    for q in queries[: min(len(queries), 32)]:
         searcher.search(q)
     warm_s = time.monotonic() - t0
     log(f"warmup (compiles + staging): {warm_s:.1f}s")
 
-    lat = []
+    # throughput: batched msearch — Q queries per device program is the
+    # TPU-idiomatic equivalent of the reference's concurrent search
+    # threads (and the only fair number behind a high-RTT tunnel)
     t0 = time.monotonic()
-    for q in queries:
-        qt = time.monotonic()
-        r = searcher.search(q)
-        lat.append(time.monotonic() - qt)
+    for i in range(0, len(queries), batch):
+        searcher.msearch(queries[i: i + batch])
     wall = time.monotonic() - t0
     qps = len(queries) / wall
+    log(f"batched qps={qps:.1f} (batch={batch})")
+
+    # latency: sequential single-query path
+    lat = []
+    seq_n = min(len(queries), 100)
+    t0 = time.monotonic()
+    for q in queries[:seq_n]:
+        qt = time.monotonic()
+        searcher.search(q)
+        lat.append(time.monotonic() - qt)
+    seq_wall = time.monotonic() - t0
+    qps_seq = seq_n / seq_wall
     lat_ms = np.asarray(lat) * 1e3
     p50 = float(np.percentile(lat_ms, 50))
     p99 = float(np.percentile(lat_ms, 99))
-    log(f"qps={qps:.1f} p50={p50:.2f}ms p99={p99:.2f}ms")
+    log(f"sequential qps={qps_seq:.1f} p50={p50:.2f}ms p99={p99:.2f}ms")
 
     print(json.dumps({
         "metric": "bm25_match_qps",
         "value": round(qps, 1),
         "unit": "qps",
         "vs_baseline": round(qps / 500.0, 3),
+        "qps_sequential": round(qps_seq, 1),
         "p50_ms": round(p50, 3),
         "p99_ms": round(p99, 3),
+        "batch": batch,
         "n_docs": n_docs,
         "platform": platform,
     }))
@@ -180,31 +201,43 @@ def main_parent():
 
     tpu_to = float(os.environ.get("OSTPU_BENCH_TPU_TIMEOUT", 1500))
     cpu_to = float(os.environ.get("OSTPU_BENCH_CPU_TIMEOUT", 1200))
-    probe_to = float(os.environ.get("OSTPU_BENCH_PROBE_TIMEOUT", 120))
+    probe_to = float(os.environ.get("OSTPU_BENCH_PROBE_TIMEOUT", 240))
+    probe_tries = int(os.environ.get("OSTPU_BENCH_PROBE_TRIES", 3))
 
-    # Cheap backend-init probe before committing to the long TPU attempt:
-    # a wedged accelerator tunnel (round-2 failure mode) costs probe_to
-    # seconds instead of tpu_to, keeping the total well inside any outer
-    # driver timeout.  A healthy init takes ~20-40s.
+    # Backend-init probe before committing to the long TPU attempt.  The
+    # accelerator tunnel wedges INTERMITTENTLY (r3: one 120s probe, gave
+    # up; r4 diagnosis: init took 0.1s at one moment and >400s twenty
+    # minutes later) — so retry with generous timeouts and log the full
+    # failure output instead of silently falling back.
     def probe_default_backend() -> bool:
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.default_backend(), len(jax.devices()))"],
-                timeout=probe_to, capture_output=True, text=True)
-            ok = r.returncode == 0
-            log(f"backend probe: rc={r.returncode} {r.stdout.strip()}"
-                f"{r.stderr.strip()[-200:] if not ok else ''}")
-            return ok
-        except subprocess.TimeoutExpired:
-            log(f"backend probe timed out after {probe_to:.0f}s")
-            return False
+        import time as _time
+
+        for attempt in range(probe_tries):
+            try:
+                r = subprocess.run(
+                    [sys.executable, "-c",
+                     "import jax; print(jax.default_backend(), "
+                     "len(jax.devices()))"],
+                    timeout=probe_to, capture_output=True, text=True)
+                ok = r.returncode == 0
+                log(f"backend probe[{attempt}]: rc={r.returncode} "
+                    f"{r.stdout.strip()}")
+                if ok:
+                    return True
+                log(f"probe stderr tail: {r.stderr.strip()[-800:]}")
+            except subprocess.TimeoutExpired:
+                log(f"backend probe[{attempt}] timed out after "
+                    f"{probe_to:.0f}s (tunnel wedged?)")
+            if attempt + 1 < probe_tries:
+                _time.sleep(15)
+        return False
 
     attempts = []
     if probe_default_backend():
         attempts.append(("default", {}, tpu_to))
     else:
-        log("skipping default-backend attempt (probe failed)")
+        log("skipping default-backend attempt (probe failed "
+            f"{probe_tries}x at {probe_to:.0f}s each)")
     attempts.append(("cpu", {"JAX_PLATFORMS": "cpu",
                              "OSTPU_BENCH_FORCE_CPU": "1"}, cpu_to))
     final_json, last_err = None, "no attempt ran"

@@ -29,7 +29,7 @@ from opensearch_tpu.common.errors import (
     NodeDisconnectedError,
     OpenSearchTpuError,
     ShardNotFoundError,
-    ValidationError,
+    VersionConflictError,
 )
 from opensearch_tpu.cluster.coordination import CoordinationError, Coordinator
 from opensearch_tpu.cluster.state import (ClusterState, allocate_shards,
@@ -400,7 +400,19 @@ class ClusterNode:
             for rep, fut in futures:
                 try:
                     fut.result(timeout=10.0)
-                except Exception:
+                except Exception as exc:
+                    if getattr(exc, "remote_type", None) == \
+                            "version_conflict_error":
+                        # the replica fenced US for a stale primary term:
+                        # the replica is ahead, not broken.  Failing it
+                        # would evict an up-to-date copy; instead refuse
+                        # the write so the client retries against the new
+                        # primary (ReplicationOperation fails the primary
+                        # itself on fencing rejections).
+                        raise VersionConflictError(
+                            r.doc_id, "current primary term",
+                            "stale primary (fenced by replica "
+                            f"[{rep}])") from exc
                     if rep in in_sync:
                         # the copy must leave the in-sync set BEFORE we ack,
                         # or a later promotion could elect a copy missing
@@ -552,16 +564,6 @@ class ClusterNode:
             by_node.setdefault(target, []).append(shard)
 
         aggs_requested = bool(body.get("aggs") or body.get("aggregations"))
-        if aggs_requested and len(by_node) > 1:
-            # Finished per-node aggregation JSON is not mergeable (exact
-            # cardinality/percentiles lose their inputs) — reject loudly
-            # rather than silently dropping the aggs, matching the REST
-            # controller's multi-index behavior.  Cross-node partial
-            # reduce lands with mergeable sketch aggregations.
-            raise ValidationError(
-                "aggregations over shards on multiple nodes are not "
-                "supported yet — shrink the index to one node or drop "
-                "the aggs clause")
 
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
@@ -572,7 +574,8 @@ class ClusterNode:
         responses = []
         futures = []
         for node, shards in by_node.items():
-            payload = {"index": index, "shards": shards, "body": sub}
+            payload = {"index": index, "shards": shards, "body": sub,
+                       "agg_partials": aggs_requested}
             if node == self.node_id:
                 responses.append(self._h_search_shards(payload))
             else:
@@ -605,9 +608,15 @@ class ClusterNode:
                      "max_score": max_score,
                      "hits": all_hits[from_: from_ + size]},
         }
-        if aggs_requested and len(responses) == 1:
-            # single data node computed the full aggregation — passthrough
-            out["aggregations"] = responses[0]["resp"].get("aggregations")
+        if aggs_requested:
+            # coordinator reduce of each node's mergeable partials
+            # (InternalAggregations.reduce / QueryPhaseResultConsumer:178)
+            from opensearch_tpu.search.aggs import reduce_aggs
+            aggs_json = body.get("aggs") or body.get("aggregations")
+            out["aggregations"] = reduce_aggs(
+                aggs_json,
+                [resp["resp"].get("aggregation_partials") or {}
+                 for resp in responses])
         return out
 
     def _h_search_shards(self, payload: dict) -> dict:
@@ -621,7 +630,9 @@ class ClusterNode:
             engine = svc.engine_for(shard_id)
             segs.extend(engine.acquire_searcher().segments)
         searcher = ShardSearcher(segs, svc.mapper, index_name=svc.name)
-        return {"resp": searcher.search(payload.get("body") or {})}
+        return {"resp": searcher.search(
+            payload.get("body") or {},
+            agg_partials=bool(payload.get("agg_partials")))}
 
     # -- lifecycle ---------------------------------------------------------
 

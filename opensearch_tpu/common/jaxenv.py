@@ -8,6 +8,24 @@ with int32 pairs; the hot scoring kernels below explicitly use
 int32/float32 so the MXU path is unaffected.
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: TPU compiles through the accelerator
+# tunnel cost tens of seconds each, and the query engine compiles one
+# program per (plan shape, size bucket) — caching them on disk makes
+# every process after the first start warm (the same role Lucene's
+# per-segment codec state plays for reopen cost).  Harmless on CPU
+# (fast compiles, small files).
+_cache_dir = os.environ.get(
+    "OSTPU_XLA_CACHE", os.path.join(os.path.expanduser("~"),
+                                    ".cache", "opensearch_tpu_xla"))
+try:
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:            # config name drift across jax versions
+    pass

@@ -43,6 +43,19 @@ def pad_pow2(n: int, minimum: int = 8) -> int:
     return 1 << (m - 1).bit_length()
 
 
+def pad_bucket(n: int, minimum: int = 4096) -> int:
+    """Coarse size bucket: ``minimum * 4^k``.  Used for per-query gather
+    budgets, where every distinct value is a separate XLA compile — on a
+    TPU behind a tunnel each compile costs tens of seconds, so 4x steps
+    (vs pow2) trade a few wasted gather lanes for ~half the program
+    count."""
+    m = max(int(n), minimum)
+    b = int(minimum)
+    while b < m:
+        b <<= 2
+    return b
+
+
 @dataclass
 class PostingsField:
     """CSR inverted index for one field.
@@ -162,7 +175,7 @@ class Segment:
         key = (field, name, nlist, m)
         idx = self._ann.get(key)
         if idx is None:
-            if name == "ivf_pq" and dv.values.shape[1] % m == 0:
+            if name == "ivf_pq":
                 idx = IvfPqIndex.build(dv.values, dv.exists, nlist, m=m)
             else:
                 idx = IvfIndex.build(dv.values, dv.exists, nlist)

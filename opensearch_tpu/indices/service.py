@@ -277,12 +277,13 @@ class IndexService:
                                                index_name=self.name)
             return self._searcher
 
-    def search(self, body: Optional[dict] = None) -> dict:
+    def search(self, body: Optional[dict] = None, *,
+               agg_partials: bool = False) -> dict:
         body = body or {}
-        if self._use_mesh(body):
+        if not agg_partials and self._use_mesh(body):
             resp = self._mesh_search(body)
         else:
-            resp = self.searcher().search(body)
+            resp = self.searcher().search(body, agg_partials=agg_partials)
         resp["_shards"] = {"total": self.num_shards,
                            "successful": self.num_shards,
                            "skipped": 0, "failed": 0}
@@ -302,8 +303,7 @@ class IndexService:
             return False
         if len(self.local_shards) < 2:
             return False
-        if (body.get("aggs") or body.get("aggregations")
-                or body.get("sort") is not None):
+        if body.get("sort") is not None:
             return False
         import jax
 
@@ -323,7 +323,45 @@ class IndexService:
                 # across refreshes; only the searcher snapshots change
                 self._mesh_searcher.update_shards(shards)
             ms = self._mesh_searcher
-        return ms.search(body)
+        aggs_json = body.get("aggs") or body.get("aggregations")
+        if not aggs_json:
+            return ms.search(body)
+        # device-collective top-k + host-side per-shard partial collect,
+        # reduced exactly like the cross-node coordinator (the agg columns
+        # are host/default-device resident; the mesh carries the scored
+        # merge).  size:0 skips the mesh scored pass entirely — the host
+        # collect already produces totals, so running both would execute
+        # the query twice for a response whose hits are discarded.
+        from opensearch_tpu.search.aggs import reduce_aggs
+        collect_body = {"size": 0, "aggs": aggs_json}
+        for key in ("query", "min_score"):
+            if body.get(key) is not None:
+                collect_body[key] = body[key]
+        size0 = int(body.get("size", 10)) == 0
+        shard_resps = [s.search(collect_body, agg_partials=True)
+                       for s in shards]
+        partials = [r.get("aggregation_partials") or {} for r in shard_resps]
+        if size0:
+            total = sum(r["hits"]["total"]["value"] for r in shard_resps)
+            resp = {"took": max((r["took"] for r in shard_resps), default=0),
+                    "timed_out": False,
+                    "hits": {"total": {"value": total, "relation": "eq"},
+                             "max_score": None, "hits": []}}
+        else:
+            resp = ms.search({k: v for k, v in body.items()
+                              if k not in ("aggs", "aggregations")})
+        resp["aggregations"] = reduce_aggs(aggs_json, partials)
+        return resp
+
+    def msearch(self, bodies: list) -> list[dict]:
+        """Batched multi-search over the node-local searcher (term-bag
+        bodies share device programs — search/batch.py)."""
+        results = self.searcher().msearch(bodies)
+        for r in results:
+            r["_shards"] = {"total": self.num_shards,
+                            "successful": self.num_shards,
+                            "skipped": 0, "failed": 0}
+        return results
 
     def count(self, query: Optional[dict] = None) -> int:
         return self.searcher().count(query)

@@ -346,6 +346,12 @@ class DenseVectorFieldType(FieldType):
                     f"[{self.name}] — supported: ivf, ivf_pq, flat")
             self.method = {"name": name,
                            **(method.get("parameters") or {})}
+            if name == "ivf_pq":
+                m = int(self.method.get("m", 8))
+                if m <= 0 or self.dims % m != 0:
+                    raise MapperParsingError(
+                        f"ivf_pq [m]=[{m}] must divide [dims]="
+                        f"[{self.dims}] for field [{self.name}]")
         else:
             self.method = None
 

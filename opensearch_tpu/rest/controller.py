@@ -15,6 +15,7 @@ from typing import Callable, Optional
 
 from opensearch_tpu.common.errors import (
     DocumentMissingError,
+    IndexNotFoundError,
     OpenSearchTpuError,
     ParsingError,
     ValidationError,
@@ -117,6 +118,8 @@ class RestController:
         r("PUT", "/{index}/_bulk", self.h_bulk)
         r("GET", "/_search", self.h_search)
         r("POST", "/_search", self.h_search)
+        r("GET", "/_msearch", self.h_msearch)
+        r("POST", "/_msearch", self.h_msearch)
         r("GET", "/_count", self.h_count)
         r("POST", "/_count", self.h_count)
         r("GET", "/_mapping", self.h_get_mapping_all)
@@ -139,6 +142,8 @@ class RestController:
         r("POST", "/{index}/_count", self.h_count)
         r("GET", "/{index}/_search", self.h_search)
         r("POST", "/{index}/_search", self.h_search)
+        r("GET", "/{index}/_msearch", self.h_msearch)
+        r("POST", "/{index}/_msearch", self.h_msearch)
         r("POST", "/{index}/_doc", self.h_index_doc_auto)
         r("PUT", "/{index}/_doc/{id}", self.h_index_doc)
         r("POST", "/{index}/_doc/{id}", self.h_index_doc)
@@ -505,6 +510,74 @@ class RestController:
             return list(self.node.indices.indices.values())
         return self.node.indices.resolve(expr)
 
+    def h_msearch(self, req):
+        """NDJSON multi-search (RestMultiSearchAction analog): alternating
+        header/body lines; header may name an index, else the URL index
+        applies.  Same-index runs batch through ShardSearcher.msearch (one
+        device program per query group — see search/batch.py)."""
+        lines = [ln for ln in req.raw_body.split(b"\n") if ln.strip()]
+        if len(lines) % 2 != 0:
+            raise ValidationError(
+                "_msearch body must be alternating header/body NDJSON lines")
+        default_index = req.path_params.get("index")
+        requests = []            # (index_name, body)
+        for i in range(0, len(lines), 2):
+            try:
+                header = json.loads(lines[i])
+                body = json.loads(lines[i + 1])
+            except json.JSONDecodeError as e:
+                raise ParsingError(f"invalid _msearch NDJSON: {e}") from e
+            index = header.get("index") or default_index
+            if index is None:
+                raise ValidationError(
+                    "_msearch header must name an [index] when the URL "
+                    "does not")
+            requests.append((index, body))
+        # group per index expression so same-index bursts batch; errors
+        # are PER sub-request (the _msearch contract: one bad body never
+        # fails its neighbours)
+        responses: list = [None] * len(requests)
+        by_index: dict[str, list[int]] = {}
+        for pos, (index, _b) in enumerate(requests):
+            by_index.setdefault(index, []).append(pos)
+
+        def err_of(e):
+            return {"error": {"type": e.error_type, "reason": e.reason},
+                    "status": e.status}
+
+        for index, positions in by_index.items():
+            try:
+                svcs = self.node.indices.resolve(index)
+                if not svcs:
+                    raise IndexNotFoundError(index)
+            except OpenSearchTpuError as e:
+                for p in positions:
+                    responses[p] = err_of(e)
+                continue
+            bodies = [requests[p][1] for p in positions]
+            results = None
+            if len(svcs) == 1:
+                try:
+                    results = svcs[0].msearch(bodies)
+                except OpenSearchTpuError:
+                    results = None       # retry body-by-body below
+            if results is not None:
+                for p, r in zip(positions, results):
+                    r["status"] = 200
+                    responses[p] = r
+                continue
+            for p, body in zip(positions, bodies):
+                try:
+                    r = (svcs[0].search(body) if len(svcs) == 1
+                         else self._multi_index_search(svcs, body))
+                    r["status"] = 200
+                    responses[p] = r
+                except OpenSearchTpuError as e:
+                    responses[p] = err_of(e)
+        return 200, {"took": max((r.get("took", 0) for r in responses),
+                                 default=0),
+                     "responses": responses}
+
     def h_search(self, req):
         body = req.json({}) or {}
         # URI-search support: ?q=field:value
@@ -529,10 +602,6 @@ class RestController:
                                   "max_score": None, "hits": []}}
         if len(services) == 1:
             return 200, services[0].search(body)
-        if body.get("aggs") or body.get("aggregations"):
-            raise ValidationError(
-                "aggregations across multiple indices are not supported yet"
-                " — target a single index")
         return 200, self._multi_index_search(services, body)
 
     def _multi_index_search(self, services, body):
@@ -540,10 +609,12 @@ class RestController:
         like cross-index query_then_fetch in the reference)."""
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
+        aggs_json = body.get("aggs") or body.get("aggregations")
         sub = dict(body)
         sub["from"] = 0
         sub["size"] = from_ + size
-        responses = [svc.search(sub) for svc in services]
+        responses = [svc.search(sub, agg_partials=bool(aggs_json))
+                     for svc in services]
         rows = []
         for resp_idx, resp in enumerate(responses):
             for pos, h in enumerate(resp["hits"]["hits"]):
@@ -555,7 +626,7 @@ class RestController:
         max_score = max((r["hits"]["max_score"] or float("-inf")
                          for r in responses), default=None)
         shards = sum(r["_shards"]["total"] for r in responses)
-        return {
+        out = {
             "took": max(r["took"] for r in responses),
             "timed_out": False,
             "_shards": {"total": shards, "successful": shards, "skipped": 0,
@@ -565,6 +636,12 @@ class RestController:
                                    else max_score),
                      "hits": all_hits[from_: from_ + size]},
         }
+        if aggs_json:
+            from opensearch_tpu.search.aggs import reduce_aggs
+            out["aggregations"] = reduce_aggs(
+                aggs_json, [r.get("aggregation_partials") or {}
+                            for r in responses])
+        return out
 
     def h_count(self, req):
         body = req.json({}) or {}

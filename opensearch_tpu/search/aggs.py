@@ -1,15 +1,31 @@
-"""Aggregations: request parsing, per-segment collection, cross-segment
-reduce, response formatting.
+"""Aggregations: request parsing, per-segment collection, mergeable
+partials, cross-shard reduce, response formatting.
 
 Analog of the reference's two-phase model (per-shard collect via
 ``BucketCollector`` -> coordinator ``InternalAggregations.reduce``; ref
 search/aggregations/BucketCollector.java:46,
 bucket/histogram/DateHistogramAggregator.java,
-bucket/terms/GlobalOrdinalsStringTermsAggregator.java).  Collection is
+bucket/terms/GlobalOrdinalsStringTermsAggregator.java,
+action/search/QueryPhaseResultConsumer.java:178).  Collection is
 array-oriented: bucket counts and metric partials are scatter-adds over
-doc-value columns (ops/aggs.py); the reduce merges per-segment partials on
-host exactly like the coordinator reduce merges per-shard ones — so the
-same code path later serves the cross-shard merge.
+doc-value columns (ops/aggs.py).
+
+The two phases are REAL phases here, crossing process boundaries:
+
+- ``AggregationExecutor.collect`` runs shard-side and produces a
+  JSON-serializable partial per agg (wire-safe: plain scalars/lists);
+- ``reduce_aggs`` runs coordinator-side over any number of partials and
+  produces the final response JSON.  The single-shard ``run`` is
+  literally ``reduce_aggs(one partial)``, so every local test also
+  validates the distributed path.
+
+Approximate-on-purpose partials (matching the reference's contracts):
+cardinality degrades from an exact value set to HyperLogLog registers
+past ``precision_threshold`` (HyperLogLogPlusPlus.java analog);
+percentiles degrade from raw values to weight-merged centroids past a
+size cap (TDigest analog); terms are truncated to ``shard_size`` per
+shard with ``doc_count_error_upper_bound`` computed from the smallest
+included count of the shards that omitted a key.
 
 Composition model: every bucket agg that selects a doc subset (filter,
 filters, range, missing, global) recurses with a narrowed matched mask, so
@@ -20,6 +36,7 @@ in the same pass via two-level scatters.
 from __future__ import annotations
 
 import datetime as _dt
+import hashlib
 import re
 from dataclasses import dataclass, field as dc_field
 
@@ -34,6 +51,10 @@ from opensearch_tpu.mapping.types import format_date_millis, parse_date_millis
 from opensearch_tpu.ops import aggs as agg_ops
 
 MAX_BUCKETS = 65536          # search.max_buckets default
+CARD_EXACT_MAX = 3000        # cardinality precision_threshold default
+PCT_RAW_MAX = 10_000         # percentiles: raw values above this compress
+PCT_CENTROIDS = 1024
+HLL_P = 12                   # 4096 registers, ~1.6% relative error
 _METRIC_TYPES = {"min", "max", "sum", "avg", "value_count", "stats",
                  "cardinality", "percentiles"}
 _BUCKET_TYPES = {"terms", "histogram", "date_histogram", "range",
@@ -139,6 +160,119 @@ def _fmt_date(millis: int, fmt: str | None) -> str:
     return dt.strftime(py)
 
 
+# ---------------------------------------------------------------------------
+# Partial-tuple helpers (sum, count, min, max) — JSON-safe (no infinities).
+# ---------------------------------------------------------------------------
+
+
+def _ser_tuple(t) -> list:
+    s, c, mn, mx = t
+    return [float(s), int(c),
+            None if not np.isfinite(mn) else float(mn),
+            None if not np.isfinite(mx) else float(mx)]
+
+
+def _merge_tuples(parts: list) -> tuple:
+    s, c, mn, mx = 0.0, 0, np.inf, -np.inf
+    for p in parts:
+        if p is None:
+            continue
+        s += p[0]
+        c += int(p[1])
+        if p[2] is not None:
+            mn = min(mn, p[2])
+        if p[3] is not None:
+            mx = max(mx, p[3])
+    return s, c, mn, mx
+
+
+def _finish_metric(typ: str, merged: tuple, params: dict | None = None):
+    s, c, mn, mx = merged
+    if typ == "sum":
+        return {"value": s}
+    if typ == "min":
+        return {"value": mn if c else None}
+    if typ == "max":
+        return {"value": mx if c else None}
+    if typ == "avg":
+        return {"value": (s / c) if c else None}
+    if typ == "value_count":
+        return {"value": c}
+    if typ == "stats":
+        return {"count": c, "min": mn if c else None, "max": mx if c else None,
+                "avg": (s / c) if c else None, "sum": s}
+    raise IllegalArgumentError(f"metric type [{typ}] has no tuple finisher")
+
+
+# ---------------------------------------------------------------------------
+# HyperLogLog (cardinality past the exact threshold).
+# ---------------------------------------------------------------------------
+
+
+def _hash64(v) -> int:
+    """Stable 64-bit hash of a scalar — MUST agree across processes (no
+    PYTHONHASHSEED dependence), so values hashed on different shard nodes
+    land in the same register."""
+    return int.from_bytes(
+        hashlib.blake2b(repr(v).encode(), digest_size=8).digest(), "little")
+
+
+def _hll_from_values(values) -> np.ndarray:
+    regs = np.zeros(1 << HLL_P, np.uint8)
+    for v in values:
+        h = _hash64(v)
+        idx = h & ((1 << HLL_P) - 1)
+        w = h >> HLL_P
+        rank = (64 - HLL_P) - w.bit_length() + 1
+        if rank > regs[idx]:
+            regs[idx] = rank
+    return regs
+
+
+def _hll_estimate(regs: np.ndarray) -> int:
+    m = regs.size
+    alpha = 0.7213 / (1 + 1.079 / m)
+    est = alpha * m * m / float(np.sum(2.0 ** -regs.astype(np.float64)))
+    if est <= 2.5 * m:
+        zeros = int((regs == 0).sum())
+        if zeros:
+            est = m * np.log(m / zeros)
+    return int(round(est))
+
+
+# ---------------------------------------------------------------------------
+# Weighted centroids (percentiles past the raw cap) — TDigest-lite.
+# ---------------------------------------------------------------------------
+
+
+def _compress_centroids(values: np.ndarray, weights: np.ndarray,
+                        n: int = PCT_CENTROIDS):
+    order = np.argsort(values, kind="stable")
+    v, w = values[order], weights[order]
+    cw = np.cumsum(w)
+    total = cw[-1]
+    bins = np.minimum(((cw - w / 2.0) / total * n).astype(np.int64), n - 1)
+    sums = np.bincount(bins, weights=v * w, minlength=n)
+    ws = np.bincount(bins, weights=w, minlength=n)
+    keep = ws > 0
+    return sums[keep] / ws[keep], ws[keep]
+
+
+def _weighted_percentile(v: np.ndarray, w: np.ndarray, p: float) -> float:
+    """Linear-interpolated quantile over point masses; reproduces
+    np.percentile exactly when every weight is 1."""
+    order = np.argsort(v, kind="stable")
+    v, w = v[order], w[order]
+    pos = np.cumsum(w) - 1.0
+    target = p / 100.0 * (w.sum() - 1.0)
+    return float(np.interp(target, pos, v))
+
+
+# ---------------------------------------------------------------------------
+# Shard-side collection
+# ---------------------------------------------------------------------------
+
+
 class AggregationExecutor:
     """Runs an agg tree over per-segment matched masks.
 
@@ -150,8 +284,13 @@ class AggregationExecutor:
         self.ctx = ctx               # compiler.ShardContext
 
     def run(self, aggs_json: dict, seg_views: list) -> dict:
+        """Single-shard convenience: collect + reduce of one partial."""
+        return reduce_aggs(aggs_json, [self.collect(aggs_json, seg_views)])
+
+    def collect(self, aggs_json: dict, seg_views: list) -> dict:
+        """Shard-side phase: one JSON-serializable partial per agg."""
         reqs = parse_aggs(aggs_json)
-        return {r.name: self._run_one(r, seg_views) for r in reqs}
+        return {r.name: self._part_one(r, seg_views) for r in reqs}
 
     # -- helpers ----------------------------------------------------------
 
@@ -177,8 +316,10 @@ class AggregationExecutor:
 
     # -- dispatch ---------------------------------------------------------
 
-    def _run_one(self, req, seg_views):
-        fn = getattr(self, f"_agg_{req.type}", None)
+    def _part_one(self, req, seg_views) -> dict:
+        if req.type in ("min", "max", "sum", "avg", "value_count", "stats"):
+            return self._part_metric(req, seg_views)
+        fn = getattr(self, f"_part_{req.type}", None)
         if fn is None:
             raise ParsingError(f"unknown aggregation type [{req.type}]")
         return fn(req, seg_views)
@@ -201,29 +342,10 @@ class AggregationExecutor:
             mx = max(mx, float(mxx))
         return s, c, mn, mx
 
-    def _agg_min(self, req, seg_views):
-        field, _ = self._field_type(req, "min")
-        s, c, mn, mx = self._collect_metric_partials(field, seg_views)
-        return {"value": mn if c else None}
-
-    def _agg_max(self, req, seg_views):
-        field, _ = self._field_type(req, "max")
-        s, c, mn, mx = self._collect_metric_partials(field, seg_views)
-        return {"value": mx if c else None}
-
-    def _agg_sum(self, req, seg_views):
-        field, _ = self._field_type(req, "sum")
-        s, c, mn, mx = self._collect_metric_partials(field, seg_views)
-        return {"value": s}
-
-    def _agg_avg(self, req, seg_views):
-        field, _ = self._field_type(req, "avg")
-        s, c, mn, mx = self._collect_metric_partials(field, seg_views)
-        return {"value": (s / c) if c else None}
-
-    def _agg_value_count(self, req, seg_views):
-        field, ft = self._field_type(req, "value_count")
-        if ft is not None and ft.dv_kind == "ordinal":
+    def _part_metric(self, req, seg_views) -> dict:
+        field, ft = self._field_type(req, req.type)
+        if (req.type == "value_count" and ft is not None
+                and ft.dv_kind == "ordinal"):
             total = 0
             for seg, dseg, matched in seg_views:
                 col = dseg.ordinal.get(field)
@@ -231,20 +353,15 @@ class AggregationExecutor:
                     continue
                 ok = matched[col["value_docs"]] & (col["ords"] >= 0)
                 total += int(ok.sum())
-            return {"value": total}
-        s, c, mn, mx = self._collect_metric_partials(field, seg_views)
-        return {"value": c}
+            return {"t": "metric", "v": [0.0, total, None, None]}
+        return {"t": "metric",
+                "v": _ser_tuple(self._collect_metric_partials(field,
+                                                              seg_views))}
 
-    def _agg_stats(self, req, seg_views):
-        field, _ = self._field_type(req, "stats")
-        s, c, mn, mx = self._collect_metric_partials(field, seg_views)
-        return {"count": c, "min": mn if c else None, "max": mx if c else None,
-                "avg": (s / c) if c else None, "sum": s}
-
-    def _agg_cardinality(self, req, seg_views):
-        """Exact distinct count (the reference's HLL++ is approximate; we
-        can afford exact via per-segment term/value sets)."""
+    def _part_cardinality(self, req, seg_views) -> dict:
         field, ft = self._field_type(req, "cardinality")
+        threshold = int(req.params.get("precision_threshold",
+                                       CARD_EXACT_MAX))
         distinct = set()
         for seg, dseg, matched in seg_views:
             m = np.asarray(matched)
@@ -261,12 +378,14 @@ class AggregationExecutor:
                     continue
                 ok = m[dv.value_docs] if len(dv.value_docs) else np.zeros(0, bool)
                 distinct.update(np.unique(dv.values[ok]).tolist())
-        return {"value": len(distinct)}
+        if len(distinct) <= threshold:
+            return {"t": "card", "kind": "set", "v": sorted(distinct, key=repr),
+                    "thr": threshold}
+        return {"t": "card", "kind": "hll",
+                "regs": _hll_from_values(distinct).tolist(), "thr": threshold}
 
-    def _agg_percentiles(self, req, seg_views):
+    def _part_percentiles(self, req, seg_views) -> dict:
         field, _ = self._field_type(req, "percentiles")
-        percents = req.params.get("percents",
-                                  [1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0])
         chunks = []
         for seg, dseg, matched in seg_views:
             dv = seg.numeric_dv.get(field)
@@ -275,84 +394,44 @@ class AggregationExecutor:
             ok = np.asarray(matched)[dv.value_docs]
             chunks.append(dv.values[ok].astype(np.float64))
         if not chunks:
-            return {"values": {f"{p}": None for p in percents}}
+            return {"t": "pct", "kind": "raw", "v": []}
         allv = np.concatenate(chunks)
-        return {"values": {f"{float(p)}": float(np.percentile(allv, p))
-                           for p in percents}}
+        if len(allv) <= PCT_RAW_MAX:
+            return {"t": "pct", "kind": "raw", "v": allv.tolist()}
+        means, weights = _compress_centroids(allv, np.ones_like(allv))
+        return {"t": "pct", "kind": "cent",
+                "m": means.tolist(), "w": weights.tolist()}
 
     # -- terms ------------------------------------------------------------
 
-    def _agg_terms(self, req, seg_views):
+    def _part_terms(self, req, seg_views) -> dict:
         field, ft = self._field_type(req, "terms")
         size = int(req.params.get("size", 10))
-        min_doc_count = int(req.params.get("min_doc_count", 1))
         order = req.params.get("order", {"_count": "desc"})
         if ft is None:
-            return {"doc_count_error_upper_bound": 0, "sum_other_doc_count": 0,
-                    "buckets": []}
+            return {"t": "terms", "tn": None, "dk": None, "buckets": [],
+                    "others": 0, "min_inc": 0}
         if ft.dv_kind == "ordinal":
             merged, sub_parts = self._terms_ordinal(field, seg_views, req.subs)
         else:
             merged, sub_parts = self._terms_numeric(field, seg_views, req.subs)
-
-        items = [(k, c) for k, c in merged.items() if c >= min_doc_count]
-        items.sort(key=self._terms_order_key(order))
-        total_in_buckets = sum(c for _k, c in items)
-        items = items[:size]
+        shard_size = int(req.params.get("shard_size")
+                         or max(size, int(size * 1.5 + 10)))
+        items = sorted(merged.items(), key=_terms_order_key(order))
+        kept, tail = items[:shard_size], items[shard_size:]
+        others = sum(c for _k, c in tail)
+        # the error-bound contract only holds for count-descending order
+        is_count_desc = _is_count_desc(order)
+        min_inc = kept[-1][1] if (tail and kept and is_count_desc) else 0
         buckets = []
-        for key, count in items:
-            b = {"key": self._term_key(key, ft), "doc_count": int(count)}
-            kas = self._term_key_as_string(key, ft)
-            if kas is not None:
-                b["key_as_string"] = kas
-            for sub in req.subs:
-                b[sub.name] = self._finish_sub_metric(sub, sub_parts.get(
-                    (sub.name, key), (0.0, 0, np.inf, -np.inf)))
-            buckets.append(b)
-        return {"doc_count_error_upper_bound": 0,
-                "sum_other_doc_count": int(total_in_buckets
-                                           - sum(b["doc_count"] for b in buckets)),
-                "buckets": buckets}
-
-    @staticmethod
-    def _terms_order_key(order):
-        if isinstance(order, list):
-            order = order[0] if order else {"_count": "desc"}
-        ((what, direction),) = order.items()
-        desc = str(direction).lower() == "desc"
-        if what == "_count":
-            return lambda kv: ((-kv[1] if desc else kv[1]), kv[0])
-        if what in ("_key", "_term"):
-            # python can't negate strings: rely on sort stability via reverse
-            import functools
-
-            def cmp(a, b):
-                if a[0] == b[0]:
-                    return 0
-                lt = a[0] < b[0]
-                if desc:
-                    lt = not lt
-                return -1 if lt else 1
-            return functools.cmp_to_key(cmp)
-        raise IllegalArgumentError(f"terms order [{what}] is not supported")
-
-    @staticmethod
-    def _term_key(key, ft):
-        if ft.type_name == "boolean":
-            return int(key)
-        if ft.dv_kind == "long":
-            return int(key)
-        if ft.dv_kind == "double":
-            return float(key)
-        return key
-
-    @staticmethod
-    def _term_key_as_string(key, ft):
-        if ft.type_name == "boolean":
-            return "true" if key else "false"
-        if ft.type_name == "date":
-            return format_date_millis(int(key))
-        return None
+        for key, count in kept:
+            subs = {sub.name: _ser_tuple(sub_parts.get(
+                (sub.name, key), (0.0, 0, np.inf, -np.inf)))
+                for sub in req.subs}
+            buckets.append([key, int(count), subs])
+        return {"t": "terms", "tn": ft.type_name, "dk": ft.dv_kind,
+                "buckets": buckets, "others": int(others),
+                "min_inc": int(min_inc)}
 
     def _terms_ordinal(self, field, seg_views, subs):
         merged: dict = {}
@@ -441,29 +520,9 @@ class AggregationExecutor:
                                       max(pmx, per_doc_max[d]))
         return merged, sub_parts
 
-    def _finish_sub_metric(self, sub, parts):
-        s, c, mn, mx = parts
-        if sub.type == "sum":
-            return {"value": s}
-        if sub.type == "min":
-            return {"value": mn if c else None}
-        if sub.type == "max":
-            return {"value": mx if c else None}
-        if sub.type == "avg":
-            return {"value": (s / c) if c else None}
-        if sub.type == "value_count":
-            return {"value": c}
-        if sub.type == "stats":
-            return {"count": c, "min": mn if c else None,
-                    "max": mx if c else None, "avg": (s / c) if c else None,
-                    "sum": s}
-        raise IllegalArgumentError(
-            f"sub-aggregation type [{sub.type}] under terms/histogram "
-            "is not supported")
-
     # -- histograms -------------------------------------------------------
 
-    def _agg_histogram(self, req, seg_views):
+    def _part_histogram(self, req, seg_views) -> dict:
         field, ft = self._field_type(req, "histogram")
         interval = float(req.params["interval"])
         if interval <= 0:
@@ -471,48 +530,47 @@ class AggregationExecutor:
         offset = float(req.params.get("offset", 0))
         s, c, mn, mx = self._collect_metric_partials(field, seg_views)
         if not c:
-            return {"buckets": []}
+            return {"t": "hist", "mn": None, "mx": None, "buckets": []}
         first = np.floor((mn - offset) / interval) * interval + offset
         n = int((mx - first) // interval) + 2
         if n > MAX_BUCKETS:
             raise IllegalArgumentError(
                 f"trying to create too many buckets ({n} > {MAX_BUCKETS})")
         edges = first + interval * np.arange(n, dtype=np.float64)
-        return self._histogram_collect(req, field, seg_views, edges,
-                                       keys=edges[:-1].tolist(),
-                                       min_doc_count=int(
-                                           req.params.get("min_doc_count", 0)))
+        buckets = self._histogram_buckets(req, field, seg_views, edges,
+                                          keys=edges[:-1])
+        return {"t": "hist", "mn": float(mn), "mx": float(mx),
+                "buckets": buckets}
 
-    def _agg_date_histogram(self, req, seg_views):
+    def _part_date_histogram(self, req, seg_views) -> dict:
         field, ft = self._field_type(req, "date_histogram")
         calendar = req.params.get("calendar_interval")
         fixed = req.params.get("fixed_interval") or req.params.get("interval")
         if calendar is None and fixed is None:
             raise ParsingError(
                 "date_histogram requires calendar_interval or fixed_interval")
-        offset = req.params.get("offset", 0)
-        if isinstance(offset, str) and offset:
-            offset = _parse_duration_ms(offset.lstrip("+-")) * (
-                -1 if offset.startswith("-") else 1)
+        offset = _dh_offset(req)
         s, c, mn, mx = self._collect_metric_partials(field, seg_views)
         if not c:
-            return {"buckets": []}
+            return {"t": "hist", "mn": None, "mx": None, "buckets": []}
         edges = build_date_edges(int(mn), int(mx), calendar=calendar,
                                  fixed=None if calendar else fixed,
                                  offset=int(offset))
-        fmt = req.params.get("format")
-        keys = edges[:-1].tolist()
-        return self._histogram_collect(
-            req, field, seg_views, edges, keys=keys,
-            min_doc_count=int(req.params.get("min_doc_count", 0)),
-            date_fmt=fmt or "")
+        buckets = self._histogram_buckets(req, field, seg_views,
+                                          edges.astype(np.float64),
+                                          keys=edges[:-1])
+        return {"t": "hist", "mn": int(mn), "mx": int(mx),
+                "buckets": buckets}
 
-    def _histogram_collect(self, req, field, seg_views, edges, keys,
-                           min_doc_count, date_fmt=None):
+    def _histogram_buckets(self, req, field, seg_views, edges, keys) -> list:
+        """Shared histogram inner loop: per-bucket counts + metric
+        sub-partials over aligned edges; emits only non-empty buckets
+        (the reduce regenerates the full grid for gap filling)."""
         n_buckets = len(keys)
         n_pad_b = pad_pow2(n_buckets + 1)
         totals = np.zeros(n_buckets, np.int64)
-        sub_parts = {sub.name: [np.zeros(n_buckets), np.zeros(n_buckets, np.int64),
+        sub_parts = {sub.name: [np.zeros(n_buckets),
+                                np.zeros(n_buckets, np.int64),
                                 np.full(n_buckets, np.inf),
                                 np.full(n_buckets, -np.inf)]
                      for sub in req.subs}
@@ -546,21 +604,15 @@ class AggregationExecutor:
                 acc[1] += np.asarray(c)[:n_buckets]
                 acc[2] = np.minimum(acc[2], np.asarray(mn)[:n_buckets])
                 acc[3] = np.maximum(acc[3], np.asarray(mx)[:n_buckets])
-        buckets = []
-        for i, key in enumerate(keys):
-            if totals[i] < min_doc_count:
-                continue
-            b = {"key": int(key) if date_fmt is not None else float(key),
-                 "doc_count": int(totals[i])}
-            if date_fmt is not None:
-                b["key_as_string"] = _fmt_date(int(key), date_fmt or None)
-            for sub in req.subs:
-                acc = sub_parts[sub.name]
-                b[sub.name] = self._finish_sub_metric(
-                    sub, (float(acc[0][i]), int(acc[1][i]),
-                          float(acc[2][i]), float(acc[3][i])))
-            buckets.append(b)
-        return {"buckets": buckets}
+        out = []
+        for i in np.nonzero(totals)[0]:
+            subs = {sub.name: _ser_tuple((float(sub_parts[sub.name][0][i]),
+                                          int(sub_parts[sub.name][1][i]),
+                                          float(sub_parts[sub.name][2][i]),
+                                          float(sub_parts[sub.name][3][i])))
+                    for sub in req.subs}
+            out.append([float(keys[i]), int(totals[i]), subs])
+        return out
 
     # -- mask-composition buckets ----------------------------------------
 
@@ -590,35 +642,32 @@ class AggregationExecutor:
             return matched
         return mask_fn
 
-    def _agg_filter(self, req, seg_views):
-        narrowed = self._narrow(seg_views, self._filter_mask_fn(req.params))
-        out = {"doc_count": sum(int(m.sum()) for _s, _d, m in narrowed)}
-        for sub in req.subs:
-            out[sub.name] = self._run_one(sub, narrowed)
-        return out
+    def _single_bucket(self, req, narrowed) -> dict:
+        return {"t": "single",
+                "doc_count": sum(int(m.sum()) for _s, _d, m in narrowed),
+                "subs": {sub.name: self._part_one(sub, narrowed)
+                         for sub in req.subs}}
 
-    def _agg_filters(self, req, seg_views):
+    def _part_filter(self, req, seg_views) -> dict:
+        return self._single_bucket(
+            req, self._narrow(seg_views, self._filter_mask_fn(req.params)))
+
+    def _part_filters(self, req, seg_views) -> dict:
         filters = req.params.get("filters")
         if not isinstance(filters, dict):
             raise ParsingError("[filters] aggregation requires keyed filters")
         buckets = {}
         for key, query_json in filters.items():
             narrowed = self._narrow(seg_views, self._filter_mask_fn(query_json))
-            b = {"doc_count": sum(int(m.sum()) for _s, _d, m in narrowed)}
-            for sub in req.subs:
-                b[sub.name] = self._run_one(sub, narrowed)
-            buckets[key] = b
-        return {"buckets": buckets}
+            buckets[key] = self._single_bucket(req, narrowed)
+        return {"t": "filters", "buckets": buckets}
 
-    def _agg_global(self, req, seg_views):
+    def _part_global(self, req, seg_views) -> dict:
         widened = [(seg, dseg, self.ctx.live_jnp(seg, dseg))
                    for seg, dseg, _m in seg_views]
-        out = {"doc_count": sum(int(m.sum()) for _s, _d, m in widened)}
-        for sub in req.subs:
-            out[sub.name] = self._run_one(sub, widened)
-        return out
+        return self._single_bucket(req, widened)
 
-    def _agg_missing(self, req, seg_views):
+    def _part_missing(self, req, seg_views) -> dict:
         field, ft = self._field_type(req, "missing")
         from opensearch_tpu.search.query_dsl import ExistsQuery
         from opensearch_tpu.search.compiler import compile_query
@@ -636,13 +685,9 @@ class AggregationExecutor:
             dims, ins = plan.prepare(bind, seg, dseg, self.ctx)
             _s, exists = run_full(plan, dims, A, ins, neg_inf)
             return ~exists & self.ctx.live_jnp(seg, dseg)
-        narrowed = self._narrow(seg_views, mask_fn)
-        out = {"doc_count": sum(int(m.sum()) for _s, _d, m in narrowed)}
-        for sub in req.subs:
-            out[sub.name] = self._run_one(sub, narrowed)
-        return out
+        return self._single_bucket(req, self._narrow(seg_views, mask_fn))
 
-    def _agg_range(self, req, seg_views, is_date=False):
+    def _part_range(self, req, seg_views, is_date=False) -> dict:
         field, ft = self._field_type(req, "range")
         ranges = req.params.get("ranges")
         if not ranges:
@@ -674,16 +719,318 @@ class AggregationExecutor:
             if key is None:
                 key = (f"{'*' if frm is None else frm}-"
                        f"{'*' if to is None else to}")
-            b = {"key": key, "doc_count":
-                 sum(int(m.sum()) for _s, _d, m in narrowed)}
+            b = self._single_bucket(req, narrowed)
+            b["key"] = key
             if frm is not None:
                 b["from"] = frm_v
             if to is not None:
                 b["to"] = to_v
-            for sub in req.subs:
-                b[sub.name] = self._run_one(sub, narrowed)
             buckets.append(b)
-        return {"buckets": buckets}
+        return {"t": "ranges", "buckets": buckets}
 
-    def _agg_date_range(self, req, seg_views):
-        return self._agg_range(req, seg_views, is_date=True)
+    def _part_date_range(self, req, seg_views) -> dict:
+        return self._part_range(req, seg_views, is_date=True)
+
+
+# ---------------------------------------------------------------------------
+# Coordinator-side reduce (InternalAggregations.reduce analog) — pure
+# function of the request + serialized partials; needs no segments, so it
+# runs identically on a coordinating-only node.
+# ---------------------------------------------------------------------------
+
+
+def reduce_aggs(aggs_json: dict, partials: list[dict]) -> dict:
+    reqs = parse_aggs(aggs_json)
+    return {r.name: _red_one(r, [p.get(r.name) for p in partials
+                                 if p is not None and p.get(r.name) is not None])
+            for r in reqs}
+
+
+def _red_one(req, parts: list):
+    if req.type in ("min", "max", "sum", "avg", "value_count", "stats"):
+        return _finish_metric(req.type,
+                              _merge_tuples([p["v"] for p in parts]))
+    fn = _REDUCERS.get(req.type)
+    if fn is None:
+        raise ParsingError(f"unknown aggregation type [{req.type}]")
+    return fn(req, parts)
+
+
+def _red_cardinality(req, parts):
+    exact: set = set()
+    hll = None
+    threshold = min((p.get("thr", CARD_EXACT_MAX) for p in parts),
+                    default=CARD_EXACT_MAX)
+    for p in parts:
+        if p["kind"] == "set":
+            exact.update(_freeze(v) for v in p["v"])
+        else:
+            regs = np.asarray(p["regs"], np.uint8)
+            hll = regs if hll is None else np.maximum(hll, regs)
+    if hll is None and len(exact) <= threshold:
+        return {"value": len(exact)}
+    if exact:
+        regs = _hll_from_values(exact)
+        hll = regs if hll is None else np.maximum(hll, regs)
+    return {"value": _hll_estimate(hll)}
+
+
+def _freeze(v):
+    return tuple(v) if isinstance(v, list) else v
+
+
+def _red_percentiles(req, parts):
+    percents = req.params.get("percents",
+                              [1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0])
+    vs, ws = [], []
+    all_raw = True
+    for p in parts:
+        if p["kind"] == "raw":
+            if p["v"]:
+                vs.append(np.asarray(p["v"], np.float64))
+                ws.append(np.ones(len(p["v"])))
+        else:
+            all_raw = False
+            vs.append(np.asarray(p["m"], np.float64))
+            ws.append(np.asarray(p["w"], np.float64))
+    if not vs:
+        return {"values": {f"{p}": None for p in percents}}
+    v, w = np.concatenate(vs), np.concatenate(ws)
+    if all_raw:
+        return {"values": {f"{float(p)}": float(np.percentile(v, p))
+                           for p in percents}}
+    return {"values": {f"{float(p)}": _weighted_percentile(v, w, p)
+                       for p in percents}}
+
+
+def _is_count_desc(order) -> bool:
+    if isinstance(order, list):
+        order = order[0] if order else {"_count": "desc"}
+    ((what, direction),) = order.items()
+    return what == "_count" and str(direction).lower() == "desc"
+
+
+def _terms_order_key(order):
+    if isinstance(order, list):
+        order = order[0] if order else {"_count": "desc"}
+    ((what, direction),) = order.items()
+    desc = str(direction).lower() == "desc"
+    if what == "_count":
+        return lambda kv: ((-kv[1] if desc else kv[1]), kv[0])
+    if what in ("_key", "_term"):
+        # python can't negate strings: rely on sort stability via reverse
+        import functools
+
+        def cmp(a, b):
+            if a[0] == b[0]:
+                return 0
+            lt = a[0] < b[0]
+            if desc:
+                lt = not lt
+            return -1 if lt else 1
+        return functools.cmp_to_key(cmp)
+    raise IllegalArgumentError(f"terms order [{what}] is not supported")
+
+
+def _term_key(key, tn, dk):
+    if tn == "boolean":
+        return int(key)
+    if dk == "long":
+        return int(key)
+    if dk == "double":
+        return float(key)
+    return key
+
+
+def _term_key_as_string(key, tn):
+    if tn == "boolean":
+        return "true" if key else "false"
+    if tn == "date":
+        return format_date_millis(int(key))
+    return None
+
+
+def _red_terms(req, parts):
+    size = int(req.params.get("size", 10))
+    min_doc_count = int(req.params.get("min_doc_count", 1))
+    order = req.params.get("order", {"_count": "desc"})
+    tn = dk = None
+    merged: dict = {}
+    sub_parts: dict = {}
+    keys_of: list[set] = []
+    for p in parts:
+        if p.get("tn") is not None:
+            tn, dk = p["tn"], p["dk"]
+        seen = set()
+        for key, count, subs in p["buckets"]:
+            if isinstance(key, float) and dk == "long":
+                key = int(key)      # JSON round-trip may floatify longs
+            seen.add(key)
+            merged[key] = merged.get(key, 0) + count
+            for sname, tup in subs.items():
+                prev = sub_parts.get((sname, key))
+                sub_parts[(sname, key)] = (
+                    _ser_tuple(_merge_tuples([prev, tup]))
+                    if prev is not None else tup)
+        keys_of.append(seen)
+    if tn is None:
+        return {"doc_count_error_upper_bound": 0, "sum_other_doc_count": 0,
+                "buckets": []}
+    items = [(k, c) for k, c in merged.items() if c >= min_doc_count]
+    items.sort(key=_terms_order_key(order))
+    total_in_buckets = sum(c for _k, c in items)
+    items = items[:size]
+    error = 0
+    buckets = []
+    for key, count in items:
+        # a shard that truncated its list and omitted this key may hold up
+        # to its min_inc more docs for it (the reference's per-bucket
+        # doc_count_error derivation)
+        err = sum(p["min_inc"] for p, seen in zip(parts, keys_of)
+                  if key not in seen)
+        error = max(error, err)
+        b = {"key": _term_key(key, tn, dk), "doc_count": int(count)}
+        kas = _term_key_as_string(key, tn)
+        if kas is not None:
+            b["key_as_string"] = kas
+        for sub in req.subs:
+            tup = sub_parts.get((sub.name, key))
+            b[sub.name] = _finish_metric(
+                sub.type, _merge_tuples([tup]) if tup is not None
+                else (0.0, 0, np.inf, -np.inf))
+        buckets.append(b)
+    sum_other = (total_in_buckets - sum(b["doc_count"] for b in buckets)
+                 + sum(p["others"] for p in parts))
+    return {"doc_count_error_upper_bound": int(error),
+            "sum_other_doc_count": int(sum_other),
+            "buckets": buckets}
+
+
+def _dh_offset(req) -> int:
+    offset = req.params.get("offset", 0)
+    if isinstance(offset, str) and offset:
+        offset = _parse_duration_ms(offset.lstrip("+-")) * (
+            -1 if offset.startswith("-") else 1)
+    return int(offset)
+
+
+def _red_histogram(req, parts, is_date=False):
+    min_doc_count = int(req.params.get("min_doc_count", 0))
+    mns = [p["mn"] for p in parts if p["mn"] is not None]
+    mxs = [p["mx"] for p in parts if p["mx"] is not None]
+    if not mns:
+        return {"buckets": []}
+    mn, mx = min(mns), max(mxs)
+    if is_date:
+        calendar = req.params.get("calendar_interval")
+        fixed = req.params.get("fixed_interval") or req.params.get("interval")
+        if calendar is None and fixed is None:
+            raise ParsingError(
+                "date_histogram requires calendar_interval or fixed_interval")
+        edges = build_date_edges(int(mn), int(mx), calendar=calendar,
+                                 fixed=None if calendar else fixed,
+                                 offset=_dh_offset(req))
+        keys = edges[:-1].astype(np.int64)
+        fmt = req.params.get("format") or ""
+    else:
+        interval = float(req.params["interval"])
+        if interval <= 0:
+            raise IllegalArgumentError("[interval] must be > 0")
+        offset = float(req.params.get("offset", 0))
+        first = np.floor((mn - offset) / interval) * interval + offset
+        n = int((mx - first) // interval) + 2
+        if n > MAX_BUCKETS:
+            raise IllegalArgumentError(
+                f"trying to create too many buckets ({n} > {MAX_BUCKETS})")
+        keys = (first + interval * np.arange(n - 1, dtype=np.float64))
+        fmt = None
+    # merge shard buckets onto the global grid; float keys land exactly on
+    # grid points (same rounding arithmetic shard-side), so match by
+    # nearest-grid-index rather than float equality
+    counts = np.zeros(len(keys), np.int64)
+    subs_acc: dict = {}
+    for p in parts:
+        for key, count, subs in p["buckets"]:
+            if is_date:
+                i = int(np.searchsorted(keys, int(round(key))))
+                if i >= len(keys) or keys[i] != int(round(key)):
+                    i = max(0, i - 1)
+            else:
+                i = min(max(int(round((key - keys[0]) / interval)), 0),
+                        len(keys) - 1)
+            counts[i] += count
+            for sname, tup in subs.items():
+                prev = subs_acc.get((sname, i))
+                subs_acc[(sname, i)] = (
+                    _ser_tuple(_merge_tuples([prev, tup]))
+                    if prev is not None else tup)
+    buckets = []
+    for i, key in enumerate(keys):
+        if counts[i] < min_doc_count:
+            continue
+        b = {"key": int(key) if is_date else float(key),
+             "doc_count": int(counts[i])}
+        if is_date:
+            b["key_as_string"] = _fmt_date(int(key), fmt or None)
+        for sub in req.subs:
+            tup = subs_acc.get((sub.name, i))
+            b[sub.name] = _finish_metric(
+                sub.type, _merge_tuples([tup]) if tup is not None
+                else (0.0, 0, np.inf, -np.inf))
+        buckets.append(b)
+    return {"buckets": buckets}
+
+
+def _red_single(req, parts):
+    out = {"doc_count": sum(p["doc_count"] for p in parts)}
+    for sub in req.subs:
+        out[sub.name] = _red_one(sub, [p["subs"][sub.name] for p in parts
+                                       if sub.name in p.get("subs", {})])
+    return out
+
+
+def _red_filters(req, parts):
+    keys = []
+    for p in parts:
+        for k in p["buckets"]:
+            if k not in keys:
+                keys.append(k)
+    buckets = {}
+    for k in keys:
+        kparts = [p["buckets"][k] for p in parts if k in p["buckets"]]
+        buckets[k] = _red_single(req, kparts)
+    return {"buckets": buckets}
+
+
+def _red_ranges(req, parts):
+    if not parts:
+        return {"buckets": []}
+    n = len(parts[0]["buckets"])
+    buckets = []
+    for i in range(n):
+        slot = [p["buckets"][i] for p in parts]
+        b = _red_single(req, slot)
+        proto = slot[0]
+        b["key"] = proto["key"]
+        if "from" in proto:
+            b["from"] = proto["from"]
+        if "to" in proto:
+            b["to"] = proto["to"]
+        buckets.append(b)
+    return {"buckets": buckets}
+
+
+_REDUCERS = {
+    "cardinality": _red_cardinality,
+    "percentiles": _red_percentiles,
+    "terms": _red_terms,
+    "histogram": lambda req, parts: _red_histogram(req, parts, is_date=False),
+    "date_histogram": lambda req, parts: _red_histogram(req, parts,
+                                                        is_date=True),
+    "filter": _red_single,
+    "filters": _red_filters,
+    "global": _red_single,
+    "missing": _red_single,
+    "range": _red_ranges,
+    "date_range": _red_ranges,
+}

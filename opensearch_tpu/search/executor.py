@@ -171,7 +171,12 @@ class ShardSearcher:
             total += int(np.asarray(matched).sum())
         return total
 
-    def search(self, body: Optional[dict] = None) -> dict:
+    def search(self, body: Optional[dict] = None, *,
+               agg_partials: bool = False) -> dict:
+        """``agg_partials=True`` is the distributed query phase: instead of
+        finished aggregations the response carries the shard's mergeable
+        ``aggregation_partials`` for a coordinator-side ``reduce_aggs``
+        (QueryPhaseResultConsumer partial-reduce analog)."""
         body = body or {}
         t0 = time.monotonic()
         size = int(body.get("size", 10))
@@ -209,26 +214,18 @@ class ShardSearcher:
                                                         min_score, views)
         rows = rows[from_: from_ + size]
 
-        aggregations = None
+        aggregations = partials = None
         if aggs_json:
             from opensearch_tpu.search.aggs import AggregationExecutor
             seg_views = [(seg, dseg, matched)
                          for seg, dseg, _s, matched in (views or [])]
-            aggregations = AggregationExecutor(self.ctx).run(aggs_json,
-                                                             seg_views)
+            execu = AggregationExecutor(self.ctx)
+            if agg_partials:
+                partials = execu.collect(aggs_json, seg_views)
+            else:
+                aggregations = execu.run(aggs_json, seg_views)
 
-        hits = []
-        for row in rows:
-            seg = self.segments[row["seg"]]
-            local = row["local"]
-            hit = {"_index": self.index_name, "_id": seg.doc_ids[local],
-                   "_score": row.get("score")}
-            src = filter_source(seg.source(local), source_spec)
-            if src is not None:
-                hit["_source"] = src
-            if "sort" in row:
-                hit["sort"] = row["sort"]
-            hits.append(hit)
+        hits = self._hits_from_rows(rows, source_spec)
 
         took = int((time.monotonic() - t0) * 1000)
         resp = {
@@ -243,7 +240,56 @@ class ShardSearcher:
         }
         if aggregations is not None:
             resp["aggregations"] = aggregations
+        if partials is not None:
+            resp["aggregation_partials"] = partials
         return resp
+
+    def msearch(self, bodies: list) -> list[dict]:
+        """Multi-search (the ``_msearch`` analog): bodies that compile to a
+        scored term-bag run as ONE batched device program per (field, k,
+        segment) — Q queries per dispatch instead of Q dispatches (see
+        search/batch.py); everything else runs the normal path.  Response
+        order matches request order."""
+        import time
+
+        from opensearch_tpu.search.batch import plan_batches
+
+        t0 = time.monotonic()
+        if not self.segments:
+            return [self.search(b) for b in bodies]
+        groups, fallback = plan_batches(self, bodies)
+        results: list = [None] * len(bodies)
+        for g in groups.values():
+            for pos, (rows, total, max_score) in g.run(self).items():
+                body = bodies[pos] or {}
+                hits = self._hits_from_rows(rows, body.get("_source"))
+                results[pos] = {
+                    "took": int((time.monotonic() - t0) * 1000),
+                    "timed_out": False,
+                    "_shards": {"total": 1, "successful": 1, "skipped": 0,
+                                "failed": 0},
+                    "hits": {"total": {"value": int(total),
+                                       "relation": "eq"},
+                             "max_score": max_score, "hits": hits},
+                }
+        for pos in fallback:
+            results[pos] = self.search(bodies[pos])
+        return results
+
+    def _hits_from_rows(self, rows, source_spec):
+        hits = []
+        for row in rows:
+            seg = self.segments[row["seg"]]
+            local = row["local"]
+            hit = {"_index": self.index_name, "_id": seg.doc_ids[local],
+                   "_score": row.get("score")}
+            src = filter_source(seg.source(local), source_spec)
+            if src is not None:
+                hit["_source"] = src
+            if "sort" in row:
+                hit["sort"] = row["sort"]
+            hits.append(hit)
+        return hits
 
     # -- internals --------------------------------------------------------
 

@@ -36,7 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from opensearch_tpu.index.segment import LONG_MISSING_MAX, pad_pow2
+from opensearch_tpu.index.segment import (LONG_MISSING_MAX, pad_bucket,
+                                           pad_pow2)
 from opensearch_tpu.ops import bm25 as bm25_ops
 from opensearch_tpu.ops import filters as filter_ops
 from opensearch_tpu.ops import phrase as phrase_ops
@@ -124,7 +125,7 @@ class TermBagPlan(Plan):
                _pad_np(bind["weights"], t_pad, 0.0, _F32),
                _scalar(bind["avgdl"], _F32),
                _scalar(bind["required"], _I32))
-        return (t_pad, pad_pow2(budget)), ins
+        return (t_pad, pad_bucket(budget)), ins
 
     def eval(self, A, dims, ins):
         t_pad, budget = dims
@@ -165,7 +166,7 @@ class PhrasePlan(Plan):
                 active[j] = True
                 e0, e1 = int(pf.offsets[tid]), int(pf.offsets[tid + 1])
                 count = int(pf.pos_offsets[e1] - pf.pos_offsets[e0])
-            budgets.append(pad_pow2(count))
+            budgets.append(pad_bucket(count, minimum=1024))
         ins = (jnp.asarray(tids), jnp.asarray(active),
                jnp.asarray(np.asarray(bind["positions"], _I32)),
                _scalar(bind["idf_sum"], _F32),
@@ -309,7 +310,7 @@ class PostingsMaskPlan(Plan):
                 tids[i] = tid
                 active[i] = True
                 budget += int(pf.df[tid])
-        return ((t_pad, pad_pow2(budget)),
+        return ((t_pad, pad_bucket(budget)),
                 (jnp.asarray(tids), jnp.asarray(active),
                  _scalar(bind["boost"], _F32)))
 
@@ -345,7 +346,7 @@ class TermRangeMaskPlan(Plan):
             lo_tid = bisect.bisect_left(sterms, bind["lo"])
             hi_tid = bisect.bisect_left(sterms, bind["hi"])
             budget = int(pf.offsets[hi_tid] - pf.offsets[lo_tid])
-        return ((pad_pow2(budget),),
+        return ((pad_bucket(budget),),
                 (_scalar(lo_tid, _I32), _scalar(hi_tid, _I32),
                  _scalar(bind["boost"], _F32)))
 
@@ -403,7 +404,7 @@ class ExpandTermsPlan(Plan):
             tids_list = self._expand(bind, sterms)
             budget = int(sum(int(pf.df[t]) for t in tids_list))
         t_pad = pad_pow2(len(tids_list), minimum=1)
-        return ((t_pad, pad_pow2(budget)),
+        return ((t_pad, pad_bucket(budget)),
                 (_pad_np(tids_list, t_pad, 0, _I32),
                  _pad_np(np.ones(len(tids_list), bool), t_pad, False, bool),
                  _scalar(bind["boost"], _F32)))

@@ -185,20 +185,35 @@ def test_cluster_search_aggs_single_node_passthrough(cluster):
     assert sorted(b["doc_count"] for b in buckets) == [3, 3]
 
 
-def test_cluster_search_aggs_multi_node_rejected(cluster):
-    """Cross-node agg reduce is not implemented yet: must error loudly,
-    never silently drop the aggregations clause."""
-    from opensearch_tpu.common.errors import ValidationError
-
+def test_cluster_search_aggs_multi_node_reduce(cluster):
+    """Cross-node aggregations: every node collects mergeable partials and
+    the coordinator reduces them — results must equal what a single-shard
+    index over the same docs reports (VERDICT r3 item 3's done bar)."""
     hub, ids, nodes = cluster
     nodes["n0"].create_index("agg6", {"settings": {"number_of_shards": 6}})
+    nodes["n0"].create_index("agg1x", {"settings": {"number_of_shards": 1}})
     wait_until(lambda: all("agg6" in nodes[i].indices for i in ids))
-    for i in range(12):
-        nodes["n0"].index_doc("agg6", str(i), {"v": i % 3})
+    for i in range(30):
+        doc = {"v": i % 3, "w": float(i)}
+        nodes["n0"].index_doc("agg6", str(i), doc)
+        nodes["n0"].index_doc("agg1x", str(i), doc)
     nodes["n0"].refresh("agg6")
-    with pytest.raises(ValidationError):
-        nodes["n0"].search("agg6", {
-            "size": 0, "aggs": {"vals": {"terms": {"field": "v"}}}})
+    nodes["n0"].refresh("agg1x")
+    aggs = {"vals": {"terms": {"field": "v"},
+                     "aggs": {"wavg": {"avg": {"field": "w"}}}},
+            "card": {"cardinality": {"field": "w"}},
+            "pct": {"percentiles": {"field": "w",
+                                    "percents": [50.0, 99.0]}},
+            "wstats": {"stats": {"field": "w"}}}
+    multi = nodes["n1"].search("agg6", {"size": 0, "aggs": aggs})
+    single = nodes["n1"].search("agg1x", {"size": 0, "aggs": aggs})
+    assert multi["aggregations"] == single["aggregations"]
+    # spot-check absolute values, not just equivalence
+    a = multi["aggregations"]
+    assert sorted(b["doc_count"] for b in a["vals"]["buckets"]) == [10, 10, 10]
+    assert a["card"]["value"] == 30
+    assert a["wstats"]["count"] == 30 and a["wstats"]["max"] == 29.0
+    assert a["pct"]["values"]["50.0"] == pytest.approx(14.5)
 
 
 def _in_sync_full(nodes, leader, index):

@@ -331,3 +331,35 @@ def test_mesh_search_path_matches_host_merge(node):
     got = [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
     assert got == want
     assert total == 40
+
+
+def test_msearch_rest_per_request_errors(node):
+    """_msearch: one bad body yields an error entry for THAT position only;
+    a multi-index pattern target works like _search."""
+    call(node, "PUT", "/ms1", {"mappings": {"properties": {
+        "t": {"type": "text"}, "n": {"type": "long"}}}})
+    call(node, "PUT", "/ms2", {"mappings": {"properties": {
+        "t": {"type": "text"}, "n": {"type": "long"}}}})
+    for i in range(4):
+        call(node, "PUT", f"/ms1/_doc/a{i}", {"t": "hello world", "n": i})
+        call(node, "PUT", f"/ms2/_doc/b{i}", {"t": "hello there", "n": 10 + i})
+    call(node, "POST", "/ms1/_refresh")
+    call(node, "POST", "/ms2/_refresh")
+    code, resp = call(node, "POST", "/_msearch", ndjson=[
+        {"index": "ms1"},
+        {"query": {"match": {"t": "hello"}}, "size": 10},
+        {"index": "ms1"},
+        {"query": {"definitely_not_a_query": {}}},
+        {"index": "ms*"},
+        {"query": {"match": {"t": "hello"}}, "size": 10},
+        {"index": "nope"},
+        {"query": {"match_all": {}}},
+    ])
+    assert code == 200
+    r = resp["responses"]
+    assert r[0]["status"] == 200
+    assert r[0]["hits"]["total"]["value"] == 4
+    assert r[1]["status"] == 400 and "error" in r[1]
+    assert r[2]["status"] == 200
+    assert r[2]["hits"]["total"]["value"] == 8      # ms1 + ms2 via pattern
+    assert r[3]["status"] == 404 and "error" in r[3]
