@@ -60,7 +60,8 @@ class Coordinator:
     def __init__(self, node_id: str, transport: TransportService,
                  voting_nodes: list[str], node_info: Optional[dict] = None,
                  on_apply: Optional[Callable[[ClusterState], None]] = None,
-                 check_interval: float = 1.0, check_retries: int = 3):
+                 check_interval: float = 1.0, check_retries: int = 3,
+                 gateway=None):
         self.node_id = node_id
         self.transport = transport
         self.voting_nodes = sorted(voting_nodes)
@@ -68,12 +69,27 @@ class Coordinator:
         self.on_apply = on_apply
         self.check_interval = check_interval
         self.check_retries = check_retries
+        self.gateway = gateway          # GatewayStateStore | None
 
         self.mode = Mode.CANDIDATE
         self.current_term = 0
         self.last_join_term = 0         # highest term we voted (joined) in
         self.accepted: ClusterState = ClusterState()
         self.committed: ClusterState = ClusterState()
+        if gateway is not None:
+            # restart: restore terms (votes MUST survive — a node that
+            # voted in term T may never vote again in T), the accepted
+            # state, and the committed state when the commit marker still
+            # names the accepted (term, version)
+            persisted = gateway.load()
+            self.current_term = persisted["current_term"]
+            self.last_join_term = persisted["last_join_term"]
+            if persisted["accepted"] is not None:
+                self.accepted = ClusterState.from_payload(
+                    persisted["accepted"])
+                if persisted["commit"] == (self.accepted.term,
+                                           self.accepted.version):
+                    self.committed = self.accepted
         self._lock = threading.RLock()
         # serializes compute+publish end-to-end (MasterService single
         # thread analog) — without it two concurrent updates both build
@@ -92,6 +108,11 @@ class Coordinator:
         t.register_handler(FOLLOWER_CHECK, self._on_follower_check)
 
     # -- helpers ----------------------------------------------------------
+
+    def _persist_terms(self):
+        """Durably record the vote BEFORE acting on it (call with lock)."""
+        if self.gateway is not None:
+            self.gateway.save_terms(self.current_term, self.last_join_term)
 
     def _majority(self) -> int:
         return len(self.voting_nodes) // 2 + 1
@@ -133,6 +154,7 @@ class Coordinator:
             new_term = self.current_term + 1
             self.current_term = new_term
             self.last_join_term = new_term   # vote for ourselves
+            self._persist_terms()
             state_term = self.accepted.term
             state_version = self.accepted.version
         joins = 1
@@ -212,6 +234,7 @@ class Coordinator:
                 self.current_term = term
                 if self.mode == Mode.LEADER:
                     self.mode = Mode.CANDIDATE
+            self._persist_terms()
             return {"joined": True, "info": self.node_info}
 
     # -- node membership (leader side) ------------------------------------
@@ -298,6 +321,12 @@ class Coordinator:
                 return {"accepted": False, "term": self.current_term}
             self.current_term = max(self.current_term, state.term)
             self.accepted = state
+            if self.gateway is not None:
+                # accepted state is durable BEFORE the ack: the quorum
+                # intersection argument needs it present after a crash
+                # (PersistedClusterStateService on PublishRequest)
+                self._persist_terms()
+                self.gateway.save_accepted(payload["state"])
             if state.master_node != self.node_id:
                 self.mode = Mode.FOLLOWER
                 self._check_failures.clear()
@@ -309,6 +338,9 @@ class Coordinator:
                     and self.accepted.version == payload["version"]
                     and self.accepted.is_newer_than(self.committed)):
                 self.committed = self.accepted
+                if self.gateway is not None:
+                    self.gateway.save_commit(self.committed.term,
+                                             self.committed.version)
                 apply_cb = self.on_apply
                 state = self.committed
             else:

@@ -1,0 +1,90 @@
+"""Durable per-node coordination state — the gateway analog.
+
+The reference persists every ACCEPTED cluster state and the current
+coordination term into a node-local Lucene index
+(ref gateway/PersistedClusterStateService.java:137, IndexWriter at :222)
+because the protocol's safety arguments assume votes and accepted states
+survive restarts: a node that voted in term T must never vote again in T
+after a crash, and a committed state must remain present (as *accepted*)
+on a majority.  Without this, a full-cluster restart resets terms to 0
+and voids every primary-term fencing guarantee built on top.
+
+Here the durable pieces are three JSON files under ``<data>/_state``,
+each written atomically (tmp + fsync + rename — the same discipline as
+the engine's commit point):
+
+- ``terms.json``     — current_term + last_join_term (the vote)
+- ``accepted.json``  — the full last-accepted cluster state payload
+- ``commit.json``    — (term, version) marker of the last commit
+
+JSON instead of a Lucene index is deliberate: cluster states here are
+small dict payloads, and the atomic-rename file is the idiomatic host
+equivalent; nothing about it touches the device path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+
+class GatewayStateStore:
+    TERMS = "terms.json"
+    ACCEPTED = "accepted.json"
+    COMMIT = "commit.json"
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    # -- io ----------------------------------------------------------------
+
+    def _write(self, name: str, obj: dict):
+        tmp = os.path.join(self.path, name + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(obj, f, separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.path, name))
+
+    def _read(self, name: str) -> Optional[dict]:
+        p = os.path.join(self.path, name)
+        if not os.path.exists(p):
+            return None
+        try:
+            with open(p) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            # a torn write can only affect the .tmp (rename is atomic);
+            # an unreadable final file means manual tampering — treat as
+            # absent rather than refusing to boot
+            return None
+
+    # -- writes on the coordination hot path -------------------------------
+
+    def save_terms(self, current_term: int, last_join_term: int):
+        self._write(self.TERMS, {"current_term": int(current_term),
+                                 "last_join_term": int(last_join_term)})
+
+    def save_accepted(self, payload: dict):
+        self._write(self.ACCEPTED, payload)
+
+    def save_commit(self, term: int, version: int):
+        self._write(self.COMMIT, {"term": int(term),
+                                  "version": int(version)})
+
+    # -- restart ----------------------------------------------------------
+
+    def load(self) -> dict:
+        """{"current_term", "last_join_term", "accepted": payload|None,
+        "commit": (term, version)|None} — all zeros/None on first boot."""
+        terms = self._read(self.TERMS) or {}
+        commit = self._read(self.COMMIT)
+        return {
+            "current_term": int(terms.get("current_term", 0)),
+            "last_join_term": int(terms.get("last_join_term", 0)),
+            "accepted": self._read(self.ACCEPTED),
+            "commit": ((int(commit["term"]), int(commit["version"]))
+                       if commit else None),
+        }

@@ -66,9 +66,12 @@ class ClusterNode:
         self.transport = transport
         self.indices: dict[str, IndexService] = {}
         self._lock = threading.RLock()
+        from opensearch_tpu.cluster.gateway import GatewayStateStore
+        self.gateway = GatewayStateStore(os.path.join(data_path, "_state"))
         self.coordinator = Coordinator(
             node_id, transport, voting_nodes,
-            node_info={"name": node_id}, on_apply=self._apply_state)
+            node_info={"name": node_id}, on_apply=self._apply_state,
+            gateway=self.gateway)
         # (index, shard) -> "primary" | "replica" as applied locally
         self._roles: dict[tuple, str] = {}
         # (index, shard) replica copies that completed peer recovery in
@@ -88,10 +91,19 @@ class ClusterNode:
         t.register_handler(A_START_RECOVERY, self._h_start_recovery)
         t.register_handler(A_FAIL_COPY, self._h_fail_copy)
         t.register_handler(A_SHARD_RECOVERED, self._h_shard_recovered)
+        # restart: reopen local shards from the restored committed state
+        # right away (the GatewayAllocator's on-disk-copy path) so engines
+        # replay their translogs before any routing decisions arrive.
+        # recover=False: replica resync waits for the first post-election
+        # committed state — at construction time peer transports aren't
+        # registered yet, and the resync belongs to the live cluster
+        restored = self.coordinator.state()
+        if restored.indices:
+            self._apply_state(restored, recover=False)
 
     # -- state application (IndicesClusterStateService analog) ------------
 
-    def _apply_state(self, state: ClusterState):
+    def _apply_state(self, state: ClusterState, recover: bool = True):
         to_promote: list[tuple] = []
         to_recover: list[tuple] = []
         with self._lock:
@@ -133,7 +145,8 @@ class ClusterNode:
                                 (index, s, entry["primary_term"]))
                         self._recovered.add((index, s))
                     elif role == "replica":
-                        if ((index, s) not in self._recovered
+                        if (recover
+                                and (index, s) not in self._recovered
                                 and (index, s) not in self._recovering
                                 and entry.get("primary")):
                             self._recovering.add((index, s))

@@ -1,0 +1,135 @@
+"""Blob-store SPI: the storage abstraction snapshots/remote-store hang on.
+
+Mirrors the reference's ``common/blobstore`` package (BlobStore /
+BlobContainer; ref repositories/blobstore/BlobStoreRepository.java:1 is
+the main consumer): a *store* hands out *containers* (nested paths), and
+containers read/write/list immutable blobs.  Writes are atomic —
+readers never observe partial blobs (tmp + fsync + rename on the fs
+impl; object stores give this for free).
+
+The ``fs`` implementation is built in (the reference's repository-fs);
+cloud backends (the reference's repository-s3/azure/gcs plugins) plug in
+by registering a factory in ``BLOBSTORE_TYPES``.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Callable, Iterator
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+
+
+class BlobStoreError(OpenSearchTpuError):
+    status = 500
+
+
+class NoSuchBlobError(BlobStoreError):
+    status = 404
+
+
+class BlobContainer:
+    """One directory-like namespace of immutable blobs."""
+
+    def read_blob(self, name: str) -> bytes:
+        raise NotImplementedError
+
+    def write_blob(self, name: str, data: bytes,
+                   fail_if_exists: bool = False):
+        raise NotImplementedError
+
+    def blob_exists(self, name: str) -> bool:
+        raise NotImplementedError
+
+    def list_blobs(self) -> Iterator[str]:
+        raise NotImplementedError
+
+    def delete_blob(self, name: str):
+        raise NotImplementedError
+
+    def child(self, path: str) -> "BlobContainer":
+        raise NotImplementedError
+
+
+class BlobStore:
+    def container(self, path: str = "") -> BlobContainer:
+        raise NotImplementedError
+
+    def delete(self):
+        """Remove the whole store (repository cleanup)."""
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# fs implementation
+# ---------------------------------------------------------------------------
+
+
+class FsBlobContainer(BlobContainer):
+    def __init__(self, root: str):
+        self.root = root
+
+    def _path(self, name: str) -> str:
+        if "/" in name or name.startswith("."):
+            raise BlobStoreError(f"invalid blob name [{name}]")
+        return os.path.join(self.root, name)
+
+    def read_blob(self, name: str) -> bytes:
+        p = self._path(name)
+        if not os.path.exists(p):
+            raise NoSuchBlobError(f"blob [{name}] not found")
+        with open(p, "rb") as f:
+            return f.read()
+
+    def write_blob(self, name: str, data: bytes,
+                   fail_if_exists: bool = False):
+        os.makedirs(self.root, exist_ok=True)
+        p = self._path(name)
+        if fail_if_exists and os.path.exists(p):
+            raise BlobStoreError(f"blob [{name}] already exists")
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+
+    def blob_exists(self, name: str) -> bool:
+        return os.path.exists(self._path(name))
+
+    def list_blobs(self) -> Iterator[str]:
+        if not os.path.isdir(self.root):
+            return iter(())
+        return iter(sorted(n for n in os.listdir(self.root)
+                           if not n.endswith(".tmp")))
+
+    def delete_blob(self, name: str):
+        p = self._path(name)
+        if os.path.exists(p):
+            os.remove(p)
+
+    def child(self, path: str) -> "FsBlobContainer":
+        safe = [s for s in path.split("/") if s and s not in (".", "..")]
+        return FsBlobContainer(os.path.join(self.root, *safe))
+
+
+class FsBlobStore(BlobStore):
+    def __init__(self, settings: dict):
+        location = settings.get("location")
+        if not location:
+            raise BlobStoreError(
+                "[fs] repository requires a [location] setting")
+        self.location = str(location)
+
+    def container(self, path: str = "") -> FsBlobContainer:
+        return FsBlobContainer(self.location).child(path) if path else \
+            FsBlobContainer(self.location)
+
+    def delete(self):
+        shutil.rmtree(self.location, ignore_errors=True)
+
+
+BLOBSTORE_TYPES: dict[str, Callable[[dict], BlobStore]] = {
+    "fs": FsBlobStore,
+}

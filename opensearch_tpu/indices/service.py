@@ -429,27 +429,43 @@ class IndicesService:
                     meta.get("settings", {}), meta.get("mappings"),
                     persist_meta=self._persist_meta)
 
+    @staticmethod
+    def validate_name(name: str):
+        if not _INDEX_NAME.match(name) or name != name.lower():
+            raise ValidationError(
+                f"invalid index name [{name}]: must be lowercase and "
+                "start with an alphanumeric")
+
+    def _register(self, name: str, settings: dict,
+                  mappings: Optional[dict]) -> IndexService:
+        """Shared open+persist+register step for create and restore
+        (call with the registry lock held)."""
+        if name in self.indices:
+            raise IndexAlreadyExistsError(name)
+        self.validate_name(name)
+        if "index" in settings:       # accept {"settings": {"index": {...}}}
+            inner = settings.pop("index")
+            settings.update(inner)
+        path = os.path.join(self.data_path, name)
+        os.makedirs(path, exist_ok=True)
+        svc = IndexService(name, path, settings, mappings,
+                           persist_meta=self._persist_meta)
+        self._persist_meta(name, settings, mappings or {})
+        self.indices[name] = svc
+        return svc
+
     def create(self, name: str, body: Optional[dict] = None) -> IndexService:
         body = body or {}
         with self._lock:
-            if name in self.indices:
-                raise IndexAlreadyExistsError(name)
-            if not _INDEX_NAME.match(name) or name != name.lower():
-                raise ValidationError(
-                    f"invalid index name [{name}]: must be lowercase and "
-                    "start with an alphanumeric")
-            settings = dict(body.get("settings", {}))
-            if "index" in settings:   # accept {"settings": {"index": {...}}}
-                inner = settings.pop("index")
-                settings.update(inner)
-            mappings = body.get("mappings")
-            path = os.path.join(self.data_path, name)
-            os.makedirs(path, exist_ok=True)
-            svc = IndexService(name, path, settings, mappings,
-                               persist_meta=self._persist_meta)
-            self._persist_meta(name, settings, mappings or {})
-            self.indices[name] = svc
-            return svc
+            return self._register(name, dict(body.get("settings", {})),
+                                  body.get("mappings"))
+
+    def open_restored(self, name: str, settings: dict,
+                      mappings: Optional[dict]) -> IndexService:
+        """Open an index whose shard directories a snapshot restore just
+        materialized (RestoreService's post-copy open)."""
+        with self._lock:
+            return self._register(name, dict(settings), mappings)
 
     def get(self, name: str) -> IndexService:
         svc = self.indices.get(name)

@@ -32,6 +32,10 @@ class Node:
         self.data_path = data_path
         os.makedirs(data_path, exist_ok=True)
         self.indices = IndicesService(os.path.join(data_path, "indices"))
+        from opensearch_tpu.snapshots.service import SnapshotsService
+        from opensearch_tpu.search.contexts import ReaderContextRegistry
+        self.snapshots = SnapshotsService(self.indices, data_path)
+        self.contexts = ReaderContextRegistry()
         self.rest = RestController(self)
         self.http = HttpServer(self.rest, host=host, port=port)
 

@@ -120,11 +120,27 @@ class RestController:
         r("POST", "/_search", self.h_search)
         r("GET", "/_msearch", self.h_msearch)
         r("POST", "/_msearch", self.h_msearch)
+        r("GET", "/_search/scroll", self.h_scroll_next)
+        r("POST", "/_search/scroll", self.h_scroll_next)
+        r("DELETE", "/_search/scroll/_all", self.h_scroll_clear_all)
+        r("DELETE", "/_search/scroll", self.h_scroll_clear)
+        r("DELETE", "/_search/point_in_time", self.h_pit_close)
         r("GET", "/_count", self.h_count)
         r("POST", "/_count", self.h_count)
         r("GET", "/_mapping", self.h_get_mapping_all)
         r("GET", "/_refresh", self.h_refresh)
         r("POST", "/_refresh", self.h_refresh)
+        r("GET", "/_snapshot", self.h_get_repos)
+        r("PUT", "/_snapshot/{repo}", self.h_put_repo)
+        r("POST", "/_snapshot/{repo}", self.h_put_repo)
+        r("GET", "/_snapshot/{repo}", self.h_get_repo)
+        r("DELETE", "/_snapshot/{repo}", self.h_delete_repo)
+        r("PUT", "/_snapshot/{repo}/{snapshot}", self.h_create_snapshot)
+        r("POST", "/_snapshot/{repo}/{snapshot}", self.h_create_snapshot)
+        r("GET", "/_snapshot/{repo}/{snapshot}", self.h_get_snapshot)
+        r("DELETE", "/_snapshot/{repo}/{snapshot}", self.h_delete_snapshot)
+        r("POST", "/_snapshot/{repo}/{snapshot}/_restore",
+          self.h_restore_snapshot)
 
         r("PUT", "/{index}", self.h_create_index)
         r("DELETE", "/{index}", self.h_delete_index)
@@ -144,6 +160,7 @@ class RestController:
         r("POST", "/{index}/_search", self.h_search)
         r("GET", "/{index}/_msearch", self.h_msearch)
         r("POST", "/{index}/_msearch", self.h_msearch)
+        r("POST", "/{index}/_search/point_in_time", self.h_pit_open)
         r("POST", "/{index}/_doc", self.h_index_doc_auto)
         r("PUT", "/{index}/_doc/{id}", self.h_index_doc)
         r("POST", "/{index}/_doc/{id}", self.h_index_doc)
@@ -578,6 +595,73 @@ class RestController:
                                  default=0),
                      "responses": responses}
 
+    # -- scroll / PIT ------------------------------------------------------
+
+    def _scroll_response(self, ctx, scroll_id):
+        from opensearch_tpu.search.executor import ShardSearcher  # noqa: F401
+        page = ctx.next_page()
+        hits = ctx.searcher._hits_from_rows(page, ctx.source_spec)
+        for h in hits:
+            h["_index"] = ctx.index_name
+        return {"_scroll_id": scroll_id, "took": 0, "timed_out": False,
+                "_shards": {"total": 1, "successful": 1, "skipped": 0,
+                            "failed": 0},
+                "hits": {"total": {"value": ctx.total, "relation": "eq"},
+                         "max_score": None, "hits": hits}}
+
+    def h_scroll_next(self, req):
+        from opensearch_tpu.search.contexts import (ScrollContext,
+                                                    parse_keepalive)
+        body = req.json({}) or {}
+        scroll_id = body.get("scroll_id") or req.param("scroll_id")
+        if not scroll_id:
+            raise ValidationError("scroll_id is required")
+        # only an EXPLICIT scroll param replaces the stored keepalive; a
+        # bare fetch keeps the lease the client asked for at open
+        raw_ka = body.get("scroll") or req.param("scroll")
+        ka = parse_keepalive(raw_ka) if raw_ka else None
+        ctx = self.node.contexts.get(scroll_id, ka)
+        if not isinstance(ctx, ScrollContext):
+            raise ValidationError(
+                f"id [{scroll_id}] is a point-in-time, not a scroll")
+        return 200, self._scroll_response(ctx, scroll_id)
+
+    def h_scroll_clear(self, req):
+        body = req.json({}) or {}
+        ids = body.get("scroll_id") or []
+        if isinstance(ids, str):
+            ids = [ids]
+        freed = sum(1 for i in ids if self.node.contexts.close(i))
+        return 200, {"succeeded": True, "num_freed": freed}
+
+    def h_scroll_clear_all(self, req):
+        return 200, {"succeeded": True,
+                     "num_freed": self.node.contexts.close_all()}
+
+    def h_pit_open(self, req):
+        from opensearch_tpu.search.contexts import (PitContext,
+                                                    parse_keepalive)
+        services = self._target_indices(req)
+        if len(services) != 1:
+            raise ValidationError(
+                "point-in-time requires exactly one target index")
+        svc = services[0]
+        ka = parse_keepalive(req.param("keep_alive"))
+        ctx = PitContext(svc.searcher(), svc.name)
+        pit_id = self.node.contexts.open(ctx, ka)
+        return 200, {"pit_id": pit_id,
+                     "_shards": {"total": svc.num_shards,
+                                 "successful": svc.num_shards,
+                                 "skipped": 0, "failed": 0}}
+
+    def h_pit_close(self, req):
+        body = req.json({}) or {}
+        ids = body.get("pit_id") or []
+        if isinstance(ids, str):
+            ids = [ids]
+        freed = sum(1 for i in ids if self.node.contexts.close(i))
+        return 200, {"succeeded": True, "num_freed": freed}
+
     def h_search(self, req):
         body = req.json({}) or {}
         # URI-search support: ?q=field:value
@@ -592,6 +676,12 @@ class RestController:
             body["size"] = int(req.param("size"))
         if req.param("from") is not None:
             body["from"] = int(req.param("from"))
+        # PIT search: the body names a held reader; no index in the path
+        if body.get("pit"):
+            return 200, self._pit_search(body)
+        scroll = req.param("scroll") or body.get("scroll")
+        if scroll:
+            return 200, self._open_scroll(req, body, scroll)
         services = self._target_indices(req)
         if not services:
             # allow_no_indices=true default: empty result, not an error
@@ -603,6 +693,46 @@ class RestController:
         if len(services) == 1:
             return 200, services[0].search(body)
         return 200, self._multi_index_search(services, body)
+
+    def _open_scroll(self, req, body, scroll):
+        """First scroll page: pin a searcher snapshot, materialize the
+        full sorted match list, serve page one (reader-context creation;
+        SearchService.createContext + scroll keepalive analog)."""
+        from opensearch_tpu.search.contexts import (ScrollContext,
+                                                    parse_keepalive)
+        services = self._target_indices(req)
+        if len(services) != 1:
+            raise ValidationError(
+                "scroll requires exactly one target index")
+        svc = services[0]
+        searcher = svc.searcher()
+        rows, total = searcher.scan_rows(
+            {k: v for k, v in body.items() if k != "slice"},
+            slice_spec=body.get("slice"))
+        ctx = ScrollContext(searcher, rows, total,
+                            page_size=int(body.get("size", 10)),
+                            source_spec=body.get("_source"),
+                            index_name=svc.name)
+        scroll_id = self.node.contexts.open(ctx, parse_keepalive(scroll))
+        return self._scroll_response(ctx, scroll_id)
+
+    def _pit_search(self, body):
+        from opensearch_tpu.search.contexts import (PitContext,
+                                                    parse_keepalive)
+        pit = body["pit"]
+        pit_id = pit.get("id")
+        if not pit_id:
+            raise ValidationError("[pit] requires an [id]")
+        ka = (parse_keepalive(pit["keep_alive"])
+              if pit.get("keep_alive") else None)
+        ctx = self.node.contexts.get(pit_id, ka)
+        if not isinstance(ctx, PitContext):
+            raise ValidationError(
+                f"id [{pit_id}] is a scroll, not a point-in-time")
+        sub = {k: v for k, v in body.items() if k != "pit"}
+        resp = ctx.searcher.search(sub)
+        resp["pit_id"] = pit_id
+        return resp
 
     def _multi_index_search(self, services, body):
         """Coordinator merge over several indices (scores are per-index,
@@ -642,6 +772,41 @@ class RestController:
                 aggs_json, [r.get("aggregation_partials") or {}
                             for r in responses])
         return out
+
+    # -- snapshots ---------------------------------------------------------
+
+    def h_get_repos(self, req):
+        return 200, self.node.snapshots.get_repository()
+
+    def h_put_repo(self, req):
+        return 200, self.node.snapshots.put_repository(
+            req.path_params["repo"], req.json({}) or {})
+
+    def h_get_repo(self, req):
+        return 200, self.node.snapshots.get_repository(
+            req.path_params["repo"])
+
+    def h_delete_repo(self, req):
+        return 200, self.node.snapshots.delete_repository(
+            req.path_params["repo"])
+
+    def h_create_snapshot(self, req):
+        return 200, self.node.snapshots.create_snapshot(
+            req.path_params["repo"], req.path_params["snapshot"],
+            req.json({}) or {})
+
+    def h_get_snapshot(self, req):
+        return 200, self.node.snapshots.get_snapshot(
+            req.path_params["repo"], req.path_params["snapshot"])
+
+    def h_delete_snapshot(self, req):
+        return 200, self.node.snapshots.delete_snapshot(
+            req.path_params["repo"], req.path_params["snapshot"])
+
+    def h_restore_snapshot(self, req):
+        return 200, self.node.snapshots.restore_snapshot(
+            req.path_params["repo"], req.path_params["snapshot"],
+            req.json({}) or {})
 
     def h_count(self, req):
         body = req.json({}) or {}

@@ -1,0 +1,152 @@
+"""Reader contexts: scroll cursors, points-in-time, sliced scans.
+
+Analog of the reference's server-held reader leases (ref
+search/internal/PitReaderContext.java, SearchService.java:170,185
+keepalive machinery, search/slice/SliceBuilder.java:81).  A context pins
+a ``ShardSearcher`` — which is already a point-in-time snapshot (its
+``ShardContext`` captured the live bitmaps at acquire; segments are
+immutable) — so deletes/refreshes after creation never change what the
+context sees, exactly like a held Lucene reader.
+
+- **Scroll**: the full sorted match list is materialized once on
+  creation and paged by cursor.  Memory is O(matched docs) per scroll,
+  the same trade the reference's scroll contexts make (they hold
+  per-shard ScoreDocs + reader leases); keepalive bounds the damage.
+- **PIT**: pins only the searcher; each page re-runs the query against
+  the frozen snapshot with ``search_after`` pagination.
+- **Slice**: ``{"id": i, "max": n}`` partitions the doc space by a hash
+  of (segment, local doc) — n independent cursors over disjoint doc
+  sets whose union is exactly the full set (the reference's sliced
+  scroll/PIT for parallel export).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+from opensearch_tpu.common.errors import (IllegalArgumentError,
+                                          OpenSearchTpuError)
+
+
+class SearchContextMissingError(OpenSearchTpuError):
+    status = 404
+
+
+def parse_keepalive(value, default_ms: int = 60_000) -> int:
+    if value is None:
+        return default_ms
+    if isinstance(value, (int, float)):
+        return int(value)
+    s = str(value)
+    units = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
+             "d": 86_400_000}
+    for suffix, mult in sorted(units.items(), key=lambda kv: -len(kv[0])):
+        if s.endswith(suffix):
+            return int(float(s[: -len(suffix)]) * mult)
+    return int(float(s) * 1000)
+
+
+def slice_filter(slice_spec: Optional[dict]):
+    """Row predicate for ``{"id": i, "max": n}`` — deterministic disjoint
+    partition of (seg, local) pairs (SliceBuilder's doc-hash strategy)."""
+    if slice_spec is None:
+        return None
+    sid = int(slice_spec.get("id", 0))
+    smax = int(slice_spec.get("max", 1))
+    if smax < 2:
+        raise IllegalArgumentError("[slice] max must be >= 2")
+    if not (0 <= sid < smax):
+        raise IllegalArgumentError(
+            f"slice id [{sid}] must be in [0, {smax})")
+
+    def pred(seg_i: int, local: int) -> bool:
+        return (seg_i * 2654435761 + local) % smax == sid
+    return pred
+
+
+class ScrollContext:
+    def __init__(self, searcher, rows: list, total: int, page_size: int,
+                 source_spec, index_name: str):
+        self.searcher = searcher
+        self.rows = rows
+        self.total = total
+        self.page_size = page_size
+        self.source_spec = source_spec
+        self.index_name = index_name
+        self.pos = 0
+
+    def next_page(self) -> list:
+        page = self.rows[self.pos: self.pos + self.page_size]
+        self.pos += len(page)
+        return page
+
+
+class PitContext:
+    def __init__(self, searcher, index_name: str):
+        self.searcher = searcher
+        self.index_name = index_name
+
+
+class ReaderContextRegistry:
+    """Keepalive-bounded registry of scroll/PIT contexts.  ``now_fn`` is
+    injectable so tests drive expiry deterministically."""
+
+    def __init__(self, now_fn: Callable[[], float] = time.monotonic,
+                 max_open: int = 500):
+        self._now = now_fn
+        self._max_open = max_open
+        self._lock = threading.Lock()
+        self._ctxs: dict[str, tuple[object, float, int]] = {}
+        # id -> (ctx, expires_at_monotonic_ms, keepalive_ms)
+
+    def _reap(self):
+        now = self._now() * 1000
+        for cid in [c for c, (_ctx, exp, _ka) in self._ctxs.items()
+                    if exp <= now]:
+            del self._ctxs[cid]
+
+    def open(self, ctx, keepalive_ms: int) -> str:
+        with self._lock:
+            self._reap()
+            if len(self._ctxs) >= self._max_open:
+                raise IllegalArgumentError(
+                    f"trying to open too many search contexts "
+                    f"(>{self._max_open}) — close scrolls/PITs or let "
+                    "keepalives lapse")
+            cid = uuid.uuid4().hex
+            self._ctxs[cid] = (ctx, self._now() * 1000 + keepalive_ms,
+                              keepalive_ms)
+            return cid
+
+    def get(self, cid: str, keepalive_ms: Optional[int] = None):
+        """Fetch + touch (every access extends the lease, like the
+        reference's keepalive refresh on use)."""
+        with self._lock:
+            self._reap()
+            entry = self._ctxs.get(cid)
+            if entry is None:
+                raise SearchContextMissingError(
+                    f"No search context found for id [{cid}]")
+            ctx, _exp, ka = entry
+            if keepalive_ms is not None:
+                ka = keepalive_ms
+            self._ctxs[cid] = (ctx, self._now() * 1000 + ka, ka)
+            return ctx
+
+    def close(self, cid: str) -> bool:
+        with self._lock:
+            return self._ctxs.pop(cid, None) is not None
+
+    def close_all(self) -> int:
+        with self._lock:
+            n = len(self._ctxs)
+            self._ctxs.clear()
+            return n
+
+    def count(self) -> int:
+        with self._lock:
+            self._reap()
+            return len(self._ctxs)

@@ -35,6 +35,7 @@ from opensearch_tpu.search.query_dsl import parse_query
 
 _F32 = np.float32
 _I32 = np.int32
+_I32_MAX = 2**31 - 1
 
 
 def _dummy_for(group: str, field: str, dseg: DeviceSegment, mapper):
@@ -185,6 +186,18 @@ class ShardSearcher:
         sort_specs = _parse_sort(body.get("sort"))
         min_score = body.get("min_score")
         source_spec = body.get("_source")
+        search_after = body.get("search_after")
+        if search_after is not None:
+            if sort_specs is None:
+                raise IllegalArgumentError(
+                    "[search_after] requires an explicit [sort]")
+            if not isinstance(search_after, (list, tuple)):
+                raise IllegalArgumentError(
+                    "[search_after] must be an array of sort values")
+            if len(search_after) != len(sort_specs):
+                raise IllegalArgumentError(
+                    f"[search_after] has {len(search_after)} values but "
+                    f"sort has {len(sort_specs)} fields")
 
         # field-sorted queries that never reference _score skip BM25 scoring
         needs_scores = (sort_specs is None
@@ -209,9 +222,9 @@ class ShardSearcher:
                 rows, total, max_score = self._topk(plan, bind, needed,
                                                     k_want, min_score)
         else:
-            rows, total, max_score = self._field_sorted(plan, bind, needed,
-                                                        k_want, sort_specs,
-                                                        min_score, views)
+            rows, total, max_score = self._field_sorted(
+                plan, bind, needed, k_want, sort_specs, min_score, views,
+                search_after=search_after)
         rows = rows[from_: from_ + size]
 
         aggregations = partials = None
@@ -396,7 +409,11 @@ class ShardSearcher:
             f"sorting on field [{field}] of type [{ft.type_name}] is not supported")
 
     def _field_sorted(self, plan, bind, needed, k_want, sort_specs, min_score,
-                      views=None):
+                      views=None, row_filter=None, search_after=None):
+        """``k_want=None`` returns EVERY matched row (scroll
+        materialization); ``row_filter(seg_i, local)`` implements sliced
+        scans; ``search_after`` drops rows at-or-before the given sort
+        tuple (PIT pagination)."""
         rows = []
         total = 0
         if views is None:
@@ -404,8 +421,14 @@ class ShardSearcher:
         for si, (seg, dseg, scores, matched) in enumerate(views):
             matched_np = np.asarray(matched)[: seg.n_docs]
             scores_np = np.asarray(scores)[: seg.n_docs]
-            total += int(matched_np.sum())
             idxs = np.nonzero(matched_np)[0]
+            if row_filter is not None and len(idxs):
+                keep = np.fromiter((row_filter(si, int(i)) for i in idxs),
+                                   bool, count=len(idxs))
+                idxs = idxs[keep]
+            # total reflects THIS cursor's doc set: a slice reports the
+            # slice's count, not the whole match count
+            total += len(idxs)
             if len(idxs) == 0:
                 continue
             key_cols = [self._sort_key_columns(seg, spec, scores_np)
@@ -418,12 +441,62 @@ class ShardSearcher:
                              "score": float(scores_np[i])})
         cmp = _sort_comparator(sort_specs)
         rows.sort(key=functools.cmp_to_key(cmp))
+        if search_after is not None:
+            probe = {"sort": list(search_after), "seg": _I32_MAX,
+                     "local": _I32_MAX}
+            rows = [r for r in rows if cmp(r, probe) > 0]
         out = []
         for row in rows[:k_want]:
             out.append({"seg": row["seg"], "local": row["local"],
                         "score": None,
                         "sort": [_sort_value(v) for v in row["sort"]]})
         return out, total, None
+
+    def scan_rows(self, body: Optional[dict] = None, slice_spec=None):
+        """Materialize EVERY matched row in result order (scroll-context
+        creation; SliceBuilder partition via ``slice_spec``).  Returns
+        (rows, total) where rows carry seg/local/score/sort."""
+        from opensearch_tpu.search.contexts import slice_filter
+
+        body = body or {}
+        pred = slice_filter(slice_spec)
+        q = parse_query(body.get("query"))
+        sort_specs = _parse_sort(body.get("sort"))
+        min_score = body.get("min_score")
+        needs_scores = sort_specs is None or min_score is not None or \
+            any(s["field"] == "_score" for s in sort_specs)
+        plan, bind = compile_query(q, self.ctx, scored=needs_scores)
+        needed = plan.arrays()
+        if not self.segments:
+            return [], 0
+        if sort_specs is not None:
+            rows, total, _ = self._field_sorted(
+                plan, bind, needed, None, sort_specs, min_score,
+                row_filter=pred)
+            return rows, total
+        per_seg_scores, per_seg_ids = [], []
+        total = 0
+        for si, (seg, dseg, scores, matched) in enumerate(
+                self._run_full(plan, bind, needed, min_score)):
+            m = np.asarray(matched)[: seg.n_docs]
+            s = np.asarray(scores)[: seg.n_docs]
+            idxs = np.nonzero(m)[0]
+            if pred is not None and len(idxs):
+                keep = np.fromiter((pred(si, int(i)) for i in idxs), bool,
+                                   count=len(idxs))
+                idxs = idxs[keep]
+            total += len(idxs)     # the slice's own count (see above)
+            per_seg_scores.append(s[idxs])
+            per_seg_ids.append((np.full(len(idxs), si, np.int32), idxs))
+        if not per_seg_scores:
+            return [], 0
+        sc = np.concatenate(per_seg_scores)
+        segi = np.concatenate([a for a, _l in per_seg_ids])
+        local = np.concatenate([l for _a, l in per_seg_ids])
+        order = np.lexsort((local, segi, -sc))
+        rows = [{"seg": int(segi[i]), "local": int(local[i]),
+                 "score": float(sc[i])} for i in order]
+        return rows, total
 
 
 def _missing_sentinel(kind, order, missing):

@@ -1,0 +1,388 @@
+"""Snapshots: incremental, segment-file-level backup into a blob store,
+and restore into fresh indices.
+
+Analog of the reference's SnapshotsService + BlobStoreRepository (ref
+snapshots/SnapshotsService.java:262 createSnapshot,
+snapshots/SnapshotShardsService.java:91 per-shard upload,
+repositories/blobstore/BlobStoreRepository.java:1 the index-N/shard-gen
+layout, snapshots/RestoreService.java restore).  Immutable array
+segments make the incremental story trivial: a segment file's content
+hash IS its identity, so unchanged segments across snapshots share one
+blob (the reference dedups by file checksum the same way).
+
+Repository layout (content-addressed):
+
+- ``index.json``                 — repository generation: list of snapshots
+- ``snap/<name>.json``           — one snapshot's manifest: per index the
+                                   settings + mappings + per-shard file
+                                   list (logical name -> blob hash)
+- ``blobs/<sha256>``             — segment file contents, deduplicated
+
+A snapshot flushes every local shard first, so the captured commit point
+covers every acked write (translog is empty at the commit, exactly like
+the reference's flush-before-snapshot).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from opensearch_tpu.common.blobstore import (BLOBSTORE_TYPES, BlobStore,
+                                             NoSuchBlobError)
+from opensearch_tpu.common.errors import (IllegalArgumentError,
+                                          OpenSearchTpuError,
+                                          ValidationError)
+
+
+class RepositoryMissingError(OpenSearchTpuError):
+    status = 404
+
+
+class SnapshotMissingError(OpenSearchTpuError):
+    status = 404
+
+
+class SnapshotInProgressError(OpenSearchTpuError):
+    status = 503
+
+
+class InvalidSnapshotNameError(ValidationError):
+    pass
+
+
+_SEGMENT_SUFFIXES = (".npz", ".json", ".src", ".liv")
+
+
+class Repository:
+    def __init__(self, name: str, type_: str, settings: dict):
+        factory = BLOBSTORE_TYPES.get(type_)
+        if factory is None:
+            raise IllegalArgumentError(
+                f"repository type [{type_}] not supported — available: "
+                f"{sorted(BLOBSTORE_TYPES)}")
+        self.name = name
+        self.type = type_
+        self.settings = settings
+        self.store: BlobStore = factory(settings)
+        self.root = self.store.container()
+        self.snaps = self.store.container("snap")
+        self.blobs = self.store.container("blobs")
+
+    # -- repository index --------------------------------------------------
+
+    def list_snapshots(self) -> list[dict]:
+        try:
+            return json.loads(self.root.read_blob("index.json"))["snapshots"]
+        except NoSuchBlobError:
+            return []
+
+    def _write_index(self, snapshots: list[dict]):
+        self.root.write_blob("index.json",
+                             json.dumps({"snapshots": snapshots}).encode())
+
+    def manifest(self, snapshot: str) -> dict:
+        try:
+            return json.loads(self.snaps.read_blob(snapshot + ".json"))
+        except NoSuchBlobError:
+            raise SnapshotMissingError(
+                f"[{self.name}:{snapshot}] is missing") from None
+
+
+class SnapshotsService:
+    """Node-level snapshot/restore orchestration over registered
+    repositories.  ``indices_service`` is the node's IndicesService."""
+
+    def __init__(self, indices_service, data_path: str):
+        self.indices_service = indices_service
+        self.data_path = data_path
+        self._repos: dict[str, Repository] = {}
+        self._lock = threading.Lock()
+        self._in_progress: set[str] = set()
+        # serializes every mutation of one repository (create's blob
+        # dedup + index.json RMW, delete's GC): a delete running beside a
+        # create could collect blobs the create just deduplicated
+        # against, and concurrent creates would lose index.json entries
+        # (the reference blocks repo ops on in-progress snapshots too)
+        self._repo_mutex: dict[str, threading.Lock] = {}
+        self._repo_file = os.path.join(data_path, "repositories.json")
+        self._load_repos()
+
+    # -- repositories ------------------------------------------------------
+
+    def _load_repos(self):
+        if os.path.exists(self._repo_file):
+            with open(self._repo_file) as f:
+                for name, spec in json.load(f).items():
+                    self._repos[name] = Repository(
+                        name, spec["type"], spec.get("settings") or {})
+
+    def _persist_repos(self):
+        tmp = self._repo_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({r.name: {"type": r.type, "settings": r.settings}
+                       for r in self._repos.values()}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._repo_file)
+
+    def put_repository(self, name: str, body: dict) -> dict:
+        type_ = body.get("type")
+        if not type_:
+            raise ValidationError("repository [type] is required")
+        repo = Repository(name, type_, body.get("settings") or {})
+        # verify: a write+read round trip (VerifyRepositoryAction analog)
+        probe = f"verify-{int(time.time() * 1000)}"
+        repo.root.write_blob(probe, b"ok")
+        repo.root.delete_blob(probe)
+        with self._lock:
+            self._repos[name] = repo
+            self._persist_repos()
+        return {"acknowledged": True}
+
+    def get_repository(self, name: Optional[str] = None) -> dict:
+        with self._lock:
+            if name is None:
+                return {r.name: {"type": r.type, "settings": r.settings}
+                        for r in self._repos.values()}
+            repo = self._repos.get(name)
+            if repo is None:
+                raise RepositoryMissingError(f"[{name}] missing")
+            return {name: {"type": repo.type, "settings": repo.settings}}
+
+    def delete_repository(self, name: str) -> dict:
+        with self._lock:
+            if name not in self._repos:
+                raise RepositoryMissingError(f"[{name}] missing")
+            del self._repos[name]
+            self._persist_repos()
+        return {"acknowledged": True}
+
+    def _repo(self, name: str) -> Repository:
+        with self._lock:
+            repo = self._repos.get(name)
+        if repo is None:
+            raise RepositoryMissingError(f"[{name}] missing")
+        return repo
+
+    def _mutex(self, repo_name: str) -> threading.Lock:
+        with self._lock:
+            lock = self._repo_mutex.get(repo_name)
+            if lock is None:
+                lock = self._repo_mutex[repo_name] = threading.Lock()
+            return lock
+
+    # -- create ------------------------------------------------------------
+
+    def create_snapshot(self, repo_name: str, snapshot: str,
+                        body: Optional[dict] = None) -> dict:
+        body = body or {}
+        if not snapshot or snapshot != snapshot.lower() or "/" in snapshot:
+            raise InvalidSnapshotNameError(
+                f"invalid snapshot name [{snapshot}]: must be lowercase "
+                "without slashes")
+        repo = self._repo(repo_name)
+        if any(s["snapshot"] == snapshot for s in repo.list_snapshots()):
+            raise InvalidSnapshotNameError(
+                f"snapshot with the same name [{snapshot}] already exists")
+        key = f"{repo_name}/{snapshot}"
+        with self._lock:
+            if key in self._in_progress:
+                raise SnapshotInProgressError(f"[{key}] already running")
+            self._in_progress.add(key)
+        try:
+            with self._mutex(repo_name):
+                return self._do_create(repo, snapshot, body)
+        finally:
+            with self._lock:
+                self._in_progress.discard(key)
+
+    def _index_names(self, expr) -> list[str]:
+        if not expr or expr in ("_all", "*"):
+            return sorted(self.indices_service.indices)
+        if isinstance(expr, str):
+            expr = [e.strip() for e in expr.split(",") if e.strip()]
+        out = []
+        for e in expr:
+            out.extend(s.name for s in self.indices_service.resolve(e))
+        return sorted(set(out))
+
+    def _do_create(self, repo: Repository, snapshot: str, body: dict) -> dict:
+        t0 = time.time()
+        names = self._index_names(body.get("indices"))
+        indices_meta = {}
+        total_files = 0
+        reused_files = 0
+        for name in names:
+            svc = self.indices_service.get(name)
+            shards_meta = {}
+            for shard_id, engine in sorted(svc.local_shards.items()):
+                commit = engine.flush()
+                seg_dir = os.path.join(engine.data_path, "segments")
+                files = []
+                for seg_id in commit["segments"]:
+                    for suffix in _SEGMENT_SUFFIXES:
+                        path = os.path.join(seg_dir, seg_id + suffix)
+                        if not os.path.exists(path):
+                            continue
+                        with open(path, "rb") as f:
+                            data = f.read()
+                        digest = hashlib.sha256(data).hexdigest()
+                        total_files += 1
+                        if repo.blobs.blob_exists(digest):
+                            reused_files += 1    # incremental: shared blob
+                        else:
+                            repo.blobs.write_blob(digest, data)
+                        files.append({"name": seg_id + suffix,
+                                      "blob": digest, "size": len(data)})
+                shards_meta[str(shard_id)] = {
+                    "commit": commit, "files": files}
+            indices_meta[name] = {
+                "settings": dict(svc.settings),
+                "mappings": svc.mapper.to_mapping(),
+                "shards": shards_meta,
+            }
+        manifest = {
+            "snapshot": snapshot,
+            "state": "SUCCESS",
+            "indices": indices_meta,
+            "start_time_in_millis": int(t0 * 1000),
+            "end_time_in_millis": int(time.time() * 1000),
+            "total_files": total_files,
+            "reused_files": reused_files,
+        }
+        repo.snaps.write_blob(snapshot + ".json",
+                              json.dumps(manifest).encode())
+        snapshots = repo.list_snapshots()
+        snapshots.append({"snapshot": snapshot, "state": "SUCCESS",
+                          "indices": sorted(indices_meta)})
+        repo._write_index(snapshots)
+        return {"snapshot": {"snapshot": snapshot, "state": "SUCCESS",
+                             "indices": sorted(indices_meta),
+                             "shards": {"total": sum(
+                                 len(m["shards"])
+                                 for m in indices_meta.values()),
+                                 "failed": 0}}}
+
+    # -- read --------------------------------------------------------------
+
+    def get_snapshot(self, repo_name: str, snapshot: Optional[str]) -> dict:
+        repo = self._repo(repo_name)
+        if snapshot in (None, "_all", "*"):
+            return {"snapshots": repo.list_snapshots()}
+        m = repo.manifest(snapshot)
+        return {"snapshots": [{"snapshot": m["snapshot"],
+                               "state": m["state"],
+                               "indices": sorted(m["indices"]),
+                               "start_time_in_millis":
+                                   m["start_time_in_millis"],
+                               "end_time_in_millis":
+                                   m["end_time_in_millis"]}]}
+
+    def delete_snapshot(self, repo_name: str, snapshot: str) -> dict:
+        """Remove the snapshot, then garbage-collect blobs no other
+        snapshot references (BlobStoreRepository's stale-blob cleanup)."""
+        repo = self._repo(repo_name)
+        with self._mutex(repo_name):
+            repo.manifest(snapshot)                   # 404 if absent
+            snapshots = [s for s in repo.list_snapshots()
+                         if s["snapshot"] != snapshot]
+            repo._write_index(snapshots)
+            repo.snaps.delete_blob(snapshot + ".json")
+            referenced = set()
+            for s in snapshots:
+                m = repo.manifest(s["snapshot"])
+                for imeta in m["indices"].values():
+                    for smeta in imeta["shards"].values():
+                        referenced.update(f["blob"]
+                                          for f in smeta["files"])
+            for blob in list(repo.blobs.list_blobs()):
+                if blob not in referenced:
+                    repo.blobs.delete_blob(blob)
+        return {"acknowledged": True}
+
+    # -- restore -----------------------------------------------------------
+
+    def restore_snapshot(self, repo_name: str, snapshot: str,
+                         body: Optional[dict] = None) -> dict:
+        """Materialize snapshotted shard commit points into fresh index
+        directories, then open them (RestoreService analog; restore into
+        an existing index name requires it deleted first, like a closed
+        index in the reference)."""
+        body = body or {}
+        repo = self._repo(repo_name)
+        m = repo.manifest(snapshot)
+        want = body.get("indices")
+        names = (self._restore_names(m, want) if want
+                 else sorted(m["indices"]))
+        rename_pattern = body.get("rename_pattern")
+        rename_replacement = body.get("rename_replacement", "")
+        restored = []
+        for name in names:
+            imeta = m["indices"].get(name)
+            if imeta is None:
+                raise SnapshotMissingError(
+                    f"index [{name}] not in snapshot [{snapshot}]")
+            target = name
+            if rename_pattern:
+                import re
+                target = re.sub(rename_pattern, rename_replacement, name)
+            # validate the (possibly renamed) target BEFORE any file is
+            # written: a malicious rename_replacement must not traverse
+            # out of the data path, and an invalid name must not leave
+            # orphan shard dirs behind
+            self.indices_service.validate_name(target)
+            if self.indices_service.exists(target):
+                raise ValidationError(
+                    f"cannot restore index [{target}] because an open "
+                    "index with same name already exists — delete it or "
+                    "rename on restore")
+            index_path = os.path.join(self.indices_service.data_path,
+                                      target)
+            for shard_id, smeta in imeta["shards"].items():
+                shard_dir = os.path.join(index_path, shard_id)
+                seg_dir = os.path.join(shard_dir, "segments")
+                os.makedirs(seg_dir, exist_ok=True)
+                for fmeta in smeta["files"]:
+                    data = repo.blobs.read_blob(fmeta["blob"])
+                    tmp = os.path.join(seg_dir, fmeta["name"] + ".tmp")
+                    with open(tmp, "wb") as f:
+                        f.write(data)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(tmp, os.path.join(seg_dir, fmeta["name"]))
+                commit = dict(smeta["commit"])
+                # the restored translog starts empty at the commit's
+                # generation (flush-before-snapshot trimmed it)
+                tmp = os.path.join(shard_dir, "commit.json.tmp")
+                with open(tmp, "w") as f:
+                    json.dump(commit, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, os.path.join(shard_dir, "commit.json"))
+            self.indices_service.open_restored(
+                target, imeta["settings"], imeta["mappings"])
+            restored.append(target)
+        return {"snapshot": {"snapshot": snapshot,
+                             "indices": restored,
+                             "shards": {"failed": 0, "total": sum(
+                                 len(m["indices"][n]["shards"])
+                                 for n in names)}}}
+
+    @staticmethod
+    def _restore_names(m: dict, expr) -> list[str]:
+        if isinstance(expr, str):
+            expr = [e.strip() for e in expr.split(",") if e.strip()]
+        import fnmatch
+        out = []
+        for e in expr:
+            hits = fnmatch.filter(sorted(m["indices"]), e)
+            if not hits and "*" not in e:
+                raise SnapshotMissingError(
+                    f"index [{e}] not in snapshot [{m['snapshot']}]")
+            out.extend(hits)
+        return sorted(set(out))

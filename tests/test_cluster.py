@@ -289,3 +289,64 @@ def test_failover_promotes_replica_no_data_loss(cluster):
         assert r["result"] == "created"
     # replacement replicas recover on the survivors and rejoin in-sync
     assert wait_until(lambda: _in_sync_full(nodes, "n0", "dur"))
+
+
+def test_full_cluster_restart_survives(tmp_path):
+    """Gateway persistence (VERDICT r3 item 4): indices, routing, docs,
+    and coordination-term monotonicity survive stopping EVERY node and
+    restarting from disk (ref gateway/PersistedClusterStateService.java:137)."""
+    ids = ["n0", "n1", "n2"]
+
+    def boot():
+        hub = LocalTransport.Hub()
+        nodes = {}
+        for nid in ids:
+            svc = TransportService(nid, LocalTransport(hub))
+            nodes[nid] = ClusterNode(nid, str(tmp_path / nid), svc, ids)
+        return hub, nodes
+
+    hub, nodes = boot()
+    assert nodes["n0"].start_election()
+    wait_until(lambda: all(
+        nodes[i].coordinator.state().master_node == "n0" for i in ids))
+    nodes["n0"].create_index("persisted", {
+        "settings": {"number_of_shards": 3},
+        "mappings": {"properties": {"msg": {"type": "text"}}}})
+    wait_until(lambda: all("persisted" in nodes[i].indices for i in ids))
+    for i in range(9):
+        nodes["n0"].index_doc("persisted", str(i), {"msg": f"doc {i}"})
+    nodes["n0"].refresh("persisted")
+    # flush every shard so segments + commit points hit disk
+    for n in nodes.values():
+        for svc in n.indices.values():
+            svc.flush()
+    term_before = nodes["n0"].coordinator.current_term
+    routing_before = nodes["n0"].coordinator.state().routing["persisted"]
+    for n in nodes.values():
+        n.stop()
+
+    # full-cluster restart: fresh transports, fresh objects, same disks
+    hub, nodes = boot()
+    # terms were restored from disk, not reset to zero
+    assert all(nodes[i].coordinator.current_term >= term_before for i in ids)
+    # committed state (indices + routing) was restored before any election
+    assert all("persisted" in nodes[i].coordinator.state().indices
+               for i in ids)
+    assert nodes["n0"].coordinator.state().routing["persisted"] == \
+        routing_before
+    # a new election must move to a STRICTLY higher term (monotonicity)
+    assert nodes["n0"].start_election()
+    assert nodes["n0"].coordinator.current_term > term_before
+    wait_until(lambda: all(
+        nodes[i].coordinator.state().master_node == "n0" for i in ids))
+    # the data came back: distributed search finds every doc
+    resp = nodes["n2"].search("persisted", {"query": {"match_all": {}},
+                                            "size": 20})
+    assert resp["hits"]["total"]["value"] == 9
+    got = {h["_id"] for h in resp["hits"]["hits"]}
+    assert got == {str(i) for i in range(9)}
+    # and writes still work under the new term
+    nodes["n1"].index_doc("persisted", "new", {"msg": "post restart"})
+    assert nodes["n1"].get_doc("persisted", "new") is not None
+    for n in nodes.values():
+        n.stop()

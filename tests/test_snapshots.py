@@ -1,0 +1,150 @@
+"""Snapshots: fs repository registration, incremental segment-file
+snapshot, restore (fresh name + rename), delete w/ blob GC — the
+round-trip 'done' bar from VERDICT r3 item 5 (ref
+snapshots/SnapshotsService.java:262, BlobStoreRepository.java:1)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(str(tmp_path / "node"), port=0).start()
+    yield n
+    n.stop()
+
+
+def call(node, method, path, body=None):
+    url = f"http://127.0.0.1:{node.port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            payload = resp.read()
+            return resp.status, json.loads(payload) if payload else {}
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, json.loads(payload) if payload else {}
+
+
+def seed(node, index, n, offset=0):
+    call(node, "PUT", f"/{index}", {"mappings": {"properties": {
+        "msg": {"type": "text"}, "n": {"type": "long"}}}})
+    for i in range(offset, offset + n):
+        call(node, "PUT", f"/{index}/_doc/{i}",
+             {"msg": f"message {i}", "n": i})
+    call(node, "POST", f"/{index}/_refresh")
+
+
+def test_snapshot_restore_round_trip(node, tmp_path):
+    seed(node, "src", 12)
+    code, _ = call(node, "PUT", "/_snapshot/backups", {
+        "type": "fs", "settings": {"location": str(tmp_path / "repo")}})
+    assert code == 200
+    code, resp = call(node, "PUT", "/_snapshot/backups/snap1", {})
+    assert code == 200
+    assert resp["snapshot"]["state"] == "SUCCESS"
+    assert resp["snapshot"]["indices"] == ["src"]
+
+    # destructive change after the snapshot
+    call(node, "DELETE", "/src/_doc/0")
+    call(node, "DELETE", "/src")
+    code, resp = call(node, "GET", "/src/_search")
+    assert code == 404
+
+    code, resp = call(node, "POST", "/_snapshot/backups/snap1/_restore", {})
+    assert code == 200
+    code, resp = call(node, "POST", "/src/_search",
+                      {"query": {"match_all": {}}, "size": 50})
+    assert code == 200
+    assert resp["hits"]["total"]["value"] == 12
+    # restored docs searchable AND gettable (version map rebuilt from
+    # restored segments)
+    code, resp = call(node, "GET", "/src/_doc/0")
+    assert code == 200 and resp["_source"]["n"] == 0
+    # restored index accepts new writes
+    code, _ = call(node, "PUT", "/src/_doc/new", {"msg": "fresh", "n": 99})
+    assert code in (200, 201)
+
+
+def test_snapshot_incremental_reuses_blobs(node, tmp_path):
+    seed(node, "inc", 8)
+    call(node, "PUT", "/_snapshot/backups", {
+        "type": "fs", "settings": {"location": str(tmp_path / "repo")}})
+    call(node, "PUT", "/_snapshot/backups/first", {})
+    # add a new segment; old segments' blobs must be REUSED
+    seed(node, "inc", 4, offset=100)
+    code, resp = call(node, "PUT", "/_snapshot/backups/second", {})
+    assert code == 200
+    m = json.loads(
+        (tmp_path / "repo" / "snap" / "second.json").read_text())
+    assert m["reused_files"] > 0
+    assert m["total_files"] > m["reused_files"]
+
+
+def test_snapshot_restore_rename(node, tmp_path):
+    seed(node, "orig", 5)
+    call(node, "PUT", "/_snapshot/backups", {
+        "type": "fs", "settings": {"location": str(tmp_path / "repo")}})
+    call(node, "PUT", "/_snapshot/backups/s1", {})
+    code, resp = call(node, "POST", "/_snapshot/backups/s1/_restore", {
+        "indices": "orig", "rename_pattern": "orig",
+        "rename_replacement": "copy"})
+    assert code == 200 and resp["snapshot"]["indices"] == ["copy"]
+    code, resp = call(node, "POST", "/copy/_search",
+                      {"query": {"match": {"msg": "message"}}, "size": 10})
+    assert resp["hits"]["total"]["value"] == 5
+    # original untouched
+    code, resp = call(node, "POST", "/orig/_count")
+    assert resp["count"] == 5
+    # restoring over an OPEN index is rejected
+    code, resp = call(node, "POST", "/_snapshot/backups/s1/_restore", {})
+    assert code == 400
+
+
+def test_snapshot_delete_gcs_unreferenced_blobs(node, tmp_path):
+    seed(node, "gc", 6)
+    call(node, "PUT", "/_snapshot/backups", {
+        "type": "fs", "settings": {"location": str(tmp_path / "repo")}})
+    call(node, "PUT", "/_snapshot/backups/a", {})
+    seed(node, "gc", 3, offset=50)
+    call(node, "PUT", "/_snapshot/backups/b", {})
+    blobs_dir = tmp_path / "repo" / "blobs"
+    n_with_both = len(list(blobs_dir.iterdir()))
+    code, _ = call(node, "DELETE", "/_snapshot/backups/b")
+    assert code == 200
+    n_after = len(list(blobs_dir.iterdir()))
+    assert n_after < n_with_both            # b-only blobs collected
+    # snapshot a still restorable after the GC
+    call(node, "DELETE", "/gc")
+    code, resp = call(node, "POST", "/_snapshot/backups/a/_restore", {})
+    assert code == 200
+    code, resp = call(node, "POST", "/gc/_count")
+    assert resp["count"] == 6
+
+
+def test_snapshot_error_shapes(node, tmp_path):
+    code, resp = call(node, "PUT", "/_snapshot/bad", {"type": "s3"})
+    assert code == 400
+    code, resp = call(node, "GET", "/_snapshot/nope")
+    assert code == 404
+    call(node, "PUT", "/_snapshot/backups", {
+        "type": "fs", "settings": {"location": str(tmp_path / "repo")}})
+    code, resp = call(node, "GET", "/_snapshot/backups/missing")
+    assert code == 404
+    code, resp = call(node, "PUT", "/_snapshot/backups/BAD~NAME", {})
+    assert code == 400
+    seed(node, "dup", 2)
+    call(node, "PUT", "/_snapshot/backups/dup1", {})
+    code, resp = call(node, "PUT", "/_snapshot/backups/dup1", {})
+    assert code == 400                      # duplicate snapshot name
+    # fs repo without location
+    code, resp = call(node, "PUT", "/_snapshot/noloc", {"type": "fs"})
+    assert code == 500 or code == 400
