@@ -305,6 +305,9 @@ class IndexService:
             return False
         if body.get("sort") is not None:
             return False
+        q = body.get("query")
+        if isinstance(q, dict) and "hybrid" in q:
+            return False       # hybrid dispatches inside ShardSearcher
         import jax
 
         return len(jax.devices()) >= len(self.local_shards)

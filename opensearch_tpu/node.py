@@ -34,8 +34,10 @@ class Node:
         self.indices = IndicesService(os.path.join(data_path, "indices"))
         from opensearch_tpu.snapshots.service import SnapshotsService
         from opensearch_tpu.search.contexts import ReaderContextRegistry
+        from opensearch_tpu.search.pipeline import SearchPipelineService
         self.snapshots = SnapshotsService(self.indices, data_path)
         self.contexts = ReaderContextRegistry()
+        self.search_pipelines = SearchPipelineService(data_path)
         self.rest = RestController(self)
         self.http = HttpServer(self.rest, host=host, port=port)
 

@@ -125,6 +125,10 @@ class RestController:
         r("DELETE", "/_search/scroll/_all", self.h_scroll_clear_all)
         r("DELETE", "/_search/scroll", self.h_scroll_clear)
         r("DELETE", "/_search/point_in_time", self.h_pit_close)
+        r("GET", "/_search/pipeline", self.h_get_pipelines)
+        r("GET", "/_search/pipeline/{id}", self.h_get_pipeline)
+        r("PUT", "/_search/pipeline/{id}", self.h_put_pipeline)
+        r("DELETE", "/_search/pipeline/{id}", self.h_delete_pipeline)
         r("GET", "/_count", self.h_count)
         r("POST", "/_count", self.h_count)
         r("GET", "/_mapping", self.h_get_mapping_all)
@@ -676,6 +680,13 @@ class RestController:
             body["size"] = int(req.param("size"))
         if req.param("from") is not None:
             body["from"] = int(req.param("from"))
+        # search pipeline: resolve the normalization-processor config the
+        # hybrid combination should use (neural-search's hook)
+        pid = req.param("search_pipeline")
+        if pid:
+            conf = self.node.search_pipelines.hybrid_conf(pid)
+            if conf is not None:
+                body["_hybrid_pipeline"] = conf
         # PIT search: the body names a held reader; no index in the path
         if body.get("pit"):
             return 200, self._pit_search(body)
@@ -772,6 +783,22 @@ class RestController:
                 aggs_json, [r.get("aggregation_partials") or {}
                             for r in responses])
         return out
+
+    # -- search pipelines --------------------------------------------------
+
+    def h_get_pipelines(self, req):
+        return 200, self.node.search_pipelines.get()
+
+    def h_get_pipeline(self, req):
+        return 200, self.node.search_pipelines.get(req.path_params["id"])
+
+    def h_put_pipeline(self, req):
+        return 200, self.node.search_pipelines.put(
+            req.path_params["id"], req.json({}) or {})
+
+    def h_delete_pipeline(self, req):
+        return 200, self.node.search_pipelines.delete(
+            req.path_params["id"])
 
     # -- snapshots ---------------------------------------------------------
 

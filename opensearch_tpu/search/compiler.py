@@ -617,6 +617,33 @@ def _c_knn(q, ctx, scored):
     return P.ScoredMaskPlan(label="knn"), {"fn": fn}
 
 
+def _c_script_score(q, ctx, scored):
+    """script_score: the child query's matched set rescored by a compiled
+    jnp expression (search/scripting.py); BASELINE config #2's
+    knn-via-script shape lowers onto the exact-knn kernels.  Unknown or
+    unsupported scripts raise ScriptException -> a clean 400."""
+    from opensearch_tpu.search.scripting import (ScriptException,
+                                                 compile_score_script)
+
+    program = compile_score_script(q.script)
+    for f in program.numeric_fields:
+        ft = ctx.field_type(f)
+        if ft is not None and ft.dv_kind not in ("long", "double"):
+            raise ScriptException(
+                f"doc['{f}'].value requires a numeric/date field, "
+                f"[{f}] is [{ft.type_name}]")
+    for f in program.vector_fields:
+        ft = ctx.field_type(f)
+        if ft is not None and ft.dv_kind != "vector":
+            raise ScriptException(
+                f"vector function over [{f}] requires a knn_vector "
+                f"field, got [{ft.type_name}]")
+    child = q.query if q.query is not None else dsl.MatchAllQuery()
+    cplan, cbind = compile_query(child, ctx, scored=program.uses_score)
+    return (P.ScriptScorePlan(child=cplan, program=program),
+            {"child": cbind, "boost": q.boost, "min_score": q.min_score})
+
+
 _COMPILERS = {
     dsl.MatchAllQuery: _c_match_all,
     dsl.MatchNoneQuery: _c_match_none,
@@ -637,4 +664,5 @@ _COMPILERS = {
     dsl.DisMaxQuery: _c_dis_max,
     dsl.SimpleQueryStringQuery: _c_simple_query_string,
     dsl.KnnQuery: _c_knn,
+    dsl.ScriptScoreQuery: _c_script_score,
 }

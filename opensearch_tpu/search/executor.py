@@ -183,6 +183,9 @@ class ShardSearcher:
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
         q = parse_query(body.get("query"))
+        from opensearch_tpu.search.query_dsl import HybridQuery
+        if isinstance(q, HybridQuery):
+            return self._hybrid_search(body, q, t0)
         sort_specs = _parse_sort(body.get("sort"))
         min_score = body.get("min_score")
         source_spec = body.get("_source")
@@ -256,6 +259,50 @@ class ShardSearcher:
         if partials is not None:
             resp["aggregation_partials"] = partials
         return resp
+
+    def _hybrid_search(self, body: dict, q, t0) -> dict:
+        """Hybrid query: each sub-query runs as its own device program;
+        the normalization processor (search/pipeline.py) combines the
+        per-sub-query top lists host-side.  ``_hybrid_pipeline`` in the
+        body carries the processor config (wired by the REST layer from
+        ?search_pipeline=...); absent -> min_max + arithmetic_mean."""
+        from opensearch_tpu.common.errors import ValidationError
+        from opensearch_tpu.search.pipeline import NormalizationConfig
+
+        if (body.get("sort") is not None or body.get("aggs")
+                or body.get("aggregations")
+                or body.get("min_score") is not None
+                or body.get("search_after") is not None):
+            raise ValidationError(
+                "[hybrid] query does not support [sort], [aggs], "
+                "[min_score] or [search_after]")
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        k_want = from_ + size
+        conf = NormalizationConfig(body.get("_hybrid_pipeline"))
+        per_query_rows = []
+        max_total = 0
+        for subq in q.queries:
+            plan, bind = compile_query(subq, self.ctx, scored=True)
+            rows, tot, _mx = self._topk(plan, bind, plan.arrays(),
+                                        k_want, None)
+            per_query_rows.append(rows)
+            max_total = max(max_total, int(tot))
+        combined = conf.apply(per_query_rows, k_want)
+        rows = combined[from_: from_ + size]
+        hits = self._hits_from_rows(rows, body.get("_source"))
+        # per-sub-query top-k truncation means the union is a lower
+        # bound beyond the largest sub-query's exact count
+        return {
+            "took": int((time.monotonic() - t0) * 1000),
+            "timed_out": False,
+            "_shards": {"total": 1, "successful": 1, "skipped": 0,
+                        "failed": 0},
+            "hits": {"total": {"value": max_total, "relation": "gte"},
+                     "max_score": (combined[0]["score"] if combined
+                                   else None),
+                     "hits": hits},
+        }
 
     def msearch(self, bodies: list) -> list[dict]:
         """Multi-search (the ``_msearch`` analog): bodies that compile to a

@@ -467,6 +467,66 @@ class ScoredMaskPlan(Plan):
         return jnp.where(mask, scores, 0.0).astype(jnp.float32), mask
 
 
+@dataclass(frozen=True)
+class ScriptScorePlan(Plan):
+    """Child plan scores re-mapped by a compiled script expression
+    (ScriptScoreQuery; ref index/query/functionscore + the k-NN plugin's
+    script-score path).  ``program`` is a scripting.ScriptProgram —
+    hashable by (source, params), so identical scripts share one
+    compiled XLA program per shape bucket."""
+
+    child: Plan = None
+    program: object = None
+
+    def arrays(self):
+        return self.child.arrays()
+
+    def prepare(self, bind, seg, dseg, ctx):
+        cdims, cins = self.child.prepare(bind["child"], seg, dseg, ctx)
+        n_pad = dseg.n_pad
+        ncols = []
+        for f in self.program.numeric_fields:
+            col = dseg.numeric.get(f)
+            if col is None:
+                ncols.append((jnp.zeros(n_pad, jnp.float32),
+                              jnp.zeros(n_pad, bool)))
+            else:
+                # dense single-value view: min == the value for
+                # single-valued fields; missing slots read 0.0
+                vals = jnp.where(col["exists"],
+                                 col["minv"].astype(jnp.float32), 0.0)
+                ncols.append((vals, col["exists"]))
+        vcols = []
+        for f in self.program.vector_fields:
+            vcol = dseg.vector.get(f)
+            if vcol is None:
+                from opensearch_tpu.search.scripting import ScriptException
+                raise ScriptException(
+                    f"script references vector field [{f}] with no "
+                    "vectors in this index")
+            vcols.append((vcol["values"], vcol["exists"]))
+        return (cdims,), (cins, tuple(ncols), tuple(vcols),
+                          self.program.param_values(),
+                          _scalar(bind["boost"], _F32),
+                          _scalar(bind.get("min_score")
+                                  if bind.get("min_score") is not None
+                                  else -np.inf, _F32))
+
+    def eval(self, A, dims, ins):
+        (cdims,) = dims
+        cins, ncols, vcols, param_vals, boost, min_score = ins
+        scores, matched = self.child.eval(A, cdims, cins)
+        new = self.program.eval(
+            scores,
+            dict(zip(self.program.numeric_fields, ncols)),
+            dict(zip(self.program.vector_fields, vcols)),
+            param_vals)
+        new = (jnp.broadcast_to(new, matched.shape)
+               .astype(jnp.float32) * boost)
+        matched = matched & (new >= min_score)
+        return jnp.where(matched, new, 0.0), matched
+
+
 def _prepare_children(children, binds, seg, dseg, ctx):
     dims, ins = [], []
     for c, b in zip(children, binds):

@@ -148,9 +148,19 @@ class KnnQuery(Query):
 
 
 @dataclass
+class HybridQuery(Query):
+    """Independent sub-queries whose scores a search pipeline's
+    normalization processor combines (the neural-search plugin's hybrid
+    query; executes per sub-query, never as one plan)."""
+
+    queries: list = dc_field(default_factory=list)
+
+
+@dataclass
 class ScriptScoreQuery(Query):
     query: Optional[Query] = None
     script: dict = dc_field(default_factory=dict)
+    min_score: Optional[float] = None
 
 
 @dataclass
@@ -354,9 +364,22 @@ def _parse_knn(body):
                     boost=_boost(v))
 
 
+def _parse_hybrid(body):
+    qs = body.get("queries")
+    if not isinstance(qs, list) or not qs:
+        raise ParsingError("[hybrid] query requires a [queries] array")
+    if len(qs) > 5:
+        raise ParsingError("[hybrid] supports at most 5 sub-queries")
+    return HybridQuery(queries=[parse_query(q) for q in qs],
+                       boost=_boost(body))
+
+
 def _parse_script_score(body):
+    ms = body.get("min_score")
     return ScriptScoreQuery(query=parse_query(body.get("query")),
-                            script=body.get("script", {}), boost=_boost(body))
+                            script=body.get("script", {}),
+                            min_score=float(ms) if ms is not None else None,
+                            boost=_boost(body))
 
 
 def _parse_simple_query_string(body):
@@ -387,5 +410,6 @@ _PARSERS = {
     "dis_max": _parse_dis_max,
     "knn": _parse_knn,
     "script_score": _parse_script_score,
+    "hybrid": _parse_hybrid,
     "simple_query_string": _parse_simple_query_string,
 }
