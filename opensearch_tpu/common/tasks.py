@@ -1,0 +1,135 @@
+"""Task management: every request runs as a registered, cancellable task.
+
+Analog of the reference's TaskManager/CancellableTask (ref
+tasks/TaskManager.java:1, CancellableTask.java,
+TaskCancellationService.java).  Long device work cooperates by calling
+``Task.ensure_not_cancelled()`` between per-segment programs — the same
+granularity as the reference's CancellableBulkScorer checking between
+Lucene leaf scorers — so a runaway query stops at the next segment
+boundary instead of holding the device until completion.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from typing import Optional
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+
+_current: "contextvars.ContextVar[Optional[Task]]" = \
+    contextvars.ContextVar("opensearch_tpu_task", default=None)
+
+
+def set_current(task: "Task"):
+    return _current.set(task)
+
+
+def reset_current(token) -> None:
+    _current.reset(token)
+
+
+def current() -> "Optional[Task]":
+    return _current.get()
+
+
+def check_current() -> None:
+    """Cooperative cancellation point — cheap no-op without a task."""
+    t = _current.get()
+    if t is not None:
+        t.ensure_not_cancelled()
+
+
+class TaskCancelledException(OpenSearchTpuError):
+    status = 400
+
+
+class Task:
+    def __init__(self, task_id: int, action: str, description: str,
+                 cancellable: bool = True):
+        self.id = task_id
+        self.action = action
+        self.description = description
+        self.cancellable = cancellable
+        self.start_time_millis = int(time.time() * 1000)
+        self._start = time.monotonic()
+        self._cancelled = threading.Event()
+        self.cancel_reason: Optional[str] = None
+
+    def cancel(self, reason: str = "by user request"):
+        if not self.cancellable:
+            raise OpenSearchTpuError(
+                f"task [{self.id}] is not cancellable")
+        self.cancel_reason = reason
+        self._cancelled.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def ensure_not_cancelled(self):
+        if self._cancelled.is_set():
+            raise TaskCancelledException(
+                f"task [{self.id}] was cancelled: {self.cancel_reason}")
+
+    def info(self) -> dict:
+        return {"id": self.id, "action": self.action,
+                "description": self.description,
+                "cancellable": self.cancellable,
+                "cancelled": self.cancelled,
+                "start_time_in_millis": self.start_time_millis,
+                "running_time_in_nanos": int(
+                    (time.monotonic() - self._start) * 1e9)}
+
+
+class TaskManager:
+    def __init__(self, node_name: str = "node"):
+        self.node_name = node_name
+        self._lock = threading.Lock()
+        self._tasks: dict[int, Task] = {}
+        self._next = 0
+
+    def register(self, action: str, description: str = "",
+                 cancellable: bool = True) -> Task:
+        with self._lock:
+            self._next += 1
+            t = Task(self._next, action, description, cancellable)
+            self._tasks[t.id] = t
+            return t
+
+    def unregister(self, task: Task):
+        with self._lock:
+            self._tasks.pop(task.id, None)
+
+    def get(self, task_id: int) -> Optional[Task]:
+        with self._lock:
+            return self._tasks.get(task_id)
+
+    def list(self, actions: Optional[str] = None) -> list[Task]:
+        with self._lock:
+            tasks = list(self._tasks.values())
+        if actions:
+            import fnmatch
+            pats = [a.strip() for a in actions.split(",") if a.strip()]
+            tasks = [t for t in tasks
+                     if any(fnmatch.fnmatch(t.action, p) for p in pats)]
+        return tasks
+
+    def cancel(self, task_id: Optional[int] = None,
+               actions: Optional[str] = None,
+               reason: str = "by user request") -> list[Task]:
+        """Cancel one task by id, or every (cancellable) task matching
+        the actions pattern; returns the tasks flagged."""
+        if task_id is not None:
+            t = self.get(task_id)
+            if t is None:
+                return []
+            t.cancel(reason)
+            return [t]
+        out = []
+        for t in self.list(actions):
+            if t.cancellable and not t.cancelled:
+                t.cancel(reason)
+                out.append(t)
+        return out

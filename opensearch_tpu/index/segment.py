@@ -222,6 +222,31 @@ class DeviceSegment:
         self.n_docs = seg.n_docs
         self.n_pad = pad_pow2(seg.n_docs + 1)
         n_pad = self.n_pad
+        # HBM budget: estimate the staged footprint from the HOST arrays
+        # (padding roughly doubles worst-case; x2 covers it) and charge
+        # the fielddata breaker BEFORE any device allocation — an
+        # oversized staging is rejected as 429, not an OOM
+        # (FileCache/fielddata-breaker analog)
+        from opensearch_tpu.common.breakers import breaker_service
+        est = 0
+        for pf in seg.postings.values():
+            est += (pf.doc_ids.nbytes + pf.tfs.nbytes + pf.offsets.nbytes
+                    + pf.doc_lens.nbytes + pf.positions.nbytes
+                    + pf.pos_offsets.nbytes)
+        for dv in seg.numeric_dv.values():
+            est += dv.values.nbytes + dv.minv.nbytes + dv.maxv.nbytes
+        for dv in seg.ordinal_dv.values():
+            est += dv.ords.nbytes + dv.min_ord.nbytes + dv.max_ord.nbytes
+        for dv in seg.vector_dv.values():
+            est += dv.values.nbytes
+        for dv in seg.geo_dv.values():
+            est += dv.lats.nbytes + dv.lons.nbytes
+        self._breaker_bytes = est * 2
+        breaker = breaker_service().fielddata
+        breaker.add_estimate(self._breaker_bytes,
+                             label=f"segment [{seg.seg_id}] staging")
+        import weakref
+        weakref.finalize(self, breaker.release, self._breaker_bytes)
 
         def pad1(a: np.ndarray, size: int, fill) -> np.ndarray:
             out = np.full(size, fill, dtype=a.dtype)

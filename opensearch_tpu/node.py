@@ -35,9 +35,11 @@ class Node:
         from opensearch_tpu.snapshots.service import SnapshotsService
         from opensearch_tpu.search.contexts import ReaderContextRegistry
         from opensearch_tpu.search.pipeline import SearchPipelineService
+        from opensearch_tpu.common.tasks import TaskManager
         self.snapshots = SnapshotsService(self.indices, data_path)
         self.contexts = ReaderContextRegistry()
         self.search_pipelines = SearchPipelineService(data_path)
+        self.task_manager = TaskManager(name)
         self.rest = RestController(self)
         self.http = HttpServer(self.rest, host=host, port=port)
 

@@ -18,6 +18,7 @@ from opensearch_tpu.common.errors import (
     IndexNotFoundError,
     OpenSearchTpuError,
     ParsingError,
+    ResourceNotFoundError,
     ValidationError,
 )
 from opensearch_tpu.version import __version__ as VERSION
@@ -72,8 +73,22 @@ class RestController:
     def register(self, method: str, pattern: str, handler: Callable):
         self.routes.append(Route(method, pattern, handler))
 
+    # handler-name -> transport-style action name (the reference's task
+    # actions; unlisted handlers register as rest:<handler>)
+    _ACTIONS = {
+        "h_search": "indices:data/read/search",
+        "h_msearch": "indices:data/read/msearch",
+        "h_scroll_next": "indices:data/read/scroll",
+        "h_bulk": "indices:data/write/bulk",
+        "h_count": "indices:data/read/count",
+        "h_create_snapshot": "cluster:admin/snapshot/create",
+        "h_restore_snapshot": "cluster:admin/snapshot/restore",
+    }
+
     def dispatch(self, method: str, path: str, params: dict,
                  body: Optional[bytes]) -> tuple[int, dict]:
+        from opensearch_tpu.common import tasks as taskmod
+
         req = RestRequest(method, path, params, body)
         try:
             for route in self.routes:
@@ -82,7 +97,20 @@ class RestController:
                 m = route.rx.match(path.rstrip("/") or "/")
                 if m:
                     req.path_params = dict(zip(route.names, m.groups()))
-                    return route.handler(req)
+                    # every request runs as a registered, cancellable
+                    # task (TaskManager.register analog); device loops
+                    # check the contextvar between segment programs
+                    handler_name = getattr(route.handler, "__name__", "?")
+                    action = self._ACTIONS.get(handler_name,
+                                               f"rest:{handler_name}")
+                    task = self.node.task_manager.register(
+                        action, f"{method} {path}")
+                    token = taskmod.set_current(task)
+                    try:
+                        return route.handler(req)
+                    finally:
+                        taskmod.reset_current(token)
+                        self.node.task_manager.unregister(task)
             # method-mismatch vs not-found distinction
             if any(r.rx.match(path.rstrip("/") or "/") for r in self.routes):
                 return 405, {"error": f"Incorrect HTTP method for uri [{path}]"
@@ -134,6 +162,10 @@ class RestController:
         r("GET", "/_mapping", self.h_get_mapping_all)
         r("GET", "/_refresh", self.h_refresh)
         r("POST", "/_refresh", self.h_refresh)
+        r("GET", "/_tasks", self.h_tasks_list)
+        r("GET", "/_tasks/{task_id}", self.h_task_get)
+        r("POST", "/_tasks/{task_id}/_cancel", self.h_task_cancel)
+        r("POST", "/_tasks/_cancel", self.h_tasks_cancel_all)
         r("GET", "/_snapshot", self.h_get_repos)
         r("PUT", "/_snapshot/{repo}", self.h_put_repo)
         r("POST", "/_snapshot/{repo}", self.h_put_repo)
@@ -241,12 +273,15 @@ class RestController:
                                 "roles": ["cluster_manager", "data"]}}}
 
     def h_nodes_stats(self, req):
+        from opensearch_tpu.common.breakers import breaker_service
         indices = self.node.indices.indices
         return 200, {"cluster_name": self.node.cluster_name, "nodes": {
             self.node.node_id: {
                 "name": self.node.name,
                 "indices": {"docs": {"count": sum(
                     s.doc_count() for s in indices.values())}},
+                "breakers": breaker_service().stats(),
+                "tasks": {"count": len(self.node.task_manager.list())},
             }}}
 
     def h_cat_indices(self, req):
@@ -716,6 +751,9 @@ class RestController:
             raise ValidationError(
                 "scroll requires exactly one target index")
         svc = services[0]
+        # keep-alive parses BEFORE any breaker reservation: a malformed
+        # value must not leak the context's request-breaker charge
+        keepalive_ms = parse_keepalive(scroll)
         searcher = svc.searcher()
         rows, total = searcher.scan_rows(
             {k: v for k, v in body.items() if k != "slice"},
@@ -724,7 +762,11 @@ class RestController:
                             page_size=int(body.get("size", 10)),
                             source_spec=body.get("_source"),
                             index_name=svc.name)
-        scroll_id = self.node.contexts.open(ctx, parse_keepalive(scroll))
+        try:
+            scroll_id = self.node.contexts.open(ctx, keepalive_ms)
+        except OpenSearchTpuError:
+            ctx.release()
+            raise
         return self._scroll_response(ctx, scroll_id)
 
     def _pit_search(self, body):
@@ -783,6 +825,44 @@ class RestController:
                 aggs_json, [r.get("aggregation_partials") or {}
                             for r in responses])
         return out
+
+    # -- task management ---------------------------------------------------
+
+    def _task_payload(self, tasks):
+        return {"nodes": {self.node.node_id: {
+            "name": self.node.name,
+            "tasks": {f"{self.node.node_id}:{t.id}": t.info()
+                      for t in tasks}}}}
+
+    def h_tasks_list(self, req):
+        return 200, self._task_payload(
+            self.node.task_manager.list(req.param("actions")))
+
+    @staticmethod
+    def _parse_task_id(raw: str) -> int:
+        # accepts bare ids and the node_id:task_id composite form
+        try:
+            return int(raw.rsplit(":", 1)[-1])
+        except ValueError:
+            raise ValidationError(f"invalid task id [{raw}]") from None
+
+    def h_task_get(self, req):
+        tid = self._parse_task_id(req.path_params["task_id"])
+        t = self.node.task_manager.get(tid)
+        if t is None:
+            raise ResourceNotFoundError(f"task [{tid}] isn't running")
+        return 200, {"completed": False, "task": t.info()}
+
+    def h_task_cancel(self, req):
+        tid = self._parse_task_id(req.path_params["task_id"])
+        cancelled = self.node.task_manager.cancel(task_id=tid)
+        if not cancelled:
+            raise ResourceNotFoundError(f"task [{tid}] isn't running")
+        return 200, self._task_payload(cancelled)
+
+    def h_tasks_cancel_all(self, req):
+        return 200, self._task_payload(self.node.task_manager.cancel(
+            actions=req.param("actions") or "*"))
 
     # -- search pipelines --------------------------------------------------
 

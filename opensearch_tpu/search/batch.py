@@ -88,8 +88,11 @@ class BatchGroup:
         k = self.k
         avgdl = searcher.ctx.field_stats(self.field).avgdl
         # accumulated per (query, segment) DEVICE handles; host-synced once
+        from opensearch_tpu.common.tasks import check_current
+
         acc: list[list] = [[] for _ in range(Q)]   # [(seg_order, v, i, t, m)]
         for seg_order, seg in enumerate(searcher.segments):
+            check_current()    # cancellation point per segment program
             dseg = seg.device()
             pf = seg.postings.get(self.field)
             p = dseg.postings.get(self.field)

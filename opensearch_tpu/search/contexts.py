@@ -43,10 +43,15 @@ def parse_keepalive(value, default_ms: int = 60_000) -> int:
     s = str(value)
     units = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
              "d": 86_400_000}
-    for suffix, mult in sorted(units.items(), key=lambda kv: -len(kv[0])):
-        if s.endswith(suffix):
-            return int(float(s[: -len(suffix)]) * mult)
-    return int(float(s) * 1000)
+    try:
+        for suffix, mult in sorted(units.items(),
+                                   key=lambda kv: -len(kv[0])):
+            if s.endswith(suffix):
+                return int(float(s[: -len(suffix)]) * mult)
+        return int(float(s) * 1000)
+    except ValueError:
+        raise IllegalArgumentError(
+            f"failed to parse keep-alive [{s}]") from None
 
 
 def slice_filter(slice_spec: Optional[dict]):
@@ -68,8 +73,11 @@ def slice_filter(slice_spec: Optional[dict]):
 
 
 class ScrollContext:
+    _ROW_BYTES = 96         # dict + three boxed values, rough host cost
+
     def __init__(self, searcher, rows: list, total: int, page_size: int,
                  source_spec, index_name: str):
+        from opensearch_tpu.common.breakers import breaker_service
         self.searcher = searcher
         self.rows = rows
         self.total = total
@@ -77,11 +85,20 @@ class ScrollContext:
         self.source_spec = source_spec
         self.index_name = index_name
         self.pos = 0
+        # the materialized cursor is the scroll's memory cost — charged
+        # to the request breaker until the context closes/expires
+        self._breaker = breaker_service().request
+        self._reserved = len(rows) * self._ROW_BYTES
+        self._breaker.add_estimate(self._reserved, label="scroll context")
 
     def next_page(self) -> list:
         page = self.rows[self.pos: self.pos + self.page_size]
         self.pos += len(page)
         return page
+
+    def release(self):
+        self._breaker.release(self._reserved)
+        self._reserved = 0
 
 
 class PitContext:
@@ -102,11 +119,17 @@ class ReaderContextRegistry:
         self._ctxs: dict[str, tuple[object, float, int]] = {}
         # id -> (ctx, expires_at_monotonic_ms, keepalive_ms)
 
+    @staticmethod
+    def _release(ctx):
+        rel = getattr(ctx, "release", None)
+        if rel is not None:
+            rel()
+
     def _reap(self):
         now = self._now() * 1000
         for cid in [c for c, (_ctx, exp, _ka) in self._ctxs.items()
                     if exp <= now]:
-            del self._ctxs[cid]
+            self._release(self._ctxs.pop(cid)[0])
 
     def open(self, ctx, keepalive_ms: int) -> str:
         with self._lock:
@@ -138,11 +161,16 @@ class ReaderContextRegistry:
 
     def close(self, cid: str) -> bool:
         with self._lock:
-            return self._ctxs.pop(cid, None) is not None
+            entry = self._ctxs.pop(cid, None)
+            if entry is not None:
+                self._release(entry[0])
+            return entry is not None
 
     def close_all(self) -> int:
         with self._lock:
             n = len(self._ctxs)
+            for ctx, _exp, _ka in self._ctxs.values():
+                self._release(ctx)
             self._ctxs.clear()
             return n
 

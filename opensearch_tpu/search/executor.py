@@ -354,8 +354,11 @@ class ShardSearcher:
     # -- internals --------------------------------------------------------
 
     def _run_full(self, plan, bind, needed, min_score):
+        from opensearch_tpu.common.tasks import check_current
+
         ms = jnp.asarray(np.float32(-np.inf if min_score is None else min_score))
         for seg in self.segments:
+            check_current()        # cancellation point per segment program
             dseg = seg.device()
             A = build_arrays(dseg, needed, self.mapper,
                              live=self.ctx.live_jnp(seg, dseg))
@@ -379,11 +382,14 @@ class ShardSearcher:
             total = sum(int(np.asarray(m).sum()) for _s, _d, _sc, m
                         in self._run_full(plan, bind, needed, min_score))
             return [], total, None
+        from opensearch_tpu.common.tasks import check_current
+
         per_seg = []
         total = 0
         max_score = -np.inf
         ms = jnp.asarray(np.float32(-np.inf if min_score is None else min_score))
         for si, seg in enumerate(self.segments):
+            check_current()        # cancellation point per segment program
             dseg = seg.device()
             A = build_arrays(dseg, needed, self.mapper,
                              live=self.ctx.live_jnp(seg, dseg))

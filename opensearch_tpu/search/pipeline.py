@@ -96,6 +96,14 @@ class NormalizationConfig:
             raise IllegalArgumentError(
                 f"unknown combination technique [{self.combination}]")
         self.weights = (comb.get("parameters") or {}).get("weights")
+        if self.weights is not None:
+            if (not isinstance(self.weights, list)
+                    or any(not isinstance(w, (int, float)) or w < 0
+                           for w in self.weights)
+                    or sum(self.weights) <= 0):
+                raise IllegalArgumentError(
+                    "combination weights must be non-negative numbers "
+                    "with a positive sum")
 
     def apply(self, per_query_rows: list[list[dict]], k: int) -> list[dict]:
         """``per_query_rows``: one row list per sub-query (rows carry

@@ -77,7 +77,11 @@ class ScriptProgram:
         out = []
         for name in self.param_names:
             v = self._params[name]
-            out.append(jnp.asarray(np.asarray(v, np.float32)))
+            try:
+                out.append(jnp.asarray(np.asarray(v, np.float32)))
+            except (ValueError, TypeError):
+                raise ScriptException(
+                    f"script param [{name}] is not numeric") from None
         return tuple(out)
 
     def eval(self, score, numeric_cols: dict, vector_cols: dict,
@@ -279,7 +283,11 @@ class _Evaluator(ast.NodeVisitor):
                 return dots / jnp.maximum(norms * qn, 1e-30)
             if name in _BARE_FNS:
                 args = [self.visit(a) for a in node.args]
-                return _BARE_FNS[name](*args)
+                try:
+                    return _BARE_FNS[name](*args)
+                except TypeError as e:
+                    raise ScriptException(
+                        f"bad arguments to [{name}]: {e}") from None
         if isinstance(node.func, ast.Attribute):
             recv = node.func.value
             # doc['f'].size()
@@ -291,7 +299,12 @@ class _Evaluator(ast.NodeVisitor):
                 if fn is None:
                     raise ScriptException(
                         f"Math.{node.func.attr} is not supported")
-                return fn(*[self.visit(a) for a in node.args])
+                try:
+                    return fn(*[self.visit(a) for a in node.args])
+                except TypeError as e:
+                    raise ScriptException(
+                        f"bad arguments to [Math.{node.func.attr}]: "
+                        f"{e}") from None
         raise ScriptException("unsupported function call in script")
 
 
