@@ -18,6 +18,34 @@ import opensearch_tpu.common.jaxenv  # noqa: F401
 import jax.numpy as jnp
 
 
+def masked_centroids(values, value_docs, matched, *, n_cent: int):
+    """Equal-weight centroids of the MATCHED values — the device side of
+    the percentiles sketch (TDigest analog; ref
+    search/aggregations/metrics TDigest percentiles).
+
+    One device sort replaces host materialization of every matched value:
+    invalid entries sort to +inf past the valid prefix, ranks bin the
+    prefix into ``n_cent`` equal-count segments, and a segment-sum emits
+    (means [n_cent] f64, weights [n_cent] i64) — the only host transfer
+    is 2*n_cent numbers regardless of how many values matched.
+    """
+    ok = matched[value_docs]
+    key = jnp.where(ok, values.astype(jnp.float64), jnp.inf)
+    sv = jnp.sort(key)
+    total = ok.sum()
+    ranks = jnp.arange(sv.shape[0])
+    valid = ranks < total
+    bins = jnp.clip((ranks * n_cent) // jnp.maximum(total, 1), 0,
+                    n_cent - 1).astype(jnp.int32)
+    tgt = jnp.where(valid, bins, n_cent)
+    sums = jnp.zeros(n_cent + 1, jnp.float64).at[tgt].add(
+        jnp.where(valid, sv, 0.0))
+    cnts = jnp.zeros(n_cent + 1, jnp.int64).at[tgt].add(
+        valid.astype(jnp.int64))
+    means = sums[:n_cent] / jnp.maximum(cnts[:n_cent], 1)
+    return means, cnts[:n_cent]
+
+
 def _first_occurrence(docs, buckets):
     """Mask of entries that are the first (doc, bucket) occurrence in the
     (sorted-per-doc) expanded arrays."""
