@@ -22,6 +22,7 @@ from opensearch_tpu.mapping.types import (
     DenseVectorFieldType,
     KeywordFieldType,
     TextFieldType,
+    parse_date_millis,
     parse_ip_long,
 )
 from opensearch_tpu.ops import bm25 as bm25_ops
@@ -294,8 +295,16 @@ def _c_multi_match(q, ctx, scored):
     if q.type not in ("best_fields", "most_fields", "phrase"):
         raise IllegalArgumentError(
             f"multi_match type [{q.type}] is not supported")
-    children, binds = [], []
+    # "*" expands to every text field (the lenient all-fields default the
+    # simple_query_string path already has)
+    fields = []
     for field, fboost in q.fields:
+        if field == "*":
+            fields.extend((f, fboost) for f in ctx.text_fields())
+        else:
+            fields.append((field, fboost))
+    children, binds = [], []
+    for field, fboost in fields:
         if ctx.field_type(field) is None:
             continue
         if q.type == "phrase":
@@ -617,6 +626,287 @@ def _c_knn(q, ctx, scored):
     return P.ScoredMaskPlan(label="knn"), {"fn": fn}
 
 
+def _c_boosting(q, ctx, scored):
+    pos_p, pos_b = compile_query(q.positive, ctx, scored)
+    neg_p, neg_b = compile_query(q.negative, ctx, scored=False)
+    return (P.BoostingPlan(positive=pos_p, negative=neg_p),
+            {"boost": q.boost, "negative_boost": q.negative_boost,
+             "children": (pos_b, neg_b)})
+
+
+def _c_terms_set(q, ctx, scored):
+    ft = _require_ft(ctx, q.field, "terms_set")
+    if ft is None:
+        return _none()
+    msm_ft = ctx.field_type(q.minimum_should_match_field)
+    if msm_ft is None or msm_ft.dv_kind not in ("long", "double"):
+        raise IllegalArgumentError(
+            f"[terms_set] minimum_should_match_field "
+            f"[{q.minimum_should_match_field}] must be a numeric field")
+    terms = [ft.term_for_query(t) for t in q.terms]
+    if not terms:
+        return _none()
+    return (P.TermsSetPlan(field=q.field,
+                           msm_field=q.minimum_should_match_field,
+                           scored=scored),
+            {"terms": tuple(terms),
+             "idfs": _idfs_for(ctx, q.field, terms),
+             "weights": np.full(len(terms), q.boost, np.float32),
+             "avgdl": ctx.field_stats(q.field).avgdl})
+
+
+def _c_distance_feature(q, ctx, scored):
+    from opensearch_tpu.search.query_dsl import (parse_distance_m,
+                                                 parse_geo_point)
+
+    ft = _require_ft(ctx, q.field, "distance_feature")
+    if ft is None:
+        return _none()
+    if ft.dv_kind == "geo_point":
+        origin = parse_geo_point(q.origin)
+        pivot = parse_distance_m(q.pivot)
+        kind = "geo"
+    elif ft.type_name == "date":
+        from opensearch_tpu.search.aggs import _parse_duration_ms
+        origin = float(parse_date_millis(q.origin))
+        pivot = float(_parse_duration_ms(q.pivot)
+                      if isinstance(q.pivot, str) else q.pivot)
+        kind = "numeric"
+    elif ft.dv_kind in ("long", "double"):
+        origin = float(q.origin)
+        pivot = float(q.pivot)
+        kind = "numeric"
+    else:
+        raise IllegalArgumentError(
+            f"[distance_feature] field [{q.field}] must be date, numeric "
+            f"or geo_point, got [{ft.type_name}]")
+    if pivot <= 0:
+        raise IllegalArgumentError("[distance_feature] pivot must be > 0")
+    return (P.DistanceFeaturePlan(field=q.field, kind=kind),
+            {"origin": origin, "pivot": pivot, "boost": q.boost})
+
+
+def _c_geo_distance(q, ctx, scored):
+    from opensearch_tpu.search.query_dsl import parse_distance_m
+
+    ft = _require_ft(ctx, q.field, "geo_distance")
+    if ft is None:
+        return _none()
+    if ft.dv_kind != "geo_point":
+        raise IllegalArgumentError(
+            f"[geo_distance] field [{q.field}] is not a geo_point")
+    return (P.GeoDistancePlan(field=q.field),
+            {"lat": q.lat, "lon": q.lon,
+             "distance_m": parse_distance_m(q.distance), "boost": q.boost})
+
+
+def _c_geo_bounding_box(q, ctx, scored):
+    ft = _require_ft(ctx, q.field, "geo_bounding_box")
+    if ft is None:
+        return _none()
+    if ft.dv_kind != "geo_point":
+        raise IllegalArgumentError(
+            f"[geo_bounding_box] field [{q.field}] is not a geo_point")
+    return (P.GeoBoxPlan(field=q.field),
+            {"top": q.top, "left": q.left, "bottom": q.bottom,
+             "right": q.right, "boost": q.boost})
+
+
+_DECAY_FNS = ("gauss", "exp", "linear")
+
+
+def _c_function_score(q, ctx, scored):
+    """function_score: per-function specs compile to static FunctionSpec
+    structure + dynamic param binds (functionscore/ dir; decay, fvf,
+    random_score, weight, script_score functions)."""
+    from opensearch_tpu.search.query_dsl import (parse_distance_m,
+                                                 parse_geo_point,
+                                                 parse_query)
+    from opensearch_tpu.search.scripting import compile_score_script
+
+    child = q.query if q.query is not None else dsl.MatchAllQuery()
+    cplan, cbind = compile_query(child, ctx, scored=True)
+    specs, binds = [], []
+    for f in q.functions:
+        f = dict(f)
+        fbind = {}
+        fplan = None
+        if f.get("filter") is not None:
+            fplan, fb = compile_query(parse_query(f["filter"]), ctx,
+                                      scored=False)
+            fbind["filter"] = fb
+        if "weight" in f:
+            fbind["weight"] = float(f["weight"])
+        decay_fn = next((d for d in _DECAY_FNS if d in f), None)
+        if "field_value_factor" in f:
+            fvf = f["field_value_factor"]
+            field = fvf.get("field")
+            ft = ctx.field_type(field or "")
+            if ft is None or ft.dv_kind not in ("long", "double"):
+                raise IllegalArgumentError(
+                    f"[field_value_factor] field [{field}] must be "
+                    "numeric")
+            specs.append(P.FunctionSpec(
+                kind="field_value_factor", filter=fplan, field=field,
+                modifier=str(fvf.get("modifier", "none")).lower()))
+            fbind.update({"factor": float(fvf.get("factor", 1.0)),
+                          "missing": float(fvf.get("missing", 1.0))})
+        elif "random_score" in f:
+            rs = f.get("random_score") or {}
+            specs.append(P.FunctionSpec(kind="random_score",
+                                        filter=fplan))
+            fbind["seed"] = float(rs.get("seed", 0))
+        elif "script_score" in f:
+            program = compile_score_script(
+                (f["script_score"] or {}).get("script") or {})
+            specs.append(P.FunctionSpec(kind="script_score",
+                                        filter=fplan, program=program))
+        elif decay_fn is not None:
+            body = f[decay_fn]
+            ((field, conf),) = tuple(body.items()) if len(body) == 1 \
+                else (_raise_decay(),)
+            ft = ctx.field_type(field)
+            if ft is None:
+                return _none()
+            if ft.dv_kind == "geo_point":
+                lat, lon = parse_geo_point(conf["origin"])
+                fbind.update({"origin_lat": lat, "origin_lon": lon,
+                              "scale": parse_distance_m(conf["scale"]),
+                              "offset": parse_distance_m(
+                                  conf.get("offset", 0))})
+                geo = True
+            elif ft.type_name == "date":
+                from opensearch_tpu.search.aggs import _parse_duration_ms
+
+                def dur(v):
+                    return float(_parse_duration_ms(v)
+                                 if isinstance(v, str) else v)
+                fbind.update({
+                    "origin": float(parse_date_millis(conf["origin"])),
+                    "scale": dur(conf["scale"]),
+                    "offset": dur(conf.get("offset", 0))})
+                geo = False
+            elif ft.dv_kind in ("long", "double"):
+                fbind.update({"origin": float(conf["origin"]),
+                              "scale": float(conf["scale"]),
+                              "offset": float(conf.get("offset", 0))})
+                geo = False
+            else:
+                raise IllegalArgumentError(
+                    f"[{decay_fn}] field [{field}] must be numeric, "
+                    "date or geo_point")
+            if fbind["scale"] <= 0:
+                raise IllegalArgumentError(
+                    f"[{decay_fn}] scale must be > 0")
+            fbind["decay"] = float(conf.get("decay", 0.5))
+            if not (0.0 < fbind["decay"] < 1.0):
+                raise IllegalArgumentError(
+                    f"[{decay_fn}] decay must be in (0, 1)")
+            specs.append(P.FunctionSpec(kind="decay", filter=fplan,
+                                        field=field, decay_fn=decay_fn,
+                                        geo=geo))
+        elif "weight" in f:
+            specs.append(P.FunctionSpec(kind="weight", filter=fplan))
+        else:
+            raise IllegalArgumentError(
+                f"unknown function_score function {sorted(f)}")
+        binds.append(fbind)
+    if q.score_mode not in ("multiply", "sum", "avg", "first", "max",
+                            "min"):
+        raise IllegalArgumentError(
+            f"unknown score_mode [{q.score_mode}]")
+    if q.boost_mode not in ("multiply", "replace", "sum", "avg", "max",
+                            "min"):
+        raise IllegalArgumentError(
+            f"unknown boost_mode [{q.boost_mode}]")
+    return (P.FunctionScorePlan(child=cplan, functions=tuple(specs),
+                                score_mode=q.score_mode,
+                                boost_mode=q.boost_mode),
+            {"child": cbind, "functions": tuple(binds), "boost": q.boost,
+             "max_boost": q.max_boost, "min_score": q.min_score})
+
+
+def _raise_decay():
+    raise IllegalArgumentError(
+        "decay function must name exactly one field")
+
+
+def _c_more_like_this(q, ctx, scored):
+    """more_like_this: host-side tf-idf term selection over the like
+    texts/docs, compiled as a should term-bag (MoreLikeThisQueryBuilder's
+    interesting-terms selection)."""
+    fields = q.fields
+    if not fields:
+        fields = [f for f, ft in ctx.mapper.field_types().items()
+                  if isinstance(ft, TextFieldType)]
+    if not fields:
+        return _none()
+    texts: list[str] = []
+    liked_ids: list[str] = []
+    for item in q.like:
+        if isinstance(item, dict):
+            doc_id = item.get("_id")
+            src = None
+            for seg in ctx.segments:
+                local = seg.id_to_local.get(str(doc_id))
+                if local is not None:
+                    src = seg.source(local)
+                    break
+            if src is None:
+                continue
+            liked_ids.append(str(doc_id))
+            for f in fields:
+                v = src.get(f)
+                if isinstance(v, str):
+                    texts.append(v)
+        else:
+            texts.append(str(item))
+    if not texts:
+        return _none()
+    clauses = []
+    for field in fields:
+        ft = ctx.field_type(field)
+        if not isinstance(ft, TextFieldType):
+            continue
+        tf: dict[str, int] = {}
+        for text in texts:
+            for t in ft.search_terms(text, ctx.mapper.analyzers):
+                tf[t] = tf.get(t, 0) + 1
+        n_docs = max(ctx.field_stats(field).doc_count, 1)
+        cands = []
+        for t, freq in tf.items():
+            if freq < q.min_term_freq:
+                continue
+            df = ctx.df(field, t)
+            if df < q.min_doc_freq:
+                continue
+            idf = np.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
+            cands.append((freq * idf, t))
+        cands.sort(key=lambda x: (-x[0], x[1]))
+        terms = [t for _s, t in cands[: q.max_query_terms]]
+        if terms:
+            required = max(1, calc_min_should_match(
+                len(terms), q.minimum_should_match))
+            clauses.append(_term_bag(ctx, field, terms, required,
+                                     q.boost, scored))
+    if not clauses:
+        return _none()
+    if len(clauses) == 1 and not liked_ids:
+        return clauses[0]
+    # the liked input docs are EXCLUDED unless include:true (the
+    # reference's default — a doc is trivially most-like itself)
+    must_not = ()
+    if liked_ids and not q.include:
+        must_not = (compile_query(dsl.IdsQuery(values=liked_ids), ctx,
+                                  scored=False),)
+    plans = tuple(p for p, _b in clauses)
+    return (P.BoolPlan(should=plans,
+                       must_not=tuple(p for p, _b in must_not)),
+            {"boost": 1.0, "required": 1,
+             "children": (tuple(b for _p, b in clauses)
+                          + tuple(b for _p, b in must_not))})
+
+
 def _c_script_score(q, ctx, scored):
     """script_score: the child query's matched set rescored by a compiled
     jnp expression (search/scripting.py); BASELINE config #2's
@@ -665,4 +955,11 @@ _COMPILERS = {
     dsl.SimpleQueryStringQuery: _c_simple_query_string,
     dsl.KnnQuery: _c_knn,
     dsl.ScriptScoreQuery: _c_script_score,
+    dsl.BoostingQuery: _c_boosting,
+    dsl.TermsSetQuery: _c_terms_set,
+    dsl.DistanceFeatureQuery: _c_distance_feature,
+    dsl.FunctionScoreQuery: _c_function_score,
+    dsl.MoreLikeThisQuery: _c_more_like_this,
+    dsl.GeoDistanceQuery: _c_geo_distance,
+    dsl.GeoBoundingBoxQuery: _c_geo_bounding_box,
 }

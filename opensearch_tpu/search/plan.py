@@ -638,6 +638,460 @@ class ConstScorePlan(Plan):
         return jnp.where(matched, boost, 0.0).astype(jnp.float32), matched
 
 
+def _nearest_value_dist(col, origin):
+    """Distance from ``origin`` to the NEAREST of a doc's values: 0 when
+    origin lies inside [min, max], else the gap to the closer bound
+    (multi-valued semantics of the reference's distance_feature/decay)."""
+    mn = col["minv"].astype(jnp.float64)
+    mx = col["maxv"].astype(jnp.float64)
+    below = jnp.maximum(mn - origin, 0.0)     # origin below the range
+    above = jnp.maximum(origin - mx, 0.0)     # origin above the range
+    return jnp.maximum(below, above)
+
+
+_EARTH_R_M = 6371008.8
+
+
+def _haversine_m(lat1, lon1, lat2, lon2):
+    """Vectorized great-circle distance in meters (degrees in)."""
+    p1, p2 = jnp.radians(lat1), jnp.radians(lat2)
+    dp = p2 - p1
+    dl = jnp.radians(lon2) - jnp.radians(lon1)
+    a = (jnp.sin(dp / 2) ** 2
+         + jnp.cos(p1) * jnp.cos(p2) * jnp.sin(dl / 2) ** 2)
+    return 2 * _EARTH_R_M * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+
+
+@dataclass(frozen=True)
+class BoostingPlan(Plan):
+    """boosting query: positive clause scores, docs also matching the
+    negative clause get demoted by negative_boost (BoostingQueryBuilder).
+    bind: {boost, negative_boost, children: (pos_bind, neg_bind)}."""
+
+    positive: Plan = None
+    negative: Plan = None
+
+    def arrays(self):
+        return self.positive.arrays() | self.negative.arrays()
+
+    def prepare(self, bind, seg, dseg, ctx):
+        cdims, cins = _prepare_children(
+            (self.positive, self.negative), bind["children"],
+            seg, dseg, ctx)
+        return cdims, (cins, _scalar(bind["boost"], _F32),
+                       _scalar(bind["negative_boost"], _F32))
+
+    def eval(self, A, dims, ins):
+        cins, boost, negative_boost = ins
+        scores, matched = self.positive.eval(A, dims[0], cins[0])
+        _ns, neg = self.negative.eval(A, dims[1], cins[1])
+        scores = jnp.where(neg, scores * negative_boost, scores) * boost
+        return jnp.where(matched, scores, 0.0), matched
+
+
+@dataclass(frozen=True)
+class TermsSetPlan(Plan):
+    """terms_set: term bag whose per-doc required count comes from a
+    NUMERIC FIELD of the doc itself (minimum_should_match_field;
+    TermsSetQueryBuilder).  bind: {terms, idfs, weights, avgdl}."""
+
+    field: str = ""
+    msm_field: str = ""
+    scored: bool = True
+
+    def arrays(self):
+        return frozenset({("postings", self.field),
+                          ("numeric", self.msm_field)})
+
+    def prepare(self, bind, seg, dseg, ctx):
+        terms = bind["terms"]
+        pf = seg.postings.get(self.field)
+        t_pad = pad_pow2(len(terms), minimum=1)
+        tids = np.zeros(t_pad, dtype=_I32)
+        active = np.zeros(t_pad, dtype=bool)
+        budget = 0
+        for i, t in enumerate(terms):
+            tid = pf.term_id(t) if pf is not None else -1
+            if tid >= 0:
+                tids[i] = tid
+                active[i] = True
+                budget += int(pf.df[tid])
+        ins = (jnp.asarray(tids), jnp.asarray(active),
+               _pad_np(bind["idfs"], t_pad, 0.0, _F32),
+               _pad_np(bind["weights"], t_pad, 0.0, _F32),
+               _scalar(bind["avgdl"], _F32))
+        return (t_pad, pad_bucket(budget)), ins
+
+    def eval(self, A, dims, ins):
+        t_pad, budget = dims
+        tids, active, idfs, weights, avgdl = ins
+        p = A["postings"][self.field]
+        msm = A["numeric"][self.msm_field]
+        n_pad = A["live"].shape[0]
+        scores, count = bm25_ops.bm25_score_count(
+            p["offsets"], p["doc_ids"], p["tfs"], p["doc_lens"],
+            tids, active, idfs, weights, avgdl,
+            n_pad=n_pad, budget=budget, scored=self.scored)
+        # per-doc minimum from the doc's own field; docs without the
+        # field never match (the reference skips them)
+        required = jnp.where(msm["exists"],
+                             msm["minv"].astype(jnp.int64), 2**62)
+        matched = (count.astype(jnp.int64) >= required) & (count > 0)
+        return jnp.where(matched, scores, 0.0), matched
+
+
+@dataclass(frozen=True)
+class DistanceFeaturePlan(Plan):
+    """distance_feature: score = boost * pivot / (pivot + distance) over
+    a numeric/date or geo_point field (DistanceFeatureQueryBuilder).
+    bind: {boost, pivot, origin} (origin = scalar, or (lat, lon))."""
+
+    field: str = ""
+    kind: str = "numeric"              # numeric | geo
+
+    def arrays(self):
+        group = "geo" if self.kind == "geo" else "numeric"
+        return frozenset({(group, self.field)})
+
+    def prepare(self, bind, seg, dseg, ctx):
+        if self.kind == "geo":
+            lat, lon = bind["origin"]
+            origin = (jnp.asarray(np.float64(lat)),
+                      jnp.asarray(np.float64(lon)))
+        else:
+            origin = _scalar(bind["origin"], np.float64)
+        return (), (origin, _scalar(bind["pivot"], np.float64),
+                    _scalar(bind["boost"], _F32))
+
+    def eval(self, A, dims, ins):
+        origin, pivot, boost = ins
+        n_pad = A["live"].shape[0]
+        if self.kind == "geo":
+            g = A["geo"][self.field]
+            lat0, lon0 = origin
+            d_entry = _haversine_m(g["lats"].astype(jnp.float64),
+                                   g["lons"].astype(jnp.float64),
+                                   lat0, lon0)
+            dist = jnp.full(n_pad, jnp.inf).at[g["value_docs"]].min(d_entry)
+            exists = g["exists"]
+        else:
+            col = A["numeric"][self.field]
+            dist = _nearest_value_dist(col, origin)
+            exists = col["exists"]
+        score = boost * (pivot / (pivot + dist))
+        matched = exists
+        return jnp.where(matched, score, 0.0).astype(jnp.float32), matched
+
+
+@dataclass(frozen=True)
+class GeoDistancePlan(Plan):
+    """geo_distance filter: any of the doc's points within ``distance``
+    meters of the origin.  bind: {lat, lon, distance_m, boost}."""
+
+    field: str = ""
+
+    def arrays(self):
+        return frozenset({("geo", self.field)})
+
+    def prepare(self, bind, seg, dseg, ctx):
+        return (), (jnp.asarray(np.float64(bind["lat"])),
+                    jnp.asarray(np.float64(bind["lon"])),
+                    jnp.asarray(np.float64(bind["distance_m"])),
+                    _scalar(bind["boost"], _F32))
+
+    def eval(self, A, dims, ins):
+        lat0, lon0, dist_m, boost = ins
+        g = A["geo"][self.field]
+        n_pad = A["live"].shape[0]
+        d_entry = _haversine_m(g["lats"].astype(jnp.float64),
+                               g["lons"].astype(jnp.float64), lat0, lon0)
+        hit = jnp.zeros(n_pad, bool).at[g["value_docs"]].max(
+            d_entry <= dist_m)
+        matched = hit & g["exists"]
+        return jnp.where(matched, boost, 0.0).astype(jnp.float32), matched
+
+
+@dataclass(frozen=True)
+class GeoBoxPlan(Plan):
+    """geo_bounding_box filter.  bind: {top, left, bottom, right, boost}
+    (no dateline wrap)."""
+
+    field: str = ""
+
+    def arrays(self):
+        return frozenset({("geo", self.field)})
+
+    def prepare(self, bind, seg, dseg, ctx):
+        return (), tuple(jnp.asarray(np.float64(bind[k]))
+                         for k in ("top", "left", "bottom", "right")) + (
+            _scalar(bind["boost"], _F32),)
+
+    def eval(self, A, dims, ins):
+        top, left, bottom, right, boost = ins
+        g = A["geo"][self.field]
+        n_pad = A["live"].shape[0]
+        lats = g["lats"].astype(jnp.float64)
+        lons = g["lons"].astype(jnp.float64)
+        inside = ((lats <= top) & (lats >= bottom)
+                  & (lons >= left) & (lons <= right))
+        hit = jnp.zeros(n_pad, bool).at[g["value_docs"]].max(inside)
+        matched = hit & g["exists"]
+        return jnp.where(matched, boost, 0.0).astype(jnp.float32), matched
+
+
+@dataclass(frozen=True)
+class FunctionSpec:
+    """One function_score function — static structure only; its dynamic
+    params ride the bind tree."""
+
+    kind: str = "weight"      # weight|field_value_factor|random_score|
+    #                           script_score|decay
+    filter: Optional[Plan] = None
+    field: str = ""           # fvf / decay target
+    modifier: str = "none"    # fvf modifier
+    decay_fn: str = "gauss"   # gauss|exp|linear
+    geo: bool = False         # decay over a geo field
+    program: object = None    # scripting.ScriptProgram for script_score
+
+
+@dataclass(frozen=True)
+class FunctionScorePlan(Plan):
+    """function_score (FunctionScoreQueryBuilder + functionscore/ dir):
+    child score combined with per-doc function factors.
+    bind: {boost, child, functions: tuple of per-function binds
+    ({filter, weight, ...params}), max_boost, min_score}."""
+
+    child: Plan = None
+    functions: tuple = ()              # tuple[FunctionSpec]
+    score_mode: str = "multiply"       # multiply|sum|avg|first|max|min
+    boost_mode: str = "multiply"       # multiply|replace|sum|avg|max|min
+
+    def arrays(self):
+        out = self.child.arrays()
+        for f in self.functions:
+            if f.filter is not None:
+                out |= f.filter.arrays()
+            if f.kind in ("field_value_factor", "decay") and not f.geo:
+                out |= frozenset({("numeric", f.field)})
+            if f.kind == "decay" and f.geo:
+                out |= frozenset({("geo", f.field)})
+            if f.kind == "script_score" and f.program is not None:
+                for nf in f.program.numeric_fields:
+                    out |= frozenset({("numeric", nf)})
+                for vf in f.program.vector_fields:
+                    out |= frozenset({("vector", vf)})
+        return out
+
+    # fixed positional param layout per function kind (ins pytrees carry
+    # no strings — jit inputs must be arrays)
+    _PARAM_ORDER = {
+        "weight": ("weight",),
+        "field_value_factor": ("factor", "missing", "weight"),
+        "random_score": ("seed", "salt", "weight"),
+        "script_score": ("weight",),
+        "decay": ("origin", "scale", "offset", "decay", "weight"),
+        "decay_geo": ("origin_lat", "origin_lon", "scale", "offset",
+                      "decay", "weight"),
+    }
+    _PARAM_DEFAULTS = {"weight": 1.0, "factor": 1.0, "missing": 1.0,
+                       "seed": 0.0, "salt": 0.0, "offset": 0.0,
+                       "decay": 0.5}
+
+    def _param_names(self, spec):
+        key = ("decay_geo" if spec.kind == "decay" and spec.geo
+               else spec.kind)
+        return self._PARAM_ORDER[key]
+
+    def prepare(self, bind, seg, dseg, ctx):
+        cdims, cins = self.child.prepare(bind["child"], seg, dseg, ctx)
+        fdims, fins = [], []
+        for spec, fb in zip(self.functions, bind["functions"]):
+            d_i, i_i = (), []
+            if spec.filter is not None:
+                fd, fi = spec.filter.prepare(fb["filter"], seg, dseg, ctx)
+                d_i = fd
+                i_i.append(fi)
+            if spec.kind == "script_score":
+                i_i.append(spec.program.param_values())
+            fb = dict(fb)
+            if spec.kind == "random_score":
+                # per-segment salt so random_score differs across segments
+                import zlib
+                fb["salt"] = float(zlib.crc32(seg.seg_id.encode()))
+            params = tuple(
+                jnp.asarray(np.float64(
+                    fb.get(name, self._PARAM_DEFAULTS.get(name, 0.0))))
+                for name in self._param_names(spec))
+            i_i.append(params)
+            fdims.append(d_i)
+            fins.append(tuple(i_i))
+        return (cdims, tuple(fdims)), (
+            cins, tuple(fins), _scalar(bind["boost"], _F32),
+            _scalar(bind.get("max_boost")
+                    if bind.get("max_boost") is not None else np.inf,
+                    np.float64),
+            _scalar(bind.get("min_score")
+                    if bind.get("min_score") is not None else -np.inf,
+                    _F32))
+
+    def _factor(self, spec, A, fdim, fin, n_pad, child_scores):
+        parts = list(fin)
+        params = dict(zip(self._param_names(spec), parts[-1]))
+        value = None
+        if spec.kind == "weight":
+            value = jnp.full(n_pad, params["weight"])
+        elif spec.kind == "field_value_factor":
+            col = A["numeric"][spec.field]
+            v = jnp.where(col["exists"],
+                          col["minv"].astype(jnp.float64),
+                          params.get("missing", 1.0))
+            v = v * params.get("factor", 1.0)
+            mod = spec.modifier
+            if mod == "log":
+                v = jnp.log10(jnp.maximum(v, 1e-12))
+            elif mod == "log1p":
+                v = jnp.log10(1.0 + jnp.maximum(v, 0.0))
+            elif mod == "log2p":
+                v = jnp.log10(2.0 + jnp.maximum(v, 0.0))
+            elif mod == "ln":
+                v = jnp.log(jnp.maximum(v, 1e-12))
+            elif mod == "ln1p":
+                v = jnp.log1p(jnp.maximum(v, 0.0))
+            elif mod == "ln2p":
+                v = jnp.log(2.0 + jnp.maximum(v, 0.0))
+            elif mod == "sqrt":
+                v = jnp.sqrt(jnp.maximum(v, 0.0))
+            elif mod == "square":
+                v = v * v
+            elif mod == "reciprocal":
+                v = 1.0 / jnp.where(v == 0, 1e-12, v)
+            value = v * params.get("weight", 1.0)
+        elif spec.kind == "random_score":
+            seed = (params["seed"] + params["salt"]).astype(jnp.uint32)
+            idx = jnp.arange(n_pad, dtype=jnp.uint32)
+            x = idx * jnp.uint32(2654435761) + seed
+            x = (x ^ (x >> 16)) * jnp.uint32(0x45D9F3B)
+            x = (x ^ (x >> 16)) * jnp.uint32(0x45D9F3B)
+            x = x ^ (x >> 16)
+            value = (x.astype(jnp.float64) / jnp.float64(2**32)) \
+                * params["weight"]
+        elif spec.kind == "script_score":
+            script_params = parts[-2]
+            ncols = {f: (jnp.where(A["numeric"][f]["exists"],
+                                   A["numeric"][f]["minv"]
+                                   .astype(jnp.float32), 0.0),
+                         A["numeric"][f]["exists"])
+                     for f in spec.program.numeric_fields}
+            vcols = {f: (A["vector"][f]["values"], A["vector"][f]["exists"])
+                     for f in spec.program.vector_fields}
+            value = spec.program.eval(child_scores, ncols, vcols,
+                                      script_params) \
+                * params.get("weight", 1.0)
+            value = jnp.broadcast_to(value, (n_pad,))
+        elif spec.kind == "decay":
+            if spec.geo:
+                g = A["geo"][spec.field]
+                d_entry = _haversine_m(
+                    g["lats"].astype(jnp.float64),
+                    g["lons"].astype(jnp.float64),
+                    params["origin_lat"], params["origin_lon"])
+                dist = jnp.full(n_pad, jnp.inf).at[
+                    g["value_docs"]].min(d_entry)
+                dist = jnp.where(g["exists"], dist, 0.0)
+            else:
+                col = A["numeric"][spec.field]
+                dist = jnp.where(
+                    col["exists"],
+                    _nearest_value_dist(col, params["origin"]), 0.0)
+            eff = jnp.maximum(dist - params.get("offset", 0.0), 0.0)
+            scale = params["scale"]
+            decay = params.get("decay", 0.5)
+            if spec.decay_fn == "gauss":
+                sigma2 = -(scale ** 2) / (2.0 * jnp.log(decay))
+                value = jnp.exp(-(eff ** 2) / (2.0 * sigma2))
+            elif spec.decay_fn == "exp":
+                lam = jnp.log(decay) / scale
+                value = jnp.exp(lam * eff)
+            else:                      # linear
+                s = scale / (1.0 - decay)
+                value = jnp.maximum((s - eff) / s, 0.0)
+            value = value * params.get("weight", 1.0)
+        applicable = jnp.ones(n_pad, bool)
+        if spec.filter is not None:
+            _fs, fmask = spec.filter.eval(A, fdim, parts[0])
+            applicable = fmask
+        return value.astype(jnp.float64), applicable
+
+    def eval(self, A, dims, ins):
+        cdims, fdims = dims
+        cins, fins, boost, max_boost, min_score = ins
+        scores, matched = self.child.eval(A, cdims, cins)
+        n_pad = A["live"].shape[0]
+        s64 = scores.astype(jnp.float64)
+        if self.functions:
+            values, apps = [], []
+            for spec, fd, fi in zip(self.functions, fdims, fins):
+                v, app = self._factor(spec, A, fd, fi, n_pad, scores)
+                values.append(v)
+                apps.append(app)
+            any_app = apps[0]
+            for a in apps[1:]:
+                any_app = any_app | a
+            if self.score_mode == "multiply":
+                factor = jnp.ones(n_pad, jnp.float64)
+                for v, a in zip(values, apps):
+                    factor = factor * jnp.where(a, v, 1.0)
+            elif self.score_mode == "sum":
+                factor = jnp.zeros(n_pad, jnp.float64)
+                for v, a in zip(values, apps):
+                    factor = factor + jnp.where(a, v, 0.0)
+            elif self.score_mode == "avg":
+                # WEIGHTED average (values already carry their weight;
+                # divide by the applicable weights, not the count)
+                tot = jnp.zeros(n_pad, jnp.float64)
+                wsum = jnp.zeros(n_pad, jnp.float64)
+                for v, a, fi in zip(values, apps, fins):
+                    w = fi[-1][-1]          # params tuple ends in weight
+                    tot = tot + jnp.where(a, v, 0.0)
+                    wsum = wsum + jnp.where(a, w, 0.0)
+                factor = tot / jnp.maximum(wsum, 1e-12)
+            elif self.score_mode == "max":
+                factor = jnp.full(n_pad, -jnp.inf)
+                for v, a in zip(values, apps):
+                    factor = jnp.maximum(factor,
+                                         jnp.where(a, v, -jnp.inf))
+            elif self.score_mode == "min":
+                factor = jnp.full(n_pad, jnp.inf)
+                for v, a in zip(values, apps):
+                    factor = jnp.minimum(factor, jnp.where(a, v, jnp.inf))
+            else:                      # first
+                factor = jnp.zeros(n_pad, jnp.float64)
+                assigned = jnp.zeros(n_pad, bool)
+                for v, a in zip(values, apps):
+                    take = a & ~assigned
+                    factor = jnp.where(take, v, factor)
+                    assigned = assigned | a
+            factor = jnp.where(any_app, factor, 1.0)
+        else:
+            factor = jnp.ones(n_pad, jnp.float64)
+        factor = jnp.minimum(factor, max_boost)
+        if self.boost_mode == "multiply":
+            out = s64 * factor
+        elif self.boost_mode == "replace":
+            out = factor
+        elif self.boost_mode == "sum":
+            out = s64 + factor
+        elif self.boost_mode == "avg":
+            out = (s64 + factor) / 2.0
+        elif self.boost_mode == "max":
+            out = jnp.maximum(s64, factor)
+        else:                          # min
+            out = jnp.minimum(s64, factor)
+        out = (out * boost).astype(jnp.float32)
+        matched = matched & (out >= min_score)
+        return jnp.where(matched, out, 0.0), matched
+
+
 def _edit_distance_le(a: str, b: str, k: int) -> bool:
     """Banded Levenshtein: True iff edit_distance(a, b) <= k."""
     if abs(len(a) - len(b)) > k:

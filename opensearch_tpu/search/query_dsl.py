@@ -10,6 +10,7 @@ ref index/query/QueryShardContext.java:95).
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Optional
 
@@ -145,6 +146,65 @@ class KnnQuery(Query):
     # per-request ANN overrides, e.g. {"nprobe": 16} (method_parameters
     # in the opensearch-knn request shape)
     method_parameters: Optional[dict] = None
+
+
+@dataclass
+class BoostingQuery(Query):
+    positive: Optional[Query] = None
+    negative: Optional[Query] = None
+    negative_boost: float = 0.5
+
+
+@dataclass
+class TermsSetQuery(Query):
+    field: str = ""
+    terms: list = dc_field(default_factory=list)
+    minimum_should_match_field: str = ""
+
+
+@dataclass
+class DistanceFeatureQuery(Query):
+    field: str = ""
+    origin: object = None
+    pivot: object = None
+
+
+@dataclass
+class FunctionScoreQuery(Query):
+    query: Optional[Query] = None
+    functions: list = dc_field(default_factory=list)   # raw function dicts
+    score_mode: str = "multiply"
+    boost_mode: str = "multiply"
+    max_boost: Optional[float] = None
+    min_score: Optional[float] = None
+
+
+@dataclass
+class MoreLikeThisQuery(Query):
+    fields: list = dc_field(default_factory=list)
+    like: list = dc_field(default_factory=list)        # texts and {_id} docs
+    max_query_terms: int = 25
+    min_term_freq: int = 2
+    min_doc_freq: int = 5
+    minimum_should_match: str = "30%"
+    include: bool = False          # include the liked docs in results
+
+
+@dataclass
+class GeoDistanceQuery(Query):
+    field: str = ""
+    lat: float = 0.0
+    lon: float = 0.0
+    distance: str = "10km"
+
+
+@dataclass
+class GeoBoundingBoxQuery(Query):
+    field: str = ""
+    top: float = 0.0
+    left: float = 0.0
+    bottom: float = 0.0
+    right: float = 0.0
 
 
 @dataclass
@@ -364,6 +424,369 @@ def _parse_knn(body):
                     boost=_boost(v))
 
 
+def parse_geo_point(v) -> tuple[float, float]:
+    """(lat, lon) from the accepted geo shapes: {lat, lon}, [lon, lat],
+    "lat,lon"."""
+    if isinstance(v, dict):
+        return float(v["lat"]), float(v["lon"])
+    if isinstance(v, (list, tuple)) and len(v) == 2:
+        return float(v[1]), float(v[0])            # GeoJSON order
+    if isinstance(v, str) and "," in v:
+        lat, _, lon = v.partition(",")
+        return float(lat), float(lon)
+    raise ParsingError(f"malformed geo point [{v!r}]")
+
+
+_DIST_UNITS = {"mm": 0.001, "cm": 0.01, "m": 1.0, "km": 1000.0,
+               "in": 0.0254, "ft": 0.3048, "yd": 0.9144,
+               "mi": 1609.344, "nmi": 1852.0, "nauticalmiles": 1852.0,
+               "kilometers": 1000.0, "meters": 1.0, "miles": 1609.344}
+
+
+def parse_distance_m(v) -> float:
+    """Distance expression -> meters ("10km", "5mi", bare number=m)."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip().lower()
+    for unit in sorted(_DIST_UNITS, key=len, reverse=True):
+        if s.endswith(unit):
+            return float(s[: -len(unit)]) * _DIST_UNITS[unit]
+    try:
+        return float(s)
+    except ValueError:
+        raise ParsingError(f"failed to parse distance [{v}]") from None
+
+
+def _parse_boosting(body):
+    if body.get("positive") is None or body.get("negative") is None:
+        raise ParsingError(
+            "[boosting] requires [positive] and [negative] clauses")
+    return BoostingQuery(positive=parse_query(body["positive"]),
+                         negative=parse_query(body["negative"]),
+                         negative_boost=float(
+                             body.get("negative_boost", 0.5)),
+                         boost=_boost(body))
+
+
+def _parse_terms_set(body):
+    field, v = _field_kv({k: x for k, x in body.items() if k != "boost"},
+                         "terms_set")
+    msm = v.get("minimum_should_match_field")
+    if not msm:
+        raise ParsingError(
+            "[terms_set] requires [minimum_should_match_field]")
+    return TermsSetQuery(field=field, terms=list(v.get("terms") or []),
+                         minimum_should_match_field=msm, boost=_boost(v))
+
+
+def _parse_distance_feature(body):
+    for key in ("field", "origin", "pivot"):
+        if body.get(key) is None:
+            raise ParsingError(f"[distance_feature] requires [{key}]")
+    return DistanceFeatureQuery(field=body["field"], origin=body["origin"],
+                                pivot=body["pivot"], boost=_boost(body))
+
+
+_FUNCTION_KEYS = ("weight", "field_value_factor", "random_score",
+                  "script_score", "gauss", "exp", "linear")
+
+
+def _parse_function_score(body):
+    functions = list(body.get("functions") or [])
+    # single-function shorthand at the top level
+    shorthand = {k: body[k] for k in _FUNCTION_KEYS if k in body}
+    if shorthand:
+        functions.append(shorthand)
+    q = parse_query(body.get("query")) if body.get("query") else None
+    return FunctionScoreQuery(
+        query=q, functions=functions,
+        score_mode=str(body.get("score_mode", "multiply")),
+        boost_mode=str(body.get("boost_mode", "multiply")),
+        max_boost=(float(body["max_boost"])
+                   if body.get("max_boost") is not None else None),
+        min_score=(float(body["min_score"])
+                   if body.get("min_score") is not None else None),
+        boost=_boost(body))
+
+
+def _parse_more_like_this(body):
+    like = body.get("like")
+    if like is None:
+        raise ParsingError("[more_like_this] requires [like]")
+    if not isinstance(like, list):
+        like = [like]
+    return MoreLikeThisQuery(
+        fields=list(body.get("fields") or []),
+        like=like,
+        max_query_terms=int(body.get("max_query_terms", 25)),
+        min_term_freq=int(body.get("min_term_freq", 2)),
+        min_doc_freq=int(body.get("min_doc_freq", 5)),
+        minimum_should_match=str(body.get("minimum_should_match", "30%")),
+        include=bool(body.get("include", False)),
+        boost=_boost(body))
+
+
+def _parse_geo_distance(body):
+    dist = body.get("distance")
+    if dist is None:
+        raise ParsingError("[geo_distance] requires [distance]")
+    field = next((k for k in body
+                  if k not in ("distance", "boost", "distance_type",
+                               "validation_method", "_name")), None)
+    if field is None:
+        raise ParsingError("[geo_distance] requires a field")
+    lat, lon = parse_geo_point(body[field])
+    parse_distance_m(dist)                  # validate eagerly
+    return GeoDistanceQuery(field=field, lat=lat, lon=lon,
+                            distance=dist, boost=_boost(body))
+
+
+def _parse_geo_bounding_box(body):
+    field = next((k for k in body
+                  if k not in ("boost", "validation_method", "type",
+                               "_name")), None)
+    if field is None:
+        raise ParsingError("[geo_bounding_box] requires a field")
+    v = body[field]
+    if "top_left" in v and "bottom_right" in v:
+        top, left = parse_geo_point(v["top_left"])
+        bottom, right = parse_geo_point(v["bottom_right"])
+    else:
+        top, left = float(v["top"]), float(v["left"])
+        bottom, right = float(v["bottom"]), float(v["right"])
+    if bottom > top:
+        raise ParsingError(
+            "[geo_bounding_box] top must be >= bottom")
+    return GeoBoundingBoxQuery(field=field, top=top, left=left,
+                               bottom=bottom, right=right,
+                               boost=_boost(body))
+
+
+# -- query_string ------------------------------------------------------------
+
+
+_QS_TOKEN = re.compile(
+    r"""\s*(?:
+        (?P<lparen>\()|(?P<rparen>\))|
+        (?P<and>AND\b|&&)|(?P<or>OR\b|\|\|)|(?P<not>NOT\b|!)|
+        (?P<plus>\+)|(?P<minus>-)|
+        (?P<quoted>"(?P<qbody>[^"]*)")|
+        (?P<range>[\[{][^\]}]+(?:[\]}]))|
+        (?P<word>[^\s()\[\]{}"]+)
+    )""", re.VERBOSE)
+
+
+def _qs_tokens(s: str):
+    pos = 0
+    out = []
+    while pos < len(s):
+        m = _QS_TOKEN.match(s, pos)
+        if m is None or m.end() == pos:
+            if s[pos:].strip():
+                raise ParsingError(
+                    f"query_string: cannot parse "
+                    f"[{s[pos:].strip()[:40]}] — unbalanced quote or "
+                    "stray bracket?")
+            break
+        out.append(m)
+        pos = m.end()
+    return out
+
+
+class _QsParser:
+    """Recursive-descent parser for the practical query_string subset:
+    AND/OR/NOT (&&/||/!), +/-, parentheses, field:value, quoted phrases,
+    wildcards, [a TO b]/{a TO b} ranges (QueryStringQueryBuilder's
+    everyday surface; the exotic tail — fuzzy slop, boost suffixes,
+    regex — parses as plain terms)."""
+
+    def __init__(self, tokens, fields, default_operator):
+        self.toks = tokens
+        self.i = 0
+        self.fields = fields
+        self.default_and = default_operator == "and"
+
+    def peek(self, name=None):
+        if self.i >= len(self.toks):
+            return None
+        if name is None:
+            return self.toks[self.i]
+        return self.toks[self.i] if self.toks[self.i].group(name) else None
+
+    def parse(self):
+        q = self.or_expr()
+        if self.i < len(self.toks):
+            raise ParsingError(
+                f"query_string: unexpected token "
+                f"[{self.toks[self.i].group(0).strip()}]")
+        return q or MatchAllQuery()
+
+    def or_expr(self):
+        parts = [self.and_expr()]
+        while self.peek("or"):
+            self.i += 1
+            parts.append(self.and_expr())
+        parts = [p for p in parts if p is not None]
+        if len(parts) <= 1:
+            return parts[0] if parts else None
+        return BoolQuery(should=parts)
+
+    def and_expr(self):
+        must, must_not, should = [], [], []
+        explicit_and = False
+        while True:
+            if self.peek("and"):
+                self.i += 1
+                explicit_and = True
+                continue
+            if self.peek("or") or self.peek("rparen") or \
+                    self.peek() is None:
+                break
+            negate = False
+            required = False
+            if self.peek("not") or self.peek("minus"):
+                self.i += 1
+                negate = True
+            elif self.peek("plus"):
+                self.i += 1
+                required = True
+            clause = self.primary()
+            if clause is None:
+                break
+            if negate:
+                must_not.append(clause)
+            elif required or self.default_and or explicit_and:
+                must.append(clause)
+            else:
+                should.append(clause)
+        if explicit_and or self.default_and:
+            must.extend(should)
+            should = []
+        if not must and not must_not and len(should) == 1:
+            return should[0]
+        if not must and not must_not and not should:
+            return None
+        return BoolQuery(must=must, must_not=must_not, should=should)
+
+    def primary(self):
+        tok = self.peek()
+        if tok is None:
+            return None
+        if tok.group("lparen"):
+            self.i += 1
+            inner = self.or_expr()
+            if not self.peek("rparen"):
+                raise ParsingError("query_string: unbalanced parentheses")
+            self.i += 1
+            return inner
+        if tok.group("quoted") is not None:
+            self.i += 1
+            return self._text_clause(tok.group("qbody"), phrase=True)
+        if tok.group("word"):
+            word = tok.group("word")
+            self.i += 1
+            if word.endswith(":"):          # field: followed by ( or "
+                field = word[:-1]
+                return self._fielded(field)
+            if ":" in word:
+                field, _, value = word.partition(":")
+                return self._value_clause(field, value)
+            return self._text_clause(word, phrase=False)
+        if tok.group("range"):
+            raise ParsingError(
+                "query_string: a range requires a field (field:[a TO b])")
+        return None
+
+    def _fielded(self, field):
+        tok = self.peek()
+        if tok is None:
+            raise ParsingError(
+                f"query_string: dangling field [{field}:]")
+        if tok.group("quoted") is not None:
+            self.i += 1
+            return MatchPhraseQuery(field=field, query=tok.group("qbody"))
+        if tok.group("range"):
+            self.i += 1
+            return self._range_clause(field, tok.group("range"))
+        if tok.group("lparen"):
+            self.i += 1
+            inner = self.or_expr()
+            if not self.peek("rparen"):
+                raise ParsingError("query_string: unbalanced parentheses")
+            self.i += 1
+            return _rewrite_default_field(inner, field)
+        if tok.group("word"):
+            self.i += 1
+            return self._value_clause(field, tok.group("word"))
+        raise ParsingError(f"query_string: bad value for [{field}]")
+
+    def _range_clause(self, field, raw):
+        inc_lo = raw[0] == "["
+        inc_hi = raw[-1] == "]"
+        body = raw[1:-1]
+        lo, _, hi = body.partition(" TO ")
+        if not _:
+            raise ParsingError(
+                f"query_string: malformed range [{raw}]")
+        params = {}
+        if lo.strip() not in ("*", ""):
+            params["gte" if inc_lo else "gt"] = lo.strip()
+        if hi.strip() not in ("*", ""):
+            params["lte" if inc_hi else "lt"] = hi.strip()
+        return RangeQuery(field=field, **params)
+
+    def _value_clause(self, field, value):
+        if "*" in value or "?" in value:
+            return WildcardQuery(field=field, value=value)
+        return MatchQuery(field=field, query=value)
+
+    def _text_clause(self, text, phrase):
+        if len(self.fields) == 1 and self.fields[0][0] != "*":
+            f, fboost = self.fields[0]
+            q = (MatchPhraseQuery(field=f, query=text) if phrase
+                 else self._value_clause(f, text))
+            q.boost = q.boost * fboost
+            return q
+        return MultiMatchQuery(fields=list(self.fields), query=text,
+                               type="phrase" if phrase else "best_fields")
+
+
+def _rewrite_default_field(q, field):
+    """Apply field:(...) grouping: rewrite default-field clauses inside."""
+    if isinstance(q, BoolQuery):
+        return BoolQuery(
+            must=[_rewrite_default_field(c, field) for c in q.must],
+            should=[_rewrite_default_field(c, field) for c in q.should],
+            must_not=[_rewrite_default_field(c, field)
+                      for c in q.must_not],
+            filter=[_rewrite_default_field(c, field) for c in q.filter],
+            boost=q.boost)
+    if isinstance(q, MultiMatchQuery):
+        if q.type == "phrase":
+            return MatchPhraseQuery(field=field, query=q.query)
+        if "*" in q.query or "?" in q.query:
+            return WildcardQuery(field=field, value=q.query)
+        return MatchQuery(field=field, query=q.query)
+    return q
+
+
+def _parse_query_string(body):
+    text = body.get("query")
+    if text is None:
+        raise ParsingError("[query_string] requires [query]")
+    fields = body.get("fields")
+    if not fields:
+        df = body.get("default_field", "*")
+        fields = [df]
+    fields = _parse_fields_with_boosts(fields)   # keep ^boost suffixes
+    op = str(body.get("default_operator", "or")).lower()
+    q = _QsParser(_qs_tokens(str(text)), fields, op).parse()
+    b = _boost(body)
+    if b != 1.0:
+        q.boost = q.boost * b
+    return q
+
+
 def _parse_hybrid(body):
     qs = body.get("queries")
     if not isinstance(qs, list) or not qs:
@@ -411,5 +834,13 @@ _PARSERS = {
     "knn": _parse_knn,
     "script_score": _parse_script_score,
     "hybrid": _parse_hybrid,
+    "boosting": _parse_boosting,
+    "terms_set": _parse_terms_set,
+    "distance_feature": _parse_distance_feature,
+    "function_score": _parse_function_score,
+    "more_like_this": _parse_more_like_this,
+    "geo_distance": _parse_geo_distance,
+    "geo_bounding_box": _parse_geo_bounding_box,
+    "query_string": _parse_query_string,
     "simple_query_string": _parse_simple_query_string,
 }
