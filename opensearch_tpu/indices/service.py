@@ -26,6 +26,7 @@ from opensearch_tpu.common.errors import (
     IllegalArgumentError,
     IndexAlreadyExistsError,
     IndexNotFoundError,
+    ResourceNotFoundError,
     ValidationError,
 )
 from opensearch_tpu.index.engine import InternalEngine, OpResult
@@ -408,6 +409,20 @@ class IndicesService:
         os.makedirs(data_path, exist_ok=True)
         self._lock = threading.RLock()
         self.indices: dict[str, IndexService] = {}
+        # alias -> {index_name: {"filter": ..., "is_write_index": bool}}
+        # (cluster-state aliases; ref cluster/metadata/AliasMetadata)
+        self.aliases: dict[str, dict[str, dict]] = {}
+        # composable index templates (ref cluster/metadata/
+        # ComposableIndexTemplate): name -> body
+        self.templates: dict[str, dict] = {}
+        self._aliases_file = os.path.join(data_path, "_aliases.json")
+        self._templates_file = os.path.join(data_path,
+                                            "_index_templates.json")
+        for path, attr in ((self._aliases_file, "aliases"),
+                           (self._templates_file, "templates")):
+            if os.path.exists(path):
+                with open(path) as f:
+                    setattr(self, attr, json.load(f))
         self._load()
 
     def _meta_path(self, name: str) -> str:
@@ -460,8 +475,28 @@ class IndicesService:
     def create(self, name: str, body: Optional[dict] = None) -> IndexService:
         body = body or {}
         with self._lock:
-            return self._register(name, dict(body.get("settings", {})),
-                                  body.get("mappings"))
+            if name in self.aliases:
+                raise IndexAlreadyExistsError(name)
+            settings = dict(body.get("settings", {}))
+            mappings = body.get("mappings")
+            tmpl = self._template_for(name)
+            if tmpl is not None:
+                # template under, request over (composable V2 merge)
+                t = tmpl.get("template") or {}
+                settings = {**(t.get("settings") or {}), **settings}
+                if t.get("mappings"):
+                    merged = dict(t["mappings"].get("properties") or {})
+                    merged.update((mappings or {}).get("properties") or {})
+                    mappings = {**t["mappings"], **(mappings or {}),
+                                "properties": merged}
+            svc = self._register(name, settings, mappings)
+            tmpl_aliases = ((tmpl or {}).get("template") or {}).get(
+                "aliases", {})
+            for alias, meta in tmpl_aliases.items():
+                self.aliases.setdefault(alias, {})[name] = meta or {}
+            if tmpl_aliases:
+                self._persist_json(self._aliases_file, self.aliases)
+            return svc
 
     def open_restored(self, name: str, settings: dict,
                       mappings: Optional[dict]) -> IndexService:
@@ -476,11 +511,15 @@ class IndicesService:
             raise IndexNotFoundError(name)
         return svc
 
+    auto_create = True          # action.auto_create_index (dynamic)
+
     def get_or_create(self, name: str) -> IndexService:
         """Auto-create on first write (action.auto_create_index default)."""
         with self._lock:
             if name in self.indices:
                 return self.indices[name]
+            if not self.auto_create:
+                raise IndexNotFoundError(name)
             return self.create(name)
 
     def exists(self, name: str) -> bool:
@@ -493,20 +532,211 @@ class IndicesService:
             del self.indices[name]
             shutil.rmtree(os.path.join(self.data_path, name),
                           ignore_errors=True)
+            # aliases pointing only at the deleted index vanish with it
+            changed = False
+            for alias in list(self.aliases):
+                if name in self.aliases[alias]:
+                    del self.aliases[alias][name]
+                    if not self.aliases[alias]:
+                        del self.aliases[alias]
+                    changed = True
+            if changed:
+                self._persist_json(self._aliases_file, self.aliases)
 
     def resolve(self, expr: str) -> list[IndexService]:
-        """Index expression: name, comma list, * / _all wildcards."""
+        """Index expression: name, alias, comma list, * / _all wildcards
+        (aliases resolve like the reference's IndexNameExpressionResolver)."""
+        return [svc for svc, _f in self.resolve_with_filters(expr)]
+
+    def resolve_with_filters(self, expr: str) -> list[tuple]:
+        """[(IndexService, alias_filter|None)]: an index reached ONLY
+        through filtered aliases carries the (should-of) alias filters;
+        any unfiltered route wins (the reference's alias-filter
+        application in QueryShardContext)."""
         if expr in ("_all", "*", ""):
-            return list(self.indices.values())
-        out = []
+            return [(s, None) for s in self.indices.values()]
+        acc: dict[str, list] = {}       # name -> [filters] | [None]
+        order: list[str] = []
+
+        def add(name, flt):
+            if name not in acc:
+                acc[name] = [flt]
+                order.append(name)
+            elif None in acc[name] or flt is None:
+                acc[name] = [None]
+            else:
+                acc[name].append(flt)
+
+        def add_alias(alias):
+            for n, meta in self.aliases[alias].items():
+                if n in self.indices:
+                    add(n, meta.get("filter"))
+
         for part in expr.split(","):
             if "*" in part:
-                rx = re.compile("^" + re.escape(part).replace(r"\*", ".*") + "$")
-                matched = [s for n, s in self.indices.items() if rx.match(n)]
-                out.extend(matched)
+                rx = re.compile("^" + re.escape(part).replace(r"\*", ".*")
+                                + "$")
+                for n in self.indices:
+                    if rx.match(n):
+                        add(n, None)
+                for alias in self.aliases:
+                    if rx.match(alias):
+                        add_alias(alias)
+            elif part in self.aliases:
+                add_alias(part)
             else:
-                out.append(self.get(part))
+                add(self.get(part).name, None)
+        out = []
+        for name in order:
+            filters = acc[name]
+            if None in filters:
+                flt = None
+            elif len(filters) == 1:
+                flt = filters[0]
+            else:
+                flt = {"bool": {"should": filters,
+                                "minimum_should_match": 1}}
+            out.append((self.indices[name], flt))
         return out
+
+    # -- aliases -----------------------------------------------------------
+
+    def _persist_json(self, path: str, obj):
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def update_aliases(self, actions: list) -> dict:
+        """POST /_aliases action list (IndicesAliasesRequest)."""
+        with self._lock:
+            staged = {a: dict(t) for a, t in self.aliases.items()}
+            for entry in actions or []:
+                if not isinstance(entry, dict) or len(entry) != 1:
+                    raise ValidationError(
+                        "alias action must be one of add/remove/"
+                        "remove_index")
+                ((op, body),) = entry.items()
+                if op == "remove_index":
+                    raise ValidationError(
+                        "[remove_index] is not supported")
+                if op not in ("add", "remove"):
+                    raise ValidationError(f"unknown alias action [{op}]")
+                if not isinstance(body, dict):
+                    raise ValidationError(
+                        f"alias action [{op}] requires an object body")
+                if body.get("routing") is not None:
+                    raise ValidationError(
+                        "alias [routing] is not supported")
+                indices = body.get("indices") or [body.get("index")]
+                names = body.get("aliases") or [body.get("alias")]
+                if not all(indices) or not all(names):
+                    raise ValidationError(
+                        f"alias action [{op}] requires [index] and "
+                        "[alias]")
+                resolved = []
+                for ix in indices:
+                    resolved.extend(s.name for s in self.resolve(ix)
+                                    if s.name in self.indices)
+                for alias in names:
+                    if alias in self.indices:
+                        raise ValidationError(
+                            f"an index named [{alias}] already exists")
+                    for ix in resolved:
+                        if op == "add":
+                            meta = {}
+                            if body.get("filter") is not None:
+                                meta["filter"] = body["filter"]
+                            if body.get("is_write_index"):
+                                meta["is_write_index"] = True
+                            staged.setdefault(alias, {})[ix] = meta
+                        else:
+                            staged.get(alias, {}).pop(ix, None)
+            self.aliases = {a: t for a, t in staged.items() if t}
+            self._persist_json(self._aliases_file, self.aliases)
+        return {"acknowledged": True}
+
+    def get_aliases(self, index: Optional[str] = None,
+                    name: Optional[str] = None) -> dict:
+        """GET /_alias family response shape: {index: {aliases: {...}}}."""
+        out: dict[str, dict] = {}
+        for alias, targets in self.aliases.items():
+            if name is not None and not re.match(
+                    "^" + re.escape(name).replace(r"\*", ".*") + "$",
+                    alias):
+                continue
+            for ix, meta in targets.items():
+                if index is not None and ix != index:
+                    continue
+                out.setdefault(ix, {"aliases": {}})["aliases"][alias] = meta
+        if name is not None and not out:
+            raise ResourceNotFoundError(f"alias [{name}] missing")
+        return out
+
+    def write_index_for(self, alias: str) -> "IndexService":
+        """Write resolution: an alias works for writes when it points at
+        one index or names an explicit write index."""
+        targets = self.aliases.get(alias)
+        if targets is None:
+            return self.get_or_create(alias)
+        writers = [ix for ix, meta in targets.items()
+                   if meta.get("is_write_index")]
+        if len(targets) == 1:
+            return self.get(next(iter(targets)))
+        if len(writers) == 1:
+            return self.get(writers[0])
+        raise ValidationError(
+            f"alias [{alias}] points to {sorted(targets)} and no single "
+            "write index is set")
+
+    # -- index templates ---------------------------------------------------
+
+    def put_template(self, name: str, body: dict) -> dict:
+        patterns = body.get("index_patterns")
+        if not patterns:
+            raise ValidationError(
+                "index template requires [index_patterns]")
+        with self._lock:
+            self.templates[name] = body
+            self._persist_json(self._templates_file, self.templates)
+        return {"acknowledged": True}
+
+    def get_template(self, name: Optional[str] = None) -> dict:
+        if name is None:
+            items = sorted(self.templates.items())
+        else:
+            items = [(n, t) for n, t in sorted(self.templates.items())
+                     if re.match("^" + re.escape(name)
+                                 .replace(r"\*", ".*") + "$", n)]
+            if not items and "*" not in name:
+                raise ResourceNotFoundError(
+                    f"index template matching [{name}] not found")
+        return {"index_templates": [
+            {"name": n, "index_template": t} for n, t in items]}
+
+    def delete_template(self, name: str) -> dict:
+        with self._lock:
+            if name not in self.templates:
+                raise ResourceNotFoundError(
+                    f"index template [{name}] missing")
+            del self.templates[name]
+            self._persist_json(self._templates_file, self.templates)
+        return {"acknowledged": True}
+
+    def _template_for(self, name: str) -> Optional[dict]:
+        """Highest-priority template whose pattern matches ``name``."""
+        best = None
+        best_prio = -1
+        for t in self.templates.values():
+            for p in t.get("index_patterns") or []:
+                if re.match("^" + re.escape(p).replace(r"\*", ".*") + "$",
+                            name):
+                    prio = int(t.get("priority", 0))
+                    if prio > best_prio:
+                        best, best_prio = t, prio
+        return best
 
     def close(self):
         for svc in self.indices.values():

@@ -40,8 +40,59 @@ class Node:
         self.contexts = ReaderContextRegistry()
         self.search_pipelines = SearchPipelineService(data_path)
         self.task_manager = TaskManager(name)
+        self._init_cluster_settings()
         self.rest = RestController(self)
         self.http = HttpServer(self.rest, host=host, port=port)
+
+    def _init_cluster_settings(self):
+        """Dynamic cluster-settings registry + persistence
+        (ClusterSettings / the _cluster/settings update API; consumers
+        wire live like SearchService.java:360)."""
+        import json as _json
+
+        from opensearch_tpu.common.settings import (Setting, Settings,
+                                                    SettingsRegistry)
+        from opensearch_tpu.search import aggs as aggs_mod
+
+        self._settings_file = os.path.join(self.data_path,
+                                           "cluster_settings.json")
+        stored = {}
+        if os.path.exists(self._settings_file):
+            with open(self._settings_file) as f:
+                stored = _json.load(f)
+        max_buckets = Setting.int_setting(
+            "search.max_buckets", 65536, min_value=1, dynamic=True)
+        auto_create = Setting.bool_setting(
+            "action.auto_create_index", True, dynamic=True)
+        max_scroll = Setting.int_setting(
+            "search.max_open_scroll_context", 500, min_value=0,
+            dynamic=True)
+        self.cluster_settings = SettingsRegistry(
+            Settings(stored), [max_buckets, auto_create, max_scroll])
+        self.cluster_settings.add_settings_update_consumer(
+            max_buckets, lambda v: setattr(aggs_mod, "MAX_BUCKETS", v))
+        self.cluster_settings.add_settings_update_consumer(
+            auto_create, lambda v: setattr(self.indices, "auto_create", v))
+        self.cluster_settings.add_settings_update_consumer(
+            max_scroll, lambda v: setattr(self.contexts, "_max_open", v))
+        # replay persisted values into the consumers at boot
+        aggs_mod.MAX_BUCKETS = self.cluster_settings.get(max_buckets)
+        self.indices.auto_create = self.cluster_settings.get(auto_create)
+        self.contexts._max_open = self.cluster_settings.get(max_scroll)
+
+    def update_cluster_settings(self, updates: dict) -> dict:
+        import json as _json
+
+        self.cluster_settings.apply_update(updates)
+        tmp = self._settings_file + ".tmp"
+        with open(tmp, "w") as f:
+            _json.dump(self.cluster_settings.settings.as_dict(), f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._settings_file)
+        return {"acknowledged": True,
+                "persistent": self.cluster_settings.settings.as_dict(),
+                "transient": {}}
 
     @property
     def port(self) -> int:

@@ -136,10 +136,33 @@ class RestController:
         r("GET", "/_cluster/stats", self.h_cluster_stats)
         r("GET", "/_nodes", self.h_nodes_info)
         r("GET", "/_nodes/stats", self.h_nodes_stats)
+        r("GET", "/_cluster/settings", self.h_cluster_get_settings)
+        r("PUT", "/_cluster/settings", self.h_cluster_put_settings)
         r("GET", "/_cat/indices", self.h_cat_indices)
         r("GET", "/_cat/health", self.h_cat_health)
         r("GET", "/_cat/count", self.h_cat_count)
         r("GET", "/_cat/shards", self.h_cat_shards)
+        r("GET", "/_cat/nodes", self.h_cat_nodes)
+        r("GET", "/_cat/aliases", self.h_cat_aliases)
+        r("GET", "/_cat/templates", self.h_cat_templates)
+        r("GET", "/_cat/segments", self.h_cat_segments)
+        r("POST", "/_aliases", self.h_update_aliases)
+        r("GET", "/_alias", self.h_get_alias)
+        r("GET", "/_alias/{name}", self.h_get_alias)
+        r("HEAD", "/_alias/{name}", self.h_alias_exists)
+        r("GET", "/{index}/_alias", self.h_get_alias)
+        r("PUT", "/{index}/_alias/{name}", self.h_put_alias)
+        r("POST", "/{index}/_alias/{name}", self.h_put_alias)
+        r("DELETE", "/{index}/_alias/{name}", self.h_delete_alias)
+        r("PUT", "/_index_template/{name}", self.h_put_template)
+        r("POST", "/_index_template/{name}", self.h_put_template)
+        r("GET", "/_index_template", self.h_get_template)
+        r("GET", "/_index_template/{name}", self.h_get_template)
+        r("DELETE", "/_index_template/{name}", self.h_delete_template)
+        r("GET", "/_analyze", self.h_analyze)
+        r("POST", "/_analyze", self.h_analyze)
+        r("GET", "/{index}/_analyze", self.h_analyze)
+        r("POST", "/{index}/_analyze", self.h_analyze)
         r("POST", "/_bulk", self.h_bulk)
         r("PUT", "/_bulk", self.h_bulk)
         r("POST", "/{index}/_bulk", self.h_bulk)
@@ -386,7 +409,7 @@ class RestController:
 
     def h_index_doc(self, req, doc_id=None, op_type=None):
         name = req.path_params["index"]
-        svc = self.node.indices.get_or_create(name)
+        svc = self.node.indices.write_index_for(name)
         doc_id = doc_id or req.path_params.get("id")
         source = req.json()
         if not isinstance(source, dict):
@@ -421,7 +444,7 @@ class RestController:
 
     def h_get_doc(self, req):
         name = req.path_params["index"]
-        svc = self.node.indices.get(name)
+        svc = self._single_index(name)
         doc = svc.get_doc(req.path_params["id"], req.param("routing"),
                           realtime=req.param("realtime", "true") != "false")
         if doc is None:
@@ -430,13 +453,13 @@ class RestController:
         return 200, {"_index": name, **doc}
 
     def h_doc_exists(self, req):
-        svc = self.node.indices.get(req.path_params["index"])
+        svc = self._single_index(req.path_params["index"])
         doc = svc.get_doc(req.path_params["id"], req.param("routing"))
         return (200, {}) if doc is not None else (404, {})
 
     def h_get_source(self, req):
         name = req.path_params["index"]
-        svc = self.node.indices.get(name)
+        svc = self._single_index(name)
         doc = svc.get_doc(req.path_params["id"], req.param("routing"))
         if doc is None:
             raise DocumentMissingError(name, req.path_params["id"])
@@ -444,7 +467,7 @@ class RestController:
 
     def h_delete_doc(self, req):
         name = req.path_params["index"]
-        svc = self.node.indices.get(name)
+        svc = self._single_index(name)
         kw = {}
         if req.param("if_seq_no") is not None:
             kw["if_seq_no"] = int(req.param("if_seq_no"))
@@ -462,7 +485,7 @@ class RestController:
 
     def h_update_doc(self, req):
         name = req.path_params["index"]
-        svc = self.node.indices.get_or_create(name)
+        svc = self.node.indices.write_index_for(name)
         body = req.json({})
         doc_id = req.path_params["id"]
         cur = svc.get_doc(doc_id, req.param("routing"))
@@ -549,7 +572,7 @@ class RestController:
         results_by_index = {}
         t0 = time.monotonic()
         for name, ops in ops_by_index.items():
-            svc = self.node.indices.get_or_create(name)
+            svc = self.node.indices.write_index_for(name)
             results_by_index[name] = svc.bulk(ops)
             if req.param("refresh") in ("", "true", "wait_for"):
                 svc.refresh()
@@ -565,6 +588,36 @@ class RestController:
         if expr is None:
             return list(self.node.indices.indices.values())
         return self.node.indices.resolve(expr)
+
+    def _target_indices_filtered(self, req) -> list:
+        """[(svc, alias_filter|None)] for search-style requests."""
+        expr = req.path_params.get("index")
+        if expr is None:
+            return [(s, None)
+                    for s in self.node.indices.indices.values()]
+        return self.node.indices.resolve_with_filters(expr)
+
+    @staticmethod
+    def _apply_alias_filter(body: dict, flt) -> dict:
+        """AND an alias filter into the request query (the reference
+        applies alias filters inside QueryShardContext)."""
+        if flt is None:
+            return body
+        out = dict(body)
+        q = body.get("query")
+        out["query"] = {"bool": {"must": [q] if q else [],
+                                 "filter": [flt]}}
+        return out
+
+    def _single_index(self, name: str):
+        """Exactly-one-index resolution for doc-level APIs (GET/DELETE/
+        UPDATE through an alias work when it targets one index)."""
+        svcs = self.node.indices.resolve(name)
+        if len(svcs) != 1:
+            raise ValidationError(
+                f"[{name}] resolves to {len(svcs)} indices — doc "
+                "operations require exactly one")
+        return svcs[0]
 
     def h_msearch(self, req):
         """NDJSON multi-search (RestMultiSearchAction analog): alternating
@@ -625,7 +678,8 @@ class RestController:
             for p, body in zip(positions, bodies):
                 try:
                     r = (svcs[0].search(body) if len(svcs) == 1
-                         else self._multi_index_search(svcs, body))
+                         else self._multi_index_search(
+                             [(s, None) for s in svcs], body))
                     r["status"] = 200
                     responses[p] = r
                 except OpenSearchTpuError as e:
@@ -728,17 +782,18 @@ class RestController:
         scroll = req.param("scroll") or body.get("scroll")
         if scroll:
             return 200, self._open_scroll(req, body, scroll)
-        services = self._target_indices(req)
-        if not services:
+        targets = self._target_indices_filtered(req)
+        if not targets:
             # allow_no_indices=true default: empty result, not an error
             return 200, {"took": 0, "timed_out": False,
                          "_shards": {"total": 0, "successful": 0,
                                      "skipped": 0, "failed": 0},
                          "hits": {"total": {"value": 0, "relation": "eq"},
                                   "max_score": None, "hits": []}}
-        if len(services) == 1:
-            return 200, services[0].search(body)
-        return 200, self._multi_index_search(services, body)
+        if len(targets) == 1:
+            svc, flt = targets[0]
+            return 200, svc.search(self._apply_alias_filter(body, flt))
+        return 200, self._multi_index_search(targets, body)
 
     def _open_scroll(self, req, body, scroll):
         """First scroll page: pin a searcher snapshot, materialize the
@@ -751,6 +806,10 @@ class RestController:
             raise ValidationError(
                 "scroll requires exactly one target index")
         svc = services[0]
+        flt = dict(self.node.indices.resolve_with_filters(
+            req.path_params["index"])).get(svc) \
+            if req.path_params.get("index") else None
+        body = self._apply_alias_filter(body, flt)
         # keep-alive parses BEFORE any breaker reservation: a malformed
         # value must not leak the context's request-breaker charge
         keepalive_ms = parse_keepalive(scroll)
@@ -796,8 +855,9 @@ class RestController:
         sub = dict(body)
         sub["from"] = 0
         sub["size"] = from_ + size
-        responses = [svc.search(sub, agg_partials=bool(aggs_json))
-                     for svc in services]
+        responses = [svc.search(self._apply_alias_filter(sub, flt),
+                                agg_partials=bool(aggs_json))
+                     for svc, flt in services]
         rows = []
         for resp_idx, resp in enumerate(responses):
             for pos, h in enumerate(resp["hits"]["hits"]):
@@ -825,6 +885,144 @@ class RestController:
                 aggs_json, [r.get("aggregation_partials") or {}
                             for r in responses])
         return out
+
+    # -- cluster settings / aliases / templates / analyze ------------------
+
+    def h_cluster_get_settings(self, req):
+        out = {"persistent": self.node.cluster_settings.settings.as_dict(),
+               "transient": {}}
+        if req.flag("include_defaults"):
+            out["defaults"] = {
+                k: s.default(self.node.cluster_settings.settings)
+                for k, s in
+                self.node.cluster_settings._registered.items()}
+        return 200, out
+
+    def h_cluster_put_settings(self, req):
+        body = req.json({}) or {}
+        updates = {**(body.get("persistent") or {}),
+                   **(body.get("transient") or {})}
+        if not updates:
+            raise ValidationError(
+                "no settings to update: provide [persistent] or "
+                "[transient]")
+        from opensearch_tpu.common.settings import Settings
+        updates = Settings(updates).as_dict()    # flatten nested keys
+        return 200, self.node.update_cluster_settings(updates)
+
+    def h_update_aliases(self, req):
+        body = req.json({}) or {}
+        return 200, self.node.indices.update_aliases(
+            body.get("actions") or [])
+
+    def h_get_alias(self, req):
+        return 200, self.node.indices.get_aliases(
+            index=req.path_params.get("index"),
+            name=req.path_params.get("name"))
+
+    def h_alias_exists(self, req):
+        try:
+            self.node.indices.get_aliases(name=req.path_params["name"])
+            return 200, {}
+        except ResourceNotFoundError:
+            return 404, {}
+
+    def h_put_alias(self, req):
+        body = req.json({}) or {}
+        action = {"index": req.path_params["index"],
+                  "alias": req.path_params["name"]}
+        for k in ("filter", "is_write_index", "routing"):
+            if body.get(k) is not None:
+                action[k] = body[k]
+        return 200, self.node.indices.update_aliases([{"add": action}])
+
+    def h_delete_alias(self, req):
+        self.node.indices.get_aliases(name=req.path_params["name"])
+        return 200, self.node.indices.update_aliases([{"remove": {
+            "index": req.path_params["index"],
+            "alias": req.path_params["name"]}}])
+
+    def h_put_template(self, req):
+        return 200, self.node.indices.put_template(
+            req.path_params["name"], req.json({}) or {})
+
+    def h_get_template(self, req):
+        return 200, self.node.indices.get_template(
+            req.path_params.get("name"))
+
+    def h_delete_template(self, req):
+        return 200, self.node.indices.delete_template(
+            req.path_params["name"])
+
+    def h_analyze(self, req):
+        body = req.json({}) or {}
+        text = body.get("text")
+        if text is None:
+            raise ValidationError("[_analyze] requires [text]")
+        texts = text if isinstance(text, list) else [text]
+        analyzer_name = body.get("analyzer")
+        index = req.path_params.get("index")
+        mapper = None
+        if index is not None:
+            mapper = self.node.indices.get(index).mapper
+        if analyzer_name is None and body.get("field") and mapper:
+            ft = mapper.field_type(body["field"])
+            analyzer_name = getattr(ft, "analyzer_name", "standard")
+        analyzers = (mapper.analyzers if mapper is not None
+                     else self._default_analyzers())
+        analyzer = analyzers.get(analyzer_name or "standard")
+        tokens = []
+        offset = 0
+        pos_base = 0
+        for t in texts:
+            for tok in analyzer.analyze(str(t)):
+                tokens.append({
+                    "token": tok.term,
+                    "start_offset": offset + tok.start_offset,
+                    "end_offset": offset + tok.end_offset,
+                    "type": "<ALPHANUM>",
+                    "position": pos_base + tok.position})
+            offset += len(str(t)) + 1
+            pos_base += 100      # position_increment_gap analog
+        return 200, {"tokens": tokens}
+
+    @staticmethod
+    def _default_analyzers():
+        from opensearch_tpu.analysis.registry import AnalysisRegistry
+        return AnalysisRegistry()
+
+    def h_cat_nodes(self, req):
+        return 200, [{"name": self.node.name, "node.role": "dimr",
+                      "master": "*", "ip": "127.0.0.1"}]
+
+    def h_cat_aliases(self, req):
+        rows = []
+        for alias, targets in sorted(self.node.indices.aliases.items()):
+            for ix, meta in sorted(targets.items()):
+                rows.append({"alias": alias, "index": ix,
+                             "filter": "*" if meta.get("filter") else "-",
+                             "is_write_index":
+                                 str(bool(meta.get("is_write_index")))
+                                 .lower()})
+        return 200, rows
+
+    def h_cat_templates(self, req):
+        return 200, [{"name": n,
+                      "index_patterns": str(t.get("index_patterns")),
+                      "order": str(t.get("priority", 0))}
+                     for n, t in sorted(self.node.indices.templates.items())]
+
+    def h_cat_segments(self, req):
+        rows = []
+        for name, svc in sorted(self.node.indices.indices.items()):
+            for shard_id, engine in sorted(svc.local_shards.items()):
+                for seg in engine.segments:
+                    rows.append({"index": name, "shard": str(shard_id),
+                                 "segment": seg.seg_id,
+                                 "docs.count": str(seg.live_count()),
+                                 "docs.deleted": str(
+                                     seg.n_docs - seg.live_count())})
+        return 200, rows
 
     # -- task management ---------------------------------------------------
 
@@ -917,8 +1115,11 @@ class RestController:
 
     def h_count(self, req):
         body = req.json({}) or {}
-        services = self._target_indices(req)
-        total = sum(svc.count(body.get("query")) for svc in services)
+        services = self._target_indices_filtered(req)
+        total = sum(
+            svc.count(self._apply_alias_filter(
+                {"query": body.get("query")}, flt)["query"])
+            for svc, flt in services)
         return 200, {"count": total,
                      "_shards": {"total": len(services),
                                  "successful": len(services), "skipped": 0,

@@ -180,6 +180,8 @@ def plan_batches(searcher, bodies: list) -> tuple[dict, list]:
         body = body or {}
         if (body.get("sort") is not None or body.get("aggs")
                 or body.get("aggregations") or body.get("min_score")
+                or body.get("highlight") or body.get("explain")
+                or body.get("docvalue_fields") or body.get("fields")
                 or int(body.get("from", 0)) != 0):
             fallback.append(pos)
             continue

@@ -183,9 +183,16 @@ class ShardSearcher:
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
         q = parse_query(body.get("query"))
+        fetch_extras = None
+        if (body.get("highlight") or body.get("explain")
+                or body.get("docvalue_fields") or body.get("fields")):
+            fetch_extras = {"highlight": body.get("highlight"),
+                            "explain": bool(body.get("explain")),
+                            "docvalue_fields": body.get("docvalue_fields"),
+                            "fields": body.get("fields"), "query": q}
         from opensearch_tpu.search.query_dsl import HybridQuery
         if isinstance(q, HybridQuery):
-            return self._hybrid_search(body, q, t0)
+            return self._hybrid_search(body, q, t0, fetch_extras)
         sort_specs = _parse_sort(body.get("sort"))
         min_score = body.get("min_score")
         source_spec = body.get("_source")
@@ -241,7 +248,7 @@ class ShardSearcher:
             else:
                 aggregations = execu.run(aggs_json, seg_views)
 
-        hits = self._hits_from_rows(rows, source_spec)
+        hits = self._hits_from_rows(rows, source_spec, fetch_extras)
 
         took = int((time.monotonic() - t0) * 1000)
         resp = {
@@ -260,7 +267,8 @@ class ShardSearcher:
             resp["aggregation_partials"] = partials
         return resp
 
-    def _hybrid_search(self, body: dict, q, t0) -> dict:
+    def _hybrid_search(self, body: dict, q, t0,
+                       fetch_extras=None) -> dict:
         """Hybrid query: each sub-query runs as its own device program;
         the normalization processor (search/pipeline.py) combines the
         per-sub-query top lists host-side.  ``_hybrid_pipeline`` in the
@@ -290,7 +298,8 @@ class ShardSearcher:
             max_total = max(max_total, int(tot))
         combined = conf.apply(per_query_rows, k_want)
         rows = combined[from_: from_ + size]
-        hits = self._hits_from_rows(rows, body.get("_source"))
+        hits = self._hits_from_rows(rows, body.get("_source"),
+                                    fetch_extras)
         # per-sub-query top-k truncation means the union is a lower
         # bound beyond the largest sub-query's exact count
         return {
@@ -336,18 +345,44 @@ class ShardSearcher:
             results[pos] = self.search(bodies[pos])
         return results
 
-    def _hits_from_rows(self, rows, source_spec):
+    def _hits_from_rows(self, rows, source_spec, fetch_extras=None):
+        from opensearch_tpu.search.fetch import (docvalue_fields,
+                                                 explain_hit,
+                                                 fields_option,
+                                                 run_highlight)
+
         hits = []
         for row in rows:
             seg = self.segments[row["seg"]]
             local = row["local"]
             hit = {"_index": self.index_name, "_id": seg.doc_ids[local],
                    "_score": row.get("score")}
-            src = filter_source(seg.source(local), source_spec)
+            source = seg.source(local)
+            src = filter_source(source, source_spec)
             if src is not None:
                 hit["_source"] = src
             if "sort" in row:
                 hit["sort"] = row["sort"]
+            if fetch_extras is not None:
+                if fetch_extras.get("highlight"):
+                    hl = run_highlight(fetch_extras["highlight"], source,
+                                       fetch_extras["query"], self.mapper)
+                    if hl:
+                        hit["highlight"] = hl
+                fields = {}
+                if fetch_extras.get("docvalue_fields"):
+                    fields.update(docvalue_fields(
+                        fetch_extras["docvalue_fields"], seg, local,
+                        self.mapper))
+                if fetch_extras.get("fields"):
+                    fields.update(fields_option(fetch_extras["fields"],
+                                                source))
+                if fields:
+                    hit["fields"] = fields
+                if fetch_extras.get("explain"):
+                    hit["_explanation"] = explain_hit(
+                        row.get("score"), fetch_extras["query"], seg,
+                        local, self.ctx)
             hits.append(hit)
         return hits
 

@@ -1,0 +1,221 @@
+"""Admin API surface: aliases, index templates, _cluster/settings,
+_analyze, _cat additions (VERDICT r3 missing #10; ref action/admin
+families, SURVEY Appendix B)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(str(tmp_path / "node"), port=0).start()
+    yield n
+    n.stop()
+
+
+def call(node, method, path, body=None):
+    url = f"http://127.0.0.1:{node.port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            payload = resp.read()
+            return resp.status, json.loads(payload) if payload else {}
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, json.loads(payload) if payload else {}
+
+
+def test_alias_lifecycle_and_search_resolution(node):
+    call(node, "PUT", "/logs-1", {})
+    call(node, "PUT", "/logs-2", {})
+    code, _ = call(node, "POST", "/_aliases", {"actions": [
+        {"add": {"index": "logs-1", "alias": "logs"}},
+        {"add": {"index": "logs-2", "alias": "logs"}},
+        {"add": {"index": "logs-2", "alias": "current",
+                 "is_write_index": True}}]})
+    assert code == 200
+    call(node, "PUT", "/logs-1/_doc/a", {"m": "one"})
+    call(node, "PUT", "/logs-2/_doc/b", {"m": "two"})
+    call(node, "POST", "/_refresh")
+    # search through the alias hits both indices
+    code, resp = call(node, "POST", "/logs/_search",
+                      {"query": {"match_all": {}}})
+    assert resp["hits"]["total"]["value"] == 2
+    # write through a single-target alias works; multi-target without a
+    # write index is rejected
+    code, _ = call(node, "PUT", "/current/_doc/c", {"m": "three"})
+    assert code in (200, 201)
+    code, resp = call(node, "GET", "/logs-2/_doc/c")
+    assert code == 200
+    code, resp = call(node, "PUT", "/logs/_doc/d", {"m": "four"})
+    assert code == 400
+    # alias listing shapes
+    code, resp = call(node, "GET", "/_alias/logs")
+    assert set(resp) == {"logs-1", "logs-2"}
+    code, resp = call(node, "GET", "/logs-1/_alias")
+    assert resp == {"logs-1": {"aliases": {"logs": {}}}}
+    code, _ = call(node, "HEAD", "/_alias/nope")
+    assert code == 404
+    # removal + index deletion cleanup
+    call(node, "DELETE", "/logs-1/_alias/logs")
+    code, resp = call(node, "GET", "/_alias/logs")
+    assert set(resp) == {"logs-2"}
+    call(node, "DELETE", "/logs-2")
+    code, resp = call(node, "GET", "/_alias")
+    assert resp == {}
+    # an alias name can't be used to create an index
+    call(node, "POST", "/_aliases", {"actions": [
+        {"add": {"index": "logs-1", "alias": "taken"}}]})
+    code, _ = call(node, "PUT", "/taken", {})
+    assert code == 400
+
+
+def test_index_templates_apply_on_creation(node):
+    code, _ = call(node, "PUT", "/_index_template/logs_t", {
+        "index_patterns": ["tmpl-*"], "priority": 10,
+        "template": {
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {"level": {"type": "keyword"},
+                                        "msg": {"type": "text"}}},
+            "aliases": {"tmpl-all": {}}}})
+    assert code == 200
+    # lower-priority template must lose
+    call(node, "PUT", "/_index_template/weak", {
+        "index_patterns": ["tmpl-*"], "priority": 1,
+        "template": {"settings": {"number_of_shards": 5}}})
+    code, _ = call(node, "PUT", "/tmpl-app",
+                   {"mappings": {"properties": {
+                       "extra": {"type": "long"}}}})
+    assert code == 200
+    code, resp = call(node, "GET", "/tmpl-app/_settings")
+    assert resp["tmpl-app"]["settings"]["index"]["number_of_shards"] == "2"
+    code, resp = call(node, "GET", "/tmpl-app/_mapping")
+    props = resp["tmpl-app"]["mappings"]["properties"]
+    assert props["level"]["type"] == "keyword"
+    assert props["extra"]["type"] == "long"     # request merged over
+    code, resp = call(node, "GET", "/_alias/tmpl-all")
+    assert "tmpl-app" in resp
+    code, resp = call(node, "GET", "/_index_template/logs_t")
+    assert resp["index_templates"][0]["name"] == "logs_t"
+    code, _ = call(node, "DELETE", "/_index_template/weak")
+    assert code == 200
+    code, _ = call(node, "GET", "/_index_template/weak")
+    assert code == 404
+    code, _ = call(node, "PUT", "/_index_template/bad", {})
+    assert code == 400
+
+
+def test_cluster_settings_dynamic_update(node):
+    code, resp = call(node, "GET", "/_cluster/settings")
+    assert code == 200 and resp == {"persistent": {}, "transient": {}}
+    code, resp = call(node, "PUT", "/_cluster/settings", {
+        "persistent": {"search.max_buckets": 100,
+                       "action.auto_create_index": False}})
+    assert code == 200
+    from opensearch_tpu.search import aggs as aggs_mod
+    assert aggs_mod.MAX_BUCKETS == 100
+    # auto-create disabled: writing to a missing index 404s
+    code, _ = call(node, "PUT", "/autono/_doc/1", {"x": 1})
+    assert code == 404
+    code, resp = call(node, "GET", "/_cluster/settings")
+    assert resp["persistent"]["search.max_buckets"] == 100
+    # unknown / non-dynamic keys rejected
+    code, _ = call(node, "PUT", "/_cluster/settings", {
+        "persistent": {"no.such.key": 1}})
+    assert code == 400
+    # reset via null
+    code, _ = call(node, "PUT", "/_cluster/settings", {
+        "persistent": {"action.auto_create_index": None}})
+    assert code == 200
+    code, _ = call(node, "PUT", "/auto2/_doc/1", {"x": 1})
+    assert code in (200, 201)
+    # restore for other tests sharing the process
+    call(node, "PUT", "/_cluster/settings", {
+        "persistent": {"search.max_buckets": None}})
+
+
+def test_analyze_endpoint(node):
+    code, resp = call(node, "POST", "/_analyze", {
+        "analyzer": "standard", "text": "The QUICK brown-fox"})
+    assert code == 200
+    toks = [t["token"] for t in resp["tokens"]]
+    assert toks == ["the", "quick", "brown", "fox"]
+    assert resp["tokens"][1]["start_offset"] == 4
+    assert resp["tokens"][1]["end_offset"] == 9
+    # field-based analyzer resolution through an index mapping
+    call(node, "PUT", "/an1", {"mappings": {"properties": {
+        "t": {"type": "text", "analyzer": "english"}}}})
+    code, resp = call(node, "POST", "/an1/_analyze", {
+        "field": "t", "text": "running foxes"})
+    toks = [t["token"] for t in resp["tokens"]]
+    assert toks == ["run", "fox"]               # stemmed
+    code, _ = call(node, "POST", "/_analyze", {"analyzer": "nope",
+                                               "text": "x"})
+    assert code == 400
+    code, _ = call(node, "POST", "/_analyze", {})
+    assert code == 400
+
+
+def test_cat_additions(node):
+    call(node, "PUT", "/catx", {})
+    call(node, "PUT", "/catx/_doc/1", {"a": 1})
+    call(node, "POST", "/catx/_refresh")
+    call(node, "POST", "/_aliases", {"actions": [
+        {"add": {"index": "catx", "alias": "caty"}}]})
+    call(node, "PUT", "/_index_template/catt",
+         {"index_patterns": ["zzz-*"]})
+    code, rows = call(node, "GET", "/_cat/nodes?format=json")
+    assert code == 200 and rows[0]["master"] == "*"
+    code, rows = call(node, "GET", "/_cat/aliases?format=json")
+    assert any(r["alias"] == "caty" and r["index"] == "catx"
+               for r in rows)
+    code, rows = call(node, "GET", "/_cat/templates?format=json")
+    assert any(r["name"] == "catt" for r in rows)
+    code, rows = call(node, "GET", "/_cat/segments?format=json")
+    assert any(r["index"] == "catx" and r["docs.count"] == "1"
+               for r in rows)
+
+
+def test_alias_filter_applied_at_search(node):
+    """Filtered aliases narrow search/count results (round-4 review
+    finding: the filter was stored but never applied)."""
+    call(node, "PUT", "/flog", {"mappings": {"properties": {
+        "level": {"type": "keyword"}, "msg": {"type": "text"}}}})
+    for i, level in enumerate(["error", "info", "error", "debug"]):
+        call(node, "PUT", f"/flog/_doc/{i}", {"level": level,
+                                              "msg": f"event {i}"})
+    call(node, "POST", "/flog/_refresh")
+    call(node, "POST", "/_aliases", {"actions": [{"add": {
+        "index": "flog", "alias": "errors",
+        "filter": {"term": {"level": "error"}}}}]})
+    code, resp = call(node, "POST", "/errors/_search",
+                      {"query": {"match_all": {}}})
+    assert resp["hits"]["total"]["value"] == 2
+    assert {h["_id"] for h in resp["hits"]["hits"]} == {"0", "2"}
+    # filter composes with the request query
+    code, resp = call(node, "POST", "/errors/_search",
+                      {"query": {"match": {"msg": "event"}}})
+    assert resp["hits"]["total"]["value"] == 2
+    code, resp = call(node, "POST", "/errors/_count")
+    assert resp["count"] == 2
+    # direct index access stays unfiltered
+    code, resp = call(node, "POST", "/flog/_count")
+    assert resp["count"] == 4
+    # doc ops through a single-target alias resolve (review finding)
+    code, resp = call(node, "GET", "/errors/_doc/1")
+    assert code == 200
+    # malformed alias action is a 400, not a crash
+    code, _ = call(node, "POST", "/_aliases",
+                   {"actions": [{"add": "foo"}]})
+    assert code == 400
+    # routing unsupported -> clean 400
+    code, _ = call(node, "PUT", "/flog/_alias/r1", {"routing": "x"})
+    assert code == 400

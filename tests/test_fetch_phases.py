@@ -1,0 +1,121 @@
+"""Fetch sub-phases: highlight / explain / docvalue_fields / fields
+(VERDICT r3 missing #7; ref search/fetch/FetchPhase.java:1 +
+search/fetch/subphase/)."""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.segment import SegmentWriter
+from opensearch_tpu.mapping.mapper import DocumentMapper
+from opensearch_tpu.search.executor import ShardSearcher
+
+MAPPING = {"properties": {
+    "title": {"type": "text"},
+    "body": {"type": "text", "analyzer": "english"},
+    "tags": {"type": "keyword"},
+    "views": {"type": "long"},
+    "ts": {"type": "date"},
+}}
+
+DOCS = [
+    {"title": "The quick brown fox",
+     "body": "The quick brown fox jumps over the lazy dog. "
+             "Foxes are quick and clever animals that jump high.",
+     "tags": ["animal", "fast"], "views": 11,
+     "ts": "2024-03-05T10:00:00Z"},
+    {"title": "Lazy dogs sleeping",
+     "body": "Dogs sleep all day long in the warm sun.",
+     "tags": ["animal"], "views": 22, "ts": "2024-04-01T00:00:00Z"},
+]
+
+
+@pytest.fixture(scope="module")
+def searcher():
+    mapper = DocumentMapper(MAPPING)
+    writer = SegmentWriter()
+    seg = writer.build([mapper.parse(str(i), d)
+                        for i, d in enumerate(DOCS)], "f0")
+    return ShardSearcher([seg], mapper)
+
+
+def test_highlight_basic_fragments(searcher):
+    resp = searcher.search({"query": {"match": {"body": "fox"}},
+                            "highlight": {"fields": {"body": {}}}})
+    hit = resp["hits"]["hits"][0]
+    frags = hit["highlight"]["body"]
+    assert frags and all("<em>" in f for f in frags)
+    # stemming-aware: "Foxes" highlights for query "fox" (english analyzer)
+    joined = " ".join(frags)
+    assert "<em>fox</em>" in joined
+    assert "<em>Foxes</em>" in joined
+
+
+def test_highlight_custom_tags_and_require_match(searcher):
+    resp = searcher.search({
+        "query": {"match": {"body": "quick"}},
+        "highlight": {"pre_tags": ["<b>"], "post_tags": ["</b>"],
+                      "fields": {"body": {}, "title": {}}}})
+    hit = resp["hits"]["hits"][0]
+    assert "<b>quick</b>" in " ".join(hit["highlight"]["body"])
+    # require_field_match (default): title terms didn't come from the
+    # query's body clause... but match shares the analyzed term, so
+    # title only highlights when requested with require_field_match off
+    resp2 = searcher.search({
+        "query": {"match": {"body": "quick"}},
+        "highlight": {"require_field_match": False,
+                      "fields": {"title": {}}}})
+    hit2 = resp2["hits"]["hits"][0]
+    assert "quick" in " ".join(hit2["highlight"]["title"])
+
+
+def test_highlight_phrase_and_wildcard(searcher):
+    resp = searcher.search({
+        "query": {"match_phrase": {"body": "lazy dog"}},
+        "highlight": {"fields": {"body": {}}}})
+    frags = resp["hits"]["hits"][0]["highlight"]["body"]
+    assert "<em>lazy</em>" in " ".join(frags)
+    resp = searcher.search({
+        "query": {"wildcard": {"title": "qui*"}},
+        "highlight": {"fields": {"title": {}}}})
+    assert "<em>quick</em>" in " ".join(
+        resp["hits"]["hits"][0]["highlight"]["title"])
+
+
+def test_explain_bm25_breakdown(searcher):
+    resp = searcher.search({"query": {"match": {"body": "fox quick"}},
+                            "explain": True})
+    hit = resp["hits"]["hits"][0]
+    exp = hit["_explanation"]
+    assert exp["value"] == pytest.approx(hit["_score"], rel=1e-5)
+    assert exp["details"], "term-level details expected"
+    term_exp = exp["details"][0]
+    labels = [d["description"] for d in term_exp["details"]]
+    assert any("idf" in lbl for lbl in labels)
+    assert any("tf" in lbl for lbl in labels)
+    # the sum of term contributions reproduces the score
+    total = sum(d["value"] for d in exp["details"])
+    assert total == pytest.approx(hit["_score"], rel=1e-4)
+
+
+def test_docvalue_fields_and_fields_api(searcher):
+    resp = searcher.search({
+        "query": {"match_all": {}},
+        "docvalue_fields": ["views", {"field": "ts"},
+                            {"field": "views", "format": "x"}, "tags"],
+        "fields": ["title", "vi*"],
+        "sort": [{"views": "asc"}]})
+    h0 = resp["hits"]["hits"][0]
+    assert h0["fields"]["views"] == [11]
+    assert h0["fields"]["ts"] == ["2024-03-05T10:00:00.000Z"]
+    assert sorted(h0["fields"]["tags"]) == ["animal", "fast"]
+    assert h0["fields"]["title"] == ["The quick brown fox"]
+
+
+def test_msearch_falls_back_for_fetch_extras(searcher):
+    got = searcher.msearch([
+        {"query": {"match": {"body": "fox"}},
+         "highlight": {"fields": {"body": {}}}},
+        {"query": {"match": {"body": "fox"}}},
+    ])
+    assert "highlight" in got[0]["hits"]["hits"][0]
+    assert "highlight" not in got[1]["hits"]["hits"][0]
