@@ -1,0 +1,479 @@
+"""Ingest pipelines: document transforms applied before indexing.
+
+Analog of the reference's IngestService + the ingest-common module's
+processors (ref ingest/IngestService.java:560,578,728 executePipelines;
+modules/ingest-common 4.7k LoC).  A pipeline is a named list of
+processors run host-side over the raw source dict — ingest never touches
+the device path, exactly like the reference runs it on the coordinating
+node before the engine sees the doc.
+
+Processors: set, remove, rename, convert, lowercase, uppercase, trim,
+split, join, append, gsub, date, fail, drop.  Each supports
+``ignore_missing`` where the reference does, ``on_failure`` handlers,
+and ``ignore_failure``.  Field paths are dotted; ``{{field}}`` mustache
+templates resolve in ``set``'s value and ``fail``'s message.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import os
+import re
+import threading
+from typing import Any, Callable, Optional
+
+from opensearch_tpu.common.errors import (IllegalArgumentError,
+                                          OpenSearchTpuError,
+                                          ResourceNotFoundError)
+
+
+class IngestProcessorError(OpenSearchTpuError):
+    status = 400
+
+
+class DropDocument(Exception):
+    """Control-flow: the drop processor removes the doc from the batch."""
+
+
+# -- dotted-path helpers ------------------------------------------------------
+
+
+def path_get(doc: dict, path: str, default=None):
+    cur: Any = doc
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return default
+        cur = cur[part]
+    return cur
+
+
+def path_has(doc: dict, path: str) -> bool:
+    sentinel = object()
+    return path_get(doc, path, sentinel) is not sentinel
+
+
+def path_set(doc: dict, path: str, value):
+    parts = path.split(".")
+    cur = doc
+    for part in parts[:-1]:
+        nxt = cur.get(part)
+        if not isinstance(nxt, dict):
+            nxt = cur[part] = {}
+        cur = nxt
+    cur[parts[-1]] = value
+
+
+def path_del(doc: dict, path: str) -> bool:
+    parts = path.split(".")
+    cur = doc
+    for part in parts[:-1]:
+        cur = cur.get(part)
+        if not isinstance(cur, dict):
+            return False
+    return cur.pop(parts[-1], None) is not None if isinstance(cur, dict) \
+        else False
+
+
+_TEMPLATE = re.compile(r"\{\{\s*([\w.]+)\s*\}\}")
+
+
+def render_template(value, doc: dict):
+    """{{field}} mustache substitution against the document."""
+    if not isinstance(value, str) or "{{" not in value:
+        return value
+    return _TEMPLATE.sub(
+        lambda m: str(path_get(doc, m.group(1), "")), value)
+
+
+# -- processors ---------------------------------------------------------------
+
+
+def _p_set(conf):
+    field = conf["field"]
+    value = conf.get("value")
+    override = conf.get("override", True)
+
+    def run(doc):
+        if not override and path_has(doc, field):
+            return
+        path_set(doc, field, render_template(value, doc))
+    return run
+
+
+def _p_remove(conf):
+    fields = conf["field"]
+    if not isinstance(fields, list):
+        fields = [fields]
+    ignore_missing = conf.get("ignore_missing", False)
+
+    def run(doc):
+        for f in fields:
+            if not path_del(doc, f) and not ignore_missing:
+                raise IngestProcessorError(f"field [{f}] not present")
+    return run
+
+
+def _p_rename(conf):
+    field, target = conf["field"], conf["target_field"]
+    ignore_missing = conf.get("ignore_missing", False)
+
+    def run(doc):
+        if not path_has(doc, field):
+            if ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{field}] not present")
+        if path_has(doc, target):
+            raise IngestProcessorError(
+                f"field [{target}] already exists")
+        path_set(doc, target, path_get(doc, field))
+        path_del(doc, field)
+    return run
+
+
+def _p_convert(conf):
+    field = conf["field"]
+    target = conf.get("target_field", field)
+    typ = conf["type"]
+    ignore_missing = conf.get("ignore_missing", False)
+    converters: dict[str, Callable] = {
+        "integer": int, "long": int, "float": float, "double": float,
+        "string": str,
+        "boolean": lambda v: (v if isinstance(v, bool) else
+                              str(v).lower() == "true"),
+        "auto": lambda v: _auto_convert(v),
+    }
+    if typ not in converters:
+        raise IllegalArgumentError(f"[convert] unknown type [{typ}]")
+
+    def run(doc):
+        if not path_has(doc, field):
+            if ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{field}] not present")
+        v = path_get(doc, field)
+        try:
+            if isinstance(v, list):
+                out = [converters[typ](x) for x in v]
+            else:
+                out = converters[typ](v)
+        except (TypeError, ValueError) as e:
+            raise IngestProcessorError(
+                f"[convert] cannot convert [{v!r}] to {typ}: {e}") \
+                from None
+        path_set(doc, target, out)
+    return run
+
+
+def _auto_convert(v):
+    if isinstance(v, str):
+        for fn in (int, float):
+            try:
+                return fn(v)
+            except ValueError:
+                pass
+        if v.lower() in ("true", "false"):
+            return v.lower() == "true"
+    return v
+
+
+def _string_proc(fn):
+    def build(conf):
+        field = conf["field"]
+        target = conf.get("target_field", field)
+        ignore_missing = conf.get("ignore_missing", False)
+
+        def run(doc):
+            if not path_has(doc, field):
+                if ignore_missing:
+                    return
+                raise IngestProcessorError(f"field [{field}] not present")
+            v = path_get(doc, field)
+            if isinstance(v, list):
+                path_set(doc, target, [fn(str(x)) for x in v])
+            else:
+                path_set(doc, target, fn(str(v)))
+        return run
+    return build
+
+
+def _p_split(conf):
+    field = conf["field"]
+    sep = _compile_rx(conf["separator"], "split")
+    target = conf.get("target_field", field)
+    ignore_missing = conf.get("ignore_missing", False)
+
+    def run(doc):
+        if not path_has(doc, field):
+            if ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{field}] not present")
+        path_set(doc, target, sep.split(str(path_get(doc, field))))
+    return run
+
+
+def _p_join(conf):
+    field = conf["field"]
+    sep = conf["separator"]
+    target = conf.get("target_field", field)
+
+    def run(doc):
+        v = path_get(doc, field)
+        if not isinstance(v, list):
+            raise IngestProcessorError(
+                f"[join] field [{field}] is not an array")
+        path_set(doc, target, sep.join(str(x) for x in v))
+    return run
+
+
+def _p_append(conf):
+    field = conf["field"]
+    value = conf.get("value")
+
+    def run(doc):
+        cur = path_get(doc, field)
+        add = value if isinstance(value, list) else [value]
+        add = [render_template(v, doc) for v in add]
+        if cur is None:
+            path_set(doc, field, list(add))
+        elif isinstance(cur, list):
+            cur.extend(add)
+        else:
+            path_set(doc, field, [cur, *add])
+    return run
+
+
+def _compile_rx(pattern: str, proc: str):
+    try:
+        return re.compile(pattern)
+    except re.error as e:
+        raise IllegalArgumentError(
+            f"[{proc}] invalid pattern [{pattern}]: {e}") from None
+
+
+def _p_gsub(conf):
+    field = conf["field"]
+    pattern = _compile_rx(conf["pattern"], "gsub")
+    replacement = conf["replacement"]
+    target = conf.get("target_field", field)
+    ignore_missing = conf.get("ignore_missing", False)
+
+    def run(doc):
+        if not path_has(doc, field):
+            if ignore_missing:
+                return
+            raise IngestProcessorError(f"field [{field}] not present")
+        path_set(doc, target,
+                 pattern.sub(replacement, str(path_get(doc, field))))
+    return run
+
+
+_DATE_FORMATS = {
+    "ISO8601": None,                       # handled by fromisoformat
+    "UNIX": "unix", "UNIX_MS": "unix_ms",
+}
+
+
+def _p_date(conf):
+    field = conf["field"]
+    target = conf.get("target_field", "@timestamp")
+    formats = conf.get("formats") or ["ISO8601"]
+
+    def run(doc):
+        v = path_get(doc, field)
+        if v is None:
+            raise IngestProcessorError(f"field [{field}] not present")
+        for fmt in formats:
+            try:
+                if fmt == "ISO8601":
+                    s = str(v).replace("Z", "+00:00")
+                    dt = _dt.datetime.fromisoformat(s)
+                elif fmt == "UNIX":
+                    dt = _dt.datetime.fromtimestamp(
+                        float(v), tz=_dt.timezone.utc)
+                elif fmt == "UNIX_MS":
+                    dt = _dt.datetime.fromtimestamp(
+                        float(v) / 1000.0, tz=_dt.timezone.utc)
+                else:
+                    dt = _dt.datetime.strptime(str(v), fmt)
+                if dt.tzinfo is None:
+                    dt = dt.replace(tzinfo=_dt.timezone.utc)
+                path_set(doc, target,
+                         dt.astimezone(_dt.timezone.utc)
+                         .strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z")
+                return
+            except (ValueError, OverflowError):
+                continue
+        raise IngestProcessorError(
+            f"[date] unable to parse [{v!r}] with formats {formats}")
+    return run
+
+
+def _p_fail(conf):
+    message = conf.get("message", "Fail processor executed")
+
+    def run(doc):
+        raise IngestProcessorError(render_template(message, doc))
+    return run
+
+
+def _p_drop(conf):
+    def run(doc):
+        raise DropDocument()
+    return run
+
+
+PROCESSORS: dict[str, Callable[[dict], Callable]] = {
+    "set": _p_set,
+    "remove": _p_remove,
+    "rename": _p_rename,
+    "convert": _p_convert,
+    "lowercase": _string_proc(str.lower),
+    "uppercase": _string_proc(str.upper),
+    "trim": _string_proc(str.strip),
+    "split": _p_split,
+    "join": _p_join,
+    "append": _p_append,
+    "gsub": _p_gsub,
+    "date": _p_date,
+    "fail": _p_fail,
+    "drop": _p_drop,
+}
+
+_META_KEYS = ("tag", "description", "if", "ignore_failure", "on_failure")
+
+
+class Pipeline:
+    def __init__(self, pipeline_id: str, body: dict):
+        self.id = pipeline_id
+        self.description = body.get("description", "")
+        self.steps: list[tuple[Callable, dict]] = []
+        for entry in body.get("processors") or []:
+            if not isinstance(entry, dict) or len(
+                    [k for k in entry if k not in _META_KEYS]) != 1:
+                raise IllegalArgumentError(
+                    "each processor entry must name exactly one "
+                    "processor type")
+            ((name, conf),) = ((k, v) for k, v in entry.items()
+                               if k not in _META_KEYS)
+            factory = PROCESSORS.get(name)
+            if factory is None:
+                raise IllegalArgumentError(
+                    f"No processor type exists with name [{name}]")
+            conf = dict(conf or {})
+            # meta keys (tag/on_failure/...) live INSIDE the processor
+            # config in the reference's shape; entry level also accepted
+            meta = {k: conf.pop(k) for k in _META_KEYS if k in conf}
+            meta.update({k: entry[k] for k in _META_KEYS if k in entry})
+            try:
+                self.steps.append((factory(conf), meta))
+            except KeyError as e:
+                raise IllegalArgumentError(
+                    f"[{name}] missing required property {e}") from None
+
+    def run(self, doc: dict) -> Optional[dict]:
+        """Transform in place; returns None when the doc was dropped."""
+        for fn, meta in self.steps:
+            try:
+                fn(doc)
+            except DropDocument:
+                return None
+            except OpenSearchTpuError as e:
+                handlers = meta.get("on_failure")
+                if handlers:
+                    doc.setdefault("_ingest", {})["on_failure_message"] = \
+                        e.reason
+                    for h in Pipeline("__on_failure__",
+                                      {"processors": handlers}).steps:
+                        try:
+                            h[0](doc)
+                        except DropDocument:
+                            return None    # drop-on-failure pattern
+                elif not meta.get("ignore_failure"):
+                    raise
+        return doc
+
+
+class IngestService:
+    """Named-pipeline registry with on-disk persistence."""
+
+    def __init__(self, data_path: str):
+        self._file = os.path.join(data_path, "ingest_pipelines.json")
+        self._lock = threading.Lock()
+        self._bodies: dict[str, dict] = {}
+        self._compiled: dict[str, Pipeline] = {}
+        if os.path.exists(self._file):
+            with open(self._file) as f:
+                self._bodies = json.load(f)
+            for pid, body in self._bodies.items():
+                self._compiled[pid] = Pipeline(pid, body)
+
+    def _persist(self):
+        tmp = self._file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._bodies, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._file)
+
+    def put(self, pipeline_id: str, body: dict) -> dict:
+        compiled = Pipeline(pipeline_id, body)   # validates eagerly
+        with self._lock:
+            self._bodies[pipeline_id] = body
+            self._compiled[pipeline_id] = compiled
+            self._persist()
+        return {"acknowledged": True}
+
+    def get(self, pipeline_id: Optional[str] = None) -> dict:
+        with self._lock:
+            if pipeline_id is None:
+                return dict(self._bodies)
+            if pipeline_id not in self._bodies:
+                raise ResourceNotFoundError(
+                    f"pipeline with id [{pipeline_id}] does not exist")
+            return {pipeline_id: self._bodies[pipeline_id]}
+
+    def delete(self, pipeline_id: str) -> dict:
+        with self._lock:
+            if pipeline_id not in self._bodies:
+                raise ResourceNotFoundError(
+                    f"pipeline with id [{pipeline_id}] does not exist")
+            del self._bodies[pipeline_id]
+            del self._compiled[pipeline_id]
+            self._persist()
+        return {"acknowledged": True}
+
+    def pipeline(self, pipeline_id: str) -> Pipeline:
+        with self._lock:
+            p = self._compiled.get(pipeline_id)
+        if p is None:
+            raise ResourceNotFoundError(
+                f"pipeline with id [{pipeline_id}] does not exist")
+        return p
+
+    def process(self, pipeline_id: str, source: dict) -> Optional[dict]:
+        """Run one doc through a named pipeline (IngestService
+        .executePipelines per-doc step); None = dropped."""
+        doc = json.loads(json.dumps(source))    # isolated deep copy
+        out = self.pipeline(pipeline_id).run(doc)
+        if out is not None:
+            out.pop("_ingest", None)
+        return out
+
+    def simulate(self, pipeline_body: dict, docs: list) -> dict:
+        pipeline = Pipeline("_simulate", pipeline_body)
+        out = []
+        for d in docs or []:
+            src = json.loads(json.dumps(d.get("_source") or {}))
+            try:
+                result = pipeline.run(src)
+            except OpenSearchTpuError as e:
+                out.append({"error": {"type": e.error_type,
+                                      "reason": e.reason}})
+                continue
+            if result is None:
+                out.append({"doc": None})
+            else:
+                result.pop("_ingest", None)
+                out.append({"doc": {"_source": result}})
+        return {"docs": out}

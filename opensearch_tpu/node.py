@@ -36,6 +36,8 @@ class Node:
         from opensearch_tpu.search.contexts import ReaderContextRegistry
         from opensearch_tpu.search.pipeline import SearchPipelineService
         from opensearch_tpu.common.tasks import TaskManager
+        from opensearch_tpu.ingest.service import IngestService
+        self.ingest = IngestService(data_path)
         self.snapshots = SnapshotsService(self.indices, data_path)
         self.contexts = ReaderContextRegistry()
         self.search_pipelines = SearchPipelineService(data_path)

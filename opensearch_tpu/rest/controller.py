@@ -159,6 +159,22 @@ class RestController:
         r("GET", "/_index_template", self.h_get_template)
         r("GET", "/_index_template/{name}", self.h_get_template)
         r("DELETE", "/_index_template/{name}", self.h_delete_template)
+        r("POST", "/_reindex", self.h_reindex)
+        r("POST", "/{index}/_update_by_query", self.h_update_by_query)
+        r("POST", "/{index}/_delete_by_query", self.h_delete_by_query)
+        r("GET", "/_field_caps", self.h_field_caps)
+        r("POST", "/_field_caps", self.h_field_caps)
+        r("GET", "/{index}/_field_caps", self.h_field_caps)
+        r("POST", "/{index}/_field_caps", self.h_field_caps)
+        r("GET", "/{index}/_termvectors/{id}", self.h_termvectors)
+        r("POST", "/{index}/_termvectors/{id}", self.h_termvectors)
+        r("PUT", "/_ingest/pipeline/{id}", self.h_put_ingest)
+        r("GET", "/_ingest/pipeline", self.h_get_ingest)
+        r("GET", "/_ingest/pipeline/{id}", self.h_get_ingest)
+        r("DELETE", "/_ingest/pipeline/{id}", self.h_delete_ingest)
+        r("POST", "/_ingest/pipeline/{id}/_simulate",
+          self.h_simulate_ingest)
+        r("POST", "/_ingest/pipeline/_simulate", self.h_simulate_ingest)
         r("GET", "/_analyze", self.h_analyze)
         r("POST", "/_analyze", self.h_analyze)
         r("GET", "/{index}/_analyze", self.h_analyze)
@@ -399,6 +415,199 @@ class RestController:
         return 200, {"_shards": {"total": svc.num_shards,
                                  "successful": svc.num_shards, "failed": 0}}
 
+    # -- reindex family (scroll-read + bulk-write; modules/reindex) --------
+
+    def _scan_all(self, svc, query):
+        """Every matching (engine, _id, source) via the scroll
+        materialization path, PER SHARD ENGINE — write-backs go straight
+        to the owning engine, so custom-routed docs are never mis-routed
+        through id-based rerouting."""
+        for engine in svc.shards:
+            searcher = engine.acquire_searcher()
+            rows, _total = searcher.scan_rows({"query": query})
+            for row in rows:
+                seg = searcher.segments[row["seg"]]
+                local = row["local"]
+                yield engine, seg.doc_ids[local], seg.source(local)
+
+    def h_reindex(self, req):
+        body = req.json({}) or {}
+        src = body.get("source") or {}
+        dest = body.get("dest") or {}
+        if not src.get("index") or not dest.get("index"):
+            raise ValidationError(
+                "[reindex] requires source.index and dest.index")
+        services = self.node.indices.resolve(src["index"])
+        dest_svc = self.node.indices.write_index_for(dest["index"])
+        # validate BEFORE any copy: a partial write then a 400 would lie
+        if any(svc.name == dest_svc.name for svc in services):
+            raise ValidationError(
+                "reindex cannot write into its own source index")
+        pid = dest.get("pipeline")
+        created = updated = total = 0
+        t0 = time.monotonic()
+        for svc in services:
+            for _eng, doc_id, source in self._scan_all(svc,
+                                                       src.get("query")):
+                total += 1
+                if pid:
+                    source = self.node.ingest.process(pid, source)
+                    if source is None:
+                        continue
+                r = dest_svc.index_doc(doc_id, source)
+                if r.result == "created":
+                    created += 1
+                else:
+                    updated += 1
+        dest_svc.refresh()
+        return 200, {"took": int((time.monotonic() - t0) * 1000),
+                     "total": total, "created": created,
+                     "updated": updated, "deleted": 0, "failures": []}
+
+    def h_update_by_query(self, req):
+        body = req.json({}) or {}
+        services = self._target_indices(req)
+        if body.get("script") is not None:
+            # painless update scripts mutate via ctx._source assignments
+            # — unsupported; full-document transforms go through ingest
+            raise ValidationError(
+                "[update_by_query] with [script] is not supported — use "
+                "an ingest [pipeline] instead")
+        pid = req.param("pipeline")
+        total = updated = 0
+        t0 = time.monotonic()
+        for svc in services:
+            for engine, doc_id, source in self._scan_all(
+                    svc, body.get("query")):
+                total += 1
+                if pid:
+                    source = self.node.ingest.process(pid, source)
+                    if source is None:
+                        continue
+                engine.index(doc_id, source)    # owning shard directly
+                updated += 1
+            svc.invalidate_searcher()
+            svc.refresh()
+        return 200, {"took": int((time.monotonic() - t0) * 1000),
+                     "total": total, "updated": updated,
+                     "failures": []}
+
+    def h_delete_by_query(self, req):
+        body = req.json({}) or {}
+        if body.get("query") is None:
+            raise ValidationError("[delete_by_query] requires [query]")
+        services = self._target_indices(req)
+        total = deleted = 0
+        t0 = time.monotonic()
+        for svc in services:
+            for engine, doc_id, _source in self._scan_all(
+                    svc, body["query"]):
+                total += 1
+                r = engine.delete(doc_id)   # owning shard directly
+                if r.result == "deleted":
+                    deleted += 1
+            svc.invalidate_searcher()
+            svc.refresh()
+        return 200, {"took": int((time.monotonic() - t0) * 1000),
+                     "total": total, "deleted": deleted,
+                     "failures": []}
+
+    # -- field_caps / termvectors ------------------------------------------
+
+    def h_field_caps(self, req):
+        body = req.json({}) or {}
+        fields = req.param("fields") or body.get("fields")
+        if not fields:
+            raise ValidationError("[_field_caps] requires [fields]")
+        if isinstance(fields, str):
+            fields = [f.strip() for f in fields.split(",") if f.strip()]
+        import fnmatch as _fn
+        services = self._target_indices(req)
+        caps: dict[str, dict] = {}
+        for svc in services:
+            for path, ft in svc.mapper.field_types().items():
+                if not any(_fn.fnmatchcase(path, p) for p in fields):
+                    continue
+                entry = caps.setdefault(path, {})
+                entry.setdefault(ft.type_name, {
+                    "type": ft.type_name,
+                    "searchable": bool(ft.index_enabled
+                                       or ft.dv_kind != "none"),
+                    "aggregatable": ft.dv_kind != "none",
+                })
+        return 200, {"indices": sorted(s.name for s in services),
+                     "fields": caps}
+
+    def h_termvectors(self, req):
+        name = req.path_params["index"]
+        svc = self._single_index(name)
+        doc = svc.get_doc(req.path_params["id"])
+        if doc is None:
+            return 404, {"_index": name, "_id": req.path_params["id"],
+                         "found": False}
+        body = req.json({}) or {}
+        wanted = body.get("fields") or req.param("fields")
+        if isinstance(wanted, str):
+            wanted = [f.strip() for f in wanted.split(",")]
+        source = doc.get("_source") or {}
+        term_vectors = {}
+        for field, ft in svc.mapper.field_types().items():
+            if wanted and field not in wanted:
+                continue
+            if not hasattr(ft, "search_terms"):
+                continue
+            value = source.get(field)
+            if value is None:
+                continue
+            analyzer = svc.mapper.analyzers.get(
+                getattr(ft, "analyzer_name", "standard"))
+            terms: dict[str, dict] = {}
+            values = value if isinstance(value, list) else [value]
+            pos_base = 0
+            for v in values:             # arrays analyze per element
+                for tok in analyzer.analyze(str(v)):
+                    t = terms.setdefault(tok.term, {"term_freq": 0,
+                                                    "tokens": []})
+                    t["term_freq"] += 1
+                    t["tokens"].append({
+                        "position": pos_base + tok.position,
+                        "start_offset": tok.start_offset,
+                        "end_offset": tok.end_offset})
+                pos_base += 100          # position_increment_gap analog
+            if terms:
+                term_vectors[field] = {"terms": terms}
+        return 200, {"_index": name, "_id": req.path_params["id"],
+                     "found": True, "term_vectors": term_vectors}
+
+    # -- ingest pipelines --------------------------------------------------
+
+    def h_put_ingest(self, req):
+        return 200, self.node.ingest.put(req.path_params["id"],
+                                         req.json({}) or {})
+
+    def h_get_ingest(self, req):
+        return 200, self.node.ingest.get(req.path_params.get("id"))
+
+    def h_delete_ingest(self, req):
+        return 200, self.node.ingest.delete(req.path_params["id"])
+
+    def h_simulate_ingest(self, req):
+        body = req.json({}) or {}
+        pid = req.path_params.get("id")
+        pipeline = (self.node.ingest.get(pid)[pid] if pid
+                    else body.get("pipeline") or {})
+        return 200, self.node.ingest.simulate(pipeline,
+                                              body.get("docs") or [])
+
+    def _ingest_pipeline_for(self, req, svc) -> Optional[str]:
+        """?pipeline= param, else the index's default_pipeline setting
+        (IndexSettings.DEFAULT_PIPELINE)."""
+        pid = req.param("pipeline")
+        if pid:
+            return None if pid == "_none" else pid
+        default = svc.settings.get("default_pipeline")
+        return default if default and default != "_none" else None
+
     # -- documents ---------------------------------------------------------
 
     def _maybe_refresh(self, svc, req):
@@ -415,6 +624,12 @@ class RestController:
         if not isinstance(source, dict):
             raise ParsingError("request body is required and must be a JSON "
                                "object")
+        pid = self._ingest_pipeline_for(req, svc)
+        if pid is not None:
+            source = self.node.ingest.process(pid, source)
+            if source is None:             # drop processor
+                return 200, {"_index": name, "_id": doc_id,
+                             "result": "noop"}
         kw = {}
         if req.param("if_seq_no") is not None:
             kw["if_seq_no"] = int(req.param("if_seq_no"))
@@ -573,7 +788,37 @@ class RestController:
         t0 = time.monotonic()
         for name, ops in ops_by_index.items():
             svc = self.node.indices.write_index_for(name)
-            results_by_index[name] = svc.bulk(ops)
+            pid = self._ingest_pipeline_for(req, svc)
+            if pid is not None:
+                cooked = []
+                dropped_at = {}
+                for i, (action, doc_id, source, kw) in enumerate(ops):
+                    # pipelines transform only index/create sources; an
+                    # update's {"doc": ...} wrapper passes through
+                    # untouched (IngestService skips updates too)
+                    if action in ("index", "create") and \
+                            source is not None:
+                        source = self.node.ingest.process(pid, source)
+                        if source is None:      # dropped
+                            dropped_at[i] = (action, doc_id)
+                            continue
+                    cooked.append((action, doc_id, source, kw))
+                results = svc.bulk(cooked)
+                # dropped docs still need a response slot (noop), keyed
+                # by their ORIGINAL action
+                merged, ri = [], 0
+                for i in range(len(ops)):
+                    if i in dropped_at:
+                        action, doc_id = dropped_at[i]
+                        merged.append({action: {
+                            "_index": name, "_id": doc_id,
+                            "result": "noop", "status": 200}})
+                    else:
+                        merged.append(results[ri])
+                        ri += 1
+                results_by_index[name] = merged
+            else:
+                results_by_index[name] = svc.bulk(ops)
             if req.param("refresh") in ("", "true", "wait_for"):
                 svc.refresh()
         items = [results_by_index[name][j] for name, j in order]

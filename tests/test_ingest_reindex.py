@@ -1,0 +1,245 @@
+"""Ingest pipelines + reindex family + field_caps/termvectors
+(VERDICT r3 missing #5/#10 tails; ref ingest/IngestService.java:560,
+modules/ingest-common, modules/reindex, action/fieldcaps)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(str(tmp_path / "node"), port=0).start()
+    yield n
+    n.stop()
+
+
+def call(node, method, path, body=None, ndjson=None):
+    url = f"http://127.0.0.1:{node.port}{path}"
+    data = None
+    headers = {}
+    if ndjson is not None:
+        data = ("\n".join(json.dumps(l) for l in ndjson) + "\n").encode()
+        headers["Content-Type"] = "application/x-ndjson"
+    elif body is not None:
+        data = json.dumps(body).encode()
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            payload = resp.read()
+            return resp.status, json.loads(payload) if payload else {}
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, json.loads(payload) if payload else {}
+
+
+def test_ingest_pipeline_crud_and_apply(node):
+    code, _ = call(node, "PUT", "/_ingest/pipeline/clean", {
+        "description": "normalize",
+        "processors": [
+            {"set": {"field": "source", "value": "pipeline"}},
+            {"lowercase": {"field": "level", "ignore_missing": True}},
+            {"rename": {"field": "msg", "target_field": "message",
+                        "ignore_missing": True}},
+            {"convert": {"field": "count", "type": "integer",
+                         "ignore_missing": True}},
+            {"split": {"field": "tags", "separator": ","}},
+            {"remove": {"field": "secret", "ignore_missing": True}},
+        ]})
+    assert code == 200
+    code, resp = call(node, "GET", "/_ingest/pipeline/clean")
+    assert code == 200 and "clean" in resp
+    call(node, "PUT", "/docs", {})
+    code, resp = call(node, "PUT", "/docs/_doc/1?pipeline=clean&refresh=true",
+                      {"level": "ERROR", "msg": "boom", "count": "7",
+                       "tags": "a,b,c", "secret": "x"})
+    assert code in (200, 201)
+    code, resp = call(node, "GET", "/docs/_doc/1")
+    src = resp["_source"]
+    assert src == {"source": "pipeline", "level": "error",
+                   "message": "boom", "count": 7, "tags": ["a", "b", "c"]}
+    # bulk with the pipeline param
+    code, resp = call(node, "POST", "/docs/_bulk?pipeline=clean", ndjson=[
+        {"index": {"_id": "2"}}, {"msg": "two", "level": "WARN",
+                                  "tags": "x,y"},
+        {"index": {"_id": "3"}}, {"msg": "three", "level": "INFO",
+                                  "tags": "z"},
+    ])
+    assert code == 200 and not resp["errors"]
+    code, resp = call(node, "GET", "/docs/_doc/2")
+    assert resp["_source"]["level"] == "warn"
+    code, _ = call(node, "DELETE", "/_ingest/pipeline/clean")
+    assert code == 200
+    code, _ = call(node, "GET", "/_ingest/pipeline/clean")
+    assert code == 404
+
+
+def test_ingest_default_pipeline_drop_and_failure(node):
+    call(node, "PUT", "/_ingest/pipeline/gate", {"processors": [
+        {"drop": {"if": "always"}}]})
+    call(node, "PUT", "/_ingest/pipeline/boomy", {"processors": [
+        {"fail": {"message": "rejected {{why}}"}}]})
+    call(node, "PUT", "/gated", {"settings": {
+        "default_pipeline": "gate"}})
+    code, resp = call(node, "PUT", "/gated/_doc/1", {"x": 1})
+    assert code == 200 and resp["result"] == "noop"
+    code, resp = call(node, "POST", "/gated/_count")
+    assert resp["count"] == 0
+    # pipeline=_none bypasses the default
+    code, resp = call(node, "PUT", "/gated/_doc/2?pipeline=_none",
+                      {"x": 2})
+    assert code in (200, 201)
+    # failure processor -> 400 with the templated message
+    call(node, "PUT", "/fdocs", {})
+    code, resp = call(node, "PUT", "/fdocs/_doc/1?pipeline=boomy",
+                      {"why": "badness"})
+    assert code == 400
+    assert "rejected badness" in json.dumps(resp)
+    # on_failure handler rescues
+    call(node, "PUT", "/_ingest/pipeline/rescue", {"processors": [
+        {"fail": {"message": "nope",
+                  "on_failure": [{"set": {"field": "rescued",
+                                          "value": True}}]}}]})
+    code, resp = call(node, "PUT", "/fdocs/_doc/2?pipeline=rescue&refresh=true",
+                      {"a": 1})
+    assert code in (200, 201)
+    code, resp = call(node, "GET", "/fdocs/_doc/2")
+    assert resp["_source"]["rescued"] is True
+    # unknown processor type rejected at PUT
+    code, _ = call(node, "PUT", "/_ingest/pipeline/bad", {"processors": [
+        {"made_up": {}}]})
+    assert code == 400
+
+
+def test_simulate(node):
+    code, resp = call(node, "POST", "/_ingest/pipeline/_simulate", {
+        "pipeline": {"processors": [
+            {"uppercase": {"field": "w"}},
+            {"date": {"field": "when", "formats": ["UNIX"]}}]},
+        "docs": [{"_source": {"w": "hey", "when": 1700000000}},
+                 {"_source": {"w": "x"}}]})
+    assert code == 200
+    d0 = resp["docs"][0]["doc"]["_source"]
+    assert d0["w"] == "HEY"
+    assert d0["@timestamp"].startswith("2023-11-14T")
+    assert "error" in resp["docs"][1]          # missing [when]
+
+
+def test_reindex_with_query_and_pipeline(node):
+    call(node, "PUT", "/src1", {})
+    for i in range(10):
+        call(node, "PUT", f"/src1/_doc/{i}",
+             {"n": i, "kind": "even" if i % 2 == 0 else "odd"})
+    call(node, "POST", "/src1/_refresh")
+    call(node, "PUT", "/_ingest/pipeline/stamp", {"processors": [
+        {"set": {"field": "copied", "value": True}}]})
+    code, resp = call(node, "POST", "/_reindex", {
+        "source": {"index": "src1",
+                   "query": {"term": {"kind": "even"}}},
+        "dest": {"index": "dst1", "pipeline": "stamp"}})
+    assert code == 200
+    assert resp["created"] == 5 and resp["total"] == 5
+    code, resp = call(node, "POST", "/dst1/_search",
+                      {"query": {"match_all": {}}, "size": 10})
+    assert resp["hits"]["total"]["value"] == 5
+    assert all(h["_source"]["copied"] for h in resp["hits"]["hits"])
+    # self-reindex rejected
+    code, _ = call(node, "POST", "/_reindex", {
+        "source": {"index": "src1"}, "dest": {"index": "src1"}})
+    assert code == 400
+
+
+def test_update_by_query_and_delete_by_query(node):
+    call(node, "PUT", "/ubq", {})
+    for i in range(8):
+        call(node, "PUT", f"/ubq/_doc/{i}", {"n": i})
+    call(node, "POST", "/ubq/_refresh")
+    call(node, "PUT", "/_ingest/pipeline/bump", {"processors": [
+        {"set": {"field": "touched", "value": "yes"}}]})
+    code, resp = call(node, "POST",
+                      "/ubq/_update_by_query?pipeline=bump",
+                      {"query": {"range": {"n": {"gte": 4}}}})
+    assert code == 200 and resp["updated"] == 4
+    code, resp = call(node, "GET", "/ubq/_doc/6")
+    assert resp["_source"]["touched"] == "yes"
+    code, resp = call(node, "GET", "/ubq/_doc/2")
+    assert "touched" not in resp["_source"]
+    code, resp = call(node, "POST", "/ubq/_delete_by_query",
+                      {"query": {"range": {"n": {"lt": 3}}}})
+    assert code == 200 and resp["deleted"] == 3
+    code, resp = call(node, "POST", "/ubq/_count")
+    assert resp["count"] == 5
+    code, _ = call(node, "POST", "/ubq/_delete_by_query", {})
+    assert code == 400
+
+
+def test_field_caps_and_termvectors(node):
+    call(node, "PUT", "/fc1", {"mappings": {"properties": {
+        "title": {"type": "text"}, "n": {"type": "long"}}}})
+    call(node, "PUT", "/fc2", {"mappings": {"properties": {
+        "title": {"type": "text"}, "n": {"type": "double"}}}})
+    code, resp = call(node, "GET", "/fc1,fc2/_field_caps?fields=title,n")
+    assert code == 200
+    assert "text" in resp["fields"]["title"]
+    assert set(resp["fields"]["n"]) == {"long", "double"}  # conflict shown
+    assert resp["fields"]["title"]["text"]["searchable"]
+    call(node, "PUT", "/fc1/_doc/1?refresh=true",
+         {"title": "hello hello world", "n": 5})
+    code, resp = call(node, "GET", "/fc1/_termvectors/1?fields=title")
+    assert code == 200 and resp["found"]
+    tv = resp["term_vectors"]["title"]["terms"]
+    assert tv["hello"]["term_freq"] == 2
+    assert tv["world"]["tokens"][0]["position"] == 2
+    code, resp = call(node, "GET", "/fc1/_termvectors/nope")
+    assert code == 404
+
+
+def test_review_fixes_ingest_round4(node):
+    """Round-4 review regressions: drop inside on_failure is a noop not a
+    500; bad regex is 400; bulk updates bypass pipelines; dropped bulk
+    ops keep their action key; routed docs delete correctly."""
+    code, _ = call(node, "PUT", "/_ingest/pipeline/dropfail", {
+        "processors": [{"convert": {"field": "n", "type": "integer",
+                                    "on_failure": [{"drop": {}}]}}]})
+    assert code == 200
+    call(node, "PUT", "/rg", {})
+    code, resp = call(node, "PUT", "/rg/_doc/1?pipeline=dropfail",
+                      {"n": "abc"})
+    assert code == 200 and resp["result"] == "noop"
+    code, _ = call(node, "PUT", "/_ingest/pipeline/badrx", {
+        "processors": [{"gsub": {"field": "f", "pattern": "[",
+                                 "replacement": ""}}]})
+    assert code == 400
+    # bulk: update action passes through a lowercasing default pipeline
+    call(node, "PUT", "/_ingest/pipeline/lower", {
+        "processors": [{"lowercase": {"field": "level"}}]})
+    call(node, "PUT", "/bup", {"settings": {"default_pipeline": "lower"}})
+    code, resp = call(node, "POST", "/bup/_bulk?refresh=true", ndjson=[
+        {"index": {"_id": "1"}}, {"level": "LOUD"},
+        {"update": {"_id": "1"}}, {"doc": {"extra": "E"}},
+    ])
+    assert code == 200 and not resp["errors"], resp
+    code, resp = call(node, "GET", "/bup/_doc/1")
+    assert resp["_source"]["level"] == "loud"     # index op transformed
+    assert resp["_source"]["extra"] == "E"        # update untouched
+    # dropped create keeps its action key
+    call(node, "PUT", "/_ingest/pipeline/dropall",
+         {"processors": [{"drop": {}}]})
+    code, resp = call(node, "POST", "/rg/_bulk?pipeline=dropall", ndjson=[
+        {"create": {"_id": "c1"}}, {"x": 1}])
+    assert "create" in resp["items"][0]
+    assert resp["items"][0]["create"]["result"] == "noop"
+    # routed doc on a 2-shard index: delete_by_query really deletes it
+    call(node, "PUT", "/routed", {"settings": {"number_of_shards": 2}})
+    call(node, "PUT", "/routed/_doc/k?routing=zzz&refresh=true", {"n": 1})
+    code, resp = call(node, "POST", "/routed/_delete_by_query",
+                      {"query": {"match_all": {}}})
+    assert resp["deleted"] == 1
+    code, resp = call(node, "POST", "/routed/_count")
+    assert resp["count"] == 0
