@@ -64,6 +64,9 @@ def path_set(doc: dict, path: str, value):
     cur[parts[-1]] = value
 
 
+_MISSING = object()
+
+
 def path_del(doc: dict, path: str) -> bool:
     parts = path.split(".")
     cur = doc
@@ -71,8 +74,10 @@ def path_del(doc: dict, path: str) -> bool:
         cur = cur.get(part)
         if not isinstance(cur, dict):
             return False
-    return cur.pop(parts[-1], None) is not None if isinstance(cur, dict) \
-        else False
+    if not isinstance(cur, dict):
+        return False
+    # sentinel: a present-but-null field still counts as deleted
+    return cur.pop(parts[-1], _MISSING) is not _MISSING
 
 
 _TEMPLATE = re.compile(r"\{\{\s*([\w.]+)\s*\}\}")
@@ -365,6 +370,15 @@ class Pipeline:
             # config in the reference's shape; entry level also accepted
             meta = {k: conf.pop(k) for k in _META_KEYS if k in conf}
             meta.update({k: entry[k] for k in _META_KEYS if k in entry})
+            if meta.get("if") is not None:
+                raise IllegalArgumentError(
+                    "processor [if] conditions (painless) are not "
+                    "supported — split into separate pipelines")
+            if meta.get("on_failure") is not None:
+                # compile handlers ONCE, validating at PUT time
+                meta["on_failure_steps"] = Pipeline(
+                    "__on_failure__",
+                    {"processors": meta["on_failure"]}).steps
             try:
                 self.steps.append((factory(conf), meta))
             except KeyError as e:
@@ -379,12 +393,11 @@ class Pipeline:
             except DropDocument:
                 return None
             except OpenSearchTpuError as e:
-                handlers = meta.get("on_failure")
+                handlers = meta.get("on_failure_steps")
                 if handlers:
                     doc.setdefault("_ingest", {})["on_failure_message"] = \
                         e.reason
-                    for h in Pipeline("__on_failure__",
-                                      {"processors": handlers}).steps:
+                    for h in handlers:
                         try:
                             h[0](doc)
                         except DropDocument:

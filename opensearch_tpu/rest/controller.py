@@ -486,6 +486,8 @@ class RestController:
                         continue
                 engine.index(doc_id, source)    # owning shard directly
                 updated += 1
+            for engine in svc.shards:
+                engine.ensure_synced()          # durable BEFORE the ack
             svc.invalidate_searcher()
             svc.refresh()
         return 200, {"took": int((time.monotonic() - t0) * 1000),
@@ -506,6 +508,8 @@ class RestController:
                 r = engine.delete(doc_id)   # owning shard directly
                 if r.result == "deleted":
                     deleted += 1
+            for engine in svc.shards:
+                engine.ensure_synced()          # durable BEFORE the ack
             svc.invalidate_searcher()
             svc.refresh()
         return 200, {"took": int((time.monotonic() - t0) * 1000),
@@ -556,7 +560,8 @@ class RestController:
                 continue
             if not hasattr(ft, "search_terms"):
                 continue
-            value = source.get(field)
+            from opensearch_tpu.ingest.service import path_get
+            value = path_get(source, field)
             if value is None:
                 continue
             analyzer = svc.mapper.analyzers.get(
@@ -791,28 +796,35 @@ class RestController:
             pid = self._ingest_pipeline_for(req, svc)
             if pid is not None:
                 cooked = []
-                dropped_at = {}
+                precooked = {}      # i -> ready response (drop/error)
                 for i, (action, doc_id, source, kw) in enumerate(ops):
                     # pipelines transform only index/create sources; an
                     # update's {"doc": ...} wrapper passes through
                     # untouched (IngestService skips updates too)
                     if action in ("index", "create") and \
                             source is not None:
-                        source = self.node.ingest.process(pid, source)
+                        try:
+                            source = self.node.ingest.process(pid,
+                                                              source)
+                        except OpenSearchTpuError as e:
+                            # per-ITEM failure: bulk never aborts
+                            precooked[i] = {action: {
+                                "_index": name, "_id": doc_id,
+                                "status": e.status,
+                                "error": {"type": e.error_type,
+                                          "reason": e.reason}}}
+                            continue
                         if source is None:      # dropped
-                            dropped_at[i] = (action, doc_id)
+                            precooked[i] = {action: {
+                                "_index": name, "_id": doc_id,
+                                "result": "noop", "status": 200}}
                             continue
                     cooked.append((action, doc_id, source, kw))
                 results = svc.bulk(cooked)
-                # dropped docs still need a response slot (noop), keyed
-                # by their ORIGINAL action
                 merged, ri = [], 0
                 for i in range(len(ops)):
-                    if i in dropped_at:
-                        action, doc_id = dropped_at[i]
-                        merged.append({action: {
-                            "_index": name, "_id": doc_id,
-                            "result": "noop", "status": 200}})
+                    if i in precooked:
+                        merged.append(precooked[i])
                     else:
                         merged.append(results[ri])
                         ri += 1

@@ -217,6 +217,19 @@ class ShardSearcher:
         needed = plan.arrays()
         k_want = from_ + size
 
+        rescore = body.get("rescore")
+        collapse = body.get("collapse")
+        if rescore and collapse:
+            raise IllegalArgumentError(
+                "cannot use [collapse] in conjunction with [rescore]")
+        if rescore is not None:
+            if sort_specs is not None:
+                raise IllegalArgumentError(
+                    "rescore is only supported on score-sorted queries")
+            # widen the first pass to the rescore window
+            spec = rescore[0] if isinstance(rescore, list) else rescore
+            k_want = max(k_want, int(spec.get("window_size", 10)))
+
         aggs_json = body.get("aggs") or body.get("aggregations")
         # with aggs, the full-scores pass runs ONCE and feeds both the
         # top-k and the aggregations (no second device execution)
@@ -225,6 +238,10 @@ class ShardSearcher:
 
         if not self.segments:
             rows, total, max_score = [], 0, None
+        elif collapse is not None:
+            rows, total, max_score = self._collapsed(
+                plan, bind, needed, k_want, sort_specs, min_score,
+                collapse, views, search_after=search_after)
         elif sort_specs is None:
             if views is not None:
                 rows, total, max_score = self._topk_from_views(views, k_want)
@@ -235,6 +252,8 @@ class ShardSearcher:
             rows, total, max_score = self._field_sorted(
                 plan, bind, needed, k_want, sort_specs, min_score, views,
                 search_after=search_after)
+        if rescore is not None and rows:
+            rows, max_score = self._rescored(rows, rescore)
         rows = rows[from_: from_ + size]
 
         aggregations = partials = None
@@ -363,6 +382,8 @@ class ShardSearcher:
                 hit["_source"] = src
             if "sort" in row:
                 hit["sort"] = row["sort"]
+            if "fields" in row:            # collapse key et al.
+                hit["fields"] = dict(row["fields"])
             if fetch_extras is not None:
                 if fetch_extras.get("highlight"):
                     hl = run_highlight(fetch_extras["highlight"], source,
@@ -540,7 +561,130 @@ class ShardSearcher:
                         "sort": [_sort_value(v) for v in row["sort"]]})
         return out, total, None
 
-    def scan_rows(self, body: Optional[dict] = None, slice_spec=None):
+    def _rescored(self, rows, rescore):
+        """Query rescorer (search/rescore/QueryRescorer): re-rank the top
+        window by combining the original score with a rescore query's
+        score for those docs; tail rows keep their order."""
+        spec = rescore[0] if isinstance(rescore, list) else rescore
+        q = spec.get("query") or {}
+        window = int(spec.get("window_size", 10))
+        rq_json = q.get("rescore_query")
+        if rq_json is None:
+            raise IllegalArgumentError(
+                "[rescore] requires [query.rescore_query]")
+        qw = float(q.get("query_weight", 1.0))
+        rw = float(q.get("rescore_query_weight", 1.0))
+        mode = str(q.get("score_mode", "total"))
+        rplan, rbind = compile_query(parse_query(rq_json), self.ctx,
+                                     scored=True)
+        rneeded = rplan.arrays()
+        # per-segment rescore scores, read only at the window's docs
+        seg_scores: dict[int, np.ndarray] = {}
+        seg_matched: dict[int, np.ndarray] = {}
+        window_rows = rows[:window]
+        segs_needed = {r["seg"] for r in window_rows}
+        for si, (seg, dseg, scores, matched) in enumerate(
+                self._run_full(rplan, rbind, rneeded, None)):
+            if si in segs_needed:
+                seg_scores[si] = np.asarray(scores)
+                seg_matched[si] = np.asarray(matched)
+        combine = {"total": lambda a, b: a + b,
+                   "multiply": lambda a, b: a * b,
+                   "avg": lambda a, b: (a + b) / 2.0,
+                   "max": max, "min": min}.get(mode)
+        if combine is None:
+            raise IllegalArgumentError(
+                f"unknown rescore score_mode [{mode}]")
+        out = []
+        for r in window_rows:
+            base = qw * (r.get("score") or 0.0)
+            if seg_matched.get(r["seg"]) is not None and \
+                    seg_matched[r["seg"]][r["local"]]:
+                rs = rw * float(seg_scores[r["seg"]][r["local"]])
+                new = combine(base, rs)
+            else:
+                new = base       # unmatched docs keep the weighted base
+            out.append({**r, "score": new})
+        out.sort(key=lambda r: (-r["score"], r["seg"], r["local"]))
+        out.extend(rows[window:])
+        max_score = out[0]["score"] if out else None
+        return out, max_score
+
+    def _collapsed(self, plan, bind, needed, k_want, sort_specs,
+                   min_score, collapse, views, search_after=None):
+        """Field collapsing (search/collapse/): one hit per distinct
+        value of the collapse field — the best-ranked in result order."""
+        field = collapse.get("field") if isinstance(collapse, dict) \
+            else None
+        if not field:
+            raise IllegalArgumentError("[collapse] requires a [field]")
+        ft = self.ctx.field_type(field)
+        if ft is None or ft.dv_kind not in ("long", "double", "ordinal"):
+            raise IllegalArgumentError(
+                f"cannot collapse on [{field}]: keyword or numeric doc "
+                "values required")
+        if sort_specs is not None:
+            ordered, total, _ = self._field_sorted(
+                plan, bind, needed, None, sort_specs, min_score, views,
+                search_after=search_after)
+        elif views is not None:
+            # an aggs pass already ran the full query: rank from it
+            # instead of a second device execution
+            ordered, total = self._rows_from_views(views)
+        else:
+            ordered, total = self.scan_rows(
+                {"query": None, "min_score": min_score}, None,
+                _precompiled=(plan, bind, needed))
+        seen: set = set()
+        out = []
+        for r in ordered:
+            seg = self.segments[r["seg"]]
+            key = self._collapse_key(seg, field, ft, r["local"])
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append({**r, "fields": {field: [key]}})
+            if len(out) >= k_want:
+                break
+        max_score = (out[0].get("score") if out and sort_specs is None
+                     else None)
+        return out, total, max_score
+
+    def _rows_from_views(self, views):
+        """All matched rows in (score desc, seg, local) order out of an
+        already-run full-scores pass."""
+        per_scores, per_ids = [], []
+        total = 0
+        for si, (seg, dseg, scores, matched) in enumerate(views):
+            m = np.asarray(matched)[: seg.n_docs]
+            s = np.asarray(scores)[: seg.n_docs]
+            idxs = np.nonzero(m)[0]
+            total += len(idxs)
+            per_scores.append(s[idxs])
+            per_ids.append((np.full(len(idxs), si, np.int32), idxs))
+        if not per_scores:
+            return [], 0
+        sc = np.concatenate(per_scores)
+        segi = np.concatenate([a for a, _l in per_ids])
+        local = np.concatenate([l for _a, l in per_ids])
+        order = np.lexsort((local, segi, -sc))
+        return [{"seg": int(segi[i]), "local": int(local[i]),
+                 "score": float(sc[i])} for i in order], total
+
+    @staticmethod
+    def _collapse_key(seg, field, ft, local):
+        ndv = seg.numeric_dv.get(field)
+        if ndv is not None and ndv.exists[local]:
+            v = ndv.minv[local]
+            return int(v) if ft.dv_kind == "long" else float(v)
+        odv = seg.ordinal_dv.get(field)
+        if odv is not None and odv.exists[local] and \
+                odv.min_ord[local] >= 0:
+            return odv.ord_terms[int(odv.min_ord[local])]
+        return None                      # missing values collapse together
+
+    def scan_rows(self, body: Optional[dict] = None, slice_spec=None,
+                  _precompiled=None):
         """Materialize EVERY matched row in result order (scroll-context
         creation; SliceBuilder partition via ``slice_spec``).  Returns
         (rows, total) where rows carry seg/local/score/sort."""
@@ -548,13 +692,16 @@ class ShardSearcher:
 
         body = body or {}
         pred = slice_filter(slice_spec)
-        q = parse_query(body.get("query"))
         sort_specs = _parse_sort(body.get("sort"))
         min_score = body.get("min_score")
-        needs_scores = sort_specs is None or min_score is not None or \
-            any(s["field"] == "_score" for s in sort_specs)
-        plan, bind = compile_query(q, self.ctx, scored=needs_scores)
-        needed = plan.arrays()
+        if _precompiled is not None:
+            plan, bind, needed = _precompiled
+        else:
+            q = parse_query(body.get("query"))
+            needs_scores = sort_specs is None or min_score is not None \
+                or any(s["field"] == "_score" for s in sort_specs)
+            plan, bind = compile_query(q, self.ctx, scored=needs_scores)
+            needed = plan.arrays()
         if not self.segments:
             return [], 0
         if sort_specs is not None:

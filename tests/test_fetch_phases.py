@@ -119,3 +119,46 @@ def test_msearch_falls_back_for_fetch_extras(searcher):
     ])
     assert "highlight" in got[0]["hits"]["hits"][0]
     assert "highlight" not in got[1]["hits"]["hits"][0]
+
+
+def test_rescore_window_rerank(searcher):
+    """Query rescorer: the window's docs re-rank by combined score."""
+    base = searcher.search({"query": {"match": {"body": "quick sun"}},
+                            "size": 5})
+    resp = searcher.search({
+        "query": {"match": {"body": "quick sun"}},
+        "rescore": {"window_size": 5, "query": {
+            "rescore_query": {"match": {"body": "dogs"}},
+            "query_weight": 0.1, "rescore_query_weight": 10.0,
+            "score_mode": "total"}},
+        "size": 5})
+    # doc1 mentions dogs -> must outrank doc0 after rescoring
+    assert resp["hits"]["hits"][0]["_id"] == "1"
+    assert base["hits"]["hits"][0]["_id"] == "0"
+    # a rescore query matching nothing leaves weighted base scores
+    resp2 = searcher.search({
+        "query": {"match": {"body": "quick sun"}},
+        "rescore": {"window_size": 5, "query": {
+            "rescore_query": {"match": {"body": "zebra"}},
+            "query_weight": 0.1, "rescore_query_weight": 10.0}},
+        "size": 5})
+    h2 = {x["_id"]: x["_score"] for x in resp2["hits"]["hits"]}
+    b = {x["_id"]: x["_score"] for x in base["hits"]["hits"]}
+    for did in h2:
+        assert h2[did] == pytest.approx(0.1 * b[did], rel=1e-5)
+
+
+def test_collapse_dedupes_by_field(searcher):
+    resp = searcher.search({"query": {"match_all": {}},
+                            "collapse": {"field": "views"}, "size": 10})
+    assert len(resp["hits"]["hits"]) == 2     # distinct views values
+    resp2 = searcher.search({"query": {"match": {"body": "quick dogs"}},
+                             "collapse": {"field": "tags"}, "size": 10})
+    # both docs tag 'animal' (doc0 also 'fast'): best-scored per group
+    seen = [h["fields"]["tags"][0] for h in resp2["hits"]["hits"]]
+    assert len(seen) == len(set(seen))
+    with pytest.raises(Exception):
+        searcher.search({"query": {"match_all": {}},
+                         "collapse": {"field": "views"},
+                         "rescore": {"query": {
+                             "rescore_query": {"match_all": {}}}}})

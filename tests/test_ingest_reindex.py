@@ -82,7 +82,7 @@ def test_ingest_pipeline_crud_and_apply(node):
 
 def test_ingest_default_pipeline_drop_and_failure(node):
     call(node, "PUT", "/_ingest/pipeline/gate", {"processors": [
-        {"drop": {"if": "always"}}]})
+        {"drop": {}}]})
     call(node, "PUT", "/_ingest/pipeline/boomy", {"processors": [
         {"fail": {"message": "rejected {{why}}"}}]})
     call(node, "PUT", "/gated", {"settings": {
@@ -243,3 +243,46 @@ def test_review_fixes_ingest_round4(node):
     assert resp["deleted"] == 1
     code, resp = call(node, "POST", "/routed/_count")
     assert resp["count"] == 0
+
+
+def test_bulk_pipeline_per_item_errors(node):
+    """A failing processor marks ITS item failed; neighbours succeed
+    (round-4 review finding: the whole bulk 400'd)."""
+    call(node, "PUT", "/_ingest/pipeline/strict", {"processors": [
+        {"convert": {"field": "n", "type": "integer"}}]})
+    call(node, "PUT", "/pbi", {})
+    code, resp = call(node, "POST", "/pbi/_bulk?pipeline=strict&refresh=true",
+                      ndjson=[
+                          {"index": {"_id": "ok"}}, {"n": "5"},
+                          {"index": {"_id": "bad"}}, {"n": "oops"},
+                          {"index": {"_id": "ok2"}}, {"n": "7"},
+                      ])
+    assert code == 200 and resp["errors"]
+    items = resp["items"]
+    assert "error" not in items[0]["index"]
+    assert items[1]["index"]["status"] == 400
+    assert "error" in items[1]["index"]
+    assert "error" not in items[2]["index"]
+    code, resp = call(node, "POST", "/pbi/_count")
+    assert resp["count"] == 2
+    # null-valued field removes cleanly
+    code, resp = call(node, "POST", "/_ingest/pipeline/_simulate", {
+        "pipeline": {"processors": [{"remove": {"field": "secret"}}]},
+        "docs": [{"_source": {"secret": None, "keep": 1}}]})
+    assert resp["docs"][0]["doc"]["_source"] == {"keep": 1}
+    # 'if' conditions rejected at PUT
+    code, _ = call(node, "PUT", "/_ingest/pipeline/cond", {"processors": [
+        {"drop": {"if": "ctx.x == 1"}}]})
+    assert code == 400
+    # bad on_failure handler rejected at PUT
+    code, _ = call(node, "PUT", "/_ingest/pipeline/badof", {"processors": [
+        {"fail": {"message": "x", "on_failure": [{"made_up": {}}]}}]})
+    assert code == 400
+    # dotted termvectors field
+    call(node, "PUT", "/tvobj", {"mappings": {"properties": {
+        "user": {"properties": {"name": {"type": "text"}}}}}})
+    call(node, "PUT", "/tvobj/_doc/1?refresh=true",
+         {"user": {"name": "alice alice"}})
+    code, resp = call(node, "GET", "/tvobj/_termvectors/1?fields=user.name")
+    assert resp["term_vectors"]["user.name"]["terms"]["alice"][
+        "term_freq"] == 2
