@@ -630,6 +630,10 @@ class ClusterNode:
                 aggs_json,
                 [resp["resp"].get("aggregation_partials") or {}
                  for resp in responses])
+        if body.get("suggest"):
+            from opensearch_tpu.search.suggest import merge_suggest
+            out["suggest"] = merge_suggest(
+                [resp["resp"].get("suggest") for resp in responses])
         return out
 
     def _h_search_shards(self, payload: dict) -> dict:

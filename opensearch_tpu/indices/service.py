@@ -328,7 +328,7 @@ class IndexService:
                 self._mesh_searcher.update_shards(shards)
             ms = self._mesh_searcher
         aggs_json = body.get("aggs") or body.get("aggregations")
-        if not aggs_json:
+        if not aggs_json and not body.get("suggest"):
             return ms.search(body)
         # device-collective top-k + host-side per-shard partial collect,
         # reduced exactly like the cross-node coordinator (the agg columns
@@ -337,7 +337,12 @@ class IndexService:
         # collect already produces totals, so running both would execute
         # the query twice for a response whose hits are discarded.
         from opensearch_tpu.search.aggs import reduce_aggs
-        collect_body = {"size": 0, "aggs": aggs_json}
+        from opensearch_tpu.search.suggest import merge_suggest
+        collect_body = {"size": 0}
+        if aggs_json:
+            collect_body["aggs"] = aggs_json
+        if body.get("suggest"):
+            collect_body["suggest"] = body["suggest"]
         for key in ("query", "min_score"):
             if body.get(key) is not None:
                 collect_body[key] = body[key]
@@ -353,8 +358,13 @@ class IndexService:
                              "max_score": None, "hits": []}}
         else:
             resp = ms.search({k: v for k, v in body.items()
-                              if k not in ("aggs", "aggregations")})
-        resp["aggregations"] = reduce_aggs(aggs_json, partials)
+                              if k not in ("aggs", "aggregations",
+                                           "suggest")})
+        if aggs_json:
+            resp["aggregations"] = reduce_aggs(aggs_json, partials)
+        if body.get("suggest"):
+            resp["suggest"] = merge_suggest(
+                [r.get("suggest") for r in shard_resps])
         return resp
 
     def msearch(self, bodies: list) -> list[dict]:

@@ -159,6 +159,10 @@ class RestController:
         r("GET", "/_index_template", self.h_get_template)
         r("GET", "/_index_template/{name}", self.h_get_template)
         r("DELETE", "/_index_template/{name}", self.h_delete_template)
+        r("GET", "/_rank_eval", self.h_rank_eval)
+        r("POST", "/_rank_eval", self.h_rank_eval)
+        r("GET", "/{index}/_rank_eval", self.h_rank_eval)
+        r("POST", "/{index}/_rank_eval", self.h_rank_eval)
         r("POST", "/_reindex", self.h_reindex)
         r("POST", "/{index}/_update_by_query", self.h_update_by_query)
         r("POST", "/{index}/_delete_by_query", self.h_delete_by_query)
@@ -414,6 +418,23 @@ class RestController:
         svc.force_merge(int(req.param("max_num_segments", 1)))
         return 200, {"_shards": {"total": svc.num_shards,
                                  "successful": svc.num_shards, "failed": 0}}
+
+    def h_rank_eval(self, req):
+        from opensearch_tpu.search.rank_eval import run_rank_eval
+
+        body = req.json({}) or {}
+        default_index = req.path_params.get("index")
+
+        def search_fn(index_expr, search_body):
+            if default_index and index_expr == "_all":
+                index_expr = default_index
+            targets = self.node.indices.resolve_with_filters(index_expr)
+            if len(targets) == 1:
+                svc, flt = targets[0]
+                return svc.search(self._apply_alias_filter(search_body,
+                                                           flt))
+            return self._multi_index_search(targets, search_body)
+        return 200, run_rank_eval(body, search_fn)
 
     # -- reindex family (scroll-read + bulk-write; modules/reindex) --------
 
@@ -1141,6 +1162,10 @@ class RestController:
             out["aggregations"] = reduce_aggs(
                 aggs_json, [r.get("aggregation_partials") or {}
                             for r in responses])
+        if body.get("suggest"):
+            from opensearch_tpu.search.suggest import merge_suggest
+            out["suggest"] = merge_suggest(
+                [r.get("suggest") for r in responses])
         return out
 
     # -- cluster settings / aliases / templates / analyze ------------------

@@ -284,6 +284,9 @@ class ShardSearcher:
             resp["aggregations"] = aggregations
         if partials is not None:
             resp["aggregation_partials"] = partials
+        if body.get("suggest"):
+            from opensearch_tpu.search.suggest import run_suggest
+            resp["suggest"] = run_suggest(body["suggest"], self.ctx)
         return resp
 
     def _hybrid_search(self, body: dict, q, t0,

@@ -1,0 +1,150 @@
+"""Rank evaluation: IR quality metrics over judged queries.
+
+Analog of ``modules/rank-eval`` (3.9k LoC): precision@k, recall@k,
+mean reciprocal rank, (n)DCG, expected reciprocal rank over a set of
+rated search requests — SURVEY flags this module as the recall@10
+verification harness for the BASELINE configs.
+"""
+
+from __future__ import annotations
+
+import math
+
+from opensearch_tpu.common.errors import ParsingError
+
+
+def _rating_of(ratings: dict, index: str, doc_id: str) -> int:
+    return ratings.get((index, doc_id), 0)
+
+
+def _metric_precision(hits, ratings, k: int, threshold: int) -> float:
+    top = hits[:k]
+    if not top:
+        return 0.0
+    rel = sum(1 for h in top
+              if _rating_of(ratings, h["_index"], h["_id"]) >= threshold)
+    return rel / len(top)
+
+
+def _metric_recall(hits, ratings, k: int, threshold: int) -> float:
+    total_rel = sum(1 for r in ratings.values() if r >= threshold)
+    if total_rel == 0:
+        return 0.0
+    top = hits[:k]
+    rel = sum(1 for h in top
+              if _rating_of(ratings, h["_index"], h["_id"]) >= threshold)
+    return rel / total_rel
+
+
+def _metric_mrr(hits, ratings, k: int, threshold: int) -> float:
+    for rank, h in enumerate(hits[:k], 1):
+        if _rating_of(ratings, h["_index"], h["_id"]) >= threshold:
+            return 1.0 / rank
+    return 0.0
+
+
+def _dcg(gains: list[float]) -> float:
+    return sum(g / math.log2(i + 2) for i, g in enumerate(gains))
+
+
+def _make_dcg(normalize: bool):
+    def metric(hits, ratings, k: int, _threshold: int) -> float:
+        gains = [(2 ** _rating_of(ratings, h["_index"], h["_id"])) - 1
+                 for h in hits[:k]]
+        if not normalize:
+            return _dcg(gains)       # raw DCG (the reference's default)
+        ideal = sorted(((2 ** r) - 1 for r in ratings.values()),
+                       reverse=True)[:k]
+        idcg = _dcg(ideal)
+        return _dcg(gains) / idcg if idcg > 0 else 0.0
+    return metric
+
+
+def _metric_err(hits, ratings, k: int, _threshold: int) -> float:
+    max_r = max((r for r in ratings.values()), default=0)
+    if max_r == 0:
+        return 0.0
+    err = 0.0
+    p_stop = 1.0
+    for rank, h in enumerate(hits[:k], 1):
+        r = _rating_of(ratings, h["_index"], h["_id"])
+        util = ((2 ** r) - 1) / (2 ** max_r)
+        err += p_stop * util / rank
+        p_stop *= (1 - util)
+    return err
+
+
+_METRICS = {
+    "precision": (_metric_precision, "precision_at_k"),
+    "recall": (_metric_recall, "recall_at_k"),
+    "mean_reciprocal_rank": (_metric_mrr, "mrr"),
+    "dcg": (None, "dcg"),        # built per request (normalize option)
+    "expected_reciprocal_rank": (_metric_err, "err"),
+}
+
+
+def run_rank_eval(body: dict, search_fn) -> dict:
+    """``search_fn(index_expr, search_body) -> search response``.
+
+    Body shape mirrors the reference's _rank_eval API: ``requests`` each
+    with id/request/ratings, one ``metric`` object.
+    """
+    requests = body.get("requests")
+    if not requests:
+        raise ParsingError("[rank_eval] requires [requests]")
+    metric_obj = body.get("metric")
+    if not isinstance(metric_obj, dict) or len(metric_obj) != 1:
+        raise ParsingError("[rank_eval] requires exactly one [metric]")
+    ((metric_name, mconf),) = metric_obj.items()
+    if metric_name not in _METRICS:
+        raise ParsingError(
+            f"unknown rank_eval metric [{metric_name}] — supported: "
+            f"{sorted(_METRICS)}")
+    mconf = mconf or {}
+    k = int(mconf.get("k", 10))
+    threshold = int(mconf.get("relevant_rating_threshold", 1))
+    if metric_name == "dcg":
+        fn = _make_dcg(bool(mconf.get("normalize", False)))
+    else:
+        fn, _label = _METRICS[metric_name]
+
+    details = {}
+    failures = {}
+    scores = []
+    for r in requests:
+        rid = r.get("id")
+        if not rid:
+            raise ParsingError("each rank_eval request needs an [id]")
+        ratings = {}
+        for rating in r.get("ratings") or []:
+            ratings[(rating["_index"], str(rating["_id"]))] = \
+                int(rating["rating"])
+        index_expr = ",".join(r.get("index") or ["_all"]) \
+            if isinstance(r.get("index"), list) else (r.get("index")
+                                                      or "_all")
+        search_body = dict(r.get("request") or {})
+        # FORCE the window: an explicit smaller size would silently
+        # deflate every metric (the reference's forcedSearchSize)
+        search_body["size"] = max(k, int(search_body.get("size", 0)))
+        try:
+            resp = search_fn(index_expr, search_body)
+        except Exception as e:       # noqa: BLE001 — per-request failure
+            failures[rid] = {"type": type(e).__name__, "reason": str(e)}
+            continue
+        hits = resp["hits"]["hits"]
+        score = fn(hits, ratings, k, threshold)
+        scores.append(score)
+        details[rid] = {
+            "metric_score": round(score, 6),
+            "unrated_docs": [
+                {"_index": h["_index"], "_id": h["_id"]}
+                for h in hits[:k]
+                if (h["_index"], h["_id"]) not in ratings],
+            "hits": [{"hit": {"_index": h["_index"], "_id": h["_id"],
+                              "_score": h.get("_score")},
+                      "rating": ratings.get((h["_index"], h["_id"]))}
+                     for h in hits[:k]],
+        }
+    quality = sum(scores) / len(scores) if scores else 0.0
+    return {"metric_score": round(quality, 6), "details": details,
+            "failures": failures}

@@ -1,0 +1,231 @@
+"""Suggesters: term (did-you-mean per token) and phrase (whole-input
+correction).
+
+Analog of ``search/suggest/`` (term, phrase suggesters; the completion
+suggester's FST is replaced by the same vocabulary scan).  Candidate
+generation walks the shard vocabulary with a banded edit-distance
+check — a host-side operation over the term dictionary, exactly where
+the reference runs its DirectSpellChecker.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from opensearch_tpu.common.errors import (IllegalArgumentError,
+                                          ParsingError)
+
+
+def _edit_distance(a: str, b: str, cap: int) -> int:
+    """Banded Levenshtein, capped at ``cap`` + 1."""
+    if abs(len(a) - len(b)) > cap:
+        return cap + 1
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i] + [0] * len(b)
+        lo, hi = max(1, i - cap), min(len(b), i + cap)
+        if lo > 1:
+            cur[lo - 1] = cap + 1
+        for j in range(lo, hi + 1):
+            cost = 0 if ca == b[j - 1] else 1
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+        for j in range(hi + 1, len(b) + 1):
+            cur[j] = cap + 1
+        prev = cur
+        if min(prev) > cap:
+            return cap + 1
+    return prev[-1]
+
+
+class Suggester:
+    def __init__(self, ctx):
+        self.ctx = ctx               # compiler.ShardContext
+
+    # -- vocabulary access -------------------------------------------------
+
+    def _vocab(self, field: str) -> dict[str, int]:
+        """term -> df across the context's segments (cached on the
+        searcher context: segments are immutable, so one scan serves
+        every suggester until the searcher is reopened)."""
+        cache = getattr(self.ctx, "_suggest_vocab", None)
+        if cache is None:
+            cache = self.ctx._suggest_vocab = {}
+        vocab = cache.get(field)
+        if vocab is not None:
+            return vocab
+        out: dict[str, int] = {}
+        for seg in self.ctx.segments:
+            pf = seg.postings.get(field)
+            if pf is None:
+                continue
+            for term, tid in pf.terms.items():
+                df = int(pf.df[tid])
+                if df > 0:
+                    out[term] = out.get(term, 0) + df
+        cache[field] = out
+        return out
+
+    def _candidates(self, term: str, vocab: dict, max_edits: int,
+                    prefix_length: int, min_len: int = 1) -> list:
+        """[(candidate, df, distance)] sorted by (distance, -df)."""
+        prefix = term[:prefix_length]
+        out = []
+        for cand, df in vocab.items():
+            if len(cand) < min_len:
+                continue
+            if prefix_length and not cand.startswith(prefix):
+                continue
+            d = _edit_distance(term, cand, max_edits)
+            if d <= max_edits:
+                out.append((cand, df, d))
+        out.sort(key=lambda t: (t[2], -t[1], t[0]))
+        return out
+
+    # -- term suggester ----------------------------------------------------
+
+    def term_suggest(self, text: str, spec: dict) -> list[dict]:
+        field = spec.get("field")
+        if not field:
+            raise ParsingError("[term] suggester requires a [field]")
+        ft = self.ctx.field_type(field)
+        if ft is None or not hasattr(ft, "search_terms"):
+            raise IllegalArgumentError(
+                f"[term] suggester field [{field}] must be a text field")
+        max_edits = int(spec.get("max_edits", 2))
+        if not (1 <= max_edits <= 2):
+            raise IllegalArgumentError("[max_edits] must be 1 or 2")
+        size = int(spec.get("size", 5))
+        prefix_length = int(spec.get("prefix_length", 1))
+        suggest_mode = spec.get("suggest_mode", "missing")
+        vocab = self._vocab(field)
+        out = []
+        import re as _re
+        for m in _re.finditer(r"\S+", str(text)):
+            token = m.group()
+            terms = ft.search_terms(token, self.ctx.mapper.analyzers)
+            analyzed = terms[0] if terms else token.lower()
+            entry = {"text": token, "offset": m.start(),
+                     "length": len(token), "options": []}
+            in_vocab = analyzed in vocab
+            if not (suggest_mode == "missing" and in_vocab):
+                for cand, df, dist in self._candidates(
+                        analyzed, vocab, max_edits, prefix_length):
+                    if cand == analyzed:
+                        continue
+                    if suggest_mode == "popular" and in_vocab and \
+                            df <= vocab[analyzed]:
+                        continue
+                    entry["options"].append({
+                        "text": cand, "freq": df,
+                        "score": round(
+                            1.0 - dist / max(len(analyzed), 1), 5)})
+                    if len(entry["options"]) >= size:
+                        break
+            out.append(entry)
+        return out
+
+    # -- phrase suggester --------------------------------------------------
+
+    def phrase_suggest(self, text: str, spec: dict) -> list[dict]:
+        """Whole-input correction: per-token best candidate joined back
+        (the reference's phrase suggester scores candidate lattices with
+        a language model; the unigram-df greedy walk is its degenerate
+        laplace-smoothed case)."""
+        field = spec.get("field")
+        if not field:
+            raise ParsingError("[phrase] suggester requires a [field]")
+        ft = self.ctx.field_type(field)
+        if ft is None or not hasattr(ft, "search_terms"):
+            raise IllegalArgumentError(
+                f"[phrase] suggester field [{field}] must be text")
+        max_errors = float(spec.get("max_errors", 1.0))
+        size = int(spec.get("size", 1))
+        vocab = self._vocab(field)
+        tokens = str(text).split()
+        budget = (int(max_errors) if max_errors >= 1
+                  else max(1, int(max_errors * len(tokens))))
+        corrected = []
+        changed = 0
+        for token in tokens:
+            terms = ft.search_terms(token, self.ctx.mapper.analyzers)
+            analyzed = terms[0] if terms else token.lower()
+            if analyzed in vocab or changed >= budget:
+                corrected.append((token, False))
+                continue
+            cands = self._candidates(analyzed, vocab, 2, 1)
+            if cands:
+                corrected.append((cands[0][0], True))
+                changed += 1
+            else:
+                corrected.append((token, False))
+        options = []
+        if changed:
+            phrase = " ".join(t for t, _c in corrected)
+            highlighted = None
+            if spec.get("highlight"):
+                pre = spec["highlight"].get("pre_tag", "<em>")
+                post = spec["highlight"].get("post_tag", "</em>")
+                highlighted = " ".join(
+                    f"{pre}{t}{post}" if c else t for t, c in corrected)
+            opt = {"text": phrase,
+                   "score": round(1.0 / (1.0 + changed), 5)}
+            if highlighted is not None:
+                opt["highlighted"] = highlighted
+            options.append(opt)
+        return [{"text": text, "offset": 0, "length": len(text),
+                 "options": options[:size]}]
+
+
+def run_suggest(suggest_json: dict, ctx) -> dict:
+    """The search body's ``suggest`` section -> response ``suggest``
+    object (SearchService's suggest phase)."""
+    s = Suggester(ctx)
+    out = {}
+    global_text = suggest_json.get("text")
+    for name, body in suggest_json.items():
+        if name == "text":
+            continue
+        if not isinstance(body, dict):
+            raise ParsingError(f"suggester [{name}] must be an object")
+        text = body.get("text", global_text)
+        if text is None:
+            raise ParsingError(f"suggester [{name}] requires [text]")
+        if "term" in body:
+            out[name] = s.term_suggest(text, body["term"])
+        elif "phrase" in body:
+            out[name] = s.phrase_suggest(text, body["phrase"])
+        else:
+            raise ParsingError(
+                f"suggester [{name}] must be [term] or [phrase]")
+    return out
+
+
+def merge_suggest(per_source: list[dict]) -> dict:
+    """Coordinator reduce of per-source suggest sections: options merge
+    by text (freqs sum, best score wins), re-sorted (the reference's
+    Suggest.reduce)."""
+    out: dict = {}
+    for section in per_source:
+        if not section:
+            continue
+        for name, entries in section.items():
+            if name not in out:
+                out[name] = [dict(e, options=list(e["options"]))
+                             for e in entries]
+                continue
+            for mine, theirs in zip(out[name], entries):
+                by_text = {o["text"]: dict(o) for o in mine["options"]}
+                for o in theirs["options"]:
+                    cur = by_text.get(o["text"])
+                    if cur is None:
+                        by_text[o["text"]] = dict(o)
+                    else:
+                        cur["freq"] = cur.get("freq", 0) + o.get("freq", 0)
+                        cur["score"] = max(cur.get("score", 0),
+                                           o.get("score", 0))
+                merged = sorted(by_text.values(),
+                                key=lambda o: (-o.get("score", 0),
+                                               -o.get("freq", 0),
+                                               o["text"]))
+                mine["options"] = merged
+    return out

@@ -1,0 +1,140 @@
+"""Suggesters (term/phrase) and rank evaluation (ref search/suggest/,
+modules/rank-eval — SURVEY's recall@10 verification harness)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = Node(str(tmp_path_factory.mktemp("node")), port=0).start()
+    call(n, "PUT", "/books", {"mappings": {"properties": {
+        "title": {"type": "text"}}}})
+    titles = ["the quick brown fox", "quickly running foxes",
+              "brown bears fishing", "quantum computing basics",
+              "fox hunting history"]
+    for i, t in enumerate(titles):
+        call(n, "PUT", f"/books/_doc/{i}", {"title": t})
+    call(n, "POST", "/books/_refresh")
+    yield n
+    n.stop()
+
+
+def call(node, method, path, body=None):
+    url = f"http://127.0.0.1:{node.port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            payload = resp.read()
+            return resp.status, json.loads(payload) if payload else {}
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, json.loads(payload) if payload else {}
+
+
+def test_term_suggester(node):
+    code, resp = call(node, "POST", "/books/_search", {
+        "size": 0,
+        "suggest": {"fix": {"text": "quik browm",
+                            "term": {"field": "title"}}}})
+    assert code == 200
+    sug = resp["suggest"]["fix"]
+    assert len(sug) == 2
+    assert sug[0]["text"] == "quik"
+    assert sug[0]["options"][0]["text"] == "quick"
+    assert sug[1]["options"][0]["text"] == "brown"
+    assert sug[0]["options"][0]["freq"] >= 1
+    # a correctly spelled term yields no options in missing mode
+    code, resp = call(node, "POST", "/books/_search", {
+        "size": 0, "suggest": {"s": {"text": "fox",
+                                     "term": {"field": "title"}}}})
+    assert resp["suggest"]["s"][0]["options"] == []
+
+
+def test_phrase_suggester_with_highlight(node):
+    code, resp = call(node, "POST", "/books/_search", {
+        "size": 0,
+        "suggest": {"fix": {"text": "quik brown fix",
+                            "phrase": {"field": "title", "max_errors": 2,
+                                       "highlight": {
+                                           "pre_tag": "<em>",
+                                           "post_tag": "</em>"}}}}})
+    opts = resp["suggest"]["fix"][0]["options"]
+    assert opts
+    assert opts[0]["text"] == "quick brown fox"
+    assert "<em>quick</em>" in opts[0]["highlighted"]
+    assert "brown" in opts[0]["highlighted"]
+    assert "<em>brown</em>" not in opts[0]["highlighted"]
+
+
+def test_suggest_errors(node):
+    code, _ = call(node, "POST", "/books/_search", {
+        "suggest": {"s": {"text": "x", "term": {}}}})
+    assert code == 400
+    code, _ = call(node, "POST", "/books/_search", {
+        "suggest": {"s": {"term": {"field": "title"}}}})
+    assert code == 400
+
+
+def test_rank_eval_metrics(node):
+    reqs = {"requests": [
+        {"id": "fox_q",
+         "request": {"query": {"match": {"title": "fox"}}},
+         "ratings": [
+             {"_index": "books", "_id": "0", "rating": 1},
+             {"_index": "books", "_id": "4", "rating": 1},
+             {"_index": "books", "_id": "3", "rating": 0}]},
+        {"id": "bears_q",
+         "request": {"query": {"match": {"title": "bears"}}},
+         "ratings": [{"_index": "books", "_id": "2", "rating": 1}]},
+    ]}
+    code, resp = call(node, "POST", "/books/_rank_eval", {
+        **reqs, "metric": {"precision": {"k": 2}}})
+    assert code == 200
+    assert resp["metric_score"] == pytest.approx(1.0)
+    assert resp["details"]["fox_q"]["metric_score"] == pytest.approx(1.0)
+    code, resp = call(node, "POST", "/books/_rank_eval", {
+        **reqs, "metric": {"recall": {"k": 10}}})
+    assert resp["metric_score"] == pytest.approx(1.0)
+    code, resp = call(node, "POST", "/books/_rank_eval", {
+        **reqs, "metric": {"mean_reciprocal_rank": {"k": 5}}})
+    assert resp["metric_score"] == pytest.approx(1.0)
+    code, resp = call(node, "POST", "/books/_rank_eval", {
+        **reqs, "metric": {"dcg": {"k": 5}}})
+    assert resp["metric_score"] > 0.0               # raw DCG (default)
+    code, resp = call(node, "POST", "/books/_rank_eval", {
+        **reqs, "metric": {"dcg": {"k": 5, "normalize": True}}})
+    assert 0.0 < resp["metric_score"] <= 1.0        # nDCG
+    # a failing request lands in failures; the rest still score
+    code, resp = call(node, "POST", "/books/_rank_eval", {
+        "requests": [
+            {"id": "good", "request": {"query": {"match": {
+                "title": "fox"}}},
+             "ratings": [{"_index": "books", "_id": "0", "rating": 1}]},
+            {"id": "broken", "request": {"query": {
+                "definitely_not": {}}}, "ratings": []}],
+        "metric": {"precision": {"k": 5}}})
+    assert code == 200
+    assert "broken" in resp["failures"]
+    assert resp["details"]["good"]["metric_score"] > 0
+    # unrated docs surface for triage
+    code, resp = call(node, "POST", "/books/_rank_eval", {
+        "requests": [{"id": "q", "request": {
+            "query": {"match": {"title": "quick"}}},
+            "ratings": []}],
+        "metric": {"precision": {"k": 5}}})
+    assert resp["details"]["q"]["unrated_docs"]
+    code, _ = call(node, "POST", "/books/_rank_eval", {
+        "requests": [], "metric": {"precision": {}}})
+    assert code == 400
+    code, _ = call(node, "POST", "/books/_rank_eval", {
+        **reqs, "metric": {"made_up": {}}})
+    assert code == 400
