@@ -634,6 +634,12 @@ class ClusterNode:
             from opensearch_tpu.search.suggest import merge_suggest
             out["suggest"] = merge_suggest(
                 [resp["resp"].get("suggest") for resp in responses])
+        if body.get("profile"):
+            shards = []
+            for resp in responses:
+                shards.extend((resp["resp"].get("profile") or {})
+                              .get("shards") or [])
+            out["profile"] = {"shards": shards}
         return out
 
     def _h_search_shards(self, payload: dict) -> dict:
@@ -647,9 +653,11 @@ class ClusterNode:
             engine = svc.engine_for(shard_id)
             segs.extend(engine.acquire_searcher().segments)
         searcher = ShardSearcher(segs, svc.mapper, index_name=svc.name)
-        return {"resp": searcher.search(
+        resp = searcher.search(
             payload.get("body") or {},
-            agg_partials=bool(payload.get("agg_partials")))}
+            agg_partials=bool(payload.get("agg_partials")))
+        svc._maybe_slowlog(payload.get("body") or {}, resp)
+        return {"resp": resp}
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -74,6 +74,29 @@ def murmur3_32(data: bytes, seed: int = 0) -> int:
 _INDEX_NAME = re.compile(r"^[a-z0-9][a-z0-9_\-.+]*$")
 
 
+def _parse_millis(v) -> int:
+    """Time expression -> ms ("500ms", "1.5s", "1m", "1d", bare
+    number=ms); -1 disables (the slow-log convention).  Unparseable
+    values log a warning once and disable rather than failing queries."""
+    if isinstance(v, (int, float)):
+        return int(v)
+    s = str(v).strip().lower()
+    for suffix, mult in (("ms", 1), ("s", 1000), ("m", 60_000),
+                         ("h", 3_600_000), ("d", 86_400_000)):
+        if s.endswith(suffix):
+            try:
+                return int(float(s[: -len(suffix)]) * mult)
+            except ValueError:
+                break
+    try:
+        return int(float(s))
+    except ValueError:
+        import logging
+        logging.getLogger("opensearch_tpu.settings").warning(
+            "unparseable time value [%s]; threshold disabled", v)
+        return -1
+
+
 def shard_id_for(doc_id: str, routing: Optional[str], num_shards: int) -> int:
     """THE routing decision — every layer (coordinator + data node) must
     agree on it, so it lives in exactly one place."""
@@ -288,7 +311,28 @@ class IndexService:
         resp["_shards"] = {"total": self.num_shards,
                            "successful": self.num_shards,
                            "skipped": 0, "failed": 0}
+        self._maybe_slowlog(body, resp)
         return resp
+
+    def _maybe_slowlog(self, body: dict, resp: dict):
+        """index.search.slowlog.threshold.query.{warn,info} (ref
+        index/SearchSlowLog.java:61): queries slower than the threshold
+        log with the source, like the reference's per-index slow log."""
+        took = resp.get("took", 0)
+        for level in ("warn", "info"):
+            raw = self.settings.get(
+                f"search.slowlog.threshold.query.{level}")
+            if raw is None:
+                continue
+            thr = _parse_millis(raw)
+            if thr >= 0 and took >= thr:
+                import logging
+                getattr(logging.getLogger(
+                    "opensearch_tpu.index.search.slowlog"), level.replace(
+                        "warn", "warning"))(
+                    "[%s] took[%dms], source[%s]", self.name, took,
+                    json.dumps(body.get("query") or {})[:256])
+                break
 
     # -- device-mesh search path (index.search.mesh: true) ----------------
 

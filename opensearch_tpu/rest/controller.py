@@ -1166,6 +1166,12 @@ class RestController:
             from opensearch_tpu.search.suggest import merge_suggest
             out["suggest"] = merge_suggest(
                 [r.get("suggest") for r in responses])
+        if body.get("profile"):
+            shards = []
+            for r in responses:
+                shards.extend((r.get("profile") or {}).get("shards")
+                              or [])
+            out["profile"] = {"shards": shards}
         return out
 
     # -- cluster settings / aliases / templates / analyze ------------------

@@ -13,6 +13,7 @@ Lucene's tie-break (score desc, then index order = (segment, local doc)).
 from __future__ import annotations
 
 import functools
+import json
 import time
 from typing import Optional
 
@@ -280,6 +281,24 @@ class ShardSearcher:
                 "hits": hits,
             },
         }
+        if body.get("profile"):
+            # phase-level breakdown (search/profile/query/QueryProfiler
+            # analog at program granularity: the device runs fused
+            # programs, so per-collector callbacks don't exist)
+            resp["profile"] = {"shards": [{
+                "id": f"[{self.index_name}][{self.shard_id}]",
+                "searches": [{"query": [{
+                    "type": type(plan).__name__,
+                    "description": json.dumps(body.get("query") or {})[:200],
+                    "time_in_nanos": int((time.monotonic() - t0) * 1e9),
+                    "children": []}],
+                    "rewrite_time": 0,
+                    "collector": [{
+                        "name": "SimpleTopDocsCollector",
+                        "reason": "search_top_hits",
+                        "time_in_nanos": int(
+                            (time.monotonic() - t0) * 1e9)}]}],
+            }]}
         if aggregations is not None:
             resp["aggregations"] = aggregations
         if partials is not None:
@@ -412,12 +431,18 @@ class ShardSearcher:
 
     # -- internals --------------------------------------------------------
 
-    def _run_full(self, plan, bind, needed, min_score):
+    def _run_full(self, plan, bind, needed, min_score,
+                  can_match_skip=False):
+        """``can_match_skip`` is ONLY safe for consumers that don't index
+        the yielded tuples by position (views/aggs paths align with
+        self.segments and must see every segment)."""
         from opensearch_tpu.common.tasks import check_current
 
         ms = jnp.asarray(np.float32(-np.inf if min_score is None else min_score))
         for seg in self.segments:
             check_current()        # cancellation point per segment program
+            if can_match_skip and not plan.can_match(bind, seg):
+                continue
             dseg = seg.device()
             A = build_arrays(dseg, needed, self.mapper,
                              live=self.ctx.live_jnp(seg, dseg))
@@ -437,11 +462,13 @@ class ShardSearcher:
         return rows, total, (None if max_score == -np.inf else float(max_score))
 
     def _topk(self, plan, bind, needed, k_want, min_score):
+        from opensearch_tpu.common.tasks import check_current
+
         if k_want == 0:            # size=0: counts only (aggs-style request)
             total = sum(int(np.asarray(m).sum()) for _s, _d, _sc, m
-                        in self._run_full(plan, bind, needed, min_score))
+                        in self._run_full(plan, bind, needed, min_score,
+                                          can_match_skip=True))
             return [], total, None
-        from opensearch_tpu.common.tasks import check_current
 
         per_seg = []
         total = 0
@@ -449,6 +476,8 @@ class ShardSearcher:
         ms = jnp.asarray(np.float32(-np.inf if min_score is None else min_score))
         for si, seg in enumerate(self.segments):
             check_current()        # cancellation point per segment program
+            if not plan.can_match(bind, seg):
+                continue           # can-match skip: no staging, no program
             dseg = seg.device()
             A = build_arrays(dseg, needed, self.mapper,
                              live=self.ctx.live_jnp(seg, dseg))

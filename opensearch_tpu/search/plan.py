@@ -71,6 +71,14 @@ class Plan:
     def arrays(self) -> frozenset:
         return frozenset()
 
+    def can_match(self, bind, seg) -> bool:
+        """Host-side pre-filter: False only when NO doc in this segment
+        can match (the CanMatchPreFilterSearchPhase analog, ref
+        action/search/CanMatchPreFilterSearchPhase.java:73) — segments
+        that can't match never dispatch a device program.  Must stay
+        conservative: returning True is always safe."""
+        return True
+
 
 @dataclass(frozen=True)
 class MatchAllPlan(Plan):
@@ -106,6 +114,14 @@ class TermBagPlan(Plan):
 
     def arrays(self):
         return frozenset({("postings", self.field)})
+
+    def can_match(self, bind, seg):
+        pf = seg.postings.get(self.field)
+        if pf is None:
+            return False
+        present = sum(1 for t in bind["terms"] if pf.term_id(t) >= 0)
+        # a doc can match at most `present` distinct query terms here
+        return present >= max(int(bind.get("required", 1)), 1)
 
     def prepare(self, bind, seg, dseg, ctx):
         terms = bind["terms"]
@@ -150,6 +166,13 @@ class PhrasePlan(Plan):
 
     def arrays(self):
         return frozenset({("postings", self.field)})
+
+    def can_match(self, bind, seg):
+        pf = seg.postings.get(self.field)
+        if pf is None:
+            return False
+        # an exact phrase needs EVERY term present
+        return all(pf.term_id(t) >= 0 for t in bind["terms"])
 
     def prepare(self, bind, seg, dseg, ctx):
         terms = bind["terms"]
@@ -233,6 +256,21 @@ class NumericRangePlan(Plan):
 
     def arrays(self):
         return frozenset({("numeric", self.field)})
+
+    def can_match(self, bind, seg):
+        dv = seg.numeric_dv.get(self.field)
+        if dv is None or not len(dv.value_docs):
+            return False
+        bounds = getattr(dv, "_value_bounds", None)
+        if bounds is None:
+            # immutable per segment: one scan serves every query
+            bounds = dv._value_bounds = (dv.values.min(), dv.values.max())
+        seg_lo, seg_hi = bounds
+        lo, hi = bind["lo"], bind["hi"]
+        if (seg_hi < lo or (seg_hi == lo and not self.include_lo)
+                or seg_lo > hi or (seg_lo == hi and not self.include_hi)):
+            return False
+        return True
 
     def prepare(self, bind, seg, dseg, ctx):
         dtype = np.int64 if self.kind == "long" else np.float64
@@ -549,6 +587,22 @@ class BoolPlan(Plan):
     def _children(self):
         return (*self.must, *self.should, *self.must_not, *self.filter)
 
+    def can_match(self, bind, seg):
+        binds = bind["children"]
+        nm, ns = len(self.must), len(self.should)
+        nn = len(self.must_not)
+        for c, b in zip(self.must, binds[:nm]):
+            if not c.can_match(b, seg):
+                return False
+        for c, b in zip(self.filter, binds[nm + ns + nn:]):
+            if not c.can_match(b, seg):
+                return False
+        if ns and not self.must and not self.filter and \
+                int(bind.get("required", 1)) >= 1:
+            return any(c.can_match(b, seg)
+                       for c, b in zip(self.should, binds[nm: nm + ns]))
+        return True
+
     def arrays(self):
         out = frozenset()
         for c in self._children():
@@ -598,6 +652,10 @@ class DisMaxPlan(Plan):
             out |= c.arrays()
         return out
 
+    def can_match(self, bind, seg):
+        return any(c.can_match(b, seg)
+                   for c, b in zip(self.children, bind["children"]))
+
     def prepare(self, bind, seg, dseg, ctx):
         cdims, cins = _prepare_children(
             self.children, bind["children"], seg, dseg, ctx)
@@ -627,6 +685,9 @@ class ConstScorePlan(Plan):
 
     def arrays(self):
         return self.child.arrays()
+
+    def can_match(self, bind, seg):
+        return self.child.can_match(bind["child"], seg)
 
     def prepare(self, bind, seg, dseg, ctx):
         cdims, cins = self.child.prepare(bind["child"], seg, dseg, ctx)
@@ -673,6 +734,9 @@ class BoostingPlan(Plan):
 
     def arrays(self):
         return self.positive.arrays() | self.negative.arrays()
+
+    def can_match(self, bind, seg):
+        return self.positive.can_match(bind["children"][0], seg)
 
     def prepare(self, bind, seg, dseg, ctx):
         cdims, cins = _prepare_children(

@@ -1,0 +1,109 @@
+"""Can-match segment skipping, search profiling, slow logs (SURVEY §5
+long-context analog + observability; ref CanMatchPreFilterSearchPhase.java:73,
+search/profile/, index/SearchSlowLog.java:61)."""
+
+import logging
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.segment import SegmentWriter
+from opensearch_tpu.mapping.mapper import DocumentMapper
+from opensearch_tpu.search.compiler import compile_query
+from opensearch_tpu.search.executor import ShardSearcher
+from opensearch_tpu.search.query_dsl import parse_query
+
+MAPPING = {"properties": {"t": {"type": "text"}, "ts": {"type": "long"}}}
+
+
+def build():
+    mapper = DocumentMapper(MAPPING)
+    writer = SegmentWriter()
+    segs = []
+    for si in range(4):
+        docs = [mapper.parse(f"{si}-{i}",
+                             {"t": f"seg{si} common word{si}_{i}",
+                              "ts": si * 1000 + i})
+                for i in range(10)]
+        segs.append(writer.build(docs, f"cm{si}"))
+    return ShardSearcher(segs, mapper), mapper
+
+
+def test_can_match_range_prunes_segments():
+    searcher, _ = build()
+    plan, bind = compile_query(parse_query(
+        {"range": {"ts": {"gte": 2000, "lt": 3000}}}), searcher.ctx,
+        scored=False)
+    matches = [plan.can_match(bind, seg) for seg in searcher.segments]
+    assert matches == [False, False, True, False]
+    # results identical to the unpruned semantics
+    resp = searcher.search({"query": {"range": {"ts": {"gte": 2000,
+                                                       "lt": 3000}}},
+                            "size": 50})
+    assert resp["hits"]["total"]["value"] == 10
+    assert all(h["_id"].startswith("2-") for h in resp["hits"]["hits"])
+
+
+def test_can_match_terms_and_phrase():
+    searcher, _ = build()
+    # a term unique to segment 1 prunes the other three
+    plan, bind = compile_query(parse_query(
+        {"match": {"t": "seg1"}}), searcher.ctx)
+    assert [plan.can_match(bind, seg)
+            for seg in searcher.segments] == [False, True, False, False]
+    # AND across terms from different segments can never match
+    plan, bind = compile_query(parse_query(
+        {"match": {"t": {"query": "seg0 seg1", "operator": "and"}}}),
+        searcher.ctx)
+    assert not any(plan.can_match(bind, seg)
+                   for seg in searcher.segments)
+    resp = searcher.search({"query": {"match": {
+        "t": {"query": "seg0 seg1", "operator": "and"}}}})
+    assert resp["hits"]["total"]["value"] == 0
+    # bool filter prunes through composition
+    plan, bind = compile_query(parse_query({"bool": {
+        "must": [{"match": {"t": "common"}}],
+        "filter": [{"range": {"ts": {"gte": 3000}}}]}}), searcher.ctx)
+    assert [plan.can_match(bind, seg)
+            for seg in searcher.segments] == [False, False, False, True]
+    # phrase needs every term
+    plan, bind = compile_query(parse_query(
+        {"match_phrase": {"t": "seg2 common"}}), searcher.ctx)
+    assert [plan.can_match(bind, seg)
+            for seg in searcher.segments] == [False, False, True, False]
+
+
+def test_profile_response_shape():
+    searcher, _ = build()
+    resp = searcher.search({"query": {"match": {"t": "common"}},
+                            "profile": True})
+    prof = resp["profile"]["shards"][0]
+    q = prof["searches"][0]["query"][0]
+    assert q["type"] == "TermBagPlan"
+    assert q["time_in_nanos"] > 0
+    assert "common" in q["description"]
+
+
+def test_search_slowlog(tmp_path, caplog):
+    from opensearch_tpu.indices.service import IndexService
+
+    svc = IndexService("slow", str(tmp_path / "slow"),
+                       {"search.slowlog.threshold.query.warn": "0ms"},
+                       {"properties": {"t": {"type": "text"}}})
+    svc.index_doc("1", {"t": "hello"})
+    svc.refresh()
+    with caplog.at_level(logging.WARNING,
+                         logger="opensearch_tpu.index.search.slowlog"):
+        svc.search({"query": {"match": {"t": "hello"}}})
+    assert any("took" in r.message or "took" in r.getMessage()
+               for r in caplog.records)
+    # disabled threshold logs nothing
+    svc2 = IndexService("fast", str(tmp_path / "fast"), {},
+                        {"properties": {"t": {"type": "text"}}})
+    svc2.index_doc("1", {"t": "hello"})
+    svc2.refresh()
+    with caplog.at_level(logging.WARNING,
+                         logger="opensearch_tpu.index.search.slowlog"):
+        n_before = len(caplog.records)
+        svc2.search({"query": {"match": {"t": "hello"}}})
+    assert len(caplog.records) == n_before
