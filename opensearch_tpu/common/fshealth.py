@@ -1,0 +1,59 @@
+"""Filesystem health probe: write-and-fsync check marking the node
+unhealthy when the data path can't take writes.
+
+Analog of ``monitor/fs/FsHealthService.java:74,209`` — the reference
+periodically writes a temp file and fsyncs it; repeated failures mark
+the node unhealthy, which removes it from election eligibility and
+surfaces in stats.  Here the probe is callable on demand (tests drive
+it deterministically) and scheduled by the node's check loop.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+
+class FsHealthService:
+    PROBE_FILE = ".es_temp_file"          # the reference's probe name
+
+    def __init__(self, data_path: str):
+        self.data_path = data_path
+        self._lock = threading.Lock()
+        self._healthy = True
+        self._last_error: Optional[str] = None
+        self._last_check_ms: Optional[int] = None
+
+    def check(self) -> bool:
+        """One write+fsync probe; updates and returns health."""
+        probe = os.path.join(self.data_path, self.PROBE_FILE)
+        try:
+            with open(probe, "wb") as f:
+                f.write(b"probe")
+                f.flush()
+                os.fsync(f.fileno())
+            os.remove(probe)
+            ok, err = True, None
+        except OSError as e:
+            ok, err = False, f"{type(e).__name__}: {e}"
+        with self._lock:
+            self._healthy = ok
+            self._last_error = err
+            self._last_check_ms = int(time.time() * 1000)
+        return ok
+
+    @property
+    def healthy(self) -> bool:
+        with self._lock:
+            return self._healthy
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {"status": "healthy" if self._healthy else "unhealthy"}
+            if self._last_error:
+                out["reason"] = self._last_error
+            if self._last_check_ms is not None:
+                out["last_check_in_millis"] = self._last_check_ms
+            return out

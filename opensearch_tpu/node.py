@@ -36,7 +36,10 @@ class Node:
         from opensearch_tpu.search.contexts import ReaderContextRegistry
         from opensearch_tpu.search.pipeline import SearchPipelineService
         from opensearch_tpu.common.tasks import TaskManager
+        from opensearch_tpu.common.fshealth import FsHealthService
         from opensearch_tpu.ingest.service import IngestService
+        self.fs_health = FsHealthService(data_path)
+        self.fs_health.check()
         self.ingest = IngestService(data_path)
         self.snapshots = SnapshotsService(self.indices, data_path)
         self.contexts = ReaderContextRegistry()

@@ -317,6 +317,8 @@ class RestController:
 
     def h_nodes_stats(self, req):
         from opensearch_tpu.common.breakers import breaker_service
+        # probe on read: stats reflect CURRENT disk health, not boot-time
+        self.node.fs_health.check()
         indices = self.node.indices.indices
         return 200, {"cluster_name": self.node.cluster_name, "nodes": {
             self.node.node_id: {
@@ -325,6 +327,7 @@ class RestController:
                     s.doc_count() for s in indices.values())}},
                 "breakers": breaker_service().stats(),
                 "tasks": {"count": len(self.node.task_manager.list())},
+                "fs": {"health": self.node.fs_health.stats()},
             }}}
 
     def h_cat_indices(self, req):

@@ -38,12 +38,32 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _handle(self):
+        from opensearch_tpu.common.breakers import (CircuitBreakingError,
+                                                    breaker_service)
+
         split = urlsplit(self.path)
         params = dict(parse_qsl(split.query, keep_blank_values=True))
         length = int(self.headers.get("Content-Length") or 0)
-        body = self.rfile.read(length) if length else b""
-        status, payload = self.server.controller.dispatch(
-            self.command, split.path, params, body)
+        # in-flight byte accounting BEFORE the body is read into memory
+        # (the reference's in_flight_requests breaker / IndexingPressure
+        # admission check)
+        breaker = breaker_service().in_flight
+        try:
+            breaker.add_estimate(length, label=f"<http_request> "
+                                               f"{split.path}")
+        except CircuitBreakingError as e:
+            # the body stays UNREAD (that's the point) — the connection
+            # cannot be reused, or the next parse reads body bytes as a
+            # request line
+            self.close_connection = True
+            status, payload = 429, e.to_xcontent()
+        else:
+            try:
+                body = self.rfile.read(length) if length else b""
+                status, payload = self.server.controller.dispatch(
+                    self.command, split.path, params, body)
+            finally:
+                breaker.release(length)
         is_cat = split.path.startswith("/_cat") and params.get("format") != "json"
         if is_cat and isinstance(payload, list):
             data = _cat_table(payload, want_header="v" in params)

@@ -222,3 +222,33 @@ def test_review_fixes_round4(node):
             "combination": {"technique": "arithmetic_mean",
                             "parameters": {"weights": [0, 0]}}}}]})
     assert code == 400
+
+
+def test_in_flight_breaker_and_fs_health(node, tmp_path):
+    """HTTP bodies charge the in_flight breaker (oversized -> 429 before
+    the body is buffered); fs health probes report in _nodes/stats."""
+    from opensearch_tpu.common.breakers import (CircuitBreakerService,
+                                                breaker_service, install)
+    from opensearch_tpu.common.fshealth import FsHealthService
+
+    code, resp = call(node, "GET", "/_nodes/stats")
+    assert resp["nodes"][node.node_id]["fs"]["health"]["status"] == \
+        "healthy"
+    tiny = CircuitBreakerService({"breaker.total.limit": 10_000,
+                                  "breaker.inflight.limit": 64})
+    prev = breaker_service()
+    install(tiny)
+    try:
+        code, resp = call(node, "PUT", "/inflight/_doc/1",
+                          {"pad": "x" * 500})
+        assert code == 429
+        assert tiny.in_flight.used == 0            # released after reject
+        code, _ = call(node, "PUT", "/inflight/_doc/1", {"p": 1})
+        assert code in (200, 201)                   # small body fine
+    finally:
+        install(prev)
+    # fs health: unhealthy path reports the failure
+    svc = FsHealthService(str(tmp_path / "nope" / "deeper"))
+    assert svc.check() is False
+    assert svc.stats()["status"] == "unhealthy"
+    assert "reason" in svc.stats()
