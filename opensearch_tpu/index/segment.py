@@ -124,6 +124,24 @@ class VectorDV:
 
 
 @dataclass
+class NestedBlock:
+    """One nested path's objects, stored OBJECT-major: columns key by
+    object id, ``obj_to_doc`` maps objects back to parents (the TPU
+    formulation of Lucene's adjacent nested documents — ref
+    index/mapper/ nested handling, join/ToParentBlockJoinQuery)."""
+
+    obj_to_doc: np.ndarray               # int32 [n_obj]
+    # child full path -> (values f64 [V], value_objs i32 [V])
+    numeric: dict[str, tuple] = dc_field(default_factory=dict)
+    # child full path -> (ord_terms list, ords i32 [V], value_objs i32)
+    ordinal: dict[str, tuple] = dc_field(default_factory=dict)
+
+    @property
+    def n_objs(self) -> int:
+        return len(self.obj_to_doc)
+
+
+@dataclass
 class GeoDV:
     offsets: np.ndarray              # int32 [n_docs+1]
     lats: np.ndarray                 # float32 [V]
@@ -148,6 +166,7 @@ class Segment:
         self.ordinal_dv: dict[str, OrdinalDV] = {}
         self.vector_dv: dict[str, VectorDV] = {}
         self.geo_dv: dict[str, GeoDV] = {}
+        self.nested: dict[str, NestedBlock] = {}
         self.live = np.ones(n_docs, dtype=bool)
         self._device: Optional["DeviceSegment"] = None
         # trained ANN structures, lazily built per (field, method) — the
@@ -318,6 +337,52 @@ class DeviceSegment:
         self._ann_staged: dict[int, tuple] = {}
         self.live = self.live_jnp(seg.live)
 
+    def nested_staged(self, path: str) -> Optional[dict]:
+        """Padded device arrays for one nested block (lazy, cached)."""
+        import jax.numpy as jnp
+
+        cache = getattr(self, "_nested_cache", None)
+        if cache is None:
+            cache = self._nested_cache = {}
+        if path in cache:
+            return cache[path]
+        block = self.seg.nested.get(path)
+        if block is None or block.n_objs == 0:
+            cache[path] = None
+            return None
+
+        def pad1(a, size, fill):
+            out = np.full(size, fill, dtype=a.dtype)
+            out[: len(a)] = a
+            return jnp.asarray(out)
+
+        n_obj_pad = pad_pow2(block.n_objs + 1)
+        staged = {
+            "n_obj_pad": n_obj_pad,
+            # padding objects belong to the parent dead slot
+            "obj_to_doc": pad1(block.obj_to_doc, n_obj_pad,
+                               self.n_pad - 1),
+            "obj_valid": pad1(np.ones(block.n_objs, bool), n_obj_pad,
+                              False),
+            "numeric": {}, "ordinal": {},
+        }
+        for f, (values, value_objs) in block.numeric.items():
+            v_pad = pad_pow2(len(values))
+            staged["numeric"][f] = {
+                "values": pad1(values, v_pad, 0.0),
+                "value_objs": pad1(value_objs, v_pad, n_obj_pad - 1),
+                "v_pad": v_pad,
+            }
+        for f, (ord_terms, ords, value_objs) in block.ordinal.items():
+            v_pad = pad_pow2(len(ords))
+            staged["ordinal"][f] = {
+                "ords": pad1(ords, v_pad, -1),
+                "value_objs": pad1(value_objs, v_pad, n_obj_pad - 1),
+                "v_pad": v_pad,
+            }
+        cache[path] = staged
+        return staged
+
     def ann_staged(self, idx) -> tuple:
         """Device-staged arrays for a trained ANN index (strong-keyed by
         the host object so a retrain restages)."""
@@ -435,7 +500,49 @@ class SegmentWriter:
                 similarity=meta.get("similarity", "l2_norm"))
         for fname, per_doc in geos.items():
             seg.geo_dv[fname] = self._build_geo(per_doc, n)
+        self._build_nested(docs, seg)
         return seg
+
+    @staticmethod
+    def _build_nested(docs: list[ParsedDocument], seg: Segment):
+        """Object-major nested blocks: objects append in doc order, child
+        columns key by object id (see NestedBlock)."""
+        paths = sorted({p for d in docs for p in d.nested})
+        for path in paths:
+            obj_to_doc: list[int] = []
+            num_cols: dict[str, tuple[list, list]] = {}
+            ord_raw: dict[str, tuple[list, list]] = {}   # terms, objs
+            for i, doc in enumerate(docs):
+                for obj in doc.nested.get(path, []):
+                    oid = len(obj_to_doc)
+                    obj_to_doc.append(i)
+                    for child, (kind, values) in obj.items():
+                        if kind == "num":
+                            vals, objs = num_cols.setdefault(child,
+                                                             ([], []))
+                        else:
+                            vals, objs = ord_raw.setdefault(child,
+                                                            ([], []))
+                        for v in values:
+                            vals.append(v)
+                            objs.append(oid)
+            if not obj_to_doc:
+                continue
+            block = NestedBlock(
+                obj_to_doc=np.asarray(obj_to_doc, np.int32))
+            for child, (vals, objs) in num_cols.items():
+                block.numeric[child] = (
+                    np.asarray(vals, np.float64),
+                    np.asarray(objs, np.int32))
+            for child, (terms, objs) in ord_raw.items():
+                ord_terms = sorted(set(terms))
+                term_to_ord = {t: o for o, t in enumerate(ord_terms)}
+                block.ordinal[child] = (
+                    ord_terms,
+                    np.asarray([term_to_ord[t] for t in terms],
+                               np.int32),
+                    np.asarray(objs, np.int32))
+            seg.nested[path] = block
 
     @staticmethod
     def _build_postings(fname, finv, n_docs, doc_lens, has_norms,

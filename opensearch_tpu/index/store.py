@@ -40,7 +40,7 @@ def _segment_encode(seg: Segment):
     meta = {"seg_id": seg.seg_id, "n_docs": seg.n_docs,
             "doc_ids": seg.doc_ids,
             "postings": {}, "numeric": {}, "ordinal": {}, "vector": {},
-            "geo": {}}
+            "geo": {}, "nested": {}}
 
     src_offsets = np.zeros(len(seg.sources) + 1, dtype=np.int64)
     for i, b in enumerate(seg.sources):
@@ -72,6 +72,19 @@ def _segment_encode(seg: Segment):
         meta["geo"][f] = {}
         for k in ("offsets", "lats", "lons", "value_docs", "exists"):
             arrays[f"g|{f}|{k}"] = getattr(dv, k)
+    for path, block in seg.nested.items():
+        meta["nested"][path] = {
+            "numeric_fields": sorted(block.numeric),
+            "ordinal_fields": sorted(block.ordinal),
+            "ord_terms": {f: block.ordinal[f][0] for f in block.ordinal},
+        }
+        arrays[f"x|{path}|obj_to_doc"] = block.obj_to_doc
+        for f, (values, value_objs) in block.numeric.items():
+            arrays[f"x|{path}|n|{f}|values"] = values
+            arrays[f"x|{path}|n|{f}|objs"] = value_objs
+        for f, (_terms, ords, value_objs) in block.ordinal.items():
+            arrays[f"x|{path}|o|{f}|ords"] = ords
+            arrays[f"x|{path}|o|{f}|objs"] = value_objs
     return arrays, meta, b"".join(seg.sources)
 
 
@@ -182,6 +195,17 @@ def _segment_decode(seg_id: str, meta: dict, z, src_blob: bytes) -> Segment:
         seg.vector_dv[f] = VectorDV(
             values=z[f"v|{f}|values"], exists=z[f"v|{f}|exists"],
             dim=m["dim"], similarity=m["similarity"])
+    for path, m in meta.get("nested", {}).items():
+        from opensearch_tpu.index.segment import NestedBlock
+        block = NestedBlock(obj_to_doc=z[f"x|{path}|obj_to_doc"])
+        for f in m["numeric_fields"]:
+            block.numeric[f] = (z[f"x|{path}|n|{f}|values"],
+                                z[f"x|{path}|n|{f}|objs"])
+        for f in m["ordinal_fields"]:
+            block.ordinal[f] = (list(m["ord_terms"][f]),
+                                z[f"x|{path}|o|{f}|ords"],
+                                z[f"x|{path}|o|{f}|objs"])
+        seg.nested[path] = block
     for f, m in meta["geo"].items():
         seg.geo_dv[f] = GeoDV(
             offsets=z[f"g|{f}|offsets"], lats=z[f"g|{f}|lats"],

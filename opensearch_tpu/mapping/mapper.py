@@ -56,6 +56,8 @@ class ParsedDocument:
     vectors: dict[str, list[float]] = dc_field(default_factory=dict)
     geo_points: dict[str, list[tuple[float, float]]] = dc_field(default_factory=dict)
     field_lengths: dict[str, int] = dc_field(default_factory=dict)  # for BM25 norms
+    # nested path -> [per-object {child_path: ("num"|"ord", [values])}]
+    nested: dict[str, list[dict]] = dc_field(default_factory=dict)
 
 
 def _dynamic_type_for(value: Any) -> Optional[dict]:
@@ -143,6 +145,23 @@ class DocumentMapper:
             if "properties" in config and "type" not in config:
                 self._merge_props(path + ".", config["properties"], fields, configs)
                 continue
+            if config.get("type") == "nested":
+                # the nested container registers AND its children do,
+                # under the full dotted path (object-major columns)
+                existing = fields.get(path)
+                ft = build_field_type(path, config)
+                if existing is not None and \
+                        existing.type_name != ft.type_name:
+                    raise MapperParsingError(
+                        f"mapper [{path}] cannot be changed from type "
+                        f"[{existing.type_name}] to [nested]")
+                fields[path] = ft
+                configs[path] = {k: v for k, v in config.items()
+                                 if k != "properties"}
+                self._merge_props(path + ".",
+                                  config.get("properties") or {},
+                                  fields, configs)
+                continue
             existing = fields.get(path)
             ft = build_field_type(path, config)
             if existing is not None and existing.type_name != ft.type_name:
@@ -186,9 +205,15 @@ class DocumentMapper:
         return doc
 
     def _parse_object(self, prefix: str, obj: dict, doc: ParsedDocument):
+        from opensearch_tpu.mapping.types import NestedFieldType
+
         for key, value in obj.items():
             path = f"{prefix}{key}"
-            if isinstance(value, dict) and self._fields.get(path) is None:
+            ft0 = self._fields.get(path)
+            if isinstance(ft0, NestedFieldType):
+                self._parse_nested(path, value, doc)
+                continue
+            if isinstance(value, dict) and ft0 is None:
                 self._parse_object(path + ".", value, doc)
                 continue
             values = value if isinstance(value, list) else [value]
@@ -212,6 +237,63 @@ class DocumentMapper:
             # multi-fields share the same raw values
             for sub_path, sub_ft in self._subfields(path):
                 self._index_values(sub_ft, values, doc)
+
+    def _parse_nested(self, path: str, value, doc: ParsedDocument):
+        """Each element of a nested array becomes ONE object record whose
+        child values stay grouped (vs the flattening object-array path
+        above — that cross-object mixing is exactly what nested
+        prevents).  Child values are stored match-ready: numeric/date/
+        boolean as numbers, keyword as terms, text as analyzed terms."""
+        if value is None:
+            return
+        objs = value if isinstance(value, list) else [value]
+        records = doc.nested.setdefault(path, [])
+        for o in objs:
+            if not isinstance(o, dict):
+                raise MapperParsingError(
+                    f"object mapping for [{path}] tried to parse field "
+                    "as object, but found a concrete value")
+            record: dict = {}
+            self._collect_nested_values(path + ".", o, record)
+            records.append(record)
+
+    def _collect_nested_values(self, prefix: str, obj: dict,
+                               record: dict):
+        for key, v in obj.items():
+            child = f"{prefix}{key}"
+            if isinstance(v, dict) and self._fields.get(child) is None:
+                self._collect_nested_values(child + ".", v, record)
+                continue
+            ft = self._fields.get(child)
+            if ft is None:
+                continue           # unmapped nested children are ignored
+            values = v if isinstance(v, list) else [v]
+            kind, out = None, []
+            for item in values:
+                if item is None:
+                    continue
+                if ft.dv_kind in ("long", "double"):
+                    dv = ft.doc_value(item)
+                    if dv is None:
+                        continue
+                    kind = "num"
+                    out.append(float(dv))
+                elif ft.dv_kind == "ordinal":
+                    dv = ft.doc_value(item)
+                    if dv is None:     # e.g. keyword past ignore_above
+                        continue
+                    kind = "ord"
+                    out.append(str(dv))
+                elif hasattr(ft, "search_terms"):      # text: terms only
+                    kind = "ord"
+                    out.extend(t for t, _p in
+                               ft.index_terms(item, self.analyzers))
+            if out:
+                prev = record.get(child)
+                if prev is not None:
+                    prev[1].extend(out)
+                else:
+                    record[child] = (kind, out)
 
     def _subfields(self, path: str):
         prefix = path + "."

@@ -394,9 +394,28 @@ class GeoPointFieldType(FieldType):
         raise MapperParsingError(f"cannot parse geo_point [{value}]")
 
 
+class NestedFieldType(FieldType):
+    """nested object container (the reference's ObjectMapper nested=true;
+    each element of the array is matched as its own unit by the nested
+    query — ref index/mapper/ + join/ToParentBlockJoinQuery).  The field
+    itself indexes nothing; its child paths carry object-major columns
+    (index/segment.py NestedBlock)."""
+
+    type_name = "nested"
+    dv_kind = "nested"
+    indexed = False
+
+    def index_terms(self, value, analyzers):
+        return []
+
+    def doc_value(self, value):
+        return None
+
+
 FIELD_TYPES = {
     cls.type_name: cls
     for cls in [
+        NestedFieldType,
         TextFieldType, KeywordFieldType, LongFieldType, IntegerFieldType,
         ShortFieldType, ByteFieldType, DoubleFieldType, FloatFieldType,
         HalfFloatFieldType, ScaledFloatFieldType, BooleanFieldType,

@@ -626,6 +626,100 @@ def _c_knn(q, ctx, scored):
     return P.ScoredMaskPlan(label="knn"), {"fn": fn}
 
 
+def _c_nested(q, ctx, scored):
+    """nested query: inner conditions compile into object-space
+    mini-plans (plan.py Obj*Plan) evaluated against the path's
+    object-major columns, scatter-OR'd back to parents.  Scoring is
+    constant (the reference's score_mode=none; avg/sum/max degrade to it
+    — inner BM25 scoring inside nested blocks is not modeled)."""
+    ft = ctx.field_type(q.path)
+    if ft is None or ft.dv_kind != "nested":
+        if q.ignore_unmapped:
+            return _none()
+        raise IllegalArgumentError(
+            f"[nested] failed to find nested object under path "
+            f"[{q.path}]")
+    inner, ibind = _compile_obj(q.query, q.path, ctx)
+    return (P.NestedPlan(path=q.path, inner=inner),
+            {"inner": ibind, "boost": q.boost})
+
+
+def _compile_obj(node, path, ctx):
+    """Inner (object-space) compiler for nested queries."""
+    prefix = path + "."
+
+    def child_ft(field):
+        if not field.startswith(prefix):
+            field = prefix + field       # accept relative child names
+        ft = ctx.field_type(field)
+        if ft is None:
+            raise IllegalArgumentError(
+                f"[nested] unknown field [{field}] under [{path}]")
+        return field, ft
+
+    if isinstance(node, dsl.MatchAllQuery) or node is None:
+        return P.ObjMatchAllPlan(), {}
+    if isinstance(node, (dsl.TermQuery, dsl.TermsQuery)):
+        raw = ([node.value] if isinstance(node, dsl.TermQuery)
+               else list(node.values))
+        field, ft = child_ft(node.field)
+        if ft.dv_kind in ("long", "double"):
+            return (P.ObjTermsPlan(field=field, kind="numeric"),
+                    {"values": [float(ft.doc_value(v)) for v in raw]})
+        return (P.ObjTermsPlan(field=field, kind="ordinal"),
+                {"values": [str(ft.term_for_query(v)) for v in raw]})
+    if isinstance(node, dsl.MatchQuery):
+        field, ft = child_ft(node.field)
+        if hasattr(ft, "search_terms"):
+            terms = ft.search_terms(str(node.query), ctx.mapper.analyzers)
+            return (P.ObjTermsPlan(field=field, kind="ordinal"),
+                    {"values": terms})
+        if ft.dv_kind in ("long", "double"):
+            return (P.ObjTermsPlan(field=field, kind="numeric"),
+                    {"values": [float(ft.doc_value(node.query))]})
+        return (P.ObjTermsPlan(field=field, kind="ordinal"),
+                {"values": [str(ft.term_for_query(node.query))]})
+    if isinstance(node, dsl.RangeQuery):
+        field, ft = child_ft(node.field)
+        if ft.dv_kind not in ("long", "double"):
+            raise IllegalArgumentError(
+                f"[nested] range over [{field}] requires a numeric/date "
+                "child field")
+        def conv(v):
+            return float(ft.doc_value(v))
+        lo = conv(node.gte) if node.gte is not None else (
+            conv(node.gt) if node.gt is not None else -np.inf)
+        hi = conv(node.lte) if node.lte is not None else (
+            conv(node.lt) if node.lt is not None else np.inf)
+        return (P.ObjRangePlan(field=field,
+                               include_lo=node.gt is None,
+                               include_hi=node.lt is None),
+                {"lo": lo, "hi": hi})
+    if isinstance(node, dsl.ExistsQuery):
+        field, _ft = child_ft(node.field)
+        return P.ObjExistsPlan(field=field), {}
+    if isinstance(node, dsl.BoolQuery):
+        groups = []
+        binds = []
+        for clause_list in (node.must + node.filter, node.should,
+                            node.must_not):
+            compiled = [_compile_obj(c, path, ctx) for c in clause_list]
+            groups.append(tuple(p for p, _b in compiled))
+            binds.extend(b for _p, b in compiled)
+        required = calc_min_should_match(
+            len(node.should),
+            node.minimum_should_match
+            if node.minimum_should_match is not None
+            else (0 if (node.must or node.filter) else 1))
+        return (P.ObjBoolPlan(must=groups[0], should=groups[1],
+                              must_not=groups[2],
+                              should_required=required >= 1),
+                {"children": tuple(binds)})
+    raise IllegalArgumentError(
+        f"[nested] inner query type [{type(node).__name__}] is not "
+        "supported — use term/terms/match/range/exists/bool")
+
+
 def _c_boosting(q, ctx, scored):
     pos_p, pos_b = compile_query(q.positive, ctx, scored)
     neg_p, neg_b = compile_query(q.negative, ctx, scored=False)
@@ -956,6 +1050,7 @@ _COMPILERS = {
     dsl.KnnQuery: _c_knn,
     dsl.ScriptScoreQuery: _c_script_score,
     dsl.BoostingQuery: _c_boosting,
+    dsl.NestedQuery: _c_nested,
     dsl.TermsSetQuery: _c_terms_set,
     dsl.DistanceFeatureQuery: _c_distance_feature,
     dsl.FunctionScoreQuery: _c_function_score,

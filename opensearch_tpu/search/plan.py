@@ -699,6 +699,173 @@ class ConstScorePlan(Plan):
         return jnp.where(matched, boost, 0.0).astype(jnp.float32), matched
 
 
+# ---------------------------------------------------------------------------
+# Nested queries: object-space mini-plans.  A nested path's objects form
+# their own padded id space; inner conditions evaluate [n_obj_pad] masks
+# which scatter-OR back to parents (ToParentBlockJoinQuery's TPU shape).
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ObjTermsPlan:
+    """term/terms membership over one nested child column.
+    bind: {"values": [...]} (raw terms for ordinal, numbers for numeric).
+    """
+
+    field: str = ""
+    kind: str = "ordinal"            # ordinal | numeric
+
+    def prepare(self, bind, block, staged):
+        col = (staged["ordinal"] if self.kind == "ordinal"
+               else staged["numeric"]).get(self.field)
+        if col is None:
+            return None
+        if self.kind == "ordinal":
+            cache = getattr(block, "_term_to_ord", None)
+            if cache is None:
+                cache = block._term_to_ord = {}
+            term_to_ord = cache.get(self.field)
+            if term_to_ord is None:
+                ord_terms, _ords, _objs = block.ordinal[self.field]
+                term_to_ord = cache[self.field] = {
+                    t: o for o, t in enumerate(ord_terms)}
+            wanted = [term_to_ord[t] for t in bind["values"]
+                      if t in term_to_ord]
+            if not wanted:
+                return None
+            q_pad = pad_pow2(len(wanted), minimum=1)
+            return (col["ords"], col["value_objs"],
+                    _pad_np(wanted, q_pad, -2, _I32))
+        wanted = [float(v) for v in bind["values"]]
+        q_pad = pad_pow2(len(wanted), minimum=1)
+        return (col["values"], col["value_objs"],
+                _pad_np(wanted, q_pad, np.nan, np.float64))
+
+    def eval(self, ins, n_obj_pad):
+        if ins is None:
+            return jnp.zeros(n_obj_pad, bool)
+        vals, objs, wanted = ins
+        hit = (vals[:, None] == wanted[None, :]).any(axis=1)
+        return jnp.zeros(n_obj_pad, bool).at[objs].max(hit)
+
+
+@dataclass(frozen=True)
+class ObjRangePlan:
+    """range over a numeric nested child.  bind: {"lo", "hi"} (floats,
+    inclusivity resolved into static flags)."""
+
+    field: str = ""
+    include_lo: bool = True
+    include_hi: bool = True
+
+    def prepare(self, bind, block, staged):
+        col = staged["numeric"].get(self.field)
+        if col is None:
+            return None
+        return (col["values"], col["value_objs"],
+                _scalar(bind["lo"], np.float64),
+                _scalar(bind["hi"], np.float64))
+
+    def eval(self, ins, n_obj_pad):
+        if ins is None:
+            return jnp.zeros(n_obj_pad, bool)
+        vals, objs, lo, hi = ins
+        above = vals >= lo if self.include_lo else vals > lo
+        below = vals <= hi if self.include_hi else vals < hi
+        return jnp.zeros(n_obj_pad, bool).at[objs].max(above & below)
+
+
+@dataclass(frozen=True)
+class ObjExistsPlan:
+    field: str = ""
+
+    def prepare(self, bind, block, staged):
+        col = (staged["numeric"].get(self.field)
+               or staged["ordinal"].get(self.field))
+        if col is None:
+            return None
+        return (col["value_objs"],)
+
+    def eval(self, ins, n_obj_pad):
+        if ins is None:
+            return jnp.zeros(n_obj_pad, bool)
+        (objs,) = ins
+        # padded entries point at the dead object slot
+        mask = jnp.zeros(n_obj_pad, bool).at[objs].max(
+            objs < n_obj_pad - 1)
+        return mask
+
+
+@dataclass(frozen=True)
+class ObjBoolPlan:
+    must: tuple = ()
+    should: tuple = ()
+    must_not: tuple = ()
+    # shoulds required only when nothing else constrains (the top-level
+    # bool's required-resolution, compiler _c_bool)
+    should_required: bool = True
+
+    def prepare(self, bind, block, staged):
+        children = (*self.must, *self.should, *self.must_not)
+        return tuple(c.prepare(b, block, staged)
+                     for c, b in zip(children, bind["children"]))
+
+    def eval(self, ins, n_obj_pad):
+        nm, ns = len(self.must), len(self.should)
+        mask = jnp.ones(n_obj_pad, bool)
+        for c, i in zip(self.must, ins[:nm]):
+            mask &= c.eval(i, n_obj_pad)
+        if ns and self.should_required:
+            any_should = jnp.zeros(n_obj_pad, bool)
+            for c, i in zip(self.should, ins[nm: nm + ns]):
+                any_should |= c.eval(i, n_obj_pad)
+            mask &= any_should
+        for c, i in zip(self.must_not, ins[nm + ns:]):
+            mask &= ~c.eval(i, n_obj_pad)
+        return mask
+
+
+@dataclass(frozen=True)
+class ObjMatchAllPlan:
+    def prepare(self, bind, block, staged):
+        return ()
+
+    def eval(self, ins, n_obj_pad):
+        return jnp.ones(n_obj_pad, bool)
+
+
+@dataclass(frozen=True)
+class NestedPlan(Plan):
+    """nested query: inner object-space condition -> parent mask.
+    bind: {"inner": inner_bind, "boost": f}."""
+
+    path: str = ""
+    inner: object = None             # Obj*Plan
+
+    def prepare(self, bind, seg, dseg, ctx):
+        block = seg.nested.get(self.path)
+        staged = dseg.nested_staged(self.path)
+        if block is None or staged is None:
+            return ("missing",), ()
+        inner_ins = self.inner.prepare(bind["inner"], block, staged)
+        return (staged["n_obj_pad"],), (
+            staged["obj_to_doc"], staged["obj_valid"], inner_ins,
+            _scalar(bind["boost"], _F32))
+
+    def eval(self, A, dims, ins):
+        n_pad = A["live"].shape[0]
+        if dims[0] == "missing":
+            return jnp.zeros(n_pad, jnp.float32), jnp.zeros(n_pad, bool)
+        n_obj_pad = dims[0]
+        obj_to_doc, obj_valid, inner_ins, boost = ins
+        obj_mask = self.inner.eval(inner_ins, n_obj_pad) & obj_valid
+        matched = jnp.zeros(n_pad, bool).at[obj_to_doc].max(obj_mask)
+        return jnp.where(matched, boost, 0.0).astype(jnp.float32), matched
+
+    def can_match(self, bind, seg):
+        return self.path in seg.nested
+
+
 def _nearest_value_dist(col, origin):
     """Distance from ``origin`` to the NEAREST of a doc's values: 0 when
     origin lies inside [min, max], else the gap to the closer bound

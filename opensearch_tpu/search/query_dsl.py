@@ -149,6 +149,14 @@ class KnnQuery(Query):
 
 
 @dataclass
+class NestedQuery(Query):
+    path: str = ""
+    query: Optional[Query] = None
+    score_mode: str = "avg"
+    ignore_unmapped: bool = False
+
+
+@dataclass
 class BoostingQuery(Query):
     positive: Optional[Query] = None
     negative: Optional[Query] = None
@@ -455,6 +463,17 @@ def parse_distance_m(v) -> float:
         return float(s)
     except ValueError:
         raise ParsingError(f"failed to parse distance [{v}]") from None
+
+
+def _parse_nested(body):
+    if not body.get("path") or body.get("query") is None:
+        raise ParsingError("[nested] requires [path] and [query]")
+    return NestedQuery(path=str(body["path"]),
+                       query=parse_query(body["query"]),
+                       score_mode=str(body.get("score_mode", "avg")),
+                       ignore_unmapped=bool(body.get("ignore_unmapped",
+                                                     False)),
+                       boost=_boost(body))
 
 
 def _parse_boosting(body):
@@ -835,6 +854,7 @@ _PARSERS = {
     "script_score": _parse_script_score,
     "hybrid": _parse_hybrid,
     "boosting": _parse_boosting,
+    "nested": _parse_nested,
     "terms_set": _parse_terms_set,
     "distance_feature": _parse_distance_feature,
     "function_score": _parse_function_score,

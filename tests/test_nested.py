@@ -1,0 +1,185 @@
+"""Nested field type + nested query: per-object matching semantics (the
+whole point — cross-object combinations must NOT match; ref
+index/mapper/ nested objects + join/ToParentBlockJoinQuery)."""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+from opensearch_tpu.index.segment import SegmentWriter
+from opensearch_tpu.mapping.mapper import DocumentMapper
+from opensearch_tpu.search.executor import ShardSearcher
+
+MAPPING = {"properties": {
+    "title": {"type": "text"},
+    "comments": {"type": "nested", "properties": {
+        "author": {"type": "keyword"},
+        "stars": {"type": "integer"},
+        "text": {"type": "text"},
+        "at": {"type": "date"},
+    }},
+}}
+
+DOCS = [
+    {"title": "post one", "comments": [
+        {"author": "alice", "stars": 5, "text": "great work",
+         "at": "2024-01-01T00:00:00Z"},
+        {"author": "bob", "stars": 1, "text": "terrible mess",
+         "at": "2024-02-01T00:00:00Z"},
+    ]},
+    {"title": "post two", "comments": [
+        {"author": "alice", "stars": 1, "text": "not my thing",
+         "at": "2024-03-01T00:00:00Z"},
+        {"author": "bob", "stars": 5, "text": "great stuff",
+         "at": "2024-04-01T00:00:00Z"},
+    ]},
+    {"title": "post three", "comments": [
+        {"author": "carol", "stars": 3, "text": "average"},
+    ]},
+    {"title": "post four no comments"},
+]
+
+
+@pytest.fixture(scope="module")
+def searcher():
+    mapper = DocumentMapper(MAPPING)
+    writer = SegmentWriter()
+    half = 2
+    segs = [writer.build([mapper.parse(str(i), d)
+                          for i, d in enumerate(DOCS[:half])], "n0"),
+            writer.build([mapper.parse(str(half + i), d)
+                          for i, d in enumerate(DOCS[half:])], "n1")]
+    return ShardSearcher(segs, mapper)
+
+
+def ids(resp):
+    return sorted(h["_id"] for h in resp["hits"]["hits"])
+
+
+def test_same_object_semantics(searcher):
+    """THE nested property: alice AND stars=5 must hold within ONE
+    comment.  Doc0 has (alice,5); doc1 has alice(1) and bob(5) — a
+    flattened index would wrongly match doc1."""
+    q = {"nested": {"path": "comments", "query": {"bool": {"must": [
+        {"term": {"comments.author": "alice"}},
+        {"term": {"comments.stars": 5}}]}}}}
+    resp = searcher.search({"query": q, "size": 10})
+    assert ids(resp) == ["0"]
+
+
+def test_nested_single_condition_and_ranges(searcher):
+    resp = searcher.search({"query": {"nested": {
+        "path": "comments",
+        "query": {"term": {"comments.author": "alice"}}}}, "size": 10})
+    assert ids(resp) == ["0", "1"]
+    resp = searcher.search({"query": {"nested": {
+        "path": "comments",
+        "query": {"range": {"comments.stars": {"gte": 4}}}}},
+        "size": 10})
+    assert ids(resp) == ["0", "1"]
+    # range + author in the same object again
+    resp = searcher.search({"query": {"nested": {
+        "path": "comments", "query": {"bool": {"must": [
+            {"term": {"comments.author": "bob"}},
+            {"range": {"comments.stars": {"lte": 2}}}]}}}},
+        "size": 10})
+    assert ids(resp) == ["0"]
+    # date range inside the object
+    resp = searcher.search({"query": {"nested": {
+        "path": "comments", "query": {"range": {"comments.at": {
+            "gte": "2024-03-15T00:00:00Z"}}}}}, "size": 10})
+    assert ids(resp) == ["1"]
+
+
+def test_nested_text_match_and_exists(searcher):
+    resp = searcher.search({"query": {"nested": {
+        "path": "comments",
+        "query": {"match": {"comments.text": "great"}}}}, "size": 10})
+    assert ids(resp) == ["0", "1"]
+    # match + author must co-occur in one object
+    resp = searcher.search({"query": {"nested": {
+        "path": "comments", "query": {"bool": {"must": [
+            {"match": {"comments.text": "great"}},
+            {"term": {"comments.author": "alice"}}]}}}}, "size": 10})
+    assert ids(resp) == ["0"]
+    resp = searcher.search({"query": {"nested": {
+        "path": "comments",
+        "query": {"exists": {"field": "comments.at"}}}}, "size": 10})
+    assert ids(resp) == ["0", "1"]          # carol's comment has no date
+
+
+def test_nested_composition_with_outer_query(searcher):
+    resp = searcher.search({"query": {"bool": {
+        "must": [{"match": {"title": "post"}}],
+        "filter": [{"nested": {"path": "comments", "query": {
+            "term": {"comments.author": "carol"}}}}]}}, "size": 10})
+    assert ids(resp) == ["2"]
+    # must_not nested: docs with NO terrible comment
+    resp = searcher.search({"query": {"bool": {
+        "must": [{"match": {"title": "post"}}],
+        "must_not": [{"nested": {"path": "comments", "query": {
+            "match": {"comments.text": "terrible"}}}}]}}, "size": 10})
+    assert ids(resp) == ["1", "2", "3"]
+
+
+def test_nested_errors_and_unmapped(searcher):
+    with pytest.raises(OpenSearchTpuError):
+        searcher.search({"query": {"nested": {
+            "path": "title", "query": {"match_all": {}}}}})
+    resp = searcher.search({"query": {"nested": {
+        "path": "nope", "ignore_unmapped": True,
+        "query": {"match_all": {}}}}, "size": 10})
+    assert resp["hits"]["total"]["value"] == 0
+    with pytest.raises(OpenSearchTpuError):
+        searcher.search({"query": {"nested": {
+            "path": "comments",
+            "query": {"wildcard": {"comments.author": "a*"}}}}})
+
+
+def test_nested_survives_persistence(tmp_path):
+    """Flush -> reopen: nested blocks round-trip through the store."""
+    from opensearch_tpu.index.engine import InternalEngine
+
+    mapper = DocumentMapper(MAPPING)
+    eng = InternalEngine(str(tmp_path / "nst"), mapper, index_name="nst")
+    for i, d in enumerate(DOCS):
+        eng.index(str(i), d)
+    eng.refresh()
+    eng.flush()
+    eng.close()
+    eng2 = InternalEngine(str(tmp_path / "nst"), mapper,
+                          index_name="nst")
+    s = eng2.acquire_searcher()
+    resp = s.search({"query": {"nested": {"path": "comments",
+                                          "query": {"bool": {"must": [
+                                              {"term": {"comments.author":
+                                                        "alice"}},
+                                              {"term": {"comments.stars":
+                                                        5}}]}}}},
+                     "size": 10})
+    assert sorted(h["_id"] for h in resp["hits"]["hits"]) == ["0"]
+
+
+def test_nested_should_optional_with_must(searcher):
+    """should beside must is OPTIONAL (round-4 review finding)."""
+    resp = searcher.search({"query": {"nested": {
+        "path": "comments", "query": {"bool": {
+            "must": [{"term": {"comments.author": "alice"}}],
+            "should": [{"term": {"comments.stars": 5}}]}}}},
+        "size": 10})
+    assert ids(resp) == ["0", "1"]          # both alice comments
+    # explicit minimum_should_match=1 makes it required again
+    resp = searcher.search({"query": {"nested": {
+        "path": "comments", "query": {"bool": {
+            "must": [{"term": {"comments.author": "alice"}}],
+            "should": [{"term": {"comments.stars": 5}}],
+            "minimum_should_match": 1}}}}, "size": 10})
+    assert ids(resp) == ["0"]
+
+
+def test_nested_date_match_parses(searcher):
+    resp = searcher.search({"query": {"nested": {
+        "path": "comments",
+        "query": {"match": {"comments.at": "2024-02-01T00:00:00Z"}}}},
+        "size": 10})
+    assert ids(resp) == ["0"]
