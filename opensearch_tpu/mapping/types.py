@@ -394,6 +394,26 @@ class GeoPointFieldType(FieldType):
         raise MapperParsingError(f"cannot parse geo_point [{value}]")
 
 
+class PercolatorFieldType(FieldType):
+    """Stores a query for reverse search (the percolator module's
+    ``percolator`` field; ref modules/percolator).  The raw query JSON
+    lives in _source; parse-time validation rejects malformed queries at
+    index time like PercolatorFieldMapper does."""
+
+    type_name = "percolator"
+    dv_kind = "none"
+    indexed = True     # produces no terms, but index-time validation runs
+
+    def index_terms(self, value, analyzers):
+        from opensearch_tpu.search.query_dsl import parse_query
+        if value is not None:
+            parse_query(value)         # validate eagerly; raises 400
+        return []
+
+    def doc_value(self, value):
+        return None
+
+
 class NestedFieldType(FieldType):
     """nested object container (the reference's ObjectMapper nested=true;
     each element of the array is matched as its own unit by the nested
@@ -415,7 +435,7 @@ class NestedFieldType(FieldType):
 FIELD_TYPES = {
     cls.type_name: cls
     for cls in [
-        NestedFieldType,
+        NestedFieldType, PercolatorFieldType,
         TextFieldType, KeywordFieldType, LongFieldType, IntegerFieldType,
         ShortFieldType, ByteFieldType, DoubleFieldType, FloatFieldType,
         HalfFloatFieldType, ScaledFloatFieldType, BooleanFieldType,

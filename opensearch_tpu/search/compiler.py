@@ -613,17 +613,71 @@ def _c_knn(q, ctx, scored):
     winners: dict[int, list[tuple[int, float]]] = {}
     for score, seg_order, local in candidates[: q.k]:
         winners.setdefault(seg_order, []).append((local, score * q.boost))
+    return _winners_plan(ctx, winners, "knn")
+
+
+def _winners_plan(ctx, winners: dict, label: str):
+    """(ScoredMaskPlan, bind) injecting host-computed per-segment winners
+    {seg_order: [(local, score)]} into the plan tree (shared by the knn
+    pre-pass and percolate)."""
     seg_order_by_id = {id(s): i for i, s in enumerate(ctx.segments)}
 
     def fn(seg, dseg):
         scores = np.zeros(dseg.n_pad, np.float32)
         mask = np.zeros(dseg.n_pad, bool)
-        for local, score in winners.get(seg_order_by_id.get(id(seg), -1), []):
+        for local, score in winners.get(
+                seg_order_by_id.get(id(seg), -1), []):
             scores[local] = score
             mask[local] = True
         return scores, mask
 
-    return P.ScoredMaskPlan(label="knn"), {"fn": fn}
+    return P.ScoredMaskPlan(label=label), {"fn": fn}
+
+
+def _c_percolate(q, ctx, scored):
+    """percolate: reverse search (modules/percolator).  Each stored query
+    (the ``percolator`` field's _source JSON) compiles and runs against a
+    tiny in-memory segment holding the candidate document(s); stored
+    queries that match ANY candidate become hits.  Matching happens at
+    compile time — the result is a ScoredMaskPlan over the query docs
+    (the same injection pattern as knn's pre-pass)."""
+    from opensearch_tpu.index.segment import SegmentWriter
+    from opensearch_tpu.search.executor import ShardSearcher
+    from opensearch_tpu.search.query_dsl import parse_query
+
+    ft = ctx.field_type(q.field)
+    if ft is None or ft.type_name != "percolator":
+        raise IllegalArgumentError(
+            f"[percolate] field [{q.field}] must be a percolator field")
+    # candidate docs in a throwaway searcher over an ISOLATED mapper
+    # clone (the percolator's MemoryIndex analog) — dynamic resolution
+    # of unmapped candidate fields must never leak into the live index
+    # mapping
+    from opensearch_tpu.mapping.mapper import DocumentMapper
+
+    tmp_mapper = DocumentMapper(ctx.mapper.to_mapping())
+    writer = SegmentWriter()
+    parsed = [tmp_mapper.parse(f"_tmp_{i}", d)
+              for i, d in enumerate(q.documents)]
+    cand = ShardSearcher([writer.build(parsed, "_percolate_tmp")],
+                         tmp_mapper)
+    winners: dict[int, list[tuple[int, float]]] = {}
+    for seg_order, seg in enumerate(ctx.segments):
+        live = ctx.lives[id(seg)]    # the searcher's PIT snapshot
+        for local in range(seg.n_docs):
+            if not live[local]:
+                continue
+            stored = seg.source(local).get(q.field)
+            if not isinstance(stored, dict):
+                continue             # absent or malformed: never matches
+            try:
+                n = cand.count(stored)
+            except IllegalArgumentError:
+                continue             # query shape our engine can't run
+            if n > 0:
+                winners.setdefault(seg_order, []).append(
+                    (local, q.boost))
+    return _winners_plan(ctx, winners, "percolate")
 
 
 def _c_nested(q, ctx, scored):
@@ -1051,6 +1105,7 @@ _COMPILERS = {
     dsl.ScriptScoreQuery: _c_script_score,
     dsl.BoostingQuery: _c_boosting,
     dsl.NestedQuery: _c_nested,
+    dsl.PercolateQuery: _c_percolate,
     dsl.TermsSetQuery: _c_terms_set,
     dsl.DistanceFeatureQuery: _c_distance_feature,
     dsl.FunctionScoreQuery: _c_function_score,

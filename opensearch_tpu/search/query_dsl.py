@@ -149,6 +149,12 @@ class KnnQuery(Query):
 
 
 @dataclass
+class PercolateQuery(Query):
+    field: str = "query"
+    documents: list = dc_field(default_factory=list)   # candidate docs
+
+
+@dataclass
 class NestedQuery(Query):
     path: str = ""
     query: Optional[Query] = None
@@ -463,6 +469,20 @@ def parse_distance_m(v) -> float:
         return float(s)
     except ValueError:
         raise ParsingError(f"failed to parse distance [{v}]") from None
+
+
+def _parse_percolate(body):
+    docs = body.get("documents")
+    if docs is None and body.get("document") is not None:
+        docs = [body["document"]]
+    if not docs:
+        raise ParsingError(
+            "[percolate] requires [document] or [documents]")
+    if not all(isinstance(d, dict) for d in docs):
+        raise ParsingError(
+            "[percolate] documents must be JSON objects")
+    return PercolateQuery(field=str(body.get("field", "query")),
+                          documents=list(docs), boost=_boost(body))
 
 
 def _parse_nested(body):
@@ -855,6 +875,7 @@ _PARSERS = {
     "hybrid": _parse_hybrid,
     "boosting": _parse_boosting,
     "nested": _parse_nested,
+    "percolate": _parse_percolate,
     "terms_set": _parse_terms_set,
     "distance_feature": _parse_distance_feature,
     "function_score": _parse_function_score,

@@ -1,0 +1,88 @@
+"""Percolator: index queries, match documents against them
+(ref modules/percolator)."""
+
+import pytest
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+from opensearch_tpu.index.segment import SegmentWriter
+from opensearch_tpu.mapping.mapper import DocumentMapper
+from opensearch_tpu.search.executor import ShardSearcher
+
+MAPPING = {"properties": {
+    "query": {"type": "percolator"},
+    "title": {"type": "text"},
+    "price": {"type": "long"},
+}}
+
+QUERIES = [
+    {"query": {"match": {"title": "laptop"}}},
+    {"query": {"bool": {"must": [{"match": {"title": "phone"}},
+                                 {"range": {"price": {"lte": 500}}}]}}},
+    {"query": {"range": {"price": {"gte": 1000}}}},
+]
+
+
+@pytest.fixture(scope="module")
+def searcher():
+    mapper = DocumentMapper(MAPPING)
+    writer = SegmentWriter()
+    seg = writer.build([mapper.parse(str(i), q)
+                        for i, q in enumerate(QUERIES)], "perc0")
+    return ShardSearcher([seg], mapper)
+
+
+def ids(resp):
+    return sorted(h["_id"] for h in resp["hits"]["hits"])
+
+
+def test_percolate_matches_stored_queries(searcher):
+    resp = searcher.search({"query": {"percolate": {
+        "field": "query",
+        "document": {"title": "new laptop stand", "price": 30}}},
+        "size": 10})
+    assert ids(resp) == ["0"]
+    resp = searcher.search({"query": {"percolate": {
+        "field": "query",
+        "document": {"title": "budget phone", "price": 199}}},
+        "size": 10})
+    assert ids(resp) == ["1"]
+    resp = searcher.search({"query": {"percolate": {
+        "field": "query",
+        "document": {"title": "luxury phone", "price": 1200}}},
+        "size": 10})
+    assert ids(resp) == ["2"]               # price>=1000, phone>500
+    # multiple candidate documents: any match counts
+    resp = searcher.search({"query": {"percolate": {
+        "field": "query",
+        "documents": [{"title": "boring desk"},
+                      {"title": "gaming laptop", "price": 2000}]}},
+        "size": 10})
+    assert ids(resp) == ["0", "2"]
+
+
+def test_percolator_field_validates_at_index_time():
+    mapper = DocumentMapper(MAPPING)
+    with pytest.raises(OpenSearchTpuError):
+        mapper.parse("bad", {"query": {"no_such_query": {}}})
+
+
+def test_percolate_errors(searcher):
+    with pytest.raises(OpenSearchTpuError):
+        searcher.search({"query": {"percolate": {
+            "field": "title", "document": {"x": 1}}}})
+    with pytest.raises(OpenSearchTpuError):
+        searcher.search({"query": {"percolate": {"field": "query"}}})
+
+
+def test_percolate_isolation_and_malformed(searcher):
+    """Review regressions: candidate docs never mutate the live mapping;
+    non-dict stored values never match; non-dict candidates are 400."""
+    before = set(searcher.mapper.field_types())
+    searcher.search({"query": {"percolate": {
+        "field": "query",
+        "document": {"brand_new_field": 42, "title": "laptop"}}},
+        "size": 10})
+    assert set(searcher.mapper.field_types()) == before
+    with pytest.raises(OpenSearchTpuError):
+        searcher.search({"query": {"percolate": {
+            "field": "query", "documents": ["nope"]}}})
