@@ -21,8 +21,12 @@ jax.config.update("jax_enable_x64", True)
 # per-segment codec state plays for reopen cost).  Harmless on CPU
 # (fast compiles, small files).
 _cache_dir = os.environ.get(
-    "OSTPU_XLA_CACHE", os.path.join(os.path.expanduser("~"),
-                                    ".cache", "opensearch_tpu_xla"))
+    "OSTPU_XLA_CACHE", os.path.join(
+        os.path.expanduser("~"), ".cache", "opensearch_tpu_xla",
+        # scope per requested platform: TPU-host and forced-CPU compiles
+        # record different machine-feature flags, and cross-loading them
+        # warns about potential SIGILL
+        (os.environ.get("JAX_PLATFORMS") or "default").replace(",", "_")))
 try:
     os.makedirs(_cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", _cache_dir)

@@ -240,12 +240,19 @@ class SettingsRegistry:
             self.register(s)
         self._settings = settings
         self._consumers: list[tuple[Setting, Callable[[Any], None]]] = []
+        self._prefixes: list[str] = []
 
     def register(self, setting: Setting):
         with self._lock:
             if setting.key in self._registered:
                 raise IllegalArgumentError(f"setting [{setting.key}] already registered")
             self._registered[setting.key] = setting
+
+    def register_prefix(self, prefix: str):
+        """Allow ANY dynamic key under ``prefix.`` (the reference's affix
+        settings, e.g. cluster.remote.<alias>.seeds)."""
+        with self._lock:
+            self._prefixes.append(prefix.rstrip(".") + ".")
 
     @property
     def settings(self) -> Settings:
@@ -270,6 +277,8 @@ class SettingsRegistry:
         for key, raw in updates.items():
             setting = self._registered.get(key)
             if setting is None:
+                if any(key.startswith(p) for p in self._prefixes):
+                    continue       # affix keys accept any value
                 raise IllegalArgumentError(
                     f"unknown setting [{key}], please check that any required plugins"
                     " are installed, or check the breaking changes documentation"

@@ -332,6 +332,11 @@ class DocumentMapper:
             return self._fields[path]
 
     def _index_values(self, ft: FieldType, values: list, doc: ParsedDocument):
+        if not getattr(ft, "allow_multiple", True) and \
+                sum(1 for v in values if v is not None) > 1:
+            raise MapperParsingError(
+                f"field [{ft.name}] of type [{ft.type_name}] does not "
+                "support arrays")
         pos_base = 0
         n_tokens = doc.field_lengths.get(ft.name, 0)
         saw_value = any(v is not None for v in values)

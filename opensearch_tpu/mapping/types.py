@@ -403,6 +403,7 @@ class PercolatorFieldType(FieldType):
     type_name = "percolator"
     dv_kind = "none"
     indexed = True     # produces no terms, but index-time validation runs
+    allow_multiple = False   # one query per doc (PercolatorFieldMapper)
 
     def index_terms(self, value, analyzers):
         from opensearch_tpu.search.query_dsl import parse_query

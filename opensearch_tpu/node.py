@@ -74,6 +74,11 @@ class Node:
             dynamic=True)
         self.cluster_settings = SettingsRegistry(
             Settings(stored), [max_buckets, auto_create, max_scroll])
+        # remote clusters configure via affix keys (RemoteClusterService)
+        self.cluster_settings.register_prefix("cluster.remote")
+        from opensearch_tpu.transport.remote import RemoteClusterService
+        self.remotes = RemoteClusterService(
+            lambda: self.cluster_settings.settings.as_dict())
         self.cluster_settings.add_settings_update_consumer(
             max_buckets, lambda v: setattr(aggs_mod, "MAX_BUCKETS", v))
         self.cluster_settings.add_settings_update_consumer(

@@ -1060,7 +1060,14 @@ class RestController:
         # PIT search: the body names a held reader; no index in the path
         if body.get("pit"):
             return 200, self._pit_search(body)
+        expr = req.path_params.get("index")
         scroll = req.param("scroll") or body.get("scroll")
+        if expr and ":" in expr:
+            if scroll:
+                raise ValidationError(
+                    "scroll is not supported with cross-cluster index "
+                    "expressions")
+            return 200, self._ccs_search(expr, body)
         if scroll:
             return 200, self._open_scroll(req, body, scroll)
         targets = self._target_indices_filtered(req)
@@ -1075,6 +1082,83 @@ class RestController:
             svc, flt = targets[0]
             return 200, svc.search(self._apply_alias_filter(body, flt))
         return 200, self._multi_index_search(targets, body)
+
+    def _ccs_search(self, expr: str, body: dict) -> dict:
+        """Cross-cluster search: 'alias:expr' parts fan out to configured
+        remotes over HTTP, local parts run here, hits merge like the
+        multi-index coordinator (TransportSearchAction's CCS split;
+        scoring is per-cluster).  Aggregations/suggest don't reduce
+        across clusters yet — rejected loudly."""
+        from opensearch_tpu.transport.remote import RemoteClusterService
+
+        if (body.get("aggs") or body.get("aggregations")
+                or body.get("suggest")):
+            raise ValidationError(
+                "cross-cluster [aggs]/[suggest] reduce is not supported "
+                "— target a single cluster")
+        local_exprs, remote_map = RemoteClusterService.split_indices(expr)
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        sub = dict(body)
+        sub["from"] = 0
+        sub["size"] = from_ + size
+        responses = []
+        # remotes fan out CONCURRENTLY (each seed attempt can block on
+        # its timeout; latency must be the slowest cluster, not the sum)
+        from concurrent.futures import ThreadPoolExecutor
+        remote_items = sorted(remote_map.items())
+        if remote_items:
+            with ThreadPoolExecutor(
+                    max_workers=min(len(remote_items), 8)) as pool:
+                futures = [(alias, rexpr, pool.submit(
+                    self.node.remotes.search, alias, rexpr, sub))
+                    for alias, rexpr in remote_items]
+                remote_resps = []
+                for alias, rexpr, fut in futures:
+                    r = fut.result()
+                    for h in r["hits"]["hits"]:
+                        h["_index"] = \
+                            f"{alias}:{h.get('_index', rexpr)}"
+                    remote_resps.append(r)
+        else:
+            remote_resps = []
+        if local_exprs:
+            targets = self.node.indices.resolve_with_filters(
+                ",".join(local_exprs))
+            responses.extend(
+                svc.search(self._apply_alias_filter(sub, flt))
+                for svc, flt in targets)
+        responses.extend(remote_resps)
+        n_clusters = len(remote_map) + (1 if local_exprs else 0)
+        out = self._merge_responses(responses, body, from_, size)
+        out["_clusters"] = {"total": n_clusters,
+                            "successful": n_clusters, "skipped": 0}
+        return out
+
+    def _merge_responses(self, responses, body, from_, size) -> dict:
+        """Shared coordinator merge (SearchPhaseController.merge analog)
+        used by the multi-index and cross-cluster paths."""
+        rows = []
+        for resp_idx, resp in enumerate(responses):
+            for pos, h in enumerate(resp["hits"]["hits"]):
+                rows.append((h, resp_idx, pos))
+        from opensearch_tpu.search.executor import merge_hit_rows
+
+        all_hits = merge_hit_rows(rows, body.get("sort"))
+        total = sum(r["hits"]["total"]["value"] for r in responses)
+        scores = [r["hits"]["max_score"] for r in responses
+                  if r["hits"]["max_score"] is not None]
+        shards = sum(r.get("_shards", {}).get("total", 1)
+                     for r in responses)
+        return {
+            "took": max((r["took"] for r in responses), default=0),
+            "timed_out": False,
+            "_shards": {"total": shards, "successful": shards,
+                        "skipped": 0, "failed": 0},
+            "hits": {"total": {"value": total, "relation": "eq"},
+                     "max_score": max(scores) if scores else None,
+                     "hits": all_hits[from_: from_ + size]},
+        }
 
     def _open_scroll(self, req, body, scroll):
         """First scroll page: pin a searcher snapshot, materialize the
@@ -1139,27 +1223,7 @@ class RestController:
         responses = [svc.search(self._apply_alias_filter(sub, flt),
                                 agg_partials=bool(aggs_json))
                      for svc, flt in services]
-        rows = []
-        for resp_idx, resp in enumerate(responses):
-            for pos, h in enumerate(resp["hits"]["hits"]):
-                rows.append((h, resp_idx, pos))
-        from opensearch_tpu.search.executor import merge_hit_rows
-
-        all_hits = merge_hit_rows(rows, body.get("sort"))
-        total = sum(r["hits"]["total"]["value"] for r in responses)
-        max_score = max((r["hits"]["max_score"] or float("-inf")
-                         for r in responses), default=None)
-        shards = sum(r["_shards"]["total"] for r in responses)
-        out = {
-            "took": max(r["took"] for r in responses),
-            "timed_out": False,
-            "_shards": {"total": shards, "successful": shards, "skipped": 0,
-                        "failed": 0},
-            "hits": {"total": {"value": total, "relation": "eq"},
-                     "max_score": (None if max_score in (None, float("-inf"))
-                                   else max_score),
-                     "hits": all_hits[from_: from_ + size]},
-        }
+        out = self._merge_responses(responses, body, from_, size)
         if aggs_json:
             from opensearch_tpu.search.aggs import reduce_aggs
             out["aggregations"] = reduce_aggs(

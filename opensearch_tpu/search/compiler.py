@@ -17,7 +17,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from opensearch_tpu.common.errors import IllegalArgumentError, ParsingError
+from opensearch_tpu.common.errors import (IllegalArgumentError,
+                                          OpenSearchTpuError, ParsingError)
 from opensearch_tpu.mapping.types import (
     DenseVectorFieldType,
     KeywordFieldType,
@@ -672,7 +673,7 @@ def _c_percolate(q, ctx, scored):
                 continue             # absent or malformed: never matches
             try:
                 n = cand.count(stored)
-            except IllegalArgumentError:
+            except OpenSearchTpuError:
                 continue             # query shape our engine can't run
             if n > 0:
                 winners.setdefault(seg_order, []).append(

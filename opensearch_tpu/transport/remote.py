@@ -1,0 +1,97 @@
+"""Remote clusters + cross-cluster search (CCS).
+
+Analog of ``transport/RemoteClusterService.java`` +
+``TransportSearchAction``'s CCS split (ref TransportSearchAction.java:
+440,525): index expressions like ``europe:logs-*`` route the sub-search
+to a configured remote cluster over its HTTP endpoint; the coordinator
+merges remote hits with local ones exactly like the multi-index merge
+(per-cluster scoring, query_then_fetch semantics).  Remotes configure
+via the affix settings ``cluster.remote.<alias>.seeds`` (a list of
+``host:port``), matching the reference's dynamic remote registry.
+
+The DCN story in SURVEY §2.3: cross-cluster traffic rides the host
+control plane (HTTP here, where the reference uses its sniff/proxy
+transport), never the device mesh.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+from opensearch_tpu.common.errors import (IllegalArgumentError,
+                                          OpenSearchTpuError)
+
+
+class RemoteClusterError(OpenSearchTpuError):
+    status = 502
+
+
+class RemoteClusterService:
+    def __init__(self, settings_fn):
+        """``settings_fn() -> dict`` returning the flat cluster settings
+        (live: reads the registry each call, so _cluster/settings
+        updates apply immediately like addSettingsUpdateConsumer)."""
+        self._settings_fn = settings_fn
+
+    def aliases(self) -> dict[str, list[str]]:
+        out: dict[str, list[str]] = {}
+        for key, value in self._settings_fn().items():
+            parts = key.split(".")
+            if (len(parts) == 4 and parts[0] == "cluster"
+                    and parts[1] == "remote" and parts[3] == "seeds"):
+                seeds = value if isinstance(value, list) else [value]
+                if seeds:
+                    out[parts[2]] = [str(s) for s in seeds]
+        return out
+
+    @staticmethod
+    def split_indices(expr: str) -> tuple[list[str], dict[str, str]]:
+        """'local1,eu:logs-*' -> (['local1'], {'eu': 'logs-*'}) — the
+        RemoteClusterAware grouping."""
+        local: list[str] = []
+        remote: dict[str, list[str]] = {}
+        for part in expr.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" in part:
+                alias, _, rest = part.partition(":")
+                remote.setdefault(alias, []).append(rest)
+            else:
+                local.append(part)
+        return local, {a: ",".join(es) for a, es in remote.items()}
+
+    def search(self, alias: str, index_expr: str, body: dict,
+               timeout: float = 30.0) -> dict:
+        seeds = self.aliases().get(alias)
+        if not seeds:
+            raise IllegalArgumentError(
+                f"no such remote cluster: [{alias}]")
+        last_err = None
+        for seed in seeds:
+            url = f"http://{seed}/{index_expr}/_search"
+            data = json.dumps(body).encode()
+            req = urllib.request.Request(
+                url, data=data, method="POST",
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                # the remote ANSWERED with an error: surface it, don't
+                # fail over (it would answer the same)
+                payload = e.read()
+                try:
+                    reason = json.loads(payload).get("error")
+                except (ValueError, AttributeError):
+                    reason = payload[:200]
+                raise RemoteClusterError(
+                    f"remote [{alias}] search failed: {reason}") from None
+            except (urllib.error.URLError, TimeoutError, OSError) as e:
+                last_err = e
+                continue             # seed unreachable: try the next
+        raise RemoteClusterError(
+            f"cannot connect to remote cluster [{alias}] "
+            f"(seeds {seeds}): {last_err}")

@@ -1,0 +1,102 @@
+"""Cross-cluster search: two live nodes, remote registered via affix
+settings, 'alias:index' expressions fan out over HTTP and merge (ref
+transport/RemoteClusterService.java, TransportSearchAction.java:440)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture()
+def two_nodes(tmp_path):
+    a = Node(str(tmp_path / "a"), name="node-a", port=0).start()
+    b = Node(str(tmp_path / "b"), name="node-b", port=0).start()
+    yield a, b
+    a.stop()
+    b.stop()
+
+
+def call(node, method, path, body=None):
+    url = f"http://127.0.0.1:{node.port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            payload = resp.read()
+            return resp.status, json.loads(payload) if payload else {}
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, json.loads(payload) if payload else {}
+
+
+def test_ccs_merges_local_and_remote(two_nodes):
+    a, b = two_nodes
+    call(a, "PUT", "/logs", {"mappings": {"properties": {
+        "m": {"type": "text"}}}})
+    call(a, "PUT", "/logs/_doc/a1", {"m": "common local event"})
+    call(a, "POST", "/_refresh")
+    call(b, "PUT", "/logs", {"mappings": {"properties": {
+        "m": {"type": "text"}}}})
+    call(b, "PUT", "/logs/_doc/b1", {"m": "common remote event"})
+    call(b, "PUT", "/logs/_doc/b2", {"m": "unrelated words"})
+    call(b, "POST", "/_refresh")
+
+    code, _ = call(a, "PUT", "/_cluster/settings", {"persistent": {
+        "cluster.remote": {"west": {
+            "seeds": [f"127.0.0.1:{b.port}"]}}}})
+    assert code == 200
+
+    code, resp = call(a, "POST", "/logs,west:logs/_search",
+                      {"query": {"match": {"m": "common"}}, "size": 10})
+    assert code == 200
+    assert resp["_clusters"]["total"] == 2
+    got = {h["_index"]: h["_id"] for h in resp["hits"]["hits"]}
+    assert got == {"logs": "a1", "west:logs": "b1"}
+    assert resp["hits"]["total"]["value"] == 2
+
+    # remote-only expression
+    code, resp = call(a, "POST", "/west:logs/_search",
+                      {"query": {"match_all": {}}, "size": 10})
+    assert resp["hits"]["total"]["value"] == 2
+    assert all(h["_index"].startswith("west:")
+               for h in resp["hits"]["hits"])
+
+    # remote index errors surface as 502-family errors, not hangs
+    code, resp = call(a, "POST", "/west:nope/_search",
+                      {"query": {"match_all": {}}})
+    assert code == 502
+    # unknown alias
+    code, resp = call(a, "POST", "/east:logs/_search",
+                      {"query": {"match_all": {}}})
+    assert code == 400
+    # aggs across clusters rejected loudly
+    code, resp = call(a, "POST", "/logs,west:logs/_search",
+                      {"size": 0, "aggs": {"x": {"terms": {
+                          "field": "m"}}}})
+    assert code == 400
+
+
+def test_ccs_unreachable_seed_fails_over_then_errors(two_nodes):
+    a, b = two_nodes
+    call(b, "PUT", "/idx", {})
+    call(b, "PUT", "/idx/_doc/1", {"x": 1})
+    call(b, "POST", "/_refresh")
+    # first seed dead, second alive -> fail over
+    call(a, "PUT", "/_cluster/settings", {"persistent": {
+        "cluster.remote": {"west": {"seeds": [
+            "127.0.0.1:1", f"127.0.0.1:{b.port}"]}}}})
+    code, resp = call(a, "POST", "/west:idx/_search",
+                      {"query": {"match_all": {}}})
+    assert code == 200 and resp["hits"]["total"]["value"] == 1
+    # all seeds dead -> 502
+    call(a, "PUT", "/_cluster/settings", {"persistent": {
+        "cluster.remote": {"gone": {"seeds": ["127.0.0.1:1"]}}}})
+    code, resp = call(a, "POST", "/gone:idx/_search",
+                      {"query": {"match_all": {}}})
+    assert code == 502

@@ -86,3 +86,10 @@ def test_percolate_isolation_and_malformed(searcher):
     with pytest.raises(OpenSearchTpuError):
         searcher.search({"query": {"percolate": {
             "field": "query", "documents": ["nope"]}}})
+
+
+def test_percolator_rejects_query_arrays():
+    mapper = DocumentMapper(MAPPING)
+    with pytest.raises(OpenSearchTpuError):
+        mapper.parse("multi", {"query": [
+            {"match": {"title": "a"}}, {"match": {"title": "b"}}]})
