@@ -113,6 +113,17 @@ class FsBlobContainer(BlobContainer):
         safe = [s for s in path.split("/") if s and s not in (".", "..")]
         return FsBlobContainer(os.path.join(self.root, *safe))
 
+    def list_children(self) -> list[str]:
+        """Names of child containers (subdirectories)."""
+        if not os.path.isdir(self.root):
+            return []
+        return sorted(n for n in os.listdir(self.root)
+                      if os.path.isdir(os.path.join(self.root, n)))
+
+    def delete_tree(self):
+        """Remove this container and everything under it."""
+        shutil.rmtree(self.root, ignore_errors=True)
+
 
 class FsBlobStore(BlobStore):
     def __init__(self, settings: dict):

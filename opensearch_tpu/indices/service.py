@@ -26,6 +26,7 @@ from opensearch_tpu.common.errors import (
     IllegalArgumentError,
     IndexAlreadyExistsError,
     IndexNotFoundError,
+    OpenSearchTpuError,
     ResourceNotFoundError,
     ValidationError,
 )
@@ -278,10 +279,50 @@ class IndexService:
             self._persist_meta(self.name, self.settings,
                                self.mapper.to_mapping())
 
+    # set by the node when a blob-repository registry exists; consulted
+    # at flush time for remote-store mirroring (RemoteStoreRefreshListener
+    # analog, at flush granularity)
+    repo_resolver = None
+
+    def _remote_repo(self):
+        rs = self.settings.get("remote_store") or {}
+        enabled = rs.get("enabled") in (True, "true")
+        repo_name = rs.get("repository")
+        if not enabled or not repo_name or self.repo_resolver is None:
+            return None
+        try:
+            return self.repo_resolver(repo_name)
+        except OpenSearchTpuError:
+            # a vanished repository must NEVER block local durability —
+            # flush proceeds, mirroring resumes when the repo returns
+            import logging
+            logging.getLogger("opensearch_tpu.remote_store").warning(
+                "[%s] remote store repository [%s] unavailable; "
+                "flushing locally only", self.name, repo_name)
+            return None
+
     def flush(self):
+        # serialized: a concurrent flush's merge-GC could delete segment
+        # files mid-upload, producing manifests that list vanished files
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self):
         self.save_meta()
-        for engine in self.shards:
-            engine.flush()
+        repo = self._remote_repo()
+        for shard_id, engine in sorted(self.local_shards.items()):
+            commit = engine.flush()
+            if repo is not None:
+                from opensearch_tpu.index.remote_store import upload_shard
+                upload_shard(repo, self.name, shard_id, engine, commit)
+        if repo is not None:
+            # index meta travels with the data: a remote restore needs
+            # settings + mappings, not just segments
+            import json as _json
+            repo.store.container(f"remote/{self.name}").write_blob(
+                "_meta.json", _json.dumps({
+                    "settings": dict(self.settings),
+                    "mappings": self.mapper.to_mapping()}).encode())
 
     def force_merge(self, max_num_segments: int = 1):
         for engine in self.shards:
@@ -490,6 +531,13 @@ class IndicesService:
             os.fsync(f.fileno())
         os.replace(tmp, self._meta_path(name))
 
+    def set_repo_resolver(self, resolver):
+        """Late-bound blob-repository lookup (the node wires it once the
+        snapshot service exists); applied to every open index."""
+        self._repo_resolver = resolver
+        for svc in self.indices.values():
+            svc.repo_resolver = resolver
+
     def _load(self):
         for name in sorted(os.listdir(self.data_path)):
             meta_path = self._meta_path(name)
@@ -522,6 +570,7 @@ class IndicesService:
         os.makedirs(path, exist_ok=True)
         svc = IndexService(name, path, settings, mappings,
                            persist_meta=self._persist_meta)
+        svc.repo_resolver = getattr(self, "_repo_resolver", None)
         self._persist_meta(name, settings, mappings or {})
         self.indices[name] = svc
         return svc
@@ -582,10 +631,27 @@ class IndicesService:
     def delete(self, name: str):
         with self._lock:
             svc = self.get(name)
+            remote_repo = None
+            try:
+                remote_repo = svc._remote_repo()
+            except Exception:      # noqa: BLE001 — best-effort cleanup
+                pass
             svc.close()
             del self.indices[name]
             shutil.rmtree(os.path.join(self.data_path, name),
                           ignore_errors=True)
+            if remote_repo is not None:
+                # the mirror dies with the index: drop its manifests and
+                # GC blobs nothing references anymore (snapshots keep
+                # theirs — the GC consults BOTH consumers)
+                from opensearch_tpu.snapshots.service import \
+                    collect_referenced_blobs
+                remote_repo.store.container(
+                    f"remote/{name}").delete_tree()
+                referenced = collect_referenced_blobs(remote_repo)
+                for blob in list(remote_repo.blobs.list_blobs()):
+                    if blob not in referenced:
+                        remote_repo.blobs.delete_blob(blob)
             # aliases pointing only at the deleted index vanish with it
             changed = False
             for alias in list(self.aliases):

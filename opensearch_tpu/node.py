@@ -42,6 +42,8 @@ class Node:
         self.fs_health.check()
         self.ingest = IngestService(data_path)
         self.snapshots = SnapshotsService(self.indices, data_path)
+        # remote-store mirroring resolves repositories late-bound
+        self.indices.set_repo_resolver(self.snapshots._repo)
         self.contexts = ReaderContextRegistry()
         self.search_pipelines = SearchPipelineService(data_path)
         self.task_manager = TaskManager(name)

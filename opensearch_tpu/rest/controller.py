@@ -9,6 +9,7 @@ calls ``dispatch(method, path, params, body)`` and gets (status, dict).
 from __future__ import annotations
 
 import json
+import os
 import re
 import time
 from typing import Callable, Optional
@@ -209,6 +210,7 @@ class RestController:
         r("GET", "/_tasks/{task_id}", self.h_task_get)
         r("POST", "/_tasks/{task_id}/_cancel", self.h_task_cancel)
         r("POST", "/_tasks/_cancel", self.h_tasks_cancel_all)
+        r("POST", "/_remotestore/_restore", self.h_remotestore_restore)
         r("GET", "/_snapshot", self.h_get_repos)
         r("PUT", "/_snapshot/{repo}", self.h_put_repo)
         r("POST", "/_snapshot/{repo}", self.h_put_repo)
@@ -1432,6 +1434,69 @@ class RestController:
     def h_delete_pipeline(self, req):
         return 200, self.node.search_pipelines.delete(
             req.path_params["id"])
+
+    def h_remotestore_restore(self, req):
+        """Restore lost indices from their remote store mirrors (the
+        remotestore restore action).  The index must not be open locally
+        — remote store is the survivor copy after total local loss."""
+        import json as _json
+
+        from opensearch_tpu.common.blobstore import NoSuchBlobError
+        from opensearch_tpu.index import remote_store as rs
+
+        body = req.json({}) or {}
+        names = body.get("indices")
+        if not names:
+            raise ValidationError(
+                "[_remotestore/_restore] requires [indices]")
+        if isinstance(names, str):
+            names = [n.strip() for n in names.split(",") if n.strip()]
+        restored = []
+        for name in names:
+            if self.node.indices.exists(name):
+                raise ValidationError(
+                    f"cannot restore [{name}]: an open index with that "
+                    "name exists — delete it first")
+            # find which repository mirrors it
+            found = None
+            for repo_name in self.node.snapshots.get_repository():
+                repo = self.node.snapshots._repo(repo_name)
+                try:
+                    meta = _json.loads(repo.store.container(
+                        f"remote/{name}").read_blob("_meta.json"))
+                except NoSuchBlobError:
+                    continue
+                found = (repo, meta)
+                break
+            if found is None:
+                raise ResourceNotFoundError(
+                    f"no remote store data for index [{name}]")
+            repo, meta = found
+            settings = dict(meta.get("settings") or {})
+            n_shards = int(settings.get("number_of_shards", 1))
+            # every shard manifest must exist BEFORE any file lands:
+            # a partial restore would leave resurrectable orphan dirs
+            missing = [sid for sid in range(n_shards)
+                       if rs.read_manifest(repo, name, sid) is None]
+            if missing:
+                raise ResourceNotFoundError(
+                    f"remote store for [{name}] is incomplete — "
+                    f"missing shard manifests {missing}")
+            index_path = os.path.join(self.node.indices.data_path, name)
+            try:
+                for shard_id in range(n_shards):
+                    rs.restore_shard(
+                        repo, name, shard_id,
+                        os.path.join(index_path, str(shard_id)))
+                self.node.indices.open_restored(name, settings,
+                                                meta.get("mappings"))
+            except Exception:
+                import shutil as _shutil
+                _shutil.rmtree(index_path, ignore_errors=True)
+                raise
+            restored.append(name)
+        return 200, {"remote_store": {"indices": restored},
+                     "acknowledged": True}
 
     # -- snapshots ---------------------------------------------------------
 

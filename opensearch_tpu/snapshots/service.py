@@ -58,6 +58,32 @@ class InvalidSnapshotNameError(ValidationError):
 _SEGMENT_SUFFIXES = (".npz", ".json", ".src", ".liv")
 
 
+def collect_referenced_blobs(repo, snapshots: Optional[list] = None) -> set:
+    """Every blob hash ANY consumer of the shared content-addressed space
+    still needs: snapshot manifests AND remote-store shard manifests.
+    The GC in delete_snapshot/remote cleanup must use this — collecting
+    from snapshots alone would destroy remote-store survivor copies."""
+    referenced: set = set()
+    if snapshots is None:
+        snapshots = repo.list_snapshots()
+    for s in snapshots:
+        m = repo.manifest(s["snapshot"])
+        for imeta in m["indices"].values():
+            for smeta in imeta["shards"].values():
+                referenced.update(f["blob"] for f in smeta["files"])
+    remote_root = repo.store.container("remote")
+    for index_name in remote_root.list_children():
+        index_c = remote_root.child(index_name)
+        for shard_name in index_c.list_children():
+            try:
+                manifest = json.loads(
+                    index_c.child(shard_name).read_blob("manifest.json"))
+            except Exception:       # noqa: BLE001 — skip torn manifests
+                continue
+            referenced.update(f["blob"] for f in manifest["files"])
+    return referenced
+
+
 class Repository:
     def __init__(self, name: str, type_: str, settings: dict):
         factory = BLOBSTORE_TYPES.get(type_)
@@ -293,13 +319,7 @@ class SnapshotsService:
                          if s["snapshot"] != snapshot]
             repo._write_index(snapshots)
             repo.snaps.delete_blob(snapshot + ".json")
-            referenced = set()
-            for s in snapshots:
-                m = repo.manifest(s["snapshot"])
-                for imeta in m["indices"].values():
-                    for smeta in imeta["shards"].values():
-                        referenced.update(f["blob"]
-                                          for f in smeta["files"])
+            referenced = collect_referenced_blobs(repo, snapshots)
             for blob in list(repo.blobs.list_blobs()):
                 if blob not in referenced:
                     repo.blobs.delete_blob(blob)
