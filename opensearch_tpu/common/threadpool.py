@@ -1,0 +1,93 @@
+"""Named, bounded thread pools with stats.
+
+Analog of the reference's ThreadPool (ref threadpool/ThreadPool.java:83;
+pool names at :99-111): work is segregated by concern so a flood of one
+kind (bulk writes) can't starve another (searches), and every pool
+reports active/queue/completed counts through ``_nodes/stats``.  Sizes
+derive from the host core count like the reference's defaults.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+
+
+class RejectedExecutionError(OpenSearchTpuError):
+    status = 429
+
+
+class _Pool:
+    def __init__(self, name: str, size: int, queue_cap: int):
+        self.name = name
+        self.size = size
+        self.queue_cap = queue_cap
+        self._executor = ThreadPoolExecutor(
+            max_workers=size, thread_name_prefix=f"opensearch[{name}]")
+        self._lock = threading.Lock()
+        self.active = 0
+        self.queued = 0
+        self.completed = 0
+        self.rejected = 0
+
+    def submit(self, fn, *args, **kwargs):
+        with self._lock:
+            if self.queued >= self.queue_cap:
+                self.rejected += 1
+                raise RejectedExecutionError(
+                    f"rejected execution on [{self.name}]: queue "
+                    f"capacity [{self.queue_cap}] reached")
+            self.queued += 1
+
+        def run():
+            with self._lock:
+                self.queued -= 1
+                self.active += 1
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                with self._lock:
+                    self.active -= 1
+                    self.completed += 1
+        return self._executor.submit(run)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"threads": self.size, "queue": self.queued,
+                    "active": self.active, "completed": self.completed,
+                    "rejected": self.rejected}
+
+    def shutdown(self):
+        self._executor.shutdown(wait=False, cancel_futures=True)
+
+
+class ThreadPool:
+    """The node's pool registry (names mirror ThreadPool.Names)."""
+
+    def __init__(self, cores: Optional[int] = None):
+        n = cores or os.cpu_count() or 4
+        self.pools: dict[str, _Pool] = {
+            "search": _Pool("search", max(2, (3 * n) // 2), 1000),
+            "write": _Pool("write", n, 10_000),
+            "get": _Pool("get", n, 1000),
+            "generic": _Pool("generic", max(4, n), 1000),
+            "snapshot": _Pool("snapshot", max(1, n // 2), 200),
+            "management": _Pool("management", max(1, n // 4), 100),
+        }
+
+    def executor(self, name: str) -> _Pool:
+        pool = self.pools.get(name)
+        if pool is None:
+            raise OpenSearchTpuError(f"no thread pool named [{name}]")
+        return pool
+
+    def stats(self) -> dict:
+        return {name: p.stats() for name, p in self.pools.items()}
+
+    def shutdown(self):
+        for p in self.pools.values():
+            p.shutdown()

@@ -34,25 +34,25 @@ def shard_container(repo, index_name: str, shard_id) -> object:
     return repo.store.container(f"remote/{index_name}/{shard_id}")
 
 
-def upload_shard(repo, index_name: str, shard_id, engine,
-                 commit: dict) -> dict:
-    """Mirror one shard's commit point into the repository.  Called
-    after ``engine.flush()`` with its commit dict; incremental by
-    content hash (unchanged segments upload nothing)."""
-    seg_dir = os.path.join(engine.data_path, "segments")
+def upload_segment_files(repo, seg_dir: str, segments: list,
+                         strict: bool = True):
+    """Content-addressed upload of a commit's segment files into the
+    repository's shared blob space (used by BOTH remote store and
+    snapshots — one dedup loop, one file-set definition).
+
+    Returns (files, uploaded, reused).  ``strict`` raises when a core
+    file vanished mid-iteration (a manifest listing missing files would
+    make a restore unopenable); .liv is legitimately optional."""
     files = []
     uploaded = reused = 0
-    for seg_id in commit["segments"]:
+    for seg_id in segments:
         for suffix in _SEGMENT_SUFFIXES:
             path = os.path.join(seg_dir, seg_id + suffix)
             if not os.path.exists(path):
-                if suffix != ".liv":
-                    # a committed segment's core files MUST exist —
-                    # writing a manifest that lists vanished files would
-                    # make the restored index unopenable
+                if suffix != ".liv" and strict:
                     raise RemoteStoreError(
                         f"segment file [{seg_id}{suffix}] vanished "
-                        "during remote upload — manifest not written")
+                        "during upload — manifest not written")
                 continue
             with open(path, "rb") as f:
                 data = f.read()
@@ -64,6 +64,17 @@ def upload_shard(repo, index_name: str, shard_id, engine,
                 uploaded += 1
             files.append({"name": seg_id + suffix, "blob": digest,
                           "size": len(data)})
+    return files, uploaded, reused
+
+
+def upload_shard(repo, index_name: str, shard_id, engine,
+                 commit: dict) -> dict:
+    """Mirror one shard's commit point into the repository.  Called
+    after ``engine.flush()`` with its commit dict; incremental by
+    content hash (unchanged segments upload nothing)."""
+    seg_dir = os.path.join(engine.data_path, "segments")
+    files, uploaded, reused = upload_segment_files(
+        repo, seg_dir, commit["segments"])
     manifest = {"commit": commit, "files": files}
     shard_container(repo, index_name, shard_id).write_blob(
         "manifest.json", json.dumps(manifest).encode())

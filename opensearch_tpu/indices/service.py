@@ -281,8 +281,10 @@ class IndexService:
 
     # set by the node when a blob-repository registry exists; consulted
     # at flush time for remote-store mirroring (RemoteStoreRefreshListener
-    # analog, at flush granularity)
+    # analog, at flush granularity).  repo_mutex_fn serializes against
+    # the snapshot service's blob GC.
     repo_resolver = None
+    repo_mutex_fn = None
 
     def _remote_repo(self):
         rs = self.settings.get("remote_store") or {}
@@ -302,27 +304,49 @@ class IndexService:
             return None
 
     def flush(self):
-        # serialized: a concurrent flush's merge-GC could delete segment
-        # files mid-upload, producing manifests that list vanished files
+        # local flush under the index lock (a concurrent flush's
+        # merge-GC could delete segment files mid-upload); REMOTE
+        # uploads happen after release so slow blob stores never stall
+        # searches/shard ops, under the repo mutex so the snapshot GC
+        # can't collect just-written blobs
         with self._lock:
-            self._flush_locked()
-
-    def _flush_locked(self):
-        self.save_meta()
+            self.save_meta()
+            commits = {sid: engine.flush()
+                       for sid, engine in sorted(
+                           self.local_shards.items())}
         repo = self._remote_repo()
-        for shard_id, engine in sorted(self.local_shards.items()):
-            commit = engine.flush()
-            if repo is not None:
-                from opensearch_tpu.index.remote_store import upload_shard
-                upload_shard(repo, self.name, shard_id, engine, commit)
-        if repo is not None:
-            # index meta travels with the data: a remote restore needs
-            # settings + mappings, not just segments
+        if repo is None:
+            return
+        import logging
+
+        from opensearch_tpu.index.remote_store import upload_shard
+        mutex = (self.repo_mutex_fn(repo.name)
+                 if self.repo_mutex_fn else None)
+        try:
+            if mutex is not None:
+                mutex.acquire()
+            for shard_id, commit in commits.items():
+                engine = self.local_shards.get(shard_id)
+                if engine is None:
+                    continue
+                try:
+                    upload_shard(repo, self.name, shard_id, engine,
+                                 commit)
+                except Exception as e:  # noqa: BLE001 — best effort
+                    # mirroring is BEST-EFFORT: local durability already
+                    # succeeded; the mirror stays at its previous commit
+                    logging.getLogger(
+                        "opensearch_tpu.remote_store").warning(
+                        "[%s][%s] remote upload failed: %s", self.name,
+                        shard_id, e)
             import json as _json
             repo.store.container(f"remote/{self.name}").write_blob(
                 "_meta.json", _json.dumps({
                     "settings": dict(self.settings),
                     "mappings": self.mapper.to_mapping()}).encode())
+        finally:
+            if mutex is not None:
+                mutex.release()
 
     def force_merge(self, max_num_segments: int = 1):
         for engine in self.shards:
@@ -531,12 +555,14 @@ class IndicesService:
             os.fsync(f.fileno())
         os.replace(tmp, self._meta_path(name))
 
-    def set_repo_resolver(self, resolver):
+    def set_repo_resolver(self, resolver, mutex_fn=None):
         """Late-bound blob-repository lookup (the node wires it once the
         snapshot service exists); applied to every open index."""
         self._repo_resolver = resolver
+        self._repo_mutex_fn = mutex_fn
         for svc in self.indices.values():
             svc.repo_resolver = resolver
+            svc.repo_mutex_fn = mutex_fn
 
     def _load(self):
         for name in sorted(os.listdir(self.data_path)):
@@ -571,6 +597,7 @@ class IndicesService:
         svc = IndexService(name, path, settings, mappings,
                            persist_meta=self._persist_meta)
         svc.repo_resolver = getattr(self, "_repo_resolver", None)
+        svc.repo_mutex_fn = getattr(self, "_repo_mutex_fn", None)
         self._persist_meta(name, settings, mappings or {})
         self.indices[name] = svc
         return svc
@@ -640,18 +667,6 @@ class IndicesService:
             del self.indices[name]
             shutil.rmtree(os.path.join(self.data_path, name),
                           ignore_errors=True)
-            if remote_repo is not None:
-                # the mirror dies with the index: drop its manifests and
-                # GC blobs nothing references anymore (snapshots keep
-                # theirs — the GC consults BOTH consumers)
-                from opensearch_tpu.snapshots.service import \
-                    collect_referenced_blobs
-                remote_repo.store.container(
-                    f"remote/{name}").delete_tree()
-                referenced = collect_referenced_blobs(remote_repo)
-                for blob in list(remote_repo.blobs.list_blobs()):
-                    if blob not in referenced:
-                        remote_repo.blobs.delete_blob(blob)
             # aliases pointing only at the deleted index vanish with it
             changed = False
             for alias in list(self.aliases):
@@ -662,6 +677,28 @@ class IndicesService:
                     changed = True
             if changed:
                 self._persist_json(self._aliases_file, self.aliases)
+        if remote_repo is not None:
+            # OUTSIDE the registry lock (the scan + GC is blob-store
+            # I/O), under the repo mutex so snapshot create/delete can't
+            # interleave: the mirror dies with the index, blobs nothing
+            # references anymore go with it (the GC consults BOTH
+            # consumers of the shared space)
+            from opensearch_tpu.snapshots.service import \
+                collect_referenced_blobs
+            mutex = (self._repo_mutex_fn(remote_repo.name)
+                     if getattr(self, "_repo_mutex_fn", None) else None)
+            try:
+                if mutex is not None:
+                    mutex.acquire()
+                remote_repo.store.container(
+                    f"remote/{name}").delete_tree()
+                referenced = collect_referenced_blobs(remote_repo)
+                for blob in list(remote_repo.blobs.list_blobs()):
+                    if blob not in referenced:
+                        remote_repo.blobs.delete_blob(blob)
+            finally:
+                if mutex is not None:
+                    mutex.release()
 
     def resolve(self, expr: str) -> list[IndexService]:
         """Index expression: name, alias, comma list, * / _all wildcards

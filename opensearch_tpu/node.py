@@ -37,13 +37,16 @@ class Node:
         from opensearch_tpu.search.pipeline import SearchPipelineService
         from opensearch_tpu.common.tasks import TaskManager
         from opensearch_tpu.common.fshealth import FsHealthService
+        from opensearch_tpu.common.threadpool import ThreadPool
+        self.thread_pool = ThreadPool()
         from opensearch_tpu.ingest.service import IngestService
         self.fs_health = FsHealthService(data_path)
         self.fs_health.check()
         self.ingest = IngestService(data_path)
         self.snapshots = SnapshotsService(self.indices, data_path)
         # remote-store mirroring resolves repositories late-bound
-        self.indices.set_repo_resolver(self.snapshots._repo)
+        self.indices.set_repo_resolver(self.snapshots._repo,
+                                       self.snapshots.repo_mutex)
         self.contexts = ReaderContextRegistry()
         self.search_pipelines = SearchPipelineService(data_path)
         self.task_manager = TaskManager(name)
@@ -117,6 +120,7 @@ class Node:
     def stop(self):
         self.http.stop()
         self.indices.close()
+        self.thread_pool.shutdown()
 
 
 def main(argv=None):

@@ -329,6 +329,7 @@ class RestController:
                     s.doc_count() for s in indices.values())}},
                 "breakers": breaker_service().stats(),
                 "tasks": {"count": len(self.node.task_manager.list())},
+                "thread_pool": self.node.thread_pool.stats(),
                 "fs": {"health": self.node.fs_health.stats()},
             }}}
 
@@ -1107,23 +1108,18 @@ class RestController:
         responses = []
         # remotes fan out CONCURRENTLY (each seed attempt can block on
         # its timeout; latency must be the slowest cluster, not the sum)
-        from concurrent.futures import ThreadPoolExecutor
         remote_items = sorted(remote_map.items())
+        remote_resps = []
         if remote_items:
-            with ThreadPoolExecutor(
-                    max_workers=min(len(remote_items), 8)) as pool:
-                futures = [(alias, rexpr, pool.submit(
-                    self.node.remotes.search, alias, rexpr, sub))
-                    for alias, rexpr in remote_items]
-                remote_resps = []
-                for alias, rexpr, fut in futures:
-                    r = fut.result()
-                    for h in r["hits"]["hits"]:
-                        h["_index"] = \
-                            f"{alias}:{h.get('_index', rexpr)}"
-                    remote_resps.append(r)
-        else:
-            remote_resps = []
+            pool = self.node.thread_pool.executor("search")
+            futures = [(alias, rexpr, pool.submit(
+                self.node.remotes.search, alias, rexpr, sub))
+                for alias, rexpr in remote_items]
+            for alias, rexpr, fut in futures:
+                r = fut.result()
+                for h in r["hits"]["hits"]:
+                    h["_index"] = f"{alias}:{h.get('_index', rexpr)}"
+                remote_resps.append(r)
         if local_exprs:
             targets = self.node.indices.resolve_with_filters(
                 ",".join(local_exprs))

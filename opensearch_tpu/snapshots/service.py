@@ -195,6 +195,13 @@ class SnapshotsService:
             raise RepositoryMissingError(f"[{name}] missing")
         return repo
 
+    def repo_mutex(self, repo_name: str) -> threading.Lock:
+        """Public: EVERY mutation of a repository's shared blob space
+        (snapshot create/delete GC, remote-store uploads and cleanup)
+        must hold this — unsynchronized writers race the GC into
+        deleting just-written blobs."""
+        return self._mutex(repo_name)
+
     def _mutex(self, repo_name: str) -> threading.Lock:
         with self._lock:
             lock = self._repo_mutex.get(repo_name)
@@ -248,23 +255,13 @@ class SnapshotsService:
             shards_meta = {}
             for shard_id, engine in sorted(svc.local_shards.items()):
                 commit = engine.flush()
-                seg_dir = os.path.join(engine.data_path, "segments")
-                files = []
-                for seg_id in commit["segments"]:
-                    for suffix in _SEGMENT_SUFFIXES:
-                        path = os.path.join(seg_dir, seg_id + suffix)
-                        if not os.path.exists(path):
-                            continue
-                        with open(path, "rb") as f:
-                            data = f.read()
-                        digest = hashlib.sha256(data).hexdigest()
-                        total_files += 1
-                        if repo.blobs.blob_exists(digest):
-                            reused_files += 1    # incremental: shared blob
-                        else:
-                            repo.blobs.write_blob(digest, data)
-                        files.append({"name": seg_id + suffix,
-                                      "blob": digest, "size": len(data)})
+                from opensearch_tpu.index.remote_store import \
+                    upload_segment_files
+                files, uploaded, reused = upload_segment_files(
+                    repo, os.path.join(engine.data_path, "segments"),
+                    commit["segments"], strict=False)
+                total_files += len(files)
+                reused_files += reused
                 shards_meta[str(shard_id)] = {
                     "commit": commit, "files": files}
             indices_meta[name] = {

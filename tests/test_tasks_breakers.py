@@ -252,3 +252,35 @@ def test_in_flight_breaker_and_fs_health(node, tmp_path):
     assert svc.check() is False
     assert svc.stats()["status"] == "unhealthy"
     assert "reason" in svc.stats()
+
+
+def test_thread_pool_stats_and_rejection(node):
+    from opensearch_tpu.common.threadpool import (RejectedExecutionError,
+                                                  ThreadPool, _Pool)
+    code, resp = call(node, "GET", "/_nodes/stats")
+    tp = resp["nodes"][node.node_id]["thread_pool"]
+    for name in ("search", "write", "get", "generic", "snapshot",
+                 "management"):
+        assert name in tp and tp[name]["threads"] >= 1
+    # bounded queue rejects with 429 semantics
+    import threading as _t
+    gate = _t.Event()
+    pool = _Pool("t", size=1, queue_cap=1)
+    try:
+        f1 = pool.submit(gate.wait)            # occupies the worker...
+        import time as _time
+        deadline = _time.monotonic() + 5
+        while pool.stats()["queue"] > 0:       # ...until the worker took it
+            if _time.monotonic() > deadline:
+                raise AssertionError("worker never dequeued f1")
+            _time.sleep(0.01)
+        f2 = pool.submit(gate.wait)            # queued
+        import pytest as _pytest
+        with _pytest.raises(RejectedExecutionError):
+            pool.submit(gate.wait)
+        assert pool.stats()["rejected"] == 1
+    finally:
+        gate.set()
+        f1.result(timeout=5)
+        f2.result(timeout=5)
+        pool.shutdown()
