@@ -308,9 +308,14 @@ class IndexService:
         # merge-GC could delete segment files mid-upload); REMOTE
         # uploads happen after release so slow blob stores never stall
         # searches/shard ops, under the repo mutex so the snapshot GC
-        # can't collect just-written blobs
+        # can't collect just-written blobs.  A per-index flush
+        # generation orders uploads: a flush that lost the mutex race to
+        # a NEWER flush skips its (stale) manifests entirely instead of
+        # rolling the mirror back.
         with self._lock:
             self.save_meta()
+            self._flush_gen = getattr(self, "_flush_gen", 0) + 1
+            my_gen = self._flush_gen
             commits = {sid: engine.flush()
                        for sid, engine in sorted(
                            self.local_shards.items())}
@@ -325,6 +330,9 @@ class IndexService:
         try:
             if mutex is not None:
                 mutex.acquire()
+            if getattr(self, "_uploaded_gen", 0) > my_gen:
+                return               # a newer flush already mirrored
+            all_ok = True
             for shard_id, commit in commits.items():
                 engine = self.local_shards.get(shard_id)
                 if engine is None:
@@ -335,15 +343,21 @@ class IndexService:
                 except Exception as e:  # noqa: BLE001 — best effort
                     # mirroring is BEST-EFFORT: local durability already
                     # succeeded; the mirror stays at its previous commit
+                    all_ok = False
                     logging.getLogger(
                         "opensearch_tpu.remote_store").warning(
                         "[%s][%s] remote upload failed: %s", self.name,
                         shard_id, e)
-            import json as _json
-            repo.store.container(f"remote/{self.name}").write_blob(
-                "_meta.json", _json.dumps({
-                    "settings": dict(self.settings),
-                    "mappings": self.mapper.to_mapping()}).encode())
+            if all_ok:
+                # meta only advances WITH the data — a newer mapping
+                # beside a stale manifest would restore segments under
+                # the wrong schema
+                import json as _json
+                repo.store.container(f"remote/{self.name}").write_blob(
+                    "_meta.json", _json.dumps({
+                        "settings": dict(self.settings),
+                        "mappings": self.mapper.to_mapping()}).encode())
+                self._uploaded_gen = my_gen
         finally:
             if mutex is not None:
                 mutex.release()
@@ -528,6 +542,7 @@ class IndicesService:
         os.makedirs(data_path, exist_ok=True)
         self._lock = threading.RLock()
         self.indices: dict[str, IndexService] = {}
+        self._deleting: set[str] = set()   # names mid remote-cleanup
         # alias -> {index_name: {"filter": ..., "is_write_index": bool}}
         # (cluster-state aliases; ref cluster/metadata/AliasMetadata)
         self.aliases: dict[str, dict[str, dict]] = {}
@@ -588,6 +603,9 @@ class IndicesService:
         (call with the registry lock held)."""
         if name in self.indices:
             raise IndexAlreadyExistsError(name)
+        if name in self._deleting:
+            raise IllegalArgumentError(
+                f"index [{name}] is being deleted — retry shortly")
         self.validate_name(name)
         if "index" in settings:       # accept {"settings": {"index": {...}}}
             inner = settings.pop("index")
@@ -665,6 +683,11 @@ class IndicesService:
                 pass
             svc.close()
             del self.indices[name]
+            if remote_repo is not None:
+                # block same-name recreation until the remote cleanup
+                # finishes, or the trailing GC would destroy the NEW
+                # index's fresh mirror
+                self._deleting.add(name)
             shutil.rmtree(os.path.join(self.data_path, name),
                           ignore_errors=True)
             # aliases pointing only at the deleted index vanish with it
@@ -699,6 +722,8 @@ class IndicesService:
             finally:
                 if mutex is not None:
                     mutex.release()
+                with self._lock:
+                    self._deleting.discard(name)
 
     def resolve(self, expr: str) -> list[IndexService]:
         """Index expression: name, alias, comma list, * / _all wildcards

@@ -25,7 +25,6 @@ the reference's flush-before-snapshot).
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import threading
@@ -53,9 +52,6 @@ class SnapshotInProgressError(OpenSearchTpuError):
 
 class InvalidSnapshotNameError(ValidationError):
     pass
-
-
-_SEGMENT_SUFFIXES = (".npz", ".json", ".src", ".liv")
 
 
 def collect_referenced_blobs(repo, snapshots: Optional[list] = None) -> set:

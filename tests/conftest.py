@@ -23,10 +23,34 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Randomized-testing seed (the OpenSearchTestCase reproducible-seed
+# technique, ref test/framework/.../OpenSearchTestCase.java): every run
+# draws a fresh seed unless OSTPU_TEST_SEED pins it; failures print the
+# seed so `OSTPU_TEST_SEED=<n> pytest ...` reproduces exactly.
+TEST_SEED = int(os.environ.get("OSTPU_TEST_SEED",
+                               np.random.SeedSequence().entropy % 2**31))
+
+
+def pytest_report_header(config):
+    return (f"opensearch_tpu randomized seed: {TEST_SEED} "
+            f"(reproduce with OSTPU_TEST_SEED={TEST_SEED})")
+
 
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture
+def random_rng(request):
+    """Per-test randomized generator: seeded from the session seed + the
+    test name, so runs randomize while staying reproducible."""
+    import zlib
+
+    sub = zlib.crc32(request.node.nodeid.encode())
+    seed = (TEST_SEED * 1_000_003 + sub) % 2**63
+    print(f"[randomized] {request.node.nodeid} seed={TEST_SEED}")
+    return np.random.default_rng(seed)
 
 
 @pytest.fixture

@@ -1,0 +1,118 @@
+"""Randomized property tests: device results vs an independent numpy
+oracle on random corpora/queries (the randomized-testing harness SURVEY
+§4 calls for; reproduce any failure with the printed OSTPU_TEST_SEED)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.segment import SegmentWriter
+from opensearch_tpu.mapping.mapper import DocumentMapper
+from opensearch_tpu.search.executor import ShardSearcher
+
+K1, B = 1.2, 0.75
+VOCAB = [f"w{i}" for i in range(40)]
+
+
+def random_corpus(rng, n_docs, n_segments):
+    mapper = DocumentMapper({"properties": {
+        "t": {"type": "text"}, "n": {"type": "long"},
+        "k": {"type": "keyword"}}})
+    writer = SegmentWriter()
+    docs = []
+    for i in range(n_docs):
+        words = rng.choice(VOCAB, size=rng.integers(1, 12)).tolist()
+        docs.append({"t": " ".join(words),
+                     "n": int(rng.integers(-50, 50)),
+                     "k": str(rng.choice(["a", "b", "c", "d"]))})
+    segs = []
+    cuts = sorted(rng.choice(np.arange(1, n_docs),
+                             size=n_segments - 1, replace=False).tolist()) \
+        if n_segments > 1 else []
+    bounds = [0, *cuts, n_docs]
+    for si in range(n_segments):
+        chunk = docs[bounds[si]: bounds[si + 1]]
+        parsed = [mapper.parse(str(bounds[si] + j), d)
+                  for j, d in enumerate(chunk)]
+        segs.append(writer.build(parsed, f"r{si}"))
+    return ShardSearcher(segs, mapper), docs
+
+
+def oracle_bm25(docs, terms, k1=K1, b=B):
+    """Scalar BM25 oracle (Lucene formula)."""
+    N = sum(1 for d in docs if d["t"])
+    avgdl = sum(len(d["t"].split()) for d in docs) / max(N, 1)
+    scores = {}
+    for term in terms:
+        df = sum(1 for d in docs if term in d["t"].split())
+        if df == 0:
+            continue
+        idf = math.log(1 + (N - df + 0.5) / (df + 0.5))
+        for i, d in enumerate(docs):
+            tf = d["t"].split().count(term)
+            if tf == 0:
+                continue
+            dl = len(d["t"].split())
+            scores[i] = scores.get(i, 0.0) + \
+                idf * tf / (tf + k1 * (1 - b + b * dl / avgdl))
+    return scores
+
+
+@pytest.mark.parametrize("trial", range(3))
+def test_random_match_queries_vs_oracle(random_rng, trial):
+    rng = random_rng
+    n_docs = int(rng.integers(20, 120))
+    searcher, docs = random_corpus(rng, n_docs,
+                                   int(rng.integers(1, 4)))
+    for _ in range(5):
+        terms = rng.choice(VOCAB,
+                           size=rng.integers(1, 4), replace=False)
+        resp = searcher.search({"query": {"match": {
+            "t": " ".join(terms)}}, "size": n_docs})
+        expected = oracle_bm25(docs, set(terms))
+        got = {int(h["_id"]): h["_score"]
+               for h in resp["hits"]["hits"]}
+        assert set(got) == set(expected), terms
+        for i, s in expected.items():
+            assert got[i] == pytest.approx(s, rel=1e-4), (terms, i)
+
+
+@pytest.mark.parametrize("trial", range(3))
+def test_random_bool_filters_vs_oracle(random_rng, trial):
+    rng = random_rng
+    n_docs = int(rng.integers(20, 120))
+    searcher, docs = random_corpus(rng, n_docs,
+                                   int(rng.integers(1, 4)))
+    for _ in range(5):
+        lo = int(rng.integers(-50, 40))
+        hi = lo + int(rng.integers(1, 40))
+        kw = str(rng.choice(["a", "b", "c", "d"]))
+        resp = searcher.search({"query": {"bool": {"filter": [
+            {"range": {"n": {"gte": lo, "lt": hi}}},
+            {"term": {"k": kw}}]}}, "size": n_docs})
+        expected = {i for i, d in enumerate(docs)
+                    if lo <= d["n"] < hi and d["k"] == kw}
+        got = {int(h["_id"]) for h in resp["hits"]["hits"]}
+        assert got == expected, (lo, hi, kw)
+
+
+@pytest.mark.parametrize("trial", range(2))
+def test_random_agg_sums_vs_oracle(random_rng, trial):
+    rng = random_rng
+    n_docs = int(rng.integers(20, 100))
+    searcher, docs = random_corpus(rng, n_docs,
+                                   int(rng.integers(1, 4)))
+    resp = searcher.search({"size": 0, "aggs": {
+        "by_k": {"terms": {"field": "k", "size": 10},
+                 "aggs": {"s": {"sum": {"field": "n"}}}}}})
+    buckets = {b["key"]: b for b in
+               resp["aggregations"]["by_k"]["buckets"]}
+    for kw in ("a", "b", "c", "d"):
+        members = [d for d in docs if d["k"] == kw]
+        if not members:
+            assert kw not in buckets
+            continue
+        assert buckets[kw]["doc_count"] == len(members)
+        assert buckets[kw]["s"]["value"] == pytest.approx(
+            sum(d["n"] for d in members))
