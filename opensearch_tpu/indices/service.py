@@ -330,16 +330,23 @@ class IndexService:
         try:
             if mutex is not None:
                 mutex.acquire()
-            if getattr(self, "_uploaded_gen", 0) > my_gen:
-                return               # a newer flush already mirrored
+            # PER-SHARD generation marks: a shard whose manifest a
+            # newer flush already wrote is never overwritten by an
+            # older one, even when that newer flush partially failed
+            shard_gens = getattr(self, "_uploaded_shard_gens", None)
+            if shard_gens is None:
+                shard_gens = self._uploaded_shard_gens = {}
             all_ok = True
             for shard_id, commit in commits.items():
                 engine = self.local_shards.get(shard_id)
                 if engine is None:
                     continue
+                if shard_gens.get(shard_id, 0) > my_gen:
+                    continue         # newer manifest already mirrored
                 try:
                     upload_shard(repo, self.name, shard_id, engine,
                                  commit)
+                    shard_gens[shard_id] = my_gen
                 except Exception as e:  # noqa: BLE001 — best effort
                     # mirroring is BEST-EFFORT: local durability already
                     # succeeded; the mirror stays at its previous commit
@@ -348,7 +355,7 @@ class IndexService:
                         "opensearch_tpu.remote_store").warning(
                         "[%s][%s] remote upload failed: %s", self.name,
                         shard_id, e)
-            if all_ok:
+            if all_ok and getattr(self, "_meta_gen", 0) < my_gen:
                 # meta only advances WITH the data — a newer mapping
                 # beside a stale manifest would restore segments under
                 # the wrong schema
@@ -357,7 +364,7 @@ class IndexService:
                     "_meta.json", _json.dumps({
                         "settings": dict(self.settings),
                         "mappings": self.mapper.to_mapping()}).encode())
-                self._uploaded_gen = my_gen
+                self._meta_gen = my_gen
         finally:
             if mutex is not None:
                 mutex.release()
@@ -686,42 +693,50 @@ class IndicesService:
             if remote_repo is not None:
                 # block same-name recreation until the remote cleanup
                 # finishes, or the trailing GC would destroy the NEW
-                # index's fresh mirror
+                # index's fresh mirror.  EVERY exit path from here on
+                # must discard the guard (see the outer try/finally).
                 self._deleting.add(name)
-            shutil.rmtree(os.path.join(self.data_path, name),
-                          ignore_errors=True)
-            # aliases pointing only at the deleted index vanish with it
-            changed = False
-            for alias in list(self.aliases):
-                if name in self.aliases[alias]:
-                    del self.aliases[alias][name]
-                    if not self.aliases[alias]:
-                        del self.aliases[alias]
-                    changed = True
-            if changed:
-                self._persist_json(self._aliases_file, self.aliases)
+            try:
+                shutil.rmtree(os.path.join(self.data_path, name),
+                              ignore_errors=True)
+                # aliases pointing only at the deleted index vanish too
+                changed = False
+                for alias in list(self.aliases):
+                    if name in self.aliases[alias]:
+                        del self.aliases[alias][name]
+                        if not self.aliases[alias]:
+                            del self.aliases[alias]
+                        changed = True
+                if changed:
+                    self._persist_json(self._aliases_file, self.aliases)
+            except BaseException:
+                self._deleting.discard(name)
+                raise
         if remote_repo is not None:
             # OUTSIDE the registry lock (the scan + GC is blob-store
             # I/O), under the repo mutex so snapshot create/delete can't
             # interleave: the mirror dies with the index, blobs nothing
             # references anymore go with it (the GC consults BOTH
             # consumers of the shared space)
-            from opensearch_tpu.snapshots.service import \
-                collect_referenced_blobs
-            mutex = (self._repo_mutex_fn(remote_repo.name)
-                     if getattr(self, "_repo_mutex_fn", None) else None)
             try:
+                from opensearch_tpu.snapshots.service import \
+                    collect_referenced_blobs
+                mutex = (self._repo_mutex_fn(remote_repo.name)
+                         if getattr(self, "_repo_mutex_fn", None)
+                         else None)
                 if mutex is not None:
                     mutex.acquire()
-                remote_repo.store.container(
-                    f"remote/{name}").delete_tree()
-                referenced = collect_referenced_blobs(remote_repo)
-                for blob in list(remote_repo.blobs.list_blobs()):
-                    if blob not in referenced:
-                        remote_repo.blobs.delete_blob(blob)
+                try:
+                    remote_repo.store.container(
+                        f"remote/{name}").delete_tree()
+                    referenced = collect_referenced_blobs(remote_repo)
+                    for blob in list(remote_repo.blobs.list_blobs()):
+                        if blob not in referenced:
+                            remote_repo.blobs.delete_blob(blob)
+                finally:
+                    if mutex is not None:
+                        mutex.release()
             finally:
-                if mutex is not None:
-                    mutex.release()
                 with self._lock:
                     self._deleting.discard(name)
 
