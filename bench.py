@@ -233,7 +233,14 @@ def main_parent():
         return False
 
     attempts = []
-    if probe_default_backend():
+    force_cpu = (os.environ.get("OSTPU_BENCH_FORCE_CPU") == "1"
+                 or os.environ.get("JAX_PLATFORMS") == "cpu")
+    if force_cpu:
+        # an explicit CPU run must never touch the accelerator tunnel
+        # (sitecustomize overrides JAX_PLATFORMS, so the probe would
+        # still hit — and hang on — a wedged tunnel)
+        log("cpu forced via env: skipping default-backend probe")
+    elif probe_default_backend():
         attempts.append(("default", {}, tpu_to))
     else:
         log("skipping default-backend attempt (probe failed "

@@ -117,6 +117,13 @@ class CircuitBreakingError(OpenSearchTpuError):
         )
 
 
+class ClusterBlockException(OpenSearchTpuError):
+    """Operation rejected by an index-level block, e.g. writes to a
+    searchable-snapshot index (cluster/block/ClusterBlockException)."""
+
+    status = 403
+
+
 class TaskCancelledError(OpenSearchTpuError):
     status = 400
 

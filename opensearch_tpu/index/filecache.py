@@ -1,0 +1,162 @@
+"""Node-level LRU blob cache backing searchable snapshots.
+
+The reference mounts snapshots as ``remote_snapshot`` indices whose data
+stays in the repository, pulled through a bounded on-disk cache (ref
+server/src/main/java/org/opensearch/index/store/remote/filecache/
+FileCache.java:47, ref server/src/main/java/org/opensearch/node/
+Node.java fileCache wiring).  Here the unit is a whole segment file
+(content-addressed blob): segments are staged fully into host/device
+memory at engine open, so an evicted file is only re-fetched at the next
+shard open — eviction never breaks a live searcher.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+
+class FileCache:
+    """Bounded content-addressed file cache with LRU eviction.
+
+    ``get(sha, fetch)`` returns a stable path ``<dir>/<sha>`` — stable so
+    shard directories can hold symlinks that survive evict/refetch
+    cycles.  Fetches run OUTSIDE the cache lock (a slow repository must
+    not stall other cache users or stats reads); concurrent misses on
+    the same sha dedup via per-sha in-flight events.
+    """
+
+    def __init__(self, cache_dir: str, max_bytes: int = 256 << 20):
+        self.cache_dir = cache_dir
+        self.max_bytes = int(max_bytes)
+        os.makedirs(cache_dir, exist_ok=True)
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[str, int]" = OrderedDict()  # sha->bytes
+        self._in_flight: dict[str, threading.Event] = {}
+        # sha -> pin count; pinned blobs are never evicted (mount in
+        # progress); counted so nested/overlapping pins compose
+        self._pinned: dict[str, int] = {}
+        self.hits = self.misses = self.evictions = 0
+        for name in sorted(os.listdir(cache_dir)):      # warm restart
+            p = os.path.join(cache_dir, name)
+            if os.path.isfile(p) and not name.endswith(".tmp"):
+                self._entries[name] = os.path.getsize(p)
+
+    def path(self, sha: str) -> str:
+        return os.path.join(self.cache_dir, sha)
+
+    def get(self, sha: str, fetch) -> str:
+        """Return the cached path for ``sha``, fetching via ``fetch()``
+        (-> bytes) on miss and evicting least-recently-used unpinned
+        entries past the budget.  Pinned entries and the just-fetched one
+        are never evicted, so a working set larger than the budget still
+        materializes (over budget, like the reference's cache under an
+        oversized mount)."""
+        while True:
+            with self._lock:
+                if sha in self._entries and os.path.exists(self.path(sha)):
+                    self._entries.move_to_end(sha)
+                    self.hits += 1
+                    return self.path(sha)
+                ev = self._in_flight.get(sha)
+                if ev is None:
+                    self._in_flight[sha] = threading.Event()
+                    self.misses += 1
+                    break               # this thread fetches
+            ev.wait()                   # another thread is fetching it
+        try:
+            data = fetch()
+            tmp = self.path(sha) + ".tmp." + str(threading.get_ident())
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path(sha))
+            with self._lock:
+                self._entries.pop(sha, None)
+                self._entries[sha] = len(data)
+                self._evict(keep=sha)
+            return self.path(sha)
+        finally:
+            with self._lock:
+                self._in_flight.pop(sha).set()
+
+    def pin(self, shas):
+        """Context manager: keep ``shas`` out of eviction while a mount
+        materializes (the whole file set must coexist until the engines
+        have loaded it).  Refcounted, so overlapping pins compose."""
+        cache = self
+        shas = set(shas)
+
+        class _Pin:
+            def __enter__(self):
+                with cache._lock:
+                    for s in shas:
+                        cache._pinned[s] = cache._pinned.get(s, 0) + 1
+
+            def __exit__(self, *exc):
+                with cache._lock:
+                    for s in shas:
+                        n = cache._pinned.get(s, 0) - 1
+                        if n <= 0:
+                            cache._pinned.pop(s, None)
+                        else:
+                            cache._pinned[s] = n
+                    cache._evict(keep=None)
+
+        return _Pin()
+
+    def set_max_bytes(self, v: int):
+        """Dynamic resize; shrinking reclaims disk immediately rather
+        than waiting for the next miss."""
+        with self._lock:
+            self.max_bytes = int(v)
+            self._evict(keep=None)
+
+    def _evict(self, keep):
+        # caller holds the lock
+        total = sum(self._entries.values())
+        for victim in list(self._entries):
+            if total <= self.max_bytes:
+                break
+            if victim == keep or victim in self._pinned:
+                continue
+            total -= self._entries.pop(victim)
+            self.evictions += 1
+            try:
+                os.remove(self.path(victim))
+            except OSError:
+                pass
+
+    def materialize_shard(self, shard_dir: str, repo):
+        """Link a mounted shard's segment files (listed in its
+        ``remote_ref.json``) to cached blobs, fetching any the LRU
+        evicted.  The shard's whole blob set is pinned for the duration
+        so fetching file N can't evict file 1 before the engine opens.
+        Symlink targets are the stable cache paths, so an existing link
+        whose blob was evicted heals by re-fetching."""
+        import json
+
+        ref_path = os.path.join(shard_dir, "remote_ref.json")
+        with open(ref_path) as f:
+            ref = json.load(f)
+        seg_dir = os.path.join(shard_dir, "segments")
+        os.makedirs(seg_dir, exist_ok=True)
+        with self.pin({fm["blob"] for fm in ref["files"]}):
+            for fmeta in ref["files"]:
+                blob = fmeta["blob"]
+                target = self.get(
+                    blob, lambda b=blob: repo.blobs.read_blob(b))
+                link = os.path.join(seg_dir, fmeta["name"])
+                if os.path.islink(link) or os.path.exists(link):
+                    os.remove(link)
+                os.symlink(target, link)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "size_in_bytes": sum(self._entries.values()),
+                    "max_size_in_bytes": self.max_bytes,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}

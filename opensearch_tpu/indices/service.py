@@ -178,8 +178,16 @@ class IndexService:
 
     # -- document ops -----------------------------------------------------
 
+    def _check_write_block(self):
+        if self.settings.get("remote_snapshot"):
+            from opensearch_tpu.common.errors import ClusterBlockException
+            raise ClusterBlockException(
+                f"index [{self.name}] blocked by: [FORBIDDEN/13/remote "
+                "index is read-only (searchable snapshot)]")
+
     def index_doc(self, doc_id: Optional[str], source: dict,
                   routing: Optional[str] = None, **kw) -> OpResult:
+        self._check_write_block()
         if doc_id is None:
             doc_id = uuid.uuid4().hex[:20]
         engine = self.route(doc_id, routing)
@@ -189,6 +197,7 @@ class IndexService:
 
     def delete_doc(self, doc_id: str, routing: Optional[str] = None,
                    **kw) -> OpResult:
+        self._check_write_block()
         engine = self.route(doc_id, routing)
         result = engine.delete(str(doc_id), **kw)
         engine.ensure_synced()
@@ -312,6 +321,8 @@ class IndexService:
         # generation orders uploads: a flush that lost the mutex race to
         # a NEWER flush skips its (stale) manifests entirely instead of
         # rolling the mirror back.
+        if self.settings.get("remote_snapshot"):
+            return                   # data lives in the repository
         with self._lock:
             self.save_meta()
             self._flush_gen = getattr(self, "_flush_gen", 0) + 1
@@ -355,21 +366,32 @@ class IndexService:
                         "opensearch_tpu.remote_store").warning(
                         "[%s][%s] remote upload failed: %s", self.name,
                         shard_id, e)
-            if all_ok and getattr(self, "_meta_gen", 0) < my_gen:
-                # meta only advances WITH the data — a newer mapping
-                # beside a stale manifest would restore segments under
-                # the wrong schema
+            if (all_ok and my_gen == self._flush_gen
+                    and getattr(self, "_meta_gen", 0) < my_gen):
+                # meta only advances WITH the data, and only from the
+                # LATEST flush — a stale flush writing current live
+                # mappings beside mixed-generation manifests would
+                # restore segments under the wrong schema
                 import json as _json
-                repo.store.container(f"remote/{self.name}").write_blob(
-                    "_meta.json", _json.dumps({
-                        "settings": dict(self.settings),
-                        "mappings": self.mapper.to_mapping()}).encode())
-                self._meta_gen = my_gen
+                try:
+                    repo.store.container(
+                        f"remote/{self.name}").write_blob(
+                        "_meta.json", _json.dumps({
+                            "settings": dict(self.settings),
+                            "mappings": self.mapper.to_mapping()
+                        }).encode())
+                    self._meta_gen = my_gen
+                except Exception as e:  # noqa: BLE001 — best effort
+                    logging.getLogger(
+                        "opensearch_tpu.remote_store").warning(
+                        "[%s] remote meta upload failed: %s",
+                        self.name, e)
         finally:
             if mutex is not None:
                 mutex.release()
 
     def force_merge(self, max_num_segments: int = 1):
+        self._check_write_block()   # would write merged files locally
         for engine in self.shards:
             engine.force_merge(max_num_segments)
         self._dirty()
@@ -521,6 +543,7 @@ class IndexService:
         }
 
     def put_mapping(self, mapping: dict):
+        self._check_write_block()   # schema must match the snapshot
         self.mapper.merge(mapping)
         self.save_meta()
 
@@ -556,6 +579,13 @@ class IndicesService:
         # composable index templates (ref cluster/metadata/
         # ComposableIndexTemplate): name -> body
         self.templates: dict[str, dict] = {}
+        # searchable-snapshot blob cache, a sibling of the index dirs
+        # (the reference's node-level FileCache, ref node/Node.java)
+        from opensearch_tpu.index.filecache import FileCache
+        self.file_cache = FileCache(
+            os.path.join(os.path.dirname(data_path) or data_path,
+                         "filecache"))
+        self._pending_mounts: list[str] = []
         self._aliases_file = os.path.join(data_path, "_aliases.json")
         self._templates_file = os.path.join(data_path,
                                             "_index_templates.json")
@@ -585,6 +615,60 @@ class IndicesService:
         for svc in self.indices.values():
             svc.repo_resolver = resolver
             svc.repo_mutex_fn = mutex_fn
+        # open mounted (remote_snapshot) indices deferred at boot, best
+        # effort: a vanished repository leaves the mount closed rather
+        # than failing node startup
+        import logging
+        pending, self._pending_mounts = self._pending_mounts, []
+        for name in pending:
+            try:
+                with open(self._meta_path(name)) as f:
+                    meta = json.load(f)
+                with self._lock, \
+                        self._mount_materialize(name, meta["settings"]):
+                    self.indices[name] = IndexService(
+                        name, os.path.join(self.data_path, name),
+                        meta["settings"], meta.get("mappings"),
+                        persist_meta=self._persist_meta)
+            except Exception as e:   # noqa: BLE001 — keep node booting
+                logging.getLogger("opensearch_tpu.indices").warning(
+                    "could not reopen mounted index [%s]: %s", name, e)
+
+    def _mount_materialize(self, name: str, settings: dict):
+        """Context manager: fetch/link a mounted index's segment files
+        from its backing repository through the node file cache, and PIN
+        the whole blob set until the caller's engines have opened —
+        without the pin, materializing shard N under a small cache
+        budget evicts shard 1's blobs from under their symlinks before
+        the engine reads them."""
+        import contextlib
+
+        mount = settings.get("remote_snapshot") or {}
+        resolver = getattr(self, "_repo_resolver", None)
+        if resolver is None:
+            raise ValidationError(
+                f"cannot open mounted index [{name}]: no repository "
+                "service")
+        repo = resolver(mount["repository"])
+        index_path = os.path.join(self.data_path, name)
+        shard_dirs, blobs = [], set()
+        for shard in sorted(os.listdir(index_path)):
+            shard_dir = os.path.join(index_path, shard)
+            ref_path = os.path.join(shard_dir, "remote_ref.json")
+            if os.path.isfile(ref_path):
+                with open(ref_path) as f:
+                    blobs.update(fm["blob"]
+                                 for fm in json.load(f)["files"])
+                shard_dirs.append(shard_dir)
+
+        @contextlib.contextmanager
+        def mount_ctx():
+            with self.file_cache.pin(blobs):
+                for sd in shard_dirs:
+                    self.file_cache.materialize_shard(sd, repo)
+                yield
+
+        return mount_ctx()
 
     def _load(self):
         for name in sorted(os.listdir(self.data_path)):
@@ -592,6 +676,11 @@ class IndicesService:
             if os.path.exists(meta_path):
                 with open(meta_path) as f:
                     meta = json.load(f)
+                if meta.get("settings", {}).get("remote_snapshot"):
+                    # mounted indices need the blob repository, wired
+                    # later via set_repo_resolver — defer the open
+                    self._pending_mounts.append(name)
+                    continue
                 self.indices[name] = IndexService(
                     name, os.path.join(self.data_path, name),
                     meta.get("settings", {}), meta.get("mappings"),
@@ -619,8 +708,13 @@ class IndicesService:
             settings.update(inner)
         path = os.path.join(self.data_path, name)
         os.makedirs(path, exist_ok=True)
-        svc = IndexService(name, path, settings, mappings,
-                           persist_meta=self._persist_meta)
+        import contextlib
+        mount_ctx = (self._mount_materialize(name, settings)
+                     if settings.get("remote_snapshot")
+                     else contextlib.nullcontext())
+        with mount_ctx:     # pin blobs until the engines have loaded
+            svc = IndexService(name, path, settings, mappings,
+                               persist_meta=self._persist_meta)
         svc.repo_resolver = getattr(self, "_repo_resolver", None)
         svc.repo_mutex_fn = getattr(self, "_repo_mutex_fn", None)
         self._persist_meta(name, settings, mappings or {})

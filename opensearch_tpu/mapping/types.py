@@ -332,7 +332,13 @@ class DenseVectorFieldType(FieldType):
         self.dims = int(self.params.get("dims") or self.params.get("dimension") or 0)
         if self.dims <= 0:
             raise MapperParsingError(f"dense_vector field [{name}] requires [dims]")
-        space = self.params.get("space_type") or self.params.get("similarity") or "l2"
+        # space_type may live at the top level (newer knn_vector
+        # mappings) or inside [method] (the opensearch-knn plugin's
+        # historical shape) — honor both, top level winning
+        space = (self.params.get("space_type")
+                 or self.params.get("similarity")
+                 or (self.params.get("method") or {}).get("space_type")
+                 or "l2")
         self.space_type = {"l2_norm": "l2", "dot_product": "innerproduct", "cosine": "cosinesimil"}.get(space, space)
         # ANN method definition (the opensearch-knn plugin's mapping shape:
         # {"name": "ivf"|"ivf_pq", "parameters": {nlist, nprobe, m}});

@@ -77,8 +77,12 @@ class Node:
         max_scroll = Setting.int_setting(
             "search.max_open_scroll_context", 500, min_value=0,
             dynamic=True)
+        cache_size = Setting.int_setting(
+            "node.searchable_snapshot.cache.size", 256 << 20,
+            min_value=0, dynamic=True)
         self.cluster_settings = SettingsRegistry(
-            Settings(stored), [max_buckets, auto_create, max_scroll])
+            Settings(stored),
+            [max_buckets, auto_create, max_scroll, cache_size])
         # remote clusters configure via affix keys (RemoteClusterService)
         self.cluster_settings.register_prefix("cluster.remote")
         from opensearch_tpu.transport.remote import RemoteClusterService
@@ -90,10 +94,14 @@ class Node:
             auto_create, lambda v: setattr(self.indices, "auto_create", v))
         self.cluster_settings.add_settings_update_consumer(
             max_scroll, lambda v: setattr(self.contexts, "_max_open", v))
+        self.cluster_settings.add_settings_update_consumer(
+            cache_size, lambda v: self.indices.file_cache.set_max_bytes(v))
         # replay persisted values into the consumers at boot
         aggs_mod.MAX_BUCKETS = self.cluster_settings.get(max_buckets)
         self.indices.auto_create = self.cluster_settings.get(auto_create)
         self.contexts._max_open = self.cluster_settings.get(max_scroll)
+        self.indices.file_cache.set_max_bytes(
+            self.cluster_settings.get(cache_size))
 
     def update_cluster_settings(self, updates: dict) -> dict:
         import json as _json

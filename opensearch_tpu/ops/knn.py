@@ -55,6 +55,24 @@ def knn_topk(vectors, valid, query, *, space: str, k: int):
     return lax.top_k(scores, k)
 
 
+def knn_topk_auto(vectors, valid, query, *, space: str, k: int):
+    """Exact top-k via the hand-written pallas kernel when opted in
+    (OSTPU_PALLAS=1, see ops/pallas_knn.py) and the layout qualifies;
+    the XLA-fused jnp path otherwise.  Identical results either way."""
+    import os
+    if os.environ.get("OSTPU_PALLAS") == "1":
+        # pallas import deferred so the default path never loads it
+        from opensearch_tpu.ops.pallas_knn import TILE, knn_scores_pallas
+        if vectors.shape[0] % TILE == 0:
+            # only real TPUs run the Mosaic-compiled kernel; everything
+            # else (cpu tests, gpu) goes through the interpreter
+            interpret = jax.default_backend() not in ("tpu", "axon")
+            scores = knn_scores_pallas(vectors, valid, query, space=space,
+                                       interpret=interpret)
+            return lax.top_k(scores, k)
+    return knn_topk(vectors, valid, query, space=space, k=k)
+
+
 @partial(jax.jit, static_argnames=("space", "k"))
 def knn_topk_batch(vectors, valid, queries, *, space: str, k: int):
     """Batched queries [Q, d] -> (scores [Q, k], ids [Q, k]).  One

@@ -1,0 +1,88 @@
+"""Pallas TPU kernel for the exact k-NN scoring hot op.
+
+The jnp formulation in ops/knn.py already lands on the MXU via XLA; this
+kernel is the hand-scheduled variant per SURVEY §7's pallas mandate: the
+vector matrix streams HBM -> VMEM one doc-tile at a time (grid over
+tiles), each tile does one [T, d] @ [d] MXU matvec plus the VPU score
+translation, writing its slice of the dense score vector — no
+intermediate [n, d] temporaries, explicit control of the tile size.
+
+Numerically identical to ``ops.knn.knn_scores`` (same formula, same
+masking); validated against it in interpreter mode on CPU
+(tests/test_pallas.py) and behind the ``OSTPU_PALLAS=1`` flag on real
+TPUs.  Tile size 256 keeps a (256, d<=1024) f32 block well under VMEM.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import opensearch_tpu.common.jaxenv  # noqa: F401
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+TILE = 256
+
+
+def _score_kernel_l2(v_ref, q_ref, valid_ref, out_ref):
+    v = v_ref[...]                       # [TILE, d] f32 (VMEM)
+    q = q_ref[...]                       # [1, d]
+    dots = jnp.sum(v * q, axis=1)        # VPU reduce ([T] matvec)
+    v2 = jnp.sum(v * v, axis=1)
+    q2 = jnp.sum(q * q)
+    d2 = jnp.maximum(v2 - 2.0 * dots + q2, 0.0)
+    scores = 1.0 / (1.0 + d2)
+    out_ref[...] = jnp.where(valid_ref[...], scores, -jnp.inf)
+
+
+def _score_kernel_cosine(v_ref, q_ref, valid_ref, out_ref):
+    v = v_ref[...]
+    q = q_ref[...]
+    dots = jnp.sum(v * q, axis=1)
+    norms = jnp.sqrt(jnp.sum(v * v, axis=1))
+    qn = jnp.sqrt(jnp.sum(q * q))
+    cos = dots / jnp.maximum(norms * qn, 1e-30)
+    out_ref[...] = jnp.where(valid_ref[...], (1.0 + cos) / 2.0, -jnp.inf)
+
+
+def _score_kernel_ip(v_ref, q_ref, valid_ref, out_ref):
+    v = v_ref[...]
+    q = q_ref[...]
+    dots = jnp.sum(v * q, axis=1)
+    scores = jnp.where(dots >= 0, dots + 1.0, 1.0 / (1.0 - dots))
+    out_ref[...] = jnp.where(valid_ref[...], scores, -jnp.inf)
+
+
+_KERNELS = {"l2": _score_kernel_l2, "cosinesimil": _score_kernel_cosine,
+            "innerproduct": _score_kernel_ip}
+
+
+@functools.partial(jax.jit, static_argnames=("space", "interpret"))
+def knn_scores_pallas(vectors, valid, query, *, space: str = "l2",
+                      interpret: bool = False):
+    """Drop-in pallas replacement for ``ops.knn.knn_scores``.
+
+    ``vectors`` [n_pad, d] f32 with n_pad % TILE == 0 (the segment
+    staging pads to pow2 >= 8, so any n_pad >= TILE qualifies; smaller
+    inputs should use the jnp path).
+    """
+    kernel = _KERNELS.get(space)
+    if kernel is None:
+        raise ValueError(f"unknown space [{space}]")
+    n_pad, d = vectors.shape
+    assert n_pad % TILE == 0, n_pad
+    grid = (n_pad // TILE,)
+    q2d = query.astype(jnp.float32).reshape(1, d)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n_pad,), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TILE, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((TILE,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((TILE,), lambda i: (i,)),
+        interpret=interpret,
+    )(vectors.astype(jnp.float32), q2d, valid)

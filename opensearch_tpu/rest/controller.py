@@ -331,6 +331,7 @@ class RestController:
                 "tasks": {"count": len(self.node.task_manager.list())},
                 "thread_pool": self.node.thread_pool.stats(),
                 "fs": {"health": self.node.fs_health.stats()},
+                "file_cache": self.node.indices.file_cache.stats(),
             }}}
 
     def h_cat_indices(self, req):

@@ -306,6 +306,16 @@ class SnapshotsService:
         """Remove the snapshot, then garbage-collect blobs no other
         snapshot references (BlobStoreRepository's stale-blob cleanup)."""
         repo = self._repo(repo_name)
+        # a snapshot backing a mounted (remote_snapshot) index is live
+        # data — deleting it would GC the very blobs searches read
+        # (ref RestoreService snapshot-in-use check)
+        for svc in self.indices_service.indices.values():
+            mount = svc.settings.get("remote_snapshot") or {}
+            if (mount.get("repository") == repo_name
+                    and mount.get("snapshot") == snapshot):
+                raise ValidationError(
+                    f"cannot delete snapshot [{snapshot}]: mounted as "
+                    f"searchable snapshot index [{svc.name}]")
         with self._mutex(repo_name):
             repo.manifest(snapshot)                   # 404 if absent
             snapshots = [s for s in repo.list_snapshots()
@@ -356,18 +366,36 @@ class SnapshotsService:
                     "rename on restore")
             index_path = os.path.join(self.indices_service.data_path,
                                       target)
+            # storage_type=remote_snapshot mounts the index: no data is
+            # copied, shard dirs get a blob reference list and segment
+            # files stream through the node file cache at open (the
+            # searchable-snapshots RestoreService path, ref
+            # RestoreService.java:233 isRemoteSnapshot / FileCache)
+            mounted = body.get("storage_type") == "remote_snapshot"
             for shard_id, smeta in imeta["shards"].items():
                 shard_dir = os.path.join(index_path, shard_id)
                 seg_dir = os.path.join(shard_dir, "segments")
                 os.makedirs(seg_dir, exist_ok=True)
-                for fmeta in smeta["files"]:
-                    data = repo.blobs.read_blob(fmeta["blob"])
-                    tmp = os.path.join(seg_dir, fmeta["name"] + ".tmp")
-                    with open(tmp, "wb") as f:
-                        f.write(data)
+                if mounted:
+                    tmp = os.path.join(shard_dir, "remote_ref.json.tmp")
+                    with open(tmp, "w") as f:
+                        json.dump({"files": [
+                            {"name": fm["name"], "blob": fm["blob"]}
+                            for fm in smeta["files"]]}, f)
                         f.flush()
                         os.fsync(f.fileno())
-                    os.replace(tmp, os.path.join(seg_dir, fmeta["name"]))
+                    os.replace(tmp, os.path.join(shard_dir,
+                                                 "remote_ref.json"))
+                else:
+                    for fmeta in smeta["files"]:
+                        data = repo.blobs.read_blob(fmeta["blob"])
+                        tmp = os.path.join(seg_dir, fmeta["name"] + ".tmp")
+                        with open(tmp, "wb") as f:
+                            f.write(data)
+                            f.flush()
+                            os.fsync(f.fileno())
+                        os.replace(tmp,
+                                   os.path.join(seg_dir, fmeta["name"]))
                 commit = dict(smeta["commit"])
                 # the restored translog starts empty at the commit's
                 # generation (flush-before-snapshot trimmed it)
@@ -377,8 +405,15 @@ class SnapshotsService:
                     f.flush()
                     os.fsync(f.fileno())
                 os.replace(tmp, os.path.join(shard_dir, "commit.json"))
+            open_settings = dict(imeta["settings"])
+            if mounted:
+                open_settings["remote_snapshot"] = {
+                    "repository": repo_name, "snapshot": snapshot}
+                # a mounted index carries no local replicas — every
+                # node reads the same repository blobs
+                open_settings["number_of_replicas"] = 0
             self.indices_service.open_restored(
-                target, imeta["settings"], imeta["mappings"])
+                target, open_settings, imeta["mappings"])
             restored.append(target)
         return {"snapshot": {"snapshot": snapshot,
                              "indices": restored,

@@ -143,3 +143,83 @@ def test_gc_spares_remote_blobs_and_flush_survives_missing_repo(
     call(node, "PUT", "/rsx/_doc/2", {"a": 2})
     code, _ = call(node, "POST", "/rsx/_flush")
     assert code == 200
+
+
+def test_meta_only_advances_from_latest_complete_flush(
+        node, tmp_path, monkeypatch):
+    """Review regressions: (a) a flush that is no longer the newest must
+    not write _meta.json (stale flush beside mixed-generation manifests
+    would restore under the wrong schema); (b) partial shard-upload
+    failure holds meta back until a later complete flush; (c) a failing
+    meta write is best-effort like the shard uploads."""
+    import opensearch_tpu.index.remote_store as rs
+
+    call(node, "PUT", "/_snapshot/m4", {
+        "type": "fs", "settings": {"location": str(tmp_path / "repo4")}})
+    call(node, "PUT", "/rsm", {
+        "settings": {"number_of_shards": 2,
+                     "remote_store": {"enabled": True,
+                                      "repository": "m4"}},
+        "mappings": {"properties": {"a": {"type": "long"}}}})
+    for i in range(6):
+        call(node, "PUT", f"/rsm/_doc/{i}", {"a": i})
+    call(node, "POST", "/rsm/_refresh")
+    assert call(node, "POST", "/rsm/_flush")[0] == 200
+    svc = node.indices.indices["rsm"]
+    assert svc._meta_gen == svc._flush_gen
+
+    # (b) one shard's upload fails: meta stays at the old generation
+    gen_before = svc._meta_gen
+    real_upload = rs.upload_shard
+
+    def fail_shard1(repo, index, shard_id, engine, commit):
+        if shard_id == 1:
+            raise OSError("blob store hiccup")
+        return real_upload(repo, index, shard_id, engine, commit)
+
+    monkeypatch.setattr(rs, "upload_shard", fail_shard1)
+    call(node, "PUT", "/rsm/_doc/10?refresh=true", {"a": 10})
+    assert call(node, "POST", "/rsm/_flush")[0] == 200
+    assert svc._meta_gen == gen_before
+
+    # (a) a newer flush starts while this one holds the mutex (simulated
+    # by bumping _flush_gen from inside the upload): no meta write
+    def bump_gen(repo, index, shard_id, engine, commit):
+        out = real_upload(repo, index, shard_id, engine, commit)
+        svc._flush_gen += 1
+        return out
+
+    monkeypatch.setattr(rs, "upload_shard", bump_gen)
+    call(node, "PUT", "/rsm/_doc/11?refresh=true", {"a": 11})
+    assert call(node, "POST", "/rsm/_flush")[0] == 200
+    assert svc._meta_gen == gen_before
+    svc._flush_gen -= 2          # undo the simulated newer flushes
+
+    # (c) meta write failure is best-effort: flush still returns 200
+    monkeypatch.setattr(rs, "upload_shard", real_upload)
+    repo_obj = node.snapshots._repo("m4")
+    real_container = repo_obj.store.container
+
+    class MetaFailing:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def write_blob(self, name, data):
+            if name == "_meta.json":
+                raise OSError("meta write refused")
+            return self._inner.write_blob(name, data)
+
+        def __getattr__(self, item):
+            return getattr(self._inner, item)
+
+    monkeypatch.setattr(repo_obj.store, "container",
+                        lambda path: MetaFailing(real_container(path)))
+    call(node, "PUT", "/rsm/_doc/12?refresh=true", {"a": 12})
+    assert call(node, "POST", "/rsm/_flush")[0] == 200
+    assert svc._meta_gen == gen_before
+
+    # finally a clean complete flush advances meta to the latest gen
+    monkeypatch.setattr(repo_obj.store, "container", real_container)
+    call(node, "PUT", "/rsm/_doc/13?refresh=true", {"a": 13})
+    assert call(node, "POST", "/rsm/_flush")[0] == 200
+    assert svc._meta_gen == svc._flush_gen

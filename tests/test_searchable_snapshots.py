@@ -1,0 +1,218 @@
+"""Searchable snapshots: mount a snapshot as a read-only index whose
+segment files stream from the repository through the node-level LRU file
+cache (ref RestoreService.java remote_snapshot storage type,
+index/store/remote/filecache/FileCache.java)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from opensearch_tpu.index.filecache import FileCache
+from opensearch_tpu.node import Node
+
+
+def call(node, method, path, body=None):
+    url = f"http://127.0.0.1:{node.port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            payload = resp.read()
+            return resp.status, json.loads(payload) if payload else {}
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, json.loads(payload) if payload else {}
+
+
+# -- FileCache unit behavior -------------------------------------------------
+
+def test_file_cache_lru_eviction(tmp_path):
+    fc = FileCache(str(tmp_path / "fc"), max_bytes=100)
+    fc.get("a", lambda: b"x" * 40)
+    fc.get("b", lambda: b"x" * 40)
+    fc.get("a", lambda: 1 / 0)          # hit: fetch not called
+    fc.get("c", lambda: b"x" * 40)      # evicts b (LRU), not a
+    stats = fc.stats()
+    assert stats["evictions"] == 1 and stats["entries"] == 2
+    assert (tmp_path / "fc" / "a").exists()
+    assert not (tmp_path / "fc" / "b").exists()
+    # evicted entries re-fetch at the same stable path
+    p = fc.get("b", lambda: b"y" * 10)
+    assert p == str(tmp_path / "fc" / "b")
+
+
+def test_file_cache_oversized_entry_and_warm_restart(tmp_path):
+    fc = FileCache(str(tmp_path / "fc"), max_bytes=10)
+    p = fc.get("big", lambda: b"z" * 50)   # larger than the whole budget
+    assert (tmp_path / "fc" / "big").read_bytes() == b"z" * 50
+    fc2 = FileCache(str(tmp_path / "fc"), max_bytes=10)
+    assert fc2.stats()["entries"] == 1     # index rebuilt from disk
+    fc2.get("big", lambda: 1 / 0)          # still a hit, no refetch
+
+
+# -- end-to-end mount --------------------------------------------------------
+
+@pytest.fixture()
+def mounted(tmp_path):
+    node = Node(str(tmp_path / "node"), port=0).start()
+    call(node, "PUT", "/_snapshot/repo", {
+        "type": "fs", "settings": {"location": str(tmp_path / "repo")}})
+    call(node, "PUT", "/src", {
+        "settings": {"number_of_shards": 2},
+        "mappings": {"properties": {"t": {"type": "text"},
+                                    "n": {"type": "long"}}}})
+    for i in range(20):
+        call(node, "PUT", f"/src/_doc/{i}", {"t": f"event {i}", "n": i})
+    call(node, "POST", "/src/_refresh")
+    assert call(node, "PUT", "/_snapshot/repo/snap1",
+                {"indices": "src"})[0] == 200
+    call(node, "DELETE", "/src")
+    code, body = call(node, "POST", "/_snapshot/repo/snap1/_restore", {
+        "indices": "src", "rename_pattern": "src",
+        "rename_replacement": "mounted",
+        "storage_type": "remote_snapshot"})
+    assert code == 200, body
+    yield node, tmp_path
+    node.stop()
+
+
+def test_mount_searches_without_local_copy(mounted):
+    node, tmp_path = mounted
+    code, body = call(node, "GET", "/mounted/_search",
+                      body={"query": {"match": {"t": "event"}},
+                            "size": 25})
+    assert code == 200 and body["hits"]["total"]["value"] == 20
+    # no segment data was copied into the index dir: every segment file
+    # is a symlink into the node file cache
+    import os
+    idx = tmp_path / "node" / "indices" / "mounted"
+    seg_files = [os.path.join(r, f) for r, _, fs in os.walk(idx)
+                 for f in fs if "/segments" in r or r.endswith("segments")]
+    assert seg_files and all(os.path.islink(p) for p in seg_files)
+    # aggs + get work too
+    code, body = call(node, "GET", "/mounted/_search", body={
+        "size": 0, "aggs": {"s": {"sum": {"field": "n"}}}})
+    assert body["aggregations"]["s"]["value"] == sum(range(20))
+    code, doc = call(node, "GET", "/mounted/_doc/7")
+    assert code == 200 and doc["_source"]["n"] == 7
+
+
+def test_mount_is_read_only(mounted):
+    node, _ = mounted
+    code, body = call(node, "PUT", "/mounted/_doc/99", {"n": 99})
+    assert code == 403, body
+    assert "read-only" in json.dumps(body)
+    code, _ = call(node, "DELETE", "/mounted/_doc/3")
+    assert code == 403
+    code, body = call(node, "POST", "/_bulk", {})  # smoke other routes
+    code, _ = call(node, "POST", "/mounted/_forcemerge")
+    assert code == 403
+    # flush is a no-op, not an error (the reference accepts it)
+    assert call(node, "POST", "/mounted/_flush")[0] == 200
+
+
+def test_backing_snapshot_protected_until_unmount(mounted):
+    node, _ = mounted
+    code, body = call(node, "DELETE", "/_snapshot/repo/snap1")
+    assert code == 400 and "mounted" in json.dumps(body)
+    assert call(node, "DELETE", "/mounted")[0] == 200
+    assert call(node, "DELETE", "/_snapshot/repo/snap1")[0] == 200
+
+
+def test_mount_survives_restart_and_eviction(mounted):
+    node, tmp_path = mounted
+    # shrink the cache to force every blob out, then restart: the
+    # deferred boot-time mount re-fetches through the cache
+    code, _ = call(node, "PUT", "/_cluster/settings", {
+        "persistent": {"node.searchable_snapshot.cache.size": 1}})
+    assert code == 200
+    node.stop()
+    import shutil
+    shutil.rmtree(tmp_path / "node" / "filecache")
+    node2 = Node(str(tmp_path / "node"), port=0).start()
+    try:
+        code, body = call(node2, "GET", "/mounted/_search",
+                          body={"size": 25})
+        assert code == 200 and body["hits"]["total"]["value"] == 20
+        code, stats = call(node2, "GET", "/_nodes/stats")
+        fc = stats["nodes"][node2.node_id]["file_cache"]
+        assert fc["misses"] > 0
+    finally:
+        node2.stop()
+
+
+def test_mount_missing_repo_does_not_block_boot(mounted):
+    node, tmp_path = mounted
+    node.stop()
+    # repository contents vanish: node must still boot, mount stays
+    # closed (404) instead of crashing startup
+    import shutil
+    shutil.rmtree(tmp_path / "repo")
+    shutil.rmtree(tmp_path / "node" / "filecache")
+    node2 = Node(str(tmp_path / "node"), port=0).start()
+    try:
+        assert call(node2, "GET", "/_cluster/health")[0] == 200
+        assert call(node2, "GET", "/mounted/_search", body={})[0] == 404
+    finally:
+        node2.stop()
+
+
+def test_file_cache_pin_and_shrink(tmp_path):
+    """Review regressions: (a) materializing a shard bigger than the
+    whole budget must pin its file set (fetching file N previously
+    evicted file 1's blob from under its symlink); (b) shrinking
+    max_bytes dynamically reclaims disk immediately."""
+    fc = FileCache(str(tmp_path / "fc"), max_bytes=50)
+    with fc.pin({"a", "b", "c"}):
+        fc.get("a", lambda: b"x" * 40)
+        fc.get("b", lambda: b"x" * 40)
+        fc.get("c", lambda: b"x" * 40)
+        assert fc.stats()["entries"] == 3   # pinned set exceeds budget
+    # pins released: next accounting evicts back toward the budget
+    assert fc.stats()["size_in_bytes"] <= 50
+    fc2 = FileCache(str(tmp_path / "fc2"), max_bytes=1000)
+    for i in range(5):
+        fc2.get(f"s{i}", lambda: b"y" * 100)
+    fc2.set_max_bytes(250)
+    st = fc2.stats()
+    assert st["size_in_bytes"] <= 250 and st["evictions"] >= 3
+    import os
+    assert len(os.listdir(tmp_path / "fc2")) == st["entries"]
+
+
+def test_mount_blocks_mapping_updates(mounted):
+    node, _ = mounted
+    code, body = call(node, "PUT", "/mounted/_mapping",
+                      {"properties": {"extra": {"type": "keyword"}}})
+    assert code == 403, body
+
+
+def test_mount_larger_than_cache_budget(tmp_path):
+    """A mount whose file set exceeds the cache budget still opens (over
+    budget while pinned) and searches correctly."""
+    node = Node(str(tmp_path / "node"), port=0).start()
+    try:
+        call(node, "PUT", "/_snapshot/r", {
+            "type": "fs", "settings": {"location": str(tmp_path / "r")}})
+        call(node, "PUT", "/_cluster/settings", {
+            "persistent": {"node.searchable_snapshot.cache.size": 1}})
+        call(node, "PUT", "/big", {"mappings": {"properties": {
+            "t": {"type": "text"}}}})
+        for i in range(30):
+            call(node, "PUT", f"/big/_doc/{i}", {"t": f"payload {i}"})
+        call(node, "POST", "/big/_refresh")
+        call(node, "PUT", "/_snapshot/r/s", {"indices": "big"})
+        call(node, "DELETE", "/big")
+        code, body = call(node, "POST", "/_snapshot/r/s/_restore", {
+            "indices": "big", "rename_pattern": "big",
+            "rename_replacement": "bigm",
+            "storage_type": "remote_snapshot"})
+        assert code == 200, body
+        code, body = call(node, "GET", "/bigm/_search", body={"size": 0})
+        assert code == 200 and body["hits"]["total"]["value"] == 30
+    finally:
+        node.stop()
