@@ -1,0 +1,79 @@
+"""Span / interval matching as batched device programs.
+
+Lucene's span family (``SpanNearQuery``, ``SpanFirstQuery``) and the
+intervals query walk position iterators doc-at-a-time (ref lucene
+``NearSpansOrdered``; ref server/src/main/java/org/opensearch/index/
+query/SpanNearQueryBuilder.java:51, IntervalQueryBuilder.java:43).  The
+TPU formulation extends the phrase kernel's (doc, position) key sets:
+
+- ordered near: anchor every occurrence of clause 0; for each later
+  clause greedily take its SMALLEST position after the previous clause's
+  match (binary search on the sorted key array).  Greedy-minimal is
+  optimal (exchange argument), so an anchor matches iff the greedy chain
+  ends within ``last - first - (k-1) <= slop``.
+- unordered near (2 clauses): nearest occurrence of the other term on
+  either side of the anchor, ``|gap| <= slop``.
+- first: anchor position ``< end``.
+
+Match frequency per doc is a scatter-add of surviving anchors, scored
+BM25-style like the phrase kernel.
+"""
+
+from __future__ import annotations
+
+import opensearch_tpu.common.jaxenv  # noqa: F401
+
+import jax.numpy as jnp
+
+from opensearch_tpu.ops.phrase import (KEY_PAD, POS_BASE,
+                                       gather_term_positions)
+
+
+def span_near_freqs(postings, term_ids, term_active, *,
+                    budgets: tuple[int, ...], n_pad: int, ordered: bool,
+                    slop, end):
+    """Per-doc count of clause-0 occurrences that start a span match.
+
+    ``slop`` (traced scalar): max total gap between consecutive clauses.
+    ``end`` (traced scalar): spans must start before this analyzer
+    position (span_first); pass a huge value to disable.
+    """
+    docs0, pos0, ok = gather_term_positions(
+        postings["offsets"], postings["pos_offsets"],
+        postings["positions"], postings["doc_ids"], term_ids[0],
+        term_active[0], budget=budgets[0], pad_doc=n_pad - 1)
+    ok = ok & (pos0 < end)
+    prev = pos0
+    for j in range(1, len(budgets)):
+        docs_j, pos_j, valid_j = gather_term_positions(
+            postings["offsets"], postings["pos_offsets"],
+            postings["positions"], postings["doc_ids"], term_ids[j],
+            term_active[j], budget=budgets[j], pad_doc=n_pad - 1)
+        keys_j = jnp.where(valid_j,
+                           docs_j.astype(jnp.int64) * POS_BASE + pos_j,
+                           KEY_PAD)
+        anchor_key = docs0.astype(jnp.int64) * POS_BASE + prev
+        if ordered:
+            # smallest occurrence strictly after the previous match
+            loc = jnp.searchsorted(keys_j, anchor_key, side="right")
+            loc = jnp.clip(loc, 0, budgets[j] - 1)
+            key = keys_j[loc]
+            same_doc = (key // POS_BASE) == docs0
+            ok = ok & same_doc & (key != KEY_PAD)
+            prev = jnp.where(same_doc, (key % POS_BASE).astype(prev.dtype),
+                             prev)
+        else:
+            # nearest occurrence on either side of the anchor
+            loc = jnp.searchsorted(keys_j, anchor_key)
+            hi = jnp.clip(loc, 0, budgets[j] - 1)
+            lo = jnp.clip(loc - 1, 0, budgets[j] - 1)
+            def gap(key):
+                same = (key // POS_BASE) == docs0
+                g = jnp.abs((key % POS_BASE) - pos0) - 1
+                return jnp.where(same & (key != KEY_PAD), g, POS_BASE)
+            best = jnp.minimum(gap(keys_j[hi]), gap(keys_j[lo]))
+            ok = ok & (best <= slop)
+    if ordered and len(budgets) > 1:
+        ok = ok & (prev - pos0 - (len(budgets) - 1) <= slop)
+    return jnp.zeros(n_pad, jnp.float32).at[docs0].add(
+        ok.astype(jnp.float32))

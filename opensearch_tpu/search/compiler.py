@@ -529,7 +529,7 @@ def _c_knn(q, ctx, scored):
     import jax.numpy as jnp
 
     from opensearch_tpu.ops.ivf import IvfPqIndex, ivf_search, ivfpq_search_l2
-    from opensearch_tpu.ops.knn import knn_topk
+    from opensearch_tpu.ops.knn import knn_topk_auto
 
     ft = ctx.field_type(q.field)
     if ft is None:
@@ -600,8 +600,8 @@ def _c_knn(q, ctx, scored):
                 vals, idx = ivf_search(*staged, qvec_j, valid,
                                        space=space, k=kk, nprobe=nprobe)
         else:
-            vals, idx = knn_topk(vcol["values"], valid, qvec_j,
-                                 space=space, k=kk)
+            vals, idx = knn_topk_auto(vcol["values"], valid, qvec_j,
+                                      space=space, k=kk)
         pending.append((seg_order, vals, idx))
     # phase 2: one host sync for all segments' top-k
     candidates = []          # (score, seg_order, local)
@@ -861,6 +861,170 @@ def _c_geo_bounding_box(q, ctx, scored):
              "right": q.right, "boost": q.boost})
 
 
+# span end disabled: any analyzer position is < this (< ops.phrase
+# POS_BASE so doc*POS_BASE+pos arithmetic can't overflow)
+_SPAN_NO_END = 1 << 21
+
+
+def _span_near_state(ctx, field, terms, *, slop, ordered, end, boost,
+                     scored):
+    stats = ctx.field_stats(field)
+    idf_sum = float(np.sum(_idfs_for(ctx, field, terms)))
+    bind = {"terms": tuple(terms), "slop": int(slop), "end": int(end),
+            "idf_sum": idf_sum, "boost": boost, "avgdl": stats.avgdl}
+    return P.SpanNearPlan(field=field, ordered=ordered,
+                          scored=scored), bind
+
+
+def _c_span_term(q, ctx, scored):
+    ft = _require_ft(ctx, q.field, "span_term")
+    if ft is None:
+        return _none()
+    return _term_bag(ctx, q.field, [str(q.value)], 1, q.boost, scored)
+
+
+def _span_clause_terms(clauses, qname):
+    """Validate span sub-clauses: span_term only, one shared field."""
+    field, terms = None, []
+    for c in clauses:
+        if not isinstance(c, dsl.SpanTermQuery):
+            raise IllegalArgumentError(
+                f"[{qname}] supports span_term clauses only, got "
+                f"[{type(c).__name__}]")
+        if field is None:
+            field = c.field
+        elif c.field != field:
+            raise IllegalArgumentError(
+                f"[{qname}] clauses must target a single field, got "
+                f"[{field}] and [{c.field}]")
+        terms.append(str(c.value))
+    return field, terms
+
+
+def _c_span_near(q, ctx, scored):
+    field, terms = _span_clause_terms(q.clauses, "span_near")
+    ft = _require_ft(ctx, field, "span_near")
+    if ft is None:
+        return _none()
+    if len(terms) == 1:
+        return _term_bag(ctx, field, terms, 1, q.boost, scored)
+    if not q.in_order and len(terms) > 2:
+        raise IllegalArgumentError(
+            "[span_near] with [in_order]=false supports at most 2 "
+            "clauses (unordered minimal-window matching beyond pairs "
+            "is not implemented)")
+    return _span_near_state(ctx, field, terms, slop=q.slop,
+                            ordered=q.in_order, end=_SPAN_NO_END,
+                            boost=q.boost, scored=scored)
+
+
+def _c_span_first(q, ctx, scored):
+    # restricted to a span_term match so 'span ends before [end]'
+    # is exact (a single term at pos occupies [pos, pos+1))
+    if not isinstance(q.match, dsl.SpanTermQuery):
+        raise IllegalArgumentError(
+            "[span_first] supports a span_term [match] only")
+    ft = _require_ft(ctx, q.match.field, "span_first")
+    if ft is None:
+        return _none()
+    return _span_near_state(ctx, q.match.field, [str(q.match.value)],
+                            slop=0, ordered=True, end=q.end,
+                            boost=q.boost, scored=scored)
+
+
+def _c_span_or(q, ctx, scored):
+    _span_clause_terms(q.clauses, "span_or")   # validation only
+    return compile_query(
+        dsl.BoolQuery(should=list(q.clauses), minimum_should_match="1",
+                      boost=q.boost), ctx, scored)
+
+
+def _c_intervals(q, ctx, scored):
+    """intervals: match / any_of / all_of rules (ref
+    IntervalQueryBuilder.java:43).  match compiles to the span kernel;
+    any_of is a should-of-1; all_of with unbounded gaps and no order is
+    positionless AND, otherwise its sub-rules must be single terms so it
+    flattens to one ordered/unordered near."""
+    ft = _require_ft(ctx, q.field, "intervals")
+    if ft is None:
+        return _none()
+
+    def rule_terms(rule):
+        m = rule.get("match")
+        if m is None or not isinstance(m, dict):
+            return None
+        analyzer = ctx.mapper.analyzers.get(ft.search_analyzer_name)
+        return [t.term for t in analyzer.analyze(str(m.get("query", "")))]
+
+    def compile_rule(rule):
+        if len(rule) != 1:
+            raise IllegalArgumentError(
+                f"[intervals] rule must have exactly one key, got "
+                f"{sorted(rule)}")
+        kind, body = next(iter(rule.items()))
+        if kind == "match":
+            terms = rule_terms(rule)
+            if not terms:
+                return _none()
+            ordered = bool(body.get("ordered", False))
+            max_gaps = int(body.get("max_gaps", -1))
+            if len(terms) == 1:
+                return _term_bag(ctx, q.field, terms, 1, q.boost, scored)
+            if max_gaps < 0 and not ordered:
+                return compile_query(dsl.BoolQuery(must=[
+                    dsl.TermQuery(field=q.field, value=t)
+                    for t in terms]), ctx, scored)
+            if not ordered and len(terms) > 2:
+                raise IllegalArgumentError(
+                    "[intervals] unordered [match] with [max_gaps] "
+                    "supports at most 2 terms")
+            slop = max_gaps if max_gaps >= 0 else _SPAN_NO_END
+            return _span_near_state(ctx, q.field, terms, slop=slop,
+                                    ordered=ordered, end=_SPAN_NO_END,
+                                    boost=q.boost, scored=scored)
+        if kind in ("any_of", "all_of"):
+            subs = body.get("intervals") or []
+            if not subs:
+                raise IllegalArgumentError(
+                    f"[intervals] [{kind}] requires [intervals]")
+            if kind == "all_of" and (body.get("ordered")
+                                     or int(body.get("max_gaps", -1)) >= 0):
+                # positional all_of flattens iff every sub-rule is a
+                # single-term match
+                flat = [rule_terms(s) for s in subs]
+                if any(t is None or len(t) != 1 for t in flat):
+                    raise IllegalArgumentError(
+                        "[intervals] [all_of] with [ordered]/[max_gaps] "
+                        "supports single-term [match] sub-rules only")
+                terms = [t[0] for t in flat]
+                ordered = bool(body.get("ordered", False))
+                max_gaps = int(body.get("max_gaps", -1))
+                if not ordered and len(terms) > 2:
+                    raise IllegalArgumentError(
+                        "[intervals] unordered [all_of] with [max_gaps] "
+                        "supports at most 2 sub-rules")
+                slop = max_gaps if max_gaps >= 0 else _SPAN_NO_END
+                return _span_near_state(ctx, q.field, terms, slop=slop,
+                                        ordered=ordered,
+                                        end=_SPAN_NO_END,
+                                        boost=q.boost, scored=scored)
+            wrapped = [dsl.IntervalsQuery(field=q.field, rule=s)
+                       for s in subs]
+            if kind == "any_of":
+                return compile_query(
+                    dsl.BoolQuery(should=wrapped,
+                                  minimum_should_match="1",
+                                  boost=q.boost), ctx, scored)
+            return compile_query(dsl.BoolQuery(must=wrapped,
+                                               boost=q.boost),
+                                 ctx, scored)
+        raise IllegalArgumentError(
+            f"[intervals] unsupported rule [{kind}] — supported: "
+            "match, any_of, all_of")
+
+    return compile_rule(q.rule)
+
+
 _DECAY_FNS = ("gauss", "exp", "linear")
 
 
@@ -1113,4 +1277,9 @@ _COMPILERS = {
     dsl.MoreLikeThisQuery: _c_more_like_this,
     dsl.GeoDistanceQuery: _c_geo_distance,
     dsl.GeoBoundingBoxQuery: _c_geo_bounding_box,
+    dsl.SpanTermQuery: _c_span_term,
+    dsl.SpanNearQuery: _c_span_near,
+    dsl.SpanFirstQuery: _c_span_first,
+    dsl.SpanOrQuery: _c_span_or,
+    dsl.IntervalsQuery: _c_intervals,
 }

@@ -41,6 +41,7 @@ from opensearch_tpu.index.segment import (LONG_MISSING_MAX, pad_bucket,
 from opensearch_tpu.ops import bm25 as bm25_ops
 from opensearch_tpu.ops import filters as filter_ops
 from opensearch_tpu.ops import phrase as phrase_ops
+from opensearch_tpu.ops import span as span_ops
 
 _I32 = np.int32
 _F32 = np.float32
@@ -204,6 +205,68 @@ class PhrasePlan(Plan):
         n_pad = A["live"].shape[0]
         tf = phrase_ops.phrase_freqs(
             p, tids, active, positions, budgets=budgets, n_pad=n_pad)
+        matched = tf > 0
+        if not self.scored:
+            return jnp.zeros(n_pad, jnp.float32), matched
+        dl = p["doc_lens"]
+        norm = bm25_ops.K1_DEFAULT * (1.0 - bm25_ops.B_DEFAULT
+                                      + bm25_ops.B_DEFAULT * dl / avgdl)
+        scores = idf_sum * boost * tf / (tf + norm)
+        return jnp.where(matched, scores, 0.0), matched
+
+
+@dataclass(frozen=True)
+class SpanNearPlan(Plan):
+    """Span/interval proximity over one field (span_near, span_first,
+    intervals match — ref SpanNearQueryBuilder.java:51,
+    IntervalQueryBuilder.java:43).  bind: {terms, slop, end, idf_sum,
+    boost, avgdl}; slop and end are dynamic scalars so tuning proximity
+    never recompiles."""
+
+    field: str = ""
+    ordered: bool = True
+    scored: bool = True
+
+    def arrays(self):
+        return frozenset({("postings", self.field)})
+
+    def can_match(self, bind, seg):
+        pf = seg.postings.get(self.field)
+        if pf is None:
+            return False
+        return all(pf.term_id(t) >= 0 for t in bind["terms"])
+
+    def prepare(self, bind, seg, dseg, ctx):
+        terms = bind["terms"]
+        pf = seg.postings.get(self.field)
+        m = len(terms)
+        tids = np.zeros(m, dtype=_I32)
+        active = np.zeros(m, dtype=bool)
+        budgets = []
+        for j, t in enumerate(terms):
+            tid = pf.term_id(t) if pf is not None else -1
+            count = 0
+            if tid >= 0:
+                tids[j] = tid
+                active[j] = True
+                e0, e1 = int(pf.offsets[tid]), int(pf.offsets[tid + 1])
+                count = int(pf.pos_offsets[e1] - pf.pos_offsets[e0])
+            budgets.append(pad_bucket(count, minimum=1024))
+        ins = (jnp.asarray(tids), jnp.asarray(active),
+               _scalar(bind["slop"], _I32), _scalar(bind["end"], _I32),
+               _scalar(bind["idf_sum"], _F32),
+               _scalar(bind["boost"], _F32),
+               _scalar(bind["avgdl"], _F32))
+        return (tuple(budgets),), ins
+
+    def eval(self, A, dims, ins):
+        (budgets,) = dims
+        tids, active, slop, end, idf_sum, boost, avgdl = ins
+        p = A["postings"][self.field]
+        n_pad = A["live"].shape[0]
+        tf = span_ops.span_near_freqs(
+            p, tids, active, budgets=budgets, n_pad=n_pad,
+            ordered=self.ordered, slop=slop, end=end)
         matched = tf > 0
         if not self.scored:
             return jnp.zeros(n_pad, jnp.float32), matched

@@ -231,6 +231,49 @@ class HybridQuery(Query):
 
 
 @dataclass
+class SpanTermQuery(Query):
+    """Positional term (ref index/query/SpanTermQueryBuilder.java:48)."""
+
+    field: str = ""
+    value: Any = None
+
+
+@dataclass
+class SpanNearQuery(Query):
+    """Terms within ``slop`` positions of each other (ref
+    SpanNearQueryBuilder.java:51)."""
+
+    clauses: list = dc_field(default_factory=list)
+    slop: int = 0
+    in_order: bool = True
+
+
+@dataclass
+class SpanFirstQuery(Query):
+    """Match near the start of the field (ref
+    SpanFirstQueryBuilder.java:47)."""
+
+    match: Optional[Query] = None
+    end: int = 0
+
+
+@dataclass
+class SpanOrQuery(Query):
+    """Union of span clauses (ref SpanOrQueryBuilder.java:46)."""
+
+    clauses: list = dc_field(default_factory=list)
+
+
+@dataclass
+class IntervalsQuery(Query):
+    """Interval rules over one field (ref IntervalQueryBuilder.java:43);
+    the rule tree is validated/compiled per shard."""
+
+    field: str = ""
+    rule: dict = dc_field(default_factory=dict)
+
+
+@dataclass
 class ScriptScoreQuery(Query):
     query: Optional[Query] = None
     script: dict = dc_field(default_factory=dict)
@@ -844,6 +887,45 @@ def _parse_script_score(body):
                             boost=_boost(body))
 
 
+def _parse_span_term(body):
+    field, v = _field_kv(body, "span_term")
+    if isinstance(v, dict):
+        return SpanTermQuery(field=field, value=v.get("value"),
+                             boost=float(v.get("boost", 1.0)))
+    return SpanTermQuery(field=field, value=v)
+
+
+def _parse_span_near(body):
+    clauses = [parse_query(c) for c in body.get("clauses") or []]
+    if not clauses:
+        raise ParsingError("[span_near] requires [clauses]")
+    return SpanNearQuery(clauses=clauses,
+                         slop=int(body.get("slop", 0)),
+                         in_order=bool(body.get("in_order", True)),
+                         boost=_boost(body))
+
+
+def _parse_span_first(body):
+    if "match" not in body or "end" not in body:
+        raise ParsingError("[span_first] requires [match] and [end]")
+    return SpanFirstQuery(match=parse_query(body["match"]),
+                          end=int(body["end"]), boost=_boost(body))
+
+
+def _parse_span_or(body):
+    clauses = [parse_query(c) for c in body.get("clauses") or []]
+    if not clauses:
+        raise ParsingError("[span_or] requires [clauses]")
+    return SpanOrQuery(clauses=clauses, boost=_boost(body))
+
+
+def _parse_intervals(body):
+    field, rule = _field_kv(body, "intervals")
+    if not isinstance(rule, dict) or len(rule) == 0:
+        raise ParsingError(f"[intervals] on [{field}] requires a rule")
+    return IntervalsQuery(field=field, rule=rule)
+
+
 def _parse_simple_query_string(body):
     return SimpleQueryStringQuery(
         query=str(body.get("query", "")),
@@ -884,4 +966,9 @@ _PARSERS = {
     "geo_bounding_box": _parse_geo_bounding_box,
     "query_string": _parse_query_string,
     "simple_query_string": _parse_simple_query_string,
+    "span_term": _parse_span_term,
+    "span_near": _parse_span_near,
+    "span_first": _parse_span_first,
+    "span_or": _parse_span_or,
+    "intervals": _parse_intervals,
 }

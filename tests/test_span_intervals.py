@@ -1,0 +1,175 @@
+"""Span family + intervals queries against a positional python oracle
+(ref SpanNearQueryBuilder.java:51, SpanFirstQueryBuilder.java:47,
+IntervalQueryBuilder.java:43)."""
+
+import pytest
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+from opensearch_tpu.index.segment import SegmentWriter
+from opensearch_tpu.mapping.mapper import DocumentMapper
+from opensearch_tpu.search.executor import ShardSearcher
+
+DOCS = [
+    "quick brown fox jumps over the lazy dog",        # 0
+    "quick fox",                                      # 1
+    "fox quick",                                      # 2
+    "quick red sly brown fox",                        # 3
+    "the brown quick fox",                            # 4
+    "dog jumps",                                      # 5
+    "quick brown cat and a slow fox",                 # 6
+]
+
+
+@pytest.fixture(scope="module")
+def searcher():
+    mapper = DocumentMapper({"properties": {"t": {"type": "text"}}})
+    writer = SegmentWriter()
+    half = [mapper.parse(str(i), {"t": t}) for i, t in enumerate(DOCS[:4])]
+    rest = [mapper.parse(str(i + 4), {"t": t})
+            for i, t in enumerate(DOCS[4:])]
+    return ShardSearcher([writer.build(half, "sp0"),
+                          writer.build(rest, "sp1")], mapper)
+
+
+def ids(resp):
+    return sorted(int(h["_id"]) for h in resp["hits"]["hits"])
+
+
+def oracle_near(terms, slop, in_order):
+    out = []
+    for i, d in enumerate(DOCS):
+        toks = d.split()
+        pos = {t: [p for p, w in enumerate(toks) if w == t]
+               for t in terms}
+        if any(not pos[t] for t in terms):
+            continue
+        ok = False
+        if in_order:
+            for p0 in pos[terms[0]]:
+                prev, good = p0, True
+                for t in terms[1:]:
+                    nxt = [p for p in pos[t] if p > prev]
+                    if not nxt:
+                        good = False
+                        break
+                    prev = min(nxt)
+                if good and prev - p0 - (len(terms) - 1) <= slop:
+                    ok = True
+                    break
+        else:
+            assert len(terms) == 2
+            ok = any(abs(p1 - p0) - 1 <= slop
+                     for p0 in pos[terms[0]] for p1 in pos[terms[1]])
+        if ok:
+            out.append(i)
+    return out
+
+
+def test_span_term(searcher):
+    resp = searcher.search({"query": {"span_term": {"t": "fox"}}})
+    assert ids(resp) == [0, 1, 2, 3, 4, 6]
+
+
+@pytest.mark.parametrize("slop,in_order", [(0, True), (1, True),
+                                           (3, True), (0, False),
+                                           (2, False)])
+def test_span_near_vs_oracle(searcher, slop, in_order):
+    body = {"query": {"span_near": {
+        "clauses": [{"span_term": {"t": "quick"}},
+                    {"span_term": {"t": "fox"}}],
+        "slop": slop, "in_order": in_order}}, "size": 10}
+    assert ids(searcher.search(body)) == \
+        oracle_near(["quick", "fox"], slop, in_order), (slop, in_order)
+
+
+def test_span_near_three_clauses_ordered(searcher):
+    body = {"query": {"span_near": {
+        "clauses": [{"span_term": {"t": "quick"}},
+                    {"span_term": {"t": "brown"}},
+                    {"span_term": {"t": "fox"}}],
+        "slop": 2, "in_order": True}}, "size": 10}
+    assert ids(searcher.search(body)) == \
+        oracle_near(["quick", "brown", "fox"], 2, True)
+
+
+def test_span_near_validation(searcher):
+    with pytest.raises(OpenSearchTpuError):
+        searcher.search({"query": {"span_near": {
+            "clauses": [{"span_term": {"t": "a"}},
+                        {"span_term": {"t": "b"}},
+                        {"span_term": {"t": "c"}}],
+            "in_order": False}}})
+    with pytest.raises(OpenSearchTpuError):
+        searcher.search({"query": {"span_near": {
+            "clauses": [{"term": {"t": "a"}}]}}})
+    with pytest.raises(OpenSearchTpuError):
+        searcher.search({"query": {"span_near": {"clauses": []}}})
+
+
+def test_span_first(searcher):
+    # 'fox' within the first 2 positions
+    resp = searcher.search({"query": {"span_first": {
+        "match": {"span_term": {"t": "fox"}}, "end": 2}}, "size": 10})
+    assert ids(resp) == [i for i, d in enumerate(DOCS)
+                         if "fox" in d.split()[:2]]
+
+
+def test_span_or(searcher):
+    resp = searcher.search({"query": {"span_or": {
+        "clauses": [{"span_term": {"t": "dog"}},
+                    {"span_term": {"t": "cat"}}]}}, "size": 10})
+    assert ids(resp) == [0, 5, 6]
+
+
+def test_intervals_match_ordered_gaps(searcher):
+    body = {"query": {"intervals": {"t": {"match": {
+        "query": "quick fox", "ordered": True, "max_gaps": 0}}}},
+        "size": 10}
+    assert ids(searcher.search(body)) == oracle_near(
+        ["quick", "fox"], 0, True)
+    body["query"]["intervals"]["t"]["match"]["max_gaps"] = 3
+    assert ids(searcher.search(body)) == oracle_near(
+        ["quick", "fox"], 3, True)
+
+
+def test_intervals_match_unordered_unbounded_is_and(searcher):
+    body = {"query": {"intervals": {"t": {"match": {
+        "query": "fox quick"}}}}, "size": 10}
+    assert ids(searcher.search(body)) == [
+        i for i, d in enumerate(DOCS)
+        if {"fox", "quick"} <= set(d.split())]
+
+
+def test_intervals_any_of_all_of(searcher):
+    body = {"query": {"intervals": {"t": {"any_of": {"intervals": [
+        {"match": {"query": "dog"}},
+        {"match": {"query": "cat"}}]}}}}, "size": 10}
+    assert ids(searcher.search(body)) == [0, 5, 6]
+    body = {"query": {"intervals": {"t": {"all_of": {
+        "ordered": True, "max_gaps": 0, "intervals": [
+            {"match": {"query": "brown"}},
+            {"match": {"query": "fox"}}]}}}}, "size": 10}
+    assert ids(searcher.search(body)) == oracle_near(
+        ["brown", "fox"], 0, True)
+
+
+def test_intervals_validation(searcher):
+    with pytest.raises(OpenSearchTpuError):
+        searcher.search({"query": {"intervals": {"t": {
+            "fuzzy": {"term": "qick"}}}}})
+    with pytest.raises(OpenSearchTpuError):
+        searcher.search({"query": {"intervals": {"t": {}}}})
+
+
+def test_span_scores_positive_and_slop_dynamic(searcher):
+    """slop is a dynamic input: widening it must not change plan
+    structure (same compiled program), and scores stay BM25-positive."""
+    base = {"query": {"span_near": {
+        "clauses": [{"span_term": {"t": "quick"}},
+                    {"span_term": {"t": "fox"}}],
+        "slop": 0, "in_order": True}}, "size": 10}
+    r0 = searcher.search(base)
+    assert all(h["_score"] > 0 for h in r0["hits"]["hits"])
+    base["query"]["span_near"]["slop"] = 3
+    r3 = searcher.search(base)
+    assert set(ids(r0)) <= set(ids(r3))
