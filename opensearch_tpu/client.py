@@ -1,0 +1,437 @@
+"""Python client mirroring the ``opensearch-py`` surface.
+
+The reference ships language clients over its REST layer (ref
+clients/..., and the separate opensearch-py project whose ``OpenSearch``
+class + namespaced ``.indices/.cluster/.snapshot/...`` sub-clients are
+the de-facto API).  This client speaks the same REST dialect against an
+``opensearch_tpu`` node: method names, argument shapes, exception
+classes (``NotFoundError``/``RequestError``/``ConflictError``/...) and
+the ``helpers.bulk`` convenience match opensearch-py so user code ports
+by changing the import.
+
+Zero third-party deps: urllib transport with per-host failover.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Optional
+
+
+class TransportError(Exception):
+    """Base client error; mirrors opensearchpy.exceptions.TransportError
+    (status_code, error, info)."""
+
+    def __init__(self, status_code, error, info=None):
+        super().__init__(status_code, error)
+        self.status_code = status_code
+        self.error = error
+        self.info = info
+
+
+class ConnectionError(TransportError):          # noqa: A001 — opensearch-py name
+    pass
+
+
+class RequestError(TransportError):             # 400
+    pass
+
+
+class AuthorizationException(TransportError):   # 403
+    pass
+
+
+class NotFoundError(TransportError):            # 404
+    pass
+
+
+class ConflictError(TransportError):            # 409
+    pass
+
+
+_HTTP_EXCEPTIONS = {400: RequestError, 403: AuthorizationException,
+                    404: NotFoundError, 409: ConflictError}
+
+
+class Transport:
+    def __init__(self, hosts, timeout: float = 30.0):
+        self.hosts = []
+        for h in hosts:
+            if isinstance(h, str):
+                self.hosts.append(h.rstrip("/"))
+            else:
+                self.hosts.append(
+                    f"http://{h.get('host', 'localhost')}:"
+                    f"{h.get('port', 9200)}")
+        self.timeout = timeout
+
+    def perform_request(self, method: str, path: str,
+                        params: Optional[dict] = None, body=None,
+                        headers: Optional[dict] = None):
+        if params:
+            from urllib.parse import urlencode
+            qs = urlencode({k: (str(v).lower()
+                                if isinstance(v, bool) else v)
+                            for k, v in params.items() if v is not None})
+            if qs:
+                path = f"{path}?{qs}"
+        hdrs = dict(headers or {})
+        if isinstance(body, (dict, list)):
+            data = json.dumps(body).encode()
+            hdrs.setdefault("Content-Type", "application/json")
+        elif isinstance(body, str):
+            data = body.encode()
+            hdrs.setdefault("Content-Type", "application/x-ndjson")
+        else:
+            data = body
+        last_err = None
+        for host in self.hosts:
+            req = urllib.request.Request(host + path, data=data,
+                                         method=method, headers=hdrs)
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout) as resp:
+                    payload = resp.read()
+                    return json.loads(payload) if payload else {}
+            except urllib.error.HTTPError as e:
+                payload = e.read()
+                try:
+                    info = json.loads(payload) if payload else {}
+                except ValueError:
+                    info = {"raw": payload.decode(errors="replace")}
+                err = (info.get("error", {}) or {})
+                reason = (err.get("reason") if isinstance(err, dict)
+                          else str(err)) or str(e)
+                cls = _HTTP_EXCEPTIONS.get(e.code, TransportError)
+                raise cls(e.code, reason, info) from None
+            except (urllib.error.URLError, OSError) as e:
+                last_err = e                   # try the next host
+        raise ConnectionError("N/A", str(last_err), last_err)
+
+
+class _Namespace:
+    def __init__(self, transport: Transport):
+        self.transport = transport
+
+
+def _idx(index) -> str:
+    return ",".join(index) if isinstance(index, (list, tuple)) else index
+
+
+class IndicesClient(_Namespace):
+    def create(self, index, body=None, params=None):
+        return self.transport.perform_request(
+            "PUT", f"/{index}", params, body or {})
+
+    def delete(self, index, params=None):
+        return self.transport.perform_request(
+            "DELETE", f"/{_idx(index)}", params)
+
+    def exists(self, index, params=None) -> bool:
+        try:
+            self.transport.perform_request("GET", f"/{_idx(index)}",
+                                           params)
+            return True
+        except NotFoundError:
+            return False
+
+    def refresh(self, index=None, params=None):
+        path = f"/{_idx(index)}/_refresh" if index else "/_refresh"
+        return self.transport.perform_request("POST", path, params)
+
+    def flush(self, index=None, params=None):
+        path = f"/{_idx(index)}/_flush" if index else "/_flush"
+        return self.transport.perform_request("POST", path, params)
+
+    def forcemerge(self, index=None, params=None):
+        path = (f"/{_idx(index)}/_forcemerge" if index
+                else "/_forcemerge")
+        return self.transport.perform_request("POST", path, params)
+
+    def get(self, index, params=None):
+        return self.transport.perform_request("GET", f"/{_idx(index)}",
+                                              params)
+
+    def get_mapping(self, index, params=None):
+        return self.transport.perform_request(
+            "GET", f"/{_idx(index)}/_mapping", params)
+
+    def put_mapping(self, index, body, params=None):
+        return self.transport.perform_request(
+            "PUT", f"/{_idx(index)}/_mapping", params, body)
+
+    def get_settings(self, index, params=None):
+        return self.transport.perform_request(
+            "GET", f"/{_idx(index)}/_settings", params)
+
+    def put_settings(self, body, index, params=None):
+        return self.transport.perform_request(
+            "PUT", f"/{_idx(index)}/_settings", params, body)
+
+    def analyze(self, index=None, body=None, params=None):
+        path = f"/{index}/_analyze" if index else "/_analyze"
+        return self.transport.perform_request("GET", path, params, body)
+
+    def get_alias(self, index=None, name=None, params=None):
+        path = "/_alias" if name is None else f"/_alias/{name}"
+        if index:
+            path = f"/{_idx(index)}{path}"
+        return self.transport.perform_request("GET", path, params)
+
+    def update_aliases(self, body, params=None):
+        return self.transport.perform_request("POST", "/_aliases",
+                                              params, body)
+
+    def put_index_template(self, name, body, params=None):
+        return self.transport.perform_request(
+            "PUT", f"/_index_template/{name}", params, body)
+
+    def delete_index_template(self, name, params=None):
+        return self.transport.perform_request(
+            "DELETE", f"/_index_template/{name}", params)
+
+
+class ClusterClient(_Namespace):
+    def health(self, params=None):
+        return self.transport.perform_request("GET", "/_cluster/health",
+                                              params)
+
+    def state(self, params=None):
+        return self.transport.perform_request("GET", "/_cluster/state",
+                                              params)
+
+    def get_settings(self, params=None):
+        return self.transport.perform_request(
+            "GET", "/_cluster/settings", params)
+
+    def put_settings(self, body, params=None):
+        return self.transport.perform_request(
+            "PUT", "/_cluster/settings", params, body)
+
+
+class CatClient(_Namespace):
+    def indices(self, params=None):
+        p = {"format": "json", **(params or {})}
+        return self.transport.perform_request("GET", "/_cat/indices", p)
+
+    def count(self, index=None, params=None):
+        p = {"format": "json", **(params or {})}
+        path = f"/_cat/count/{_idx(index)}" if index else "/_cat/count"
+        return self.transport.perform_request("GET", path, p)
+
+
+class SnapshotClient(_Namespace):
+    def create_repository(self, repository, body, params=None):
+        return self.transport.perform_request(
+            "PUT", f"/_snapshot/{repository}", params, body)
+
+    def delete_repository(self, repository, params=None):
+        return self.transport.perform_request(
+            "DELETE", f"/_snapshot/{repository}", params)
+
+    def create(self, repository, snapshot, body=None, params=None):
+        return self.transport.perform_request(
+            "PUT", f"/_snapshot/{repository}/{snapshot}", params,
+            body or {})
+
+    def get(self, repository, snapshot, params=None):
+        return self.transport.perform_request(
+            "GET", f"/_snapshot/{repository}/{snapshot}", params)
+
+    def delete(self, repository, snapshot, params=None):
+        return self.transport.perform_request(
+            "DELETE", f"/_snapshot/{repository}/{snapshot}", params)
+
+    def restore(self, repository, snapshot, body=None, params=None):
+        return self.transport.perform_request(
+            "POST", f"/_snapshot/{repository}/{snapshot}/_restore",
+            params, body or {})
+
+
+class IngestClient(_Namespace):
+    def put_pipeline(self, id, body, params=None):       # noqa: A002
+        return self.transport.perform_request(
+            "PUT", f"/_ingest/pipeline/{id}", params, body)
+
+    def get_pipeline(self, id=None, params=None):        # noqa: A002
+        path = (f"/_ingest/pipeline/{id}" if id
+                else "/_ingest/pipeline")
+        return self.transport.perform_request("GET", path, params)
+
+    def delete_pipeline(self, id, params=None):          # noqa: A002
+        return self.transport.perform_request(
+            "DELETE", f"/_ingest/pipeline/{id}", params)
+
+    def simulate(self, body, id=None, params=None):      # noqa: A002
+        path = (f"/_ingest/pipeline/{id}/_simulate" if id
+                else "/_ingest/pipeline/_simulate")
+        return self.transport.perform_request("POST", path, params, body)
+
+
+class TasksClient(_Namespace):
+    def list(self, params=None):                         # noqa: A003
+        return self.transport.perform_request("GET", "/_tasks", params)
+
+    def cancel(self, task_id, params=None):
+        return self.transport.perform_request(
+            "POST", f"/_tasks/{task_id}/_cancel", params)
+
+
+class NodesClient(_Namespace):
+    def stats(self, params=None):
+        return self.transport.perform_request("GET", "/_nodes/stats",
+                                              params)
+
+
+class OpenSearch:
+    """Drop-in analog of ``opensearchpy.OpenSearch`` for this node."""
+
+    def __init__(self, hosts=None, timeout: float = 30.0, **_ignored):
+        hosts = hosts or [{"host": "localhost", "port": 9200}]
+        if isinstance(hosts, (str, dict)):
+            hosts = [hosts]
+        self.transport = Transport(hosts, timeout=timeout)
+        self.indices = IndicesClient(self.transport)
+        self.cluster = ClusterClient(self.transport)
+        self.cat = CatClient(self.transport)
+        self.snapshot = SnapshotClient(self.transport)
+        self.ingest = IngestClient(self.transport)
+        self.tasks = TasksClient(self.transport)
+        self.nodes = NodesClient(self.transport)
+
+    def ping(self) -> bool:
+        try:
+            self.transport.perform_request("GET", "/")
+            return True
+        except TransportError:
+            return False
+
+    def info(self):
+        return self.transport.perform_request("GET", "/")
+
+    def index(self, index, body, id=None, params=None):  # noqa: A002
+        if id is None:
+            return self.transport.perform_request(
+                "POST", f"/{index}/_doc", params, body)
+        return self.transport.perform_request(
+            "PUT", f"/{index}/_doc/{id}", params, body)
+
+    def create(self, index, id, body, params=None):      # noqa: A002
+        return self.transport.perform_request(
+            "PUT", f"/{index}/_create/{id}", params, body)
+
+    def get(self, index, id, params=None):               # noqa: A002
+        return self.transport.perform_request(
+            "GET", f"/{index}/_doc/{id}", params)
+
+    def exists(self, index, id, params=None) -> bool:    # noqa: A002
+        try:
+            self.get(index, id, params)
+            return True
+        except NotFoundError:
+            return False
+
+    def delete(self, index, id, params=None):            # noqa: A002
+        return self.transport.perform_request(
+            "DELETE", f"/{index}/_doc/{id}", params)
+
+    def update(self, index, id, body, params=None):      # noqa: A002
+        return self.transport.perform_request(
+            "POST", f"/{index}/_update/{id}", params, body)
+
+    def search(self, index=None, body=None, params=None):
+        path = (f"/{_idx(index)}/_search" if index else "/_search")
+        return self.transport.perform_request("POST", path, params,
+                                              body or {})
+
+    def msearch(self, body, index=None, params=None):
+        path = (f"/{_idx(index)}/_msearch" if index else "/_msearch")
+        if isinstance(body, list):
+            body = "\n".join(json.dumps(x) for x in body) + "\n"
+        return self.transport.perform_request("POST", path, params, body)
+
+    def count(self, index=None, body=None, params=None):
+        path = f"/{_idx(index)}/_count" if index else "/_count"
+        return self.transport.perform_request("POST", path, params,
+                                              body or {})
+
+    def mget(self, body, index=None, params=None):
+        path = f"/{index}/_mget" if index else "/_mget"
+        return self.transport.perform_request("POST", path, params, body)
+
+    def bulk(self, body, index=None, params=None):
+        path = f"/{index}/_bulk" if index else "/_bulk"
+        if isinstance(body, list):
+            body = "\n".join(json.dumps(x) for x in body) + "\n"
+        return self.transport.perform_request("POST", path, params, body)
+
+    def scroll(self, scroll_id, params=None, body=None):
+        b = dict(body or {})
+        b["scroll_id"] = scroll_id
+        return self.transport.perform_request("POST", "/_search/scroll",
+                                              params, b)
+
+    def clear_scroll(self, scroll_id, params=None):
+        return self.transport.perform_request(
+            "DELETE", "/_search/scroll", params,
+            {"scroll_id": scroll_id})
+
+    def create_pit(self, index, params=None):
+        return self.transport.perform_request(
+            "POST", f"/{_idx(index)}/_search/point_in_time",
+            params or {"keep_alive": "1m"})
+
+    def delete_pit(self, body, params=None):
+        return self.transport.perform_request(
+            "DELETE", "/_search/point_in_time", params, body)
+
+    def delete_by_query(self, index, body, params=None):
+        return self.transport.perform_request(
+            "POST", f"/{_idx(index)}/_delete_by_query", params, body)
+
+    def update_by_query(self, index, body=None, params=None):
+        return self.transport.perform_request(
+            "POST", f"/{_idx(index)}/_update_by_query", params,
+            body or {})
+
+    def reindex(self, body, params=None):
+        return self.transport.perform_request("POST", "/_reindex",
+                                              params, body)
+
+
+class helpers:                                     # noqa: N801 — opensearch-py name
+    """``opensearchpy.helpers`` analog (the bulk convenience)."""
+
+    @staticmethod
+    def bulk(client: OpenSearch, actions, chunk_size: int = 500,
+             raise_on_error: bool = True):
+        """Actions like opensearch-py: dicts with ``_index``/``_id``/
+        ``_op_type`` meta keys + source fields.  Returns (ok_count,
+        errors)."""
+        ok, errors = 0, []
+        batch = list(actions)
+        for start in range(0, len(batch), chunk_size):
+            lines = []
+            for a in batch[start:start + chunk_size]:
+                a = dict(a)
+                op = a.pop("_op_type", "index")
+                meta = {k: a.pop(k) for k in ("_index", "_id")
+                        if k in a}
+                src = a.pop("_source", a)
+                lines.append(json.dumps({op: meta}))
+                if op != "delete":
+                    lines.append(json.dumps(src))
+            resp = client.bulk("\n".join(lines) + "\n")
+            for item in resp.get("items", []):
+                res = next(iter(item.values()))
+                if res.get("status", 200) < 300:
+                    ok += 1
+                else:
+                    errors.append(item)
+        if errors and raise_on_error:
+            raise TransportError(
+                None, f"{len(errors)} document(s) failed to index",
+                errors)
+        return ok, errors

@@ -26,6 +26,7 @@ class Node:
                  cluster_name: str = "opensearch-tpu",
                  host: str = "127.0.0.1", port: int = 9200):
         self.name = name
+        self.host = host
         self.cluster_name = cluster_name
         self.node_id = uuid.uuid4().hex[:22]
         self.cluster_uuid = uuid.uuid4().hex[:22]
@@ -122,6 +123,14 @@ class Node:
         return self.http.port
 
     def start(self):
+        from opensearch_tpu.bootstrap import (default_checks,
+                                              run_bootstrap_checks)
+        # the reference enforces once the node publishes beyond
+        # loopback (BootstrapChecks.enforceLimits); dev mode only warns
+        enforce = (self.host not in ("127.0.0.1", "localhost", "::1")
+                   or os.environ.get("OSTPU_ENFORCE_BOOTSTRAP") == "1")
+        run_bootstrap_checks(default_checks(self.data_path),
+                             enforce=enforce)
         self.http.start()
         return self
 

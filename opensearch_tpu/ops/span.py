@@ -54,24 +54,37 @@ def span_near_freqs(postings, term_ids, term_active, *,
                            KEY_PAD)
         anchor_key = docs0.astype(jnp.int64) * POS_BASE + prev
         if ordered:
-            # smallest occurrence strictly after the previous match
-            loc = jnp.searchsorted(keys_j, anchor_key, side="right")
-            loc = jnp.clip(loc, 0, budgets[j] - 1)
+            # smallest occurrence strictly after the previous match; a
+            # searchsorted past the end must NOT be clamp-accepted (a
+            # clause whose position count exactly fills its bucket has
+            # no KEY_PAD slot, and the clamped last key sits BEFORE the
+            # anchor — an out-of-order false match)
+            raw = jnp.searchsorted(keys_j, anchor_key, side="right")
+            loc = jnp.clip(raw, 0, budgets[j] - 1)
             key = keys_j[loc]
             same_doc = (key // POS_BASE) == docs0
-            ok = ok & same_doc & (key != KEY_PAD)
+            ok = (ok & (raw < budgets[j]) & same_doc
+                  & (key != KEY_PAD) & (key > anchor_key))
             prev = jnp.where(same_doc, (key % POS_BASE).astype(prev.dtype),
                              prev)
         else:
-            # nearest occurrence on either side of the anchor
+            # nearest occurrence on either side of the anchor; when both
+            # clauses are the SAME term the anchor's own occurrence is
+            # in keys_j and must not satisfy itself (Lucene requires two
+            # distinct spans), so scan loc-1..loc+1 excluding self
+            self_key = jnp.where(term_ids[j] == term_ids[0],
+                                 anchor_key, jnp.int64(-1))
             loc = jnp.searchsorted(keys_j, anchor_key)
-            hi = jnp.clip(loc, 0, budgets[j] - 1)
-            lo = jnp.clip(loc - 1, 0, budgets[j] - 1)
-            def gap(key):
+
+            def gap(idx):
+                oob = (idx < 0) | (idx >= budgets[j])
+                key = keys_j[jnp.clip(idx, 0, budgets[j] - 1)]
                 same = (key // POS_BASE) == docs0
                 g = jnp.abs((key % POS_BASE) - pos0) - 1
-                return jnp.where(same & (key != KEY_PAD), g, POS_BASE)
-            best = jnp.minimum(gap(keys_j[hi]), gap(keys_j[lo]))
+                return jnp.where(same & (key != KEY_PAD) & ~oob
+                                 & (key != self_key), g, POS_BASE)
+            best = jnp.minimum(jnp.minimum(gap(loc - 1), gap(loc)),
+                               gap(loc + 1))
             ok = ok & (best <= slop)
     if ordered and len(budgets) > 1:
         ok = ok & (prev - pos0 - (len(budgets) - 1) <= slop)

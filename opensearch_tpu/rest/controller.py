@@ -27,20 +27,21 @@ from opensearch_tpu.version import __version__ as VERSION
 
 class RestRequest:
     def __init__(self, method: str, path: str, params: dict,
-                 body: Optional[bytes]):
+                 body: Optional[bytes], content_type: str = ""):
         self.method = method
         self.path = path
         self.params = params or {}
         self.raw_body = body or b""
+        self.content_type = content_type
         self.path_params: dict[str, str] = {}
 
     def json(self, default=None):
+        """Structured body, negotiated by Content-Type (JSON default;
+        YAML/CBOR via x-content, ref libs/x-content XContentType)."""
         if not self.raw_body:
             return default
-        try:
-            return json.loads(self.raw_body)
-        except json.JSONDecodeError as e:
-            raise ParsingError(f"request body is not valid JSON: {e}")
+        from opensearch_tpu.common.xcontent import from_bytes
+        return from_bytes(self.raw_body, self.content_type)
 
     def param(self, name: str, default=None):
         return self.params.get(name, self.path_params.get(name, default))
@@ -87,10 +88,11 @@ class RestController:
     }
 
     def dispatch(self, method: str, path: str, params: dict,
-                 body: Optional[bytes]) -> tuple[int, dict]:
+                 body: Optional[bytes],
+                 content_type: str = "") -> tuple[int, dict]:
         from opensearch_tpu.common import tasks as taskmod
 
-        req = RestRequest(method, path, params, body)
+        req = RestRequest(method, path, params, body, content_type)
         try:
             for route in self.routes:
                 if route.method != method:

@@ -61,7 +61,8 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 body = self.rfile.read(length) if length else b""
                 status, payload = self.server.controller.dispatch(
-                    self.command, split.path, params, body)
+                    self.command, split.path, params, body,
+                    self.headers.get("Content-Type") or "")
             finally:
                 breaker.release(length)
         is_cat = split.path.startswith("/_cat") and params.get("format") != "json"
@@ -69,8 +70,24 @@ class _Handler(BaseHTTPRequestHandler):
             data = _cat_table(payload, want_header="v" in params)
             ctype = "text/plain; charset=UTF-8"
         else:
-            data = (json.dumps(payload) + "\n").encode()
-            ctype = "application/json; charset=UTF-8"
+            # response format negotiation (x-content: json/yaml/cbor via
+            # ?format= or Accept); _cat keeps its table/json handling
+            from opensearch_tpu.common.errors import OpenSearchTpuError
+            from opensearch_tpu.common.xcontent import to_bytes
+            fmt = params.get("format") or ""
+            if split.path.startswith("/_cat"):
+                # only format=json reaches here (tables short-circuit
+                # above); pin it so Accept can't override an explicit
+                # format=json request
+                fmt = "json"
+            try:
+                data, ctype = to_bytes(payload,
+                                       self.headers.get("Accept") or "",
+                                       fmt)
+            except OpenSearchTpuError as e:
+                status = e.status
+                data = (json.dumps(e.to_xcontent()) + "\n").encode()
+                ctype = "application/json; charset=UTF-8"
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))

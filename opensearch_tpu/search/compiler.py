@@ -962,6 +962,19 @@ def _c_intervals(q, ctx, scored):
                 f"[intervals] rule must have exactly one key, got "
                 f"{sorted(rule)}")
         kind, body = next(iter(rule.items()))
+        allowed = {"match": {"query", "ordered", "max_gaps"},
+                   "any_of": {"intervals"},
+                   "all_of": {"intervals", "ordered", "max_gaps"}}
+        if kind in allowed and isinstance(body, dict):
+            extra = set(body) - allowed[kind]
+            if extra:
+                # silently dropping filter/analyzer/use_field/... would
+                # return over-broad results — reject like every other
+                # unsupported interval feature
+                raise IllegalArgumentError(
+                    f"[intervals] [{kind}] options {sorted(extra)} are "
+                    f"not supported — supported: "
+                    f"{sorted(allowed[kind])}")
         if kind == "match":
             terms = rule_terms(rule)
             if not terms:

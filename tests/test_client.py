@@ -1,0 +1,113 @@
+"""Python client (opensearch-py-compatible surface) against a live node."""
+
+import pytest
+
+from opensearch_tpu.client import (ConflictError, NotFoundError,
+                                   OpenSearch, RequestError, helpers)
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture()
+def client(tmp_path):
+    node = Node(str(tmp_path / "node"), port=0).start()
+    yield OpenSearch(hosts=[{"host": "127.0.0.1", "port": node.port}])
+    node.stop()
+
+
+def test_crud_and_search(client):
+    assert client.ping() and "version" in client.info()
+    client.indices.create("idx", {"mappings": {"properties": {
+        "t": {"type": "text"}, "n": {"type": "long"}}}})
+    assert client.indices.exists("idx")
+    r = client.index("idx", {"t": "hello world", "n": 1}, id="1")
+    assert r["result"] == "created"
+    client.index("idx", {"t": "goodbye world", "n": 2}, id="2",
+                 params={"refresh": True})
+    assert client.get("idx", "1")["_source"]["n"] == 1
+    assert client.exists("idx", "1") and not client.exists("idx", "9")
+    resp = client.search(index="idx", body={
+        "query": {"match": {"t": "world"}}})
+    assert resp["hits"]["total"]["value"] == 2
+    assert client.count(index="idx")["count"] == 2
+    client.delete("idx", "1")
+    with pytest.raises(NotFoundError):
+        client.get("idx", "1")
+
+
+def test_exception_mapping(client):
+    with pytest.raises(NotFoundError) as e:
+        client.search(index="nope", body={})
+    assert e.value.status_code == 404 and e.value.info["status"] == 404
+    client.indices.create("e1")
+    with pytest.raises(RequestError):
+        client.search(index="e1", body={"query": {"bogus": {}}})
+    client.index("e1", {"a": 1}, id="1")
+    with pytest.raises(ConflictError):
+        client.create("e1", "1", {"a": 2})
+
+
+def test_bulk_helper_and_msearch(client):
+    client.indices.create("b", {"mappings": {"properties": {
+        "n": {"type": "long"}}}})
+    ok, errors = helpers.bulk(client, [
+        {"_index": "b", "_id": str(i), "n": i} for i in range(10)])
+    assert ok == 10 and not errors
+    client.indices.refresh("b")
+    resp = client.msearch([
+        {"index": "b"}, {"query": {"range": {"n": {"gte": 5}}}},
+        {"index": "b"}, {"query": {"match_all": {}}, "size": 0}])
+    assert resp["responses"][0]["hits"]["total"]["value"] == 5
+    assert resp["responses"][1]["hits"]["total"]["value"] == 10
+    # scroll through everything
+    first = client.search(index="b", body={"size": 4},
+                          params={"scroll": "1m"})
+    seen = len(first["hits"]["hits"])
+    sid = first["_scroll_id"]
+    while True:
+        page = client.scroll(sid, body={"scroll": "1m"})
+        if not page["hits"]["hits"]:
+            break
+        seen += len(page["hits"]["hits"])
+        sid = page["_scroll_id"]
+    assert seen == 10
+    client.clear_scroll(sid)
+
+
+def test_namespaced_clients(client):
+    assert client.cluster.health()["status"] in ("green", "yellow")
+    client.indices.create("ns", {})
+    client.index("ns", {"x": 1}, id="1", params={"refresh": True})
+    assert any(r["index"] == "ns" for r in client.cat.indices())
+    client.indices.update_aliases({"actions": [
+        {"add": {"index": "ns", "alias": "ns-alias"}}]})
+    assert client.search(index="ns-alias",
+                         body={})["hits"]["total"]["value"] == 1
+    client.cluster.put_settings({"persistent": {
+        "search.max_buckets": 5000}})
+    flat = str(client.cluster.get_settings())
+    assert "5000" in flat
+    stats = client.nodes.stats()
+    assert "file_cache" in str(stats)
+
+
+def test_snapshot_roundtrip_via_client(client, tmp_path):
+    client.indices.create("s", {})
+    client.index("s", {"v": 1}, id="1", params={"refresh": True})
+    client.snapshot.create_repository("r", {
+        "type": "fs",
+        "settings": {"location": str(tmp_path / "repo")}})
+    client.snapshot.create("r", "snap")
+    client.indices.delete("s")
+    client.snapshot.restore("r", "snap", {"indices": "s"})
+    assert client.get("s", "1")["_source"]["v"] == 1
+    client.indices.delete("s")
+    client.snapshot.delete("r", "snap")
+
+
+def test_connection_failover():
+    from opensearch_tpu.client import ConnectionError as CErr
+    c = OpenSearch(hosts=[{"host": "127.0.0.1", "port": 1}],
+                   timeout=2)
+    assert c.ping() is False
+    with pytest.raises(CErr):
+        c.info()

@@ -173,3 +173,78 @@ def test_span_scores_positive_and_slop_dynamic(searcher):
     base["query"]["span_near"]["slop"] = 3
     r3 = searcher.search(base)
     assert set(ids(r0)) <= set(ids(r3))
+
+
+def test_ordered_full_bucket_no_false_match(searcher):
+    """Review regression: an anchor past the last occurrence of the next
+    clause must not clamp-match the final key (out-of-order false
+    positive when a clause's positions exactly fill the pad bucket).
+    Exercised logically here: 'fox quick' (doc 2) must NEVER match
+    ordered quick->fox regardless of slop."""
+    for slop in (0, 5, 100):
+        resp = searcher.search({"query": {"span_near": {
+            "clauses": [{"span_term": {"t": "quick"}},
+                        {"span_term": {"t": "fox"}}],
+            "slop": slop, "in_order": True}}, "size": 10})
+        assert 2 not in ids(resp), slop
+
+
+def test_full_bucket_boundary_ordered():
+    """Force the bucket-exactly-full layout (1024 positions = the
+    minimum bucket, no KEY_PAD slot) and check the trailing anchor."""
+    import numpy as np
+
+    from opensearch_tpu.mapping.mapper import DocumentMapper
+
+    mapper = DocumentMapper({"properties": {"t": {"type": "text"}}})
+    writer = SegmentWriter()
+    docs = []
+    # 1023 'b' occurrences spread over filler docs, then the trap doc
+    # 'b a' where 'a' follows every 'b' — an ordered a->b anchor in the
+    # trap doc has NO following b
+    for i in range(341):
+        docs.append(mapper.parse(str(i), {"t": "b b b"}))
+    docs.append(mapper.parse("999", {"t": "b a"}))
+    seg = writer.build(docs, "fb")
+    pf = seg.postings["t"]
+    tid = pf.term_id("b")
+    e0, e1 = int(pf.offsets[tid]), int(pf.offsets[tid + 1])
+    assert int(pf.pos_offsets[e1] - pf.pos_offsets[e0]) == 1024
+    s = ShardSearcher([seg], mapper)
+    resp = s.search({"query": {"span_near": {
+        "clauses": [{"span_term": {"t": "a"}},
+                    {"span_term": {"t": "b"}}],
+        "slop": 1000, "in_order": True}}, "size": 400})
+    assert ids(resp) == []     # no b after any a anywhere
+
+
+def test_unordered_same_term_needs_two_occurrences():
+    """Review regression: [fox, fox] unordered must not let a single
+    occurrence match itself."""
+    from opensearch_tpu.mapping.mapper import DocumentMapper
+
+    mapper = DocumentMapper({"properties": {"t": {"type": "text"}}})
+    docs = [("0", "one fox here"), ("1", "fox and fox"),
+            ("2", "fox then later a fox"), ("3", "no animals")]
+    seg = SegmentWriter().build(
+        [mapper.parse(i, {"t": t}) for i, t in docs], "st")
+    s = ShardSearcher([seg], mapper)
+    body = {"query": {"span_near": {
+        "clauses": [{"span_term": {"t": "fox"}},
+                    {"span_term": {"t": "fox"}}],
+        "slop": 1, "in_order": False}}, "size": 10}
+    assert ids(s.search(body)) == [1]
+    body["query"]["span_near"]["slop"] = 10
+    assert ids(s.search(body)) == [1, 2]
+
+
+def test_intervals_rejects_unsupported_options(searcher):
+    bad1 = {"query": {"intervals": {"t": {"match": {
+        "query": "quick fox", "max_gaps": 1,
+        "filter": {"not_containing": {"match": {"query": "x"}}}}}}}}
+    with pytest.raises(OpenSearchTpuError, match="not supported"):
+        searcher.search(bad1)
+    bad2 = {"query": {"intervals": {"t": {"match": {
+        "query": "quick", "use_field": "other"}}}}}
+    with pytest.raises(OpenSearchTpuError, match="not supported"):
+        searcher.search(bad2)

@@ -1,0 +1,140 @@
+"""x-content multi-format: CBOR codec + YAML, request/response
+negotiation over REST (ref libs/x-content XContentType.java:38)."""
+
+import json
+import struct
+import urllib.error
+import urllib.request
+
+import pytest
+
+from opensearch_tpu.common.errors import OpenSearchTpuError, ParsingError
+from opensearch_tpu.common.xcontent import (cbor_dumps, cbor_loads,
+                                            from_bytes, to_bytes)
+from opensearch_tpu.node import Node
+
+
+@pytest.mark.parametrize("value", [
+    None, True, False, 0, 23, 24, 255, 256, 65536, 2**32, -1, -25,
+    1.5, -2.75, "", "héllo ✓", b"\x00\xff", [], [1, [2, 3], "x"],
+    {}, {"a": 1, "nested": {"b": [True, None, 3.14]}},
+])
+def test_cbor_roundtrip(value):
+    assert cbor_loads(cbor_dumps(value)) == value
+
+
+def test_cbor_half_float_and_tag_decode():
+    # 0xF9 0x3C00 = half-precision 1.0; tag 0 wrapping a string
+    assert cbor_loads(bytes([0xF9, 0x3C, 0x00])) == 1.0
+    tagged = bytes([0xC0]) + cbor_dumps("2026-01-01")
+    assert cbor_loads(tagged) == "2026-01-01"
+
+
+def test_cbor_malformed():
+    with pytest.raises(ParsingError):
+        cbor_loads(cbor_dumps({"a": 1})[:-1])      # truncated
+    with pytest.raises(ParsingError):
+        cbor_loads(cbor_dumps(1) + b"\x00")        # trailing bytes
+    with pytest.raises(ParsingError):
+        cbor_loads(bytes([0x5F]))                  # indefinite length
+
+
+def test_from_bytes_negotiation():
+    assert from_bytes(b'{"a": 1}') == {"a": 1}
+    assert from_bytes(b"a: 1\nb: [x, y]\n",
+                      "application/yaml") == {"a": 1, "b": ["x", "y"]}
+    assert from_bytes(cbor_dumps({"a": 1}),
+                      "application/cbor; charset=x") == {"a": 1}
+    with pytest.raises(OpenSearchTpuError) as e:
+        from_bytes(b"x", "application/smile")
+    assert e.value.status == 406
+    with pytest.raises(ParsingError):
+        from_bytes(b"{bad", "application/json")
+    with pytest.raises(ParsingError):
+        from_bytes(b"a: [unclosed", "application/yaml")
+
+
+def test_to_bytes_negotiation():
+    data, ct = to_bytes({"a": 1})
+    assert json.loads(data) == {"a": 1} and "json" in ct
+    data, ct = to_bytes({"a": 1}, format_param="yaml")
+    assert b"a: 1" in data and "yaml" in ct
+    data, ct = to_bytes({"a": 1}, accept="application/cbor")
+    assert cbor_loads(data) == {"a": 1} and ct == "application/cbor"
+
+
+def _raw(node, method, path, data=None, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{node.port}{path}", data=data,
+        method=method, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, r.headers.get("Content-Type"), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Content-Type"), e.read()
+
+
+def test_rest_yaml_and_cbor(tmp_path):
+    node = Node(str(tmp_path / "n"), port=0).start()
+    try:
+        # YAML request body
+        c, _, _ = _raw(node, "PUT", "/y",
+                       data=b"mappings:\n  properties:\n    n:\n"
+                            b"      type: long\n",
+                       headers={"Content-Type": "application/yaml"})
+        assert c == 200
+        c, _, _ = _raw(node, "PUT", "/y/_doc/1?refresh=true",
+                       data=b"n: 7\n",
+                       headers={"Content-Type": "application/yaml"})
+        assert c in (200, 201)
+        # YAML response via format param
+        c, ct, body = _raw(node, "GET", "/y/_doc/1?format=yaml")
+        assert c == 200 and "yaml" in ct and b"n: 7" in body
+        # CBOR request + response via Accept
+        c, ct, body = _raw(
+            node, "POST", "/y/_search",
+            data=cbor_dumps({"query": {"term": {"n": 7}}}),
+            headers={"Content-Type": "application/cbor",
+                     "Accept": "application/cbor"})
+        assert c == 200 and ct == "application/cbor"
+        assert cbor_loads(body)["hits"]["total"]["value"] == 1
+        # SMILE is a clear 406 both ways
+        c, _, _ = _raw(node, "POST", "/y/_search", data=b"x",
+                       headers={"Content-Type": "application/smile"})
+        assert c == 406
+        c, _, _ = _raw(node, "GET", "/y/_doc/1?format=smile")
+        assert c == 406
+        # _cat stays tabular/json regardless of format param
+        c, ct, body = _raw(node, "GET", "/_cat/indices?format=json")
+        assert c == 200 and "json" in ct
+    finally:
+        node.stop()
+
+
+def test_cbor_malformed_inputs_are_parsing_errors():
+    """Review regression: malformed CBOR must surface as 400 parsing
+    errors, never as raw TypeError/UnicodeDecodeError/RecursionError
+    (500s)."""
+    # map with an array key {[1]: 2}
+    with pytest.raises(ParsingError, match="map keys"):
+        cbor_loads(bytes([0xA1, 0x81, 0x01, 0x02]))
+    # invalid UTF-8 text string
+    with pytest.raises(ParsingError, match="UTF-8"):
+        cbor_loads(bytes([0x62, 0xFF, 0xFE]))
+    # deep nesting: 3000 x array-of-one
+    with pytest.raises(ParsingError, match="nested too deeply"):
+        cbor_loads(bytes([0x81] * 3000) + bytes([0x01]))
+    # declared container length far beyond the input
+    with pytest.raises(ParsingError, match="exceeds input"):
+        cbor_loads(bytes([0x9B]) + struct.pack(">Q", 2**40))
+
+
+def test_cat_format_json_wins_over_accept(tmp_path):
+    node = Node(str(tmp_path / "n"), port=0).start()
+    try:
+        c, ct, body = _raw(node, "GET", "/_cat/indices?format=json",
+                           headers={"Accept": "application/yaml"})
+        assert c == 200 and "json" in ct
+        json.loads(body)
+    finally:
+        node.stop()
