@@ -56,7 +56,14 @@ _HTTP_EXCEPTIONS = {400: RequestError, 403: AuthorizationException,
 
 
 class Transport:
-    def __init__(self, hosts, timeout: float = 30.0):
+    def __init__(self, hosts, timeout: float = 30.0, http_auth=None):
+        import base64
+        self._auth_header = None
+        if http_auth:
+            if isinstance(http_auth, (tuple, list)):
+                http_auth = ":".join(http_auth)
+            self._auth_header = ("Basic " + base64.b64encode(
+                http_auth.encode()).decode())
         self.hosts = []
         for h in hosts:
             if isinstance(h, str):
@@ -78,6 +85,8 @@ class Transport:
             if qs:
                 path = f"{path}?{qs}"
         hdrs = dict(headers or {})
+        if self._auth_header and "Authorization" not in hdrs:
+            hdrs["Authorization"] = self._auth_header
         if isinstance(body, (dict, list)):
             data = json.dumps(body).encode()
             hdrs.setdefault("Content-Type", "application/json")
@@ -288,11 +297,13 @@ class NodesClient(_Namespace):
 class OpenSearch:
     """Drop-in analog of ``opensearchpy.OpenSearch`` for this node."""
 
-    def __init__(self, hosts=None, timeout: float = 30.0, **_ignored):
+    def __init__(self, hosts=None, timeout: float = 30.0, http_auth=None,
+                 **_ignored):
         hosts = hosts or [{"host": "localhost", "port": 9200}]
         if isinstance(hosts, (str, dict)):
             hosts = [hosts]
-        self.transport = Transport(hosts, timeout=timeout)
+        self.transport = Transport(hosts, timeout=timeout,
+                                   http_auth=http_auth)
         self.indices = IndicesClient(self.transport)
         self.cluster = ClusterClient(self.transport)
         self.cat = CatClient(self.transport)

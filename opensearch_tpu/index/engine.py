@@ -64,9 +64,10 @@ class InternalEngine:
 
     def __init__(self, data_path: str, mapper: DocumentMapper,
                  index_name: str = "index", shard_id: int = 0,
-                 durability: str = "request"):
+                 durability: str = "request", codec: str = "default"):
         self.data_path = data_path
         self.mapper = mapper
+        self.codec = codec
         self.index_name = index_name
         self.shard_id = shard_id
         self.primary_term = 1
@@ -490,7 +491,7 @@ class InternalEngine:
             seg_dir = os.path.join(self.data_path, "segments")
             for seg in self.segments:
                 if seg.seg_id not in self._persisted_segments:
-                    save_segment(seg, seg_dir)
+                    save_segment(seg, seg_dir, codec=self.codec)
                     self._persisted_segments.add(seg.seg_id)
                 elif seg.seg_id in self._live_dirty:
                     save_live(seg, seg_dir)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import zlib
 
 import numpy as np
 
@@ -88,9 +89,23 @@ def _segment_encode(seg: Segment):
     return arrays, meta, b"".join(seg.sources)
 
 
-def save_segment(seg: Segment, dirpath: str):
+CODECS = ("default", "best_compression")
+
+
+def save_segment(seg: Segment, dirpath: str, codec: str = "default"):
+    """``codec`` mirrors the reference's two stored-field codecs (ref
+    index/codec/CodecService.java:46 — LZ4 "default" vs zstd/DEFLATE
+    "best_compression", the index.codec setting): best_compression
+    deflates the arrays (compressed npz) and the _source blob, trading
+    write CPU for disk; the read path is self-describing via meta."""
+    if codec not in CODECS:
+        raise OpenSearchTpuError(f"unknown codec [{codec}]")
     os.makedirs(dirpath, exist_ok=True)
     arrays, meta, src_bytes = _segment_encode(seg)
+    compress = codec == "best_compression"
+    if compress:
+        meta["src_codec"] = "zlib"
+        src_bytes = zlib.compress(src_bytes, 6)
     base = os.path.join(dirpath, seg.seg_id)
     with open(base + ".src.tmp", "wb") as f:
         f.write(src_bytes)
@@ -98,7 +113,7 @@ def save_segment(seg: Segment, dirpath: str):
         os.fsync(f.fileno())
     os.replace(base + ".src.tmp", base + ".src")
     with open(base + ".npz.tmp", "wb") as f:
-        np.savez(f, **arrays)
+        (np.savez_compressed if compress else np.savez)(f, **arrays)
         f.flush()
         os.fsync(f.fileno())
     os.replace(base + ".npz.tmp", base + ".npz")
@@ -127,7 +142,9 @@ def load_segment(dirpath: str, seg_id: str) -> Segment:
         z = np.load(base + ".npz")
         with open(base + ".src", "rb") as f:
             src_blob = f.read()
-    except (OSError, ValueError) as e:
+        if meta.get("src_codec") == "zlib":
+            src_blob = zlib.decompress(src_blob)
+    except (OSError, ValueError, zlib.error) as e:
         raise CorruptIndexError(f"cannot read segment [{seg_id}]: {e}") from e
     seg = _segment_decode(seg_id, meta, z, src_blob)
     if os.path.exists(base + ".liv"):

@@ -125,6 +125,14 @@ class IndexService:
         self.mapper = DocumentMapper(mappings or {})
         self._durability = settings.get("translog", {}).get("durability",
                                                             "request")
+        # index.codec (ref index/codec/CodecService.java:46): default vs
+        # best_compression, fixed at index creation like the reference
+        self._codec = str(settings.get("codec", "default"))
+        from opensearch_tpu.index.store import CODECS
+        if self._codec not in CODECS:
+            raise IllegalArgumentError(
+                f"unknown value for [index.codec]: [{self._codec}] — "
+                f"supported: {list(CODECS)}")
         # in cluster mode a node hosts only the shards routed to it
         # (IndicesClusterStateService analog); standalone hosts all
         if local_shard_ids is None:
@@ -139,7 +147,8 @@ class IndexService:
         return InternalEngine(os.path.join(self.data_path, str(shard_id)),
                               self.mapper, index_name=self.name,
                               shard_id=shard_id,
-                              durability=self._durability)
+                              durability=self._durability,
+                              codec=self._codec)
 
     @property
     def shards(self) -> list[InternalEngine]:

@@ -51,6 +51,8 @@ class Node:
         self.contexts = ReaderContextRegistry()
         self.search_pipelines = SearchPipelineService(data_path)
         self.task_manager = TaskManager(name)
+        from opensearch_tpu.security.identity import IdentityService
+        self.identity = IdentityService(data_path)
         self._init_cluster_settings()
         self.rest = RestController(self)
         self.http = HttpServer(self.rest, host=host, port=port)
@@ -81,9 +83,12 @@ class Node:
         cache_size = Setting.int_setting(
             "node.searchable_snapshot.cache.size", 256 << 20,
             min_value=0, dynamic=True)
+        identity_enabled = Setting.bool_setting(
+            "identity.enabled", False, dynamic=True)
         self.cluster_settings = SettingsRegistry(
             Settings(stored),
-            [max_buckets, auto_create, max_scroll, cache_size])
+            [max_buckets, auto_create, max_scroll, cache_size,
+             identity_enabled])
         # remote clusters configure via affix keys (RemoteClusterService)
         self.cluster_settings.register_prefix("cluster.remote")
         from opensearch_tpu.transport.remote import RemoteClusterService
@@ -97,12 +102,17 @@ class Node:
             max_scroll, lambda v: setattr(self.contexts, "_max_open", v))
         self.cluster_settings.add_settings_update_consumer(
             cache_size, lambda v: self.indices.file_cache.set_max_bytes(v))
+        self.cluster_settings.add_settings_update_consumer(
+            identity_enabled,
+            lambda v: setattr(self.identity, "enabled", v))
         # replay persisted values into the consumers at boot
         aggs_mod.MAX_BUCKETS = self.cluster_settings.get(max_buckets)
         self.indices.auto_create = self.cluster_settings.get(auto_create)
         self.contexts._max_open = self.cluster_settings.get(max_scroll)
         self.indices.file_cache.set_max_bytes(
             self.cluster_settings.get(cache_size))
+        self.identity.enabled = self.cluster_settings.get(
+            identity_enabled)
 
     def update_cluster_settings(self, updates: dict) -> dict:
         import json as _json

@@ -88,12 +88,15 @@ class RestController:
     }
 
     def dispatch(self, method: str, path: str, params: dict,
-                 body: Optional[bytes],
-                 content_type: str = "") -> tuple[int, dict]:
+                 body: Optional[bytes], content_type: str = "",
+                 authorization: str = "") -> tuple[int, dict]:
         from opensearch_tpu.common import tasks as taskmod
 
         req = RestRequest(method, path, params, body, content_type)
         try:
+            identity = getattr(self.node, "identity", None)
+            principal = (identity.check(method, path, authorization)
+                         if identity is not None else None)
             for route in self.routes:
                 if route.method != method:
                     continue
@@ -104,6 +107,11 @@ class RestController:
                     # task (TaskManager.register analog); device loops
                     # check the contextvar between segment programs
                     handler_name = getattr(route.handler, "__name__", "?")
+                    if identity is not None:
+                        # authorize on the MATCHED route, not the raw
+                        # path — path suffixes are forgeable via ids
+                        identity.authorize(principal, method, path,
+                                           handler_name)
                     action = self._ACTIONS.get(handler_name,
                                                f"rest:{handler_name}")
                     task = self.node.task_manager.register(
@@ -208,6 +216,10 @@ class RestController:
         r("GET", "/_mapping", self.h_get_mapping_all)
         r("GET", "/_refresh", self.h_refresh)
         r("POST", "/_refresh", self.h_refresh)
+        r("GET", "/_security/user", self.h_security_list_users)
+        r("PUT", "/_security/user/{username}", self.h_security_put_user)
+        r("DELETE", "/_security/user/{username}",
+          self.h_security_delete_user)
         r("GET", "/_tasks", self.h_tasks_list)
         r("GET", "/_tasks/{task_id}", self.h_task_get)
         r("POST", "/_tasks/{task_id}/_cancel", self.h_task_cancel)
@@ -1387,6 +1399,25 @@ class RestController:
             "name": self.node.name,
             "tasks": {f"{self.node.node_id}:{t.id}": t.info()
                       for t in tasks}}}}
+
+    def h_security_list_users(self, req):
+        return 200, self.node.identity.list_users()
+
+    def h_security_put_user(self, req):
+        body = req.json({}) or {}
+        name = req.param("username")
+        created = self.node.identity.put_user(
+            name, body.get("password") or "",
+            body.get("roles") or ["readonly"])
+        return 200, {"user": name, "created": created}
+
+    def h_security_delete_user(self, req):
+        name = req.param("username")
+        if not self.node.identity.delete_user(name):
+            from opensearch_tpu.common.errors import \
+                ResourceNotFoundError
+            raise ResourceNotFoundError(f"user [{name}] not found")
+        return 200, {"user": name, "deleted": True}
 
     def h_tasks_list(self, req):
         return 200, self._task_payload(

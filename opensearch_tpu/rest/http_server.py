@@ -62,7 +62,8 @@ class _Handler(BaseHTTPRequestHandler):
                 body = self.rfile.read(length) if length else b""
                 status, payload = self.server.controller.dispatch(
                     self.command, split.path, params, body,
-                    self.headers.get("Content-Type") or "")
+                    self.headers.get("Content-Type") or "",
+                    self.headers.get("Authorization") or "")
             finally:
                 breaker.release(length)
         is_cat = split.path.startswith("/_cat") and params.get("format") != "json"

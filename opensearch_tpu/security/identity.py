@@ -1,0 +1,180 @@
+"""Identity: internal users, basic auth, coarse role enforcement.
+
+Analog of the reference's identity subsystem (ref server/src/main/java/
+org/opensearch/identity/IdentityService.java:23 + the internal-users
+model of the security plugin).  Scope matches the in-core feature, not
+the full security plugin: an internal user store (PBKDF2-hashed
+passwords, persisted), HTTP Basic authentication, and two built-in
+roles — ``admin`` (everything) and ``readonly`` (GET plus search/count
+POSTs).  Disabled until ``identity.enabled`` is set, like the
+reference's feature-flagged identity.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import secrets
+import threading
+
+from opensearch_tpu.common.errors import (IllegalArgumentError,
+                                          OpenSearchTpuError)
+
+
+class AuthenticationError(OpenSearchTpuError):
+    status = 401
+
+
+class AuthorizationError(OpenSearchTpuError):
+    status = 403
+
+
+ROLES = ("admin", "readonly")
+# Handlers a readonly principal may hit beyond plain GET/HEAD: the
+# search-shaped POSTs plus releasing its own scroll/PIT contexts.
+# Authorization keys on the MATCHED ROUTE's handler, never on the raw
+# path — substring/suffix path checks are bypassable with crafted
+# document ids like POST /idx/_doc/_search (review finding, reproduced)
+READONLY_HANDLERS = frozenset({
+    "h_search", "h_msearch", "h_count", "h_field_caps", "h_analyze",
+    "h_termvectors", "h_rank_eval", "h_mget", "h_scroll_next",
+    "h_scroll_clear", "h_scroll_clear_all", "h_pit_open", "h_pit_close",
+})
+# security APIs require admin even for reads (user enumeration hands an
+# attacker the exact accounts to target)
+_ADMIN_ONLY_PREFIX = "h_security_"
+
+
+def _hash(password: str, salt: bytes) -> bytes:
+    return hashlib.pbkdf2_hmac("sha256", password.encode(), salt,
+                               50_000)
+
+
+class IdentityService:
+    def __init__(self, data_path: str):
+        self.path = os.path.join(data_path, "security", "users.json")
+        self._lock = threading.RLock()
+        self.enabled = False
+        self._users: dict[str, dict] = {}
+        # name -> sha256(salt || password) of an ALREADY PBKDF2-verified
+        # credential: the slow KDF runs once per (user, password), not
+        # per request (the reference realms cache verified creds the
+        # same way); invalidated on any user mutation
+        self._verified: dict[str, bytes] = {}
+        if os.path.exists(self.path):
+            with open(self.path) as f:
+                self._users = json.load(f)
+
+    def _persist(self):
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._users, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    # -- user management --------------------------------------------------
+
+    def put_user(self, name: str, password: str, roles: list[str]):
+        if not name or "/" in name or ":" in name:
+            raise IllegalArgumentError(f"invalid username [{name}]")
+        if not password or len(password) < 6:
+            raise IllegalArgumentError(
+                "password must be at least 6 characters")
+        bad = [r for r in roles if r not in ROLES]
+        if bad or not roles:
+            raise IllegalArgumentError(
+                f"invalid roles {bad or roles} — supported: "
+                f"{list(ROLES)}")
+        salt = secrets.token_bytes(16)
+        with self._lock:
+            created = name not in self._users
+            self._users[name] = {
+                "salt": salt.hex(),
+                "hash": _hash(password, salt).hex(),
+                "roles": sorted(set(roles))}
+            self._verified.pop(name, None)
+            self._persist()
+            return created
+
+    def delete_user(self, name: str) -> bool:
+        with self._lock:
+            existed = self._users.pop(name, None) is not None
+            self._verified.pop(name, None)
+            if existed:
+                self._persist()
+            return existed
+
+    def list_users(self) -> dict:
+        with self._lock:
+            return {n: {"roles": u["roles"]}
+                    for n, u in sorted(self._users.items())}
+
+    # -- enforcement ------------------------------------------------------
+
+    def authenticate(self, authorization: str) -> dict:
+        """Basic-auth header -> user record; constant-time compare."""
+        if not authorization or not authorization.startswith("Basic "):
+            raise AuthenticationError("missing authentication credentials")
+        try:
+            raw = base64.b64decode(authorization[6:]).decode()
+            name, _, password = raw.partition(":")
+        except Exception:  # noqa: BLE001 — any malformed header is a 401
+            raise AuthenticationError("invalid basic auth header")
+        user = self._users.get(name)
+        if user is None:
+            raise AuthenticationError(
+                f"authentication failed for [{name}]")
+        salt = bytes.fromhex(user["salt"])
+        fast = hashlib.sha256(salt + password.encode()).digest()
+        cached = self._verified.get(name)
+        if cached is not None and hmac.compare_digest(cached, fast):
+            return {"name": name, "roles": user["roles"]}
+        want = bytes.fromhex(user["hash"])
+        got = _hash(password, salt)
+        if not hmac.compare_digest(want, got):
+            raise AuthenticationError(
+                f"authentication failed for [{name}]")
+        with self._lock:
+            self._verified[name] = fast
+        return {"name": name, "roles": user["roles"]}
+
+    def authorize(self, principal: dict | None, method: str, path: str,
+                  handler: str):
+        """Route-level authorization: ``handler`` is the matched route's
+        handler name (the action identity), resolved AFTER routing so
+        path tricks can't reclassify an action."""
+        if principal is None:
+            return
+        if handler.startswith(_ADMIN_ONLY_PREFIX):
+            if "admin" not in principal["roles"]:
+                raise AuthorizationError(
+                    f"no permissions for [{handler.removeprefix('h_')}] "
+                    f"and user [{principal['name']}]")
+            return
+        if "admin" in principal["roles"]:
+            return
+        if method in ("GET", "HEAD") or handler in READONLY_HANDLERS:
+            return
+        raise AuthorizationError(
+            f"no permissions for [{method} {path}] and user "
+            f"[{principal['name']}]")
+
+    def check(self, method: str, path: str,
+              authorization: str) -> dict | None:
+        """Authentication gate for one request (authorization happens
+        per matched route via ``authorize``); no-op while disabled or
+        for the liveness root.  Returns the principal (or None when
+        disabled)."""
+        if not self.enabled or not self._users:
+            # zero users + enabled would lock EVERYONE out including the
+            # operator bootstrapping the first admin — enforcement
+            # begins once an internal user exists
+            return None
+        if path == "/" and method in ("GET", "HEAD"):
+            return None                   # ping stays open, like the
+        return self.authenticate(authorization)        # reference's /

@@ -17,7 +17,9 @@ def test_default_checks_run_and_report_cleanly(tmp_path):
     fails = run_bootstrap_checks(default_checks(str(tmp_path)),
                                  enforce=False)
     for f in fails:
-        assert f.startswith("[") and "too low" in f or "unavailable" in f
+        assert f.startswith("[") and (
+            "too low" in f or "unavailable" in f or "not writable" in f
+            or "could not run" in f)
     names = {c.name for c in default_checks(str(tmp_path))}
     assert names == {"file descriptors", "vm.max_map_count",
                      "max threads", "data path writable",

@@ -1,0 +1,75 @@
+"""index.codec: default vs best_compression segment formats (ref
+index/codec/CodecService.java:46)."""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+def call(node, method, path, body=None):
+    url = f"http://127.0.0.1:{node.port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            payload = resp.read()
+            return resp.status, json.loads(payload) if payload else {}
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, json.loads(payload) if payload else {}
+
+
+DOC = {"msg": "the quick brown fox " * 40, "n": 1}
+
+
+def _src_bytes(tmp_path, index):
+    total = 0
+    root = tmp_path / "node" / "indices" / index
+    for r, _, files in os.walk(root):
+        for f in files:
+            if f.endswith(".src"):
+                total += os.path.getsize(os.path.join(r, f))
+    assert total > 0
+    return total
+
+
+def test_best_compression_shrinks_and_survives_restart(tmp_path):
+    node = Node(str(tmp_path / "node"), port=0).start()
+    call(node, "PUT", "/plain", {"settings": {"codec": "default"}})
+    call(node, "PUT", "/packed",
+         {"settings": {"index": {"codec": "best_compression"}}})
+    for idx in ("plain", "packed"):
+        for i in range(50):
+            call(node, "PUT", f"/{idx}/_doc/{i}", DOC)
+        call(node, "POST", f"/{idx}/_refresh")
+        assert call(node, "POST", f"/{idx}/_flush")[0] == 200
+    plain, packed = (_src_bytes(tmp_path, "plain"),
+                     _src_bytes(tmp_path, "packed"))
+    assert packed < plain / 5, (plain, packed)   # repetitive text deflates
+    node.stop()
+    # compressed segments reload transparently (meta is self-describing)
+    node2 = Node(str(tmp_path / "node"), port=0).start()
+    try:
+        code, body = call(node2, "GET", "/packed/_search",
+                          body={"query": {"term": {"n": 1}}, "size": 1})
+        assert code == 200 and body["hits"]["total"]["value"] == 50
+        assert body["hits"]["hits"][0]["_source"]["msg"] == DOC["msg"]
+    finally:
+        node2.stop()
+
+
+def test_unknown_codec_rejected(tmp_path):
+    node = Node(str(tmp_path / "node"), port=0).start()
+    try:
+        code, body = call(node, "PUT", "/bad",
+                          {"settings": {"codec": "zstd_turbo"}})
+        assert code == 400 and "index.codec" in json.dumps(body)
+    finally:
+        node.stop()

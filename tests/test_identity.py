@@ -1,0 +1,192 @@
+"""Identity: internal users, basic auth, role enforcement (ref
+identity/IdentityService.java:23)."""
+
+import base64
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+def call(node, method, path, body=None, auth=None):
+    url = f"http://127.0.0.1:{node.port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    headers = {"Content-Type": "application/json"} if data else {}
+    if auth:
+        headers["Authorization"] = "Basic " + base64.b64encode(
+            f"{auth[0]}:{auth[1]}".encode()).decode()
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            payload = resp.read()
+            return resp.status, json.loads(payload) if payload else {}
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, json.loads(payload) if payload else {}
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(str(tmp_path / "node"), port=0).start()
+    yield n
+    n.stop()
+
+
+def test_disabled_by_default(node):
+    assert call(node, "GET", "/_cluster/health")[0] == 200
+    assert call(node, "GET", "/_security/user")[0] == 200
+
+
+def test_auth_flow_and_roles(node):
+    # bootstrap: create users, then enable
+    assert call(node, "PUT", "/_security/user/admin",
+                {"password": "s3cret1", "roles": ["admin"]})[0] == 200
+    assert call(node, "PUT", "/_security/user/viewer",
+                {"password": "v13wer1", "roles": ["readonly"]})[0] == 200
+    assert call(node, "PUT", "/_cluster/settings", {
+        "persistent": {"identity.enabled": True}},
+        auth=("admin", "s3cret1"))[0] == 200
+    # anonymous: 401 everywhere except the liveness root
+    assert call(node, "GET", "/")[0] == 200
+    assert call(node, "GET", "/_cluster/health")[0] == 401
+    assert call(node, "PUT", "/idx", {})[0] == 401
+    # wrong password: 401
+    assert call(node, "GET", "/_cluster/health",
+                auth=("admin", "nope000"))[0] == 401
+    # admin: full access
+    assert call(node, "PUT", "/idx", {}, auth=("admin", "s3cret1"))[0] == 200
+    assert call(node, "PUT", "/idx/_doc/1?refresh=true", {"a": 1},
+                auth=("admin", "s3cret1"))[0] in (200, 201)
+    # readonly: reads + search-shaped POSTs pass, writes 403
+    ro = ("viewer", "v13wer1")
+    assert call(node, "GET", "/idx/_doc/1", auth=ro)[0] == 200
+    assert call(node, "POST", "/idx/_search", {}, auth=ro)[0] == 200
+    assert call(node, "POST", "/idx/_count", {}, auth=ro)[0] == 200
+    code, body = call(node, "PUT", "/idx/_doc/2", {"a": 2}, auth=ro)
+    assert code == 403 and "no permissions" in json.dumps(body)
+    assert call(node, "POST", "/_bulk", None, auth=ro)[0] == 403
+    # readonly cannot manage users either
+    assert call(node, "PUT", "/_security/user/evil",
+                {"password": "evil123", "roles": ["admin"]},
+                auth=ro)[0] == 403
+
+
+def test_users_survive_restart(tmp_path):
+    n = Node(str(tmp_path / "node"), port=0).start()
+    call(n, "PUT", "/_security/user/admin",
+         {"password": "s3cret1", "roles": ["admin"]})
+    call(n, "PUT", "/_cluster/settings",
+         {"persistent": {"identity.enabled": True}})
+    n.stop()
+    n2 = Node(str(tmp_path / "node"), port=0).start()
+    try:
+        assert call(n2, "GET", "/_cluster/health")[0] == 401
+        assert call(n2, "GET", "/_cluster/health",
+                    auth=("admin", "s3cret1"))[0] == 200
+    finally:
+        n2.stop()
+
+
+def test_user_validation(node):
+    assert call(node, "PUT", "/_security/user/x",
+                {"password": "short"})[0] == 400
+    assert call(node, "PUT", "/_security/user/x",
+                {"password": "longenough",
+                 "roles": ["superuser"]})[0] == 400
+    assert call(node, "PUT", "/_security/user/a:b",
+                {"password": "longenough", "roles": ["admin"]})[0] == 400
+    assert call(node, "DELETE", "/_security/user/ghost")[0] == 404
+
+
+def test_enabled_with_no_users_does_not_lock_out(node):
+    assert call(node, "PUT", "/_cluster/settings", {
+        "persistent": {"identity.enabled": True}})[0] == 200
+    # no users yet: enforcement deferred so the operator can bootstrap
+    assert call(node, "GET", "/_cluster/health")[0] == 200
+    call(node, "PUT", "/_security/user/admin",
+         {"password": "s3cret1", "roles": ["admin"]})
+    assert call(node, "GET", "/_cluster/health")[0] == 401
+
+
+def test_readonly_cannot_write_via_crafted_ids(node):
+    """Review regression (reproduced live pre-fix): authorization keys
+    on the matched route, so POST /idx/_doc/_search must not let a
+    readonly user create a document whose id merely LOOKS like a read
+    action."""
+    call(node, "PUT", "/_security/user/admin",
+         {"password": "s3cret1", "roles": ["admin"]})
+    call(node, "PUT", "/_security/user/viewer",
+         {"password": "v13wer1", "roles": ["readonly"]})
+    call(node, "PUT", "/_cluster/settings",
+         {"persistent": {"identity.enabled": True}},
+         auth=("admin", "s3cret1"))
+    call(node, "PUT", "/idx", {}, auth=("admin", "s3cret1"))
+    ro = ("viewer", "v13wer1")
+    for path in ("/idx/_doc/_search", "/idx/_doc/_count",
+                 "/idx/_update/_msearch"):
+        code, _ = call(node, "POST", path, {"a": 1}, auth=ro)
+        assert code == 403, path
+    # readonly CAN release its own contexts (DELETE scroll/PIT)
+    code, body = call(node, "POST", "/idx/_search?scroll=1m",
+                      {"size": 1}, auth=ro)
+    assert code == 200
+    sid = body["_scroll_id"]
+    assert call(node, "DELETE", "/_search/scroll",
+                {"scroll_id": sid}, auth=ro)[0] == 200
+    # but security APIs are admin-only, even GET
+    assert call(node, "GET", "/_security/user", auth=ro)[0] == 403
+    assert call(node, "GET", "/_security/user",
+                auth=("admin", "s3cret1"))[0] == 200
+
+
+def test_put_user_reports_update_vs_create(node):
+    code, body = call(node, "PUT", "/_security/user/u1",
+                      {"password": "abcdef1", "roles": ["admin"]})
+    assert code == 200 and body["created"] is True
+    code, body = call(node, "PUT", "/_security/user/u1",
+                      {"password": "newpass1", "roles": ["admin"]})
+    assert code == 200 and body["created"] is False
+
+
+def test_credential_cache_invalidated_on_password_change(node):
+    from opensearch_tpu.security.identity import AuthenticationError
+
+    node.identity.put_user("u", "firstpw", ["admin"])
+    node.identity.enabled = True
+    hdr = "Basic " + base64.b64encode(b"u:firstpw").decode()
+    assert node.identity.authenticate(hdr)["name"] == "u"
+    assert node.identity.authenticate(hdr)["name"] == "u"  # cached path
+    node.identity.put_user("u", "secondpw", ["admin"])
+    with pytest.raises(AuthenticationError):
+        node.identity.authenticate(hdr)
+    hdr2 = "Basic " + base64.b64encode(b"u:secondpw").decode()
+    assert node.identity.authenticate(hdr2)["name"] == "u"
+
+
+def test_client_http_auth(node):
+    from opensearch_tpu.client import (AuthorizationException,
+                                       OpenSearch, TransportError)
+
+    call(node, "PUT", "/_security/user/admin",
+         {"password": "s3cret1", "roles": ["admin"]})
+    call(node, "PUT", "/_security/user/viewer",
+         {"password": "v13wer1", "roles": ["readonly"]})
+    call(node, "PUT", "/_cluster/settings",
+         {"persistent": {"identity.enabled": True}},
+         auth=("admin", "s3cret1"))
+    host = f"http://127.0.0.1:{node.port}"
+    anon = OpenSearch(hosts=[host])
+    with pytest.raises(TransportError) as e:
+        anon.cluster.health()
+    assert e.value.status_code == 401
+    admin = OpenSearch(hosts=[host], http_auth=("admin", "s3cret1"))
+    assert admin.cluster.health()["status"] in ("green", "yellow")
+    admin.indices.create("ci", {})
+    ro = OpenSearch(hosts=[host], http_auth=("viewer", "v13wer1"))
+    assert ro.search(index="ci", body={})["hits"]["total"]["value"] == 0
+    with pytest.raises(AuthorizationException):
+        ro.index("ci", {"a": 1}, id="1")
