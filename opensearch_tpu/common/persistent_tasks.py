@@ -1,0 +1,127 @@
+"""Persistent tasks: background jobs that survive node restarts.
+
+Analog of the reference's persistent-tasks framework (ref server/src/
+main/java/org/opensearch/persistent/PersistentTasksService.java:47,
+PersistentTasksCustomMetadata in cluster state): a task is submitted
+with an action name + params, durably recorded BEFORE it starts, and —
+unlike the plain TaskManager's in-flight tasks — re-executed from its
+params after a crash/restart.  Single-node analog: the durable record
+lives in ``persistent_tasks.json`` under the data path instead of
+replicated cluster state; executors are registered per action name and
+must be idempotent (the reference makes the same demand of its
+PersistentTasksExecutor implementations).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import uuid
+from typing import Callable, Optional
+
+from opensearch_tpu.common.errors import (IllegalArgumentError,
+                                          ResourceNotFoundError)
+
+
+class PersistentTasksService:
+    def __init__(self, data_path: str):
+        self.path = os.path.join(data_path, "persistent_tasks.json")
+        self._lock = threading.RLock()
+        self._executors: dict[str, Callable[[dict], dict]] = {}
+        self._threads: dict[str, threading.Thread] = {}
+        self._tasks: dict[str, dict] = {}
+        if os.path.exists(self.path):
+            with open(self.path) as f:
+                self._tasks = json.load(f)
+
+    MAX_TERMINAL = 100   # completed/failed records kept for polling
+
+    def _persist(self):
+        # terminal tasks are kept only for status polling; the reference
+        # removes them from cluster state on completion — an unbounded
+        # ledger would grow persist latency and boot time forever
+        terminal = [tid for tid, t in self._tasks.items()
+                    if t["state"] != "started"]
+        for tid in terminal[:-self.MAX_TERMINAL or None]:
+            del self._tasks[tid]
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._tasks, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def register_executor(self, action: str,
+                          fn: Callable[[dict], dict]):
+        """``fn(params) -> result`` runs in a background thread; it MUST
+        be idempotent — a crash between start and completion re-runs it
+        at the next boot."""
+        self._executors[action] = fn
+
+    def submit(self, action: str, params: dict) -> str:
+        if action not in self._executors:
+            raise IllegalArgumentError(
+                f"unknown persistent task action [{action}]")
+        task_id = uuid.uuid4().hex[:16]
+        with self._lock:
+            self._tasks[task_id] = {"action": action, "params": params,
+                                    "state": "started"}
+            self._persist()              # durable BEFORE execution
+        self._spawn(task_id)
+        return task_id
+
+    def _spawn(self, task_id: str):
+        def run():
+            t = self._tasks[task_id]
+            try:
+                result = self._executors[t["action"]](t["params"])
+                state, extra = "completed", {"result": result}
+            except Exception as e:  # noqa: BLE001 — recorded, not raised
+                state, extra = "failed", {"error": f"{type(e).__name__}: "
+                                                   f"{e}"}
+            with self._lock:
+                self._tasks[task_id] = {**t, "state": state, **extra}
+                self._persist()
+                self._threads.pop(task_id, None)
+
+        th = threading.Thread(target=run, daemon=True,
+                              name=f"persistent-task-{task_id}")
+        with self._lock:
+            self._threads[task_id] = th
+        th.start()
+
+    def resume_incomplete(self):
+        """Boot-time recovery: re-execute every task that was recorded
+        but never reached a terminal state (the reference reassigns such
+        tasks when their node leaves)."""
+        with self._lock:
+            pending = [tid for tid, t in self._tasks.items()
+                       if t["state"] == "started"
+                       and t["action"] in self._executors]
+        for tid in pending:
+            self._spawn(tid)
+        return pending
+
+    def get_or_none(self, task_id: str) -> Optional[dict]:
+        with self._lock:
+            t = self._tasks.get(task_id)
+            return None if t is None else {"id": task_id, **t}
+
+    def get(self, task_id: str) -> dict:
+        t = self.get_or_none(task_id)
+        if t is None:
+            raise ResourceNotFoundError(
+                f"persistent task [{task_id}] not found")
+        return t
+
+    def list(self) -> list[dict]:           # noqa: A003
+        with self._lock:
+            return [{"id": tid, **t}
+                    for tid, t in sorted(self._tasks.items())]
+
+    def wait(self, task_id: str, timeout: float = 30.0) -> dict:
+        th = self._threads.get(task_id)
+        if th is not None:
+            th.join(timeout)
+        return self.get(task_id)

@@ -54,7 +54,12 @@ class Node:
         from opensearch_tpu.security.identity import IdentityService
         self.identity = IdentityService(data_path)
         self._init_cluster_settings()
+        from opensearch_tpu.common.persistent_tasks import \
+            PersistentTasksService
+        self.persistent_tasks = PersistentTasksService(data_path)
         self.rest = RestController(self)
+        self.persistent_tasks.register_executor(
+            "indices:data/write/reindex", self.rest._do_reindex)
         self.http = HttpServer(self.rest, host=host, port=port)
 
     def _init_cluster_settings(self):
@@ -142,6 +147,9 @@ class Node:
         run_bootstrap_checks(default_checks(self.data_path),
                              enforce=enforce)
         self.http.start()
+        # re-run persistent tasks that never completed (crash between
+        # submit and completion); executors are idempotent
+        self.persistent_tasks.resume_incomplete()
         return self
 
     def stop(self):

@@ -221,6 +221,7 @@ class RestController:
         r("DELETE", "/_security/user/{username}",
           self.h_security_delete_user)
         r("GET", "/_tasks", self.h_tasks_list)
+        r("GET", "/_persistent_tasks", self.h_persistent_tasks_list)
         r("GET", "/_tasks/{task_id}", self.h_task_get)
         r("POST", "/_tasks/{task_id}/_cancel", self.h_task_cancel)
         r("POST", "/_tasks/_cancel", self.h_tasks_cancel_all)
@@ -472,8 +473,35 @@ class RestController:
                 local = row["local"]
                 yield engine, seg.doc_ids[local], seg.source(local)
 
+    def _validate_reindex(self, body) -> None:
+        """Cheap request checks shared by both modes — a malformed async
+        request must 400 at submit time, not become a persisted failed
+        task."""
+        src = body.get("source") or {}
+        dest = body.get("dest") or {}
+        if not src.get("index") or not dest.get("index"):
+            raise ValidationError(
+                "[reindex] requires source.index and dest.index")
+        services = self.node.indices.resolve(src["index"])
+        dest_svc = self.node.indices.write_index_for(dest["index"])
+        if any(svc.name == dest_svc.name for svc in services):
+            raise ValidationError(
+                "reindex cannot write into its own source index")
+
     def h_reindex(self, req):
         body = req.json({}) or {}
+        self._validate_reindex(body)
+        if str(req.param("wait_for_completion",
+                         "true")).lower() == "false":
+            # runs as a PERSISTENT task: durably recorded, resumed on
+            # restart (ref persistent/PersistentTasksService.java:47;
+            # reindex is idempotent — doc ids overwrite)
+            task_id = self.node.persistent_tasks.submit(
+                "indices:data/write/reindex", body)
+            return 200, {"task": task_id}
+        return 200, self._do_reindex(body)
+
+    def _do_reindex(self, body):
         src = body.get("source") or {}
         dest = body.get("dest") or {}
         if not src.get("index") or not dest.get("index"):
@@ -502,9 +530,9 @@ class RestController:
                 else:
                     updated += 1
         dest_svc.refresh()
-        return 200, {"took": int((time.monotonic() - t0) * 1000),
-                     "total": total, "created": created,
-                     "updated": updated, "deleted": 0, "failures": []}
+        return {"took": int((time.monotonic() - t0) * 1000),
+                "total": total, "created": created,
+                "updated": updated, "deleted": 0, "failures": []}
 
     def h_update_by_query(self, req):
         body = req.json({}) or {}
@@ -1405,14 +1433,16 @@ class RestController:
 
     def h_security_put_user(self, req):
         body = req.json({}) or {}
-        name = req.param("username")
+        # path_params directly: req.param() would let a ?username= query
+        # parameter retarget the operation at a different account
+        name = req.path_params["username"]
         created = self.node.identity.put_user(
             name, body.get("password") or "",
-            body.get("roles") or ["readonly"])
+            body.get("roles"))   # None preserves roles (rotation)
         return 200, {"user": name, "created": created}
 
     def h_security_delete_user(self, req):
-        name = req.param("username")
+        name = req.path_params["username"]
         if not self.node.identity.delete_user(name):
             from opensearch_tpu.common.errors import \
                 ResourceNotFoundError
@@ -1432,11 +1462,27 @@ class RestController:
             raise ValidationError(f"invalid task id [{raw}]") from None
 
     def h_task_get(self, req):
-        tid = self._parse_task_id(req.path_params["task_id"])
+        raw = req.path_params["task_id"]
+        # persistent tasks (reindex?wait_for_completion=false) answer
+        # here too, like the reference's GET _tasks/<id> for reindex
+        pt = self.node.persistent_tasks.get_or_none(raw)
+        if pt is not None:
+            done = pt["state"] in ("completed", "failed")
+            return 200, {"completed": done,
+                         "task": {"id": raw, "action": pt["action"],
+                                  "state": pt["state"]},
+                         **({"response": pt.get("result")}
+                            if pt.get("result") else {}),
+                         **({"error": pt["error"]}
+                            if pt.get("error") else {})}
+        tid = self._parse_task_id(raw)
         t = self.node.task_manager.get(tid)
         if t is None:
             raise ResourceNotFoundError(f"task [{tid}] isn't running")
         return 200, {"completed": False, "task": t.info()}
+
+    def h_persistent_tasks_list(self, req):
+        return 200, {"tasks": self.node.persistent_tasks.list()}
 
     def h_task_cancel(self, req):
         tid = self._parse_task_id(req.path_params["task_id"])

@@ -19,6 +19,7 @@ import json
 import os
 import secrets
 import threading
+from typing import Optional
 
 from opensearch_tpu.common.errors import (IllegalArgumentError,
                                           OpenSearchTpuError)
@@ -79,12 +80,20 @@ class IdentityService:
 
     # -- user management --------------------------------------------------
 
-    def put_user(self, name: str, password: str, roles: list[str]):
+    def put_user(self, name: str, password: str,
+                 roles: Optional[list] = None):
+        """``roles=None`` preserves an existing user's roles (password
+        rotation must not silently demote — demoting the sole admin
+        would lock user management out permanently); new users default
+        to readonly."""
         if not name or "/" in name or ":" in name:
             raise IllegalArgumentError(f"invalid username [{name}]")
         if not password or len(password) < 6:
             raise IllegalArgumentError(
                 "password must be at least 6 characters")
+        if roles is None:
+            existing = self._users.get(name)
+            roles = existing["roles"] if existing else ["readonly"]
         bad = [r for r in roles if r not in ROLES]
         if bad or not roles:
             raise IllegalArgumentError(

@@ -190,3 +190,28 @@ def test_client_http_auth(node):
     assert ro.search(index="ci", body={})["hits"]["total"]["value"] == 0
     with pytest.raises(AuthorizationException):
         ro.index("ci", {"a": 1}, id="1")
+
+
+def test_password_rotation_preserves_roles(node):
+    """Review regression: PUT without [roles] must not demote — the
+    sole admin rotating their password would lock out user management
+    permanently."""
+    call(node, "PUT", "/_security/user/boss",
+         {"password": "firstpw", "roles": ["admin"]})
+    call(node, "PUT", "/_cluster/settings",
+         {"persistent": {"identity.enabled": True}})
+    code, body = call(node, "PUT", "/_security/user/boss",
+                      {"password": "secondpw"},
+                      auth=("boss", "firstpw"))
+    assert code == 200 and body["created"] is False
+    # still admin: can manage users with the NEW password
+    assert call(node, "PUT", "/_security/user/other",
+                {"password": "otherpw", "roles": ["readonly"]},
+                auth=("boss", "secondpw"))[0] == 200
+    # query param cannot retarget the path's username
+    code, _ = call(node, "DELETE", "/_security/user/other?username=boss",
+                   auth=("boss", "secondpw"))
+    assert code == 200
+    users = call(node, "GET", "/_security/user",
+                 auth=("boss", "secondpw"))[1]
+    assert "boss" in users and "other" not in users
