@@ -59,6 +59,15 @@ _METRIC_TYPES = {"min", "max", "sum", "avg", "value_count", "stats",
                  "cardinality", "percentiles"}
 _BUCKET_TYPES = {"terms", "histogram", "date_histogram", "range",
                  "date_range", "filter", "filters", "global", "missing"}
+# pipeline aggs (search/pipeline_aggs.py) parse like any agg but collect
+# nothing shard-side; they run as a reduce post-pass
+from opensearch_tpu.search.pipeline_aggs import (  # noqa: E402
+    PIPELINE_TYPES as _PIPELINE_TYPES, apply_pipelines as _apply_pipelines)
+
+
+def _metric_subs(req):
+    """Sub-aggs that collect shard-side (pipeline subs don't)."""
+    return [s for s in req.subs if s.type not in _PIPELINE_TYPES]
 
 
 @dataclass
@@ -78,12 +87,15 @@ def parse_aggs(aggs_json: dict) -> list[AggRequest]:
             raise ParsingError(
                 f"aggregation [{name}] must have exactly one type, got {types}")
         typ = types[0]
-        if typ not in _METRIC_TYPES | _BUCKET_TYPES:
+        if typ not in _METRIC_TYPES | _BUCKET_TYPES | _PIPELINE_TYPES:
             raise ParsingError(f"unknown aggregation type [{typ}]")
         subs = parse_aggs(subs_json)
         if typ in _METRIC_TYPES and subs:
             raise ParsingError(
                 f"metric aggregation [{name}] cannot have sub-aggregations")
+        if typ in _PIPELINE_TYPES and subs:
+            raise ParsingError(
+                f"pipeline aggregation [{name}] cannot have sub-aggregations")
         out.append(AggRequest(name, typ, body[typ], subs))
     return out
 
@@ -366,6 +378,8 @@ class AggregationExecutor:
     # -- dispatch ---------------------------------------------------------
 
     def _part_one(self, req, seg_views) -> dict:
+        if req.type in _PIPELINE_TYPES:
+            return {"t": "pipeline"}     # reduce-side only, no shard work
         if req.type in ("min", "max", "sum", "avg", "value_count", "stats"):
             return self._part_metric(req, seg_views)
         fn = getattr(self, f"_part_{req.type}", None)
@@ -504,10 +518,11 @@ class AggregationExecutor:
         if ft is None:
             return {"t": "terms", "tn": None, "dk": None, "buckets": [],
                     "others": 0, "min_inc": 0}
+        msubs = _metric_subs(req)
         if ft.dv_kind == "ordinal":
-            merged, sub_parts = self._terms_ordinal(field, seg_views, req.subs)
+            merged, sub_parts = self._terms_ordinal(field, seg_views, msubs)
         else:
-            merged, sub_parts = self._terms_numeric(field, seg_views, req.subs)
+            merged, sub_parts = self._terms_numeric(field, seg_views, msubs)
         shard_size = int(req.params.get("shard_size")
                          or max(size, int(size * 1.5 + 10)))
         items = sorted(merged.items(), key=_terms_order_key(order))
@@ -520,7 +535,7 @@ class AggregationExecutor:
         for key, count in kept:
             subs = {sub.name: _ser_tuple(sub_parts.get(
                 (sub.name, key), (0.0, 0, np.inf, -np.inf)))
-                for sub in req.subs}
+                for sub in msubs}
             buckets.append([key, int(count), subs])
         return {"t": "terms", "tn": ft.type_name, "dk": ft.dv_kind,
                 "buckets": buckets, "others": int(others),
@@ -662,11 +677,12 @@ class AggregationExecutor:
         n_buckets = len(keys)
         n_pad_b = pad_pow2(n_buckets + 1)
         totals = np.zeros(n_buckets, np.int64)
+        msubs = _metric_subs(req)
         sub_parts = {sub.name: [np.zeros(n_buckets),
                                 np.zeros(n_buckets, np.int64),
                                 np.full(n_buckets, np.inf),
                                 np.full(n_buckets, -np.inf)]
-                     for sub in req.subs}
+                     for sub in msubs}
         edges_j = jnp.asarray(edges)
         for seg, dseg, matched in seg_views:
             col = self._dev_numeric(dseg, field)
@@ -676,7 +692,7 @@ class AggregationExecutor:
                 col["values"], col["value_docs"], matched, edges_j,
                 n_buckets_pad=n_pad_b))
             totals += counts[:n_buckets]
-            for sub in req.subs:
+            for sub in msubs:
                 sf, _ = self._field_type(sub, sub.type)
                 scol = self._dev_numeric(dseg, sf)
                 if scol is None:
@@ -703,7 +719,7 @@ class AggregationExecutor:
                                           int(sub_parts[sub.name][1][i]),
                                           float(sub_parts[sub.name][2][i]),
                                           float(sub_parts[sub.name][3][i])))
-                    for sub in req.subs}
+                    for sub in msubs}
             out.append([float(keys[i]), int(totals[i]), subs])
         return out
 
@@ -739,7 +755,8 @@ class AggregationExecutor:
         return {"t": "single",
                 "doc_count": sum(int(m.sum()) for _s, _d, m in narrowed),
                 "subs": {sub.name: self._part_one(sub, narrowed)
-                         for sub in req.subs}}
+                         for sub in req.subs
+                         if sub.type not in _PIPELINE_TYPES}}
 
     def _part_filter(self, req, seg_views) -> dict:
         return self._single_bucket(
@@ -834,9 +851,13 @@ class AggregationExecutor:
 
 def reduce_aggs(aggs_json: dict, partials: list[dict]) -> dict:
     reqs = parse_aggs(aggs_json)
-    return {r.name: _red_one(r, [p.get(r.name) for p in partials
-                                 if p is not None and p.get(r.name) is not None])
-            for r in reqs}
+    out = {r.name: _red_one(r, [p.get(r.name) for p in partials
+                                if p is not None
+                                and p.get(r.name) is not None])
+           for r in reqs if r.type not in _PIPELINE_TYPES}
+    # pipeline aggs run over the fully-reduced tree (the reference's
+    # post-reduce PipelineAggregator pass)
+    return _apply_pipelines(reqs, out)
 
 
 def _red_one(req, parts: list):
@@ -986,7 +1007,7 @@ def _red_terms(req, parts):
         kas = _term_key_as_string(key, tn)
         if kas is not None:
             b["key_as_string"] = kas
-        for sub in req.subs:
+        for sub in _metric_subs(req):
             tup = sub_parts.get((sub.name, key))
             b[sub.name] = _finish_metric(
                 sub.type, _merge_tuples([tup]) if tup is not None
@@ -1065,7 +1086,7 @@ def _red_histogram(req, parts, is_date=False):
              "doc_count": int(counts[i])}
         if is_date:
             b["key_as_string"] = _fmt_date(int(key), fmt or None)
-        for sub in req.subs:
+        for sub in _metric_subs(req):
             tup = subs_acc.get((sub.name, i))
             b[sub.name] = _finish_metric(
                 sub.type, _merge_tuples([tup]) if tup is not None
@@ -1077,6 +1098,8 @@ def _red_histogram(req, parts, is_date=False):
 def _red_single(req, parts):
     out = {"doc_count": sum(p["doc_count"] for p in parts)}
     for sub in req.subs:
+        if sub.type in _PIPELINE_TYPES:
+            continue                    # applied in the post-reduce pass
         out[sub.name] = _red_one(sub, [p["subs"][sub.name] for p in parts
                                        if sub.name in p.get("subs", {})])
     return out

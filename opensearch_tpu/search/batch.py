@@ -34,26 +34,56 @@ _F32 = np.float32
 
 
 @partial(jax.jit, static_argnames=("n_pad", "budget", "k"))
-def batch_bm25_topk(offsets, doc_ids, tfs, doc_lens, live,
-                    term_ids, term_active, idfs, weights, avgdl, required,
-                    *, n_pad: int, budget: int, k: int):
-    """Score Q term-bag queries against one segment in one program.
+def batch_bm25_union_topk(offsets, doc_ids, tfs, doc_lens, live,
+                          union_tids, union_active, union_idfs,
+                          weights, act, required, avgdl,
+                          *, n_pad: int, budget: int, k: int):
+    """Score Q term-bag queries against one segment in ONE program via
+    the union-of-terms formulation.
 
-    ``term_ids``/``term_active``/``idfs``/``weights`` are [Q, T];
-    ``required`` is [Q] (AND = T, OR = minimum_should_match).  Returns
-    (vals [Q, k], idx [Q, k], totals [Q], maxes [Q]).
+    The naive vmap (round 4) gathered every query's postings separately,
+    so a 64-query batch either compiled one program per budget bucket
+    (compile explosion) or paid the heaviest query's gather budget 64
+    times (work explosion — the r4 throughput inversion).  Instead:
+
+      1. gather the postings of the ~T DISTINCT terms of the whole batch
+         once (``budget`` >= sum of their dfs — each posting touched once
+         per batch, not once per query);
+      2. scatter per-posting BM25 base scores idf*tf/(tf+norm) into a
+         dense [n_pad, T] doc x term matrix;
+      3. one [Q,T] @ [T,n_pad] matmul applies every query's term weights
+         — exactly the shape the MXU wants — and a second matmul over the
+         presence matrix counts matched terms for AND /
+         minimum_should_match semantics;
+      4. batched ``lax.top_k`` over [Q, n_pad].
+
+    ``union_tids``/``union_active``/``union_idfs`` are [T]; ``weights``
+    (boost-scaled, accumulated over duplicate query terms) and ``act``
+    (occurrence counts, so duplicated terms still satisfy AND) are
+    [Q, T]; ``required`` is [Q].  Returns (vals [Q, k], idx [Q, k],
+    totals [Q], maxes [Q]).
     """
-
-    def one(tid, act, idf_, w, req):
-        scores, count = bm25_ops.bm25_score_count(
-            offsets, doc_ids, tfs, doc_lens, tid, act, idf_, w, avgdl,
-            n_pad=n_pad, budget=budget, scored=True)
-        matched = (count >= req) & live
-        key = jnp.where(matched, scores, -jnp.inf)
-        vals, idx = lax.top_k(key, k)
-        return vals, idx, matched.sum(), jnp.max(key)
-
-    return jax.vmap(one)(term_ids, term_active, idfs, weights, required)
+    d, tf, slot, valid = bm25_ops.gather_postings(
+        offsets, doc_ids, tfs, union_tids, union_active,
+        budget=budget, pad_doc=n_pad - 1)
+    dl = doc_lens[d]
+    norm = bm25_ops.K1_DEFAULT * (1.0 - bm25_ops.B_DEFAULT
+                                  + bm25_ops.B_DEFAULT * dl / avgdl)
+    base = union_idfs[slot] * tf / (tf + norm)
+    t_pad = union_tids.shape[0]
+    dense = jnp.zeros((n_pad, t_pad), jnp.float32).at[d, slot].add(
+        jnp.where(valid, base, 0.0))
+    pres = jnp.zeros((n_pad, t_pad), jnp.float32).at[d, slot].add(
+        jnp.where(valid, 1.0, 0.0))
+    scores = jnp.einsum("qt,nt->qn", weights, dense,
+                        preferred_element_type=jnp.float32)
+    counts = jnp.einsum("qt,nt->qn", act,
+                        (pres > 0).astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+    matched = (counts >= required[:, None].astype(jnp.float32)) & live[None, :]
+    key = jnp.where(matched, scores, -jnp.inf)
+    vals, idx = lax.top_k(key, k)
+    return vals, idx, matched.sum(axis=1), jnp.max(key, axis=1)
 
 
 class BatchGroup:
@@ -80,17 +110,24 @@ class BatchGroup:
         """Execute against every segment; returns {pos: (rows, total,
         max_score)} in the sequential path's row format.
 
-        Within a segment, queries are sub-grouped by their own gather
-        budget bucket — one kernel launch per (bucket) — so a query over
-        rare terms never pays a hot term's gather budget."""
+        The union-of-terms kernel (``batch_bm25_union_topk``) gathers
+        each DISTINCT term of the batch once per segment and scores all
+        queries with one matmul, so total gather work is the union of
+        the batch's postings — independent of Q — and the whole batch is
+        ONE XLA program per (t_pad, q_pad, budget, k).  Round-4's
+        per-query vmap paid either a compile per budget bucket or the
+        heaviest budget x Q in wasted gathers (the throughput
+        inversion)."""
         Q = len(self.positions)
-        t_pad = pad_pow2(max(len(t) for t in self.terms), minimum=1)
         k = self.k
         avgdl = searcher.ctx.field_stats(self.field).avgdl
-        # accumulated per (query, segment) DEVICE handles; host-synced once
+        # device handles per segment LAUNCH; host-synced once at the end
+        # (4 D2H transfers per segment, not 4 per query per segment — the
+        # tunnel's RTT makes tiny per-query transfers the next bottleneck)
         from opensearch_tpu.common.tasks import check_current
 
-        acc: list[list] = [[] for _ in range(Q)]   # [(seg_order, v, i, t, m)]
+        launches = []             # (seg_order, vals[Q,k], idx, tot, mx)
+        q_pad = pad_pow2(Q, minimum=8)
         for seg_order, seg in enumerate(searcher.segments):
             check_current()    # cancellation point per segment program
             dseg = seg.device()
@@ -98,57 +135,62 @@ class BatchGroup:
             p = dseg.postings.get(self.field)
             if pf is None or p is None:
                 continue
-            tids = np.zeros((Q, t_pad), _I32)
-            active = np.zeros((Q, t_pad), bool)
-            idfs = np.zeros((Q, t_pad), _F32)
-            weights = np.zeros((Q, t_pad), _F32)
-            buckets: dict[int, list[int]] = {}
+            # distinct terms of the whole batch -> union slots
+            slot_of: dict[int, int] = {}
+            budget = 0
+            for terms in self.terms:
+                for t in terms:
+                    tid = pf.term_id(t)
+                    if tid >= 0 and tid not in slot_of:
+                        slot_of[tid] = len(slot_of)
+                        budget += int(pf.df[tid])
+            t_pad = pad_pow2(len(slot_of), minimum=8)
+            union_tids = np.zeros(t_pad, _I32)
+            union_active = np.zeros(t_pad, bool)
+            union_idfs = np.zeros(t_pad, _F32)
+            weights = np.zeros((q_pad, t_pad), _F32)
+            act = np.zeros((q_pad, t_pad), _F32)
+            for tid, si in slot_of.items():
+                union_tids[si] = tid
+                union_active[si] = True
             for qi, terms in enumerate(self.terms):
-                b = 0
                 for ti, t in enumerate(terms):
                     tid = pf.term_id(t)
-                    if tid >= 0:
-                        tids[qi, ti] = tid
-                        active[qi, ti] = True
-                        b += int(pf.df[tid])
-                idfs[qi, : len(terms)] = self.idfs[qi]
-                weights[qi, : len(terms)] = self.weights[qi]
-                buckets.setdefault(pad_bucket(b), []).append(qi)
+                    if tid < 0:
+                        continue
+                    si = slot_of[tid]
+                    union_idfs[si] = self.idfs[qi][ti]   # idf is per term
+                    weights[qi, si] += self.weights[qi][ti]
+                    act[qi, si] += 1.0   # occurrence count: duplicate
+                    # terms keep satisfying AND (required counts slots)
             live = searcher.ctx.live_jnp(seg, dseg)
             kk = min(k, dseg.n_pad)
-            required = np.asarray(self.required, _I32)
-            for budget, qis in buckets.items():
-                # pad the batch axis to pow2 buckets — every distinct Q
-                # would otherwise be its own XLA program
-                q_pad = pad_pow2(len(qis), minimum=8)
-                sel = np.zeros(q_pad, np.int64)
-                sel[: len(qis)] = qis
-                req = required[sel].copy()
-                req[len(qis):] = t_pad + 1          # padding rows match nothing
-                vals, idx, tot, mx = batch_bm25_topk(
-                    p["offsets"], p["doc_ids"], p["tfs"], p["doc_lens"],
-                    live, jnp.asarray(tids[sel]), jnp.asarray(active[sel]),
-                    jnp.asarray(idfs[sel]), jnp.asarray(weights[sel]),
-                    jnp.asarray(np.float32(avgdl)),
-                    jnp.asarray(req),
-                    n_pad=dseg.n_pad, budget=budget, k=kk)
-                for bi, qi in enumerate(qis):
-                    acc[qi].append((seg_order, vals[bi], idx[bi],
-                                    tot[bi], mx[bi]))
+            req = np.full(q_pad, np.inf, _F32)  # padding rows match nothing
+            req[:Q] = self.required
+            vals, idx, tot, mx = batch_bm25_union_topk(
+                p["offsets"], p["doc_ids"], p["tfs"], p["doc_lens"],
+                live, jnp.asarray(union_tids), jnp.asarray(union_active),
+                jnp.asarray(union_idfs), jnp.asarray(weights),
+                jnp.asarray(act), jnp.asarray(req),
+                jnp.asarray(np.float32(avgdl)),
+                n_pad=dseg.n_pad, budget=pad_bucket(budget), k=kk)
+            launches.append((seg_order, vals, idx, tot, mx))
+        # ONE host sync region: convert whole launches after the dispatch loop
+        synced = [(so, np.asarray(v), np.asarray(i), np.asarray(t),
+                   np.asarray(m)) for so, v, i, t, m in launches]
         out = {}
-        # ONE host sync region: convert after the full dispatch loop
         for qi, pos in enumerate(self.positions):
             rows_v, rows_s, rows_l = [], [], []
             total = 0
             max_score = -np.inf
-            for seg_order, vals, idx, tot, mx in acc[qi]:
-                vals, idx = np.asarray(vals), np.asarray(idx)
+            for seg_order, avals, aidx, atot, amx in synced:
+                vals, idx = avals[qi], aidx[qi]
                 keep = vals > -np.inf
                 rows_v.append(vals[keep])
                 rows_s.append(np.full(int(keep.sum()), seg_order, _I32))
                 rows_l.append(idx[keep])
-                total += int(tot)
-                max_score = max(max_score, float(mx))
+                total += int(atot[qi])
+                max_score = max(max_score, float(amx[qi]))
             if not rows_v:
                 out[pos] = ([], 0, None)
                 continue

@@ -1,0 +1,335 @@
+"""Pipeline aggregations vs plain-Python oracles, plus 1-shard vs N-shard
+partial-merge parity (the reference reduces pipelines AFTER the final
+cross-shard reduce — search/aggregations/pipeline/PipelineAggregator.java
+— so results must be identical however the segments are split)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.segment import SegmentWriter
+from opensearch_tpu.mapping.mapper import DocumentMapper
+from opensearch_tpu.search.aggs import reduce_aggs
+from opensearch_tpu.search.executor import ShardSearcher
+
+MAPPING = {"properties": {
+    "day": {"type": "date"},
+    "price": {"type": "double"},
+    "sparse": {"type": "double"},              # absent in month 2: a gap
+    "group": {"type": "keyword"},
+}}
+
+# 6 months, deterministic per-month sums
+DOCS = []
+for m in range(1, 7):
+    for i in range(m * 2):                     # month m has 2m docs
+        d = {"day": f"2023-{m:02d}-{(i % 27) + 1:02d}",
+             "price": float(m * 10 + i),
+             "group": "a" if i % 2 == 0 else "b"}
+        if m != 2:
+            d["sparse"] = float(m)
+        DOCS.append(d)
+
+MONTH_SUMS = [sum(d["price"] for d in DOCS
+                  if d["day"].startswith(f"2023-{m:02d}")) for m in range(1, 7)]
+MONTH_COUNTS = [m * 2 for m in range(1, 7)]
+
+HISTO = {"date_histogram": {"field": "day", "calendar_interval": "month"},
+         "aggs": {"total": {"sum": {"field": "price"}}}}
+
+
+def _searcher(n_segments):
+    mapper = DocumentMapper(MAPPING)
+    writer = SegmentWriter()
+    segs = []
+    per = math.ceil(len(DOCS) / n_segments)
+    for si in range(n_segments):
+        chunk = DOCS[si * per: (si + 1) * per]
+        if not chunk:
+            continue
+        parsed = [mapper.parse(f"{si}_{i}", d) for i, d in enumerate(chunk)]
+        segs.append(writer.build(parsed, f"s{si}"))
+    return ShardSearcher(segs, mapper)
+
+
+@pytest.fixture(scope="module")
+def one_shard():
+    return _searcher(1)
+
+
+def run_aggs(aggs, n_shards=1):
+    """Run via the real search path; n_shards>1 splits the corpus into
+    per-segment 'shards', collects wire partials from each, and reduces
+    them coordinator-side — the distributed path."""
+    body = {"size": 0, "query": {"match_all": {}}, "aggs": aggs}
+    if n_shards == 1:
+        return _searcher(1).search(body)["aggregations"]
+    partials = []
+    for si in range(n_shards):
+        s = _searcher(n_shards)
+        # one "shard" = one segment of the split
+        sub = ShardSearcher([s.segments[si]], s.mapper)
+        partials.append(sub.search(body, agg_partials=True)
+                        ["aggregation_partials"])
+    return reduce_aggs(aggs, partials)
+
+
+@pytest.mark.parametrize("n_shards", [1, 3])
+def test_cumulative_sum_and_derivative(n_shards):
+    aggs = {"histo": {**HISTO, "aggs": {
+        **HISTO["aggs"],
+        "cum": {"cumulative_sum": {"buckets_path": "total"}},
+        "deriv": {"derivative": {"buckets_path": "total"}},
+    }}}
+    out = run_aggs(aggs, n_shards)["histo"]["buckets"]
+    assert len(out) == 6
+    running = 0.0
+    for i, b in enumerate(out):
+        assert b["total"]["value"] == pytest.approx(MONTH_SUMS[i])
+        running += MONTH_SUMS[i]
+        assert b["cum"]["value"] == pytest.approx(running)
+        if i == 0:
+            assert "deriv" not in b
+        else:
+            assert b["deriv"]["value"] == pytest.approx(
+                MONTH_SUMS[i] - MONTH_SUMS[i - 1])
+
+
+def test_derivative_count_path_and_unit():
+    aggs = {"histo": {"date_histogram": {"field": "day",
+                                         "fixed_interval": "1d"},
+                      "aggs": {"d": {"derivative": {
+                          "buckets_path": "_count", "unit": "1d"}}}}}
+    out = run_aggs(aggs)["histo"]["buckets"]
+    # every bucket after the first has value + normalized_value
+    with_d = [b for b in out if "d" in b]
+    assert with_d
+    for prev, b in zip(out, out[1:]):
+        if "d" in b:
+            diff = b["doc_count"] - prev["doc_count"]
+            assert b["d"]["value"] == pytest.approx(diff)
+            days = (b["key"] - prev["key"]) / 86_400_000
+            assert b["d"]["normalized_value"] == pytest.approx(diff / days)
+
+
+def test_serial_diff_lag2():
+    aggs = {"histo": {**HISTO, "aggs": {
+        **HISTO["aggs"],
+        "sd": {"serial_diff": {"buckets_path": "total", "lag": 2}}}}}
+    out = run_aggs(aggs)["histo"]["buckets"]
+    for i, b in enumerate(out):
+        if i < 2:
+            assert "sd" not in b
+        else:
+            assert b["sd"]["value"] == pytest.approx(
+                MONTH_SUMS[i] - MONTH_SUMS[i - 2])
+
+
+def test_moving_fn_window_excludes_current():
+    aggs = {"histo": {**HISTO, "aggs": {
+        **HISTO["aggs"],
+        "mf": {"moving_fn": {"buckets_path": "total", "window": 2,
+                             "script": "MovingFunctions.max(values)"}}}}}
+    out = run_aggs(aggs)["histo"]["buckets"]
+    # MovFnPipelineAggregator.java:136 — window [i-w, i), current excluded
+    assert "mf" not in out[0]
+    for i in range(1, 6):
+        expect = max(MONTH_SUMS[max(0, i - 2): i])
+        assert out[i]["mf"]["value"] == pytest.approx(expect)
+
+
+def test_moving_avg_alias_models():
+    for model, expect_fn in [
+        ("simple", lambda w: sum(w) / len(w)),
+        ("linear", lambda w: sum(v * (j + 1) for j, v in enumerate(w))
+         / sum(range(1, len(w) + 1))),
+    ]:
+        aggs = {"histo": {**HISTO, "aggs": {
+            **HISTO["aggs"],
+            "ma": {"moving_avg": {"buckets_path": "total", "window": 3,
+                                  "model": model}}}}}
+        out = run_aggs(aggs)["histo"]["buckets"]
+        for i in range(1, 6):
+            w = MONTH_SUMS[max(0, i - 3): i]
+            assert out[i]["ma"]["value"] == pytest.approx(expect_fn(w)), model
+
+
+@pytest.mark.parametrize("n_shards", [1, 3])
+def test_sibling_bucket_metrics(n_shards):
+    aggs = {"histo": HISTO,
+            "avg_m": {"avg_bucket": {"buckets_path": "histo>total"}},
+            "max_m": {"max_bucket": {"buckets_path": "histo>total"}},
+            "min_m": {"min_bucket": {"buckets_path": "histo>total"}},
+            "sum_m": {"sum_bucket": {"buckets_path": "histo>total"}},
+            "stats_m": {"stats_bucket": {"buckets_path": "histo>total"}},
+            "est_m": {"extended_stats_bucket":
+                      {"buckets_path": "histo>total"}},
+            "pct_m": {"percentiles_bucket":
+                      {"buckets_path": "histo>total",
+                       "percents": [50.0, 100.0]}}}
+    out = run_aggs(aggs, n_shards)
+    v = np.asarray(MONTH_SUMS)
+    assert out["avg_m"]["value"] == pytest.approx(v.mean())
+    assert out["max_m"]["value"] == pytest.approx(v.max())
+    # max is June's bucket key (epoch millis of 2023-06-01)
+    assert out["max_m"]["keys"] == ["1685577600000"]
+    assert out["min_m"]["value"] == pytest.approx(v.min())
+    assert out["sum_m"]["value"] == pytest.approx(v.sum())
+    st = out["stats_m"]
+    assert st["count"] == 6 and st["avg"] == pytest.approx(v.mean())
+    est = out["est_m"]
+    assert est["std_deviation"] == pytest.approx(v.std())
+    assert est["std_deviation_bounds"]["upper"] == pytest.approx(
+        v.mean() + 2 * v.std())
+    # nearest-rank percentiles over sorted bucket values
+    s = np.sort(v)
+    assert out["pct_m"]["values"]["50.0"] == pytest.approx(s[2])
+    assert out["pct_m"]["values"]["100.0"] == pytest.approx(s[-1])
+
+
+def test_stats_bucket_count_path():
+    aggs = {"histo": {"date_histogram": {"field": "day",
+                                         "calendar_interval": "month"}},
+            "st": {"stats_bucket": {"buckets_path": "histo>_count"}}}
+    out = run_aggs(aggs)
+    assert out["st"]["sum"] == pytest.approx(sum(MONTH_COUNTS))
+    assert out["st"]["max"] == pytest.approx(max(MONTH_COUNTS))
+
+
+@pytest.mark.parametrize("n_shards", [1, 3])
+def test_bucket_script_and_selector(n_shards):
+    aggs = {"histo": {**HISTO, "aggs": {
+        **HISTO["aggs"],
+        "per_doc": {"bucket_script": {
+            "buckets_path": {"t": "total", "c": "_count"},
+            "script": "params.t / params.c"}},
+        "keep_big": {"bucket_selector": {
+            "buckets_path": {"c": "_count"},
+            "script": "params.c > 4"}}}}}
+    out = run_aggs(aggs, n_shards)["histo"]["buckets"]
+    # months 1,2 (counts 2,4) dropped by the selector
+    assert [b["doc_count"] for b in out] == [6, 8, 10, 12]
+    for b, m in zip(out, range(3, 7)):
+        assert b["per_doc"]["value"] == pytest.approx(
+            MONTH_SUMS[m - 1] / MONTH_COUNTS[m - 1])
+
+
+def test_bucket_script_bare_names_and_ternary():
+    aggs = {"histo": {**HISTO, "aggs": {
+        **HISTO["aggs"],
+        "bs": {"bucket_script": {
+            "buckets_path": {"t": "total"},
+            "script": "t > 100 ? t * 2 : 0"}}}}}
+    out = run_aggs(aggs)["histo"]["buckets"]
+    for i, b in enumerate(out):
+        expect = MONTH_SUMS[i] * 2 if MONTH_SUMS[i] > 100 else 0.0
+        assert b["bs"]["value"] == pytest.approx(expect)
+
+
+def test_bucket_sort_desc_and_size():
+    aggs = {"histo": {**HISTO, "aggs": {
+        **HISTO["aggs"],
+        "by_total": {"bucket_sort": {
+            "sort": [{"total": {"order": "desc"}}], "size": 3}}}}}
+    out = run_aggs(aggs)["histo"]["buckets"]
+    got = [b["total"]["value"] for b in out]
+    assert got == sorted(MONTH_SUMS, reverse=True)[:3]
+
+
+def test_bucket_sort_from_without_sort():
+    aggs = {"histo": {**HISTO, "aggs": {
+        "trunc": {"bucket_sort": {"from": 4}}}}}
+    out = run_aggs(aggs)["histo"]["buckets"]
+    assert len(out) == 2                       # months 5, 6 kept
+
+
+def test_chained_pipelines():
+    """derivative of cumulative_sum == the original series (shifted);
+    max_bucket over the derivative — declaration-order chaining."""
+    aggs = {"histo": {**HISTO, "aggs": {
+        **HISTO["aggs"],
+        "cum": {"cumulative_sum": {"buckets_path": "total"}},
+        "d_of_c": {"derivative": {"buckets_path": "cum"}}}},
+        "max_d": {"max_bucket": {"buckets_path": "histo>d_of_c"}}}
+    out = run_aggs(aggs)
+    buckets = out["histo"]["buckets"]
+    for i in range(1, 6):
+        assert buckets[i]["d_of_c"]["value"] == pytest.approx(MONTH_SUMS[i])
+    assert out["max_d"]["value"] == pytest.approx(max(MONTH_SUMS[1:]))
+
+
+def test_pipeline_inside_single_bucket_filter():
+    aggs = {"only_a": {"filter": {"term": {"group": "a"}}, "aggs": {
+        "histo": HISTO,
+        "avg_m": {"avg_bucket": {"buckets_path": "histo>total"}}}}}
+    out = run_aggs(aggs)["only_a"]
+    sums = [b["total"]["value"] for b in out["histo"]["buckets"]]
+    assert out["avg_m"]["value"] == pytest.approx(np.mean(sums))
+
+
+def test_sibling_path_through_single_bucket():
+    aggs = {"only_a": {"filter": {"term": {"group": "a"}},
+                       "aggs": {"histo": HISTO}},
+            "avg_m": {"avg_bucket": {"buckets_path": "only_a>histo>total"}}}
+    out = run_aggs(aggs)
+    sums = [b["total"]["value"] for b in out["only_a"]["histo"]["buckets"]]
+    assert out["avg_m"]["value"] == pytest.approx(np.mean(sums))
+
+
+def test_gap_policy_skip_vs_insert_zeros():
+    """``sparse`` has no values in month 2, so avg(month 2) is a gap
+    (BucketHelpers.GapPolicy): skip -> derivative bridges over it;
+    insert_zeros -> the gap becomes 0.0."""
+    base = {"date_histogram": {"field": "day", "calendar_interval": "month"},
+            "aggs": {"a": {"avg": {"field": "sparse"}}}}
+    skip = {"histo": {**base, "aggs": {
+        **base["aggs"],
+        "d": {"derivative": {"buckets_path": "a", "gap_policy": "skip"}}}}}
+    out = run_aggs(skip)["histo"]["buckets"]
+    assert out[1]["a"]["value"] is None        # month 2 is a real gap
+    assert "d" not in out[1]
+    # month 3's derivative bridges the gap: avg(3) - avg(1) = 3 - 1
+    assert out[2]["d"]["value"] == pytest.approx(2.0)
+
+    zeros = {"histo": {**base, "aggs": {
+        **base["aggs"],
+        "d": {"derivative": {"buckets_path": "a",
+                             "gap_policy": "insert_zeros"}}}}}
+    out = run_aggs(zeros)["histo"]["buckets"]
+    assert out[1]["d"]["value"] == pytest.approx(0.0 - 1.0)
+    assert out[2]["d"]["value"] == pytest.approx(3.0 - 0.0)
+
+
+def test_pipeline_agg_rejects_subs():
+    from opensearch_tpu.common.errors import ParsingError
+
+    with pytest.raises(ParsingError):
+        run_aggs({"x": {"cumulative_sum": {"buckets_path": "t"},
+                        "aggs": {"y": {"sum": {"field": "price"}}}}})
+
+
+def test_keep_values_gap_preserves_previous():
+    """keep_values never clears the carried value at a gap — same
+    bridging as skip (DerivativePipelineAggregator.java leaves
+    lastBucketValue untouched on NaN)."""
+    base = {"date_histogram": {"field": "day", "calendar_interval": "month"},
+            "aggs": {"a": {"avg": {"field": "sparse"}}}}
+    aggs = {"histo": {**base, "aggs": {
+        **base["aggs"],
+        "d": {"derivative": {"buckets_path": "a",
+                             "gap_policy": "keep_values"}}}}}
+    out = run_aggs(aggs)["histo"]["buckets"]
+    assert "d" not in out[1]                   # the gap itself
+    assert out[2]["d"]["value"] == pytest.approx(3.0 - 1.0)
+
+
+def test_parent_pipeline_outside_multibucket_is_rejected():
+    from opensearch_tpu.common.errors import IllegalArgumentError
+
+    with pytest.raises(IllegalArgumentError):
+        run_aggs({"cs": {"cumulative_sum": {"buckets_path": "h>m"}}})
+    with pytest.raises(IllegalArgumentError):
+        run_aggs({"f": {"filter": {"term": {"group": "a"}},
+                        "aggs": {"cs": {"cumulative_sum":
+                                        {"buckets_path": "x"}}}}})

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Probe-and-pounce: the accelerator tunnel works in short windows and
+# wedges for hours.  Loop a cheap probe; the moment it answers, run the
+# staged bench (bench.py) which records any accelerator result to
+# BENCH_TPU_RECORD.json.  Exits once a TPU-platform result lands.
+cd /root/repo
+LOG=/root/repo/bench_tpu_r05.log
+while true; do
+  if timeout 90 python -c "import jax; assert jax.default_backend() != 'cpu', jax.default_backend(); print(jax.devices())" >> "$LOG" 2>&1; then
+    echo "$(date -u +%FT%TZ) tunnel alive - running staged bench" >> "$LOG"
+    OSTPU_BENCH_TPU_TIMEOUT=2400 OSTPU_BENCH_PROBE_TRIES=1 timeout 2700 \
+      python bench.py > /tmp/bench_tpu_attempt.json 2>> "$LOG"
+    echo "$(date -u +%FT%TZ) bench attempt done: $(cat /tmp/bench_tpu_attempt.json)" >> "$LOG"
+    if [ -f /root/repo/BENCH_TPU_RECORD.json ]; then
+      echo "$(date -u +%FT%TZ) TPU RESULT RECORDED" >> "$LOG"
+      exit 0
+    fi
+  else
+    echo "$(date -u +%FT%TZ) probe failed/wedged" >> "$LOG"
+  fi
+  sleep 150
+done
