@@ -415,7 +415,7 @@ class ClusterNode:
                     fut.result(timeout=10.0)
                 except Exception as exc:
                     if getattr(exc, "remote_type", None) == \
-                            "version_conflict_error":
+                            "version_conflict_engine_exception":
                         # the replica fenced US for a stale primary term:
                         # the replica is ahead, not broken.  Failing it
                         # would evict an up-to-date copy; instead refuse

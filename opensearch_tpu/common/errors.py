@@ -17,20 +17,32 @@ class OpenSearchTpuError(Exception):
         self.reason = reason
         self.metadata = metadata
 
+    #: explicit wire name when the reference's differs from the derived one
+    wire_name: str | None = None
+
     @property
     def error_type(self) -> str:
-        # CamelCase -> snake_case, mirroring the reference's error type names.
+        # CamelCase -> snake_case with the reference's `_exception` suffix
+        # (OpenSearchException.getExceptionName) — clients and the YAML
+        # conformance suites match on these exact strings.
+        if self.wire_name is not None:
+            return self.wire_name
         name = type(self).__name__
         out = []
         for i, ch in enumerate(name):
             if ch.isupper() and i > 0:
                 out.append("_")
             out.append(ch.lower())
-        return "".join(out)
+        s = "".join(out)
+        if s.endswith("_error"):
+            s = s[: -len("_error")] + "_exception"
+        return s
 
     def to_xcontent(self) -> dict:
         return {
             "error": {
+                "root_cause": [{"type": self.error_type,
+                                "reason": self.reason}],
                 "type": self.error_type,
                 "reason": self.reason,
                 **({"metadata": self.metadata} if self.metadata else {}),
@@ -44,6 +56,8 @@ class ResourceNotFoundError(OpenSearchTpuError):
 
 
 class IndexNotFoundError(ResourceNotFoundError):
+    wire_name = "index_not_found_exception"
+
     def __init__(self, index: str):
         super().__init__(f"no such index [{index}]", index=index)
 
@@ -58,6 +72,8 @@ class ResourceAlreadyExistsError(OpenSearchTpuError):
 
 
 class IndexAlreadyExistsError(ResourceAlreadyExistsError):
+    wire_name = "resource_already_exists_exception"
+
     def __init__(self, index: str):
         super().__init__(f"index [{index}] already exists", index=index)
 
@@ -65,6 +81,7 @@ class IndexAlreadyExistsError(ResourceAlreadyExistsError):
 class ValidationError(OpenSearchTpuError):
     """Bad request payloads (action/ValidateActions analog)."""
 
+    wire_name = "action_request_validation_exception"
     status = 400
 
 
@@ -72,10 +89,14 @@ class ParsingError(ValidationError):
     """Malformed query DSL / mapping / settings JSON
     (core/common/ParsingException analog)."""
 
+    wire_name = None                 # derived: parsing_exception
+
 
 class MapperParsingError(ValidationError):
     """Document does not fit the mapping
     (index/mapper/MapperParsingException analog)."""
+
+    wire_name = None                 # derived: mapper_parsing_exception
 
 
 class StrictDynamicMappingError(MapperParsingError):
@@ -89,12 +110,13 @@ class StrictDynamicMappingError(MapperParsingError):
 
 
 class IllegalArgumentError(ValidationError):
-    pass
+    wire_name = None                 # derived: illegal_argument_exception
 
 
 class VersionConflictError(OpenSearchTpuError):
     """Optimistic concurrency failure (index/engine/VersionConflictEngineException)."""
 
+    wire_name = "version_conflict_engine_exception"
     status = 409
 
     def __init__(self, doc_id: str, expected, actual):

@@ -182,6 +182,10 @@ class InternalEngine:
             if version_type == "external":
                 if version <= cur:
                     raise VersionConflictError(doc_id, f"> [{cur}]", version)
+            elif version_type == "external_gte":
+                if version < cur:
+                    raise VersionConflictError(doc_id, f">= [{cur}]",
+                                               version)
             else:
                 if cur != version:
                     raise VersionConflictError(doc_id, version, cur)
@@ -198,7 +202,7 @@ class InternalEngine:
             entry = self._current_entry(doc_id)
             self._check_conflicts(doc_id, entry, if_seq_no, if_primary_term,
                                   version, version_type)
-            if version_type == "external":
+            if version_type in ("external", "external_gte"):
                 new_version = version
             else:
                 new_version = (entry.version + 1
@@ -261,7 +265,8 @@ class InternalEngine:
                                   version, version_type)
             if entry is None or entry.deleted:
                 return OpResult(str(doc_id), self._seq_no, 1, "not_found")
-            new_version = (version if version_type == "external"
+            new_version = (version
+                           if version_type in ("external", "external_gte")
                            else entry.version + 1)
             seq = self._seq_no + 1
             result = self._do_delete(doc_id, seq_no=seq, version=new_version,
@@ -406,27 +411,47 @@ class InternalEngine:
                         return None
                     if e.hot_idx >= 0:
                         doc = self._hot[e.hot_idx]
-                        return {"_id": doc_id, "_version": e.version,
-                                "_seq_no": e.seq_no, "_source": doc.source,
-                                "found": True}
+                        out = {"_id": doc_id, "_version": e.version,
+                               "_seq_no": e.seq_no,
+                               "_primary_term": self.primary_term,
+                               "_source": doc.source, "found": True}
+                        if doc.routing is not None:
+                            out["_routing"] = doc.routing
+                        return self._finish_get(out)
                     rop = self._replica_ops.get(e.seq_no)
                     if rop is not None and rop["id"] == doc_id:
                         # replica realtime GET from the buffered op (the
                         # reference reads the translog, ShardGetService)
-                        return {"_id": doc_id, "_version": e.version,
-                                "_seq_no": e.seq_no,
-                                "_source": rop["source"], "found": True}
+                        out = {"_id": doc_id, "_version": e.version,
+                               "_seq_no": e.seq_no,
+                               "_primary_term": self.primary_term,
+                               "_source": rop["source"], "found": True}
+                        if rop.get("routing") is not None:
+                            out["_routing"] = rop["routing"]
+                        return self._finish_get(out)
                 # falls through: doc lives in a segment
             # pending (unrefreshed) deletes stay visible to non-realtime
             # reads, exactly like an unrefreshed Lucene reader
             for seg in reversed(self.segments):
                 local = seg.id_to_local.get(doc_id)
                 if local is not None and seg.live[local]:
-                    return {"_id": doc_id,
-                            "_version": int(seg.versions[local]),
-                            "_seq_no": int(seg.seq_nos[local]),
-                            "_source": seg.source(local), "found": True}
+                    out = {"_id": doc_id,
+                           "_version": int(seg.versions[local]),
+                           "_seq_no": int(seg.seq_nos[local]),
+                           "_primary_term": self.primary_term,
+                           "_source": seg.source(local), "found": True}
+                    routing = seg.routings.get(local)
+                    if routing is not None:
+                        out["_routing"] = routing
+                    return self._finish_get(out)
             return None
+
+    def _finish_get(self, out: dict) -> dict:
+        """_source meta-field policy: enabled=false never returns source
+        (SourceFieldMapper.enabled)."""
+        if not getattr(self.mapper, "source_enabled", True):
+            out.pop("_source", None)
+        return out
 
     def acquire_searcher(self) -> ShardSearcher:
         """Search-visible snapshot; refresh() publishes new segments."""
@@ -543,7 +568,9 @@ class InternalEngine:
                 for local in range(seg.n_docs):
                     if seg.live[local]:
                         doc = self.mapper.parse(seg.doc_ids[local],
-                                                seg.source(local))
+                                                seg.source(local),
+                                                routing=seg.routings.get(
+                                                    local))
                         doc.seq_no = int(seg.seq_nos[local])
                         doc.version = int(seg.versions[local])
                         live_docs.append(doc)

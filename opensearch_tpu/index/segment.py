@@ -161,6 +161,9 @@ class Segment:
         self.sources: list[bytes] = []
         self.seq_nos = np.zeros(n_docs, dtype=np.int64)
         self.versions = np.ones(n_docs, dtype=np.int64)
+        # local -> custom routing value (only docs indexed with one; the
+        # reference stores _routing as a stored field)
+        self.routings: dict[int, str] = {}
         self.postings: dict[str, PostingsField] = {}
         self.numeric_dv: dict[str, NumericDV] = {}
         self.ordinal_dv: dict[str, OrdinalDV] = {}
@@ -443,6 +446,8 @@ class SegmentWriter:
             seg.sources.append(json.dumps(doc.source, separators=(",", ":")).encode())
             seg.seq_nos[i] = doc.seq_no
             seg.versions[i] = doc.version
+            if doc.routing is not None:
+                seg.routings[i] = doc.routing
             for fname, toks in doc.tokens.items():
                 per_term: dict[str, tuple[int, list[int]]] = {}
                 for term, pos in toks:

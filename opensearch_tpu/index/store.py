@@ -40,6 +40,7 @@ def _segment_encode(seg: Segment):
     }
     meta = {"seg_id": seg.seg_id, "n_docs": seg.n_docs,
             "doc_ids": seg.doc_ids,
+            "routings": {str(k): v for k, v in seg.routings.items()},
             "postings": {}, "numeric": {}, "ordinal": {}, "vector": {},
             "geo": {}, "nested": {}}
 
@@ -180,6 +181,8 @@ def _segment_decode(seg_id: str, meta: dict, z, src_blob: bytes) -> Segment:
     seg = Segment(seg_id, meta["n_docs"])
     seg.doc_ids = list(meta["doc_ids"])
     seg.id_to_local = {d: i for i, d in enumerate(seg.doc_ids)}
+    seg.routings = {int(k): v
+                    for k, v in (meta.get("routings") or {}).items()}
     seg.seq_nos = z["seq_nos"]
     seg.versions = z["versions"]
     seg.live = z["live"].copy()

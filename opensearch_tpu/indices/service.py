@@ -72,7 +72,20 @@ def murmur3_32(data: bytes, seed: int = 0) -> int:
     return h
 
 
-_INDEX_NAME = re.compile(r"^[a-z0-9][a-z0-9_\-.+]*$")
+_INDEX_NAME_FORBIDDEN = set('\\/*?"<>| ,#:')
+
+
+def deep_merge_doc(base: dict, patch: dict) -> dict:
+    """Recursive partial-document merge for _update: nested objects merge
+    key-by-key, everything else (incl. arrays) replaces
+    (XContentHelper.update / UpdateHelper semantics)."""
+    out = dict(base)
+    for k, v in patch.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_merge_doc(out[k], v)
+        else:
+            out[k] = v
+    return out
 
 
 def _parse_millis(v) -> int:
@@ -226,6 +239,9 @@ class IndexService:
         touched = set()
         for action, doc_id, source, params in ops:
             try:
+                if doc_id == "":
+                    raise IllegalArgumentError(
+                        "if _id is specified it must not be empty")
                 if action in ("index", "create"):
                     if action == "create" and doc_id is not None:
                         existing = self.get_doc(doc_id,
@@ -234,8 +250,12 @@ class IndexService:
                             raise ValidationError(
                                 f"[{doc_id}]: version conflict, document "
                                 "already exists")
+                    cas = {k: int(params[k])
+                           for k in ("if_seq_no", "if_primary_term")
+                           if params.get(k) is not None}
                     r = self.index_doc(doc_id, source,
-                                       routing=params.get("routing"))
+                                       routing=params.get("routing"),
+                                       **cas)
                     results.append({action: {
                         "_index": self.name, "_id": r.doc_id,
                         "_version": r.version, "_seq_no": r.seq_no,
@@ -249,6 +269,27 @@ class IndexService:
                         "status": 404 if r.result == "not_found" else 200}})
                 elif action == "update":
                     cur = self.get_doc(doc_id, params.get("routing"))
+                    from opensearch_tpu.common.errors import (
+                        VersionConflictError)
+                    if params.get("if_seq_no") is not None:
+                        cur_seq = cur["_seq_no"] if cur is not None else -1
+                        if int(params["if_seq_no"]) != cur_seq:
+                            raise VersionConflictError(
+                                doc_id, f"seq_no [{params['if_seq_no']}]",
+                                f"seq_no [{cur_seq}]")
+                    if params.get("if_primary_term") is not None:
+                        cur_term = (cur.get("_primary_term", 1)
+                                    if cur is not None else 0)
+                        if int(params["if_primary_term"]) != cur_term:
+                            raise VersionConflictError(
+                                doc_id,
+                                f"primary_term "
+                                f"[{params['if_primary_term']}]",
+                                f"primary_term [{cur_term}]")
+                    if cur is not None and "_source" not in cur:
+                        raise IllegalArgumentError(
+                            f"[{doc_id}]: source is missing — partial "
+                            "updates require [_source] to be enabled")
                     if cur is None:
                         if "upsert" in source:
                             merged = source["upsert"]
@@ -257,10 +298,30 @@ class IndexService:
                                 DocumentMissingError)
                             raise DocumentMissingError(self.name, doc_id)
                     else:
-                        merged = dict(cur["_source"])
-                        merged.update(source.get("doc", {}))
+                        merged = deep_merge_doc(cur["_source"],
+                                                source.get("doc", {}))
                     r = self.index_doc(doc_id, merged,
                                        routing=params.get("routing"))
+                    src_spec = params.get("_source")
+                    if src_spec is None and isinstance(source, dict):
+                        src_spec = source.get("_source")
+                    if src_spec:
+                        from opensearch_tpu.search.fetch import (
+                            filter_source)
+                        spec = src_spec
+                        if spec in ("true", "false"):
+                            spec = spec == "true"
+                        elif not isinstance(spec, bool):
+                            spec = (spec.split(",")
+                                    if isinstance(spec, str) else spec)
+                        results.append({"update": {
+                            "_index": self.name, "_id": r.doc_id,
+                            "_version": r.version, "_seq_no": r.seq_no,
+                            "result": "updated", "status": 200,
+                            "get": {"found": True,
+                                    "_source": filter_source(merged,
+                                                             spec)}}})
+                        continue
                     results.append({"update": {
                         "_index": self.name, "_id": r.doc_id,
                         "_version": r.version, "result": "updated",
@@ -283,6 +344,13 @@ class IndexService:
     def refresh(self):
         for engine in self.shards:
             engine.refresh()
+        self._dirty()
+
+    def refresh_doc_shard(self, doc_id: str, routing: Optional[str] = None):
+        """?refresh=true on a single-document write refreshes ONLY the
+        owning shard (RestActions write-refresh semantics: other shards'
+        pending ops stay invisible)."""
+        self.route(doc_id, routing).refresh()
         self._dirty()
 
     def invalidate_searcher(self):
@@ -418,9 +486,56 @@ class IndexService:
                                                index_name=self.name)
             return self._searcher
 
+    def index_setting(self, key: str, default):
+        """Per-index setting lookup accepting both the dotted and bare
+        key forms the create body may use."""
+        v = self.settings.get(f"index.{key}", self.settings.get(key))
+        return default if v is None else v
+
+    def _check_search_limits(self, body: dict):
+        """Per-index request-size guards (IndexSettings.MAX_* family)."""
+        mrw = int(self.index_setting("max_result_window", 10000))
+        window = int(body.get("from", 0) or 0) + int(
+            body.get("size", 10) if body.get("size") is not None else 10)
+        if window > mrw:
+            raise IllegalArgumentError(
+                f"Result window is too large, from + size must be less "
+                f"than or equal to: [{mrw}] but was [{window}]. See the "
+                "scroll api for a more efficient way to request large "
+                "data sets.")
+        dvf = body.get("docvalue_fields") or []
+        max_dvf = int(self.index_setting("max_docvalue_fields_search", 100))
+        if len(dvf) > max_dvf:
+            raise IllegalArgumentError(
+                f"Trying to retrieve too many docvalue_fields. Must be "
+                f"less than or equal to: [{max_dvf}] but was "
+                f"[{len(dvf)}]. This limit can be set by changing the "
+                "[index.max_docvalue_fields_search] index level setting.")
+        sf = body.get("script_fields") or {}
+        max_sf = int(self.index_setting("max_script_fields", 32))
+        if len(sf) > max_sf:
+            raise IllegalArgumentError(
+                f"Trying to retrieve too many script_fields. Must be "
+                f"less than or equal to: [{max_sf}] but was [{len(sf)}]. "
+                "This limit can be set by changing the "
+                "[index.max_script_fields] index level setting.")
+        rescore = body.get("rescore")
+        if rescore:
+            spec = rescore[0] if isinstance(rescore, list) else rescore
+            window = int(spec.get("window_size", 10))
+            max_rw = int(self.index_setting("max_rescore_window", 10000))
+            if window > max_rw:
+                raise IllegalArgumentError(
+                    f"Rescore window [{window}] is too large. It must "
+                    f"be less than [{max_rw}]. This prevents allocating "
+                    "massive heaps for storing the results to be "
+                    "rescored. This limit can be set by changing the "
+                    "[index.max_rescore_window] index level setting.")
+
     def search(self, body: Optional[dict] = None, *,
                agg_partials: bool = False) -> dict:
         body = body or {}
+        self._check_search_limits(body)
         if not agg_partials and self._use_mesh(body):
             resp = self._mesh_search(body)
         else:
@@ -697,10 +812,19 @@ class IndicesService:
 
     @staticmethod
     def validate_name(name: str):
-        if not _INDEX_NAME.match(name) or name != name.lower():
+        """Reference rules (MetadataCreateIndexService.validateIndexName):
+        lowercase, no reserved characters, must not start with _ - +,
+        not '.'/'..', < 255 bytes.  Any unicode satisfying those is
+        legal (e.g. CJK names)."""
+        bad = (not name or name != name.lower() or name in (".", "..")
+               or name[0] in "_-+"
+               or any(c in _INDEX_NAME_FORBIDDEN for c in name)
+               or len(name.encode("utf-8")) > 255)
+        if bad:
             raise ValidationError(
-                f"invalid index name [{name}]: must be lowercase and "
-                "start with an alphanumeric")
+                f"invalid index name [{name}]: must be lowercase, must "
+                "not contain [\\/*?\"<>|, #:] or spaces, and must not "
+                "start with [_-+]")
 
     def _register(self, name: str, settings: dict,
                   mappings: Optional[dict]) -> IndexService:
@@ -750,9 +874,10 @@ class IndicesService:
             svc = self._register(name, settings, mappings)
             tmpl_aliases = ((tmpl or {}).get("template") or {}).get(
                 "aliases", {})
-            for alias, meta in tmpl_aliases.items():
+            req_aliases = body.get("aliases") or {}
+            for alias, meta in {**tmpl_aliases, **req_aliases}.items():
                 self.aliases.setdefault(alias, {})[name] = meta or {}
-            if tmpl_aliases:
+            if tmpl_aliases or req_aliases:
                 self._persist_json(self._aliases_file, self.aliases)
             return svc
 
@@ -766,8 +891,23 @@ class IndicesService:
     def get(self, name: str) -> IndexService:
         svc = self.indices.get(name)
         if svc is None:
-            raise IndexNotFoundError(name)
+            svc = self._alias_single(name)
+            if svc is None:
+                raise IndexNotFoundError(name)
         return svc
+
+    def _alias_single(self, name: str):
+        """Resolve an alias for a single-index op (get/mget): one target
+        resolves, several is an error (TransportSingleShardAction)."""
+        targets = self.aliases.get(name)
+        if not targets:
+            return None
+        if len(targets) > 1:
+            raise IllegalArgumentError(
+                f"alias [{name}] has more than one index associated with "
+                f"it [{', '.join(sorted(targets))}], can't execute a "
+                "single index op")
+        return self.indices.get(next(iter(targets)))
 
     auto_create = True          # action.auto_create_index (dynamic)
 
@@ -793,6 +933,16 @@ class IndicesService:
                 pass
             svc.close()
             del self.indices[name]
+            # drop the index from every alias (empty aliases disappear,
+            # like cluster-state alias metadata on index deletion)
+            changed = False
+            for alias in list(self.aliases):
+                if self.aliases[alias].pop(name, None) is not None:
+                    changed = True
+                    if not self.aliases[alias]:
+                        del self.aliases[alias]
+            if changed:
+                self._persist_json(self._aliases_file, self.aliases)
             if remote_repo is not None:
                 # block same-name recreation until the remote cleanup
                 # finishes, or the trailing GC would destroy the NEW
@@ -987,9 +1137,11 @@ class IndicesService:
             return self.get(next(iter(targets)))
         if len(writers) == 1:
             return self.get(writers[0])
-        raise ValidationError(
-            f"alias [{alias}] points to {sorted(targets)} and no single "
-            "write index is set")
+        raise IllegalArgumentError(
+            f"no write index is defined for alias [{alias}]. The write "
+            "index may be explicitly disabled using is_write_index=false "
+            "or the alias points to multiple indices without one being "
+            "designated as a write index")
 
     # -- index templates ---------------------------------------------------
 

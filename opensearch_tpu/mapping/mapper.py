@@ -87,6 +87,10 @@ class DocumentMapper:
         self._fields: dict[str, FieldType] = {}
         self._field_configs: dict[str, dict] = {}
         self.dynamic = "true"  # "true" | "false" | "strict"
+        # _source meta-field: enabled=false stops storing source bytes
+        # (SourceFieldMapper.enabled) — GET/_source then 404s and hits
+        # carry no _source
+        self.source_enabled = True
         if mapping:
             self.merge(mapping)
 
@@ -137,12 +141,18 @@ class DocumentMapper:
             self._fields = new_fields
             self._field_configs = new_configs
             self.dynamic = new_dynamic
+            src_meta = mapping.get("_source")
+            if isinstance(src_meta, dict) and "enabled" in src_meta:
+                self.source_enabled = bool(src_meta["enabled"])
 
     def _merge_props(self, prefix: str, props: dict,
                      fields: dict, configs: dict):
         for name, config in props.items():
             path = f"{prefix}{name}"
-            if "properties" in config and "type" not in config:
+            if "properties" in config and config.get(
+                    "type", "object") == "object":
+                # implicit or explicit object container: children map
+                # flattened under the dotted path (ObjectMapper)
                 self._merge_props(path + ".", config["properties"], fields, configs)
                 continue
             if config.get("type") == "nested":
@@ -337,6 +347,34 @@ class DocumentMapper:
             raise MapperParsingError(
                 f"field [{ft.name}] of type [{ft.type_name}] does not "
                 "support arrays")
+        from opensearch_tpu.mapping.types import JoinFieldType
+        if isinstance(ft, JoinFieldType):
+            # join values land in the hidden #name / #parent ordinal
+            # columns (ParentJoinFieldMapper's joinField + parentIdField)
+            for v in values:
+                if v is None:
+                    continue
+                if isinstance(v, str):
+                    name, parent = v, None
+                elif isinstance(v, dict):
+                    name, parent = v.get("name"), v.get("parent")
+                else:
+                    raise MapperParsingError(
+                        f"[{ft.name}] join value must be a relation name "
+                        "or {name, parent}")
+                if not ft.is_relation(name):
+                    raise MapperParsingError(
+                        f"unknown join name [{name}] for field "
+                        f"[{ft.name}]")
+                if ft.parent_of(name) is not None and parent is None:
+                    raise MapperParsingError(
+                        f"[parent] is missing for join field [{ft.name}]")
+                doc.ordinals.setdefault(f"{ft.name}#name",
+                                        []).append(str(name))
+                if parent is not None:
+                    doc.ordinals.setdefault(f"{ft.name}#parent",
+                                            []).append(str(parent))
+            return
         pos_base = 0
         n_tokens = doc.field_lengths.get(ft.name, 0)
         saw_value = any(v is not None for v in values)
@@ -375,6 +413,12 @@ class DocumentMapper:
                     doc.vectors[ft.name] = dv
                 elif kind == "geo_point":
                     doc.geo_points.setdefault(ft.name, []).append(dv)
+        if saw_value and ft.index_enabled and not ft.doc_values_enabled \
+                and not toks:
+            # doc_values disabled and no indexed terms (numeric/date):
+            # record a presence marker so `exists` keeps working (the
+            # reference indexes points + _field_names for this)
+            toks.append(("\x01present", 0))
         if not toks:
             doc.tokens.pop(ft.name, None)
         # field_lengths presence == "this doc has the field" (the norms-entry

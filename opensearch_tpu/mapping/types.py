@@ -439,6 +439,99 @@ class NestedFieldType(FieldType):
         return None
 
 
+class JoinFieldType(FieldType):
+    """Parent-join field (ref modules/parent-join/
+    ParentJoinFieldMapper.java).  A doc's value is either a relation
+    name ("question") or {"name": "answer", "parent": "<parent _id>"}.
+    The mapper writes two hidden ordinal columns — ``<field>#name``
+    (relation) and ``<field>#parent`` (the parent join key) — which
+    has_child / has_parent / parent_id join host-side across segments
+    (the global-ordinals OrdinalMap role)."""
+
+    type_name = "join"
+    dv_kind = "none"
+    indexed = False
+    allow_multiple = False
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        rel = self.params.get("relations") or {}
+        # parent -> [children]
+        self.relations = {p: (c if isinstance(c, list) else [c])
+                          for p, c in rel.items()}
+
+    def parent_of(self, child_type: str):
+        for p, cs in self.relations.items():
+            if child_type in cs:
+                return p
+        return None
+
+    def is_relation(self, name: str) -> bool:
+        return name in self.relations or self.parent_of(name) is not None
+
+    def index_terms(self, value, analyzers):
+        return []
+
+
+class ObjectFieldType(FieldType):
+    """Explicit ``type: object`` container: no terms/doc-values of its
+    own — its sub-fields are mapped flattened as ``parent.child``
+    (ObjectMapper)."""
+
+    type_name = "object"
+    dv_kind = "none"
+    indexed = False
+
+    def index_terms(self, value, analyzers):
+        return []
+
+
+class BinaryFieldType(FieldType):
+    """base64 blob: kept in _source, not term-searchable.  A constant
+    presence marker is indexed per valued doc so ``exists`` works (the
+    reference tracks the same via _field_names — BinaryFieldMapper)."""
+
+    type_name = "binary"
+    dv_kind = "none"
+    indexed = True          # only the presence marker below
+
+    def index_terms(self, value, analyzers):
+        return [] if value is None else [("\x01present", 0)]
+
+
+class UnsignedLongFieldType(FieldType):
+    """64-bit unsigned integer (opensearch's unsigned_long).  Values are
+    stored raw in the int64 column; the upper half-range [2^63, 2^64)
+    saturates to 2^63-1 (ordering preserved, exact values above 2^63
+    are not distinguished — the reference's full-range support would
+    need an unsigned column type)."""
+
+    type_name = "unsigned_long"
+    dv_kind = "long"
+    indexed = True
+
+    _MAX_I64 = (1 << 63) - 1
+
+    def index_terms(self, value, analyzers):
+        return []
+
+    def _clamp(self, value) -> int:
+        v = int(value)
+        if not (0 <= v < (1 << 64)):
+            raise IllegalArgumentError(
+                f"Value [{value}] is out of range for an unsigned long")
+        return min(v, self._MAX_I64)
+
+    def doc_value(self, value):
+        return self._clamp(value)
+
+    def term_for_query(self, value):
+        return self._clamp(value)
+
+    def range_bound(self, value):
+        return self._clamp(value)
+
+
 FIELD_TYPES = {
     cls.type_name: cls
     for cls in [
@@ -447,6 +540,8 @@ FIELD_TYPES = {
         ShortFieldType, ByteFieldType, DoubleFieldType, FloatFieldType,
         HalfFloatFieldType, ScaledFloatFieldType, BooleanFieldType,
         DateFieldType, IpFieldType, DenseVectorFieldType, GeoPointFieldType,
+        BinaryFieldType, UnsignedLongFieldType, ObjectFieldType,
+        JoinFieldType,
     ]
 }
 FIELD_TYPES["knn_vector"] = DenseVectorFieldType

@@ -78,6 +78,9 @@ class Node:
         if os.path.exists(self._settings_file):
             with open(self._settings_file) as f:
                 stored = _json.load(f)
+        # transient settings live in memory only; persistent survive boot
+        self.settings_buckets = {"persistent": dict(stored),
+                                 "transient": {}}
         max_buckets = Setting.int_setting(
             "search.max_buckets", 65536, min_value=1, dynamic=True)
         auto_create = Setting.bool_setting(
@@ -90,10 +93,22 @@ class Node:
             min_value=0, dynamic=True)
         identity_enabled = Setting.bool_setting(
             "identity.enabled", False, dynamic=True)
+        alloc_enable = Setting.str_setting(
+            "cluster.routing.allocation.enable", "all", dynamic=True,
+            choices=("all", "primaries", "new_primaries", "none"))
+        from opensearch_tpu.common.errors import IllegalArgumentError
+
+        def _bp_mode_check(v: str):
+            if v not in ("monitor_only", "enforced", "disabled"):
+                raise IllegalArgumentError(
+                    f"Invalid SearchBackpressureMode: {v}")
+        backpressure_mode = Setting(
+            "search_backpressure.mode", "monitor_only", str,
+            validator=_bp_mode_check, dynamic=True)
         self.cluster_settings = SettingsRegistry(
             Settings(stored),
             [max_buckets, auto_create, max_scroll, cache_size,
-             identity_enabled])
+             identity_enabled, alloc_enable, backpressure_mode])
         # remote clusters configure via affix keys (RemoteClusterService)
         self.cluster_settings.register_prefix("cluster.remote")
         from opensearch_tpu.transport.remote import RemoteClusterService
@@ -119,19 +134,31 @@ class Node:
         self.identity.enabled = self.cluster_settings.get(
             identity_enabled)
 
-    def update_cluster_settings(self, updates: dict) -> dict:
+    def update_cluster_settings(self, persistent: dict | None = None,
+                                transient: dict | None = None) -> dict:
+        """Two-bucket cluster settings (ClusterUpdateSettingsRequest):
+        null values reset; only the persistent bucket survives restart."""
         import json as _json
 
-        self.cluster_settings.apply_update(updates)
+        self.cluster_settings.apply_update(
+            {**(persistent or {}), **(transient or {})})
+        for bucket, ups in (("persistent", persistent),
+                            ("transient", transient)):
+            d = self.settings_buckets[bucket]
+            for k, v in (ups or {}).items():
+                if v is None:
+                    d.pop(k, None)
+                else:
+                    d[k] = v
         tmp = self._settings_file + ".tmp"
         with open(tmp, "w") as f:
-            _json.dump(self.cluster_settings.settings.as_dict(), f)
+            _json.dump(self.settings_buckets["persistent"], f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._settings_file)
         return {"acknowledged": True,
-                "persistent": self.cluster_settings.settings.as_dict(),
-                "transient": {}}
+                "persistent": dict(self.settings_buckets["persistent"]),
+                "transient": dict(self.settings_buckets["transient"])}
 
     @property
     def port(self) -> int:

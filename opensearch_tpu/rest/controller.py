@@ -16,6 +16,7 @@ from typing import Callable, Optional
 
 from opensearch_tpu.common.errors import (
     DocumentMissingError,
+    IllegalArgumentError,
     IndexNotFoundError,
     OpenSearchTpuError,
     ParsingError,
@@ -49,6 +50,45 @@ class RestRequest:
     def flag(self, name: str) -> bool:
         v = self.params.get(name)
         return v is not None and str(v).lower() in ("", "true", "1")
+
+
+def _nest_settings(flat: dict) -> dict:
+    """Dotted settings keys -> the nested tree the reference's
+    Settings.toXContent(flat_settings=false) renders."""
+    out: dict = {}
+    for key, v in flat.items():
+        node = out
+        parts = str(key).split(".")
+        for p in parts[:-1]:
+            nxt = node.get(p)
+            if not isinstance(nxt, dict):
+                nxt = node[p] = {}
+            node = nxt
+        node[parts[-1]] = v
+    return out
+
+
+def _flatten_nulls(d: dict, prefix: str = ""):
+    """Yield (dotted_key, None) for nulls nested anywhere in a settings
+    body (Settings flattening drops them, but null means RESET)."""
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if v is None:
+            yield key, None
+        elif isinstance(v, dict):
+            yield from _flatten_nulls(v, key + ".")
+
+
+def _total_hits_as_int(resp: dict):
+    """?rest_total_hits_as_int=true: render hits.total as the pre-7.0
+    integer (RestSearchAction.TOTAL_HITS_AS_INT_PARAM), including per
+    sub-response in _msearch."""
+    hits = resp.get("hits")
+    if isinstance(hits, dict) and isinstance(hits.get("total"), dict):
+        hits["total"] = hits["total"].get("value", 0)
+    for sub in resp.get("responses") or []:
+        if isinstance(sub, dict):
+            _total_hits_as_int(sub)
 
 
 class Route:
@@ -102,7 +142,12 @@ class RestController:
                     continue
                 m = route.rx.match(path.rstrip("/") or "/")
                 if m:
-                    req.path_params = dict(zip(route.names, m.groups()))
+                    # percent-decode captured segments: /index/_doc/中文
+                    # arrives as %E4%B8%AD%E6%96%87 (RestRequest.java
+                    # decodes the same way)
+                    from urllib.parse import unquote
+                    req.path_params = dict(zip(
+                        route.names, (unquote(g) for g in m.groups())))
                     # every request runs as a registered, cancellable
                     # task (TaskManager.register analog); device loops
                     # check the contextvar between segment programs
@@ -118,7 +163,11 @@ class RestController:
                         action, f"{method} {path}")
                     token = taskmod.set_current(task)
                     try:
-                        return route.handler(req)
+                        status, resp = route.handler(req)
+                        if params.get("rest_total_hits_as_int") == "true" \
+                                and isinstance(resp, dict):
+                            _total_hits_as_int(resp)
+                        return status, resp
                     finally:
                         taskmod.reset_current(token)
                         self.node.task_manager.unregister(task)
@@ -152,6 +201,7 @@ class RestController:
         r("GET", "/_cat/indices", self.h_cat_indices)
         r("GET", "/_cat/health", self.h_cat_health)
         r("GET", "/_cat/count", self.h_cat_count)
+        r("GET", "/_cat/count/{index}", self.h_cat_count)
         r("GET", "/_cat/shards", self.h_cat_shards)
         r("GET", "/_cat/nodes", self.h_cat_nodes)
         r("GET", "/_cat/aliases", self.h_cat_aliases)
@@ -293,6 +343,8 @@ class RestController:
             "cluster_name": self.node.cluster_name,
             "status": status,
             "timed_out": False,
+            "discovered_master": True,
+            "discovered_cluster_manager": True,
             "number_of_nodes": 1,
             "number_of_data_nodes": 1,
             "active_primary_shards": active,
@@ -305,7 +357,37 @@ class RestController:
             "number_of_in_flight_fetch": 0,
             "task_max_waiting_in_queue_millis": 0,
             "active_shards_percent_as_number": 100.0,
+            **self._health_indices_level(req, indices),
         }
+
+    def _health_indices_level(self, req, indices) -> dict:
+        """?level=indices|shards adds the per-index (and per-shard)
+        breakdown (ClusterHealthResponse levels)."""
+        level = req.param("level", "cluster")
+        if level not in ("indices", "shards"):
+            return {}
+        out = {}
+        for name, svc in indices.items():
+            st = "yellow" if svc.num_replicas else "green"
+            entry = {
+                "status": st,
+                "number_of_shards": svc.num_shards,
+                "number_of_replicas": svc.num_replicas,
+                "active_primary_shards": svc.num_shards,
+                "active_shards": svc.num_shards,
+                "relocating_shards": 0,
+                "initializing_shards": 0,
+                "unassigned_shards": svc.num_replicas * svc.num_shards,
+            }
+            if level == "shards":
+                entry["shards"] = {
+                    str(i): {"status": st, "primary_active": True,
+                             "active_shards": 1, "relocating_shards": 0,
+                             "initializing_shards": 0,
+                             "unassigned_shards": svc.num_replicas}
+                    for i in range(svc.num_shards)}
+            out[name] = entry
+        return {"indices": out}
 
     def h_cluster_state(self, req):
         return 200, {
@@ -364,8 +446,15 @@ class RestController:
                       "node.total": "1", "shards": str(h["active_shards"])}]
 
     def h_cat_count(self, req):
-        total = sum(s.doc_count() for s in self.node.indices.indices.values())
-        return 200, [{"epoch": str(int(time.time())), "count": str(total)}]
+        targets = (self._target_indices(req)
+                   if req.path_params.get("index")
+                   else self.node.indices.indices.values())
+        total = sum(s.doc_count() for s in targets)
+        now = time.time()
+        return 200, [{"epoch": str(int(now)),
+                      "timestamp": time.strftime("%H:%M:%S",
+                                                 time.gmtime(now)),
+                      "count": str(total)}]
 
     def h_cat_shards(self, req):
         rows = []
@@ -685,16 +774,45 @@ class RestController:
 
     # -- documents ---------------------------------------------------------
 
-    def _maybe_refresh(self, svc, req):
+    @staticmethod
+    def _bulk_source_param(req):
+        """URL-level _source/_source_includes/_source_excludes default
+        for bulk update items."""
+        if req.param("_source") is not None:
+            return req.param("_source")
+        inc = req.param("_source_includes")
+        exc = req.param("_source_excludes")
+        if inc or exc:
+            spec = {}
+            if inc:
+                spec["includes"] = inc.split(",")
+            if exc:
+                spec["excludes"] = exc.split(",")
+            return spec
+        return None
+
+    def _maybe_refresh(self, svc, req, doc_id=None) -> bool:
         refresh = req.param("refresh")
         if refresh is not None and str(refresh).lower() in ("", "true",
                                                             "wait_for"):
-            svc.refresh()
+            if doc_id is not None:
+                # a single-doc write refreshes only its owning shard
+                svc.refresh_doc_shard(str(doc_id), req.param("routing"))
+            else:
+                svc.refresh()
+            # wait_for reports forced_refresh=false (the write merely
+            # waited); an explicit refresh reports true
+            return str(refresh).lower() != "wait_for"
+        return False
 
     def h_index_doc(self, req, doc_id=None, op_type=None):
         name = req.path_params["index"]
         svc = self.node.indices.write_index_for(name)
         doc_id = doc_id or req.path_params.get("id")
+        if doc_id is not None and len(str(doc_id).encode("utf-8")) > 512:
+            raise ValidationError(
+                f"id is too long, must be no longer than 512 bytes but "
+                f"was: {len(str(doc_id).encode('utf-8'))}")
         source = req.json()
         if not isinstance(source, dict):
             raise ParsingError("request body is required and must be a JSON "
@@ -713,18 +831,26 @@ class RestController:
         if req.param("version") is not None:
             kw["version"] = int(req.param("version"))
             kw["version_type"] = req.param("version_type", "internal")
+        if ((op_type or req.param("op_type")) == "create"
+                and kw.get("version_type", "internal") != "internal"):
+            raise ValidationError(
+                "Validation Failed: 1: create operations only support "
+                "internal versioning. use index instead;")
         if (op_type or req.param("op_type")) == "create" and doc_id is not None:
             if svc.get_doc(doc_id, req.param("routing")) is not None:
                 from opensearch_tpu.common.errors import VersionConflictError
                 raise VersionConflictError(doc_id, "document to be absent",
                                            "exists")
         r = svc.index_doc(doc_id, source, routing=req.param("routing"), **kw)
-        self._maybe_refresh(svc, req)
+        forced = self._maybe_refresh(svc, req, doc_id=r.doc_id)
         status = 201 if r.result == "created" else 200
-        return status, {"_index": name, "_id": r.doc_id,
-                        "_version": r.version, "_seq_no": r.seq_no,
-                        "_primary_term": 1, "result": r.result,
-                        "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        out = {"_index": name, "_id": r.doc_id,
+               "_version": r.version, "_seq_no": r.seq_no,
+               "_primary_term": 1, "result": r.result,
+               "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        if forced:
+            out["forced_refresh"] = True
+        return status, out
 
     def h_index_doc_auto(self, req):
         return self.h_index_doc(req, doc_id=None)
@@ -740,6 +866,12 @@ class RestController:
         if doc is None:
             return 404, {"_index": name, "_id": req.path_params["id"],
                          "found": False}
+        if req.param("version") is not None \
+                and int(req.param("version")) != doc["_version"]:
+            from opensearch_tpu.common.errors import VersionConflictError
+            raise VersionConflictError(req.path_params["id"],
+                                       req.param("version"),
+                                       doc["_version"])
         return 200, {"_index": name, **doc}
 
     def h_doc_exists(self, req):
@@ -753,6 +885,11 @@ class RestController:
         doc = svc.get_doc(req.path_params["id"], req.param("routing"))
         if doc is None:
             raise DocumentMissingError(name, req.path_params["id"])
+        if "_source" not in doc:
+            from opensearch_tpu.common.errors import ResourceNotFoundError
+            raise ResourceNotFoundError(
+                f"document source missing for [{name}]/"
+                f"[{req.path_params['id']}]")
         return 200, doc["_source"]
 
     def h_delete_doc(self, req):
@@ -763,22 +900,58 @@ class RestController:
             kw["if_seq_no"] = int(req.param("if_seq_no"))
         if req.param("if_primary_term") is not None:
             kw["if_primary_term"] = int(req.param("if_primary_term"))
+        if req.param("version") is not None:
+            kw["version"] = int(req.param("version"))
+            kw["version_type"] = req.param("version_type", "internal")
         r = svc.delete_doc(req.path_params["id"],
                            routing=req.param("routing"), **kw)
-        self._maybe_refresh(svc, req)
+        forced = self._maybe_refresh(svc, req, doc_id=r.doc_id)
         if r.result == "not_found":
             return 404, {"_index": name, "_id": r.doc_id,
-                         "result": "not_found"}
-        return 200, {"_index": name, "_id": r.doc_id, "_version": r.version,
-                     "_seq_no": r.seq_no, "result": "deleted",
-                     "_shards": {"total": 1, "successful": 1, "failed": 0}}
+                         "result": "not_found",
+                         "_shards": {"total": 1, "successful": 1,
+                                     "failed": 0}}
+        out = {"_index": name, "_id": r.doc_id, "_version": r.version,
+               "_seq_no": r.seq_no, "result": "deleted",
+               "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        if forced:
+            out["forced_refresh"] = True
+        return 200, out
 
     def h_update_doc(self, req):
+        from opensearch_tpu.indices.service import deep_merge_doc
+
         name = req.path_params["index"]
         svc = self.node.indices.write_index_for(name)
         body = req.json({})
         doc_id = req.path_params["id"]
         cur = svc.get_doc(doc_id, req.param("routing"))
+        created = cur is None
+        kw = {}
+        if req.param("if_seq_no") is not None:
+            kw["if_seq_no"] = int(req.param("if_seq_no"))
+        if req.param("if_primary_term") is not None:
+            kw["if_primary_term"] = int(req.param("if_primary_term"))
+        if kw and cur is None and "upsert" not in body \
+                and not body.get("doc_as_upsert"):
+            # CAS on a missing doc is document_missing, not a conflict
+            raise DocumentMissingError(name, doc_id)
+        if kw and cur is not None:
+            # CAS params check against the CURRENT doc before any noop
+            # short-circuit (UpdateHelper applies them to the write)
+            from opensearch_tpu.common.errors import VersionConflictError
+            cur_seq = cur["_seq_no"] if cur is not None else -1
+            cur_term = cur.get("_primary_term", 1) if cur is not None else 0
+            if kw.get("if_seq_no") is not None \
+                    and kw["if_seq_no"] != cur_seq:
+                raise VersionConflictError(
+                    doc_id, f"seq_no [{kw['if_seq_no']}]",
+                    f"seq_no [{cur_seq}]")
+            if kw.get("if_primary_term") is not None \
+                    and kw["if_primary_term"] != cur_term:
+                raise VersionConflictError(
+                    doc_id, f"primary_term [{kw['if_primary_term']}]",
+                    f"primary_term [{cur_term}]")
         if cur is None:
             if "upsert" in body:
                 merged = body["upsert"]
@@ -790,29 +963,96 @@ class RestController:
             if "doc" not in body:
                 raise ValidationError("[_update] requires a [doc] or "
                                       "[upsert] section")
-            merged = dict(cur["_source"])
-            merged.update(body["doc"])
-        r = svc.index_doc(doc_id, merged, routing=req.param("routing"))
-        self._maybe_refresh(svc, req)
-        return 200, {"_index": name, "_id": r.doc_id, "_version": r.version,
-                     "_seq_no": r.seq_no, "result": "updated"}
+            if "_source" not in cur:
+                raise ValidationError(
+                    f"[{name}][{doc_id}]: source is missing — partial "
+                    "updates require [_source] to be enabled")
+            merged = deep_merge_doc(cur["_source"], body["doc"])
+            # detect_noop (default true): an update that changes nothing
+            # neither bumps the version nor writes (UpdateHelper.java)
+            if merged == cur["_source"] and body.get("detect_noop", True):
+                out = {"_index": name, "_id": doc_id,
+                       "_version": cur["_version"],
+                       "_seq_no": cur["_seq_no"],
+                       "result": "noop",
+                       "_shards": {"total": 0, "successful": 0,
+                                   "failed": 0}}
+                self._update_get_section(req, out, cur)
+                return 200, out
+        r = svc.index_doc(doc_id, merged, routing=req.param("routing"), **kw)
+        forced = self._maybe_refresh(svc, req, doc_id=r.doc_id)
+        out = {"_index": name, "_id": r.doc_id, "_version": r.version,
+               "_seq_no": r.seq_no,
+               "result": "created" if created else "updated",
+               "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        if forced:
+            out["forced_refresh"] = True
+        self._update_get_section(
+            req, out, svc.get_doc(doc_id, req.param("routing")))
+        return 200, out
+
+    @staticmethod
+    def _update_get_section(req, out, doc):
+        """?_source=... on _update returns the post-update doc inline
+        (UpdateResponse.getGetResult)."""
+        spec = req.param("_source")
+        if spec is None or doc is None:
+            return
+        from opensearch_tpu.search.fetch import filter_source
+        if spec in ("", "true", "false"):
+            spec = spec != "false"
+        else:
+            spec = spec.split(",")
+        src = filter_source(doc.get("_source"), spec)
+        get = {"found": True, "_seq_no": doc["_seq_no"],
+               "_primary_term": doc.get("_primary_term", 1)}
+        if src is not None:
+            get["_source"] = src
+        out["get"] = get
 
     def h_mget(self, req):
         body = req.json({})
         default_index = req.path_params.get("index")
         docs_out = []
-        for spec in body.get("docs", []) or [
-                {"_id": i} for i in body.get("ids", [])]:
+        specs = body.get("docs", []) or [
+            {"_id": i} for i in body.get("ids", [])]
+        if not specs:
+            raise ValidationError(
+                "Validation Failed: 1: no documents to get;")
+        missing = [i + 1 for i, s in enumerate(specs) if "_id" not in s]
+        if missing:
+            raise ValidationError("Validation Failed: " + "".join(
+                f"{i}: id is missing;" for i in missing))
+        no_index = [i + 1 for i, s in enumerate(specs)
+                    if s.get("_index", default_index) is None]
+        if no_index:
+            raise ValidationError("Validation Failed: " + "".join(
+                f"{i}: index is missing;" for i in no_index))
+        for spec in specs:
             name = spec.get("_index", default_index)
-            if name is None:
-                raise ValidationError("_mget requires an index per doc")
+            doc_id = str(spec["_id"])        # ids are strings on the wire
+            routing = spec.get("routing")
             try:
                 svc = self.node.indices.get(name)
-                doc = svc.get_doc(spec["_id"], spec.get("routing"))
+            except IllegalArgumentError as e:
+                # e.g. an alias over multiple indices: a per-doc error,
+                # not a request failure (TransportMultiGetAction)
+                docs_out.append({"_index": name, "_id": doc_id, "error": {
+                    "root_cause": [{"type": e.error_type,
+                                    "reason": e.reason}],
+                    "type": e.error_type, "reason": e.reason}})
+                continue
+            except OpenSearchTpuError:
+                docs_out.append({"_index": name, "_id": doc_id,
+                                 "found": False})
+                continue
+            try:
+                doc = svc.get_doc(doc_id, None if routing is None
+                                  else str(routing))
             except OpenSearchTpuError:
                 doc = None
             if doc is None:
-                docs_out.append({"_index": name, "_id": spec["_id"],
+                docs_out.append({"_index": name, "_id": doc_id,
                                  "found": False})
             else:
                 docs_out.append({"_index": name, **doc})
@@ -841,6 +1081,9 @@ class RestController:
             action, meta = next(iter(action_line.items()))
             if action not in ("index", "create", "delete", "update"):
                 raise ParsingError(f"unknown bulk action [{action}]")
+            if action == "index" and meta.get("op_type") == "create":
+                action = "create"    # renders as a create item, with
+                # create's already-exists conflict semantics
             name = meta.get("_index", default_index)
             if name is None:
                 raise ValidationError("bulk item requires _index")
@@ -854,53 +1097,92 @@ class RestController:
                 except json.JSONDecodeError as e:
                     raise ParsingError(f"malformed bulk source line: {e}")
                 i += 1
+            require_alias = meta.get(
+                "require_alias", req.param("require_alias") == "true")
+            if require_alias and name not in self.node.indices.aliases:
+                bucket = ops_by_index.setdefault("\x00err", [])
+                order.append(("\x00err", len(bucket)))
+                bucket.append({action: {
+                    "_index": name, "_id": meta.get("_id"), "status": 404,
+                    "error": {"type": "index_not_found_exception",
+                              "reason": f"no such index [{name}] and "
+                                        "[require_alias] request flag is "
+                                        f"[true] and [{name}] is not an "
+                                        "alias"}}})
+                continue
             bucket = ops_by_index.setdefault(name, [])
             order.append((name, len(bucket)))
             bucket.append((action, meta.get("_id"), source,
                            {"routing": meta.get("routing",
-                                                meta.get("_routing"))}))
+                                                meta.get("_routing")),
+                            "if_seq_no": meta.get("if_seq_no"),
+                            "if_primary_term": meta.get(
+                                "if_primary_term"),
+                            "pipeline": meta.get("pipeline"),
+                            "_source": meta.get(
+                                "_source", self._bulk_source_param(req))}))
         results_by_index = {}
         t0 = time.monotonic()
         for name, ops in ops_by_index.items():
-            svc = self.node.indices.write_index_for(name)
-            pid = self._ingest_pipeline_for(req, svc)
-            if pid is not None:
-                cooked = []
-                precooked = {}      # i -> ready response (drop/error)
-                for i, (action, doc_id, source, kw) in enumerate(ops):
-                    # pipelines transform only index/create sources; an
-                    # update's {"doc": ...} wrapper passes through
-                    # untouched (IngestService skips updates too)
-                    if action in ("index", "create") and \
-                            source is not None:
-                        try:
-                            source = self.node.ingest.process(pid,
-                                                              source)
-                        except OpenSearchTpuError as e:
-                            # per-ITEM failure: bulk never aborts
-                            precooked[i] = {action: {
-                                "_index": name, "_id": doc_id,
-                                "status": e.status,
-                                "error": {"type": e.error_type,
-                                          "reason": e.reason}}}
-                            continue
-                        if source is None:      # dropped
-                            precooked[i] = {action: {
-                                "_index": name, "_id": doc_id,
-                                "result": "noop", "status": 200}}
-                            continue
-                    cooked.append((action, doc_id, source, kw))
-                results = svc.bulk(cooked)
-                merged, ri = [], 0
-                for i in range(len(ops)):
-                    if i in precooked:
-                        merged.append(precooked[i])
-                    else:
-                        merged.append(results[ri])
-                        ri += 1
-                results_by_index[name] = merged
-            else:
-                results_by_index[name] = svc.bulk(ops)
+            if name == "\x00err":     # pre-cooked require_alias failures
+                results_by_index[name] = ops
+                continue
+            try:
+                svc = self.node.indices.write_index_for(name)
+            except OpenSearchTpuError as e:
+                # unresolvable write target (e.g. alias without a write
+                # index): item-level errors, never a request failure
+                results_by_index[name] = [{action: {
+                    "_index": name, "_id": doc_id, "status": 400,
+                    "error": {"type": "illegal_argument_exception",
+                              "reason": e.reason}}}
+                    for action, doc_id, _s, _kw in ops]
+                continue
+            req_pid = self._ingest_pipeline_for(req, svc)
+            cooked = []
+            precooked = {}      # i -> ready response (drop/error)
+            for i, (action, doc_id, source, kw) in enumerate(ops):
+                # pipelines transform only index/create sources; an
+                # update's {"doc": ...} wrapper passes through
+                # untouched (IngestService skips updates too).  A
+                # per-item [pipeline] in the action metadata overrides
+                # the request-level one.
+                pid = kw.get("pipeline") or req_pid
+                if pid is not None and action in ("index", "create") \
+                        and source is not None:
+                    try:
+                        source = self.node.ingest.process(pid, source)
+                    except ResourceNotFoundError as e:
+                        # a missing pipeline is a CLIENT error per item
+                        # (TransportBulkAction: illegal_argument, 400)
+                        precooked[i] = {action: {
+                            "_index": name, "_id": doc_id, "status": 400,
+                            "error": {"type": "illegal_argument_exception",
+                                      "reason": e.reason}}}
+                        continue
+                    except OpenSearchTpuError as e:
+                        # per-ITEM failure: bulk never aborts
+                        precooked[i] = {action: {
+                            "_index": name, "_id": doc_id,
+                            "status": e.status,
+                            "error": {"type": e.error_type,
+                                      "reason": e.reason}}}
+                        continue
+                    if source is None:      # dropped
+                        precooked[i] = {action: {
+                            "_index": name, "_id": doc_id,
+                            "result": "noop", "status": 200}}
+                        continue
+                cooked.append((action, doc_id, source, kw))
+            results = svc.bulk(cooked)
+            merged, ri = [], 0
+            for i in range(len(ops)):
+                if i in precooked:
+                    merged.append(precooked[i])
+                else:
+                    merged.append(results[ri])
+                    ri += 1
+            results_by_index[name] = merged
             if req.param("refresh") in ("", "true", "wait_for"):
                 svc.refresh()
         items = [results_by_index[name][j] for name, j in order]
@@ -1115,7 +1397,31 @@ class RestController:
                     "expressions")
             return 200, self._ccs_search(expr, body)
         if scroll:
+            if int(body.get("from", 0) or 0) > 0:
+                raise IllegalArgumentError(
+                    "`from` parameter must be set to 0 when `scroll` is "
+                    "used")
+            batch = int(body.get("size", 10)
+                        if body.get("size") is not None else 10)
+            if batch > 10000:
+                raise IllegalArgumentError(
+                    f"Batch size is too large, size must be less than or "
+                    f"equal to: [10000] but was [{batch}]. Scroll batch "
+                    "sizes cost as much memory as result windows so they "
+                    "are controlled by the [index.max_result_window] "
+                    "index level setting.")
             return 200, self._open_scroll(req, body, scroll)
+        from_ = int(body.get("from", 0) or 0)
+        size_ = int(body.get("size", 10)
+                    if body.get("size") is not None else 10)
+        if from_ < 0:
+            raise IllegalArgumentError(f"[from] parameter cannot be "
+                                       f"negative, found [{from_}]")
+        if size_ < 0:
+            raise IllegalArgumentError(f"[size] parameter cannot be "
+                                       f"negative, found [{size_}]")
+        # per-index window/field-count limits apply in IndexService.search
+        # (index.max_result_window et al are index-level settings)
         targets = self._target_indices_filtered(req)
         if not targets:
             # allow_no_indices=true default: empty result, not an error
@@ -1285,8 +1591,11 @@ class RestController:
     # -- cluster settings / aliases / templates / analyze ------------------
 
     def h_cluster_get_settings(self, req):
-        out = {"persistent": self.node.cluster_settings.settings.as_dict(),
-               "transient": {}}
+        buckets = getattr(self.node, "settings_buckets", None) or {
+            "persistent": self.node.cluster_settings.settings.as_dict(),
+            "transient": {}}
+        out = {"persistent": _nest_settings(buckets["persistent"]),
+               "transient": _nest_settings(buckets["transient"])}
         if req.flag("include_defaults"):
             out["defaults"] = {
                 k: s.default(self.node.cluster_settings.settings)
@@ -1296,15 +1605,26 @@ class RestController:
 
     def h_cluster_put_settings(self, req):
         body = req.json({}) or {}
-        updates = {**(body.get("persistent") or {}),
-                   **(body.get("transient") or {})}
-        if not updates:
+        from opensearch_tpu.common.settings import Settings
+
+        def flat(d):
+            # flatten nested keys; preserve explicit nulls (= reset)
+            out = Settings(d or {}).as_dict()
+            for k, v in _flatten_nulls(d or {}):
+                out[k] = v
+            return out
+
+        persistent = flat(body.get("persistent"))
+        transient = flat(body.get("transient"))
+        if not persistent and not transient:
             raise ValidationError(
                 "no settings to update: provide [persistent] or "
                 "[transient]")
-        from opensearch_tpu.common.settings import Settings
-        updates = Settings(updates).as_dict()    # flatten nested keys
-        return 200, self.node.update_cluster_settings(updates)
+        out = self.node.update_cluster_settings(
+            persistent=persistent, transient=transient)
+        out["persistent"] = _nest_settings(out["persistent"])
+        out["transient"] = _nest_settings(out["transient"])
+        return 200, out
 
     def h_update_aliases(self, req):
         body = req.json({}) or {}
@@ -1611,6 +1931,23 @@ class RestController:
 
     def h_count(self, req):
         body = req.json({}) or {}
+        unknown = set(body) - {"query"}
+        if unknown:
+            raise ParsingError(
+                f"request does not support {sorted(unknown)}")
+        q = req.param("q")
+        if q and "query" not in body:
+            qs = {"query": q}
+            if req.param("df"):
+                qs["default_field"] = req.param("df")
+            if req.param("analyze_wildcard") is not None:
+                qs["analyze_wildcard"] = (req.param("analyze_wildcard")
+                                          == "true")
+            if req.param("lenient") is not None:
+                qs["lenient"] = req.param("lenient") == "true"
+            if req.param("default_operator"):
+                qs["default_operator"] = req.param("default_operator")
+            body["query"] = {"query_string": qs}
         services = self._target_indices_filtered(req)
         total = sum(
             svc.count(self._apply_alias_filter(

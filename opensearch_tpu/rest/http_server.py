@@ -15,10 +15,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qsl, urlsplit
 
 
-def _cat_table(rows: list[dict], want_header: bool) -> bytes:
+def _cat_table(rows: list[dict], want_header: bool,
+               columns: str | None = None) -> bytes:
     if not rows:
         return b""
     cols = list(rows[0])
+    if columns:                       # ?h=a,b column selection
+        cols = [c.strip() for c in columns.split(",") if c.strip()]
     widths = {c: max(len(c) if want_header else 0,
                      *(len(str(r.get(c, ""))) for r in rows)) for c in cols}
     out = []
@@ -68,7 +71,8 @@ class _Handler(BaseHTTPRequestHandler):
                 breaker.release(length)
         is_cat = split.path.startswith("/_cat") and params.get("format") != "json"
         if is_cat and isinstance(payload, list):
-            data = _cat_table(payload, want_header="v" in params)
+            data = _cat_table(payload, want_header="v" in params,
+                              columns=params.get("h"))
             ctype = "text/plain; charset=UTF-8"
         else:
             # response format negotiation (x-content: json/yaml/cbor via

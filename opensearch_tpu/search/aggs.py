@@ -56,18 +56,43 @@ PCT_RAW_MAX = 10_000         # percentiles: raw values above this compress
 PCT_CENTROIDS = 1024
 HLL_P = 12                   # 4096 registers, ~1.6% relative error
 _METRIC_TYPES = {"min", "max", "sum", "avg", "value_count", "stats",
-                 "cardinality", "percentiles"}
+                 "cardinality", "percentiles", "extended_stats",
+                 "weighted_avg", "percentile_ranks",
+                 "median_absolute_deviation", "top_hits"}
 _BUCKET_TYPES = {"terms", "histogram", "date_histogram", "range",
-                 "date_range", "filter", "filters", "global", "missing"}
+                 "date_range", "filter", "filters", "global", "missing",
+                 "significant_terms", "rare_terms", "multi_terms",
+                 "composite"}
 # pipeline aggs (search/pipeline_aggs.py) parse like any agg but collect
 # nothing shard-side; they run as a reduce post-pass
 from opensearch_tpu.search.pipeline_aggs import (  # noqa: E402
     PIPELINE_TYPES as _PIPELINE_TYPES, apply_pipelines as _apply_pipelines)
 
 
+_TUPLE_METRICS = {"min", "max", "sum", "avg", "value_count", "stats"}
+
+
 def _metric_subs(req):
-    """Sub-aggs that collect shard-side (pipeline subs don't)."""
-    return [s for s in req.subs if s.type not in _PIPELINE_TYPES]
+    """Sub-aggs that collect via the (sum, count, min, max) tuple
+    machinery under terms/histogram/multi_terms/composite buckets.
+    Pipeline subs collect nothing; top_hits has its own per-bucket path;
+    anything else under these parents is an explicit 400 (the richer
+    composition surface lives under filter/filters/range/global/missing,
+    which recurse with full generality)."""
+    out = []
+    for s in req.subs:
+        if s.type in _PIPELINE_TYPES or s.type == "top_hits":
+            continue
+        if s.type not in _TUPLE_METRICS:
+            raise IllegalArgumentError(
+                f"[{req.type}] does not support [{s.type}] "
+                "sub-aggregations (nest it under a filter instead)")
+        out.append(s)
+    return out
+
+
+def _top_hits_subs(req):
+    return [s for s in req.subs if s.type == "top_hits"]
 
 
 @dataclass
@@ -196,6 +221,27 @@ def _merge_tuples(parts: list) -> tuple:
         if p[3] is not None:
             mx = max(mx, p[3])
     return s, c, mn, mx
+
+
+def _top_hits_sort(sort):
+    """(field, desc) for a top_hits sort spec; (None, True) = by _score.
+    Numeric-field sorts only (the agg's common shape); anything else is
+    a 400, not a silent misorder."""
+    if sort is None:
+        return None, True
+    if isinstance(sort, list):
+        if len(sort) != 1:
+            raise IllegalArgumentError(
+                "[top_hits] supports a single sort key")
+        sort = sort[0]
+    if isinstance(sort, str):
+        return (None, True) if sort == "_score" else (sort, False)
+    ((field, spec),) = sort.items()
+    desc = (spec.get("order", "asc") if isinstance(spec, dict)
+            else spec) == "desc"
+    if field == "_score":
+        return None, True
+    return field, desc
 
 
 def _finish_metric(typ: str, merged: tuple, params: dict | None = None):
@@ -341,8 +387,11 @@ class AggregationExecutor:
     matched masks, one per segment.
     """
 
-    def __init__(self, ctx):
+    def __init__(self, ctx, scores_of: dict | None = None):
         self.ctx = ctx               # compiler.ShardContext
+        # per-segment query-phase scores (seg.name -> [n_pad] array);
+        # only top_hits needs them, and only when sorting by _score
+        self.scores_of = scores_of or {}
 
     def run(self, aggs_json: dict, seg_views: list) -> dict:
         """Single-shard convenience: collect + reduce of one partial."""
@@ -358,6 +407,10 @@ class AggregationExecutor:
     def _field_type(self, req, caller):
         field = req.params.get("field")
         if field is None:
+            if caller == "terms":
+                raise ParsingError(
+                    "Required one of fields [field, script], but none "
+                    "were specified. ")
             raise ParsingError(f"[{caller}] aggregation requires a [field]")
         ft = self.ctx.field_type(field)
         if ft is not None and ft.dv_kind == "none":
@@ -509,20 +562,214 @@ class AggregationExecutor:
         allv = np.concatenate(raw_chunks)
         return {"t": "pct", "kind": "raw", "v": allv.tolist()}
 
+    def _part_percentile_ranks(self, req, seg_views) -> dict:
+        """Same mergeable value sketch as percentiles (raw below the cap,
+        equal-weight centroids above); the rank direction happens at
+        reduce.  Ref metrics/PercentileRanksAggregationBuilder.java."""
+        if req.params.get("values") is None:
+            raise ParsingError(
+                "[percentile_ranks] requires a [values] array")
+        return self._part_percentiles(req, seg_views)
+
+    def _part_median_absolute_deviation(self, req, seg_views) -> dict:
+        """MAD over the same sketch (exact on raw partials; on centroid
+        partials the weighted-median deviation is the TDigest-style
+        approximation the reference documents).  Ref
+        metrics/MedianAbsoluteDeviationAggregator.java."""
+        return self._part_percentiles(req, seg_views)
+
+    def _part_extended_stats(self, req, seg_views) -> dict:
+        """stats + sum_of_squares partial (the extra moment the variance
+        family needs).  Ref metrics/ExtendedStatsAggregator.java."""
+        field, _ = self._field_type(req, "extended_stats")
+        s = sq = 0.0
+        c = 0
+        mn, mx = np.inf, -np.inf
+        for seg, dseg, matched in seg_views:
+            dv = seg.numeric_dv.get(field)
+            if dv is None or not len(dv.value_docs):
+                continue
+            ok = np.asarray(matched)[dv.value_docs]
+            v = dv.values[ok].astype(np.float64)
+            if not len(v):
+                continue
+            s += float(v.sum())
+            sq += float((v * v).sum())
+            c += int(len(v))
+            mn = min(mn, float(v.min()))
+            mx = max(mx, float(v.max()))
+        return {"t": "estats",
+                "v": _ser_tuple((s, c, mn, mx)) + [float(sq)]}
+
+    def _part_weighted_avg(self, req, seg_views) -> dict:
+        """sum(value*weight) / sum(weight) partial.  Multi-valued value
+        fields weight every value by the doc's (single-valued) weight;
+        docs missing the weight field are skipped, docs missing the
+        value field use [value.missing] if set.  Ref
+        metrics/WeightedAvgAggregator.java."""
+        vcfg = req.params.get("value") or {}
+        wcfg = req.params.get("weight") or {}
+        vfield, wfield = vcfg.get("field"), wcfg.get("field")
+        if not vfield or not wfield:
+            raise ParsingError(
+                "[weighted_avg] requires [value.field] and [weight.field]")
+        v_missing = vcfg.get("missing")
+        vw_sum = w_sum = 0.0
+        for seg, dseg, matched in seg_views:
+            wdv = seg.numeric_dv.get(wfield)
+            if wdv is None or not len(wdv.value_docs):
+                continue
+            m = np.asarray(matched)
+            weight_of = np.zeros(seg.n_docs)
+            has_w = np.zeros(seg.n_docs, bool)
+            wok = m[wdv.value_docs]
+            weight_of[wdv.value_docs[wok]] = wdv.values[wok].astype(np.float64)
+            has_w[wdv.value_docs[wok]] = True
+            vdv = seg.numeric_dv.get(vfield)
+            got_v = np.zeros(seg.n_docs, bool)
+            if vdv is not None and len(vdv.value_docs):
+                vok = m[vdv.value_docs] & has_w[vdv.value_docs]
+                vd = vdv.value_docs[vok]
+                vw_sum += float((vdv.values[vok].astype(np.float64)
+                                 * weight_of[vd]).sum())
+                # each doc's weight counts once no matter how many values
+                got_v[vd] = True
+                w_sum += float(weight_of[np.nonzero(got_v)[0]].sum())
+            if v_missing is not None:
+                fill = has_w & ~got_v & m[: seg.n_docs]
+                vw_sum += float(v_missing) * float(weight_of[fill].sum())
+                w_sum += float(weight_of[fill].sum())
+        return {"t": "wavg", "v": [vw_sum, w_sum]}
+
+    def _part_top_hits(self, req, seg_views) -> dict:
+        """Per-shard top hits by query score (or a numeric field sort),
+        serialized with their _source so the coordinator merge needs no
+        second fetch round-trip.  Ref metrics/TopHitsAggregator.java."""
+        hits, total = self._top_hits_collect(req, seg_views)
+        return {"t": "tophits", "hits": hits, "total": total}
+
+    def _top_hits_collect(self, req, seg_views):
+        from opensearch_tpu.search.fetch import filter_source
+
+        size = int(req.params.get("size", 3))
+        from_ = int(req.params.get("from", 0))
+        want = from_ + size
+        sort_field, sort_desc = _top_hits_sort(req.params.get("sort"))
+        source_spec = req.params.get("_source")
+        rows = []
+        total = 0
+        for seg, dseg, matched in seg_views:
+            m = np.asarray(matched)[: seg.n_docs]
+            docs = np.nonzero(m)[0]
+            total += int(len(docs))
+            if not len(docs):
+                continue
+            if sort_field is None:
+                scores = self.scores_of.get(seg.seg_id)
+                key = (np.asarray(scores)[: seg.n_docs][docs]
+                       if scores is not None
+                       else np.zeros(len(docs)))
+                desc = True
+            else:
+                dv = seg.numeric_dv.get(sort_field)
+                key = np.full(len(docs), np.nan)
+                if dv is not None and len(dv.value_docs):
+                    col = np.full(seg.n_docs, np.nan)
+                    col[dv.value_docs[::-1]] = dv.values[::-1]  # first value
+                    key = col[docs]
+                desc = sort_desc
+            nan_safe = np.where(np.isnan(key), -np.inf if desc else np.inf,
+                                key)                   # missing sorts last
+            order = np.argsort(-nan_safe if desc else nan_safe,
+                               kind="stable")[:want]
+            for i in order:
+                d = int(docs[i])
+                k = key[i]
+                rows.append((float(k) if np.isfinite(k) else None, seg, d))
+        last = -np.inf if (sort_field is None or sort_desc) else np.inf
+        rows.sort(key=lambda r: r[0] if r[0] is not None else last,
+                  reverse=(sort_field is None or sort_desc))
+        out = []
+        for k, seg, d in rows[:want]:
+            hit = {"_id": seg.doc_ids[d],
+                   "_score": k if sort_field is None else None}
+            src = filter_source(seg.source(d), source_spec)
+            if src is not None:
+                hit["_source"] = src
+            if sort_field is not None:
+                hit["sort"] = [k]
+            out.append(hit)
+        return out, total
+
     # -- terms ------------------------------------------------------------
 
     def _part_terms(self, req, seg_views) -> dict:
         field, ft = self._field_type(req, "terms")
         size = int(req.params.get("size", 10))
         order = req.params.get("order", {"_count": "desc"})
+        missing = req.params.get("missing")
         if ft is None:
-            return {"t": "terms", "tn": None, "dk": None, "buckets": [],
+            if missing is None:
+                return {"t": "terms", "tn": None, "dk": None,
+                        "buckets": [], "others": 0, "min_inc": 0}
+            # unmapped field + missing: every matched doc buckets under
+            # the missing value (TermsAggregatorFactory unmapped+missing)
+            total = sum(int(np.asarray(m)[: s.n_docs].sum())
+                        for s, _d, m in seg_views)
+            value_type = req.params.get("value_type")
+            if value_type == "date":
+                tn, dk = "date", "long"
+                missing = int(parse_date_millis(missing))
+            elif isinstance(missing, bool):
+                tn, dk, missing = "boolean", "long", int(missing)
+            elif isinstance(missing, str):
+                tn, dk = "keyword", "ordinal"
+            elif isinstance(missing, int):
+                tn, dk = "long", "long"
+            else:
+                tn, dk = "double", "double"
+            buckets = [[missing, total, {}]] if total else []
+            return {"t": "terms", "tn": tn, "dk": dk, "buckets": buckets,
                     "others": 0, "min_inc": 0}
         msubs = _metric_subs(req)
         if ft.dv_kind == "ordinal":
             merged, sub_parts = self._terms_ordinal(field, seg_views, msubs)
         else:
             merged, sub_parts = self._terms_numeric(field, seg_views, msubs)
+        if int(req.params.get("min_doc_count", 1)) == 0:
+            # zero-count buckets: every term of the index joins with 0
+            # (TermsAggregator's buildEmptyAggregation grid fill)
+            for seg, _d, _m in seg_views:
+                if ft.dv_kind == "ordinal":
+                    dv = seg.ordinal_dv.get(field)
+                    for t in (dv.ord_terms if dv is not None else ()):
+                        merged.setdefault(t, 0)
+                else:
+                    dv = seg.numeric_dv.get(field)
+                    if dv is not None:
+                        for v in np.unique(dv.values):
+                            key = (float(v) if dv.kind == "double"
+                                   else int(v))
+                            merged.setdefault(key, 0)
+        if missing is not None:
+            # docs without a value for the field take the missing value
+            absent = 0
+            for seg, dseg, matched in seg_views:
+                m = np.asarray(matched)[: seg.n_docs]
+                dv = (seg.ordinal_dv if ft.dv_kind == "ordinal"
+                      else seg.numeric_dv).get(field)
+                with_val = (len(np.unique(dv.value_docs[
+                    m[dv.value_docs]])) if dv is not None
+                    and len(dv.value_docs) else 0)
+                absent += int(m.sum()) - with_val
+            if absent:
+                key = (missing if ft.dv_kind == "ordinal"
+                       else (float(missing) if ft.dv_kind == "double"
+                             else int(parse_date_millis(missing)
+                                      if ft.type_name == "date"
+                                      and isinstance(missing, str)
+                                      else missing)))
+                merged[key] = merged.get(key, 0) + absent
         shard_size = int(req.params.get("shard_size")
                          or max(size, int(size * 1.5 + 10)))
         items = sorted(merged.items(), key=_terms_order_key(order))
@@ -532,14 +779,37 @@ class AggregationExecutor:
         is_count_desc = _is_count_desc(order)
         min_inc = kept[-1][1] if (tail and kept and is_count_desc) else 0
         buckets = []
+        th_subs = _top_hits_subs(req)
         for key, count in kept:
             subs = {sub.name: _ser_tuple(sub_parts.get(
                 (sub.name, key), (0.0, 0, np.inf, -np.inf)))
                 for sub in msubs}
+            for sub in th_subs:     # per-bucket top hits: narrowed mask
+                subs[sub.name] = self._part_top_hits(
+                    sub, self._terms_key_views(field, ft, seg_views, key))
             buckets.append([key, int(count), subs])
         return {"t": "terms", "tn": ft.type_name, "dk": ft.dv_kind,
                 "buckets": buckets, "others": int(others),
                 "min_inc": int(min_inc)}
+
+    def _terms_key_views(self, field, ft, seg_views, key):
+        """seg_views narrowed to docs holding ``key`` in ``field``."""
+        out = []
+        for seg, dseg, matched in seg_views:
+            m = np.asarray(matched)[: seg.n_docs]
+            mask = np.zeros(seg.n_docs, bool)
+            if ft.dv_kind == "ordinal":
+                dv = seg.ordinal_dv.get(field)
+                if dv is not None and len(dv.value_docs):
+                    o = dv.term_to_ord.get(key, -1)
+                    if o >= 0:
+                        mask[dv.value_docs[dv.ords == o]] = True
+            else:
+                dv = seg.numeric_dv.get(field)
+                if dv is not None and len(dv.value_docs):
+                    mask[dv.value_docs[dv.values == key]] = True
+            out.append((seg, dseg, m & mask))
+        return out
 
     def _terms_ordinal(self, field, seg_views, subs):
         merged: dict = {}
@@ -628,6 +898,246 @@ class AggregationExecutor:
                                       max(pmx, per_doc_max[d]))
         return merged, sub_parts
 
+    # -- significant / rare / multi terms ---------------------------------
+
+    def _field_term_counts(self, field, ft, seg, matched_np) -> dict:
+        """term -> doc_count over one segment's matched mask (each doc
+        counts once per distinct value)."""
+        out: dict = {}
+        if ft.dv_kind == "ordinal":
+            dv = seg.ordinal_dv.get(field)
+            if dv is None or not len(dv.value_docs):
+                return out
+            ok = matched_np[dv.value_docs]
+            ords, counts = np.unique(dv.ords[ok], return_counts=True)
+            for o, c in zip(ords, counts):
+                if o >= 0:
+                    out[dv.ord_terms[o]] = int(c)
+        else:
+            dv = seg.numeric_dv.get(field)
+            if dv is None or not len(dv.value_docs):
+                return out
+            ok = matched_np[dv.value_docs]
+            pair_dtype = np.int64 if dv.kind == "long" else np.float64
+            pairs = np.unique(np.stack(
+                [dv.values[ok].astype(pair_dtype),
+                 dv.value_docs[ok].astype(pair_dtype)]), axis=1)
+            vals, counts = np.unique(pairs[0], return_counts=True)
+            for v, c in zip(vals, counts):
+                key = float(v) if dv.kind == "double" else int(v)
+                out[key] = int(c)
+        return out
+
+    def _part_significant_terms(self, req, seg_views) -> dict:
+        """Foreground (matched) vs background (whole live segment) term
+        counts; the JLH scoring happens at reduce over the merged totals.
+        Ref bucket/terms/SignificantTermsAggregatorFactory.java +
+        heuristic/JLHScore.java."""
+        field, ft = self._field_type(req, "significant_terms")
+        if ft is None:
+            return {"t": "sig", "tn": None, "dk": None, "fg_total": 0,
+                    "bg_total": 0, "buckets": []}
+        fg: dict = {}
+        bg: dict = {}
+        fg_total = bg_total = 0
+        for seg, dseg, matched in seg_views:
+            m = np.asarray(matched)[: seg.n_docs]
+            live = np.asarray(self.ctx.live_jnp(seg, dseg))[: seg.n_docs]
+            fg_total += int(m.sum())
+            bg_total += int(live.sum())
+            for t, c in self._field_term_counts(field, ft, seg, m).items():
+                fg[t] = fg.get(t, 0) + c
+            for t, c in self._field_term_counts(field, ft, seg,
+                                                live).items():
+                bg[t] = bg.get(t, 0) + c
+        shard_size = int(req.params.get("shard_size")
+                         or max(int(req.params.get("size", 10)) * 2, 100))
+        rows = [[t, c, bg.get(t, c)] for t, c in fg.items()]
+        rows.sort(key=lambda r: -_jlh(r[1], fg_total, r[2], bg_total))
+        return {"t": "sig", "tn": ft.type_name, "dk": ft.dv_kind,
+                "fg_total": fg_total, "bg_total": bg_total,
+                "buckets": rows[:shard_size]}
+
+    def _part_rare_terms(self, req, seg_views) -> dict:
+        """Counts for terms at-or-below max_doc_count, plus the names of
+        terms already over it ('over'): a term rare on every shard can
+        still sum over the threshold, and a term omitted by one shard is
+        ambiguous without the over-list (the reference uses a CuckooFilter
+        for the same exclusion — bucket/terms/RareTermsAggregator).."""
+        field, ft = self._field_type(req, "rare_terms")
+        max_dc = int(req.params.get("max_doc_count", 1))
+        if max_dc < 1 or max_dc > 100:
+            raise IllegalArgumentError(
+                "[max_doc_count] must be in [1, 100]")
+        if ft is None:
+            return {"t": "rare", "tn": None, "dk": None, "buckets": [],
+                    "over": []}
+        counts: dict = {}
+        for seg, dseg, matched in seg_views:
+            m = np.asarray(matched)[: seg.n_docs]
+            for t, c in self._field_term_counts(field, ft, seg, m).items():
+                counts[t] = counts.get(t, 0) + c
+        rare = [[t, c] for t, c in counts.items() if c <= max_dc]
+        over = [t for t, c in counts.items() if c > max_dc]
+        return {"t": "rare", "tn": ft.type_name, "dk": ft.dv_kind,
+                "buckets": rare, "over": over}
+
+    def _part_multi_terms(self, req, seg_views) -> dict:
+        """Buckets per combination of values across N fields (cartesian
+        per doc, the reference's MultiTermsAggregator).  Metric sub-aggs
+        accumulate per combination in the same pass."""
+        specs = req.params.get("terms")
+        if not isinstance(specs, list) or len(specs) < 2:
+            raise ParsingError(
+                "[multi_terms] requires at least two [terms] sources")
+        fields = []
+        for spec in specs:
+            f = spec.get("field")
+            if not f:
+                raise ParsingError("[multi_terms] source requires [field]")
+            fields.append((f, self.ctx.field_type(f)))
+        msubs = _metric_subs(req)
+        merged: dict = {}
+        sub_parts: dict = {}
+        for seg, dseg, matched in seg_views:
+            m = np.asarray(matched)[: seg.n_docs]
+            per_field = [self._doc_values_lists(f, ft, seg, m)
+                         for f, ft in fields]
+            docs = set(per_field[0])
+            for vals in per_field[1:]:
+                docs &= set(vals)
+            sub_cols = [self._doc_metric_tuples(sub, seg, m)
+                        for sub in msubs]
+            import itertools
+
+            for d in docs:
+                combos = list(itertools.product(
+                    *[vals[d] for vals in per_field]))
+                for key in combos:
+                    merged[key] = merged.get(key, 0) + 1
+                for si, sub in enumerate(msubs):
+                    tup = sub_cols[si].get(d)
+                    if tup is None:
+                        continue
+                    for key in combos:
+                        prev = sub_parts.get((sub.name, key),
+                                             (0.0, 0, np.inf, -np.inf))
+                        sub_parts[(sub.name, key)] = (
+                            prev[0] + tup[0], prev[1] + tup[1],
+                            min(prev[2], tup[2]), max(prev[3], tup[3]))
+        size = int(req.params.get("size", 10))
+        shard_size = int(req.params.get("shard_size")
+                         or max(size, int(size * 1.5 + 10)))
+        order = req.params.get("order", {"_count": "desc"})
+        items = sorted(merged.items(), key=_terms_order_key(order))
+        kept, tail = items[:shard_size], items[shard_size:]
+        min_inc = (kept[-1][1] if tail and kept and _is_count_desc(order)
+                   else 0)
+        buckets = []
+        for key, count in kept:
+            subs = {sub.name: _ser_tuple(sub_parts.get(
+                (sub.name, key), (0.0, 0, np.inf, -np.inf)))
+                for sub in msubs}
+            buckets.append([list(key), int(count), subs])
+        return {"t": "mterms", "buckets": buckets,
+                "others": sum(c for _k, c in tail), "min_inc": int(min_inc)}
+
+    def _doc_values_lists(self, field, ft, seg, matched_np) -> dict:
+        """doc -> list of values for one field (matched docs only)."""
+        out: dict = {}
+        if ft is not None and ft.dv_kind == "ordinal":
+            dv = seg.ordinal_dv.get(field)
+            if dv is None:
+                return out
+            ok = matched_np[dv.value_docs] & (dv.ords >= 0)
+            for d, o in zip(dv.value_docs[ok], dv.ords[ok]):
+                out.setdefault(int(d), []).append(dv.ord_terms[o])
+        else:
+            dv = seg.numeric_dv.get(field)
+            if dv is None:
+                return out
+            ok = matched_np[dv.value_docs]
+            for d, v in zip(dv.value_docs[ok], dv.values[ok]):
+                out.setdefault(int(d), []).append(
+                    float(v) if dv.kind == "double" else int(v))
+        return out
+
+    def _doc_metric_tuples(self, sub, seg, matched_np) -> dict:
+        """doc -> (sum, count, min, max) for one metric sub-agg field."""
+        sf, _sft = self._field_type(sub, sub.type)
+        dv = seg.numeric_dv.get(sf)
+        out: dict = {}
+        if dv is None:
+            return out
+        ok = matched_np[dv.value_docs]
+        for d, v in zip(dv.value_docs[ok], dv.values[ok].astype(np.float64)):
+            prev = out.get(int(d), (0.0, 0, np.inf, -np.inf))
+            out[int(d)] = (prev[0] + v, prev[1] + 1, min(prev[2], v),
+                           max(prev[3], v))
+        return out
+
+    # -- composite --------------------------------------------------------
+
+    def _part_composite(self, req, seg_views) -> dict:
+        """Paginated multi-source buckets: each shard emits its first
+        ``size`` keys after ``after`` in composite order, so the merged
+        union always contains the global first ``size`` (ref
+        bucket/composite/CompositeAggregator.java).  Sources: terms,
+        histogram, date_histogram."""
+        sources = _composite_sources(req)
+        size = int(req.params.get("size", 10))
+        after = req.params.get("after")
+        after_key = (tuple(after[name] for name, _f, _x, _o, _k in sources)
+                     if after is not None else None)
+        msubs = _metric_subs(req)
+        merged: dict = {}
+        sub_parts: dict = {}
+        for seg, dseg, matched in seg_views:
+            m = np.asarray(matched)[: seg.n_docs]
+            per_source = []
+            for name, field, xform, _order, _kind in sources:
+                ft = self.ctx.field_type(field)
+                vals = self._doc_values_lists(field, ft, seg, m)
+                if xform is not None:
+                    vals = {d: sorted({xform(v) for v in vs})
+                            for d, vs in vals.items()}
+                per_source.append(vals)
+            docs = set(per_source[0])
+            for vals in per_source[1:]:
+                docs &= set(vals)
+            sub_cols = [self._doc_metric_tuples(sub, seg, m)
+                        for sub in msubs]
+            import itertools
+
+            for d in docs:
+                combos = set(itertools.product(
+                    *[vals[d] for vals in per_source]))
+                for key in combos:
+                    merged[key] = merged.get(key, 0) + 1
+                for si, sub in enumerate(msubs):
+                    tup = sub_cols[si].get(d)
+                    if tup is None:
+                        continue
+                    for key in combos:
+                        prev = sub_parts.get((sub.name, key),
+                                             (0.0, 0, np.inf, -np.inf))
+                        sub_parts[(sub.name, key)] = (
+                            prev[0] + tup[0], prev[1] + tup[1],
+                            min(prev[2], tup[2]), max(prev[3], tup[3]))
+        cmp_key = _composite_sort_key(sources)
+        items = sorted(merged.items(), key=lambda kv: cmp_key(kv[0]))
+        if after_key is not None:
+            ak = cmp_key(after_key)
+            items = [kv for kv in items if cmp_key(kv[0]) > ak]
+        items = items[:size]
+        buckets = []
+        for key, count in items:
+            subs = {sub.name: _ser_tuple(sub_parts.get(
+                (sub.name, key), (0.0, 0, np.inf, -np.inf)))
+                for sub in msubs}
+            buckets.append([list(key), int(count), subs])
+        return {"t": "composite", "buckets": buckets}
+
     # -- histograms -------------------------------------------------------
 
     def _part_histogram(self, req, seg_views) -> dict:
@@ -674,6 +1184,10 @@ class AggregationExecutor:
         """Shared histogram inner loop: per-bucket counts + metric
         sub-partials over aligned edges; emits only non-empty buckets
         (the reduce regenerates the full grid for gap filling)."""
+        if _top_hits_subs(req):
+            raise IllegalArgumentError(
+                f"[{req.type}] does not support [top_hits] "
+                "sub-aggregations (nest top_hits under terms or a filter)")
         n_buckets = len(keys)
         n_pad_b = pad_pow2(n_buckets + 1)
         totals = np.zeros(n_buckets, np.int64)
@@ -917,6 +1431,94 @@ def _red_percentiles(req, parts):
                        for p in percents}}
 
 
+def _red_extended_stats(req, parts):
+    s, c, mn, mx = _merge_tuples([p["v"][:4] for p in parts])
+    sq = sum(float(p["v"][4]) for p in parts)
+    sigma = float(req.params.get("sigma", 2.0))
+    if not c:
+        return {"count": 0, "min": None, "max": None, "avg": None,
+                "sum": 0.0, "sum_of_squares": None, "variance": None,
+                "std_deviation": None,
+                "std_deviation_bounds": {"upper": None, "lower": None}}
+    avg = s / c
+    var = sq / c - avg * avg
+    std = float(np.sqrt(max(var, 0.0)))
+    var_samp = (sq - c * avg * avg) / (c - 1) if c > 1 else None
+    return {"count": int(c), "min": mn, "max": mx, "avg": avg, "sum": s,
+            "sum_of_squares": sq, "variance": var,
+            "variance_population": var, "variance_sampling": var_samp,
+            "std_deviation": std, "std_deviation_population": std,
+            "std_deviation_sampling": (float(np.sqrt(max(var_samp, 0.0)))
+                                       if var_samp is not None else None),
+            "std_deviation_bounds": {"upper": avg + sigma * std,
+                                     "lower": avg - sigma * std}}
+
+
+def _red_weighted_avg(req, parts):
+    vw = sum(p["v"][0] for p in parts)
+    w = sum(p["v"][1] for p in parts)
+    return {"value": (vw / w) if w else None}
+
+
+def _pct_values_weights(parts):
+    vs, ws = [], []
+    for p in parts:
+        if p["kind"] == "raw":
+            if p["v"]:
+                vs.append(np.asarray(p["v"], np.float64))
+                ws.append(np.ones(len(p["v"])))
+        else:
+            vs.append(np.asarray(p["m"], np.float64))
+            ws.append(np.asarray(p["w"], np.float64))
+    if not vs:
+        return None, None
+    return np.concatenate(vs), np.concatenate(ws)
+
+
+def _red_percentile_ranks(req, parts):
+    values = req.params.get("values") or []
+    v, w = _pct_values_weights(parts)
+    out = {}
+    for x in values:
+        if v is None:
+            out[f"{float(x)}"] = None
+        else:
+            out[f"{float(x)}"] = float(
+                100.0 * w[v <= float(x)].sum() / w.sum())
+    return {"values": out}
+
+
+def _red_mad(req, parts):
+    v, w = _pct_values_weights(parts)
+    if v is None:
+        return {"value": None}
+    med = _weighted_percentile(v, w, 50.0)
+    return {"value": _weighted_percentile(np.abs(v - med), w, 50.0)}
+
+
+def _red_top_hits(req, parts):
+    size = int(req.params.get("size", 3))
+    from_ = int(req.params.get("from", 0))
+    sort_field, sort_desc = _top_hits_sort(req.params.get("sort"))
+    hits = [h for p in parts for h in p["hits"]]
+    if sort_field is None:
+        hits.sort(key=lambda h: (h.get("_score") if h.get("_score")
+                                 is not None else -np.inf), reverse=True)
+    else:
+        last = -np.inf if sort_desc else np.inf
+        hits.sort(key=lambda h: (h["sort"][0] if h.get("sort")
+                                 and h["sort"][0] is not None else last),
+                  reverse=sort_desc)
+    total = sum(p["total"] for p in parts)
+    page = hits[from_: from_ + size]
+    max_score = None
+    scores = [h["_score"] for h in hits if h.get("_score") is not None]
+    if scores:
+        max_score = max(scores)
+    return {"hits": {"total": {"value": int(total), "relation": "eq"},
+                     "max_score": max_score, "hits": page}}
+
+
 def _is_count_desc(order) -> bool:
     if isinstance(order, list):
         order = order[0] if order else {"_count": "desc"}
@@ -982,6 +1584,9 @@ def _red_terms(req, parts):
             seen.add(key)
             merged[key] = merged.get(key, 0) + count
             for sname, tup in subs.items():
+                if isinstance(tup, dict):      # top_hits partial
+                    sub_parts.setdefault((sname, key), []).append(tup)
+                    continue
                 prev = sub_parts.get((sname, key))
                 sub_parts[(sname, key)] = (
                     _ser_tuple(_merge_tuples([prev, tup]))
@@ -990,6 +1595,10 @@ def _red_terms(req, parts):
     if tn is None:
         return {"doc_count_error_upper_bound": 0, "sum_other_doc_count": 0,
                 "buckets": []}
+    inc, exc = req.params.get("include"), req.params.get("exclude")
+    if inc is not None or exc is not None:
+        sel = _terms_include_filter(inc, exc, tn)
+        merged = {k: c for k, c in merged.items() if sel(k)}
     items = [(k, c) for k, c in merged.items() if c >= min_doc_count]
     items.sort(key=_terms_order_key(order))
     total_in_buckets = sum(c for _k, c in items)
@@ -1012,12 +1621,89 @@ def _red_terms(req, parts):
             b[sub.name] = _finish_metric(
                 sub.type, _merge_tuples([tup]) if tup is not None
                 else (0.0, 0, np.inf, -np.inf))
+        for sub in _top_hits_subs(req):
+            b[sub.name] = _red_top_hits(
+                sub, sub_parts.get((sub.name, key), []))
         buckets.append(b)
     sum_other = (total_in_buckets - sum(b["doc_count"] for b in buckets)
                  + sum(p["others"] for p in parts))
     return {"doc_count_error_upper_bound": int(error),
             "sum_other_doc_count": int(sum_other),
             "buckets": buckets}
+
+
+def _mix64(v: int) -> int:
+    """BitMixer.mix64 (Stafford variant 9, libs/common BitMixer.java:120)
+    — signed, for floorMod parity with the reference's partitioning."""
+    m = (1 << 64) - 1
+    z = v & m
+    z = ((z ^ (z >> 32)) * 0x4CD6944C5CC20B6D) & m
+    z = ((z ^ (z >> 29)) * 0xFC12C5B19D3259E9) & m
+    z ^= z >> 32
+    return z - (1 << 64) if z >= (1 << 63) else z
+
+
+def _terms_include_filter(inc, exc, tn):
+    """terms include/exclude: exact-value arrays, a regex string, or the
+    partition form {partition, num_partitions} — hash-compatible with
+    the reference (IncludeExclude.java:239 murmur3_x86_32 seed 31 +
+    floorMod for strings; Long.hashCode for numerics)."""
+    if isinstance(inc, dict):
+        part = int(inc.get("partition", -1))
+        num = int(inc.get("num_partitions", 0))
+        if part < 0 or num <= 0 or part >= num:
+            raise IllegalArgumentError(
+                "Missing or invalid [partition]/[num_partitions] for "
+                "partition-based include")
+        if exc is not None:
+            raise IllegalArgumentError(
+                "Cannot specify any excludes when using a "
+                "partition-based include")
+        from opensearch_tpu.indices.service import murmur3_32
+
+        def sel(key):
+            if isinstance(key, str):
+                h = murmur3_32(key.encode("utf-8"), 31)
+                if h >= 2**31:
+                    h -= 2**32
+            else:
+                h = _mix64(int(key))       # BitMixer.mix64 (long keys)
+            return h % num == part
+        return sel
+    def norm(vals):
+        out = set()
+        for v in vals:
+            out.add(v)
+            out.add(str(v))
+            if tn == "date":
+                try:
+                    out.add(parse_date_millis(v))
+                except (ValueError, IllegalArgumentError, TypeError):
+                    pass
+        return out
+
+    def key_forms(key):
+        forms = {key, str(key)}
+        kas = _term_key_as_string(key, tn)
+        if kas is not None:
+            forms.add(kas)
+        return forms
+
+    def matches(spec, key):
+        if spec is None:
+            return None
+        if isinstance(spec, str):            # regex form
+            return any(re.fullmatch(spec, str(f)) for f in key_forms(key))
+        vals = norm(spec if isinstance(spec, list) else [spec])
+        return bool(key_forms(key) & vals)
+
+    def sel(key):
+        if inc is not None and not matches(inc, key):
+            return False
+        if exc is not None and matches(exc, key):
+            return False
+        return True
+    return sel
 
 
 def _dh_offset(req) -> int:
@@ -1095,6 +1781,228 @@ def _red_histogram(req, parts, is_date=False):
     return {"buckets": buckets}
 
 
+def _composite_sources(req):
+    """[(name, field, value_transform, order, kind)] for a composite
+    request's sources."""
+    import math as _math
+
+    sources = req.params.get("sources")
+    if not isinstance(sources, list) or not sources:
+        raise ParsingError("[composite] requires a [sources] array")
+    out = []
+    for s in sources:
+        if not isinstance(s, dict) or len(s) != 1:
+            raise ParsingError("[composite] source must have one name")
+        ((name, body),) = s.items()
+        if not isinstance(body, dict) or len(body) != 1:
+            raise ParsingError(
+                f"[composite] source [{name}] must have one type")
+        ((styp, cfg),) = body.items()
+        field = cfg.get("field")
+        if not field:
+            raise ParsingError(f"[composite] source [{name}] requires "
+                               "[field]")
+        order = cfg.get("order", "asc")
+        if styp == "terms":
+            xform, kind = None, "terms"
+        elif styp == "histogram":
+            interval = float(cfg.get("interval", 0))
+            if interval <= 0:
+                raise ParsingError("[interval] must be > 0")
+            xform = lambda v, i=interval: _math.floor(float(v) / i) * i  # noqa: E731
+            kind = "histogram"
+        elif styp == "date_histogram":
+            calendar = cfg.get("calendar_interval")
+            if calendar in ("month", "1M"):
+                def xform(v):
+                    dt = _dt.datetime.fromtimestamp(
+                        int(v) / 1000, tz=_dt.timezone.utc)
+                    return int(_floor_month(dt, 1).timestamp() * 1000)
+            elif calendar in ("year", "1y"):
+                def xform(v):
+                    dt = _dt.datetime.fromtimestamp(
+                        int(v) / 1000, tz=_dt.timezone.utc)
+                    return int(_dt.datetime(
+                        dt.year, 1, 1,
+                        tzinfo=_dt.timezone.utc).timestamp() * 1000)
+            else:
+                fixed = cfg.get("fixed_interval") or cfg.get("interval")
+                if fixed is None:
+                    raise ParsingError(
+                        f"[composite] source [{name}] requires an interval")
+                ms = (_CAL_FIXED_MS.get(calendar)
+                      or _parse_duration_ms(fixed))
+                xform = lambda v, m=ms: (int(v) // m) * m  # noqa: E731
+            kind = "date"
+        else:
+            raise ParsingError(
+                f"[composite] source type [{styp}] is not supported")
+        out.append((name, field, xform, order, kind))
+    return out
+
+
+def _composite_sort_key(sources):
+    """Comparable wrapper honoring each source's asc/desc order."""
+    import functools
+
+    orders = [o for _n, _f, _x, o, _k in sources]
+
+    def cmp(a, b):
+        for av, bv, o in zip(a, b, orders):
+            if av == bv:
+                continue
+            lt = av < bv
+            if str(o).lower() == "desc":
+                lt = not lt
+            return -1 if lt else 1
+        return 0
+
+    return functools.cmp_to_key(cmp)
+
+
+def _red_composite(req, parts):
+    sources = _composite_sources(req)
+    size = int(req.params.get("size", 10))
+    merged: dict = {}
+    sub_parts: dict = {}
+    for p in parts:
+        for key, count, subs in p["buckets"]:
+            key = tuple(int(v) if kind == "date"
+                        else (float(v) if kind == "histogram" else v)
+                        for v, (_n, _f, _x, _o, kind) in zip(key, sources))
+            merged[key] = merged.get(key, 0) + count
+            for sname, tup in subs.items():
+                prev = sub_parts.get((sname, key))
+                sub_parts[(sname, key)] = (
+                    _ser_tuple(_merge_tuples([prev, tup]))
+                    if prev is not None else tup)
+    K = _composite_sort_key(sources)
+    items = sorted(merged.items(), key=lambda kv: K(kv[0]))[:size]
+    buckets = []
+    for key, count in items:
+        b = {"key": {name: v for v, (name, *_rest) in zip(key, sources)},
+             "doc_count": int(count)}
+        for sub in _metric_subs(req):
+            tup = sub_parts.get((sub.name, key))
+            b[sub.name] = _finish_metric(
+                sub.type, _merge_tuples([tup]) if tup is not None
+                else (0.0, 0, np.inf, -np.inf))
+        buckets.append(b)
+    out = {"buckets": buckets}
+    if buckets:
+        out["after_key"] = buckets[-1]["key"]
+    return out
+
+
+def _jlh(fg: int, fg_total: int, bg: int, bg_total: int) -> float:
+    """JLH significance: (fg% - bg%) * (fg% / bg%) — the reference's
+    default heuristic (bucket/terms/heuristic/JLHScore.java:103)."""
+    if not fg_total or not bg_total or not bg:
+        return 0.0
+    fg_rate = fg / fg_total
+    bg_rate = bg / bg_total
+    if fg_rate <= bg_rate:
+        return 0.0
+    return (fg_rate - bg_rate) * (fg_rate / bg_rate)
+
+
+def _red_significant_terms(req, parts):
+    size = int(req.params.get("size", 10))
+    min_doc_count = int(req.params.get("min_doc_count", 3))
+    tn = dk = None
+    fg_total = bg_total = 0
+    fg: dict = {}
+    bg: dict = {}
+    for p in parts:
+        if p.get("tn") is not None:
+            tn, dk = p["tn"], p["dk"]
+        fg_total += p["fg_total"]
+        bg_total += p["bg_total"]
+        for key, f, b in p["buckets"]:
+            if isinstance(key, float) and dk == "long":
+                key = int(key)
+            fg[key] = fg.get(key, 0) + f
+            bg[key] = bg.get(key, 0) + b
+    scored = []
+    for key, f in fg.items():
+        if f < min_doc_count:
+            continue
+        score = _jlh(f, fg_total, bg[key], bg_total)
+        if score > 0:
+            scored.append((score, key, f, bg[key]))
+    scored.sort(key=lambda r: (-r[0], r[1]))
+    buckets = [{"key": _term_key(key, tn, dk), "doc_count": int(f),
+                "score": score, "bg_count": int(b)}
+               for score, key, f, b in scored[:size]]
+    return {"doc_count": int(fg_total), "bg_count": int(bg_total),
+            "buckets": buckets}
+
+
+def _red_rare_terms(req, parts):
+    max_dc = int(req.params.get("max_doc_count", 1))
+    tn = dk = None
+    counts: dict = {}
+    over: set = set()
+    for p in parts:
+        if p.get("tn") is not None:
+            tn, dk = p["tn"], p["dk"]
+        over.update(_freeze(t) for t in p.get("over", []))
+        for key, c in p["buckets"]:
+            if isinstance(key, float) and dk == "long":
+                key = int(key)
+            counts[key] = counts.get(key, 0) + c
+    items = [(k, c) for k, c in counts.items()
+             if c <= max_dc and k not in over]
+    items.sort(key=lambda kv: kv[0])
+    return {"buckets": [{"key": _term_key(k, tn, dk), "doc_count": int(c)}
+                        for k, c in items]}
+
+
+def _red_multi_terms(req, parts):
+    size = int(req.params.get("size", 10))
+    min_doc_count = int(req.params.get("min_doc_count", 1))
+    order = req.params.get("order", {"_count": "desc"})
+    merged: dict = {}
+    sub_parts: dict = {}
+    keys_of: list[set] = []
+    for p in parts:
+        seen = set()
+        for key, count, subs in p["buckets"]:
+            key = tuple(key)
+            seen.add(key)
+            merged[key] = merged.get(key, 0) + count
+            for sname, tup in subs.items():
+                prev = sub_parts.get((sname, key))
+                sub_parts[(sname, key)] = (
+                    _ser_tuple(_merge_tuples([prev, tup]))
+                    if prev is not None else tup)
+        keys_of.append(seen)
+    items = [(k, c) for k, c in merged.items() if c >= min_doc_count]
+    items.sort(key=_terms_order_key(order))
+    total_in_buckets = sum(c for _k, c in items)
+    items = items[:size]
+    buckets = []
+    error = 0
+    for key, count in items:
+        err = sum(p["min_inc"] for p, seen in zip(parts, keys_of)
+                  if key not in seen)
+        error = max(error, err)
+        b = {"key": list(key),
+             "key_as_string": "|".join(str(k) for k in key),
+             "doc_count": int(count)}
+        for sub in _metric_subs(req):
+            tup = sub_parts.get((sub.name, key))
+            b[sub.name] = _finish_metric(
+                sub.type, _merge_tuples([tup]) if tup is not None
+                else (0.0, 0, np.inf, -np.inf))
+        buckets.append(b)
+    sum_other = (total_in_buckets - sum(b["doc_count"] for b in buckets)
+                 + sum(p["others"] for p in parts))
+    return {"doc_count_error_upper_bound": int(error),
+            "sum_other_doc_count": int(sum_other),
+            "buckets": buckets}
+
+
 def _red_single(req, parts):
     out = {"doc_count": sum(p["doc_count"] for p in parts)}
     for sub in req.subs:
@@ -1139,7 +2047,16 @@ def _red_ranges(req, parts):
 _REDUCERS = {
     "cardinality": _red_cardinality,
     "percentiles": _red_percentiles,
+    "percentile_ranks": _red_percentile_ranks,
+    "median_absolute_deviation": _red_mad,
+    "extended_stats": _red_extended_stats,
+    "weighted_avg": _red_weighted_avg,
+    "top_hits": _red_top_hits,
     "terms": _red_terms,
+    "significant_terms": _red_significant_terms,
+    "rare_terms": _red_rare_terms,
+    "multi_terms": _red_multi_terms,
+    "composite": _red_composite,
     "histogram": lambda req, parts: _red_histogram(req, parts, is_date=False),
     "date_histogram": lambda req, parts: _red_histogram(req, parts,
                                                         is_date=True),

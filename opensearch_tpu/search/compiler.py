@@ -175,6 +175,11 @@ def _c_match_none(q, ctx, scored):
 
 
 def _c_term(q, ctx, scored):
+    if q.field == "_id":
+        # term/terms on the _id metafield = an ids query
+        # (IdFieldMapper.termQuery)
+        return _c_ids(dsl.IdsQuery(values=[str(q.value)], boost=q.boost),
+                      ctx, scored)
     ft = _require_ft(ctx, q.field, "term")
     if ft is None:
         return _none()
@@ -192,6 +197,9 @@ def _c_term(q, ctx, scored):
 
 
 def _c_terms(q, ctx, scored):
+    if q.field == "_id":
+        return _c_ids(dsl.IdsQuery(values=[str(v) for v in q.values],
+                                   boost=q.boost), ctx, scored)
     ft = _require_ft(ctx, q.field, "terms")
     if ft is None or not q.values:
         return _none()
@@ -232,8 +240,13 @@ def _c_match(q, ctx, scored):
     if ft is None:
         return _none()
     if not isinstance(ft, TextFieldType):
-        return _c_term(dsl.TermQuery(field=q.field, value=q.query,
-                                     boost=q.boost), ctx, scored)
+        try:
+            return _c_term(dsl.TermQuery(field=q.field, value=q.query,
+                                         boost=q.boost), ctx, scored)
+        except (OpenSearchTpuError, ValueError):
+            if q.lenient:
+                return _none()
+            raise
     terms = ft.search_terms(q.query, ctx.mapper.analyzers)
     if not terms:
         return _none()
@@ -398,15 +411,211 @@ def _c_range(q, ctx, scored):
 
 
 def _c_exists(q, ctx, scored):
+    if q.field in ("_id", "_index", "_seq_no", "_version"):
+        # always-present metafields: every live doc matches
+        # (exists rewrites to match_all for fields with norms/dv on all
+        # docs — MetadataFieldMapper existence semantics)
+        return _c_match_all(dsl.MatchAllQuery(boost=q.boost), ctx, scored)
     ft = ctx.field_type(q.field)
-    if ft is None:
-        return _none()
+    if ft is None or ft.type_name == "object":
+        # object container (explicit or implicit): exists = any child
+        # field exists (ObjectMapper existence expansion)
+        children = [f for f in getattr(ctx.mapper, "_fields", {})
+                    if f.startswith(q.field + ".")]
+        if not children:
+            return _none()
+        return _c_bool(dsl.BoolQuery(
+            should=[dsl.ExistsQuery(field=f) for f in children],
+            boost=q.boost), ctx, scored)
     src = {"long": "numeric", "double": "numeric", "ordinal": "ordinal",
            "vector": "vector", "geo_point": "geo", "none": "norms"}[ft.dv_kind]
     if src != "norms" and not ft.doc_values_enabled:
-        raise IllegalArgumentError(
-            f"exists on field [{q.field}] requires doc_values")
+        if ft.indexed and ft.index_enabled:
+            # doc_values disabled but indexed: existence via the
+            # postings presence column (the reference's _field_names
+            # fallback)
+            src = "norms"
+        else:
+            raise IllegalArgumentError(
+                f"exists on field [{q.field}] requires doc_values or an "
+                "indexed field")
     return P.ExistsPlan(field=q.field, src=src), {"boost": q.boost}
+
+
+# -- parent-join (modules/parent-join) --------------------------------------
+
+
+def _find_join_field(ctx):
+    for f, ft in ctx.mapper.field_types().items():
+        if ft.type_name == "join":
+            return f, ft
+    return None, None
+
+
+def _host_run_scored(ctx, q):
+    """Run an inner query over every segment host-side; [(seg, scores
+    np[n_pad], matched np[n_pad])].  The pre-pass the join queries (and
+    knn before them) inject via ScoredMaskPlan."""
+    from opensearch_tpu.search.executor import build_arrays
+    from opensearch_tpu.search.plan import run_full
+
+    import jax.numpy as jnp
+
+    plan, bind = compile_query(q, ctx, scored=True)
+    needed = plan.arrays()
+    neg_inf = jnp.asarray(np.float32(-np.inf))
+    out = []
+    for seg in ctx.segments:
+        dseg = seg.device()
+        A = build_arrays(dseg, needed, ctx.mapper,
+                         live=ctx.live_jnp(seg, dseg))
+        dims, ins = plan.prepare(bind, seg, dseg, ctx)
+        scores, matched = run_full(plan, dims, A, ins, neg_inf)
+        out.append((seg, np.asarray(scores), np.asarray(matched)))
+    return out
+
+
+def _ord_per_doc(seg, field) -> dict:
+    """doc -> term for a single-valued hidden ordinal column, cached on
+    the segment (segments are immutable)."""
+    cache = getattr(seg, "_join_col_cache", None)
+    if cache is None:
+        cache = seg._join_col_cache = {}
+    out = cache.get(field)
+    if out is None:
+        dv = seg.ordinal_dv.get(field)
+        out = {} if dv is None else {
+            int(d): dv.ord_terms[o]
+            for d, o in zip(dv.value_docs, dv.ords) if o >= 0}
+        cache[field] = out
+    return out
+
+
+def _join_mask_plan(ctx, fn, label):
+    return P.ScoredMaskPlan(label=label), {"fn": fn}
+
+
+def _c_has_child(q, ctx, scored):
+    field, jft = _find_join_field(ctx)
+    if field is None:
+        return _none()
+    parent_rel = jft.parent_of(q.type)
+    if parent_rel is None:
+        raise IllegalArgumentError(
+            f"[has_child] join field [{field}] has no child relation "
+            f"[{q.type}]")
+    state: dict = {}
+
+    def compute():
+        agg: dict = {}      # parent _id -> [count, total, mx, mn]
+        for seg, scores, matched in _host_run_scored(ctx, q.query):
+            names = _ord_per_doc(seg, field + "#name")
+            parents = _ord_per_doc(seg, field + "#parent")
+            for local in np.nonzero(matched[: seg.n_docs])[0]:
+                local = int(local)
+                if names.get(local) != q.type:
+                    continue
+                pid = parents.get(local)
+                if pid is None:
+                    continue
+                s = float(scores[local])
+                cur = agg.get(pid)
+                if cur is None:
+                    agg[pid] = [1, s, s, s]
+                else:
+                    cur[0] += 1
+                    cur[1] += s
+                    cur[2] = max(cur[2], s)
+                    cur[3] = min(cur[3], s)
+        out = {}
+        for pid, (count, total, mx, mn) in agg.items():
+            if count < q.min_children:
+                continue
+            if q.max_children is not None and count > q.max_children:
+                continue
+            out[pid] = {"none": 1.0, "sum": total, "max": mx, "min": mn,
+                        "avg": total / count}.get(q.score_mode, 1.0)
+        state["scores"] = out
+
+    def fn(seg, dseg):
+        if "scores" not in state:
+            compute()
+        sc = np.zeros(dseg.n_pad, np.float32)
+        mk = np.zeros(dseg.n_pad, bool)
+        names = _ord_per_doc(seg, field + "#name")
+        for pid, s in state["scores"].items():
+            local = seg.id_to_local.get(pid)
+            if local is None or not seg.live[local]:
+                continue
+            if names.get(local) != parent_rel:
+                continue
+            mk[local] = True
+            sc[local] = q.boost * s
+        return sc, mk
+
+    return _join_mask_plan(ctx, fn, "has_child")
+
+
+def _c_has_parent(q, ctx, scored):
+    field, jft = _find_join_field(ctx)
+    if field is None:
+        return _none()
+    if q.parent_type not in jft.relations:
+        raise IllegalArgumentError(
+            f"[has_parent] join field [{field}] has no parent relation "
+            f"[{q.parent_type}]")
+    state: dict = {}
+
+    def compute():
+        out = {}
+        for seg, scores, matched in _host_run_scored(ctx, q.query):
+            names = _ord_per_doc(seg, field + "#name")
+            for local in np.nonzero(matched[: seg.n_docs])[0]:
+                local = int(local)
+                if names.get(local) != q.parent_type:
+                    continue
+                out[seg.doc_ids[local]] = float(scores[local])
+        state["scores"] = out
+
+    def fn(seg, dseg):
+        if "scores" not in state:
+            compute()
+        sc = np.zeros(dseg.n_pad, np.float32)
+        mk = np.zeros(dseg.n_pad, bool)
+        parents = _ord_per_doc(seg, field + "#parent")
+        for local, pid in parents.items():
+            s = state["scores"].get(pid)
+            if s is None or not seg.live[local]:
+                continue
+            mk[local] = True
+            sc[local] = q.boost * (s if q.score else 1.0)
+        return sc, mk
+
+    return _join_mask_plan(ctx, fn, "has_parent")
+
+
+def _c_parent_id(q, ctx, scored):
+    field, jft = _find_join_field(ctx)
+    if field is None:
+        return _none()
+    if jft.parent_of(q.type) is None:
+        raise IllegalArgumentError(
+            f"[parent_id] join field [{field}] has no child relation "
+            f"[{q.type}]")
+
+    def fn(seg, dseg):
+        sc = np.zeros(dseg.n_pad, np.float32)
+        mk = np.zeros(dseg.n_pad, bool)
+        names = _ord_per_doc(seg, field + "#name")
+        parents = _ord_per_doc(seg, field + "#parent")
+        for local, pid in parents.items():
+            if pid == q.id and names.get(local) == q.type \
+                    and seg.live[local]:
+                mk[local] = True
+                sc[local] = q.boost
+        return sc, mk
+
+    return _join_mask_plan(ctx, fn, "parent_id")
 
 
 def _c_ids(q, ctx, scored):
@@ -441,6 +650,7 @@ def _c_wildcard(q, ctx, scored):
         return _none()
     return (P.ExpandTermsPlan(field=q.field, mode="wildcard"),
             {"pattern": str(q.value), "fuzzy_dist": 0, "prefix_length": 0,
+             "nocase": bool(getattr(q, "case_insensitive", False)),
              "boost": q.boost})
 
 
@@ -1272,6 +1482,9 @@ _COMPILERS = {
     dsl.RangeQuery: _c_range,
     dsl.ExistsQuery: _c_exists,
     dsl.IdsQuery: _c_ids,
+    dsl.HasChildQuery: _c_has_child,
+    dsl.HasParentQuery: _c_has_parent,
+    dsl.ParentIdQuery: _c_parent_id,
     dsl.PrefixQuery: _c_prefix,
     dsl.WildcardQuery: _c_wildcard,
     dsl.RegexpQuery: _c_regexp,

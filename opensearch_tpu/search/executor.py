@@ -185,6 +185,9 @@ class ShardSearcher:
         from_ = int(body.get("from", 0))
         q = parse_query(body.get("query"))
         fetch_extras = None
+        # request-size limits (docvalue_fields, rescore window, result
+        # window) are enforced by IndexService._check_search_limits with
+        # the index's own settings; the shard searcher stays policy-free
         if (body.get("highlight") or body.get("explain")
                 or body.get("docvalue_fields") or body.get("fields")):
             fetch_extras = {"highlight": body.get("highlight"),
@@ -262,7 +265,9 @@ class ShardSearcher:
             from opensearch_tpu.search.aggs import AggregationExecutor
             seg_views = [(seg, dseg, matched)
                          for seg, dseg, _s, matched in (views or [])]
-            execu = AggregationExecutor(self.ctx)
+            scores_of = {seg.seg_id: s
+                         for seg, _d, s, _m in (views or [])}
+            execu = AggregationExecutor(self.ctx, scores_of=scores_of)
             if agg_partials:
                 partials = execu.collect(aggs_json, seg_views)
             else:

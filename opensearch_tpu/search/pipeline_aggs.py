@@ -292,18 +292,9 @@ def _eval_moving_fn(script, window_values: np.ndarray):
 
 # -- parent pipelines -----------------------------------------------------
 
-def _simple(name_value):
-    v = name_value
-    out = {"value": v}
-    if v is not None and (isinstance(v, float) and np.isnan(v)):
-        out["value"] = None
-    return out
-
-
-def _apply_parent(req, buckets: list, keyed_dict=None):
-    """Apply one parent pipeline agg to the parent's bucket list in
-    place.  ``keyed_dict`` is the original dict for keyed filters
-    buckets (mutated on bucket_selector/sort)."""
+def _apply_parent(req, buckets: list):
+    """Apply one parent pipeline agg to the parent's bucket list,
+    returning the (possibly filtered/reordered) list."""
     params = req.params
     typ = req.type
     gp = _gap_policy(params)
@@ -421,6 +412,8 @@ def _apply_parent(req, buckets: list, keyed_dict=None):
         from_ = int(params.get("from", 0))
         size = params.get("size")
         if sort:
+            import functools
+
             keys = []
             for spec in sort:
                 if isinstance(spec, str):
@@ -430,20 +423,28 @@ def _apply_parent(req, buckets: list, keyed_dict=None):
                     if isinstance(opts, dict) else "desc"
                 keys.append((path, order == "desc"))
 
-            def sort_key(b):
-                out = []
-                for path, desc in keys:
-                    if path == "_key":
-                        v = b.get("key")
-                    else:
-                        v = bucket_value(b, path, gp)
-                    if v is None:
-                        v = -np.inf if desc else np.inf
-                    out.append(-v if desc and isinstance(v, (int, float))
-                               else v)
-                return tuple(out)
+            def val_of(b, path):
+                return b.get("key") if path == "_key" \
+                    else bucket_value(b, path, gp)
 
-            buckets = sorted(buckets, key=sort_key)
+            def cmp(a, b):
+                # per-key comparison: None always sorts last; desc flips
+                # the comparison, never negates (string keys sort too)
+                for path, desc in keys:
+                    va, vb = val_of(a, path), val_of(b, path)
+                    if va == vb:
+                        continue
+                    if va is None:
+                        return 1
+                    if vb is None:
+                        return -1
+                    lt = va < vb
+                    if desc:
+                        lt = not lt
+                    return -1 if lt else 1
+                return 0
+
+            buckets = sorted(buckets, key=functools.cmp_to_key(cmp))
         end = None if size is None else from_ + int(size)
         return buckets[from_:end]
     raise ParsingError(f"unknown pipeline aggregation [{typ}]")

@@ -482,7 +482,8 @@ class ExpandTermsPlan(Plan):
     def _expand(self, bind, sterms: list[str]) -> list[int]:
         pat = bind["pattern"]
         if self.mode == "wildcard":
-            rx = re.compile(fnmatch.translate(pat))
+            flags = re.IGNORECASE if bind.get("nocase") else 0
+            rx = re.compile(fnmatch.translate(pat), flags)
             return [i for i, t in enumerate(sterms) if rx.match(t)]
         if self.mode == "regexp":
             rx = re.compile(pat)

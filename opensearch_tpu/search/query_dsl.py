@@ -51,6 +51,7 @@ class MatchQuery(Query):
     operator: str = "or"            # or | and
     minimum_should_match: Optional[str] = None
     fuzziness: Optional[str] = None
+    lenient: bool = False           # format mismatch -> no match, not 400
 
 
 @dataclass
@@ -110,6 +111,9 @@ class PrefixQuery(Query):
 class WildcardQuery(Query):
     field: str = ""
     value: str = ""
+    case_insensitive: bool = False  # query_string wildcards normalize
+    # through the analyzer chain (lowercase); the plain wildcard query
+    # is exact unless case_insensitive is set
 
 
 @dataclass
@@ -160,6 +164,35 @@ class NestedQuery(Query):
     query: Optional[Query] = None
     score_mode: str = "avg"
     ignore_unmapped: bool = False
+
+
+@dataclass
+class HasChildQuery(Query):
+    """Parents with >= min matching children (modules/parent-join/
+    HasChildQueryBuilder.java)."""
+
+    type: str = ""
+    query: Optional[Query] = None
+    score_mode: str = "none"        # none | sum | max | min | avg
+    min_children: int = 1
+    max_children: Optional[int] = None
+
+
+@dataclass
+class HasParentQuery(Query):
+    """Children whose parent matches (HasParentQueryBuilder.java)."""
+
+    parent_type: str = ""
+    query: Optional[Query] = None
+    score: bool = False
+
+
+@dataclass
+class ParentIdQuery(Query):
+    """Children of one specific parent (ParentIdQueryBuilder.java)."""
+
+    type: str = ""
+    id: str = ""
 
 
 @dataclass
@@ -528,6 +561,35 @@ def _parse_percolate(body):
                           documents=list(docs), boost=_boost(body))
 
 
+def _parse_has_child(body):
+    if not body.get("type") or body.get("query") is None:
+        raise ParsingError("[has_child] requires [type] and [query]")
+    mx = body.get("max_children")
+    return HasChildQuery(type=str(body["type"]),
+                         query=parse_query(body["query"]),
+                         score_mode=str(body.get("score_mode", "none")),
+                         min_children=int(body.get("min_children", 1)),
+                         max_children=None if mx is None else int(mx),
+                         boost=_boost(body))
+
+
+def _parse_has_parent(body):
+    if not body.get("parent_type") or body.get("query") is None:
+        raise ParsingError("[has_parent] requires [parent_type] and "
+                           "[query]")
+    return HasParentQuery(parent_type=str(body["parent_type"]),
+                          query=parse_query(body["query"]),
+                          score=bool(body.get("score", False)),
+                          boost=_boost(body))
+
+
+def _parse_parent_id(body):
+    if not body.get("type") or body.get("id") is None:
+        raise ParsingError("[parent_id] requires [type] and [id]")
+    return ParentIdQuery(type=str(body["type"]), id=str(body["id"]),
+                         boost=_boost(body))
+
+
 def _parse_nested(body):
     if not body.get("path") or body.get("query") is None:
         raise ParsingError("[nested] requires [path] and [query]")
@@ -819,7 +881,8 @@ class _QsParser:
 
     def _value_clause(self, field, value):
         if "*" in value or "?" in value:
-            return WildcardQuery(field=field, value=value)
+            return WildcardQuery(field=field, value=value,
+                                 case_insensitive=True)
         return MatchQuery(field=field, query=value)
 
     def _text_clause(self, text, phrase):
@@ -847,7 +910,8 @@ def _rewrite_default_field(q, field):
         if q.type == "phrase":
             return MatchPhraseQuery(field=field, query=q.query)
         if "*" in q.query or "?" in q.query:
-            return WildcardQuery(field=field, value=q.query)
+            return WildcardQuery(field=field, value=q.query,
+                                 case_insensitive=True)
         return MatchQuery(field=field, query=q.query)
     return q
 
@@ -863,10 +927,23 @@ def _parse_query_string(body):
     fields = _parse_fields_with_boosts(fields)   # keep ^boost suffixes
     op = str(body.get("default_operator", "or")).lower()
     q = _QsParser(_qs_tokens(str(text)), fields, op).parse()
+    if body.get("lenient"):
+        _mark_lenient(q)
     b = _boost(body)
     if b != 1.0:
         q.boost = q.boost * b
     return q
+
+
+def _mark_lenient(q):
+    """lenient=true: type-mismatch clauses match nothing instead of
+    erroring (QueryStringQueryParser.setLenient)."""
+    if isinstance(q, MatchQuery):
+        q.lenient = True
+    elif isinstance(q, BoolQuery):
+        for group in (q.must, q.should, q.must_not, q.filter):
+            for c in group:
+                _mark_lenient(c)
 
 
 def _parse_hybrid(body):
@@ -946,6 +1023,9 @@ _PARSERS = {
     "range": _parse_range,
     "exists": _parse_exists,
     "ids": _parse_ids,
+    "has_child": _parse_has_child,
+    "has_parent": _parse_has_parent,
+    "parent_id": _parse_parent_id,
     "prefix": _term_like(PrefixQuery, "prefix"),
     "wildcard": _term_like(WildcardQuery, "wildcard"),
     "regexp": _term_like(RegexpQuery, "regexp"),

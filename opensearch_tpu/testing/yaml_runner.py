@@ -1,0 +1,431 @@
+"""YAML REST conformance runner: executes the reference's rest-api-spec
+YAML suites verbatim against a running node.
+
+Analog of ``OpenSearchClientYamlSuiteTestCase`` (ref test/framework/src/
+main/java/org/opensearch/test/rest/yaml/
+OpenSearchClientYamlSuiteTestCase.java:85) with the same execution model:
+each suite file is a set of tests, each test a list of executable
+sections — ``do`` (an API call resolved through the rest-api-spec api
+JSON definitions, ref rest-api-spec/src/main/resources/rest-api-spec/
+api/), assertions (``match``, ``length``, ``is_true``, ``is_false``,
+``gt``/``gte``/``lt``/``lte``), a stash (``set`` / ``$var``
+substitution), and ``catch`` for expected errors.  SURVEY §4.5 calls
+these suites "the machine-checkable compatibility target".
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field as dc_field
+
+import yaml
+
+# skip-features the runner implements; a test declaring anything else is
+# reported as skipped, never silently passed
+SUPPORTED_FEATURES = {"stash_in_key", "stash_in_path", "stash_path_replace",
+                      "contains", "close_to"}
+
+_CATCH_STATUS = {"bad_request": (400, 400), "unauthorized": (401, 401),
+                 "forbidden": (403, 403), "missing": (404, 404),
+                 "request_timeout": (408, 408), "conflict": (409, 409),
+                 "unavailable": (503, 503), "param": (400, 400),
+                 "request": (400, 599)}
+
+
+@dataclass
+class StepResult:
+    test: str
+    ok: bool
+    skipped: bool = False
+    message: str = ""
+
+
+@dataclass
+class ApiSpecs:
+    """Lazy loader over rest-api-spec/api/*.json."""
+
+    api_dir: str
+    _cache: dict = dc_field(default_factory=dict)
+
+    def get(self, name: str) -> dict:
+        spec = self._cache.get(name)
+        if spec is None:
+            import os
+
+            with open(os.path.join(self.api_dir, name + ".json")) as f:
+                spec = json.load(f)[name]
+            self._cache[name] = spec
+        return spec
+
+    def resolve(self, name: str, params: dict):
+        """(method, path, query, body_allowed): picks the path variant
+        with the most satisfied path parts (the official runner's
+        best-match rule), leaving the rest as query params."""
+        spec = self.get(name)
+        best = None
+        for p in spec["url"]["paths"]:
+            parts = set(p.get("parts") or ())
+            if not parts <= set(params):
+                continue
+            if best is None or len(parts) > len(best[0]):
+                best = (parts, p)
+        if best is None:
+            raise ValueError(f"no path of [{name}] matches {sorted(params)}")
+        parts, p = best
+        path = p["path"]
+        for part in parts:
+            v = params[part]
+            if isinstance(v, list):          # multi-index: /a,b/_refresh
+                v = ",".join(str(x) for x in v)
+            path = path.replace("{" + part + "}",
+                                urllib.parse.quote(str(v), safe=","))
+        query = {k: v for k, v in params.items()
+                 if k not in parts and k != "body"}
+        methods = p["methods"]
+        method = methods[0]
+        if "body" in params and params["body"] is not None \
+                and "GET" in methods and "POST" in methods:
+            method = "POST"          # bodies ride POST when both exist
+        return method, path, query
+
+
+class YamlRunner:
+    """Executes one suite file's tests against ``base_url``."""
+
+    def __init__(self, base_url: str, api_specs: ApiSpecs):
+        self.base_url = base_url.rstrip("/")
+        self.specs = api_specs
+
+    # -- http -------------------------------------------------------------
+
+    def _call(self, method, path, query, body, headers=None):
+        url = self.base_url + path
+        if query:
+            url += "?" + urllib.parse.urlencode(
+                {k: (str(v).lower() if isinstance(v, bool) else v)
+                 for k, v in query.items()})
+        data = None
+        hdrs = {"Content-Type": "application/json"}
+        if body is not None:
+            if isinstance(body, list):       # ndjson (bulk / msearch)
+                data = ("\n".join(
+                    x if isinstance(x, str) else json.dumps(x)
+                    for x in body) + "\n").encode()
+                hdrs["Content-Type"] = "application/x-ndjson"
+            elif isinstance(body, str):
+                data = body.encode()
+            else:
+                data = json.dumps(body).encode()
+        hdrs.update(headers or {})
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers=hdrs)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, self._parse(r.read(),
+                                             r.headers.get("Content-Type"))
+        except urllib.error.HTTPError as e:
+            return e.code, self._parse(e.read(),
+                                       e.headers.get("Content-Type"))
+
+    @staticmethod
+    def _parse(raw: bytes, ctype):
+        if ctype and "json" in ctype:
+            return json.loads(raw) if raw else {}
+        return raw.decode(errors="replace")
+
+    # -- suite execution --------------------------------------------------
+
+    def run_file(self, path: str) -> list[StepResult]:
+        with open(path) as f:
+            docs = list(yaml.safe_load_all(f))
+        setup = teardown = None
+        tests = []
+        for doc in docs:
+            if not doc:
+                continue
+            for name, steps in doc.items():
+                if name == "setup":
+                    setup = steps
+                elif name == "teardown":
+                    teardown = steps
+                else:
+                    tests.append((name, steps))
+        results = []
+        for name, steps in tests:
+            results.append(self._run_test(name, steps, setup, teardown))
+        return results
+
+    def _run_test(self, name, steps, setup, teardown) -> StepResult:
+        self.stash: dict = {}
+        self.last = None
+        self.last_status = None
+        try:
+            skip_msg = self._skip_reason(steps)
+            if skip_msg:
+                return StepResult(name, ok=True, skipped=True,
+                                  message=skip_msg)
+            if setup:
+                for step in setup:
+                    self._step(step)
+            try:
+                for step in steps:
+                    self._step(step)
+            finally:
+                if teardown:
+                    for step in teardown:
+                        self._step(step)
+                self._wipe()
+            return StepResult(name, ok=True)
+        except AssertionError as e:
+            return StepResult(name, ok=False, message=str(e))
+        except Exception as e:  # noqa: BLE001 — report, don't crash the run
+            return StepResult(name, ok=False,
+                              message=f"{type(e).__name__}: {e}")
+
+    def _wipe(self):
+        """Between-tests cleanup (the runner's wipeCluster analog):
+        delete every concrete index and template."""
+        status, resp = self._call("GET", "/_cat/indices",
+                                  {"format": "json"}, None)
+        if status == 200 and isinstance(resp, list):
+            for row in resp:
+                name = row.get("index")
+                if name:
+                    self._call("DELETE",
+                               "/" + urllib.parse.quote(name, safe=""),
+                               {}, None)
+        status, resp = self._call("GET", "/_template", {}, None)
+        if status == 200 and isinstance(resp, dict):
+            for name in resp:
+                self._call("DELETE", f"/_template/{name}", {}, None)
+
+    def _skip_reason(self, steps):
+        for step in steps:
+            if "skip" in step:
+                sk = step["skip"] or {}
+                feats = sk.get("features") or []
+                if isinstance(feats, str):
+                    feats = [feats]
+                unsupported = [f for f in feats
+                               if f not in SUPPORTED_FEATURES]
+                if unsupported:
+                    return f"features {unsupported}"
+                version = str(sk.get("version", ""))
+                if version.strip().lower() == "all":
+                    return sk.get("reason", "skip all")
+                # legacy numeric ranges target ES 6/7-era gaps; the
+                # implementation under test is current, so they don't
+                # apply
+        return None
+
+    # -- steps ------------------------------------------------------------
+
+    def _step(self, step: dict):
+        ((kind, body),) = step.items() if len(step) == 1 else (
+            ("do", step.get("do")),)
+        if kind == "skip":
+            return
+        if kind == "do":
+            return self._do(body)
+        if kind == "set":
+            ((path, var),) = body.items()
+            self.stash[var] = self._extract(path)
+            return
+        if kind == "match":
+            ((path, expect),) = body.items()
+            got = self._extract(path)
+            expect = self._sub(expect)
+            if (isinstance(expect, str) and len(expect) > 2
+                    and expect.lstrip().startswith("/")
+                    and expect.rstrip().endswith("/")):
+                pat = expect.strip().strip("/")
+                assert re.search(pat, str(got), re.X | re.S), \
+                    f"match {path}: /{pat}/ !~ {got!r}"
+            elif isinstance(expect, float) and isinstance(got, (int, float)):
+                assert abs(float(got) - expect) < 1e-6 or got == expect, \
+                    f"match {path}: expected {expect!r}, got {got!r}"
+            else:
+                assert _eq(got, expect), \
+                    f"match {path}: expected {expect!r}, got {got!r}"
+            return
+        if kind == "contains":
+            ((path, expect),) = body.items()
+            got = self._extract(path)
+            expect = self._sub(expect)
+            ok = (expect in got if not isinstance(expect, dict)
+                  else any(_eq(x, expect) for x in got))
+            assert ok, f"contains {path}: {expect!r} not in {got!r}"
+            return
+        if kind == "length":
+            ((path, expect),) = body.items()
+            got = self._extract(path)
+            assert len(got) == int(self._sub(expect)), \
+                f"length {path}: expected {expect}, got {len(got)}"
+            return
+        if kind in ("is_true", "is_false"):
+            try:
+                got = self._extract(body)
+            except AssertionError:
+                got = None               # absent path is falsy (official
+                # runner: is_false passes on a missing field)
+            truthy = got not in (None, False, 0, "", "false") \
+                and got != {}
+            assert truthy == (kind == "is_true"), \
+                f"{kind} {body}: got {got!r}"
+            return
+        if kind in ("gt", "gte", "lt", "lte"):
+            ((path, expect),) = body.items()
+            got = float(self._extract(path))
+            expect = float(self._sub(expect))
+            ok = {"gt": got > expect, "gte": got >= expect,
+                  "lt": got < expect, "lte": got <= expect}[kind]
+            assert ok, f"{kind} {path}: got {got}, bound {expect}"
+            return
+        if kind == "close_to":
+            ((path, spec),) = body.items()
+            got = float(self._extract(path))
+            assert abs(got - float(spec["value"])) <= float(
+                spec.get("error", 1e-6)), f"close_to {path}: {got}"
+            return
+        raise ValueError(f"unsupported section [{kind}]")
+
+    def _do(self, body: dict):
+        body = dict(body)
+        catch = body.pop("catch", None)
+        headers = self._sub(body.pop("headers", None))
+        body.pop("warnings", None)
+        body.pop("allowed_warnings", None)
+        body.pop("node_selector", None)
+        ((api, raw_params),) = body.items()
+        params = self._sub(raw_params or {})
+        req_body = params.pop("body", None) if isinstance(params, dict) \
+            else None
+        try:
+            method, path, query = self.specs.resolve(api, {**params,
+                                                           "body": req_body})
+        except ValueError:
+            # unresolvable path = client-side validation failure — what
+            # `catch: param` asserts (the official runner raises the
+            # same from its request builder)
+            if catch == "param":
+                return
+            raise
+        ignore = query.pop("ignore", None)
+        status, resp = self._call(method, path, query, req_body, headers)
+        self.last, self.last_status = resp, status
+        if method == "HEAD":
+            # HEAD APIs are booleans in the official client: 404 is a
+            # `false` response, not an error
+            self.last = status == 200
+            if catch is None:
+                assert status in (200, 404), f"{api} -> {status}"
+                return
+        if ignore is not None and status == int(ignore):
+            return
+        if catch is None:
+            assert status < 400, \
+                f"{api} -> {status}: {json.dumps(resp)[:300]}"
+            return
+        if catch.startswith("/"):
+            assert status >= 400, f"{api}: expected error, got {status}"
+            # catch regexes are compiled WITHOUT comments mode (spaces are
+            # literal), unlike match assertions (DoSection vs MatchAssertion)
+            pat = catch.strip("/")
+            assert re.search(pat, json.dumps(resp), re.S), \
+                f"{api}: /{pat}/ !~ {json.dumps(resp)[:300]}"
+            return
+        lo, hi = _CATCH_STATUS.get(catch, (400, 599))
+        assert lo <= status <= hi, \
+            f"{api}: catch {catch} expected {lo}-{hi}, got {status} " \
+            f"{json.dumps(resp)[:200]}"
+
+    # -- paths & stash ----------------------------------------------------
+
+    def _extract(self, path):
+        if path in ("$body", ""):
+            return self.last
+        node = self.last
+        for part in _split_path(str(self._sub(path))):
+            if isinstance(node, list):
+                node = node[int(part)]
+            elif isinstance(node, dict):
+                if part not in node:
+                    raise AssertionError(
+                        f"path [{path}]: missing [{part}] in "
+                        f"{json.dumps(node)[:200]}")
+                node = node[part]
+            else:
+                raise AssertionError(f"path [{path}]: hit leaf at "
+                                     f"[{part}]")
+        return node
+
+    def _sub(self, v):
+        """Recursive $stash substitution."""
+        if isinstance(v, str):
+            if v.startswith("$"):
+                key = v[1:]
+                if key in self.stash:
+                    return self.stash[key]
+            return re.sub(r"\$\{(\w+)\}",
+                          lambda m: str(self.stash.get(m.group(1),
+                                                       m.group(0))), v)
+        if isinstance(v, dict):
+            return {self._sub(k) if isinstance(k, str) else k:
+                    self._sub(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [self._sub(x) for x in v]
+        return v
+
+
+def _split_path(path: str) -> list[str]:
+    """Dotted path with \\. escapes (field names containing dots)."""
+    out, cur, i = [], "", 0
+    while i < len(path):
+        c = path[i]
+        if c == "\\" and i + 1 < len(path) and path[i + 1] == ".":
+            cur += "."
+            i += 2
+            continue
+        if c == ".":
+            out.append(cur)
+            cur = ""
+        else:
+            cur += c
+        i += 1
+    out.append(cur)
+    return [p for p in out if p != ""]
+
+
+def _expand_dotted(d):
+    """Dotted keys in an expected map address nested values (the Java
+    runner resolves them via ObjectPath before comparing)."""
+    out = {}
+    for k, v in d.items():
+        v = _expand_dotted(v) if isinstance(v, dict) else v
+        if isinstance(k, str) and "." in k:
+            node = out
+            parts = k.split(".")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = v
+        else:
+            out[k] = v
+    return out
+
+
+def _eq(got, expect) -> bool:
+    """YAML-runner equality: ints/floats compare numerically; dotted keys
+    in expected maps expand into nested paths; None only equals None."""
+    if isinstance(expect, (int, float)) and isinstance(got, (int, float)) \
+            and not isinstance(expect, bool) and not isinstance(got, bool):
+        return float(got) == float(expect)
+    if isinstance(expect, dict) and isinstance(got, dict):
+        e, g = _expand_dotted(expect), got
+        if set(e) != set(g):
+            return False
+        return all(_eq(g[k], e[k]) for k in e)
+    return got == expect

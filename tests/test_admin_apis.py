@@ -126,7 +126,7 @@ def test_cluster_settings_dynamic_update(node):
     code, _ = call(node, "PUT", "/autono/_doc/1", {"x": 1})
     assert code == 404
     code, resp = call(node, "GET", "/_cluster/settings")
-    assert resp["persistent"]["search.max_buckets"] == 100
+    assert resp["persistent"]["search"]["max_buckets"] == 100
     # unknown / non-dynamic keys rejected
     code, _ = call(node, "PUT", "/_cluster/settings", {
         "persistent": {"no.such.key": 1}})

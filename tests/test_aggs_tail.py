@@ -1,0 +1,282 @@
+"""Aggregation-tail correctness vs plain-Python oracles (VERDICT r4
+item 5): composite (+after pagination), significant_terms, top_hits,
+extended_stats, percentile_ranks, weighted_avg, multi_terms, rare_terms,
+median_absolute_deviation — each also checked 1-shard vs 3-shard
+partial-merge (reduce_aggs over wire partials)."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.segment import SegmentWriter
+from opensearch_tpu.mapping.mapper import DocumentMapper
+from opensearch_tpu.search.aggs import reduce_aggs
+from opensearch_tpu.search.executor import ShardSearcher
+
+MAPPING = {"properties": {
+    "cat": {"type": "keyword"},
+    "tag": {"type": "keyword"},
+    "n": {"type": "long"},
+    "price": {"type": "double"},
+    "w": {"type": "double"},
+    "body": {"type": "text"},
+    "day": {"type": "date"},
+}}
+
+rng = np.random.default_rng(11)
+CATS = ["a", "b", "c"]
+DOCS = []
+for i in range(90):
+    cat = CATS[i % 3]
+    DOCS.append({
+        "cat": cat,
+        "tag": f"t{i % 7}" if i % 9 else f"rare{i}",   # rare{0,9,...} once
+        "n": int(i % 5),
+        "price": float(i),
+        "w": float(1 + i % 3),
+        # 'sig' appears mostly in cat a docs -> significant for query sig
+        "body": ("sig special" if (cat == "a" and i % 2 == 0)
+                 else "common filler"),
+        "day": f"2023-0{(i % 3) + 1}-15",
+    })
+
+
+def searcher(n_segments=1):
+    mapper = DocumentMapper(MAPPING)
+    w = SegmentWriter()
+    segs = []
+    per = math.ceil(len(DOCS) / n_segments)
+    for si in range(n_segments):
+        chunk = DOCS[si * per: (si + 1) * per]
+        if chunk:
+            parsed = [mapper.parse(f"{si}-{i}", d)
+                      for i, d in enumerate(chunk)]
+            segs.append(w.build(parsed, f"s{si}"))
+    return ShardSearcher(segs, mapper)
+
+
+def run(aggs, query=None, n_shards=1):
+    body = {"size": 0, "query": query or {"match_all": {}}, "aggs": aggs}
+    if n_shards == 1:
+        return searcher(1).search(body)["aggregations"]
+    partials = []
+    split = searcher(n_shards)
+    for si in range(len(split.segments)):
+        sub = ShardSearcher([split.segments[si]], split.mapper)
+        # round-trip through JSON: partials must be wire-safe
+        partials.append(json.loads(json.dumps(
+            sub.search(body, agg_partials=True)["aggregation_partials"])))
+    return reduce_aggs(aggs, partials)
+
+
+@pytest.mark.parametrize("n_shards", [1, 3])
+def test_extended_stats(n_shards):
+    out = run({"es": {"extended_stats": {"field": "price"}}}, n_shards=n_shards)
+    v = np.asarray([d["price"] for d in DOCS])
+    es = out["es"]
+    assert es["count"] == len(v)
+    assert es["avg"] == pytest.approx(v.mean())
+    assert es["sum_of_squares"] == pytest.approx((v ** 2).sum())
+    assert es["variance"] == pytest.approx(v.var())
+    assert es["std_deviation"] == pytest.approx(v.std())
+    assert es["std_deviation_bounds"]["upper"] == pytest.approx(
+        v.mean() + 2 * v.std())
+
+
+@pytest.mark.parametrize("n_shards", [1, 3])
+def test_weighted_avg(n_shards):
+    out = run({"wa": {"weighted_avg": {"value": {"field": "price"},
+                                       "weight": {"field": "w"}}}},
+              n_shards=n_shards)
+    v = np.asarray([d["price"] for d in DOCS])
+    w = np.asarray([d["w"] for d in DOCS])
+    assert out["wa"]["value"] == pytest.approx((v * w).sum() / w.sum())
+
+
+@pytest.mark.parametrize("n_shards", [1, 3])
+def test_percentile_ranks(n_shards):
+    out = run({"pr": {"percentile_ranks": {"field": "price",
+                                           "values": [10, 50, 89]}}},
+              n_shards=n_shards)
+    v = np.asarray([d["price"] for d in DOCS])
+    for x in (10, 50, 89):
+        assert out["pr"]["values"][f"{float(x)}"] == pytest.approx(
+            100.0 * (v <= x).sum() / len(v))
+
+
+@pytest.mark.parametrize("n_shards", [1, 3])
+def test_median_absolute_deviation(n_shards):
+    out = run({"mad": {"median_absolute_deviation": {"field": "price"}}},
+              n_shards=n_shards)
+    v = np.asarray([d["price"] for d in DOCS], np.float64)
+    med = np.median(v)
+    assert out["mad"]["value"] == pytest.approx(
+        np.median(np.abs(v - med)), rel=0.02)
+
+
+@pytest.mark.parametrize("n_shards", [1, 3])
+def test_significant_terms_jlh(n_shards):
+    out = run({"sig": {"significant_terms": {"field": "cat",
+                                             "min_doc_count": 1}}},
+              query={"match": {"body": "sig"}}, n_shards=n_shards)
+    # 'sig' only occurs in cat=a docs: a is the only significant bucket
+    assert out["sig"]["doc_count"] == 15          # fg size
+    keys = [b["key"] for b in out["sig"]["buckets"]]
+    assert keys == ["a"]
+    b = out["sig"]["buckets"][0]
+    assert b["doc_count"] == 15 and b["bg_count"] == 30
+    fg_rate, bg_rate = 15 / 15, 30 / 90
+    assert b["score"] == pytest.approx(
+        (fg_rate - bg_rate) * (fg_rate / bg_rate))
+
+
+@pytest.mark.parametrize("n_shards", [1, 3])
+def test_rare_terms(n_shards):
+    out = run({"rare": {"rare_terms": {"field": "tag"}}}, n_shards=n_shards)
+    # oracle: tags occurring exactly once across the WHOLE corpus
+    from collections import Counter
+    c = Counter(d["tag"] for d in DOCS)
+    expect = sorted(t for t, n in c.items() if n == 1)
+    assert [b["key"] for b in out["rare"]["buckets"]] == expect
+    assert all(b["doc_count"] == 1 for b in out["rare"]["buckets"])
+
+
+def test_rare_terms_cross_shard_exclusion():
+    """A term under max_doc_count on EVERY shard but over it in total
+    must not be reported (the over-list / CuckooFilter role)."""
+    out = run({"rare": {"rare_terms": {"field": "cat",
+                                       "max_doc_count": 40}}}, n_shards=3)
+    # each cat has 30 docs: <=40 per merged sum? 30 <= 40 -> all rare.
+    assert len(out["rare"]["buckets"]) == 3
+    out = run({"rare": {"rare_terms": {"field": "cat",
+                                       "max_doc_count": 20}}}, n_shards=3)
+    # per 30-doc shard each cat has ~10 (<=20) but totals 30 > 20
+    assert out["rare"]["buckets"] == []
+
+
+@pytest.mark.parametrize("n_shards", [1, 3])
+def test_multi_terms_with_metric_sub(n_shards):
+    out = run({"mt": {"multi_terms": {"terms": [{"field": "cat"},
+                                                {"field": "n"}],
+                                      "size": 50},
+                      "aggs": {"p": {"sum": {"field": "price"}}}}},
+              n_shards=n_shards)
+    from collections import Counter, defaultdict
+    c = Counter((d["cat"], d["n"]) for d in DOCS)
+    sums = defaultdict(float)
+    for d in DOCS:
+        sums[(d["cat"], d["n"])] += d["price"]
+    got = {tuple(b["key"]): (b["doc_count"], b["p"]["value"])
+           for b in out["mt"]["buckets"]}
+    assert len(got) == len(c)
+    for k, n in c.items():
+        assert got[k][0] == n
+        assert got[k][1] == pytest.approx(sums[k])
+    # count-desc order with key tiebreak
+    counts = [b["doc_count"] for b in out["mt"]["buckets"]]
+    assert counts == sorted(counts, reverse=True)
+
+
+@pytest.mark.parametrize("n_shards", [1, 3])
+def test_top_hits_top_level_and_under_terms(n_shards):
+    aggs = {"cats": {"terms": {"field": "cat"},
+                     "aggs": {"best": {"top_hits": {
+                         "size": 2, "sort": [{"price": {"order": "desc"}}],
+                         "_source": ["price", "cat"]}}}},
+            "overall": {"top_hits": {"size": 3,
+                                     "sort": [{"price": {"order": "desc"}}]}}}
+    out = run(aggs, n_shards=n_shards)
+    top = out["overall"]["hits"]
+    assert top["total"]["value"] == 90
+    assert [h["sort"][0] for h in top["hits"]] == [89.0, 88.0, 87.0]
+    for b in out["cats"]["buckets"]:
+        cat = b["key"]
+        oracle = sorted((d["price"] for d in DOCS if d["cat"] == cat),
+                        reverse=True)[:2]
+        hits = b["best"]["hits"]["hits"]
+        assert [h["sort"][0] for h in hits] == oracle
+        assert hits[0]["_source"]["cat"] == cat
+        assert set(hits[0]["_source"]) == {"price", "cat"}
+
+
+def test_top_hits_by_score():
+    out = run({"th": {"top_hits": {"size": 2}}},
+              query={"match": {"body": "sig"}})
+    hits = out["th"]["hits"]
+    assert hits["total"]["value"] == 15
+    assert hits["max_score"] is not None
+    assert hits["hits"][0]["_score"] == pytest.approx(hits["max_score"])
+
+
+@pytest.mark.parametrize("n_shards", [1, 3])
+def test_composite_terms_pagination(n_shards):
+    from collections import Counter
+    c = Counter((d["cat"], d["n"]) for d in DOCS)
+    expect = sorted(c.items())
+    aggs = {"comp": {"composite": {
+        "size": 4, "sources": [{"c": {"terms": {"field": "cat"}}},
+                               {"num": {"terms": {"field": "n"}}}]}}}
+    seen = []
+    after = None
+    for _page in range(10):
+        a = {"comp": {"composite": {**aggs["comp"]["composite"]}}}
+        if after is not None:
+            a["comp"]["composite"]["after"] = after
+        out = run(a, n_shards=n_shards)["comp"]
+        if not out["buckets"]:
+            break
+        for b in out["buckets"]:
+            seen.append(((b["key"]["c"], b["key"]["num"]), b["doc_count"]))
+        after = out.get("after_key")
+        if after is None:
+            break
+    assert seen == expect
+
+
+@pytest.mark.parametrize("n_shards", [1, 3])
+def test_composite_date_histogram_source_with_sub(n_shards):
+    aggs = {"comp": {"composite": {
+        "size": 10,
+        "sources": [{"month": {"date_histogram":
+                               {"field": "day",
+                                "calendar_interval": "month"}}}]},
+        "aggs": {"p": {"avg": {"field": "price"}}}}}
+    out = run(aggs, n_shards=n_shards)["comp"]
+    assert len(out["buckets"]) == 3
+    from collections import defaultdict
+    per_month = defaultdict(list)
+    for d in DOCS:
+        per_month[d["day"][:7]].append(d["price"])
+    months = sorted(per_month)
+    for b, m in zip(out["buckets"], months):
+        import datetime as dt
+        got = dt.datetime.fromtimestamp(
+            b["key"]["month"] / 1000, tz=dt.timezone.utc).strftime("%Y-%m")
+        assert got == m
+        assert b["doc_count"] == len(per_month[m])
+        assert b["p"]["value"] == pytest.approx(np.mean(per_month[m]))
+
+
+def test_composite_desc_order():
+    aggs = {"comp": {"composite": {
+        "size": 2, "sources": [{"c": {"terms": {"field": "cat",
+                                                "order": "desc"}}}]}}}
+    out = run(aggs)["comp"]
+    assert [b["key"]["c"] for b in out["buckets"]] == ["c", "b"]
+    # paginate past the end
+    aggs["comp"]["composite"]["after"] = out["after_key"]
+    out2 = run(aggs)["comp"]
+    assert [b["key"]["c"] for b in out2["buckets"]] == ["a"]
+
+
+def test_unsupported_sub_agg_is_400():
+    from opensearch_tpu.common.errors import IllegalArgumentError
+
+    with pytest.raises(IllegalArgumentError):
+        run({"t": {"terms": {"field": "cat"},
+                   "aggs": {"c": {"cardinality": {"field": "tag"}}}}})
+    with pytest.raises(IllegalArgumentError):
+        run({"h": {"histogram": {"field": "price", "interval": 10},
+                   "aggs": {"th": {"top_hits": {}}}}})

@@ -201,11 +201,11 @@ def test_cat_and_stats(node):
 def test_error_shapes(node):
     status, body = call(node, "GET", "/missing_index/_search", {})
     assert status == 404
-    assert body["error"]["type"] == "index_not_found_error"
+    assert body["error"]["type"] == "index_not_found_exception"
     status, body = call(node, "POST", "/library/_search",
                         {"query": {"bogus": {}}})
     assert status == 400
-    assert body["error"]["type"] == "parsing_error"
+    assert body["error"]["type"] == "parsing_exception"
     status, body = call(node, "DELETE", "/")
     assert status in (400, 405)
 
