@@ -64,7 +64,9 @@ class Coordinator:
                  gateway=None):
         self.node_id = node_id
         self.transport = transport
-        self.voting_nodes = sorted(voting_nodes)
+        # bootstrap voting configuration; once states carry a `voting`
+        # field (dynamic reconfiguration) the accepted/committed one wins
+        self._initial_voting = sorted(voting_nodes)
         self.node_info = node_info or {"name": node_id}
         self.on_apply = on_apply
         self.check_interval = check_interval
@@ -114,8 +116,31 @@ class Coordinator:
         if self.gateway is not None:
             self.gateway.save_terms(self.current_term, self.last_join_term)
 
+    @property
+    def voting_nodes(self) -> list[str]:
+        """Current voting configuration: the committed state's (falling
+        back to accepted, then the bootstrap set) —
+        CoordinationMetadata.getLastCommittedConfiguration."""
+        v = self.committed.voting or self.accepted.voting
+        return sorted(v) if v else self._initial_voting
+
     def _majority(self) -> int:
         return len(self.voting_nodes) // 2 + 1
+
+    def _reconfigure(self, nodes: dict) -> tuple:
+        """Voting config for a node set: every master-eligible node,
+        trimmed to an odd count so a single failure never halves the
+        quorum (cluster/coordination/Reconfigurator.java)."""
+        eligible = sorted(n for n, info in nodes.items()
+                          if (info or {}).get("master_eligible", True))
+        if not eligible:
+            return tuple(self._initial_voting)
+        if len(eligible) % 2 == 0 and len(eligible) > 1:
+            for cand in reversed(eligible):
+                if cand != self.node_id:
+                    eligible.remove(cand)
+                    break
+        return tuple(eligible)
 
     def is_leader(self) -> bool:
         return self.mode == Mode.LEADER
@@ -190,7 +215,8 @@ class Coordinator:
             nodes[self.node_id] = self.node_info
             nodes.update(joiners)
             first = base.with_(term=term, version=base.version + 1,
-                               master_node=self.node_id, nodes=nodes)
+                               master_node=self.node_id, nodes=nodes,
+                               voting=self._reconfigure(nodes))
         try:
             self.publish(first)
         except FailedToCommitError:
@@ -240,11 +266,13 @@ class Coordinator:
     # -- node membership (leader side) ------------------------------------
 
     def add_node(self, node_id: str, info: dict):
-        """Leader: admit a (data) node into the cluster state."""
+        """Leader: admit a node; master-eligible joiners grow the voting
+        configuration (dynamic reconfiguration)."""
         def update(state: ClusterState) -> ClusterState:
             nodes = dict(state.nodes)
             nodes[node_id] = info
-            return allocate_shards(state.with_(nodes=nodes))
+            return allocate_shards(state.with_(
+                nodes=nodes, voting=self._reconfigure(nodes)))
         self.submit_state_update(update)
 
     def remove_node(self, node_id: str):
@@ -253,7 +281,8 @@ class Coordinator:
                 return state
             nodes = dict(state.nodes)
             del nodes[node_id]
-            return allocate_shards(state.with_(nodes=nodes))
+            return allocate_shards(state.with_(
+                nodes=nodes, voting=self._reconfigure(nodes)))
         self.submit_state_update(update)
 
     # -- publication ------------------------------------------------------
@@ -276,31 +305,58 @@ class Coordinator:
         return new_state
 
     def publish(self, state: ClusterState):
-        """Two-phase: PUBLISH to every node in the state, COMMIT after a
-        majority of VOTING nodes acked (Publication.java)."""
+        """Two-phase: PUBLISH to every node in the state (as a DIFF over
+        the previous committed state when possible, falling back to the
+        full state on a base mismatch — PublishRequest's Diff path),
+        COMMIT once a quorum acked.  During a voting reconfiguration the
+        quorum must hold in BOTH the old (committed) and new
+        configurations (the Zen2 joint-consensus rule)."""
+        from opensearch_tpu.cluster.state import diff_states
+
+        with self._lock:
+            base = self.committed
+            old_config = set(self.voting_nodes)
+        new_config = set(state.voting) or old_config
         payload = state.to_payload()
+        diff = (diff_states(base, state)
+                if base.version > 0 and base.master_node == self.node_id
+                else None)
         targets = [n for n in state.nodes if n != self.node_id]
         ok_nodes = []
+        acked = set()
         local = self._on_publish({"state": payload})   # accept locally first
-        acks = (1 if (local.get("accepted")
-                      and self.node_id in self.voting_nodes) else 0)
+        if local.get("accepted"):
+            acked.add(self.node_id)
         for peer in targets:
             try:
-                r = self.transport.send_request(peer, PUBLISH,
-                                                {"state": payload},
-                                                timeout=5.0)
+                if diff is not None:
+                    r = self.transport.send_request(peer, PUBLISH,
+                                                    {"diff": diff},
+                                                    timeout=5.0)
+                    if not r.get("accepted") and r.get("need_full"):
+                        # receiver holds a different base: full state
+                        r = self.transport.send_request(
+                            peer, PUBLISH, {"state": payload}, timeout=5.0)
+                else:
+                    r = self.transport.send_request(peer, PUBLISH,
+                                                    {"state": payload},
+                                                    timeout=5.0)
                 if r.get("accepted"):
                     ok_nodes.append(peer)
-                    if peer in self.voting_nodes:
-                        acks += 1
+                    acked.add(peer)
             except OpenSearchTpuError:
                 continue
-        if acks < self._majority():
+
+        def quorum(config: set) -> bool:
+            return len(acked & config) >= len(config) // 2 + 1
+
+        if not (quorum(old_config) and quorum(new_config)):
             with self._lock:
                 self.mode = Mode.CANDIDATE
             raise FailedToCommitError(
                 f"publication of term {state.term} version {state.version} "
-                f"got {acks}/{self._majority()} votes")
+                f"got {sorted(acked)} acks, needs majorities of "
+                f"{sorted(old_config)} and {sorted(new_config)}")
         self._on_commit({"term": state.term, "version": state.version})
         for peer in ok_nodes:
             try:
@@ -312,7 +368,19 @@ class Coordinator:
                 continue
 
     def _on_publish(self, payload: dict) -> dict:
-        state = ClusterState.from_payload(payload["state"])
+        if "diff" in payload:
+            from opensearch_tpu.cluster.state import apply_diff
+
+            diff = payload["diff"]
+            with self._lock:
+                if (self.accepted.term, self.accepted.version) != \
+                        (diff["base_term"], diff["base_version"]):
+                    # can't apply: ask for the full state
+                    return {"accepted": False, "need_full": True,
+                            "term": self.current_term}
+                state = apply_diff(self.accepted, diff)
+        else:
+            state = ClusterState.from_payload(payload["state"])
         with self._lock:
             if state.term < self.current_term:
                 return {"accepted": False, "term": self.current_term}
@@ -324,9 +392,10 @@ class Coordinator:
             if self.gateway is not None:
                 # accepted state is durable BEFORE the ack: the quorum
                 # intersection argument needs it present after a crash
-                # (PersistedClusterStateService on PublishRequest)
+                # (PersistedClusterStateService on PublishRequest) — the
+                # FULL reconstructed state, even when a diff arrived
                 self._persist_terms()
-                self.gateway.save_accepted(payload["state"])
+                self.gateway.save_accepted(state.to_payload())
             if state.master_node != self.node_id:
                 self.mode = Mode.FOLLOWER
                 self._check_failures.clear()

@@ -104,6 +104,14 @@ class ClusterNode:
     # -- state application (IndicesClusterStateService analog) ------------
 
     def _apply_state(self, state: ClusterState, recover: bool = True):
+        # handshake newly-seen peers in the background: the negotiated
+        # protocol version is cached per peer and an incompatible major
+        # is logged (the TransportHandshaker-on-connect analog)
+        for peer in state.nodes:
+            if (peer != self.node_id
+                    and peer not in self.transport._peer_versions):
+                threading.Thread(target=self._handshake_peer,
+                                 args=(peer,), daemon=True).start()
         to_promote: list[tuple] = []
         to_recover: list[tuple] = []
         with self._lock:
@@ -180,14 +188,27 @@ class ClusterNode:
         (ref indices/recovery/RecoverySourceHandler.java:105,
         ReplicationTracker.markAllocationIdAsInSync:1533)."""
         try:
+            svc = self.indices.get(index)
+            local_ckpt = -1
+            if svc is not None:
+                # offer op-based recovery: our highest applied seq-no
+                local_ckpt = svc.engine_for(shard)._seq_no
             resp = self.transport.send_request(
                 primary, A_START_RECOVERY,
-                {"index": index, "shard": shard}, timeout=30.0)
+                {"index": index, "shard": shard, "node": self.node_id,
+                 "local_checkpoint": local_ckpt}, timeout=30.0)
             svc = self.indices.get(index)
             if svc is None:
                 return
             engine = svc.engine_for(shard)
-            engine.install_checkpoint(resp["ckpt"], resp["blobs"])
+            if resp.get("mode") == "ops":
+                # retention-lease fast path: replay the missed ops, no
+                # file copy (RecoverySourceHandler phase-2-only recovery)
+                for op in resp["ops"]:
+                    engine.apply_replica_op(op)
+                engine.refresh()
+            else:
+                engine.install_checkpoint(resp["ckpt"], resp["blobs"])
             svc.invalidate_searcher()
             master = self._master()
             payload = {"index": index, "shard": shard,
@@ -206,14 +227,29 @@ class ClusterNode:
                 self._recovering.discard((index, shard))
 
     def _h_start_recovery(self, payload: dict) -> dict:
-        """Primary side: refresh so every acked op is segment-covered,
-        then ship the full segment set."""
+        """Primary side: if a retention lease covers the replica's local
+        checkpoint, ship just the missed ops (no file copy —
+        index/seqno/RetentionLease); otherwise refresh and ship the full
+        segment set."""
         svc = self.indices.get(payload["index"])
         if svc is None:
             raise ShardNotFoundError(
                 f"[{payload['index']}][{payload['shard']}] not on this node")
         engine = svc.engine_for(payload["shard"])
+        replica = payload.get("node")
+        local_ckpt = int(payload.get("local_checkpoint", -1))
+        if replica is not None and local_ckpt >= 0:
+            ops = engine.ops_since(local_ckpt)
+            if ops is not None:
+                # renew the lease at the replica's NEW checkpoint
+                engine.add_retention_lease(replica, engine._seq_no)
+                return {"mode": "ops", "ops": ops,
+                        "max_seq_no": engine._seq_no}
         engine.refresh()
+        if replica is not None:
+            # track the copy from here on so its next recovery can be
+            # ops-based
+            engine.add_retention_lease(replica, engine._seq_no)
         ckpt = engine.checkpoint_info()
         return {"ckpt": ckpt, "blobs": engine.segments_blobs(ckpt["segments"])}
 
@@ -255,6 +291,14 @@ class ClusterNode:
             e["in_sync"] = [n for n in e["in_sync"] if n != node]
             return allocate_shards(state.with_(routing=routing))
         self.coordinator.submit_state_update(update)
+        # a permanently-failed copy releases its retention lease so the
+        # primary's translog can trim again (RetentionLease expiry)
+        svc = self.indices.get(index)
+        if svc is not None:
+            try:
+                svc.engine_for(shard).remove_retention_lease(node)
+            except OpenSearchTpuError:
+                pass
         return {"acknowledged": True}
 
     # -- master proxying ---------------------------------------------------
@@ -413,6 +457,10 @@ class ClusterNode:
             for rep, fut in futures:
                 try:
                     fut.result(timeout=10.0)
+                    # the ack advances the replica's retention lease —
+                    # translog history stays bounded by the slowest
+                    # replica's checkpoint (RetentionLease renewal)
+                    engine.add_retention_lease(rep, r.seq_no)
                 except Exception as exc:
                     if getattr(exc, "remote_type", None) == \
                             "version_conflict_engine_exception":
@@ -667,6 +715,14 @@ class ClusterNode:
     def start(self):
         self.coordinator.start()
         return self
+
+    def _handshake_peer(self, peer: str):
+        try:
+            self.transport.negotiated_version(peer)
+        except OpenSearchTpuError as e:
+            import logging
+            logging.getLogger("opensearch_tpu.transport").warning(
+                "handshake with [%s] failed: %s", peer, e)
 
     def stop(self):
         self.coordinator.stop()

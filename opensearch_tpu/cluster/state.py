@@ -42,6 +42,10 @@ class ClusterState:
     indices: dict = field(default_factory=dict)
     # index -> [shard-group entry per shard] (see module docstring)
     routing: dict = field(default_factory=dict)
+    # master-eligible node ids whose majority elects and commits
+    # (CoordinationMetadata.VotingConfiguration; [] = not yet set, the
+    # coordinator falls back to its bootstrap configuration)
+    voting: tuple = ()
 
     def is_newer_than(self, other: "ClusterState") -> bool:
         return (self.term, self.version) > (other.term, other.version)
@@ -58,6 +62,7 @@ class ClusterState:
             "nodes": self.nodes,
             "indices": self.indices,
             "routing": self.routing,
+            "voting": list(self.voting),
         }
 
     @staticmethod
@@ -71,7 +76,49 @@ class ClusterState:
             indices=dict(p.get("indices") or {}),
             routing={k: [dict(e) for e in v]
                      for k, v in (p.get("routing") or {}).items()},
+            voting=tuple(p.get("voting") or ()),
         )
+
+
+# -- state diffs (cluster/Diff.java / DiffableUtils analog) -----------------
+
+_DIFF_DICTS = ("nodes", "indices", "routing")
+_DIFF_SCALARS = ("cluster_name", "term", "version", "master_node", "voting")
+
+
+def diff_states(old: "ClusterState", new: "ClusterState") -> dict:
+    """Entry-level diff of two payloads keyed by the base (term, version)
+    — the receiver may only apply it over exactly that accepted state
+    (PublishRequest's Diff path; full-state fallback on mismatch)."""
+    oldp, newp = old.to_payload(), new.to_payload()
+    d = {"base_term": old.term, "base_version": old.version}
+    for k in _DIFF_SCALARS:
+        d[k] = newp[k]
+    for k in _DIFF_DICTS:
+        set_, del_ = {}, []
+        for key, v in newp[k].items():
+            if oldp[k].get(key) != v:
+                set_[key] = v
+        for key in oldp[k]:
+            if key not in newp[k]:
+                del_.append(key)
+        d[k] = {"set": set_, "del": del_}
+    return d
+
+
+def apply_diff(base: "ClusterState", diff: dict) -> "ClusterState":
+    """Reconstruct the full state a diff describes over ``base`` (the
+    caller must have checked base identity)."""
+    p = base.to_payload()
+    for k in _DIFF_SCALARS:
+        p[k] = diff[k]
+    for k in _DIFF_DICTS:
+        merged = dict(p[k])
+        for key in diff[k]["del"]:
+            merged.pop(key, None)
+        merged.update(diff[k]["set"])
+        p[k] = merged
+    return ClusterState.from_payload(p)
 
 
 def allocate_shards(state: ClusterState) -> ClusterState:

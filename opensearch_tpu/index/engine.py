@@ -87,6 +87,13 @@ class InternalEngine:
         # point lands (Lucene keeps old files until commit)
         self._obsolete_files: set[str] = set()
         self._seg_counter = 0
+        # lease id (replica node) -> lowest retained seq_no; leases pin
+        # translog generations past flush (RetentionLease analog)
+        self.retention_leases: dict[str, int] = {}
+        # generation -> max seq_no it contains (recorded at roll time) so
+        # lease-aware trimming deletes exactly the generations every
+        # lease has moved past
+        self._gen_max_seq: dict[int, int] = {}
         # engine-unique segment-id prefix: segments INSTALLED from another
         # engine (segment replication / recovery) keep their foreign ids,
         # so locally-built ids must never collide with them — a promoted
@@ -322,6 +329,43 @@ class InternalEngine:
                     deleted=op["op"] == "delete", hot_idx=-1)
             self._seq_no = max(self._seq_no, seq)
 
+    # -- retention leases (index/seqno/RetentionLease.java analog) --------
+
+    def add_retention_lease(self, lease_id: str, retaining_seq_no: int):
+        """Primary: retain translog ops from ``retaining_seq_no`` on for
+        the lease holder, so a briefly-partitioned replica can recover
+        by op replay instead of a full file copy."""
+        with self._lock:
+            self.retention_leases[str(lease_id)] = int(retaining_seq_no)
+
+    def remove_retention_lease(self, lease_id: str):
+        with self._lock:
+            self.retention_leases.pop(str(lease_id), None)
+
+    def get_retention_leases(self) -> dict:
+        with self._lock:
+            return dict(self.retention_leases)
+
+    def ops_since(self, from_seq: int):
+        """Every op with seq_no > from_seq, in order — or None when the
+        translog no longer retains a contiguous history up to the global
+        checkpoint (then only a file copy can recover).  Contiguity is
+        checked in O(n) over the RETAINED ops (seq_nos are unique), never
+        over the full history."""
+        from_seq = int(from_seq)
+        with self._lock:
+            self._ensure_open()
+            ops = sorted({op["seq_no"]: op
+                          for op in self.translog.read_ops(from_seq)
+                          }.values(), key=lambda o: o["seq_no"])
+            expected = self._seq_no - from_seq
+            if (len(ops) == expected
+                    and (expected == 0
+                         or (ops[0]["seq_no"] == from_seq + 1
+                             and ops[-1]["seq_no"] == self._seq_no))):
+                return ops
+            return None
+
     def checkpoint_info(self) -> dict:
         """Current segment-set checkpoint the primary publishes after a
         refresh (ReplicationCheckpoint analog): segment ids + per-segment
@@ -521,6 +565,7 @@ class InternalEngine:
                 elif seg.seg_id in self._live_dirty:
                     save_live(seg, seg_dir)
             self._live_dirty.clear()
+            self._gen_max_seq[self.translog.generation] = self._seq_no
             self.translog.roll_generation()
             commit = {"segments": [s.seg_id for s in self.segments],
                       "max_seq_no": self._seq_no,
@@ -532,7 +577,22 @@ class InternalEngine:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, os.path.join(self.data_path, self.COMMIT_FILE))
-            self.translog.trim(self.translog.generation)
+            if not self.retention_leases:
+                self.translog.trim(self.translog.generation)
+                self._gen_max_seq.clear()
+            else:
+                # trim only the generations EVERY lease has moved past:
+                # history stays bounded by the slowest replica's
+                # checkpoint, not unbounded (RetentionLease semantics)
+                floor = min(self.retention_leases.values())
+                keep = self.translog.generation
+                for gen in sorted(self._gen_max_seq):
+                    if self._gen_max_seq[gen] > floor:
+                        keep = min(keep, gen)
+                        break
+                self.translog.trim(keep)
+                for gen in [g for g in self._gen_max_seq if g < keep]:
+                    del self._gen_max_seq[gen]
             # Delete tombstones at or below the committed max seq-no are
             # durable in the persisted live bitmaps now — prune them so a
             # delete-heavy workload doesn't grow the version map forever

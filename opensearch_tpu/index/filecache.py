@@ -145,6 +145,9 @@ class FileCache:
         os.makedirs(seg_dir, exist_ok=True)
         with self.pin({fm["blob"] for fm in ref["files"]}):
             for fmeta in ref["files"]:
+                from opensearch_tpu.index.remote_store import (
+                    validate_manifest_name)
+                validate_manifest_name(fmeta["name"])
                 blob = fmeta["blob"]
                 target = self.get(
                     blob, lambda b=blob: repo.blobs.read_blob(b))

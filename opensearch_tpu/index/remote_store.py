@@ -92,6 +92,19 @@ def read_manifest(repo, index_name: str, shard_id) -> Optional[dict]:
         return None
 
 
+def validate_manifest_name(name: str) -> str:
+    """Manifest-supplied file names join into the shard directory — the
+    same rule FsBlobContainer._path enforces for blob names (no path
+    separators, no leading dot) must hold here, or a tampered repository
+    manifest writes outside the shard dir on restore/mount."""
+    if ("/" in name or os.sep in name or (os.altsep and os.altsep in name)
+            or name.startswith(".") or not name):
+        from opensearch_tpu.common.errors import IllegalArgumentError
+        raise IllegalArgumentError(
+            f"invalid file name [{name}] in remote store manifest")
+    return name
+
+
 def restore_shard(repo, index_name: str, shard_id,
                   shard_dir: str) -> dict:
     """Materialize a shard directory from its remote manifest (the
@@ -103,6 +116,7 @@ def restore_shard(repo, index_name: str, shard_id,
     seg_dir = os.path.join(shard_dir, "segments")
     os.makedirs(seg_dir, exist_ok=True)
     for fmeta in manifest["files"]:
+        validate_manifest_name(fmeta["name"])
         data = repo.blobs.read_blob(fmeta["blob"])
         tmp = os.path.join(seg_dir, fmeta["name"] + ".tmp")
         with open(tmp, "wb") as f:

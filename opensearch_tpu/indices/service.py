@@ -27,6 +27,7 @@ from opensearch_tpu.common.errors import (
     IndexAlreadyExistsError,
     IndexNotFoundError,
     OpenSearchTpuError,
+    ResourceAlreadyExistsError,
     ResourceNotFoundError,
     ValidationError,
 )
@@ -206,6 +207,14 @@ class IndexService:
             raise ClusterBlockException(
                 f"index [{self.name}] blocked by: [FORBIDDEN/13/remote "
                 "index is read-only (searchable snapshot)]")
+        blocked = self.settings.get(
+            "index.blocks.write",
+            (self.settings.get("blocks") or {}).get("write", False))
+        if str(blocked).lower() == "true":
+            from opensearch_tpu.common.errors import ClusterBlockException
+            raise ClusterBlockException(
+                f"index [{self.name}] blocked by: [FORBIDDEN/8/index "
+                "write (api)]")
 
     def index_doc(self, doc_id: Optional[str], source: dict,
                   routing: Optional[str] = None, **kw) -> OpResult:
@@ -486,6 +495,22 @@ class IndexService:
                                                index_name=self.name)
             return self._searcher
 
+    def update_settings(self, flat: dict):
+        """Apply a dynamic settings update; static settings reject
+        (IndexScopedSettings.NOT_DYNAMIC check)."""
+        for key, value in flat.items():
+            bare = key[6:] if key.startswith("index.") else key
+            if bare in ("number_of_shards", "routing_partition_size"):
+                raise IllegalArgumentError(
+                    f"final [{key}] setting: this setting is not "
+                    "updateable")
+            if bare == "number_of_replicas":
+                self.num_replicas = int(value)
+            self.settings[f"index.{bare}"] = value
+        if self._persist_meta is not None:
+            self._persist_meta(self.name, self.settings,
+                               self.get_mapping().get("mappings"))
+
     def index_setting(self, key: str, default):
         """Per-index setting lookup accepting both the dotted and bare
         key forms the create body may use."""
@@ -710,11 +735,17 @@ class IndicesService:
             os.path.join(os.path.dirname(data_path) or data_path,
                          "filecache"))
         self._pending_mounts: list[str] = []
+        # data streams: name -> {"timestamp_field", "generation",
+        # "indices": [backing names]} (cluster/metadata/DataStream)
+        self.data_streams: dict[str, dict] = {}
         self._aliases_file = os.path.join(data_path, "_aliases.json")
         self._templates_file = os.path.join(data_path,
                                             "_index_templates.json")
+        self._datastreams_file = os.path.join(data_path,
+                                              "_data_streams.json")
         for path, attr in ((self._aliases_file, "aliases"),
-                           (self._templates_file, "templates")):
+                           (self._templates_file, "templates"),
+                           (self._datastreams_file, "data_streams")):
             if os.path.exists(path):
                 with open(path) as f:
                     setattr(self, attr, json.load(f))
@@ -1032,8 +1063,16 @@ class IndicesService:
                 for alias in self.aliases:
                     if rx.match(alias):
                         add_alias(alias)
+                for ds in self.data_streams:
+                    if rx.match(ds):
+                        for n in self.data_streams[ds]["indices"]:
+                            add(n, None)
             elif part in self.aliases:
                 add_alias(part)
+            elif part in self.data_streams:
+                # a data stream searches all its backing indices
+                for n in self.data_streams[part]["indices"]:
+                    add(n, None)
             else:
                 add(self.get(part).name, None)
         out = []
@@ -1127,7 +1166,10 @@ class IndicesService:
 
     def write_index_for(self, alias: str) -> "IndexService":
         """Write resolution: an alias works for writes when it points at
-        one index or names an explicit write index."""
+        one index or names an explicit write index; a data stream always
+        writes to its newest backing index."""
+        if alias in self.data_streams:
+            return self.data_stream_write_index(alias)
         targets = self.aliases.get(alias)
         if targets is None:
             return self.get_or_create(alias)
@@ -1176,6 +1218,235 @@ class IndicesService:
             del self.templates[name]
             self._persist_json(self._templates_file, self.templates)
         return {"acknowledged": True}
+
+    # -- rollover / resize / data streams ---------------------------------
+
+    @staticmethod
+    def _next_rollover_name(name: str) -> str:
+        """<base>-000001 -> <base>-000002; no numeric suffix appends one
+        (MetadataRolloverService.generateRolloverIndexName)."""
+        m = re.match(r"^(.*)-(\d+)$", name)
+        if m:
+            n = int(m.group(2)) + 1
+            return f"{m.group(1)}-{n:0{max(6, len(m.group(2)))}d}"
+        return f"{name}-000001"
+
+    def _rollover_conditions_met(self, svc: IndexService,
+                                 conditions: dict) -> dict:
+        """Evaluate max_docs / max_age / max_size against the write
+        index (RolloverRequest conditions)."""
+        results = {}
+        for cond, want in (conditions or {}).items():
+            if cond == "max_docs":
+                results["[max_docs: %s]" % want] = \
+                    svc.doc_count() >= int(want)
+            elif cond == "max_age":
+                from opensearch_tpu.common.settings import parse_time
+                age_s = time.time() - svc.creation_date / 1000.0
+                results["[max_age: %s]" % want] = \
+                    age_s >= parse_time(want)
+            elif cond == "max_size":
+                from opensearch_tpu.common.settings import parse_bytes
+                size = sum(
+                    sum(len(b) for b in seg.sources)
+                    for e in svc.shards
+                    for seg in e.acquire_searcher().segments)
+                results["[max_size: %s]" % want] = \
+                    size >= parse_bytes(want)
+            else:
+                raise IllegalArgumentError(
+                    f"unknown rollover condition [{cond}]")
+        return results
+
+    def rollover(self, target: str, body: Optional[dict] = None,
+                 dry_run: bool = False) -> dict:
+        """Roll a write alias or data stream over to a fresh index
+        (action/admin/indices/rollover/MetadataRolloverService)."""
+        body = body or {}
+        with self._lock:
+            if target in self.data_streams:
+                return self._rollover_data_stream(target, body, dry_run)
+            targets = self.aliases.get(target)
+            if not targets:
+                raise IllegalArgumentError(
+                    f"rollover target [{target}] is not an alias or "
+                    "data stream")
+            writers = [n for n, m in targets.items()
+                       if m.get("is_write_index")]
+            if len(targets) == 1:
+                old = next(iter(targets))
+            elif len(writers) == 1:
+                old = writers[0]
+            else:
+                raise IllegalArgumentError(
+                    f"rollover target [{target}] does not point to a "
+                    "single write index")
+            new = body.get("new_index") or self._next_rollover_name(old)
+            conds = self._rollover_conditions_met(
+                self.indices[old], body.get("conditions") or {})
+            rolled = all(conds.values()) if conds else True
+            out = {"acknowledged": rolled and not dry_run,
+                   "shards_acknowledged": rolled and not dry_run,
+                   "old_index": old, "new_index": new,
+                   "rolled_over": rolled and not dry_run,
+                   "dry_run": dry_run, "conditions": conds}
+            if dry_run or not rolled:
+                return out
+            self.create(new, {k: v for k, v in body.items()
+                              if k in ("settings", "mappings",
+                                       "aliases")})
+            meta = dict(targets.get(old) or {})
+            meta["is_write_index"] = False
+            self.aliases[target][old] = meta
+            self.aliases[target][new] = {"is_write_index": True}
+            self._persist_json(self._aliases_file, self.aliases)
+            return out
+
+    def resize(self, source: str, target: str, mode: str,
+               body: Optional[dict] = None) -> dict:
+        """shrink / split / clone: create ``target`` with the new shard
+        count and re-bucket every live doc by the target routing (the
+        reference relinks Lucene segments —
+        action/admin/indices/shrink/TransportResizeAction; the array
+        engine re-routes sources instead, same observable result)."""
+        body = body or {}
+        with self._lock:
+            svc = self.get(source)
+            if target in self.indices or target in self.aliases:
+                raise IndexAlreadyExistsError(target)
+            blocked = svc.index_setting(
+                "blocks.write",
+                (svc.settings.get("blocks") or {}).get("write", False))
+            if str(blocked).lower() != "true":
+                raise IllegalArgumentError(
+                    f"index [{source}] must block writes to resize "
+                    "(set index.blocks.write: true)")
+            src_shards = svc.num_shards
+            settings = dict(body.get("settings") or {})
+            tgt_shards = int(settings.get(
+                "number_of_shards",
+                settings.get("index.number_of_shards",
+                             1 if mode == "shrink" else
+                             src_shards * 2 if mode == "split"
+                             else src_shards)))
+            if mode == "shrink" and src_shards % tgt_shards != 0:
+                raise IllegalArgumentError(
+                    f"the number of source shards [{src_shards}] must be "
+                    f"a multiple of [{tgt_shards}]")
+            if mode == "split" and tgt_shards % src_shards != 0:
+                raise IllegalArgumentError(
+                    f"the number of target shards [{tgt_shards}] must be "
+                    f"a multiple of the source shards [{src_shards}]")
+            if mode == "clone" and tgt_shards != src_shards:
+                raise IllegalArgumentError(
+                    "clone must keep the source's number of shards")
+            settings["number_of_shards"] = tgt_shards
+            settings.pop("index.number_of_shards", None)
+            settings.pop("blocks", None)
+            new_svc = self.create(target, {
+                "settings": settings,
+                "mappings": svc.get_mapping().get("mappings"),
+                "aliases": body.get("aliases") or {}})
+        # copy OUTSIDE the registry lock: doc-by-doc re-route.  Refresh
+        # first — the copy reads segments, and unrefreshed hot-buffer
+        # docs would silently miss the target otherwise
+        svc.refresh()
+        copied = 0
+        for engine in svc.shards:
+            searcher = engine.acquire_searcher()
+            for seg in searcher.segments:
+                for local in range(seg.n_docs):
+                    if not seg.live[local]:
+                        continue
+                    new_svc.index_doc(seg.doc_ids[local],
+                                      seg.source(local),
+                                      routing=seg.routings.get(local))
+                    copied += 1
+        new_svc.refresh()
+        return {"acknowledged": True, "shards_acknowledged": True,
+                "index": target, "copied_docs": copied}
+
+    # -- data streams ------------------------------------------------------
+
+    def create_data_stream(self, name: str) -> dict:
+        """A data stream needs a matching template with a [data_stream]
+        section; its first backing index is .ds-<name>-000001
+        (MetadataCreateDataStreamService)."""
+        with self._lock:
+            if name in self.data_streams:
+                raise ResourceAlreadyExistsError(
+                    f"data_stream [{name}] already exists")
+            tmpl = self._template_for(name)
+            if tmpl is None or "data_stream" not in tmpl:
+                raise IllegalArgumentError(
+                    f"no matching index template with a data_stream "
+                    f"definition for [{name}]")
+            ts_field = ((tmpl.get("data_stream") or {}).get(
+                "timestamp_field") or {}).get("name", "@timestamp")
+            backing = f".ds-{name}-000001"
+            self.create(backing, {
+                "mappings": {"properties": {ts_field: {"type": "date"}}}})
+            self.data_streams[name] = {"timestamp_field": ts_field,
+                                       "generation": 1,
+                                       "indices": [backing]}
+            self._persist_json(self._datastreams_file, self.data_streams)
+            return {"acknowledged": True}
+
+    def _rollover_data_stream(self, name: str, body: dict,
+                              dry_run: bool) -> dict:
+        ds = self.data_streams[name]
+        old = ds["indices"][-1]
+        conds = self._rollover_conditions_met(
+            self.indices[old], (body or {}).get("conditions") or {})
+        rolled = all(conds.values()) if conds else True
+        gen = ds["generation"] + 1
+        new = f".ds-{name}-{gen:06d}"
+        out = {"acknowledged": rolled and not dry_run,
+               "old_index": old, "new_index": new,
+               "rolled_over": rolled and not dry_run,
+               "dry_run": dry_run, "conditions": conds}
+        if dry_run or not rolled:
+            return out
+        self.create(new, {"mappings": {"properties": {
+            ds["timestamp_field"]: {"type": "date"}}}})
+        ds["generation"] = gen
+        ds["indices"].append(new)
+        self._persist_json(self._datastreams_file, self.data_streams)
+        return out
+
+    def get_data_streams(self, name: Optional[str] = None) -> dict:
+        with self._lock:
+            items = []
+            for n, ds in sorted(self.data_streams.items()):
+                if name and name != n and not re.match(
+                        "^" + re.escape(name).replace(r"\*", ".*") + "$",
+                        n):
+                    continue
+                items.append({
+                    "name": n,
+                    "timestamp_field": {"name": ds["timestamp_field"]},
+                    "indices": [{"index_name": i} for i in ds["indices"]],
+                    "generation": ds["generation"],
+                    "status": "GREEN",
+                })
+            return {"data_streams": items}
+
+    def delete_data_stream(self, name: str) -> dict:
+        with self._lock:
+            ds = self.data_streams.get(name)
+            if ds is None:
+                raise ResourceNotFoundError(
+                    f"data_stream [{name}] not found")
+            for backing in ds["indices"]:
+                if backing in self.indices:
+                    self.delete(backing)
+            del self.data_streams[name]
+            self._persist_json(self._datastreams_file, self.data_streams)
+            return {"acknowledged": True}
+
+    def data_stream_write_index(self, name: str) -> "IndexService":
+        ds = self.data_streams[name]
+        return self.get(ds["indices"][-1])
 
     def _template_for(self, name: str) -> Optional[dict]:
         """Highest-priority template whose pattern matches ``name``."""

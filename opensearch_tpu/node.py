@@ -24,7 +24,8 @@ from opensearch_tpu.rest.http_server import HttpServer
 class Node:
     def __init__(self, data_path: str, name: str = "node-1",
                  cluster_name: str = "opensearch-tpu",
-                 host: str = "127.0.0.1", port: int = 9200):
+                 host: str = "127.0.0.1", port: int = 9200,
+                 path_repo: "list[str] | None" = None):
         self.name = name
         self.host = host
         self.cluster_name = cluster_name
@@ -44,7 +45,8 @@ class Node:
         self.fs_health = FsHealthService(data_path)
         self.fs_health.check()
         self.ingest = IngestService(data_path)
-        self.snapshots = SnapshotsService(self.indices, data_path)
+        self.snapshots = SnapshotsService(self.indices, data_path,
+                                          path_repo=path_repo)
         # remote-store mirroring resolves repositories late-bound
         self.indices.set_repo_resolver(self.snapshots._repo,
                                        self.snapshots.repo_mutex)
@@ -137,11 +139,17 @@ class Node:
     def update_cluster_settings(self, persistent: dict | None = None,
                                 transient: dict | None = None) -> dict:
         """Two-bucket cluster settings (ClusterUpdateSettingsRequest):
-        null values reset; only the persistent bucket survives restart."""
+        null values reset; transient overrides persistent; only the
+        persistent bucket survives restart."""
         import json as _json
 
-        self.cluster_settings.apply_update(
-            {**(persistent or {}), **(transient or {})})
+        touched = set(persistent or {}) | set(transient or {})
+        # validate BEFORE mutating the buckets (a rejected update must
+        # leave them unchanged)
+        self.cluster_settings.validate(
+            {k: v for k, v in {**(persistent or {}),
+                               **(transient or {})}.items()
+             if v is not None})
         for bucket, ups in (("persistent", persistent),
                             ("transient", transient)):
             d = self.settings_buckets[bucket]
@@ -150,6 +158,13 @@ class Node:
                     d.pop(k, None)
                 else:
                     d[k] = v
+        # the EFFECTIVE value of a touched key is transient over
+        # persistent over default — never just this request's value
+        # (ClusterSettings precedence)
+        effective = {**self.settings_buckets["persistent"],
+                     **self.settings_buckets["transient"]}
+        self.cluster_settings.apply_update(
+            {k: effective.get(k) for k in touched})
         tmp = self._settings_file + ".tmp"
         with open(tmp, "w") as f:
             _json.dump(self.settings_buckets["persistent"], f)
@@ -173,6 +188,14 @@ class Node:
                    or os.environ.get("OSTPU_ENFORCE_BOOTSTRAP") == "1")
         run_bootstrap_checks(default_checks(self.data_path),
                              enforce=enforce)
+        if self.identity.enabled and self.host not in ("127.0.0.1",
+                                                       "localhost", "::1"):
+            import logging
+            logging.getLogger("opensearch_tpu.security").warning(
+                "identity.enabled is set with a non-loopback bind [%s] "
+                "and no TLS: basic-auth credentials travel in cleartext "
+                "(the reference's security plugin requires TLS here)",
+                self.host)
         self.http.start()
         # re-run persistent tasks that never completed (crash between
         # submit and completion); executors are idempotent

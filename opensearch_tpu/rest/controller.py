@@ -215,6 +215,21 @@ class RestController:
         r("PUT", "/{index}/_alias/{name}", self.h_put_alias)
         r("POST", "/{index}/_alias/{name}", self.h_put_alias)
         r("DELETE", "/{index}/_alias/{name}", self.h_delete_alias)
+        r("POST", "/{index}/_rollover", self.h_rollover)
+        r("POST", "/{index}/_rollover/{target}", self.h_rollover)
+        r("PUT", "/{index}/_shrink/{target}", self.h_resize_shrink)
+        r("POST", "/{index}/_shrink/{target}", self.h_resize_shrink)
+        r("PUT", "/{index}/_split/{target}", self.h_resize_split)
+        r("POST", "/{index}/_split/{target}", self.h_resize_split)
+        r("PUT", "/{index}/_clone/{target}", self.h_resize_clone)
+        r("POST", "/{index}/_clone/{target}", self.h_resize_clone)
+        r("GET", "/{index}/_recovery", self.h_recovery)
+        r("GET", "/_recovery", self.h_recovery)
+        r("PUT", "/_data_stream/{name}", self.h_create_data_stream)
+        r("GET", "/_data_stream", self.h_get_data_stream)
+        r("GET", "/_data_stream/{name}", self.h_get_data_stream)
+        r("DELETE", "/_data_stream/{name}", self.h_delete_data_stream)
+        r("POST", "/_cluster/reroute", self.h_reroute)
         r("PUT", "/_index_template/{name}", self.h_put_template)
         r("POST", "/_index_template/{name}", self.h_put_template)
         r("GET", "/_index_template", self.h_get_template)
@@ -295,6 +310,7 @@ class RestController:
         r("GET", "/{index}/_mapping", self.h_get_mapping)
         r("PUT", "/{index}/_mapping", self.h_put_mapping)
         r("GET", "/{index}/_settings", self.h_get_settings)
+        r("PUT", "/{index}/_settings", self.h_put_index_settings)
         r("GET", "/{index}/_stats", self.h_index_stats)
         r("POST", "/{index}/_refresh", self.h_refresh)
         r("GET", "/{index}/_refresh", self.h_refresh)
@@ -844,7 +860,7 @@ class RestController:
         r = svc.index_doc(doc_id, source, routing=req.param("routing"), **kw)
         forced = self._maybe_refresh(svc, req, doc_id=r.doc_id)
         status = 201 if r.result == "created" else 200
-        out = {"_index": name, "_id": r.doc_id,
+        out = {"_index": svc.name, "_id": r.doc_id,
                "_version": r.version, "_seq_no": r.seq_no,
                "_primary_term": 1, "result": r.result,
                "_shards": {"total": 1, "successful": 1, "failed": 0}}
@@ -1626,6 +1642,92 @@ class RestController:
         out["transient"] = _nest_settings(out["transient"])
         return 200, out
 
+    def h_put_index_settings(self, req):
+        """Dynamic per-index settings update (RestUpdateSettingsAction);
+        static settings like number_of_shards are rejected."""
+        body = req.json({}) or {}
+        updates = body.get("settings", body) or {}
+        from opensearch_tpu.common.settings import Settings
+        flat = Settings(updates).as_dict()
+        for svc in self.node.indices.resolve(req.path_params["index"]):
+            svc.update_settings(flat)
+        return 200, {"acknowledged": True}
+
+    def h_rollover(self, req):
+        body = req.json({}) or {}
+        if req.path_params.get("target"):
+            body["new_index"] = req.path_params["target"]
+        return 200, self.node.indices.rollover(
+            req.path_params["index"], body,
+            dry_run=req.flag("dry_run"))
+
+    def _h_resize(self, req, mode):
+        return 200, self.node.indices.resize(
+            req.path_params["index"], req.path_params["target"], mode,
+            req.json({}) or {})
+
+    def h_resize_shrink(self, req):
+        return self._h_resize(req, "shrink")
+
+    def h_resize_split(self, req):
+        return self._h_resize(req, "split")
+
+    def h_resize_clone(self, req):
+        return self._h_resize(req, "clone")
+
+    def h_recovery(self, req):
+        """Per-shard recovery report (indices/recovery/RecoveryState):
+        the array engine recovers locally from commit + translog, so
+        every started shard reports a DONE store recovery."""
+        out = {}
+        targets = (self.node.indices.resolve(req.path_params["index"])
+                   if req.path_params.get("index")
+                   else self.node.indices.indices.values())
+        for svc in targets:
+            shards = []
+            for engine in svc.shards:
+                shards.append({
+                    "id": engine.shard_id,
+                    "type": "STORE",
+                    "stage": "DONE",
+                    "primary": True,
+                    "source": {},
+                    "target": {"id": self.node.node_id,
+                               "name": self.node.name},
+                    "index": {"size": {}, "files": {}},
+                    "translog": {"recovered": 0, "total": 0,
+                                 "percent": "100.0%"},
+                })
+            out[svc.name] = {"shards": shards}
+        return 200, out
+
+    def h_create_data_stream(self, req):
+        return 200, self.node.indices.create_data_stream(
+            req.path_params["name"])
+
+    def h_get_data_stream(self, req):
+        return 200, self.node.indices.get_data_streams(
+            req.path_params.get("name"))
+
+    def h_delete_data_stream(self, req):
+        return 200, self.node.indices.delete_data_stream(
+            req.path_params["name"])
+
+    def h_reroute(self, req):
+        """Single-node reroute: validates command names; allocation
+        decisions are a no-op with one node (the decider chain lives in
+        cluster/state.allocate_shards for the multi-node path)."""
+        body = req.json({}) or {}
+        known = {"move", "cancel", "allocate_replica",
+                 "allocate_stale_primary", "allocate_empty_primary"}
+        for cmd in body.get("commands") or []:
+            ((name, _args),) = cmd.items()
+            if name not in known:
+                raise IllegalArgumentError(
+                    f"unknown reroute command [{name}]")
+        return 200, {"acknowledged": True,
+                     "state": {"cluster_name": self.node.cluster_name}}
+
     def h_update_aliases(self, req):
         body = req.json({}) or {}
         return 200, self.node.indices.update_aliases(
@@ -1953,7 +2055,8 @@ class RestController:
             svc.count(self._apply_alias_filter(
                 {"query": body.get("query")}, flt)["query"])
             for svc, flt in services)
+        n_shards = sum(svc.num_shards for svc, _f in services)
         return 200, {"count": total,
-                     "_shards": {"total": len(services),
-                                 "successful": len(services), "skipped": 0,
+                     "_shards": {"total": n_shards,
+                                 "successful": n_shards, "skipped": 0,
                                  "failed": 0}}

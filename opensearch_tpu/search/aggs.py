@@ -990,6 +990,10 @@ class AggregationExecutor:
         if not isinstance(specs, list) or len(specs) < 2:
             raise ParsingError(
                 "[multi_terms] requires at least two [terms] sources")
+        if _top_hits_subs(req):
+            raise IllegalArgumentError(
+                "[multi_terms] does not support [top_hits] "
+                "sub-aggregations (nest top_hits under terms or a filter)")
         fields = []
         for spec in specs:
             f = spec.get("field")
@@ -1085,8 +1089,19 @@ class AggregationExecutor:
         bucket/composite/CompositeAggregator.java).  Sources: terms,
         histogram, date_histogram."""
         sources = _composite_sources(req)
+        if _top_hits_subs(req):
+            raise IllegalArgumentError(
+                "[composite] does not support [top_hits] "
+                "sub-aggregations (nest top_hits under terms or a filter)")
         size = int(req.params.get("size", 10))
         after = req.params.get("after")
+        if after is not None:
+            missing_srcs = [name for name, _f, _x, _o, _k in sources
+                            if name not in after]
+            if missing_srcs:
+                raise ParsingError(
+                    f"[composite] after key is missing sources "
+                    f"{missing_srcs}")
         after_key = (tuple(after[name] for name, _f, _x, _o, _k in sources)
                      if after is not None else None)
         msubs = _metric_subs(req)

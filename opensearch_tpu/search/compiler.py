@@ -329,6 +329,7 @@ def _c_multi_match(q, ctx, scored):
             sub = dsl.MatchQuery(field=field, query=q.query,
                                  operator=q.operator,
                                  minimum_should_match=q.minimum_should_match,
+                                 lenient=getattr(q, "lenient", False),
                                  boost=q.boost * fboost)
             p, b = _c_match(sub, ctx, scored)
         if not isinstance(p, P.MatchNonePlan):
@@ -372,6 +373,15 @@ def _c_bool(q, ctx, scored):
 
 
 def _c_range(q, ctx, scored):
+    if getattr(q, "lenient", False):
+        try:
+            return _c_range_strict(q, ctx, scored)
+        except (OpenSearchTpuError, ValueError):
+            return _none()
+    return _c_range_strict(q, ctx, scored)
+
+
+def _c_range_strict(q, ctx, scored):
     ft = _require_ft(ctx, q.field, "range")
     if ft is None:
         return _none()

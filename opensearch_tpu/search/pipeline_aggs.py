@@ -596,8 +596,10 @@ def _apply_in_agg(req, node: dict):
         if sub.type in PARENT_TYPES:
             blist = _apply_parent(sub, blist)
     if keyed:
-        kept = {id(b) for b in blist}
-        for k in [k for k, b in buckets.items() if id(b) not in kept]:
-            del buckets[k]
+        # rebuild the keyed dict in the (possibly sorted/filtered)
+        # bucket order — JSON key order carries bucket_sort's result
+        by_id = {id(b): k for k, b in buckets.items()}
+        node["buckets"] = {by_id[id(b)]: b for b in blist
+                           if id(b) in by_id}
     else:
         node["buckets"] = blist

@@ -69,6 +69,7 @@ class MultiMatchQuery(Query):
     operator: str = "or"
     tie_breaker: float = 0.0
     minimum_should_match: Optional[str] = None
+    lenient: bool = False
 
 
 @dataclass
@@ -89,6 +90,7 @@ class RangeQuery(Query):
     lt: Any = None
     fmt: Optional[str] = None
     time_zone: Optional[str] = None
+    lenient: bool = False           # query_string lenient: bad bound -> none
 
 
 @dataclass
@@ -938,7 +940,7 @@ def _parse_query_string(body):
 def _mark_lenient(q):
     """lenient=true: type-mismatch clauses match nothing instead of
     erroring (QueryStringQueryParser.setLenient)."""
-    if isinstance(q, MatchQuery):
+    if isinstance(q, (MatchQuery, MultiMatchQuery, RangeQuery)):
         q.lenient = True
     elif isinstance(q, BoolQuery):
         for group in (q.must, q.should, q.must_not, q.filter):

@@ -136,6 +136,9 @@ class IdentityService:
             raise AuthenticationError("invalid basic auth header")
         user = self._users.get(name)
         if user is None:
+            # pay the full PBKDF2 cost for unknown users too, or response
+            # timing enumerates valid account names
+            _hash(password, b"\x00" * 16)
             raise AuthenticationError(
                 f"authentication failed for [{name}]")
         salt = bytes.fromhex(user["salt"])

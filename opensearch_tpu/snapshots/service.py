@@ -119,9 +119,16 @@ class SnapshotsService:
     """Node-level snapshot/restore orchestration over registered
     repositories.  ``indices_service`` is the node's IndicesService."""
 
-    def __init__(self, indices_service, data_path: str):
+    def __init__(self, indices_service, data_path: str,
+                 path_repo: Optional[list] = None):
         self.indices_service = indices_service
         self.data_path = data_path
+        # fs repositories may only live under these roots (the reference
+        # rejects locations outside path.repo —
+        # FsRepository/Environment.resolveRepoFile); default: the node's
+        # own data path
+        self.path_repo = [os.path.realpath(p)
+                          for p in (path_repo or [data_path])]
         self._repos: dict[str, Repository] = {}
         self._lock = threading.Lock()
         self._in_progress: set[str] = set()
@@ -156,7 +163,18 @@ class SnapshotsService:
         type_ = body.get("type")
         if not type_:
             raise ValidationError("repository [type] is required")
-        repo = Repository(name, type_, body.get("settings") or {})
+        settings = body.get("settings") or {}
+        if type_ == "fs":
+            loc = os.path.realpath(str(settings.get("location") or ""))
+            if not any(loc == root or loc.startswith(root + os.sep)
+                       for root in self.path_repo):
+                from opensearch_tpu.common.errors import (
+                    IllegalArgumentError)
+                raise IllegalArgumentError(
+                    f"location [{settings.get('location')}] doesn't "
+                    "match any of the locations specified by path.repo "
+                    f"{self.path_repo}")
+        repo = Repository(name, type_, settings)
         # verify: a write+read round trip (VerifyRepositoryAction analog)
         probe = f"verify-{int(time.time() * 1000)}"
         repo.root.write_blob(probe, b"ok")

@@ -37,6 +37,10 @@ from opensearch_tpu.version import TRANSPORT_PROTOCOL_VERSION
 MARKER = b"OT"
 STATUS_RESPONSE = 0x01
 STATUS_ERROR = 0x02
+STATUS_COMPRESSED = 0x04      # zlib body (TcpHeader's compressed flag)
+
+HANDSHAKE = "internal:tcp/handshake"
+COMPRESS_THRESHOLD = 1024     # bytes; small frames ship raw
 
 
 class ReceiveTimeoutError(OpenSearchTpuError):
@@ -49,17 +53,31 @@ class RemoteTransportError(OpenSearchTpuError):
 
 
 def encode_frame(req_id: int, status: int, action: str,
-                 payload: dict) -> bytes:
+                 payload: dict, version: int | None = None) -> bytes:
+    """``version`` is the NEGOTIATED protocol version for this peer
+    (TransportHandshaker); bodies above COMPRESS_THRESHOLD ship
+    zlib-compressed with the header flag set (TcpHeader.java:47-61)."""
+    import zlib
+
     out = StreamOutput()
-    out.write_vint(TRANSPORT_PROTOCOL_VERSION)
+    out.write_vint(version or TRANSPORT_PROTOCOL_VERSION)
     out.write_string(action)
     out.write_value(payload)
     body = out.bytes()
+    if len(body) > COMPRESS_THRESHOLD:
+        compressed = zlib.compress(body, 3)
+        if len(compressed) < len(body):
+            body = compressed
+            status |= STATUS_COMPRESSED
     return (MARKER + struct.pack(">IQB", len(body) + 9, req_id, status)
             + body)
 
 
-def decode_frame(body: bytes):
+def decode_frame(body: bytes, status: int = 0):
+    import zlib
+
+    if status & STATUS_COMPRESSED:
+        body = zlib.decompress(body)
     inp = StreamInput(body)
     version = inp.read_vint()
     inp.version = version
@@ -76,9 +94,54 @@ class TransportService:
         self._pending: dict[int, Future] = {}
         self._req_counter = 0
         self._lock = threading.Lock()
+        # target -> negotiated protocol version (TransportHandshaker's
+        # per-channel version); populated lazily on first contact
+        self._peer_versions: dict[str, int] = {}
         self._executor = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix=f"transport-{node_id}")
+        self.register_handler(HANDSHAKE, self._on_handshake)
         transport.bind(self)
+
+    def _on_handshake(self, payload: dict) -> dict:
+        theirs = int(payload.get("version", 1))
+        if theirs // 100 != TRANSPORT_PROTOCOL_VERSION // 100:
+            raise OpenSearchTpuError(
+                f"incompatible transport protocol: theirs [{theirs}] vs "
+                f"ours [{TRANSPORT_PROTOCOL_VERSION}] (major mismatch)")
+        return {"version": TRANSPORT_PROTOCOL_VERSION,
+                "node": self.node_id}
+
+    def negotiated_version(self, target: str, timeout: float = 5.0) -> int:
+        """Handshake once per peer: both sides speak
+        min(local, remote) afterwards; a major-version mismatch refuses
+        the connection (TransportHandshaker.java)."""
+        v = self._peer_versions.get(target)
+        if v is not None:
+            return v
+        fut = self.submit_request(target, HANDSHAKE,
+                                  {"version": TRANSPORT_PROTOCOL_VERSION,
+                                   "node": self.node_id})
+        try:
+            r = fut.result(timeout=timeout)
+            theirs = int(r.get("version", 1))
+        except RemoteTransportError as e:
+            if "no handler" in str(e):
+                # legacy peer without the handshake handler: assume the
+                # current build's version
+                theirs = TRANSPORT_PROTOCOL_VERSION
+            else:
+                raise  # incompatible peer: surface, don't cache
+        except Exception:  # noqa: BLE001 — unreachable peer: don't cache
+            theirs = TRANSPORT_PROTOCOL_VERSION
+        if theirs // 100 != TRANSPORT_PROTOCOL_VERSION // 100:
+            raise OpenSearchTpuError(
+                f"incompatible transport protocol with [{target}]: "
+                f"theirs [{theirs}] vs ours "
+                f"[{TRANSPORT_PROTOCOL_VERSION}]")
+        v = min(theirs, TRANSPORT_PROTOCOL_VERSION)
+        with self._lock:
+            self._peer_versions[target] = v
+        return v
 
     # -- registration -----------------------------------------------------
 
@@ -89,6 +152,8 @@ class TransportService:
 
     def submit_request(self, target: str, action: str,
                        payload: Optional[dict] = None) -> Future:
+        version = (self._peer_versions.get(target)
+                   if action != HANDSHAKE else TRANSPORT_PROTOCOL_VERSION)
         with self._lock:
             self._req_counter += 1
             req_id = self._req_counter
@@ -97,7 +162,8 @@ class TransportService:
         try:
             self.transport.send(self.node_id, target,
                                 encode_frame(req_id, 0, action,
-                                             payload or {}))
+                                             payload or {},
+                                             version=version))
         except Exception as e:
             with self._lock:
                 self._pending.pop(req_id, None)
@@ -127,7 +193,7 @@ class TransportService:
         """Called by the transport with one decoded frame body (after the
         length prefix)."""
         req_id, status = struct.unpack(">QB", frame[:9])
-        _version, action, payload = decode_frame(frame[9:])
+        _version, action, payload = decode_frame(frame[9:], status)
         if status & STATUS_RESPONSE:
             with self._lock:
                 fut = self._pending.pop(req_id, None)

@@ -11,5 +11,7 @@ __version__ = "0.1.0"
 INDEX_FORMAT_VERSION = 1
 # Bump when the translog record framing changes incompatibly.
 TRANSLOG_FORMAT_VERSION = 1
-# Wire protocol version for the node-to-node transport layer.
-TRANSPORT_PROTOCOL_VERSION = 1
+# Wire protocol version for the node-to-node transport layer:
+# major*100 + minor.  Handshakes negotiate min(local, remote) and refuse
+# a major mismatch (TransportHandshaker analog).
+TRANSPORT_PROTOCOL_VERSION = 101

@@ -1,0 +1,146 @@
+"""Admin-API tail: rollover, shrink/split/clone, recovery API, data
+streams, reroute (VERDICT r4 item 8; ref action/admin/indices/rollover/,
+shrink/, datastream/)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(str(tmp_path / "node"), port=0,
+             path_repo=[str(tmp_path)]).start()
+    yield n
+    n.stop()
+
+
+def call(node, method, path, body=None):
+    url = f"http://127.0.0.1:{node.port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type":
+                                          "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_rollover_write_alias(node):
+    call(node, "PUT", "/logs-000001",
+         {"aliases": {"logs": {"is_write_index": True}}})
+    for i in range(3):
+        call(node, "PUT", f"/logs/_doc/{i}?refresh=true", {"n": i})
+    # unmet condition -> not rolled
+    code, resp = call(node, "POST", "/logs/_rollover",
+                      {"conditions": {"max_docs": 100}})
+    assert code == 200 and resp["rolled_over"] is False
+    assert resp["new_index"] == "logs-000002"
+    # met condition -> rolled; writes flip to the new index
+    code, resp = call(node, "POST", "/logs/_rollover",
+                      {"conditions": {"max_docs": 3}})
+    assert resp["rolled_over"] is True
+    assert resp["old_index"] == "logs-000001"
+    code, resp = call(node, "PUT", "/logs/_doc/new?refresh=true",
+                      {"n": 9})
+    assert resp["_index"] == "logs-000002"
+    # the alias still searches BOTH indices
+    code, resp = call(node, "POST", "/logs/_search",
+                      {"query": {"match_all": {}}})
+    assert resp["hits"]["total"]["value"] == 4
+
+
+def test_rollover_requires_alias(node):
+    call(node, "PUT", "/plain", {})
+    code, resp = call(node, "POST", "/plain/_rollover", {})
+    assert code == 400
+
+
+@pytest.mark.parametrize("mode,src,tgt", [("shrink", 4, 2),
+                                          ("split", 2, 4),
+                                          ("clone", 3, 3)])
+def test_resize(node, mode, src, tgt):
+    call(node, "PUT", f"/src_{mode}",
+         {"settings": {"number_of_shards": src}})
+    for i in range(20):
+        call(node, "PUT", f"/src_{mode}/_doc/{i}", {"n": i})
+    call(node, "POST", f"/src_{mode}/_refresh")
+    # resize requires a write block
+    code, resp = call(node, "PUT",
+                      f"/src_{mode}/_{mode}/dst_{mode}",
+                      {"settings": {"number_of_shards": tgt}})
+    assert code == 400 and "blocks.write" in resp["error"]["reason"]
+    call(node, "PUT", f"/src_{mode}/_settings",
+         {"index.blocks.write": True})
+    code, resp = call(node, "PUT",
+                      f"/src_{mode}/_{mode}/dst_{mode}",
+                      {"settings": {"number_of_shards": tgt}})
+    assert code == 200, resp
+    code, resp = call(node, "GET", f"/dst_{mode}/_count")
+    assert resp["count"] == 20
+    assert resp["_shards"]["total"] == tgt
+    # every doc fetches by id from the re-routed target
+    code, resp = call(node, "GET", f"/dst_{mode}/_doc/7")
+    assert resp["_source"] == {"n": 7}
+
+
+def test_resize_invalid_factor(node):
+    call(node, "PUT", "/s3", {"settings": {"number_of_shards": 3}})
+    call(node, "PUT", "/s3/_settings", {"index.blocks.write": True})
+    code, resp = call(node, "PUT", "/s3/_shrink/s3small",
+                      {"settings": {"number_of_shards": 2}})
+    assert code == 400
+
+
+def test_recovery_api(node):
+    call(node, "PUT", "/r1", {"settings": {"number_of_shards": 2}})
+    code, resp = call(node, "GET", "/r1/_recovery")
+    assert code == 200
+    shards = resp["r1"]["shards"]
+    assert len(shards) == 2
+    assert all(s["stage"] == "DONE" for s in shards)
+
+
+def test_data_stream_lifecycle(node):
+    # needs a matching template with a data_stream section
+    code, resp = call(node, "PUT", "/_data_stream/metrics")
+    assert code == 400
+    call(node, "PUT", "/_index_template/metrics_t", {
+        "index_patterns": ["metrics*"], "data_stream": {}})
+    code, resp = call(node, "PUT", "/_data_stream/metrics")
+    assert code == 200
+    # writes land in the newest backing index
+    code, resp = call(node, "POST", "/metrics/_doc?refresh=true",
+                      {"@timestamp": "2023-05-01T00:00:00Z", "v": 1})
+    assert resp["_index"] == ".ds-metrics-000001"
+    # rollover creates generation 2; writes flip
+    code, resp = call(node, "POST", "/metrics/_rollover", {})
+    assert resp["new_index"] == ".ds-metrics-000002"
+    code, resp = call(node, "POST", "/metrics/_doc?refresh=true",
+                      {"@timestamp": "2023-05-02T00:00:00Z", "v": 2})
+    assert resp["_index"] == ".ds-metrics-000002"
+    # search spans all generations
+    code, resp = call(node, "POST", "/metrics/_search",
+                      {"query": {"match_all": {}}})
+    assert resp["hits"]["total"]["value"] == 2
+    code, resp = call(node, "GET", "/_data_stream/metrics")
+    ds = resp["data_streams"][0]
+    assert ds["generation"] == 2 and len(ds["indices"]) == 2
+    # delete removes backing indices
+    code, resp = call(node, "DELETE", "/_data_stream/metrics")
+    assert code == 200
+    assert call(node, "GET", "/.ds-metrics-000001")[0] == 404
+
+
+def test_reroute_validates_commands(node):
+    code, _ = call(node, "POST", "/_cluster/reroute",
+                   {"commands": [{"move": {"index": "x", "shard": 0}}]})
+    assert code == 200
+    code, _ = call(node, "POST", "/_cluster/reroute",
+                   {"commands": [{"explode": {}}]})
+    assert code == 400

@@ -9,7 +9,7 @@ from opensearch_tpu.node import Node
 
 @pytest.fixture()
 def client(tmp_path):
-    node = Node(str(tmp_path / "node"), port=0).start()
+    node = Node(str(tmp_path / "node"), port=0, path_repo=[str(tmp_path)]).start()
     yield OpenSearch(hosts=[{"host": "127.0.0.1", "port": node.port}])
     node.stop()
 

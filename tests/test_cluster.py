@@ -350,3 +350,50 @@ def test_full_cluster_restart_survives(tmp_path):
     assert nodes["n1"].get_doc("persisted", "new") is not None
     for n in nodes.values():
         n.stop()
+
+
+def test_ops_based_recovery_via_retention_lease(tmp_path):
+    """A replica that briefly fell behind recovers by op replay (no
+    segment file copy) because the primary holds its retention lease."""
+    hub = LocalTransport.Hub()
+    svc_by = {}
+    ids = ["rl0", "rl1"]
+    nodes = {}
+    for nid in ids:
+        svc = TransportService(nid, LocalTransport(hub))
+        svc_by[nid] = svc
+        nodes[nid] = ClusterNode(nid, str(tmp_path / nid), svc, ids)
+    try:
+        assert nodes["rl0"].coordinator.start_election()
+        nodes["rl0"].create_index("idx", {"settings": {
+            "number_of_shards": 1, "number_of_replicas": 1}})
+        for i in range(4):
+            nodes["rl0"].index_doc("idx", f"d{i}", {"n": i})
+        wait_until(lambda: ("idx", 0) in nodes["rl1"]._recovered)
+        primary_engine = nodes["rl0"].indices.get("idx").engine_for(0)
+        assert "rl1" in primary_engine.get_retention_leases()
+        # replica misses two ops (drop its inbound replication)
+        hub.disconnect("rl1")
+        nodes["rl0"].index_doc("idx", "d4", {"n": 4})
+        nodes["rl0"].index_doc("idx", "d5", {"n": 5})
+        hub.clear_rules()
+        # re-run recovery: it must take the ops path
+        calls = {}
+        orig = nodes["rl0"]._h_start_recovery
+
+        def spy(payload):
+            r = orig(payload)
+            calls["mode"] = r.get("mode", "files")
+            return r
+        nodes["rl0"]._h_start_recovery = spy
+        svc_by["rl0"].register_handler(
+            "internal:index/shard/recovery/start", spy)
+        nodes["rl1"]._recovered.discard(("idx", 0))
+        nodes["rl1"]._run_recovery("idx", 0, "rl0")
+        assert calls["mode"] == "ops"
+        rep = nodes["rl1"].indices.get("idx").engine_for(0)
+        assert rep.get("d5")["_source"] == {"n": 5}
+        assert rep._seq_no == primary_engine._seq_no
+    finally:
+        for n in nodes.values():
+            n.stop()

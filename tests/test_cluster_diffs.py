@@ -1,0 +1,168 @@
+"""Cluster-state diff publication + dynamic voting reconfiguration
+(VERDICT r4 item 8; ref cluster/Diff.java, DiffableUtils.java,
+cluster/coordination/Reconfigurator.java)."""
+
+import json
+
+import pytest
+
+from opensearch_tpu.cluster.coordination import Coordinator, Mode
+from opensearch_tpu.cluster.state import (ClusterState, apply_diff,
+                                          diff_states)
+from opensearch_tpu.transport.service import LocalTransport, TransportService
+
+
+def make_cluster(n=3, check_retries=2):
+    hub = LocalTransport.Hub()
+    ids = [f"node_{i}" for i in range(n)]
+    coords = {}
+    for node_id in ids:
+        svc = TransportService(node_id, LocalTransport(hub))
+        coords[node_id] = Coordinator(node_id, svc, voting_nodes=ids,
+                                      node_info={"name": node_id},
+                                      check_retries=check_retries)
+    return hub, ids, coords
+
+
+def teardown(coords):
+    for c in coords.values():
+        c.stop()
+        c.transport.close()
+
+
+def big_state(n_indices=200):
+    indices = {f"idx_{i}": {"settings": {"number_of_shards": 3},
+                            "mappings": {"properties": {
+                                "f": {"type": "keyword"}}}}
+               for i in range(n_indices)}
+    routing = {f"idx_{i}": [{"shard": s, "primary": "node_0",
+                             "replicas": [], "in_sync": ["node_0"],
+                             "primary_term": 1}
+                            for s in range(3)] for i in range(n_indices)}
+    return ClusterState(term=3, version=10, master_node="node_0",
+                        nodes={"node_0": {"name": "node_0"}},
+                        indices=indices, routing=routing,
+                        voting=("node_0",))
+
+
+def test_diff_roundtrip_and_size():
+    old = big_state()
+    # one index changes, one is added, one removed
+    indices = dict(old.indices)
+    indices["idx_0"] = {"settings": {"number_of_shards": 3,
+                                     "refresh_interval": -1},
+                        "mappings": indices["idx_0"]["mappings"]}
+    indices["brand_new"] = {"settings": {}, "mappings": {}}
+    del indices["idx_7"]
+    new = old.with_(version=11, indices=indices)
+    d = diff_states(old, new)
+    rebuilt = apply_diff(old, d)
+    assert rebuilt.to_payload() == new.to_payload()
+    # the wire win: the diff is a small fraction of the full state
+    full_bytes = len(json.dumps(new.to_payload()))
+    diff_bytes = len(json.dumps(d))
+    assert diff_bytes < full_bytes / 10, (diff_bytes, full_bytes)
+
+
+def test_diff_base_mismatch_detected():
+    old = big_state()
+    new = old.with_(version=11)
+    d = diff_states(old, new)
+    assert (d["base_term"], d["base_version"]) == (old.term, old.version)
+
+
+def test_publication_uses_diffs_with_full_fallback():
+    hub, ids, coords = make_cluster()
+    try:
+        assert coords["node_0"].start_election()
+        leader = coords["node_0"]
+        # capture the wire: count diff vs full publishes
+        seen = {"diff": 0, "full": 0}
+        orig = leader.transport.send_request
+
+        def spy(target, action, payload, **kw):
+            if action.endswith("publish"):
+                seen["diff" if "diff" in payload else "full"] += 1
+            return orig(target, action, payload, **kw)
+        leader.transport.send_request = spy
+        leader.submit_state_update(
+            lambda s: s.with_(indices={**s.indices,
+                                       "a": {"settings": {},
+                                             "mappings": {}}}))
+        assert seen["diff"] >= 2 and seen["full"] == 0
+        # a fresh node (no accepted state) forces the full fallback
+        svc = TransportService("node_3", LocalTransport(hub))
+        coords["node_3"] = Coordinator("node_3", svc,
+                                       voting_nodes=ids,
+                                       node_info={"name": "node_3"})
+        seen["diff"] = seen["full"] = 0
+        leader.add_node("node_3", {"name": "node_3"})
+        assert seen["full"] >= 1          # node_3 needed the full state
+        assert coords["node_3"].state().version == \
+            leader.state().version
+    finally:
+        teardown(coords)
+
+
+def test_voting_config_grows_and_shrinks():
+    hub, ids, coords = make_cluster(3)
+    try:
+        assert coords["node_0"].start_election()
+        leader = coords["node_0"]
+        assert set(leader.state().voting) == set(ids)
+        # two more master-eligible nodes join -> config grows to 5
+        for nid in ("node_3", "node_4"):
+            svc = TransportService(nid, LocalTransport(hub))
+            coords[nid] = Coordinator(nid, svc, voting_nodes=ids,
+                                      node_info={"name": nid})
+            leader.add_node(nid, {"name": nid})
+        assert len(leader.state().voting) == 5
+        # one leaves -> trimmed back to an odd size (never even)
+        leader.remove_node("node_4")
+        assert len(leader.state().voting) % 2 == 1
+        assert "node_4" not in leader.state().voting
+    finally:
+        teardown(coords)
+
+
+def test_replace_a_voting_node():
+    """Planned node replacement: add the replacement, remove the old
+    voter, and the cluster keeps committing — the scenario a static
+    voting config cannot survive (VERDICT r4 missing #7)."""
+    hub, ids, coords = make_cluster(3)
+    try:
+        assert coords["node_0"].start_election()
+        leader = coords["node_0"]
+        svc = TransportService("node_9", LocalTransport(hub))
+        coords["node_9"] = Coordinator("node_9", svc, voting_nodes=ids,
+                                       node_info={"name": "node_9"})
+        leader.add_node("node_9", {"name": "node_9"})
+        leader.remove_node("node_2")
+        hub.disconnect("node_2")                   # old voter is gone
+        assert set(leader.state().voting) == {"node_0", "node_1",
+                                              "node_9"}
+        # the reconfigured cluster still commits with the NEW quorum
+        leader.submit_state_update(
+            lambda s: s.with_(indices={**s.indices,
+                                       "post": {"settings": {},
+                                                "mappings": {}}}))
+        assert "post" in leader.state().indices
+        assert "post" in coords["node_9"].state().indices
+    finally:
+        teardown(coords)
+
+
+def test_even_config_trims_to_odd():
+    hub, ids, coords = make_cluster(3)
+    try:
+        assert coords["node_0"].start_election()
+        leader = coords["node_0"]
+        svc = TransportService("node_3", LocalTransport(hub))
+        coords["node_3"] = Coordinator("node_3", svc, voting_nodes=ids,
+                                       node_info={"name": "node_3"})
+        leader.add_node("node_3", {"name": "node_3"})
+        # 4 eligible nodes -> 3 voters, leader always kept
+        voting = leader.state().voting
+        assert len(voting) == 3 and "node_0" in voting
+    finally:
+        teardown(coords)

@@ -14,7 +14,7 @@ from opensearch_tpu.node import Node
 
 @pytest.fixture()
 def node(tmp_path):
-    n = Node(str(tmp_path / "node"), port=0).start()
+    n = Node(str(tmp_path / "node"), port=0, path_repo=[str(tmp_path)]).start()
     yield n
     n.stop()
 
@@ -35,7 +35,7 @@ def call(node, method, path, body=None):
 
 
 def test_remote_store_mirror_and_restore(tmp_path):
-    node = Node(str(tmp_path / "node"), port=0).start()
+    node = Node(str(tmp_path / "node"), port=0, path_repo=[str(tmp_path)]).start()
     call(node, "PUT", "/_snapshot/mirror", {
         "type": "fs", "settings": {"location": str(tmp_path / "repo")}})
     code, _ = call(node, "PUT", "/rsidx", {
@@ -61,7 +61,7 @@ def test_remote_store_mirror_and_restore(tmp_path):
     # loss, not intentional deletion)
     node.stop()
     shutil.rmtree(tmp_path / "node" / "indices" / "rsidx")
-    node = Node(str(tmp_path / "node"), port=0).start()
+    node = Node(str(tmp_path / "node"), port=0, path_repo=[str(tmp_path)]).start()
     code, _ = call(node, "POST", "/rsidx/_count")
     assert code == 404
 

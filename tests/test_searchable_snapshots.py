@@ -58,7 +58,7 @@ def test_file_cache_oversized_entry_and_warm_restart(tmp_path):
 
 @pytest.fixture()
 def mounted(tmp_path):
-    node = Node(str(tmp_path / "node"), port=0).start()
+    node = Node(str(tmp_path / "node"), port=0, path_repo=[str(tmp_path)]).start()
     call(node, "PUT", "/_snapshot/repo", {
         "type": "fs", "settings": {"location": str(tmp_path / "repo")}})
     call(node, "PUT", "/src", {
@@ -133,7 +133,7 @@ def test_mount_survives_restart_and_eviction(mounted):
     node.stop()
     import shutil
     shutil.rmtree(tmp_path / "node" / "filecache")
-    node2 = Node(str(tmp_path / "node"), port=0).start()
+    node2 = Node(str(tmp_path / "node"), port=0, path_repo=[str(tmp_path)]).start()
     try:
         code, body = call(node2, "GET", "/mounted/_search",
                           body={"size": 25})
@@ -153,7 +153,7 @@ def test_mount_missing_repo_does_not_block_boot(mounted):
     import shutil
     shutil.rmtree(tmp_path / "repo")
     shutil.rmtree(tmp_path / "node" / "filecache")
-    node2 = Node(str(tmp_path / "node"), port=0).start()
+    node2 = Node(str(tmp_path / "node"), port=0, path_repo=[str(tmp_path)]).start()
     try:
         assert call(node2, "GET", "/_cluster/health")[0] == 200
         assert call(node2, "GET", "/mounted/_search", body={})[0] == 404
@@ -194,7 +194,7 @@ def test_mount_blocks_mapping_updates(mounted):
 def test_mount_larger_than_cache_budget(tmp_path):
     """A mount whose file set exceeds the cache budget still opens (over
     budget while pinned) and searches correctly."""
-    node = Node(str(tmp_path / "node"), port=0).start()
+    node = Node(str(tmp_path / "node"), port=0, path_repo=[str(tmp_path)]).start()
     try:
         call(node, "PUT", "/_snapshot/r", {
             "type": "fs", "settings": {"location": str(tmp_path / "r")}})

@@ -14,7 +14,7 @@ from opensearch_tpu.node import Node
 
 @pytest.fixture()
 def node(tmp_path):
-    n = Node(str(tmp_path / "node"), port=0).start()
+    n = Node(str(tmp_path / "node"), port=0, path_repo=[str(tmp_path)]).start()
     yield n
     n.stop()
 
@@ -148,3 +148,28 @@ def test_snapshot_error_shapes(node, tmp_path):
     # fs repo without location
     code, resp = call(node, "PUT", "/_snapshot/noloc", {"type": "fs"})
     assert code == 500 or code == 400
+
+
+def test_fs_repo_location_outside_path_repo_rejected(node, tmp_path):
+    """ADVICE r4: arbitrary fs locations are rejected unless under a
+    path.repo root (Environment.resolveRepoFile analog)."""
+    code, resp = call(node, "PUT", "/_snapshot/evil", {
+        "type": "fs", "settings": {"location": "/etc/cron.d"}})
+    assert code == 400
+    assert "path.repo" in resp["error"]["reason"]
+    # traversal out of an allowed root is caught by realpath resolution
+    code, _ = call(node, "PUT", "/_snapshot/sneaky", {
+        "type": "fs",
+        "settings": {"location": str(tmp_path) + "/../outside"}})
+    assert code == 400
+
+
+def test_manifest_file_name_validation():
+    from opensearch_tpu.index.remote_store import validate_manifest_name
+    import pytest as _pytest
+    from opensearch_tpu.common.errors import IllegalArgumentError
+
+    assert validate_manifest_name("seg_0.npz") == "seg_0.npz"
+    for bad in ("../../x", "a/b", ".hidden", ""):
+        with _pytest.raises(IllegalArgumentError):
+            validate_manifest_name(bad)

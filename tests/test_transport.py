@@ -162,3 +162,76 @@ def test_tcp_peer_down():
     with pytest.raises((NodeDisconnectedError, ReceiveTimeoutError)):
         a.send_request("node_b", "echo", {}, timeout=1.0)
     a.close()
+
+
+def test_handshake_negotiates_min_version():
+    """TransportHandshaker analog: both sides speak the min version and
+    the result is cached per peer."""
+    from opensearch_tpu.transport.service import (HANDSHAKE,
+                                                  LocalTransport,
+                                                  TransportService)
+    from opensearch_tpu.version import TRANSPORT_PROTOCOL_VERSION
+
+    hub = LocalTransport.Hub()
+    a = TransportService("a", LocalTransport(hub))
+    b = TransportService("b", LocalTransport(hub))
+    try:
+        assert a.negotiated_version("b") == TRANSPORT_PROTOCOL_VERSION
+        assert a._peer_versions["b"] == TRANSPORT_PROTOCOL_VERSION
+        # a peer one minor behind negotiates down
+        b._handlers[HANDSHAKE] = lambda p: {
+            "version": TRANSPORT_PROTOCOL_VERSION - 1, "node": "b"}
+        a._peer_versions.clear()
+        assert a.negotiated_version("b") == TRANSPORT_PROTOCOL_VERSION - 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_handshake_rejects_major_mismatch():
+    from opensearch_tpu.common.errors import OpenSearchTpuError
+    from opensearch_tpu.transport.service import (HANDSHAKE,
+                                                  LocalTransport,
+                                                  TransportService)
+    from opensearch_tpu.version import TRANSPORT_PROTOCOL_VERSION
+
+    hub = LocalTransport.Hub()
+    a = TransportService("a", LocalTransport(hub))
+    b = TransportService("b", LocalTransport(hub))
+    try:
+        b._handlers[HANDSHAKE] = lambda p: {
+            "version": TRANSPORT_PROTOCOL_VERSION + 100, "node": "b"}
+        with pytest.raises(OpenSearchTpuError):
+            a.negotiated_version("b")
+        assert "b" not in a._peer_versions    # incompatibility not cached
+    finally:
+        a.close()
+        b.close()
+
+
+def test_large_frames_compress_on_the_wire():
+    """Bodies above the threshold ship zlib-compressed with the header
+    flag set, transparently to handlers (TcpHeader compressed flag)."""
+    import struct as _struct
+    import zlib as _zlib
+
+    from opensearch_tpu.transport.service import (STATUS_COMPRESSED,
+                                                  LocalTransport,
+                                                  TransportService,
+                                                  encode_frame)
+
+    big = {"blob": "x" * 50_000}
+    frame = encode_frame(7, 0, "test/echo", big)
+    _req, status = _struct.unpack(">QB", frame[6:15])
+    assert status & STATUS_COMPRESSED
+    assert len(frame) < 5_000        # 50k of 'x' compresses hard
+    # round trip through a live pair
+    hub = LocalTransport.Hub()
+    a = TransportService("a", LocalTransport(hub))
+    b = TransportService("b", LocalTransport(hub))
+    try:
+        b.register_handler("test/echo", lambda p: p)
+        assert a.send_request("b", "test/echo", big) == big
+    finally:
+        a.close()
+        b.close()
