@@ -164,6 +164,10 @@ class Segment:
         # local -> custom routing value (only docs indexed with one; the
         # reference stores _routing as a stored field)
         self.routings: dict[int, str] = {}
+        # completion field -> {(local, input): weight} — per-INPUT
+        # suggestion weights (CompletionFieldMapper stores weight per
+        # entry in the FST)
+        self.completion_weights: dict[str, dict] = {}
         self.postings: dict[str, PostingsField] = {}
         self.numeric_dv: dict[str, NumericDV] = {}
         self.ordinal_dv: dict[str, OrdinalDV] = {}
@@ -448,6 +452,12 @@ class SegmentWriter:
             seg.versions[i] = doc.version
             if doc.routing is not None:
                 seg.routings[i] = doc.routing
+            for cfield, entries in doc.completions.items():
+                wmap = seg.completion_weights.setdefault(cfield, {})
+                for text, weight in entries:
+                    key = (i, text)
+                    if weight > wmap.get(key, 0):
+                        wmap[key] = weight
             for fname, toks in doc.tokens.items():
                 per_term: dict[str, tuple[int, list[int]]] = {}
                 for term, pos in toks:

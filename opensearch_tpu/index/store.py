@@ -41,6 +41,10 @@ def _segment_encode(seg: Segment):
     meta = {"seg_id": seg.seg_id, "n_docs": seg.n_docs,
             "doc_ids": seg.doc_ids,
             "routings": {str(k): v for k, v in seg.routings.items()},
+            "completion_weights": {
+                f: {f"{local}\x00{text}": w
+                    for (local, text), w in wmap.items()}
+                for f, wmap in seg.completion_weights.items()},
             "postings": {}, "numeric": {}, "ordinal": {}, "vector": {},
             "geo": {}, "nested": {}}
 
@@ -183,6 +187,12 @@ def _segment_decode(seg_id: str, meta: dict, z, src_blob: bytes) -> Segment:
     seg.id_to_local = {d: i for i, d in enumerate(seg.doc_ids)}
     seg.routings = {int(k): v
                     for k, v in (meta.get("routings") or {}).items()}
+    for f, wmap in (meta.get("completion_weights") or {}).items():
+        out = {}
+        for key, w in wmap.items():
+            local, _, text = key.partition("\x00")
+            out[(int(local), text)] = w
+        seg.completion_weights[f] = out
     seg.seq_nos = z["seq_nos"]
     seg.versions = z["versions"]
     seg.live = z["live"].copy()

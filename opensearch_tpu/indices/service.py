@@ -631,6 +631,12 @@ class IndexService:
         aggs_json = body.get("aggs") or body.get("aggregations")
         if not aggs_json and not body.get("suggest"):
             return ms.search(body)
+        if (aggs_json and not body.get("suggest")
+                and int(body.get("size", 10)) == 0
+                and ms.supports_mesh_aggs(aggs_json)):
+            # the metric-agg family reduces ON the mesh (one ICI
+            # collective), never serializing per-shard partials
+            return ms.mesh_metric_aggs(body, aggs_json)
         # device-collective top-k + host-side per-shard partial collect,
         # reduced exactly like the cross-node coordinator (the agg columns
         # are host/default-device resident; the mesh carries the scored

@@ -56,6 +56,9 @@ class ParsedDocument:
     vectors: dict[str, list[float]] = dc_field(default_factory=dict)
     geo_points: dict[str, list[tuple[float, float]]] = dc_field(default_factory=dict)
     field_lengths: dict[str, int] = dc_field(default_factory=dict)  # for BM25 norms
+    # completion field -> [(input, weight)] — weights are PER INPUT
+    completions: dict[str, list[tuple[str, int]]] = dc_field(
+        default_factory=dict)
     # nested path -> [per-object {child_path: ("num"|"ord", [values])}]
     nested: dict[str, list[dict]] = dc_field(default_factory=dict)
 
@@ -347,7 +350,28 @@ class DocumentMapper:
             raise MapperParsingError(
                 f"field [{ft.name}] of type [{ft.type_name}] does not "
                 "support arrays")
-        from opensearch_tpu.mapping.types import JoinFieldType
+        from opensearch_tpu.mapping.types import (CompletionFieldType,
+                                                  JoinFieldType)
+        if isinstance(ft, CompletionFieldType):
+            # {"input": [...], "weight": n} | "text" | ["a", "b"]:
+            # inputs land in the sorted ordinal column (the prefix
+            # range), weights stay PER INPUT in a dedicated structure
+            # (CompletionFieldMapper.parse keeps weight per entry)
+            for v in values:
+                if v is None:
+                    continue
+                if isinstance(v, dict):
+                    inputs = v.get("input") or []
+                    if isinstance(inputs, str):
+                        inputs = [inputs]
+                    weight = int(v.get("weight", 1))
+                else:
+                    inputs, weight = [str(v)], 1
+                for text in inputs:
+                    doc.ordinals.setdefault(ft.name, []).append(str(text))
+                    doc.completions.setdefault(ft.name, []).append(
+                        (str(text), weight))
+            return
         if isinstance(ft, JoinFieldType):
             # join values land in the hidden #name / #parent ordinal
             # columns (ParentJoinFieldMapper's joinField + parentIdField)

@@ -473,6 +473,23 @@ class JoinFieldType(FieldType):
         return []
 
 
+class CompletionFieldType(FieldType):
+    """Prefix completion (suggest/completion/CompletionFieldMapper).
+    Inputs live in the segment's SORTED ordinal column, so a prefix is a
+    binary-searched ordinal range — the array-native stand-in for the
+    reference's FST; weights ride a parallel numeric column."""
+
+    type_name = "completion"
+    dv_kind = "ordinal"
+    indexed = False
+
+    def doc_value(self, value):
+        return str(value)
+
+    def index_terms(self, value, analyzers):
+        return []
+
+
 class ObjectFieldType(FieldType):
     """Explicit ``type: object`` container: no terms/doc-values of its
     own — its sub-fields are mapped flattened as ``parent.child``
@@ -541,7 +558,7 @@ FIELD_TYPES = {
         HalfFloatFieldType, ScaledFloatFieldType, BooleanFieldType,
         DateFieldType, IpFieldType, DenseVectorFieldType, GeoPointFieldType,
         BinaryFieldType, UnsignedLongFieldType, ObjectFieldType,
-        JoinFieldType,
+        JoinFieldType, CompletionFieldType,
     ]
 }
 FIELD_TYPES["knn_vector"] = DenseVectorFieldType

@@ -176,6 +176,61 @@ class Suggester:
                  "options": options[:size]}]
 
 
+def completion_suggest(ctx, prefix: str, spec: dict) -> list[dict]:
+    """Prefix completion over the sorted ordinal column — a
+    binary-searched range per segment instead of an FST walk
+    (suggest/completion/CompletionSuggester.java), merged by best
+    weight across segments."""
+    import bisect
+
+    field = spec.get("field")
+    if not field:
+        raise ParsingError("[completion] requires a [field]")
+    size = int(spec.get("size", 5))
+    skip_dup = bool(spec.get("skip_duplicates", False))
+    best: dict[str, tuple] = {}      # input -> (weight, doc_id)
+    for seg in ctx.segments:
+        dv = seg.ordinal_dv.get(field)
+        if dv is None or not dv.ord_terms:
+            continue
+        # ord -> docs, built once per (immutable) segment+field
+        cache = getattr(seg, "_completion_cache", None)
+        if cache is None:
+            cache = seg._completion_cache = {}
+        docs_of = cache.get(field)
+        if docs_of is None:
+            docs_of = {}
+            for d, o in zip(dv.value_docs, dv.ords):
+                if o >= 0:
+                    docs_of.setdefault(int(o), []).append(int(d))
+            cache[field] = docs_of
+        weights = seg.completion_weights.get(field, {})
+        lo = bisect.bisect_left(dv.ord_terms, prefix)
+        for o in range(lo, len(dv.ord_terms)):
+            text = dv.ord_terms[o]
+            if not text.startswith(prefix):
+                break
+            for d in docs_of.get(o, ()):
+                if not seg.live[d]:
+                    continue
+                w = weights.get((d, text), 1)
+                cur = best.get(text)
+                if cur is None or w > cur[0]:
+                    best[text] = (w, seg.doc_ids[d])
+    ranked = sorted(best.items(), key=lambda kv: (-kv[1][0], kv[0]))
+    seen_docs: set = set()
+    options = []
+    for text, (w, doc_id) in ranked:
+        if skip_dup and doc_id in seen_docs:
+            continue
+        seen_docs.add(doc_id)
+        options.append({"text": text, "_id": doc_id, "_score": float(w)})
+        if len(options) >= size:
+            break
+    return [{"text": prefix, "offset": 0, "length": len(prefix),
+             "options": options}]
+
+
 def run_suggest(suggest_json: dict, ctx) -> dict:
     """The search body's ``suggest`` section -> response ``suggest``
     object (SearchService's suggest phase)."""
@@ -187,6 +242,14 @@ def run_suggest(suggest_json: dict, ctx) -> dict:
             continue
         if not isinstance(body, dict):
             raise ParsingError(f"suggester [{name}] must be an object")
+        if "completion" in body:
+            prefix = body.get("prefix", body.get("text", global_text))
+            if prefix is None:
+                raise ParsingError(
+                    f"suggester [{name}] requires [prefix]")
+            out[name] = completion_suggest(ctx, str(prefix),
+                                           body["completion"])
+            continue
         text = body.get("text", global_text)
         if text is None:
             raise ParsingError(f"suggester [{name}] requires [text]")
@@ -196,7 +259,8 @@ def run_suggest(suggest_json: dict, ctx) -> dict:
             out[name] = s.phrase_suggest(text, body["phrase"])
         else:
             raise ParsingError(
-                f"suggester [{name}] must be [term] or [phrase]")
+                f"suggester [{name}] must be [term], [phrase] or "
+                "[completion]")
     return out
 
 

@@ -1,0 +1,70 @@
+"""Perf-regression guard (VERDICT r4 item 3: r03->r04 silently lost 22%
+and batched fell below sequential with no gate).  Absolute QPS is
+machine-dependent, so the guard checks the INVARIANT that regressed: a
+64-query msearch batch must not be slower than the same queries run
+sequentially — the union-of-terms kernel amortizes every per-query cost,
+so an inversion means a recompile/staging bug crept back in."""
+
+import time
+
+import numpy as np
+import pytest
+
+import bench
+from opensearch_tpu.mapping.mapper import DocumentMapper
+from opensearch_tpu.search.executor import ShardSearcher
+
+
+@pytest.mark.slow
+def test_batched_not_slower_than_sequential():
+    raw = bench.build_raw_corpus(20_000)
+    seg = bench.make_segment(raw)
+    mapper = DocumentMapper({"properties": {"body": {"type": "text"}}})
+    s = ShardSearcher([seg], mapper, index_name="bench")
+    pairs = bench.gen_query_terms(128)
+    queries = [{"query": {"match": {"body": f"t{a} t{b}"}}, "size": 10}
+               for a, b in pairs]
+    # warm both paths (compiles out of the measurement)
+    for i in range(0, 128, 64):
+        s.msearch(queries[i: i + 64])
+    for q in queries[:16]:
+        s.search(q)
+
+    t0 = time.monotonic()
+    for _ in range(2):
+        for i in range(0, 128, 64):
+            s.msearch(queries[i: i + 64])
+    batched_qps = 256 / (time.monotonic() - t0)
+
+    t0 = time.monotonic()
+    for q in queries[:64]:
+        s.search(q)
+    seq_qps = 64 / (time.monotonic() - t0)
+
+    # generous 0.8x floor absorbs machine noise while still catching the
+    # r4-style inversion (batched was 2.7x SLOWER then)
+    assert batched_qps >= 0.8 * seq_qps, (
+        f"batched msearch regressed below sequential: "
+        f"{batched_qps:.1f} vs {seq_qps:.1f} qps")
+
+
+def test_batched_single_program_per_batch():
+    """The union kernel must stay ONE compile per (q_pad, t_pad, budget)
+    — per-query budget bucketing (the r4 compile explosion) would show
+    up as many cache entries."""
+    from opensearch_tpu.search import batch as batch_mod
+
+    raw = bench.build_raw_corpus(5_000)
+    seg = bench.make_segment(raw)
+    mapper = DocumentMapper({"properties": {"body": {"type": "text"}}})
+    s = ShardSearcher([seg], mapper, index_name="bench")
+    pairs = bench.gen_query_terms(64)
+    queries = [{"query": {"match": {"body": f"t{a} t{b}"}}, "size": 10}
+               for a, b in pairs]
+    before = batch_mod.batch_bm25_union_topk._cache_size()
+    s.msearch(queries)
+    s.msearch(queries)          # identical batch: no new programs
+    after = batch_mod.batch_bm25_union_topk._cache_size()
+    assert after - before <= 1, (
+        f"one 64-query batch compiled {after - before} programs "
+        "(per-query budget bucketing is back?)")

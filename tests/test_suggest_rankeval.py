@@ -138,3 +138,74 @@ def test_rank_eval_metrics(node):
     code, _ = call(node, "POST", "/books/_rank_eval", {
         **reqs, "metric": {"made_up": {}}})
     assert code == 400
+
+
+def test_completion_suggester():
+    """completion field + prefix suggest vs a plain oracle
+    (CompletionSuggester / CompletionFieldMapper analog)."""
+    from opensearch_tpu.index.segment import SegmentWriter
+    from opensearch_tpu.mapping.mapper import DocumentMapper
+    from opensearch_tpu.search.executor import ShardSearcher
+
+    mapper = DocumentMapper({"properties": {
+        "sug": {"type": "completion"}, "title": {"type": "keyword"}}})
+    w = SegmentWriter()
+    docs = [
+        ("1", {"sug": {"input": ["trial", "trying"], "weight": 10},
+               "title": "a"}),
+        ("2", {"sug": {"input": ["tried"], "weight": 5}, "title": "b"}),
+        ("3", {"sug": "trick", "title": "c"}),
+        ("4", {"sug": {"input": ["other"], "weight": 99}, "title": "d"}),
+    ]
+    segs = []
+    for si in range(2):
+        parsed = [mapper.parse(i, s) for i, s in docs[si::2]]
+        segs.append(w.build(parsed, f"s{si}"))
+    s = ShardSearcher(segs, mapper)
+    resp = s.search({"suggest": {
+        "c": {"prefix": "tri", "completion": {"field": "sug"}}}})
+    entry = resp["suggest"]["c"][0]
+    assert entry["text"] == "tri" and entry["length"] == 3
+    opts = entry["options"]
+    # weight-desc, prefix-only ("trying" starts with "try", not "tri"):
+    # trial (10) > tried (5) > trick (1)
+    assert [o["text"] for o in opts] == ["trial", "tried", "trick"]
+    assert opts[0]["_score"] == 10.0 and opts[0]["_id"] == "1"
+    # skip_duplicates collapses per-doc
+    resp = s.search({"suggest": {
+        "c": {"prefix": "tri", "completion": {
+            "field": "sug", "skip_duplicates": True}}}})
+    opts = resp["suggest"]["c"][0]["options"]
+    assert [o["_id"] for o in opts] == ["1", "2", "3"]
+    # size truncation
+    resp = s.search({"suggest": {
+        "c": {"prefix": "tri", "completion": {"field": "sug",
+                                              "size": 2}}}})
+    assert len(resp["suggest"]["c"][0]["options"]) == 2
+
+
+def test_completion_per_input_weights_and_persistence(tmp_path):
+    """Each input keeps ITS OWN weight (not the doc max), and weights
+    survive the segment save/load round trip."""
+    from opensearch_tpu.index.segment import SegmentWriter
+    from opensearch_tpu.index.store import load_segment, save_segment
+    from opensearch_tpu.mapping.mapper import DocumentMapper
+    from opensearch_tpu.search.executor import ShardSearcher
+
+    mapper = DocumentMapper({"properties": {"sug": {"type": "completion"}}})
+    parsed = [
+        mapper.parse("1", {"sug": [{"input": ["apple"], "weight": 100},
+                                   {"input": ["apricot"], "weight": 1}]}),
+        mapper.parse("2", {"sug": {"input": ["applause"], "weight": 50}}),
+    ]
+    seg = SegmentWriter().build(parsed, "sw")
+    save_segment(seg, str(tmp_path))
+    seg2 = load_segment(str(tmp_path), "sw")
+    for s in (seg, seg2):
+        searcher = ShardSearcher([s], mapper)
+        resp = searcher.search({"suggest": {
+            "c": {"prefix": "ap", "completion": {"field": "sug"}}}})
+        opts = resp["suggest"]["c"][0]["options"]
+        # apricot must rank by ITS weight (1), below applause (50)
+        assert [(o["text"], o["_score"]) for o in opts] == [
+            ("apple", 100.0), ("applause", 50.0), ("apricot", 1.0)]
