@@ -121,6 +121,53 @@ def apply_diff(base: "ClusterState", diff: dict) -> "ClusterState":
     return ClusterState.from_payload(p)
 
 
+def _alloc_setting(settings: dict, suffix: str):
+    """Read index.routing.allocation.<suffix> in flat or nested form."""
+    for key in (f"index.routing.allocation.{suffix}",
+                f"routing.allocation.{suffix}"):
+        if key in settings:
+            return settings[key]
+    node = settings.get("routing") or {}
+    node = node.get("allocation") or {}
+    for part in suffix.split("."):
+        if not isinstance(node, dict):
+            return None
+        node = node.get(part)
+        if node is None:
+            return None
+    return node
+
+
+def _as_name_set(v):
+    if v is None:
+        return None
+    if isinstance(v, str):
+        return {s.strip() for s in v.split(",") if s.strip()}
+    return set(v)
+
+
+def node_allowed(index_settings: dict, node_id: str) -> bool:
+    """The decider chain's filter deciders (cluster/routing/allocation/
+    decider/FilterAllocationDecider.java): include/exclude/require by
+    node name.  Same-shard and shards-per-node deciders apply at the
+    candidate-selection site."""
+    exclude = _as_name_set(_alloc_setting(index_settings, "exclude._name"))
+    if exclude and node_id in exclude:
+        return False
+    include = _as_name_set(_alloc_setting(index_settings, "include._name"))
+    if include is not None and include and node_id not in include:
+        return False
+    require = _as_name_set(_alloc_setting(index_settings, "require._name"))
+    if require and node_id not in require:
+        return False
+    return True
+
+
+def _shards_per_node_cap(index_settings: dict):
+    v = _alloc_setting(index_settings, "total_shards_per_node")
+    return None if v is None else int(v)
+
+
 def allocate_shards(state: ClusterState) -> ClusterState:
     """Shard-group allocation over data nodes — the BalancedShardsAllocator
     + in-sync-promotion logic at the fidelity this needs:
@@ -184,17 +231,40 @@ def allocate_shards(state: ClusterState) -> ClusterState:
                 counts[e["primary"]] += 1
             for r in e["replicas"]:
                 counts[r] += 1
-    # pass 2: fill holes on least-loaded distinct nodes
-    for entries in routing.values():
+    # pass 2: fill holes on least-loaded distinct nodes that the decider
+    # chain allows (filter deciders + same-shard + shards-per-node —
+    # cluster/routing/allocation/decider/)
+    def index_shard_count(index, node):
+        return sum((1 if e2["primary"] == node else 0)
+                   + e2["replicas"].count(node)
+                   for e2 in routing[index])
+
+    for index, entries in routing.items():
+        isettings = (state.indices.get(index) or {}).get("settings") or {}
+        cap = _shards_per_node_cap(isettings)
+
+        def allowed(node, holders):
+            if node in holders:
+                return False               # SameShardAllocationDecider
+            if not node_allowed(isettings, node):
+                return False               # FilterAllocationDecider
+            if cap is not None and index_shard_count(index, node) >= cap:
+                return False               # ShardsLimitAllocationDecider
+            return True
+
         for e in entries:
             if e["primary"] is None:
-                target = min(sorted(counts), key=lambda n: counts[n])
+                cands = [n for n in sorted(counts) if allowed(n, set())]
+                if not cands:
+                    cands = sorted(counts)  # a primary MUST live somewhere
+                target = min(cands, key=lambda n: counts[n])
                 e["primary"] = target
                 counts[target] += 1
                 e["in_sync"] = []              # fresh shard: no history
             holders = set(copies_of(e))
             while len(e["replicas"]) < e["_want"]:
-                cands = [n for n in sorted(counts) if n not in holders]
+                cands = [n for n in sorted(counts)
+                         if allowed(n, holders)]
                 if not cands:
                     break
                 target = min(cands, key=lambda n: counts[n])

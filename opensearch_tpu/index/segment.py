@@ -456,7 +456,9 @@ class SegmentWriter:
                 wmap = seg.completion_weights.setdefault(cfield, {})
                 for text, weight in entries:
                     key = (i, text)
-                    if weight > wmap.get(key, 0):
+                    # an explicit weight of 0 must round-trip (it ranks
+                    # LAST, not as the implicit 1)
+                    if key not in wmap or weight > wmap[key]:
                         wmap[key] = weight
             for fname, toks in doc.tokens.items():
                 per_term: dict[str, tuple[int, list[int]]] = {}

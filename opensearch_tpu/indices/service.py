@@ -633,6 +633,7 @@ class IndexService:
             return ms.search(body)
         if (aggs_json and not body.get("suggest")
                 and int(body.get("size", 10)) == 0
+                and body.get("min_score") is None
                 and ms.supports_mesh_aggs(aggs_json)):
             # the metric-agg family reduces ON the mesh (one ICI
             # collective), never serializing per-shard partials

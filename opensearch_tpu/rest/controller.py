@@ -207,6 +207,19 @@ class RestController:
         r("GET", "/_cat/aliases", self.h_cat_aliases)
         r("GET", "/_cat/templates", self.h_cat_templates)
         r("GET", "/_cat/segments", self.h_cat_segments)
+        r("GET", "/_cat/recovery", self.h_cat_recovery)
+        r("GET", "/_cat/recovery/{index}", self.h_cat_recovery)
+        r("GET", "/_cat/repositories", self.h_cat_repositories)
+        r("GET", "/_cat/snapshots/{repo}", self.h_cat_snapshots)
+        r("GET", "/_cat/tasks", self.h_cat_tasks)
+        r("GET", "/_cat/thread_pool", self.h_cat_thread_pool)
+        r("GET", "/_cat/pending_tasks", self.h_cat_pending_tasks)
+        r("GET", "/_cat/plugins", self.h_cat_plugins)
+        r("GET", "/_cat/cluster_manager", self.h_cat_cluster_manager)
+        r("GET", "/_cat/master", self.h_cat_cluster_manager)
+        r("GET", "/_cat/nodeattrs", self.h_cat_nodeattrs)
+        r("GET", "/_cat/allocation", self.h_cat_allocation)
+        r("GET", "/_cat/fielddata", self.h_cat_fielddata)
         r("POST", "/_aliases", self.h_update_aliases)
         r("GET", "/_alias", self.h_get_alias)
         r("GET", "/_alias/{name}", self.h_get_alias)
@@ -1840,6 +1853,86 @@ class RestController:
                                  "docs.count": str(seg.live_count()),
                                  "docs.deleted": str(
                                      seg.n_docs - seg.live_count())})
+        return 200, rows
+
+    def h_cat_recovery(self, req):
+        rows = []
+        targets = (self.node.indices.resolve(req.path_params["index"])
+                   if req.path_params.get("index")
+                   else self.node.indices.indices.values())
+        for svc in sorted(targets, key=lambda s: s.name):
+            for shard_id, _engine in sorted(svc.local_shards.items()):
+                rows.append({"index": svc.name, "shard": str(shard_id),
+                             "type": "store", "stage": "done",
+                             "source_node": "-",
+                             "target_node": self.node.name,
+                             "files_percent": "100.0%",
+                             "bytes_percent": "100.0%"})
+        return 200, rows
+
+    def h_cat_repositories(self, req):
+        return 200, [{"id": name, "type": meta["type"]}
+                     for name, meta in sorted(
+                         self.node.snapshots.get_repository().items())]
+
+    def h_cat_snapshots(self, req):
+        repo = req.path_params["repo"]
+        out = self.node.snapshots.get_snapshot(repo, "_all")
+        return 200, [{"id": s["snapshot"], "status": s.get("state", ""),
+                      "indices": str(len(s.get("indices", [])))}
+                     for s in out.get("snapshots", [])]
+
+    def h_cat_tasks(self, req):
+        return 200, [{"action": t.action,
+                      "task_id": f"{self.node.node_id}:{t.id}",
+                      "type": "transport"}
+                     for t in sorted(self.node.task_manager.list(),
+                                     key=lambda t: t.id)]
+
+    def h_cat_thread_pool(self, req):
+        rows = []
+        for name, stats in sorted(self.node.thread_pool.stats().items()):
+            rows.append({"node_name": self.node.name, "name": name,
+                         "active": str(stats.get("active", 0)),
+                         "queue": str(stats.get("queue", 0)),
+                         "rejected": str(stats.get("rejected", 0))})
+        return 200, rows
+
+    def h_cat_pending_tasks(self, req):
+        return 200, []               # single node: no pending state tasks
+
+    def h_cat_plugins(self, req):
+        # built-in module set (the reference lists installed plugins)
+        return 200, [{"name": self.node.name, "component": c,
+                      "version": VERSION}
+                     for c in ("analysis-common", "ingest-common",
+                               "parent-join", "percolator", "rank-eval",
+                               "reindex", "search-pipeline-common")]
+
+    def h_cat_cluster_manager(self, req):
+        return 200, [{"id": self.node.node_id, "host": self.node.host,
+                      "ip": self.node.host, "node": self.node.name}]
+
+    def h_cat_nodeattrs(self, req):
+        return 200, [{"node": self.node.name, "host": self.node.host,
+                      "attr": "accelerator", "value": "tpu"}]
+
+    def h_cat_allocation(self, req):
+        shards = sum(s.num_shards
+                     for s in self.node.indices.indices.values())
+        return 200, [{"shards": str(shards), "node": self.node.name,
+                      "host": self.node.host, "ip": self.node.host}]
+
+    def h_cat_fielddata(self, req):
+        rows = []
+        for name, svc in sorted(self.node.indices.indices.items()):
+            for engine in svc.shards:
+                for seg in engine.segments:
+                    for field, dv in sorted(seg.ordinal_dv.items()):
+                        rows.append({
+                            "node": self.node.name, "field": field,
+                            "size": str(dv.ords.nbytes
+                                        + dv.value_docs.nbytes)})
         return 200, rows
 
     # -- task management ---------------------------------------------------

@@ -475,10 +475,14 @@ class ShardSearcher:
                                           can_match_skip=True))
             return [], total, None
 
-        per_seg = []
-        total = 0
-        max_score = -np.inf
+        # phase 1: DISPATCH every segment's program without a host sync —
+        # jax's async dispatch runs them back to back on the device while
+        # the host prepares the next segment (the concurrent-segment-
+        # search answer in the XLA model; ref search/query/
+        # ConcurrentQueryPhaseSearcher.java gets the same overlap from
+        # slice threads)
         ms = jnp.asarray(np.float32(-np.inf if min_score is None else min_score))
+        launched = []
         for si, seg in enumerate(self.segments):
             check_current()        # cancellation point per segment program
             if not plan.can_match(bind, seg):
@@ -488,7 +492,12 @@ class ShardSearcher:
                              live=self.ctx.live_jnp(seg, dseg))
             dims, ins = plan.prepare(bind, seg, dseg, self.ctx)
             k = min(k_want, dseg.n_pad)
-            vals, idx, tot, mx = P.run_topk(plan, dims, k, A, ins, ms)
+            launched.append((si, *P.run_topk(plan, dims, k, A, ins, ms)))
+        # phase 2: ONE host-sync region over all segments' results
+        per_seg = []
+        total = 0
+        max_score = -np.inf
+        for si, vals, idx, tot, mx in launched:
             vals = np.asarray(vals)
             idx = np.asarray(idx)
             keep = vals > -np.inf

@@ -285,11 +285,16 @@ def merge_suggest(per_source: list[dict]) -> dict:
                         by_text[o["text"]] = dict(o)
                     else:
                         cur["freq"] = cur.get("freq", 0) + o.get("freq", 0)
-                        cur["score"] = max(cur.get("score", 0),
-                                           o.get("score", 0))
-                merged = sorted(by_text.values(),
-                                key=lambda o: (-o.get("score", 0),
-                                               -o.get("freq", 0),
-                                               o["text"]))
+                        for sk in ("score", "_score"):
+                            if sk in cur or sk in o:
+                                cur[sk] = max(cur.get(sk, 0),
+                                              o.get(sk, 0))
+                # completion options carry "_score" (weights), term/
+                # phrase carry "score" — both sort weight/score desc
+                merged = sorted(
+                    by_text.values(),
+                    key=lambda o: (-o.get("score",
+                                          o.get("_score", 0)),
+                                   -o.get("freq", 0), o["text"]))
                 mine["options"] = merged
     return out

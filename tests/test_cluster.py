@@ -397,3 +397,39 @@ def test_ops_based_recovery_via_retention_lease(tmp_path):
     finally:
         for n in nodes.values():
             n.stop()
+
+
+def test_allocation_deciders_filter_and_limits():
+    """FilterAllocationDecider + ShardsLimitAllocationDecider analogs
+    steer replica placement (VERDICT: the decider chain)."""
+    from opensearch_tpu.cluster.state import (ClusterState,
+                                              allocate_shards)
+
+    nodes = {f"n{i}": {"name": f"n{i}"} for i in range(4)}
+    # exclude n3 entirely; 2 shards x 1 replica
+    st = ClusterState(nodes=nodes, indices={"idx": {"settings": {
+        "number_of_shards": 2, "number_of_replicas": 1,
+        "index.routing.allocation.exclude._name": "n3"}}})
+    out = allocate_shards(st)
+    placed = {c for e in out.routing["idx"]
+              for c in [e["primary"], *e["replicas"]]}
+    assert "n3" not in placed and len(placed) >= 2
+    # require pins every copy onto the named set
+    st = ClusterState(nodes=nodes, indices={"idx": {"settings": {
+        "number_of_shards": 2, "number_of_replicas": 1,
+        "index.routing.allocation.require._name": "n0,n1"}}})
+    out = allocate_shards(st)
+    placed = {c for e in out.routing["idx"]
+              for c in [e["primary"], *e["replicas"]]}
+    assert placed <= {"n0", "n1"}
+    # total_shards_per_node caps replica fill (primaries may still
+    # exceed it as a last resort: availability beats placement limits)
+    st = ClusterState(nodes=nodes, indices={"idx": {"settings": {
+        "number_of_shards": 4, "number_of_replicas": 1,
+        "index.routing.allocation.total_shards_per_node": 2}}})
+    out = allocate_shards(st)
+    per_node = {}
+    for e in out.routing["idx"]:
+        for c in [e["primary"], *e["replicas"]]:
+            per_node[c] = per_node.get(c, 0) + 1
+    assert max(per_node.values()) <= 2

@@ -209,3 +209,28 @@ def test_completion_per_input_weights_and_persistence(tmp_path):
         # apricot must rank by ITS weight (1), below applause (50)
         assert [(o["text"], o["_score"]) for o in opts] == [
             ("apple", 100.0), ("applause", 50.0), ("apricot", 1.0)]
+
+
+def test_completion_merge_and_zero_weight():
+    """Cross-shard completion merge keeps weight order (_score vs score
+    key mismatch regression) and an explicit weight 0 round-trips."""
+    from opensearch_tpu.index.segment import SegmentWriter
+    from opensearch_tpu.mapping.mapper import DocumentMapper
+    from opensearch_tpu.search.executor import ShardSearcher
+    from opensearch_tpu.search.suggest import merge_suggest
+
+    mapper = DocumentMapper({"properties": {"sug": {"type": "completion"}}})
+    w = SegmentWriter()
+    s1 = ShardSearcher([w.build([mapper.parse(
+        "1", {"sug": {"input": ["trial"], "weight": 10}})], "a")], mapper)
+    s2 = ShardSearcher([w.build([
+        mapper.parse("2", {"sug": {"input": ["tried"], "weight": 5}}),
+        mapper.parse("3", {"sug": {"input": ["trill"], "weight": 0}}),
+    ], "b")], mapper)
+    body = {"suggest": {"c": {"prefix": "tri",
+                              "completion": {"field": "sug"}}}}
+    merged = merge_suggest([s1.search(body)["suggest"],
+                            s2.search(body)["suggest"]])
+    opts = merged["c"][0]["options"]
+    assert [(o["text"], o["_score"]) for o in opts] == [
+        ("trial", 10.0), ("tried", 5.0), ("trill", 0.0)]
