@@ -427,11 +427,12 @@ class Coordinator:
                     "term": self.current_term}
 
     def _on_follower_check(self, payload: dict) -> dict:
-        # leader asks follower: still following me in this term?
+        # leader asks follower: still following me in this term?  The
+        # applied version rides along for the LagDetector.
         with self._lock:
             ok = (payload["term"] == self.current_term
                   and self.mode == Mode.FOLLOWER)
-            return {"ok": ok}
+            return {"ok": ok, "version": self.committed.version}
 
     def run_checks_once(self):
         """One failure-detection round (scheduled repeatedly in production,
@@ -442,13 +443,21 @@ class Coordinator:
             term = self.current_term
         if mode == Mode.LEADER:
             for peer in [n for n in state.nodes if n != self.node_id]:
+                lagging = False
                 try:
                     r = self.transport.send_request(
                         peer, FOLLOWER_CHECK, {"term": term}, timeout=2.0)
                     ok = r.get("ok")
+                    # LagDetector (coordination/LagDetector.java): a
+                    # follower that acks checks but never APPLIES the
+                    # published state is as gone as a dead one — it
+                    # would serve stale reads forever
+                    lagging = bool(ok) and (int(r.get("version",
+                                                      state.version))
+                                            < state.version)
                 except OpenSearchTpuError:
                     ok = False
-                if ok:
+                if ok and not lagging:
                     self._check_failures.pop(peer, None)
                 else:
                     n = self._check_failures.get(peer, 0) + 1

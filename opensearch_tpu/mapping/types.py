@@ -473,6 +473,37 @@ class JoinFieldType(FieldType):
         return []
 
 
+class RankFeatureFieldType(FieldType):
+    """Positive per-doc feature for rank_feature queries
+    (mapper-extras RankFeatureFieldMapper): a double doc value; values
+    must be strictly positive."""
+
+    type_name = "rank_feature"
+    dv_kind = "double"
+    indexed = False
+    allow_multiple = False
+
+    def index_terms(self, value, analyzers):
+        return []
+
+    def doc_value(self, value):
+        try:
+            v = float(value)
+        except (TypeError, ValueError) as e:
+            raise MapperParsingError(
+                f"failed to parse field [{self.name}] of type "
+                f"[rank_feature]: [{value}]") from e
+        if not math.isfinite(v) or v <= 0:
+            raise MapperParsingError(
+                f"[rank_feature] field [{self.name}] requires a positive "
+                f"finite value, got [{value}]")
+        if self.params.get("positive_score_impact") is False:
+            # negative-impact features store the reciprocal, like the
+            # reference's freq encoding
+            v = 1.0 / v
+        return v
+
+
 class CompletionFieldType(FieldType):
     """Prefix completion (suggest/completion/CompletionFieldMapper).
     Inputs live in the segment's SORTED ordinal column, so a prefix is a
@@ -558,7 +589,7 @@ FIELD_TYPES = {
         HalfFloatFieldType, ScaledFloatFieldType, BooleanFieldType,
         DateFieldType, IpFieldType, DenseVectorFieldType, GeoPointFieldType,
         BinaryFieldType, UnsignedLongFieldType, ObjectFieldType,
-        JoinFieldType, CompletionFieldType,
+        JoinFieldType, CompletionFieldType, RankFeatureFieldType,
     ]
 }
 FIELD_TYPES["knn_vector"] = DenseVectorFieldType

@@ -1081,6 +1081,157 @@ def _c_geo_bounding_box(q, ctx, scored):
              "right": q.right, "boost": q.boost})
 
 
+def _c_geo_polygon(q, ctx, scored):
+    ft = _require_ft(ctx, q.field, "geo_polygon")
+    if ft is None:
+        return _none()
+    if ft.dv_kind != "geo_point":
+        raise IllegalArgumentError(
+            f"[geo_polygon] field [{q.field}] is not a geo_point")
+    return (P.GeoPolygonPlan(field=q.field),
+            {"lats": [p[0] for p in q.points],
+             "lons": [p[1] for p in q.points], "boost": q.boost})
+
+
+def _expand_prefix_terms(ctx, field, prefix: str, max_expansions: int):
+    """Terms with ``prefix`` across all segments (sorted dictionaries =
+    binary-searched range per segment), capped like MultiTermQuery's
+    max_expansions."""
+    import bisect
+
+    out: list[str] = []
+    seen: set = set()
+    for seg in ctx.segments:
+        pf = seg.postings.get(field)
+        if pf is None:
+            continue
+        sterms = ctx.sorted_terms(seg, field)
+        lo = bisect.bisect_left(sterms, prefix)
+        for i in range(lo, len(sterms)):
+            t = sterms[i]
+            if not t.startswith(prefix):
+                break
+            if t not in seen:
+                seen.add(t)
+                out.append(t)
+            if len(out) >= max_expansions:
+                return out
+    return out
+
+
+def _phrase_from_tokens(ctx, field, terms, positions, boost, scored):
+    """PhrasePlan bind straight from (term, position) tokens — keeps the
+    analyzer's position gaps (stopword holes) intact."""
+    if len(terms) == 1:
+        return _term_bag(ctx, field, [terms[0]], 1, boost, scored)
+    stats = ctx.field_stats(field)
+    idf_sum = float(np.sum(_idfs_for(ctx, field, terms)))
+    bind = {"terms": tuple(terms), "positions": tuple(positions),
+            "idf_sum": idf_sum, "boost": boost, "avgdl": stats.avgdl}
+    return P.PhrasePlan(field=field, scored=scored), bind
+
+
+def _c_match_phrase_prefix(q, ctx, scored):
+    """Phrase whose LAST token is a prefix: expand it against the term
+    dictionary and dis-max the resulting phrases, substituting the last
+    term IN PLACE so original token positions (incl. stopword gaps)
+    survive (MatchPhrasePrefixQueryBuilder -> MultiPhrasePrefixQuery)."""
+    ft = _require_ft(ctx, q.field, "match_phrase_prefix")
+    if ft is None:
+        return _none()
+    if not isinstance(ft, TextFieldType):
+        return _c_term(dsl.TermQuery(field=q.field, value=q.query,
+                                     boost=q.boost), ctx, scored)
+    analyzer = ctx.mapper.analyzers.get(ft.search_analyzer_name)
+    toks = analyzer.analyze(str(q.query))
+    if not toks:
+        return _none()
+    if q.slop:
+        raise IllegalArgumentError(
+            "match_phrase_prefix slop > 0 is not supported yet")
+    terms = [t.term for t in toks]
+    positions = [t.position for t in toks]
+    expansions = _expand_prefix_terms(ctx, q.field, terms[-1],
+                                      int(q.max_expansions))
+    if not expansions:
+        return _none()
+    plans, binds = [], []
+    for t in expansions:
+        p, b = _phrase_from_tokens(ctx, q.field, terms[:-1] + [t],
+                                   positions, q.boost, scored)
+        plans.append(p)
+        binds.append(b)
+    if len(plans) == 1:
+        return plans[0], binds[0]
+    return (P.DisMaxPlan(children=tuple(plans)),
+            {"boost": 1.0, "tie_breaker": 0.0, "children": tuple(binds)})
+
+
+def _c_match_bool_prefix(q, ctx, scored):
+    """Every token a term clause, the last a prefix clause, combined as
+    a bool (MatchBoolPrefixQueryBuilder)."""
+    ft = _require_ft(ctx, q.field, "match_bool_prefix")
+    if ft is None:
+        return _none()
+    if not isinstance(ft, TextFieldType):
+        return _c_term(dsl.TermQuery(field=q.field, value=q.query,
+                                     boost=q.boost), ctx, scored)
+    terms = ft.search_terms(str(q.query), ctx.mapper.analyzers)
+    if not terms:
+        return _none()
+    clauses: list = [dsl.TermQuery(field=q.field, value=t)
+                     for t in terms[:-1]]
+    clauses.append(dsl.PrefixQuery(field=q.field, value=terms[-1]))
+    if q.operator == "and":
+        return compile_query(dsl.BoolQuery(must=clauses, boost=q.boost),
+                             ctx, scored)
+    return compile_query(dsl.BoolQuery(should=clauses,
+                                       minimum_should_match="1",
+                                       boost=q.boost), ctx, scored)
+
+
+def _c_rank_feature(q, ctx, scored):
+    """rank_feature scoring lowered onto the script-score plan: the
+    saturation/log/sigmoid curves are exactly the painless-subset
+    expressions over doc['f'].value (RankFeatureQueryBuilder; the
+    feature column is a positive numeric doc value)."""
+    ft = _require_ft(ctx, q.field, "rank_feature")
+    if ft is None:
+        return _none()
+    if ft.dv_kind not in ("long", "double"):
+        raise IllegalArgumentError(
+            f"[rank_feature] field [{q.field}] must be numeric "
+            f"(rank_feature type), got [{ft.type_name}]")
+    f = f"doc['{q.field}'].value"
+    if q.log is not None:
+        scaling = float(q.log.get("scaling_factor", 1.0))
+        src = f"Math.log({scaling} + {f})"
+    elif q.sigmoid is not None:
+        if "pivot" not in q.sigmoid or "exponent" not in q.sigmoid:
+            raise ParsingError(
+                "[rank_feature] sigmoid requires [pivot] and [exponent]")
+        pivot = float(q.sigmoid["pivot"])
+        exp = float(q.sigmoid["exponent"])
+        src = (f"Math.pow({f}, {exp}) / "
+               f"(Math.pow({f}, {exp}) + Math.pow({pivot}, {exp}))")
+    else:
+        pivot = (q.saturation or {}).get("pivot")
+        if pivot is None:
+            # default pivot ~ the field's mean positive value (the
+            # reference uses an approximate geometric mean)
+            total, count = 0.0, 0
+            for seg in ctx.segments:
+                dv = seg.numeric_dv.get(q.field)
+                if dv is not None and len(dv.values):
+                    total += float(np.sum(dv.values))
+                    count += int(len(dv.values))
+            pivot = (total / count) if count else 1.0
+        src = f"{f} / ({f} + {float(pivot)})"
+    return compile_query(dsl.ScriptScoreQuery(
+        query=dsl.ExistsQuery(field=q.field),
+        script={"source": src}, boost=q.boost), ctx, scored)
+
+
 # span end disabled: any analyzer position is < this (< ops.phrase
 # POS_BASE so doc*POS_BASE+pos arithmetic can't overflow)
 _SPAN_NO_END = 1 << 21
@@ -1512,6 +1663,10 @@ _COMPILERS = {
     dsl.FunctionScoreQuery: _c_function_score,
     dsl.MoreLikeThisQuery: _c_more_like_this,
     dsl.GeoDistanceQuery: _c_geo_distance,
+    dsl.GeoPolygonQuery: _c_geo_polygon,
+    dsl.MatchPhrasePrefixQuery: _c_match_phrase_prefix,
+    dsl.MatchBoolPrefixQuery: _c_match_bool_prefix,
+    dsl.RankFeatureQuery: _c_rank_feature,
     dsl.GeoBoundingBoxQuery: _c_geo_bounding_box,
     dsl.SpanTermQuery: _c_span_term,
     dsl.SpanNearQuery: _c_span_near,

@@ -1107,6 +1107,50 @@ class GeoDistancePlan(Plan):
 
 
 @dataclass(frozen=True)
+class GeoPolygonPlan(Plan):
+    """geo_polygon filter: even-odd ray casting over the polygon's edge
+    list, vectorized values x edges (GeoPolygonQueryBuilder; planar
+    approximation like the reference's legacy path).  bind: {lats, lons
+    (padded to v_pad, inactive edges zero-length), boost}."""
+
+    field: str = ""
+
+    def arrays(self):
+        return frozenset({("geo", self.field)})
+
+    def prepare(self, bind, seg, dseg, ctx):
+        lats = np.asarray(bind["lats"], np.float64)
+        lons = np.asarray(bind["lons"], np.float64)
+        v_pad = pad_pow2(len(lats), minimum=4)
+        # pad by repeating the last vertex: zero-length edges never cross
+        plats = np.full(v_pad, lats[-1])
+        plons = np.full(v_pad, lons[-1])
+        plats[: len(lats)] = lats
+        plons[: len(lons)] = lons
+        return ((v_pad,), (jnp.asarray(plats), jnp.asarray(plons),
+                           _scalar(bind["boost"], _F32)))
+
+    def eval(self, A, dims, ins):
+        plats, plons, boost = ins
+        g = A["geo"][self.field]
+        n_pad = A["live"].shape[0]
+        y = g["lats"].astype(jnp.float64)[:, None]      # [V, 1]
+        x = g["lons"].astype(jnp.float64)[:, None]
+        yi, xi = plats[None, :], plons[None, :]         # [1, E]
+        yj = jnp.roll(plats, -1)[None, :]
+        xj = jnp.roll(plons, -1)[None, :]
+        straddles = (yi > y) != (yj > y)
+        # safe where straddles is False (the denominator can be 0 there)
+        t = jnp.where(straddles, (y - yi) / jnp.where(yj - yi == 0, 1.0,
+                                                      yj - yi), 0.0)
+        crosses = straddles & (x < xi + t * (xj - xi))
+        inside = (crosses.sum(axis=1) % 2) == 1
+        hit = jnp.zeros(n_pad, bool).at[g["value_docs"]].max(inside)
+        matched = hit & g["exists"]
+        return jnp.where(matched, boost, 0.0).astype(jnp.float32), matched
+
+
+@dataclass(frozen=True)
 class GeoBoxPlan(Plan):
     """geo_bounding_box filter.  bind: {top, left, bottom, right, boost}
     (no dateline wrap)."""

@@ -62,6 +62,39 @@ class MatchPhraseQuery(Query):
 
 
 @dataclass
+class MatchPhrasePrefixQuery(Query):
+    field: str = ""
+    query: Any = None
+    slop: int = 0
+    max_expansions: int = 50
+
+
+@dataclass
+class MatchBoolPrefixQuery(Query):
+    field: str = ""
+    query: Any = None
+    operator: str = "or"
+    max_expansions: int = 50
+
+
+@dataclass
+class GeoPolygonQuery(Query):
+    field: str = ""
+    points: list = dc_field(default_factory=list)   # [(lat, lon)]
+
+
+@dataclass
+class RankFeatureQuery(Query):
+    """Score by a per-doc feature value (modules/mapper-extras
+    RankFeatureQueryBuilder): saturation (default), log, or sigmoid."""
+
+    field: str = ""
+    saturation: Optional[dict] = None
+    log: Optional[dict] = None
+    sigmoid: Optional[dict] = None
+
+
+@dataclass
 class MultiMatchQuery(Query):
     fields: list = dc_field(default_factory=list)   # [(field, boost)]
     query: Any = None
@@ -563,6 +596,86 @@ def _parse_percolate(body):
                           documents=list(docs), boost=_boost(body))
 
 
+def _parse_match_phrase_prefix(body):
+    field, v = _field_kv(body, "match_phrase_prefix")
+    if isinstance(v, dict):
+        return MatchPhrasePrefixQuery(
+            field=field, query=v.get("query"),
+            slop=int(v.get("slop", 0)),
+            max_expansions=int(v.get("max_expansions", 50)),
+            boost=_boost(v))
+    return MatchPhrasePrefixQuery(field=field, query=v)
+
+
+def _parse_match_bool_prefix(body):
+    field, v = _field_kv(body, "match_bool_prefix")
+    if isinstance(v, dict):
+        return MatchBoolPrefixQuery(
+            field=field, query=v.get("query"),
+            operator=str(v.get("operator", "or")).lower(),
+            max_expansions=int(v.get("max_expansions", 50)),
+            boost=_boost(v))
+    return MatchBoolPrefixQuery(field=field, query=v)
+
+
+def _parse_wrapper(body):
+    """wrapper: {query: <base64 of a JSON query>} — decodes and parses
+    inline (WrapperQueryBuilder)."""
+    import base64
+    import json as _json
+
+    raw = body.get("query")
+    if raw is None:
+        raise ParsingError("[wrapper] requires [query]")
+    try:
+        inner = _json.loads(base64.b64decode(raw))
+    except Exception as e:  # noqa: BLE001 — any malformed payload is a 400
+        raise ParsingError(f"[wrapper] cannot decode query: {e}") from None
+    return parse_query(inner)
+
+
+def _parse_geo_polygon(body):
+    field = next((k for k in body if k not in ("boost", "_name",
+                                               "validation_method")), None)
+    if field is None or not isinstance(body[field], dict):
+        raise ParsingError("[geo_polygon] requires a field with [points]")
+    pts = body[field].get("points")
+    if not pts or len(pts) < 3:
+        raise ParsingError("[geo_polygon] requires at least 3 [points]")
+    points = []
+    for p in pts:
+        try:
+            if isinstance(p, dict):
+                points.append((float(p["lat"]), float(p["lon"])))
+            elif isinstance(p, (list, tuple)):
+                points.append((float(p[1]), float(p[0])))   # [lon, lat]
+            elif isinstance(p, str) and "," in p:
+                lat, _, lon = p.partition(",")
+                points.append((float(lat), float(lon)))
+            else:
+                raise ParsingError(
+                    f"[geo_polygon] malformed point {p!r} (lat/lon "
+                    "object, [lon, lat] array, or 'lat,lon' string; "
+                    "geohash points are not supported)")
+        except ParsingError:
+            raise
+        except (KeyError, ValueError, TypeError, IndexError) as e:
+            raise ParsingError(
+                f"[geo_polygon] malformed point {p!r}: {e}") from None
+    return GeoPolygonQuery(field=field, points=points, boost=_boost(body))
+
+
+def _parse_rank_feature(body):
+    field = body.get("field")
+    if not field:
+        raise ParsingError("[rank_feature] requires [field]")
+    return RankFeatureQuery(field=str(field),
+                            saturation=body.get("saturation"),
+                            log=body.get("log"),
+                            sigmoid=body.get("sigmoid"),
+                            boost=_boost(body))
+
+
 def _parse_has_child(body):
     if not body.get("type") or body.get("query") is None:
         raise ParsingError("[has_child] requires [type] and [query]")
@@ -1028,6 +1141,11 @@ _PARSERS = {
     "has_child": _parse_has_child,
     "has_parent": _parse_has_parent,
     "parent_id": _parse_parent_id,
+    "match_phrase_prefix": _parse_match_phrase_prefix,
+    "match_bool_prefix": _parse_match_bool_prefix,
+    "wrapper": _parse_wrapper,
+    "geo_polygon": _parse_geo_polygon,
+    "rank_feature": _parse_rank_feature,
     "prefix": _term_like(PrefixQuery, "prefix"),
     "wildcard": _term_like(WildcardQuery, "wildcard"),
     "regexp": _term_like(RegexpQuery, "regexp"),

@@ -197,3 +197,34 @@ def test_allocate_shards_replicas_and_promotion():
     assert len(e["replicas"]) == 1 and e["replicas"][0] != survivor
     assert e["replicas"][0] not in e["in_sync"]  # fresh copy must recover
     teardown({})
+
+
+def test_lag_detector_removes_stuck_follower():
+    """A follower that answers checks but never applies published states
+    is removed after check_retries rounds (coordination/LagDetector.java
+    analog)."""
+    hub, ids, coords, applied = make_cluster(check_retries=2)
+    try:
+        assert coords["node_0"].start_election()
+        leader = coords["node_0"]
+        # wedge node_2's state application: it still ACKS follower
+        # checks but silently drops publishes from now on (the handler
+        # table holds the bound method, so patch it there)
+        from opensearch_tpu.cluster.coordination import PUBLISH
+        stuck = coords["node_2"]
+        orig_publish = stuck.transport._handlers[PUBLISH]
+        stuck.transport._handlers[PUBLISH] = lambda p: {
+            "accepted": False, "term": stuck.current_term}
+        leader.submit_state_update(
+            lambda s: s.with_(indices={**s.indices,
+                                       "i1": {"settings": {},
+                                              "mappings": {}}}))
+        assert "node_2" in leader.state().nodes
+        leader.run_checks_once()       # lag round 1
+        leader.run_checks_once()       # lag round 2 -> removed
+        assert "node_2" not in leader.state().nodes
+        # healthy follower stays
+        assert "node_1" in leader.state().nodes
+        stuck.transport._handlers[PUBLISH] = orig_publish
+    finally:
+        teardown(coords)

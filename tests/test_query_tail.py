@@ -239,3 +239,107 @@ def test_review_fixes_query_tail(searcher):
     got = scores(resp)
     # avg of w=3 (value 3) and w=1 (value 1) = (3+1)/(3+1) = 1.0
     assert all(v == pytest.approx(1.0) for v in got.values())
+
+
+def _tail_searcher():
+    from opensearch_tpu.index.segment import SegmentWriter
+    from opensearch_tpu.mapping.mapper import DocumentMapper
+    from opensearch_tpu.search.executor import ShardSearcher
+
+    mapper = DocumentMapper({"properties": {
+        "body": {"type": "text"},
+        "loc": {"type": "geo_point"},
+        "pagerank": {"type": "rank_feature"},
+    }})
+    w = SegmentWriter()
+    docs = [
+        ("1", {"body": "quick brown fox", "loc": {"lat": 1, "lon": 1},
+               "pagerank": 8.0}),
+        ("2", {"body": "quick brown foam", "loc": {"lat": 5, "lon": 5},
+               "pagerank": 2.0}),
+        ("3", {"body": "brown quick fox", "loc": {"lat": 9, "lon": 9},
+               "pagerank": 0.5}),
+        ("4", {"body": "slow green turtle", "loc": {"lat": 2, "lon": 8}}),
+    ]
+    segs = [w.build([mapper.parse(i, s) for i, s in docs[:2]], "a"),
+            w.build([mapper.parse(i, s) for i, s in docs[2:]], "b")]
+    return ShardSearcher(segs, mapper)
+
+
+def _hit_ids(resp):
+    return sorted(h["_id"] for h in resp["hits"]["hits"])
+
+
+def test_match_phrase_prefix():
+    s = _tail_searcher()
+    resp = s.search({"query": {"match_phrase_prefix": {"body": "quick brown fo"}}})
+    assert _hit_ids(resp) == ["1", "2"]       # fox + foam, ordered phrase
+    resp = s.search({"query": {"match_phrase_prefix": {"body": {
+        "query": "quick brown fo", "max_expansions": 1}}}})
+    assert len(resp["hits"]["hits"]) == 1     # expansion cap
+    resp = s.search({"query": {"match_phrase_prefix": {"body": "brown zz"}}})
+    assert _hit_ids(resp) == []
+
+
+def test_match_bool_prefix():
+    s = _tail_searcher()
+    # terms in ANY order, last token a prefix
+    resp = s.search({"query": {"match_bool_prefix": {"body": "fox qui"}}})
+    assert _hit_ids(resp) == ["1", "2", "3"]  # OR semantics
+    resp = s.search({"query": {"match_bool_prefix": {"body": {
+        "query": "fox qui", "operator": "and"}}}})
+    assert _hit_ids(resp) == ["1", "3"]
+
+
+def test_wrapper_query():
+    import base64
+    import json
+
+    s = _tail_searcher()
+    inner = base64.b64encode(json.dumps(
+        {"term": {"body": "turtle"}}).encode()).decode()
+    resp = s.search({"query": {"wrapper": {"query": inner}}})
+    assert _hit_ids(resp) == ["4"]
+    from opensearch_tpu.common.errors import ParsingError
+    with pytest.raises(ParsingError):
+        s.search({"query": {"wrapper": {"query": "!!!notbase64"}}})
+
+
+def test_geo_polygon():
+    s = _tail_searcher()
+    # triangle covering (1,1) and (5,5) but not (9,9) or (2,8)
+    resp = s.search({"query": {"geo_polygon": {"loc": {"points": [
+        {"lat": 0, "lon": 0}, {"lat": 0, "lon": 7},
+        {"lat": 7, "lon": 7}, {"lat": 7, "lon": 0}]}}}})
+    assert _hit_ids(resp) == ["1", "2"]
+    # concave polygon: L-shape that excludes (5,5)
+    resp = s.search({"query": {"geo_polygon": {"loc": {"points": [
+        {"lat": 0, "lon": 0}, {"lat": 10, "lon": 0},
+        {"lat": 10, "lon": 3}, {"lat": 3, "lon": 3},
+        {"lat": 3, "lon": 10}, {"lat": 0, "lon": 10}]}}}})
+    assert _hit_ids(resp) == ["1", "4"]
+
+
+def test_rank_feature():
+    s = _tail_searcher()
+    resp = s.search({"query": {"rank_feature": {"field": "pagerank",
+                                                "saturation":
+                                                {"pivot": 2.0}}}})
+    ids = [h["_id"] for h in resp["hits"]["hits"]]
+    assert ids == ["1", "2", "3"]            # by feature desc; doc 4 absent
+    assert resp["hits"]["hits"][0]["_score"] == pytest.approx(
+        8.0 / (8.0 + 2.0))
+    assert resp["hits"]["hits"][1]["_score"] == pytest.approx(0.5)
+    # log curve
+    resp = s.search({"query": {"rank_feature": {"field": "pagerank",
+                                                "log": {"scaling_factor":
+                                                        1.0}}}})
+    import math
+    assert resp["hits"]["hits"][0]["_score"] == pytest.approx(
+        math.log(1 + 8.0))
+    # positive-only validation at index time
+    from opensearch_tpu.common.errors import MapperParsingError
+    from opensearch_tpu.mapping.mapper import DocumentMapper
+    m = DocumentMapper({"properties": {"f": {"type": "rank_feature"}}})
+    with pytest.raises(MapperParsingError):
+        m.parse("x", {"f": -1})
