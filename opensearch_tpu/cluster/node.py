@@ -65,6 +65,11 @@ class ClusterNode:
         os.makedirs(data_path, exist_ok=True)
         self.transport = transport
         self.indices: dict[str, IndexService] = {}
+        # data-node write admission (the same per-shard byte accounting
+        # the single-node path gets from IndicesService)
+        from opensearch_tpu.common.indexing_pressure import IndexingPressure
+        self.indexing_pressure = IndexingPressure(
+            int(os.environ.get("OSTPU_INDEXING_PRESSURE_LIMIT", 64 << 20)))
         self._lock = threading.RLock()
         from opensearch_tpu.cluster.gateway import GatewayStateStore
         self.gateway = GatewayStateStore(os.path.join(data_path, "_state"))
@@ -131,6 +136,7 @@ class ClusterNode:
                             dict(meta.get("settings") or {}),
                             meta.get("mappings"),
                             local_shard_ids=sorted(mine))
+                        svc.indexing_pressure = self.indexing_pressure
                         self.indices[index] = svc
                 else:
                     want = set(mine)
@@ -438,8 +444,13 @@ class ClusterNode:
         engine = svc.engine_for(shard)
         entry = self._entry(index, shard)
         if payload["op"] == "index":
-            r = engine.index(payload["id"], payload["source"],
-                             routing=payload.get("routing"))
+            import json as _json
+            n_bytes = len(_json.dumps(payload["source"],
+                                      separators=(",", ":")))
+            with self.indexing_pressure.coordinating((index, shard),
+                                                     n_bytes):
+                r = engine.index(payload["id"], payload["source"],
+                                 routing=payload.get("routing"))
         else:
             r = engine.delete(payload["id"])
         engine.ensure_synced()

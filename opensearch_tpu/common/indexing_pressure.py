@@ -1,0 +1,94 @@
+"""Indexing-pressure accounting: per-node and PER-SHARD in-flight
+indexing bytes with 429 rejection past the limit.
+
+Analog of ``index/ShardIndexingPressure.java`` +
+``IndexingPressureService``: every write op charges its source size for
+the duration of the operation; the node limit guards total memory, the
+per-shard soft limit keeps one hot shard from starving the rest (the
+reference's shard-level min/max granting).  Stats surface in
+``_nodes/stats`` like the reference's ``indexing_pressure`` section.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+
+
+class IndexingPressureRejection(OpenSearchTpuError):
+    status = 429
+
+
+class IndexingPressure:
+    def __init__(self, limit_bytes: int = 64 << 20,
+                 shard_fraction: float = 0.25):
+        self.limit_bytes = int(limit_bytes)
+        # one shard may hold at most this fraction of the node budget
+        # while OTHER shards are also writing (soloists get the node
+        # limit — ShardIndexingPressure's dynamic granting, simplified)
+        self.shard_fraction = float(shard_fraction)
+        self._lock = threading.Lock()
+        self._current = 0
+        self._per_shard: dict = {}
+        self._total = 0                   # lifetime bytes
+        self._rejections = 0
+        self._shard_rejections: dict = {}
+
+    @contextmanager
+    def coordinating(self, shard_key, n_bytes: int):
+        n_bytes = int(n_bytes)
+        with self._lock:
+            new_total = self._current + n_bytes
+            if new_total > self.limit_bytes:
+                self._rejections += 1
+                self._shard_rejections[shard_key] = \
+                    self._shard_rejections.get(shard_key, 0) + 1
+                raise IndexingPressureRejection(
+                    f"rejecting coordinating operation of [{n_bytes}] "
+                    f"bytes: current [{self._current}] + operation would "
+                    f"exceed [indexing_pressure.memory.limit] of "
+                    f"[{self.limit_bytes}]")
+            shard_now = self._per_shard.get(shard_key, 0) + n_bytes
+            others_active = any(k != shard_key for k in self._per_shard)
+            if others_active \
+                    and shard_now > self.limit_bytes * self.shard_fraction:
+                self._rejections += 1
+                self._shard_rejections[shard_key] = \
+                    self._shard_rejections.get(shard_key, 0) + 1
+                raise IndexingPressureRejection(
+                    f"rejecting coordinating operation of [{n_bytes}] "
+                    f"bytes for shard [{shard_key}]: shard in-flight "
+                    f"[{shard_now}] would exceed its share of "
+                    f"[indexing_pressure.memory.limit]")
+            self._current = new_total
+            self._per_shard[shard_key] = shard_now
+            self._total += n_bytes
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._current -= n_bytes
+                left = self._per_shard.get(shard_key, 0) - n_bytes
+                if left <= 0:
+                    self._per_shard.pop(shard_key, None)
+                else:
+                    self._per_shard[shard_key] = left
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "memory": {
+                    "current": {"coordinating_in_bytes": self._current,
+                                "per_shard": {
+                                    f"[{k[0]}][{k[1]}]": v
+                                    for k, v in self._per_shard.items()}},
+                    "total": {"coordinating_in_bytes": self._total,
+                              "coordinating_rejections": self._rejections},
+                    "limit_in_bytes": self.limit_bytes,
+                },
+                "shard_rejections": {
+                    f"[{k[0]}][{k[1]}]": v
+                    for k, v in self._shard_rejections.items()},
+            }

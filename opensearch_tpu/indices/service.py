@@ -216,12 +216,30 @@ class IndexService:
                 f"index [{self.name}] blocked by: [FORBIDDEN/8/index "
                 "write (api)]")
 
+    # node-level tracker injected by IndicesService at registration;
+    # None = standalone IndexService (tests) with no admission control
+    indexing_pressure = None
+
     def index_doc(self, doc_id: Optional[str], source: dict,
-                  routing: Optional[str] = None, **kw) -> OpResult:
+                  routing: Optional[str] = None,
+                  op_bytes: Optional[int] = None, **kw) -> OpResult:
+        """``op_bytes``: the caller's known wire size (REST passes the
+        raw body length so the hot path never re-serializes just to
+        measure)."""
         self._check_write_block()
         if doc_id is None:
             doc_id = uuid.uuid4().hex[:20]
-        engine = self.route(doc_id, routing)
+        shard = self.route_shard(str(doc_id), routing)
+        engine = self.engine_for(shard)
+        if self.indexing_pressure is not None:
+            if op_bytes is None:
+                op_bytes = len(json.dumps(source, separators=(",", ":")))
+            with self.indexing_pressure.coordinating((self.name, shard),
+                                                     int(op_bytes)):
+                result = engine.index(str(doc_id), source,
+                                      routing=routing, **kw)
+                engine.ensure_synced()
+            return result
         result = engine.index(str(doc_id), source, routing=routing, **kw)
         engine.ensure_synced()
         return result
@@ -264,6 +282,7 @@ class IndexService:
                            if params.get(k) is not None}
                     r = self.index_doc(doc_id, source,
                                        routing=params.get("routing"),
+                                       op_bytes=params.get("op_bytes"),
                                        **cas)
                     results.append({action: {
                         "_index": self.name, "_id": r.doc_id,
@@ -745,6 +764,11 @@ class IndicesService:
         # data streams: name -> {"timestamp_field", "generation",
         # "indices": [backing names]} (cluster/metadata/DataStream)
         self.data_streams: dict[str, dict] = {}
+        # node-wide indexing-pressure admission (ShardIndexingPressure)
+        from opensearch_tpu.common.indexing_pressure import IndexingPressure
+        self.indexing_pressure = IndexingPressure(
+            int(os.environ.get("OSTPU_INDEXING_PRESSURE_LIMIT",
+                               64 << 20)))
         self._aliases_file = os.path.join(data_path, "_aliases.json")
         self._templates_file = os.path.join(data_path,
                                             "_index_templates.json")
@@ -843,10 +867,12 @@ class IndicesService:
                     # later via set_repo_resolver — defer the open
                     self._pending_mounts.append(name)
                     continue
-                self.indices[name] = IndexService(
+                svc = IndexService(
                     name, os.path.join(self.data_path, name),
                     meta.get("settings", {}), meta.get("mappings"),
                     persist_meta=self._persist_meta)
+                svc.indexing_pressure = self.indexing_pressure
+                self.indices[name] = svc
 
     @staticmethod
     def validate_name(name: str):
@@ -888,6 +914,7 @@ class IndicesService:
                                persist_meta=self._persist_meta)
         svc.repo_resolver = getattr(self, "_repo_resolver", None)
         svc.repo_mutex_fn = getattr(self, "_repo_mutex_fn", None)
+        svc.indexing_pressure = self.indexing_pressure
         self._persist_meta(name, settings, mappings or {})
         self.indices[name] = svc
         return svc

@@ -52,6 +52,56 @@ class RestRequest:
         return v is not None and str(v).lower() in ("", "true", "1")
 
 
+def _os_stats() -> dict:
+    """OsProbe analog over stdlib (loadavg + memory via sysconf)."""
+    import os as _os
+
+    try:
+        la1, la5, la15 = _os.getloadavg()
+    except OSError:
+        la1 = la5 = la15 = 0.0
+    try:
+        page = _os.sysconf("SC_PAGE_SIZE")
+        total = _os.sysconf("SC_PHYS_PAGES") * page
+        free = _os.sysconf("SC_AVPHYS_PAGES") * page
+    except (ValueError, OSError):
+        total = free = 0
+    return {"cpu": {"load_average": {"1m": la1, "5m": la5, "15m": la15}},
+            "mem": {"total_in_bytes": total, "free_in_bytes": free}}
+
+
+def _process_stats() -> dict:
+    """ProcessProbe analog: CURRENT rss from /proc statm (linux), peak
+    rss from getrusage (kbytes on linux, bytes on darwin)."""
+    import resource
+    import sys as _sys
+
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    peak = ru.ru_maxrss * (1 if _sys.platform == "darwin" else 1024)
+    resident = peak
+    try:
+        with open("/proc/self/statm") as f:
+            import os as _os
+            resident = int(f.read().split()[1]) * _os.sysconf(
+                "SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    return {"cpu": {"total_in_millis": int(
+        (ru.ru_utime + ru.ru_stime) * 1000)},
+        "mem": {"resident_in_bytes": resident,
+                "peak_resident_in_bytes": peak},
+        "open_file_descriptors": _count_fds()}
+
+
+def _count_fds() -> int:
+    import os as _os
+
+    try:
+        return len(_os.listdir("/proc/self/fd"))
+    except OSError:
+        return -1
+
+
 def _nest_settings(flat: dict) -> dict:
     """Dotted settings keys -> the nested tree the reference's
     Settings.toXContent(flat_settings=false) renders."""
@@ -458,6 +508,10 @@ class RestController:
                 "thread_pool": self.node.thread_pool.stats(),
                 "fs": {"health": self.node.fs_health.stats()},
                 "file_cache": self.node.indices.file_cache.stats(),
+                "indexing_pressure":
+                    self.node.indices.indexing_pressure.stats(),
+                "os": _os_stats(),
+                "process": _process_stats(),
             }}}
 
     def h_cat_indices(self, req):
@@ -870,7 +924,8 @@ class RestController:
                 from opensearch_tpu.common.errors import VersionConflictError
                 raise VersionConflictError(doc_id, "document to be absent",
                                            "exists")
-        r = svc.index_doc(doc_id, source, routing=req.param("routing"), **kw)
+        r = svc.index_doc(doc_id, source, routing=req.param("routing"),
+                          op_bytes=len(req.raw_body or b""), **kw)
         forced = self._maybe_refresh(svc, req, doc_id=r.doc_id)
         status = 201 if r.result == "created" else 200
         out = {"_index": svc.name, "_id": r.doc_id,
@@ -1148,6 +1203,8 @@ class RestController:
                             "if_primary_term": meta.get(
                                 "if_primary_term"),
                             "pipeline": meta.get("pipeline"),
+                            "op_bytes": len(lines[i - 1])
+                            if source is not None else None,
                             "_source": meta.get(
                                 "_source", self._bulk_source_param(req))}))
         results_by_index = {}

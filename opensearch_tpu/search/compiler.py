@@ -1181,13 +1181,33 @@ def _c_match_bool_prefix(q, ctx, scored):
         return _none()
     clauses: list = [dsl.TermQuery(field=q.field, value=t)
                      for t in terms[:-1]]
-    clauses.append(dsl.PrefixQuery(field=q.field, value=terms[-1]))
+    expansions = _expand_prefix_terms(ctx, q.field, terms[-1],
+                                      int(q.max_expansions))
+    if not expansions:
+        return _none()
+    # capped dictionary expansion, like the phrase-prefix sibling
+    clauses.append(dsl.TermsQuery(field=q.field, values=expansions)
+                   if len(expansions) > 1
+                   else dsl.TermQuery(field=q.field,
+                                      value=expansions[0]))
     if q.operator == "and":
         return compile_query(dsl.BoolQuery(must=clauses, boost=q.boost),
                              ctx, scored)
     return compile_query(dsl.BoolQuery(should=clauses,
                                        minimum_should_match="1",
                                        boost=q.boost), ctx, scored)
+
+
+def _positive_float(v, what: str) -> float:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        raise ParsingError(
+            f"[rank_feature] {what} must be a number, got [{v}]") from None
+    if not math.isfinite(f) or f <= 0:
+        raise ParsingError(
+            f"[rank_feature] {what} must be positive, got [{v}]")
+    return f
 
 
 def _c_rank_feature(q, ctx, scored):
@@ -1210,12 +1230,14 @@ def _c_rank_feature(q, ctx, scored):
         if "pivot" not in q.sigmoid or "exponent" not in q.sigmoid:
             raise ParsingError(
                 "[rank_feature] sigmoid requires [pivot] and [exponent]")
-        pivot = float(q.sigmoid["pivot"])
-        exp = float(q.sigmoid["exponent"])
+        pivot = _positive_float(q.sigmoid["pivot"], "sigmoid pivot")
+        exp = _positive_float(q.sigmoid["exponent"], "sigmoid exponent")
         src = (f"Math.pow({f}, {exp}) / "
                f"(Math.pow({f}, {exp}) + Math.pow({pivot}, {exp}))")
     else:
         pivot = (q.saturation or {}).get("pivot")
+        if pivot is not None:
+            pivot = _positive_float(pivot, "saturation pivot")
         if pivot is None:
             # default pivot ~ the field's mean positive value (the
             # reference uses an approximate geometric mean)
