@@ -563,6 +563,28 @@ class IndexService:
                 f"less than or equal to: [{max_sf}] but was [{len(sf)}]. "
                 "This limit can be set by changing the "
                 "[index.max_script_fields] index level setting.")
+        max_tc = int(self.index_setting("max_terms_count", 65536))
+
+        def check_terms(node):
+            if isinstance(node, dict):
+                tq = node.get("terms")
+                if isinstance(tq, dict):
+                    for f, vals in tq.items():
+                        if isinstance(vals, list) and len(vals) > max_tc:
+                            raise IllegalArgumentError(
+                                f"The number of terms [{len(vals)}] "
+                                "used in the Terms Query request has "
+                                "exceeded the allowed maximum of "
+                                f"[{max_tc}]. This maximum can be set "
+                                "by changing the [index.max_terms_count] "
+                                "index level setting.")
+                for v in node.values():
+                    check_terms(v)
+            elif isinstance(node, list):
+                for v in node:
+                    check_terms(v)
+        if body.get("query") is not None:
+            check_terms(body["query"])
         rescore = body.get("rescore")
         if rescore:
             spec = rescore[0] if isinstance(rescore, list) else rescore
@@ -1193,7 +1215,15 @@ class IndicesService:
             for ix, meta in targets.items():
                 if index is not None and ix != index:
                     continue
-                out.setdefault(ix, {"aliases": {}})["aliases"][alias] = meta
+                rendered = dict(meta or {})
+                # a bare [routing] renders as both index_routing and
+                # search_routing (AliasMetadata's xcontent shape)
+                routing = rendered.pop("routing", None)
+                if routing is not None:
+                    rendered.setdefault("index_routing", routing)
+                    rendered.setdefault("search_routing", routing)
+                out.setdefault(ix, {"aliases": {}})["aliases"][alias] = \
+                    rendered
         if name is not None and not out:
             raise ResourceNotFoundError(f"alias [{name}] missing")
         return out

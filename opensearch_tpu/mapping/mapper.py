@@ -25,7 +25,7 @@ from dataclasses import dataclass, field as dc_field
 from typing import Any, Optional
 
 from opensearch_tpu.analysis import AnalysisRegistry
-from opensearch_tpu.common.errors import MapperParsingError, StrictDynamicMappingError
+from opensearch_tpu.common.errors import (IllegalArgumentError, MapperParsingError, StrictDynamicMappingError)
 from opensearch_tpu.mapping.types import (
     FieldType,
     TextFieldType,
@@ -151,6 +151,9 @@ class DocumentMapper:
     def _merge_props(self, prefix: str, props: dict,
                      fields: dict, configs: dict):
         for name, config in props.items():
+            if not str(name):
+                raise IllegalArgumentError(
+                    "field name cannot be an empty string")
             path = f"{prefix}{name}"
             if "properties" in config and config.get(
                     "type", "object") == "object":

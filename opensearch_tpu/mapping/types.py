@@ -59,20 +59,19 @@ def format_date_millis(millis: int) -> str:
 
 
 def parse_ip_long(value: Any) -> int:
-    """IPs are stored as a single int64 doc value.  IPv4 fits exactly; IPv6 is
-    reduced to its top 62 bits then biased above all v4 values, so the mapping
-    is monotone (order-preserving) within and across families and always fits
-    a signed int64.  Bottom 66 bits of a v6 address are not distinguished by
-    range comparisons (exact term matches go through the inverted index, which
-    keeps the canonical string).
+    """IPs are stored as a single int64 doc value ordered like the
+    reference (16-byte comparison with v4 embedded at ``::ffff:0:0/96``,
+    so ``::1`` < any v4 < global-unicast v6).  The 128-bit form is
+    monotone-compressed: values below 2^49 (every v4-mapped address and
+    the low v6 space) keep full precision; higher v6 addresses keep
+    their top 62 bits (range comparisons there are coarse — exact term
+    matches ride the inverted index, which keeps the canonical string).
     """
     addr = ipaddress.ip_address(str(value))
-    as_int = int(addr)
-    if addr.version == 4:
-        return as_int
-    # top 62 bits -> [0, 2^62); adding the 2^62 bias keeps the result in
-    # [2^62, 2^63), strictly above every v4 value and monotone in the address.
-    return (as_int >> 66) + (1 << 62)
+    v = ((0xFFFF << 32) | int(addr)) if addr.version == 4 else int(addr)
+    if v < (1 << 49):
+        return v
+    return (1 << 49) + (v >> 66)
 
 
 _LONG_RANGE = {
@@ -289,17 +288,25 @@ class DateFieldType(FieldType):
     type_name = "date"
     dv_kind = "long"
 
+    def _parse(self, value):
+        fmt = str(self.params.get("format", ""))
+        if "epoch_second" in fmt and isinstance(value, (int, float)) \
+                or "epoch_second" in fmt and str(value).lstrip(
+                    "-").isdigit():
+            return int(float(value) * 1000)
+        return parse_date_millis(value)
+
     def index_terms(self, value, analyzers):
         return []
 
     def doc_value(self, value):
-        return None if value is None else parse_date_millis(value)
+        return None if value is None else self._parse(value)
 
     def term_for_query(self, value):
-        return parse_date_millis(value)
+        return self._parse(value)
 
     def range_bound(self, value):
-        return parse_date_millis(value)
+        return self._parse(value)
 
 
 class IpFieldType(FieldType):
@@ -580,6 +587,14 @@ class UnsignedLongFieldType(FieldType):
         return self._clamp(value)
 
 
+class DateNanosFieldType(DateFieldType):
+    """date_nanos: stored at millisecond precision in the same int64
+    column (the reference keeps nanos; sub-millisecond precision is not
+    distinguished here — documented divergence)."""
+
+    type_name = "date_nanos"
+
+
 FIELD_TYPES = {
     cls.type_name: cls
     for cls in [
@@ -590,6 +605,7 @@ FIELD_TYPES = {
         DateFieldType, IpFieldType, DenseVectorFieldType, GeoPointFieldType,
         BinaryFieldType, UnsignedLongFieldType, ObjectFieldType,
         JoinFieldType, CompletionFieldType, RankFeatureFieldType,
+        DateNanosFieldType,
     ]
 }
 FIELD_TYPES["knn_vector"] = DenseVectorFieldType

@@ -107,10 +107,18 @@ class Node:
         backpressure_mode = Setting(
             "search_backpressure.mode", "monitor_only", str,
             validator=_bp_mode_check, dynamic=True)
+        max_keep_alive = Setting.time_setting(
+            "search.max_keep_alive", 24 * 3600.0, dynamic=True)
+        default_keep_alive = Setting.time_setting(
+            "search.default_keep_alive", 300.0, dynamic=True)
         self.cluster_settings = SettingsRegistry(
             Settings(stored),
             [max_buckets, auto_create, max_scroll, cache_size,
-             identity_enabled, alloc_enable, backpressure_mode])
+             identity_enabled, alloc_enable, backpressure_mode,
+             max_keep_alive, default_keep_alive])
+        self.cluster_settings.add_settings_update_consumer(
+            max_keep_alive,
+            lambda v: setattr(self.contexts, "max_keep_alive_s", v))
         # remote clusters configure via affix keys (RemoteClusterService)
         self.cluster_settings.register_prefix("cluster.remote")
         from opensearch_tpu.transport.remote import RemoteClusterService

@@ -332,8 +332,11 @@ class RestController:
         r("POST", "/_msearch", self.h_msearch)
         r("GET", "/_search/scroll", self.h_scroll_next)
         r("POST", "/_search/scroll", self.h_scroll_next)
+        r("GET", "/_search/scroll/{scroll_id}", self.h_scroll_next)
+        r("POST", "/_search/scroll/{scroll_id}", self.h_scroll_next)
         r("DELETE", "/_search/scroll/_all", self.h_scroll_clear_all)
         r("DELETE", "/_search/scroll", self.h_scroll_clear)
+        r("DELETE", "/_search/scroll/{scroll_id}", self.h_scroll_clear)
         r("DELETE", "/_search/point_in_time", self.h_pit_close)
         r("GET", "/_search/pipeline", self.h_get_pipelines)
         r("GET", "/_search/pipeline/{id}", self.h_get_pipeline)
@@ -563,7 +566,10 @@ class RestController:
 
     def h_get_index(self, req):
         svc = self.node.indices.get(req.path_params["index"])
-        return 200, {svc.name: {**svc.get_mapping(), **svc.get_settings()}}
+        aliases = (self.node.indices.get_aliases(index=svc.name)
+                   .get(svc.name, {}).get("aliases", {}))
+        return 200, {svc.name: {"aliases": aliases, **svc.get_mapping(),
+                                **svc.get_settings()}}
 
     def h_index_exists(self, req):
         if self.node.indices.exists(req.path_params["index"]):
@@ -1401,7 +1407,8 @@ class RestController:
         from opensearch_tpu.search.contexts import (ScrollContext,
                                                     parse_keepalive)
         body = req.json({}) or {}
-        scroll_id = body.get("scroll_id") or req.param("scroll_id")
+        scroll_id = (body.get("scroll_id") or req.param("scroll_id")
+                     or req.path_params.get("scroll_id"))
         if not scroll_id:
             raise ValidationError("scroll_id is required")
         # only an EXPLICIT scroll param replaces the stored keepalive; a
@@ -1416,10 +1423,13 @@ class RestController:
 
     def h_scroll_clear(self, req):
         body = req.json({}) or {}
-        ids = body.get("scroll_id") or []
+        ids = (body.get("scroll_id")
+               or req.path_params.get("scroll_id") or [])
         if isinstance(ids, str):
-            ids = [ids]
+            ids = ids.split(",")
         freed = sum(1 for i in ids if self.node.contexts.close(i))
+        if ids and freed == 0:
+            return 404, {"succeeded": False, "num_freed": 0}
         return 200, {"succeeded": True, "num_freed": freed}
 
     def h_scroll_clear_all(self, req):
@@ -1450,20 +1460,122 @@ class RestController:
         freed = sum(1 for i in ids if self.node.contexts.close(i))
         return 200, {"succeeded": True, "num_freed": freed}
 
+    _SEARCH_BODY_KEYS = frozenset({
+        "query", "size", "from", "sort", "aggs", "aggregations",
+        "_source", "min_score", "search_after", "highlight", "explain",
+        "docvalue_fields", "fields", "script_fields", "rescore",
+        "collapse", "suggest", "profile", "track_total_hits",
+        "track_scores", "scroll", "slice", "pit", "timeout",
+        "terminate_after", "version", "seq_no_primary_term",
+        "indices_boost", "stored_fields", "post_filter",
+        "_hybrid_pipeline"})
+
     def h_search(self, req):
         body = req.json({}) or {}
-        # URI-search support: ?q=field:value
+        unknown = set(body) - self._SEARCH_BODY_KEYS
+        if unknown:
+            # the reference 400s on unknown top-level search keys
+            # (SearchSourceBuilder's strict parser)
+            raise ParsingError(
+                f"unknown key for a search request: "
+                f"[{sorted(unknown)[0]}]")
+        # URI-search support: ?q= runs through query_string with its df/
+        # operator/lenient params (RestSearchAction.parseSearchSource)
         q = req.param("q")
         if q:
-            if ":" in q:
-                field, _, text = q.partition(":")
-                body.setdefault("query", {"match": {field: text}})
-            else:
-                body.setdefault("query", {"simple_query_string": {"query": q}})
+            qs = {"query": q}
+            if req.param("df"):
+                qs["default_field"] = req.param("df")
+            if req.param("default_operator"):
+                qs["default_operator"] = req.param("default_operator")
+            if req.param("analyze_wildcard") is not None:
+                qs["analyze_wildcard"] = (req.param("analyze_wildcard")
+                                          == "true")
+            if req.param("lenient") is not None:
+                qs["lenient"] = req.param("lenient") == "true"
+            body.setdefault("query", {"query_string": qs})
         if req.param("size") is not None:
             body["size"] = int(req.param("size"))
         if req.param("from") is not None:
             body["from"] = int(req.param("from"))
+        src_spec = self._bulk_source_param(req)
+        if src_spec is not None:
+            body["_source"] = src_spec     # URL params override the body
+        if body.get("query") is not None:
+            self._resolve_terms_lookup(body["query"])
+        if req.param("track_total_hits") is not None \
+                and "track_total_hits" not in body:
+            raw_tth = req.param("track_total_hits")
+            body["track_total_hits"] = (int(raw_tth)
+                                        if raw_tth.lstrip("-").isdigit()
+                                        else raw_tth != "false")
+        if req.param("docvalue_fields") and "docvalue_fields" not in body:
+            body["docvalue_fields"] = \
+                req.param("docvalue_fields").split(",")
+        tth0 = body.get("track_total_hits")
+        if (isinstance(tth0, int) and not isinstance(tth0, bool)
+                and tth0 <= 0 and tth0 != -1):
+            raise IllegalArgumentError(
+                "[track_total_hits] parameter must be positive or "
+                f"equals to -1, got {tth0}")
+        if (req.param("rest_total_hits_as_int") == "true"
+                and isinstance(tth0, int)
+                and not isinstance(tth0, bool)):
+            raise IllegalArgumentError(
+                "[rest_total_hits_as_int] cannot be used if the tracking "
+                f"of total hits is not accurate, got {tth0}")
+        resp_status, resp = self._h_search_inner(req, body)
+        tth = body.get("track_total_hits")
+        if isinstance(resp, dict):
+            hits = resp.get("hits")
+            if tth is False and isinstance(hits, dict):
+                if req.param("rest_total_hits_as_int") == "true":
+                    # the int rendering of an untracked total is -1
+                    hits["total"] = {"value": -1, "relation": "eq"}
+                else:
+                    hits.pop("total", None)
+            elif (isinstance(tth, int) and not isinstance(tth, bool)
+                  and isinstance(hits, dict)
+                  and isinstance(hits.get("total"), dict)
+                  and hits["total"]["value"] > tth):
+                # tracking cap: report the cap with relation gte
+                hits["total"] = {"value": tth, "relation": "gte"}
+        return resp_status, resp
+
+    def _resolve_terms_lookup(self, node):
+        """terms lookup ({"terms": {field: {index, id, path}}}) resolves
+        to the referenced doc's values at the COORDINATOR, like
+        TermsQueryBuilder's fetch phase."""
+        if isinstance(node, dict):
+            tq = node.get("terms")
+            if isinstance(tq, dict):
+                for f, spec in list(tq.items()):
+                    if f in ("boost", "_name") or not isinstance(spec,
+                                                                 dict):
+                        continue
+                    if "index" not in spec or "id" not in spec:
+                        continue
+                    svc = self.node.indices.get(spec["index"])
+                    doc = svc.get_doc(str(spec["id"]),
+                                      spec.get("routing"))
+                    vals = []
+                    if doc is not None:
+                        src = doc.get("_source") or {}
+                        for part in str(spec.get("path", "")).split("."):
+                            src = (src.get(part)
+                                   if isinstance(src, dict) else None)
+                            if src is None:
+                                break
+                        if src is not None:
+                            vals = src if isinstance(src, list) else [src]
+                    tq[f] = vals
+            for v in node.values():
+                self._resolve_terms_lookup(v)
+        elif isinstance(node, list):
+            for v in node:
+                self._resolve_terms_lookup(v)
+
+    def _h_search_inner(self, req, body):
         # search pipeline: resolve the normalization-processor config the
         # hybrid combination should use (neural-search's hook)
         pid = req.param("search_pipeline")
@@ -1483,6 +1595,12 @@ class RestController:
                     "expressions")
             return 200, self._ccs_search(expr, body)
         if scroll:
+            if body.get("size") == 0:
+                raise IllegalArgumentError(
+                    "[size] cannot be [0] in a scroll context")
+            if req.param("request_cache") == "true":
+                raise IllegalArgumentError(
+                    "[request_cache] cannot be used in a scroll context")
             if int(body.get("from", 0) or 0) > 0:
                 raise IllegalArgumentError(
                     "`from` parameter must be set to 0 when `scroll` is "

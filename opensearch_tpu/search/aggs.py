@@ -60,9 +60,9 @@ _METRIC_TYPES = {"min", "max", "sum", "avg", "value_count", "stats",
                  "weighted_avg", "percentile_ranks",
                  "median_absolute_deviation", "top_hits"}
 _BUCKET_TYPES = {"terms", "histogram", "date_histogram", "range",
-                 "date_range", "filter", "filters", "global", "missing",
-                 "significant_terms", "rare_terms", "multi_terms",
-                 "composite"}
+                 "date_range", "ip_range", "filter", "filters", "global",
+                 "missing", "significant_terms", "rare_terms",
+                 "multi_terms", "composite"}
 # pipeline aggs (search/pipeline_aggs.py) parse like any agg but collect
 # nothing shard-side; they run as a reduce post-pass
 from opensearch_tpu.search.pipeline_aggs import (  # noqa: E402
@@ -83,6 +83,10 @@ def _metric_subs(req):
     for s in req.subs:
         if s.type in _PIPELINE_TYPES or s.type == "top_hits":
             continue
+        if s.type == "composite":
+            raise IllegalArgumentError(
+                "[composite] aggregation cannot be used with a parent "
+                f"aggregation of type: [{req.type}]")
         if s.type not in _TUPLE_METRICS:
             raise IllegalArgumentError(
                 f"[{req.type}] does not support [{s.type}] "
@@ -125,8 +129,9 @@ def parse_aggs(aggs_json: dict) -> list[AggRequest]:
     return out
 
 
-_DURATION = re.compile(r"^(\d+)(ms|s|m|h|d)$")
-_DUR_MS = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000, "d": 86_400_000}
+_DURATION = re.compile(r"^(\d+)(nanos|micros|ms|s|m|h|d)$")
+_DUR_MS = {"nanos": 1e-6, "micros": 1e-3, "ms": 1, "s": 1000,
+           "m": 60_000, "h": 3_600_000, "d": 86_400_000}
 _CAL_FIXED_MS = {"second": 1000, "1s": 1000, "minute": 60_000, "1m": 60_000,
                  "hour": 3_600_000, "1h": 3_600_000, "day": 86_400_000,
                  "1d": 86_400_000, "week": 7 * 86_400_000, "1w": 7 * 86_400_000}
@@ -188,11 +193,25 @@ def build_date_edges(lo: int, hi: int, calendar=None, fixed=None,
     return arr
 
 
+_NAMED_DATE_FORMATS = {
+    "iso8601": "__iso8601__",
+    "strict_date": "yyyy-MM-dd", "date": "yyyy-MM-dd",
+    "strict_date_time": "yyyy-MM-dd'T'HH:mm:ss.SSSZ",
+    "basic_date": "yyyyMMdd",
+    "year_month_day": "yyyy-MM-dd",
+    "strict_date_hour_minute_second": "yyyy-MM-dd'T'HH:mm:ss",
+}
+
+
 def _fmt_date(millis: int, fmt: str | None) -> str:
     if not fmt:
         return format_date_millis(int(millis))
+    fmt = _NAMED_DATE_FORMATS.get(fmt, fmt)
+    if fmt == "__iso8601__":
+        return format_date_millis(int(millis))
     py = (fmt.replace("yyyy", "%Y").replace("MM", "%m").replace("dd", "%d")
-          .replace("HH", "%H").replace("mm", "%M").replace("ss", "%S"))
+          .replace("HH", "%H").replace("mm", "%M").replace("ss", "%S")
+          .replace("'T'", "T"))
     dt = _dt.datetime.fromtimestamp(millis / 1000, tz=_dt.timezone.utc)
     return dt.strftime(py)
 
@@ -1089,6 +1108,10 @@ class AggregationExecutor:
         bucket/composite/CompositeAggregator.java).  Sources: terms,
         histogram, date_histogram."""
         sources = _composite_sources(req)
+        if int(req.params.get("size", 10)) > MAX_BUCKETS:
+            raise IllegalArgumentError(
+                f"Trying to create too many buckets "
+                f"({req.params.get('size')} > {MAX_BUCKETS})")
         if _top_hits_subs(req):
             raise IllegalArgumentError(
                 "[composite] does not support [top_hits] "
@@ -1096,21 +1119,29 @@ class AggregationExecutor:
         size = int(req.params.get("size", 10))
         after = req.params.get("after")
         if after is not None:
-            missing_srcs = [name for name, _f, _x, _o, _k in sources
-                            if name not in after]
+            missing_srcs = [s[0] for s in sources if s[0] not in after]
             if missing_srcs:
                 raise ParsingError(
                     f"[composite] after key is missing sources "
                     f"{missing_srcs}")
-        after_key = (tuple(after[name] for name, _f, _x, _o, _k in sources)
-                     if after is not None else None)
+        if after is not None:
+            vals = []
+            for name, _f, _x, _o, kind, _fmt in sources:
+                v = after[name]
+                if kind == "date" and isinstance(v, str) \
+                        and not v.lstrip("-").isdigit():
+                    v = parse_date_millis(v)
+                vals.append(v)
+            after_key = tuple(vals)
+        else:
+            after_key = None
         msubs = _metric_subs(req)
         merged: dict = {}
         sub_parts: dict = {}
         for seg, dseg, matched in seg_views:
             m = np.asarray(matched)[: seg.n_docs]
             per_source = []
-            for name, field, xform, _order, _kind in sources:
+            for name, field, xform, _order, _kind, _fmt in sources:
                 ft = self.ctx.field_type(field)
                 vals = self._doc_values_lists(field, ft, seg, m)
                 if xform is not None:
@@ -1326,49 +1357,116 @@ class AggregationExecutor:
             return ~exists & self.ctx.live_jnp(seg, dseg)
         return self._single_bucket(req, self._narrow(seg_views, mask_fn))
 
-    def _part_range(self, req, seg_views, is_date=False) -> dict:
+    def _part_range(self, req, seg_views, is_date=False,
+                    kind="numeric") -> dict:
         field, ft = self._field_type(req, "range")
         ranges = req.params.get("ranges")
         if not ranges:
             raise ParsingError("[range] aggregation requires [ranges]")
+
+        def parse_bound(v):
+            if v is None:
+                return None
+            if is_date:
+                # the FIELD's parser honors format: epoch_second etc.
+                return (ft.range_bound(v) if ft is not None
+                        else parse_date_millis(v))
+            if kind == "ip":
+                from opensearch_tpu.mapping.types import parse_ip_long
+                return parse_ip_long(v)
+            return float(v)
+
+        # buckets sort by (from asc, to asc) regardless of request
+        # order (RangeAggregator's range sorting)
+        def _order_key(r):
+            f = parse_bound(r.get("from"))
+            t = parse_bound(r.get("to"))
+            return (-np.inf if f is None else f,
+                    np.inf if t is None else t)
+        ranges = sorted(ranges, key=_order_key)
         buckets = []
         for r in ranges:
             frm = r.get("from")
             to = r.get("to")
-            if is_date:
-                frm_v = parse_date_millis(frm) if frm is not None else None
-                to_v = parse_date_millis(to) if to is not None else None
-            else:
-                frm_v = float(frm) if frm is not None else None
-                to_v = float(to) if to is not None else None
+            frm_v = parse_bound(frm)
+            to_v = parse_bound(to)
+            inc_hi = bool(r.get("_to_inclusive", False))
 
-            def mask_fn(seg, dseg, frm_v=frm_v, to_v=to_v):
+            missing = req.params.get("missing")
+            missing_v = parse_bound(missing) if missing is not None \
+                else None
+            lo_b = -np.inf if frm_v is None else frm_v
+            hi_b = np.inf if to_v is None else to_v
+            missing_in = (missing_v is not None and lo_b <= missing_v
+                          and (missing_v <= hi_b if inc_hi
+                               else missing_v < hi_b))
+
+            def mask_fn(seg, dseg, frm_v=frm_v, to_v=to_v,
+                        inc_hi=inc_hi, missing_in=missing_in):
                 col = self._dev_numeric(dseg, field)
                 if col is None:
+                    if missing_in:      # every doc lacks the field
+                        return jnp.ones(dseg.n_pad, bool)
                     return jnp.zeros(dseg.n_pad, bool)
                 from opensearch_tpu.ops.filters import range_mask
                 lo = -np.inf if frm_v is None else frm_v
                 hi = np.inf if to_v is None else to_v
                 vals = col["values"].astype(jnp.float64)
-                return range_mask(vals, col["value_docs"], lo, hi,
-                                  include_lo=True, include_hi=False,
-                                  n_pad=dseg.n_pad)
+                hit = range_mask(vals, col["value_docs"], lo, hi,
+                                 include_lo=True, include_hi=inc_hi,
+                                 n_pad=dseg.n_pad)
+                if missing_in:
+                    # docs without a value take the [missing] value
+                    hit = hit | ~col["exists"]
+                return hit
             narrowed = self._narrow(seg_views, mask_fn)
             key = r.get("key")
             if key is None:
-                key = (f"{'*' if frm is None else frm}-"
-                       f"{'*' if to is None else to}")
+                def _bound(raw, parsed):
+                    if raw is None:
+                        return "*"
+                    if is_date:
+                        # numeric literals echo verbatim; date STRINGS
+                        # render at millis precision
+                        if isinstance(raw, str) and not str(
+                                raw).lstrip("-").isdigit():
+                            return format_date_millis(int(parsed))
+                        return str(raw)
+                    if kind == "ip":
+                        return str(raw)
+                    return str(float(parsed))
+                key = _bound(frm, frm_v) + "-" + _bound(to, to_v)
             b = self._single_bucket(req, narrowed)
             b["key"] = key
             if frm is not None:
-                b["from"] = frm_v
+                b["from"] = frm if kind == "ip" else frm_v
             if to is not None:
-                b["to"] = to_v
+                b["to"] = to if kind == "ip" else to_v
             buckets.append(b)
         return {"t": "ranges", "buckets": buckets}
 
     def _part_date_range(self, req, seg_views) -> dict:
         return self._part_range(req, seg_views, is_date=True)
+
+    def _part_ip_range(self, req, seg_views) -> dict:
+        """ip_range: from/to ip literals or CIDR masks over the monotone
+        int64 ip column (bucket/range/IpRangeAggregationBuilder; a mask
+        becomes an INCLUSIVE [network, broadcast] range)."""
+        import ipaddress
+
+        ranges = []
+        for r in req.params.get("ranges") or []:
+            if "mask" in r:
+                net = ipaddress.ip_network(str(r["mask"]), strict=False)
+                ranges.append({"key": r.get("key", str(r["mask"])),
+                               "from": str(net.network_address),
+                               "to": str(ipaddress.ip_address(
+                                   int(net.broadcast_address) + 1))})
+            else:
+                ranges.append(dict(r))
+        req2 = AggRequest(req.name, "ip_range",
+                          {**req.params, "ranges": ranges}, req.subs)
+        return self._part_range(req2, seg_views, kind="ip")
 
 
 # ---------------------------------------------------------------------------
@@ -1803,7 +1901,7 @@ def _composite_sources(req):
 
     sources = req.params.get("sources")
     if not isinstance(sources, list) or not sources:
-        raise ParsingError("[composite] requires a [sources] array")
+        raise ParsingError("Required [sources]")
     out = []
     for s in sources:
         if not isinstance(s, dict) or len(s) != 1:
@@ -1842,17 +1940,26 @@ def _composite_sources(req):
                         tzinfo=_dt.timezone.utc).timestamp() * 1000)
             else:
                 fixed = cfg.get("fixed_interval") or cfg.get("interval")
-                if fixed is None:
-                    raise ParsingError(
-                        f"[composite] source [{name}] requires an interval")
-                ms = (_CAL_FIXED_MS.get(calendar)
-                      or _parse_duration_ms(fixed))
-                xform = lambda v, m=ms: (int(v) // m) * m  # noqa: E731
+                ms = _CAL_FIXED_MS.get(calendar)
+                if ms is None:
+                    if fixed is None:
+                        raise ParsingError(
+                            f"[composite] source [{name}] requires an "
+                            "interval")
+                    ms = _parse_duration_ms(fixed)
+                off = cfg.get("offset", 0)
+                if isinstance(off, str) and off:
+                    off = (_parse_duration_ms(off.lstrip("+-"))
+                           * (-1 if off.startswith("-") else 1))
+                off = int(off)
+                xform = (lambda v, m=ms, o=off:
+                         ((int(v) - o) // m) * m + o)  # noqa: E731
             kind = "date"
         else:
             raise ParsingError(
                 f"[composite] source type [{styp}] is not supported")
-        out.append((name, field, xform, order, kind))
+        out.append((name, field, xform, order, kind,
+                    cfg.get("format")))
     return out
 
 
@@ -1860,7 +1967,7 @@ def _composite_sort_key(sources):
     """Comparable wrapper honoring each source's asc/desc order."""
     import functools
 
-    orders = [o for _n, _f, _x, o, _k in sources]
+    orders = [s[3] for s in sources]
 
     def cmp(a, b):
         for av, bv, o in zip(a, b, orders):
@@ -1882,9 +1989,9 @@ def _red_composite(req, parts):
     sub_parts: dict = {}
     for p in parts:
         for key, count, subs in p["buckets"]:
-            key = tuple(int(v) if kind == "date"
-                        else (float(v) if kind == "histogram" else v)
-                        for v, (_n, _f, _x, _o, kind) in zip(key, sources))
+            key = tuple(int(v) if s[4] == "date"
+                        else (float(v) if s[4] == "histogram" else v)
+                        for v, s in zip(key, sources))
             merged[key] = merged.get(key, 0) + count
             for sname, tup in subs.items():
                 prev = sub_parts.get((sname, key))
@@ -1895,8 +2002,13 @@ def _red_composite(req, parts):
     items = sorted(merged.items(), key=lambda kv: K(kv[0]))[:size]
     buckets = []
     for key, count in items:
-        b = {"key": {name: v for v, (name, *_rest) in zip(key, sources)},
-             "doc_count": int(count)}
+        rendered = {}
+        for v, s in zip(key, sources):
+            name, kind, fmt = s[0], s[4], s[5]
+            if kind == "date" and fmt:
+                v = _fmt_date(int(v), fmt)
+            rendered[name] = v
+        b = {"key": rendered, "doc_count": int(count)}
         for sub in _metric_subs(req):
             tup = sub_parts.get((sub.name, key))
             b[sub.name] = _finish_metric(
@@ -2081,4 +2193,5 @@ _REDUCERS = {
     "missing": _red_single,
     "range": _red_ranges,
     "date_range": _red_ranges,
+    "ip_range": _red_ranges,
 }

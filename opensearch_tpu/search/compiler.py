@@ -247,7 +247,11 @@ def _c_match(q, ctx, scored):
             if q.lenient:
                 return _none()
             raise
-    terms = ft.search_terms(q.query, ctx.mapper.analyzers)
+    qa = getattr(q, "analyzer", None)
+    if qa:
+        terms = ctx.mapper.analyzers.get(qa).terms(str(q.query))
+    else:
+        terms = ft.search_terms(q.query, ctx.mapper.analyzers)
     if not terms:
         return _none()
     if q.fuzziness is not None:
@@ -306,6 +310,29 @@ def _c_match_phrase(q, ctx, scored):
 
 
 def _c_multi_match(q, ctx, scored):
+    if q.type == "bool_prefix":
+        # dis-max of per-field match_bool_prefix
+        # (MultiMatchQueryBuilder.Type.BOOL_PREFIX)
+        plans, binds = [], []
+        for field, fboost in q.fields:
+            if ctx.field_type(field) is None:
+                continue
+            p, b = _c_match_bool_prefix(dsl.MatchBoolPrefixQuery(
+                field=field, query=q.query, operator=q.operator,
+                analyzer=getattr(q, "analyzer", None),
+                minimum_should_match=q.minimum_should_match,
+                fuzziness=getattr(q, "fuzziness", None),
+                boost=q.boost * fboost), ctx, scored)
+            if not isinstance(p, P.MatchNonePlan):
+                plans.append(p)
+                binds.append(b)
+        if not plans:
+            return _none()
+        if len(plans) == 1:
+            return plans[0], binds[0]
+        return (P.DisMaxPlan(children=tuple(plans)),
+                {"boost": 1.0, "tie_breaker": q.tie_breaker,
+                 "children": tuple(binds)})
     if q.type not in ("best_fields", "most_fields", "phrase"):
         raise IllegalArgumentError(
             f"multi_match type [{q.type}] is not supported")
@@ -330,6 +357,7 @@ def _c_multi_match(q, ctx, scored):
                                  operator=q.operator,
                                  minimum_should_match=q.minimum_should_match,
                                  lenient=getattr(q, "lenient", False),
+                                 analyzer=getattr(q, "analyzer", None),
                                  boost=q.boost * fboost)
             p, b = _c_match(sub, ctx, scored)
         if not isinstance(p, P.MatchNonePlan):
@@ -1035,7 +1063,7 @@ def _c_distance_feature(q, ctx, scored):
         origin = parse_geo_point(q.origin)
         pivot = parse_distance_m(q.pivot)
         kind = "geo"
-    elif ft.type_name == "date":
+    elif ft.type_name in ("date", "date_nanos"):
         from opensearch_tpu.search.aggs import _parse_duration_ms
         origin = float(parse_date_millis(q.origin))
         pivot = float(_parse_duration_ms(q.pivot)
@@ -1176,25 +1204,39 @@ def _c_match_bool_prefix(q, ctx, scored):
     if not isinstance(ft, TextFieldType):
         return _c_term(dsl.TermQuery(field=q.field, value=q.query,
                                      boost=q.boost), ctx, scored)
-    terms = ft.search_terms(str(q.query), ctx.mapper.analyzers)
+    analyzer_name = getattr(q, "analyzer", None)
+    if analyzer_name:
+        terms = ctx.mapper.analyzers.get(analyzer_name).terms(
+            str(q.query))
+    else:
+        terms = ft.search_terms(str(q.query), ctx.mapper.analyzers)
     if not terms:
         return _none()
-    clauses: list = [dsl.TermQuery(field=q.field, value=t)
-                     for t in terms[:-1]]
+    fuzz = getattr(q, "fuzziness", None)
+    if fuzz is not None:
+        clauses = [dsl.FuzzyQuery(field=q.field, value=t,
+                                  fuzziness=fuzz) for t in terms[:-1]]
+    else:
+        clauses = [dsl.TermQuery(field=q.field, value=t)
+                   for t in terms[:-1]]
     expansions = _expand_prefix_terms(ctx, q.field, terms[-1],
                                       int(q.max_expansions))
-    if not expansions:
+    if expansions:
+        # capped dictionary expansion, like the phrase-prefix sibling
+        clauses.append(dsl.TermsQuery(field=q.field, values=expansions)
+                       if len(expansions) > 1
+                       else dsl.TermQuery(field=q.field,
+                                          value=expansions[0]))
+    elif not clauses:
         return _none()
-    # capped dictionary expansion, like the phrase-prefix sibling
-    clauses.append(dsl.TermsQuery(field=q.field, values=expansions)
-                   if len(expansions) > 1
-                   else dsl.TermQuery(field=q.field,
-                                      value=expansions[0]))
+    # an unexpandable prefix contributes nothing; other clauses (e.g.
+    # fuzzy terms) still match under OR semantics
     if q.operator == "and":
         return compile_query(dsl.BoolQuery(must=clauses, boost=q.boost),
                              ctx, scored)
+    msm = getattr(q, "minimum_should_match", None) or "1"
     return compile_query(dsl.BoolQuery(should=clauses,
-                                       minimum_should_match="1",
+                                       minimum_should_match=str(msm),
                                        boost=q.boost), ctx, scored)
 
 
@@ -1355,9 +1397,10 @@ def _c_intervals(q, ctx, scored):
                 f"[intervals] rule must have exactly one key, got "
                 f"{sorted(rule)}")
         kind, body = next(iter(rule.items()))
-        allowed = {"match": {"query", "ordered", "max_gaps"},
+        allowed = {"match": {"query", "ordered", "max_gaps", "mode"},
                    "any_of": {"intervals"},
-                   "all_of": {"intervals", "ordered", "max_gaps"}}
+                   "all_of": {"intervals", "ordered", "max_gaps",
+                              "mode"}}
         if kind in allowed and isinstance(body, dict):
             extra = set(body) - allowed[kind]
             if extra:
@@ -1372,7 +1415,9 @@ def _c_intervals(q, ctx, scored):
             terms = rule_terms(rule)
             if not terms:
                 return _none()
-            ordered = bool(body.get("ordered", False))
+            mode = body.get("mode")
+            ordered = (mode == "ordered" if mode is not None
+                       else bool(body.get("ordered", False)))
             max_gaps = int(body.get("max_gaps", -1))
             if len(terms) == 1:
                 return _term_bag(ctx, q.field, terms, 1, q.boost, scored)
@@ -1393,6 +1438,8 @@ def _c_intervals(q, ctx, scored):
             if not subs:
                 raise IllegalArgumentError(
                     f"[intervals] [{kind}] requires [intervals]")
+            if body.get("mode") is not None:
+                body = {**body, "ordered": body["mode"] == "ordered"}
             if kind == "all_of" and (body.get("ordered")
                                      or int(body.get("max_gaps", -1)) >= 0):
                 # positional all_of flattens iff every sub-rule is a
@@ -1424,9 +1471,51 @@ def _c_intervals(q, ctx, scored):
             return compile_query(dsl.BoolQuery(must=wrapped,
                                                boost=q.boost),
                                  ctx, scored)
+        if kind in ("prefix", "wildcard", "regexp", "fuzzy"):
+            # multi-term rules expand against the term dictionary and
+            # compile as a should-of-1 over the expansions
+            # (IntervalsSourceProvider's Prefix/Wildcard/Regexp/Fuzzy)
+            import re as _re
+
+            if kind == "prefix":
+                pat = str(body.get("prefix", ""))
+                terms = _expand_prefix_terms(ctx, q.field, pat, 128)
+            elif kind == "fuzzy":
+                term = str(body.get("term", ""))
+                return compile_query(dsl.FuzzyQuery(
+                    field=q.field, value=term,
+                    fuzziness=str(body.get("fuzziness", "AUTO")),
+                    prefix_length=int(body.get("prefix_length", 0)),
+                    boost=q.boost), ctx, scored)
+            else:
+                pat = str(body.get("pattern", ""))
+                flags = (_re.IGNORECASE
+                         if body.get("case_insensitive") else 0)
+                if kind == "wildcard":
+                    import fnmatch
+                    rx = _re.compile(fnmatch.translate(pat), flags)
+                else:
+                    rx = _re.compile(pat, flags)
+                terms = []
+                seen = set()
+                for seg in ctx.segments:
+                    if q.field not in seg.postings:
+                        continue
+                    for t in ctx.sorted_terms(seg, q.field):
+                        if t not in seen and rx.fullmatch(t):
+                            seen.add(t)
+                            terms.append(t)
+                        if len(terms) >= 128:
+                            break
+            if not terms:
+                return _none()
+            return compile_query(dsl.BoolQuery(
+                should=[dsl.TermQuery(field=q.field, value=t)
+                        for t in terms],
+                minimum_should_match="1", boost=q.boost), ctx, scored)
         raise IllegalArgumentError(
             f"[intervals] unsupported rule [{kind}] — supported: "
-            "match, any_of, all_of")
+            "match, any_of, all_of, prefix, wildcard, regexp, fuzzy")
 
     return compile_rule(q.rule)
 

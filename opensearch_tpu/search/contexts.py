@@ -131,7 +131,20 @@ class ReaderContextRegistry:
                     if exp <= now]:
             self._release(self._ctxs.pop(cid)[0])
 
+    # search.max_keep_alive (dynamic; node wires the consumer)
+    max_keep_alive_s = 24 * 3600.0
+
+    def _check_keepalive(self, keepalive_ms: int):
+        limit_ms = int(self.max_keep_alive_s * 1000)
+        if keepalive_ms > limit_ms:
+            raise IllegalArgumentError(
+                f"Keep alive for request ({keepalive_ms}ms) is too "
+                f"large. It must be less than ({limit_ms}ms). This "
+                "limit can be set by changing the [search.max_keep_"
+                "alive] cluster level setting.")
+
     def open(self, ctx, keepalive_ms: int) -> str:
+        self._check_keepalive(keepalive_ms)
         with self._lock:
             self._reap()
             if len(self._ctxs) >= self._max_open:
@@ -155,6 +168,7 @@ class ReaderContextRegistry:
                     f"No search context found for id [{cid}]")
             ctx, _exp, ka = entry
             if keepalive_ms is not None:
+                self._check_keepalive(keepalive_ms)
                 ka = keepalive_ms
             self._ctxs[cid] = (ctx, self._now() * 1000 + ka, ka)
             return ctx

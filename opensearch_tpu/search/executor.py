@@ -200,6 +200,14 @@ class ShardSearcher:
         sort_specs = _parse_sort(body.get("sort"))
         min_score = body.get("min_score")
         source_spec = body.get("_source")
+        stored = body.get("stored_fields")
+        if stored is not None and source_spec is None:
+            # legacy stored_fields: _source returns only when asked for
+            # explicitly (RestSearchAction's stored-fields contract)
+            if isinstance(stored, str):
+                stored = [stored]
+            if "_source" not in stored:
+                source_spec = False
         search_after = body.get("search_after")
         if search_after is not None:
             if sort_specs is None:
@@ -311,6 +319,11 @@ class ShardSearcher:
         if body.get("suggest"):
             from opensearch_tpu.search.suggest import run_suggest
             resp["suggest"] = run_suggest(body["suggest"], self.ctx)
+            for entries in resp["suggest"].values():
+                for entry in entries:
+                    for opt in entry.get("options", ()):
+                        if "_id" in opt and "_index" not in opt:
+                            opt["_index"] = self.index_name
         return resp
 
     def _hybrid_search(self, body: dict, q, t0,
@@ -597,14 +610,34 @@ class ShardSearcher:
         cmp = _sort_comparator(sort_specs)
         rows.sort(key=functools.cmp_to_key(cmp))
         if search_after is not None:
-            probe = {"sort": list(search_after), "seg": _I32_MAX,
+            coerced = []
+            for v, spec in zip(search_after, sort_specs):
+                ft = (None if spec["field"] == "_score"
+                      else self.ctx.field_type(spec["field"]))
+                if ft is not None and isinstance(v, str) \
+                        and ft.dv_kind in ("long", "double"):
+                    # date strings etc. compare in COLUMN space
+                    v = ft.range_bound(v)
+                coerced.append(v)
+            probe = {"sort": coerced, "seg": _I32_MAX,
                      "local": _I32_MAX}
             rows = [r for r in rows if cmp(r, probe) > 0]
         out = []
+        nanos_mult = [1_000_000 if (spec["field"] != "_score"
+                                    and getattr(self.ctx.field_type(
+                                        spec["field"]), "type_name", "")
+                                    == "date_nanos") else None
+                      for spec in sort_specs]
         for row in rows[:k_want]:
+            vals = []
+            for v, mult in zip(row["sort"], nanos_mult):
+                sv = _sort_value(v)
+                # date_nanos sort keys render in NANOS (the reference's
+                # resolution-aware sort serialization)
+                vals.append(sv * mult if mult and isinstance(
+                    sv, int) else sv)
             out.append({"seg": row["seg"], "local": row["local"],
-                        "score": None,
-                        "sort": [_sort_value(v) for v in row["sort"]]})
+                        "score": None, "sort": vals})
         return out, total, None
 
     def _rescored(self, rows, rescore):

@@ -121,6 +121,14 @@ def sibling_values(level: dict, path: str, gap_policy: str):
     node = level
     for i, part in enumerate(parts):
         nxt = node.get(part) if isinstance(node, dict) else None
+        if nxt is None and "." in part and isinstance(node, dict):
+            # "agg.metric" dot form: split at the first dot that names
+            # an agg at this level (BucketsPath's AGG_PATH separators)
+            name, _, rest = part.partition(".")
+            if name in node:
+                nxt = node[name]
+                parts = parts[:i] + [name, rest] + parts[i + 1:]
+                part = name
         if nxt is None:
             raise IllegalArgumentError(
                 f"No aggregation found for path [{path}]")
@@ -131,7 +139,12 @@ def sibling_values(level: dict, path: str, gap_policy: str):
                     f"No aggregation [metric] found for path [{path}]")
             vals, keys = [], []
             for b in _buckets_list(nxt):
-                vals.append(bucket_value(b, rest, gap_policy))
+                if gap_policy == "skip" and b.get("doc_count") == 0:
+                    # empty buckets are gaps to sibling metrics
+                    # (BucketMetricsPipelineAggregator.collectBucketValue)
+                    vals.append(None)
+                else:
+                    vals.append(bucket_value(b, rest, gap_policy))
                 keys.append(b.get("key"))
             return vals, keys
         node = nxt                      # single-bucket: descend

@@ -1432,11 +1432,14 @@ class FunctionScorePlan(Plan):
 
 
 def _edit_distance_le(a: str, b: str, k: int) -> bool:
-    """Banded Levenshtein: True iff edit_distance(a, b) <= k."""
+    """Banded optimal-string-alignment distance (Levenshtein WITH
+    transpositions — Lucene's fuzzy default, fuzzy_transpositions=true):
+    True iff distance(a, b) <= k."""
     if abs(len(a) - len(b)) > k:
         return False
     if k == 0:
         return a == b
+    prev2 = None
     prev = list(range(len(b) + 1))
     for i, ca in enumerate(a, 1):
         cur = [i] + [0] * len(b)
@@ -1447,9 +1450,12 @@ def _edit_distance_le(a: str, b: str, k: int) -> bool:
         for j in range(lo, hi + 1):
             cost = 0 if ca == b[j - 1] else 1
             cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+            if (prev2 is not None and i > 1 and j > 1
+                    and ca == b[j - 2] and a[i - 2] == b[j - 1]):
+                cur[j] = min(cur[j], prev2[j - 2] + 1)   # transposition
         for j in range(hi + 1, len(b) + 1):
             cur[j] = k + 1
-        prev = cur
+        prev2, prev = prev, cur
         if min(prev) > k:
             return False
     return prev[len(b)] <= k

@@ -52,6 +52,7 @@ class MatchQuery(Query):
     minimum_should_match: Optional[str] = None
     fuzziness: Optional[str] = None
     lenient: bool = False           # format mismatch -> no match, not 400
+    analyzer: Optional[str] = None
 
 
 @dataclass
@@ -75,6 +76,9 @@ class MatchBoolPrefixQuery(Query):
     query: Any = None
     operator: str = "or"
     max_expansions: int = 50
+    minimum_should_match: Optional[str] = None
+    analyzer: Optional[str] = None
+    fuzziness: Optional[str] = None
 
 
 @dataclass
@@ -103,6 +107,8 @@ class MultiMatchQuery(Query):
     tie_breaker: float = 0.0
     minimum_should_match: Optional[str] = None
     lenient: bool = False
+    analyzer: Optional[str] = None
+    fuzziness: Optional[str] = None
 
 
 @dataclass
@@ -431,6 +437,7 @@ def _parse_match(body):
                 None if v.get("minimum_should_match") is None
                 else str(v.get("minimum_should_match"))),
             fuzziness=v.get("fuzziness"),
+            analyzer=v.get("analyzer"),
             boost=_boost(v))
     return MatchQuery(field=field, query=v)
 
@@ -455,6 +462,9 @@ def _parse_multi_match(body):
         minimum_should_match=(
             None if body.get("minimum_should_match") is None
             else str(body.get("minimum_should_match"))),
+        analyzer=body.get("analyzer"),
+        fuzziness=(None if body.get("fuzziness") is None
+                   else str(body.get("fuzziness"))),
         boost=_boost(body))
 
 
@@ -614,6 +624,10 @@ def _parse_match_bool_prefix(body):
             field=field, query=v.get("query"),
             operator=str(v.get("operator", "or")).lower(),
             max_expansions=int(v.get("max_expansions", 50)),
+            minimum_should_match=v.get("minimum_should_match"),
+            analyzer=v.get("analyzer"),
+            fuzziness=(None if v.get("fuzziness") is None
+                       else str(v.get("fuzziness"))),
             boost=_boost(v))
     return MatchBoolPrefixQuery(field=field, query=v)
 

@@ -188,7 +188,7 @@ def completion_suggest(ctx, prefix: str, spec: dict) -> list[dict]:
         raise ParsingError("[completion] requires a [field]")
     size = int(spec.get("size", 5))
     skip_dup = bool(spec.get("skip_duplicates", False))
-    best: dict[str, tuple] = {}      # input -> (weight, doc_id)
+    best: dict[str, tuple] = {}      # input -> (weight, doc_id, seg, d)
     for seg in ctx.segments:
         dv = seg.ordinal_dv.get(field)
         if dv is None or not dv.ord_terms:
@@ -216,15 +216,19 @@ def completion_suggest(ctx, prefix: str, spec: dict) -> list[dict]:
                 w = weights.get((d, text), 1)
                 cur = best.get(text)
                 if cur is None or w > cur[0]:
-                    best[text] = (w, seg.doc_ids[d])
+                    best[text] = (w, seg.doc_ids[d], seg, d)
     ranked = sorted(best.items(), key=lambda kv: (-kv[1][0], kv[0]))
     seen_docs: set = set()
     options = []
-    for text, (w, doc_id) in ranked:
+    for text, (w, doc_id, seg, d) in ranked:
         if skip_dup and doc_id in seen_docs:
             continue
         seen_docs.add(doc_id)
-        options.append({"text": text, "_id": doc_id, "_score": float(w)})
+        opt = {"text": text, "_id": doc_id, "_score": float(w)}
+        src_doc = seg.source(d)
+        if src_doc is not None:
+            opt["_source"] = src_doc
+        options.append(opt)
         if len(options) >= size:
             break
     return [{"text": prefix, "offset": 0, "length": len(prefix),

@@ -29,6 +29,17 @@ import yaml
 SUPPORTED_FEATURES = {"stash_in_key", "stash_in_path", "stash_path_replace",
                       "contains", "close_to"}
 
+def _json_date(o):
+    """YAML parses bare ISO timestamps into datetime objects; they ship
+    as the ISO string the author wrote."""
+    import datetime
+
+    if isinstance(o, (datetime.datetime, datetime.date)):
+        s = o.isoformat()
+        return s.replace("+00:00", "Z")
+    raise TypeError(f"not JSON serializable: {o!r}")
+
+
 _CATCH_STATUS = {"bad_request": (400, 400), "unauthorized": (401, 401),
                  "forbidden": (403, 403), "missing": (404, 404),
                  "request_timeout": (408, 408), "conflict": (409, 409),
@@ -106,7 +117,9 @@ class YamlRunner:
         url = self.base_url + path
         if query:
             url += "?" + urllib.parse.urlencode(
-                {k: (str(v).lower() if isinstance(v, bool) else v)
+                {k: (str(v).lower() if isinstance(v, bool)
+                     else ",".join(str(x) for x in v)
+                     if isinstance(v, list) else v)
                  for k, v in query.items()})
         data = None
         hdrs = {"Content-Type": "application/json"}
@@ -119,7 +132,7 @@ class YamlRunner:
             elif isinstance(body, str):
                 data = body.encode()
             else:
-                data = json.dumps(body).encode()
+                data = json.dumps(body, default=_json_date).encode()
         hdrs.update(headers or {})
         req = urllib.request.Request(url, data=data, method=method,
                                      headers=hdrs)
@@ -133,6 +146,10 @@ class YamlRunner:
 
     @staticmethod
     def _parse(raw: bytes, ctype):
+        return YamlRunner._parse_impl(raw, ctype)
+
+    @staticmethod
+    def _parse_impl(raw: bytes, ctype):
         if ctype and "json" in ctype:
             return json.loads(raw) if raw else {}
         return raw.decode(errors="replace")

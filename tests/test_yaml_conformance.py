@@ -111,6 +111,59 @@ SUITES = {
     },
     "indices.exists/10_basic.yml": None,
     "indices.refresh/10_basic.yml": None,
+    "search/10_source_filtering.yml": {
+        "docvalue_fields with explicit format":
+            "docvalue_fields DecimalFormat rendering",
+    },
+    "search/20_default_values.yml": None,
+    "search/60_query_string.yml": None,
+    "search/90_search_after.yml": {
+        "date_nanos": "sub-millisecond date_nanos precision",
+        "unsigned long": "unsigned_long above 2^63 saturates",
+    },
+    "search/110_field_collapsing.yml": {
+        "field collapsing, inner_hits, and fields":
+            "collapse inner_hits",
+        "field collapsing, inner_hits and maxConcurrentGroupRequests":
+            "collapse inner_hits",
+    },
+    "search/170_terms_query.yml": None,
+    "search/220_total_hits_object.yml": None,
+    "search/230_interval_query.yml": {
+        "Test unordered with no overlap in match":
+            "non-overlap constraint in unordered interval pairs",
+        "Test ordered combination with disjunction via mode":
+            "ordered all_of over multi-term sub-rules",
+    },
+    "search/250_distance_feature.yml": None,
+    "search/310_match_bool_prefix.yml": {
+        "multi_match multiple fields with boost":
+            "per-field boost in bool_prefix dis-max tie ordering",
+        "multi_match multiple fields with slop throws exception":
+            "slop validation on bool_prefix",
+    },
+    "scroll/10_basic.yml": None,
+    "scroll/11_clear.yml": None,
+    "scroll/12_slices.yml": {
+        "Sliced scroll": "per-slice totals diverge on single-shard slices",
+        "Sliced scroll with invalid arguments": "slice arg validation",
+    },
+    "scroll/20_keep_alive.yml": None,
+    "indices.create/10_basic.yml": None,
+    "search.aggregation/10_histogram.yml": {
+        "Format test": "numeric key_as_string DecimalFormat",
+        "date_histogram on range": "date_range field type",
+        "date_histogram on range with offset": "date_range field type",
+    },
+    "search.aggregation/230_composite.yml": {
+        "Composite aggregation with nested parent":
+            "nested aggregation type",
+    },
+    "search.aggregation/40_range.yml": None,
+    "cat.aliases/10_basic.yml": {
+        "Help": "_cat help table not implemented",
+    },
+    "suggest/20_completion.yml": None,
     "cat.count/10_basic.yml": {
         "Test cat count help": "_cat help table not implemented",
     },
