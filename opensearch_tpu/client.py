@@ -56,8 +56,12 @@ _HTTP_EXCEPTIONS = {400: RequestError, 403: AuthorizationException,
 
 
 class Transport:
-    def __init__(self, hosts, timeout: float = 30.0, http_auth=None):
+    def __init__(self, hosts, timeout: float = 30.0, http_auth=None,
+                 headers: Optional[dict] = None):
         import base64
+        # default headers sent on every request (opaque id / traceparent
+        # attribution, like opensearch-py's per-client headers)
+        self.default_headers = dict(headers or {})
         self._auth_header = None
         if http_auth:
             if isinstance(http_auth, (tuple, list)):
@@ -84,7 +88,7 @@ class Transport:
                             for k, v in params.items() if v is not None})
             if qs:
                 path = f"{path}?{qs}"
-        hdrs = dict(headers or {})
+        hdrs = {**self.default_headers, **(headers or {})}
         if self._auth_header and "Authorization" not in hdrs:
             hdrs["Authorization"] = self._auth_header
         if isinstance(body, (dict, list)):
@@ -293,17 +297,27 @@ class NodesClient(_Namespace):
         return self.transport.perform_request("GET", "/_nodes/stats",
                                               params)
 
+    def trace(self, params=None):
+        """Recent spans from the node's in-memory trace exporter
+        (this engine's GET /_nodes/trace debug endpoint)."""
+        return self.transport.perform_request("GET", "/_nodes/trace",
+                                              params)
+
+    def hot_threads(self, params=None):
+        return self.transport.perform_request(
+            "GET", "/_nodes/hot_threads", params)
+
 
 class OpenSearch:
     """Drop-in analog of ``opensearchpy.OpenSearch`` for this node."""
 
     def __init__(self, hosts=None, timeout: float = 30.0, http_auth=None,
-                 **_ignored):
+                 headers=None, **_ignored):
         hosts = hosts or [{"host": "localhost", "port": 9200}]
         if isinstance(hosts, (str, dict)):
             hosts = [hosts]
         self.transport = Transport(hosts, timeout=timeout,
-                                   http_auth=http_auth)
+                                   http_auth=http_auth, headers=headers)
         self.indices = IndicesClient(self.transport)
         self.cluster = ClusterClient(self.transport)
         self.cat = CatClient(self.transport)

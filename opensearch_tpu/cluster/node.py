@@ -643,37 +643,49 @@ class ClusterNode:
         sub["from"] = 0
         sub["size"] = from_ + size
 
-        responses = []
-        futures = []
-        for node, shards in by_node.items():
-            payload = {"index": index, "shards": shards, "body": sub,
-                       "agg_partials": aggs_requested}
-            if node == self.node_id:
-                responses.append(self._h_search_shards(payload))
-            else:
-                futures.append(self.transport.submit_request(
-                    node, A_SEARCH_SHARDS, payload))
-        for fut in futures:
-            responses.append(fut.result(timeout=30.0))
+        from opensearch_tpu.common.telemetry import tracer
 
-        all_hits = []
-        total = 0
-        max_score = None
-        rows = []
-        for node_idx, resp in enumerate(responses):
-            r = resp["resp"]
-            for pos, h in enumerate(r["hits"]["hits"]):
-                rows.append((h, node_idx, pos))
-            total += r["hits"]["total"]["value"]
-            ms = r["hits"]["max_score"]
-            if ms is not None and (max_score is None or ms > max_score):
-                max_score = ms
-        all_hits = merge_hit_rows(rows, body.get("sort"))
+        # coordinator span: the scatter RPCs inject its trace context, so
+        # every remote shard query phase parents under this trace
+        with tracer().start_span(
+                "search.coordinator",
+                {"index": index, "node": self.node_id,
+                 "shards": len(routing), "nodes": len(by_node)}):
+            responses = []
+            futures = []
+            for node, shards in by_node.items():
+                payload = {"index": index, "shards": shards, "body": sub,
+                           "agg_partials": aggs_requested}
+                if node == self.node_id:
+                    responses.append(self._h_search_shards(payload))
+                else:
+                    futures.append(self.transport.submit_request(
+                        node, A_SEARCH_SHARDS, payload))
+            for fut in futures:
+                responses.append(fut.result(timeout=30.0))
+
+            total = 0
+            max_score = None
+            rows = []
+            for node_idx, resp in enumerate(responses):
+                r = resp["resp"]
+                for pos, h in enumerate(r["hits"]["hits"]):
+                    rows.append((h, node_idx, pos))
+                total += r["hits"]["total"]["value"]
+                ms = r["hits"]["max_score"]
+                if ms is not None and (max_score is None or ms > max_score):
+                    max_score = ms
+            with tracer().start_span("coordinator.reduce",
+                                     {"sources": len(responses),
+                                      "rows": len(rows)}):
+                all_hits = merge_hit_rows(rows, body.get("sort"))
         n_shards = len(routing)
         out = {
             "took": max((resp["resp"]["took"] for resp in responses),
                         default=0),
-            "timed_out": False,
+            # one shard running out of budget flags the whole response
+            "timed_out": any(resp["resp"].get("timed_out")
+                             for resp in responses),
             "_shards": {"total": n_shards, "successful": n_shards,
                         "skipped": 0, "failed": 0},
             "hits": {"total": {"value": total, "relation": "eq"},

@@ -25,10 +25,15 @@ class FsHealthService:
         self._healthy = True
         self._last_error: Optional[str] = None
         self._last_check_ms: Optional[int] = None
+        self._last_probe_elapsed_ms: Optional[int] = None
 
     def check(self) -> bool:
-        """One write+fsync probe; updates and returns health."""
+        """One write+fsync probe; updates and returns health.  The probe
+        is timed with a monotonic clock (the reference flags SLOW fsyncs
+        too, FsHealthService.monitorFSHealth) — wall clock only stamps
+        WHEN the check ran."""
         probe = os.path.join(self.data_path, self.PROBE_FILE)
+        t0 = time.monotonic()
         try:
             with open(probe, "wb") as f:
                 f.write(b"probe")
@@ -38,10 +43,12 @@ class FsHealthService:
             ok, err = True, None
         except OSError as e:
             ok, err = False, f"{type(e).__name__}: {e}"
+        elapsed_ms = int((time.monotonic() - t0) * 1000)
         with self._lock:
             self._healthy = ok
             self._last_error = err
-            self._last_check_ms = int(time.time() * 1000)
+            self._last_check_ms = int(time.time() * 1000)  # wall-clock: timestamp
+            self._last_probe_elapsed_ms = elapsed_ms
         return ok
 
     @property
@@ -56,4 +63,7 @@ class FsHealthService:
                 out["reason"] = self._last_error
             if self._last_check_ms is not None:
                 out["last_check_in_millis"] = self._last_check_ms
+            if self._last_probe_elapsed_ms is not None:
+                out["probe_elapsed_in_millis"] = \
+                    self._last_probe_elapsed_ms
             return out

@@ -47,12 +47,17 @@ class TaskCancelledException(OpenSearchTpuError):
 
 class Task:
     def __init__(self, task_id: int, action: str, description: str,
-                 cancellable: bool = True):
+                 cancellable: bool = True,
+                 headers: Optional[dict] = None):
         self.id = task_id
         self.action = action
         self.description = description
         self.cancellable = cancellable
-        self.start_time_millis = int(time.time() * 1000)
+        # request-attribution headers (the reference threads X-Opaque-Id
+        # from the REST request into every task it spawns — ref
+        # tasks/Task.java HEADERS_TO_COPY)
+        self.headers: dict = dict(headers or {})
+        self.start_time_millis = int(time.time() * 1000)  # wall-clock: timestamp
         self._start = time.monotonic()
         self._cancelled = threading.Event()
         self.cancel_reason: Optional[str] = None
@@ -74,13 +79,16 @@ class Task:
                 f"task [{self.id}] was cancelled: {self.cancel_reason}")
 
     def info(self) -> dict:
-        return {"id": self.id, "action": self.action,
-                "description": self.description,
-                "cancellable": self.cancellable,
-                "cancelled": self.cancelled,
-                "start_time_in_millis": self.start_time_millis,
-                "running_time_in_nanos": int(
-                    (time.monotonic() - self._start) * 1e9)}
+        out = {"id": self.id, "action": self.action,
+               "description": self.description,
+               "cancellable": self.cancellable,
+               "cancelled": self.cancelled,
+               "start_time_in_millis": self.start_time_millis,
+               "running_time_in_nanos": int(
+                   (time.monotonic() - self._start) * 1e9)}
+        if self.headers:
+            out["headers"] = dict(self.headers)
+        return out
 
 
 class TaskManager:
@@ -91,10 +99,12 @@ class TaskManager:
         self._next = 0
 
     def register(self, action: str, description: str = "",
-                 cancellable: bool = True) -> Task:
+                 cancellable: bool = True,
+                 headers: Optional[dict] = None) -> Task:
         with self._lock:
             self._next += 1
-            t = Task(self._next, action, description, cancellable)
+            t = Task(self._next, action, description, cancellable,
+                     headers=headers)
             self._tasks[t.id] = t
             return t
 

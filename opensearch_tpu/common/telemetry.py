@@ -1,0 +1,364 @@
+"""Telemetry SPI: distributed tracing + metrics registry.
+
+Analog of the reference's ``libs/telemetry`` (tracing/Tracer.java,
+metrics/MetricsRegistry.java) with the OTel plugin's behavior folded in
+at the fidelity this engine needs:
+
+- ``Tracer``: contextvar-scoped spans carrying W3C trace-context ids
+  (``traceparent`` header compatible, TracingContextPropagator analog).
+  Finished spans land in a bounded in-memory exporter the
+  ``GET /_nodes/trace`` debug endpoint reads — the InMemorySpanExporter
+  technique from the reference's telemetry tests.
+- ``MetricsRegistry``: named counters and fixed-bucket latency
+  histograms with percentile readout, surfaced by ``_nodes/stats``
+  under a ``telemetry`` section.
+
+Timing uses ``time.monotonic`` (durations must never jump with wall
+clock); span start/end wall timestamps are kept separately for display.
+Everything is cheap enough to stay always-on: a span is one small object
+and two dict writes, matching the reference's default no-sampling OTel
+configuration in tests.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+import uuid
+from bisect import bisect_left
+from collections import deque
+from typing import Optional
+
+_current_span: "contextvars.ContextVar[Optional[Span]]" = \
+    contextvars.ContextVar("opensearch_tpu_span", default=None)
+
+TRACEPARENT = "traceparent"
+
+
+class SpanContext:
+    """The propagatable identity of a span (trace_id + span_id) — what
+    crosses process/transport boundaries via ``traceparent``."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def to_traceparent(self) -> str:
+        # W3C trace-context: version-traceid-spanid-flags (sampled)
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    @staticmethod
+    def from_traceparent(value) -> "Optional[SpanContext]":
+        if not value or not isinstance(value, str):
+            return None
+        parts = value.strip().split("-")
+        if len(parts) < 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+            return None
+        try:
+            int(parts[1], 16)
+            int(parts[2], 16)
+        except ValueError:
+            return None
+        return SpanContext(parts[1], parts[2])
+
+
+class Span:
+    """One timed operation.  ``end()`` freezes the duration and ships the
+    span to the tracer's in-memory exporter."""
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 parent_span_id: Optional[str],
+                 attributes: Optional[dict] = None):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = uuid.uuid4().hex[:16]
+        self.parent_span_id = parent_span_id
+        self.attributes: dict = dict(attributes or {})
+        self.start_time_millis = int(time.time() * 1000)  # wall-clock: display timestamp
+        self._start = time.monotonic()
+        self.duration_nanos: Optional[int] = None
+        self.error: Optional[str] = None
+
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def set_attribute(self, key: str, value) -> "Span":
+        self.attributes[key] = value
+        return self
+
+    def record_error(self, err) -> None:
+        self.error = f"{type(err).__name__}: {err}"
+
+    def end(self) -> None:
+        if self.duration_nanos is not None:
+            return                       # idempotent
+        self.duration_nanos = int((time.monotonic() - self._start) * 1e9)
+        self.tracer._export(self)
+
+    def to_dict(self) -> dict:
+        out = {"name": self.name, "trace_id": self.trace_id,
+               "span_id": self.span_id,
+               "parent_span_id": self.parent_span_id,
+               "start_time_in_millis": self.start_time_millis,
+               "duration_in_nanos": self.duration_nanos,
+               "attributes": dict(self.attributes)}
+        if self.error is not None:
+            out["error"] = self.error
+        return out
+
+
+class Tracer:
+    """Contextvar-scoped span stack + bounded finished-span buffer.
+
+    ``start_span`` is a context manager: the new span becomes current for
+    the ``with`` body, so nested instrumentation parents automatically;
+    an explicit ``parent`` (a SpanContext extracted from transport
+    headers) overrides the ambient current span — that is how remote
+    shard executions join the coordinator's trace.
+    """
+
+    def __init__(self, max_spans: int = 2048):
+        self._finished: "deque[dict]" = deque(maxlen=max_spans)
+        self._lock = threading.Lock()
+
+    # -- span lifecycle ---------------------------------------------------
+
+    def begin_span(self, name: str, attributes: Optional[dict] = None,
+                   parent: "SpanContext | Span | None" = None) -> Span:
+        """Non-context-manager start (callers that end() across scopes)."""
+        if parent is None:
+            parent = _current_span.get()
+        if parent is None:
+            trace_id, parent_id = uuid.uuid4().hex, None
+        else:
+            trace_id = parent.trace_id
+            parent_id = (parent.span_id if isinstance(parent, SpanContext)
+                         else parent.span_id)
+        return Span(self, name, trace_id, parent_id, attributes)
+
+    @contextlib.contextmanager
+    def start_span(self, name: str, attributes: Optional[dict] = None,
+                   parent: "SpanContext | Span | None" = None):
+        span = self.begin_span(name, attributes, parent)
+        token = _current_span.set(span)
+        try:
+            yield span
+        except BaseException as e:
+            span.record_error(e)
+            raise
+        finally:
+            _current_span.reset(token)
+            span.end()
+
+    @staticmethod
+    def current() -> Optional[Span]:
+        return _current_span.get()
+
+    def _export(self, span: Span) -> None:
+        with self._lock:
+            self._finished.append(span.to_dict())
+
+    # -- context propagation (TracingContextPropagator analog) ------------
+
+    @staticmethod
+    def inject(headers: dict) -> dict:
+        """Write the current span's ``traceparent`` into ``headers`` (a
+        no-op outside any span)."""
+        span = _current_span.get()
+        if span is not None:
+            headers[TRACEPARENT] = span.context().to_traceparent()
+        return headers
+
+    @staticmethod
+    def extract(headers: Optional[dict]) -> Optional[SpanContext]:
+        if not headers:
+            return None
+        value = headers.get(TRACEPARENT)
+        if value is None:            # HTTP headers arrive case-insensitive
+            for k, v in headers.items():
+                if str(k).lower() == TRACEPARENT:
+                    value = v
+                    break
+        return SpanContext.from_traceparent(value)
+
+    # -- readout ----------------------------------------------------------
+
+    def recent(self, limit: int = 100,
+               trace_id: Optional[str] = None) -> list[dict]:
+        """Most-recent finished spans, newest first."""
+        with self._lock:
+            spans = list(self._finished)
+        spans.reverse()
+        if trace_id:
+            spans = [s for s in spans if s["trace_id"] == trace_id]
+        return spans[: max(0, int(limit))]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._finished.clear()
+
+
+# default latency buckets in milliseconds (upper bounds; +inf implied) —
+# the OTel explicit-bucket histogram shape the reference's metrics SPI
+# defaults to, shifted down for sub-ms device dispatches
+DEFAULT_BUCKETS_MS = (0.5, 1, 2, 5, 10, 25, 50, 100, 250, 500, 1000,
+                      2500, 5000, 10000, 30000)
+
+
+class Counter:
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket latency histogram with percentile readout.
+
+    Percentiles interpolate within the winning bucket (the Prometheus
+    ``histogram_quantile`` estimation), so p50/p99 stay meaningful
+    without storing raw samples.
+    """
+
+    def __init__(self, name: str, buckets=DEFAULT_BUCKETS_MS):
+        self.name = name
+        self.buckets = tuple(float(b) for b in buckets)
+        self._counts = [0] * (len(self.buckets) + 1)   # last = +inf
+        self._count = 0
+        self._sum = 0.0
+        self._max = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value_ms: float) -> None:
+        value_ms = float(value_ms)
+        idx = bisect_left(self.buckets, value_ms)
+        with self._lock:
+            self._counts[idx] += 1
+            self._count += 1
+            self._sum += value_ms
+            if value_ms > self._max:
+                self._max = value_ms
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 100]; linear interpolation inside the target bucket."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+            hi = self._max
+        if total == 0:
+            return 0.0
+        rank = (q / 100.0) * total
+        cum = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                up = self.buckets[i] if i < len(self.buckets) else hi
+                # no estimate may exceed the observed maximum (the raw
+                # bucket bound can overshoot badly for sparse data)
+                up = max(lo, min(up, hi))
+                frac = (rank - cum) / c
+                return lo + (up - lo) * min(max(frac, 0.0), 1.0)
+            cum += c
+        return hi
+
+    def stats(self) -> dict:
+        with self._lock:
+            count, total, mx = self._count, self._sum, self._max
+        out = {"count": count,
+               "sum_in_millis": round(total, 3),
+               "max_in_millis": round(mx, 3)}
+        if count:
+            out["avg_in_millis"] = round(total / count, 3)
+            out["percentiles"] = {
+                "50.0": round(self.percentile(50), 3),
+                "90.0": round(self.percentile(90), 3),
+                "99.0": round(self.percentile(99), 3)}
+        return out
+
+
+class MetricsRegistry:
+    """Named counters + histograms (libs/telemetry MetricsRegistry
+    analog).  Instruments are created on first use and live forever —
+    matching the reference's register-once semantics."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter(name))
+        return c
+
+    def histogram(self, name: str,
+                  buckets=DEFAULT_BUCKETS_MS) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(name,
+                                                Histogram(name, buckets))
+        return h
+
+    @contextlib.contextmanager
+    def time_ms(self, name: str):
+        """Time a block into histogram ``name`` (milliseconds)."""
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.histogram(name).observe((time.monotonic() - t0) * 1000)
+
+    def stats(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            histograms = dict(self._histograms)
+        return {"counters": {n: c.value
+                             for n, c in sorted(counters.items())},
+                "histograms": {n: h.stats()
+                               for n, h in sorted(histograms.items())}}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._histograms.clear()
+
+
+# -- process-wide defaults (the breaker_service() singleton pattern) -----
+#
+# Multi-node-in-one-process tests share these; spans carry a ``node``
+# attribute where the owning node matters.
+
+_tracer = Tracer()
+_metrics = MetricsRegistry()
+
+
+def tracer() -> Tracer:
+    return _tracer
+
+
+def metrics() -> MetricsRegistry:
+    return _metrics

@@ -204,6 +204,10 @@ class InternalEngine:
               if_primary_term: Optional[int] = None,
               version: Optional[int] = None,
               version_type: str = "internal") -> OpResult:
+        import time as _time
+
+        from opensearch_tpu.common.telemetry import metrics
+        t0 = _time.monotonic()
         with self._lock:
             self._ensure_open()
             entry = self._current_entry(doc_id)
@@ -219,7 +223,11 @@ class InternalEngine:
                                     seq_no=seq, version=new_version,
                                     record=True)
             self._seq_no = seq
-            return result
+        m = metrics()
+        m.counter("indexing.ops").inc()
+        m.histogram("indexing.index_ms").observe(
+            (_time.monotonic() - t0) * 1000)
+        return result
 
     def _do_index(self, doc_id, source, routing, seq_no, version,
                   record: bool) -> OpResult:
@@ -513,7 +521,11 @@ class InternalEngine:
         """Publish buffered writes + pending deletes to searchers
         (OpenSearchReaderManager.refresh analog).  Returns the number of
         docs in the new segment (0 if none was created)."""
-        with self._lock:
+        from opensearch_tpu.common.telemetry import metrics, tracer
+        with tracer().start_span(
+                "engine.refresh",
+                {"index": self.index_name, "shard": self.shard_id}), \
+                metrics().time_ms("indexing.refresh_ms"), self._lock:
             self._ensure_open()
             by_seg: dict[int, tuple[Segment, list[int]]] = {}
             for seg, local in self._pending_deletes:

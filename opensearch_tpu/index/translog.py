@@ -176,11 +176,13 @@ class Translog:
         if self._ops_since_sync == 0 and \
                 self._synced_offset == self._file.tell():
             return   # already durable: skip the double fsync per op
-        self._file.flush()
-        os.fsync(self._file.fileno())
-        self._synced_offset = self._file.tell()
-        self._ops_since_sync = 0
-        self._write_checkpoint()
+        from opensearch_tpu.common.telemetry import metrics
+        with metrics().time_ms("translog.sync_ms"):
+            self._file.flush()
+            os.fsync(self._file.fileno())
+            self._synced_offset = self._file.tell()
+            self._ops_since_sync = 0
+            self._write_checkpoint()
 
     def roll_generation(self):
         """Start a new generation file (pre-commit, rollGeneration analog)."""

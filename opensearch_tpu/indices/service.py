@@ -89,6 +89,18 @@ def deep_merge_doc(base: dict, patch: dict) -> dict:
     return out
 
 
+# cluster-level slowlog threshold defaults, keyed by the full dotted
+# setting (e.g. "search.slowlog.threshold.query.warn") — populated by
+# the node's _cluster/settings consumers; per-index settings override
+# (the reference's index-setting-with-node-default layering)
+SLOWLOG_DEFAULTS: dict = {}
+
+# severity order matters: the slowest matching threshold wins, highest
+# level first (SearchSlowLog's warn > info > debug > trace)
+_SLOWLOG_LEVELS = (("warn", 30), ("info", 20), ("debug", 10),
+                   ("trace", 5))
+
+
 def _parse_millis(v) -> int:
     """Time expression -> ms ("500ms", "1.5s", "1m", "1d", bare
     number=ms); -1 disables (the slow-log convention).  Unparseable
@@ -134,7 +146,7 @@ class IndexService:
         if self.num_shards < 1:
             raise IllegalArgumentError(
                 f"number_of_shards must be >= 1, got {self.num_shards}")
-        self.creation_date = int(time.time() * 1000)
+        self.creation_date = int(time.time() * 1000)  # wall-clock: timestamp
         self.uuid = uuid.uuid4().hex[:22]
         self.mapper = DocumentMapper(mappings or {})
         self._durability = settings.get("translog", {}).get("durability",
@@ -227,6 +239,7 @@ class IndexService:
         raw body length so the hot path never re-serializes just to
         measure)."""
         self._check_write_block()
+        t0 = time.monotonic()
         if doc_id is None:
             doc_id = uuid.uuid4().hex[:20]
         shard = self.route_shard(str(doc_id), routing)
@@ -239,9 +252,12 @@ class IndexService:
                 result = engine.index(str(doc_id), source,
                                       routing=routing, **kw)
                 engine.ensure_synced()
-            return result
-        result = engine.index(str(doc_id), source, routing=routing, **kw)
-        engine.ensure_synced()
+        else:
+            result = engine.index(str(doc_id), source, routing=routing,
+                                  **kw)
+            engine.ensure_synced()
+        self._maybe_indexing_slowlog(
+            int((time.monotonic() - t0) * 1000), result.doc_id, source)
         return result
 
     def delete_doc(self, doc_id: str, routing: Optional[str] = None,
@@ -612,24 +628,54 @@ class IndexService:
         self._maybe_slowlog(body, resp)
         return resp
 
+    def _slowlog_threshold(self, key: str):
+        """Per-index setting (either [index.]-prefixed or bare) over the
+        cluster-level default (SLOWLOG_DEFAULTS)."""
+        return self.index_setting(key, SLOWLOG_DEFAULTS.get(key))
+
     def _maybe_slowlog(self, body: dict, resp: dict):
-        """index.search.slowlog.threshold.query.{warn,info} (ref
-        index/SearchSlowLog.java:61): queries slower than the threshold
-        log with the source, like the reference's per-index slow log."""
+        """index.search.slowlog.threshold.query.{warn,info,debug,trace}
+        (ref index/SearchSlowLog.java:61): queries slower than the
+        threshold log with the source at the matching level; the most
+        severe matching threshold wins.  Dynamic: per-index via
+        PUT /{index}/_settings, cluster default via _cluster/settings."""
+        import logging
         took = resp.get("took", 0)
-        for level in ("warn", "info"):
-            raw = self.settings.get(
+        for level, py_level in _SLOWLOG_LEVELS:
+            raw = self._slowlog_threshold(
                 f"search.slowlog.threshold.query.{level}")
             if raw is None:
                 continue
             thr = _parse_millis(raw)
             if thr >= 0 and took >= thr:
-                import logging
-                getattr(logging.getLogger(
-                    "opensearch_tpu.index.search.slowlog"), level.replace(
-                        "warn", "warning"))(
-                    "[%s] took[%dms], source[%s]", self.name, took,
+                logging.getLogger(
+                    "opensearch_tpu.index.search.slowlog").log(
+                    py_level, "[%s] took[%dms], timed_out[%s], "
+                    "source[%s]", self.name, took,
+                    str(bool(resp.get("timed_out"))).lower(),
                     json.dumps(body.get("query") or {})[:256])
+                break
+
+    def _maybe_indexing_slowlog(self, took_ms: int, doc_id: str,
+                                source: dict):
+        """index.indexing.slowlog.threshold.index.{warn,info,debug,trace}
+        (ref index/IndexingSlowLog.java:64): writes slower than the
+        threshold log doc id + truncated source."""
+        import logging
+        for level, py_level in _SLOWLOG_LEVELS:
+            raw = self._slowlog_threshold(
+                f"indexing.slowlog.threshold.index.{level}")
+            if raw is None:
+                continue
+            thr = _parse_millis(raw)
+            if thr >= 0 and took_ms >= thr:
+                max_chars = int(self.index_setting(
+                    "indexing.slowlog.source", 1000))
+                logging.getLogger(
+                    "opensearch_tpu.index.indexing.slowlog").log(
+                    py_level, "[%s/%s] took[%dms], source[%s]",
+                    self.name, doc_id, took_ms,
+                    json.dumps(source)[:max_chars])
                 break
 
     # -- device-mesh search path (index.search.mesh: true) ----------------
@@ -1306,7 +1352,9 @@ class IndicesService:
                     svc.doc_count() >= int(want)
             elif cond == "max_age":
                 from opensearch_tpu.common.settings import parse_time
-                age_s = time.time() - svc.creation_date / 1000.0
+                # creation_date is a wall timestamp, so the age
+                # comparison must stay in the same clock domain
+                age_s = time.time() - svc.creation_date / 1000.0  # wall-clock
                 results["[max_age: %s]" % want] = \
                     age_s >= parse_time(want)
             elif cond == "max_size":

@@ -119,6 +119,24 @@ class Node:
         self.cluster_settings.add_settings_update_consumer(
             max_keep_alive,
             lambda v: setattr(self.contexts, "max_keep_alive_s", v))
+        # cluster-level slowlog threshold DEFAULTS (per-index settings
+        # override; the reference layers index settings over node ones)
+        from opensearch_tpu.indices import service as indices_mod
+        for prefix in ("search.slowlog.threshold.query",
+                       "indexing.slowlog.threshold.index"):
+            for level in ("warn", "info", "debug", "trace"):
+                key = f"{prefix}.{level}"
+                s = Setting(key, None, lambda x: x, dynamic=True)
+                self.cluster_settings.register(s)
+
+                def _apply(v, key=key):
+                    if v is None:
+                        indices_mod.SLOWLOG_DEFAULTS.pop(key, None)
+                    else:
+                        indices_mod.SLOWLOG_DEFAULTS[key] = v
+                self.cluster_settings.add_settings_update_consumer(
+                    s, _apply)
+                _apply(self.cluster_settings.get(s))   # replay persisted
         # remote clusters configure via affix keys (RemoteClusterService)
         self.cluster_settings.register_prefix("cluster.remote")
         from opensearch_tpu.transport.remote import RemoteClusterService

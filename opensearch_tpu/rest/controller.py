@@ -179,9 +179,19 @@ class RestController:
 
     def dispatch(self, method: str, path: str, params: dict,
                  body: Optional[bytes], content_type: str = "",
-                 authorization: str = "") -> tuple[int, dict]:
+                 authorization: str = "",
+                 headers: Optional[dict] = None) -> tuple[int, dict]:
         from opensearch_tpu.common import tasks as taskmod
+        from opensearch_tpu.common.telemetry import metrics, tracer
 
+        headers = headers or {}
+        # request attribution: X-Opaque-Id threads into the task and all
+        # downstream transport requests (Task.java HEADERS_TO_COPY)
+        opaque_id = None
+        for k, v in headers.items():
+            if str(k).lower() == "x-opaque-id":
+                opaque_id = v
+                break
         req = RestRequest(method, path, params, body, content_type)
         try:
             identity = getattr(self.node, "identity", None)
@@ -209,11 +219,28 @@ class RestController:
                                            handler_name)
                     action = self._ACTIONS.get(handler_name,
                                                f"rest:{handler_name}")
+                    task_headers = ({"X-Opaque-Id": opaque_id}
+                                    if opaque_id else None)
                     task = self.node.task_manager.register(
-                        action, f"{method} {path}")
+                        action, f"{method} {path}",
+                        headers=task_headers)
                     token = taskmod.set_current(task)
+                    # root span: honors an incoming W3C traceparent so
+                    # client-initiated traces continue through the node
+                    attrs = {"http.method": method, "http.path": path,
+                             "action": action,
+                             "node": getattr(self.node, "node_id",
+                                             self.node.name)}
+                    if opaque_id:
+                        attrs["x_opaque_id"] = opaque_id
                     try:
-                        status, resp = route.handler(req)
+                        with tracer().start_span(
+                                f"rest:{action}", attributes=attrs,
+                                parent=tracer().extract(headers)) as span, \
+                                metrics().time_ms("rest.request_ms"):
+                            metrics().counter("rest.requests").inc()
+                            status, resp = route.handler(req)
+                            span.set_attribute("http.status", status)
                         if params.get("rest_total_hits_as_int") == "true" \
                                 and isinstance(resp, dict):
                             _total_hits_as_int(resp)
@@ -246,6 +273,8 @@ class RestController:
         r("GET", "/_cluster/stats", self.h_cluster_stats)
         r("GET", "/_nodes", self.h_nodes_info)
         r("GET", "/_nodes/stats", self.h_nodes_stats)
+        r("GET", "/_nodes/trace", self.h_nodes_trace)
+        r("GET", "/_nodes/hot_threads", self.h_hot_threads)
         r("GET", "/_cluster/settings", self.h_cluster_get_settings)
         r("PUT", "/_cluster/settings", self.h_cluster_put_settings)
         r("GET", "/_cat/indices", self.h_cat_indices)
@@ -498,6 +527,7 @@ class RestController:
 
     def h_nodes_stats(self, req):
         from opensearch_tpu.common.breakers import breaker_service
+        from opensearch_tpu.common.telemetry import metrics
         # probe on read: stats reflect CURRENT disk health, not boot-time
         self.node.fs_health.check()
         indices = self.node.indices.indices
@@ -515,7 +545,42 @@ class RestController:
                     self.node.indices.indexing_pressure.stats(),
                 "os": _os_stats(),
                 "process": _process_stats(),
+                # counters + latency histograms with p50/p90/p99 readout
+                # (the telemetry SPI's MetricsRegistry surface)
+                "telemetry": metrics().stats(),
             }}}
+
+    def h_nodes_trace(self, req):
+        """Recent finished spans from the bounded in-memory exporter —
+        a debug surface over the tracing SPI (the reference exports via
+        OTLP; this engine keeps a ring buffer readable over REST)."""
+        from opensearch_tpu.common.telemetry import tracer
+        limit = int(req.param("size", 100))
+        spans = tracer().recent(limit, trace_id=req.param("trace_id"))
+        return 200, {"cluster_name": self.node.cluster_name,
+                     "nodes": {self.node.node_id: {
+                         "name": self.node.name,
+                         "spans": spans}}}
+
+    def h_hot_threads(self, req):
+        """Per-thread stack dump (RestNodesHotThreadsAction analog over
+        sys._current_frames — the busiest diagnostic when a query
+        wedges host-side)."""
+        import sys
+        import threading as _threading
+        import traceback
+
+        names = {t.ident: t.name for t in _threading.enumerate()}
+        lines = [f"::: {{{self.node.name}}}{{{self.node.node_id}}}"]
+        for ident, frame in sorted(sys._current_frames().items()):
+            lines.append(
+                f"\n   thread [{names.get(ident, '?')}] id [{ident}]:")
+            lines.extend(
+                "     " + ln.rstrip() for ln in
+                traceback.format_stack(frame))
+        return 200, {"nodes": {self.node.node_id: {
+            "name": self.node.name,
+            "hot_threads": "\n".join(lines)}}}
 
     def h_cat_indices(self, req):
         rows = []
@@ -536,7 +601,7 @@ class RestController:
                    if req.path_params.get("index")
                    else self.node.indices.indices.values())
         total = sum(s.doc_count() for s in targets)
-        now = time.time()
+        now = time.time()   # wall-clock: epoch/timestamp columns
         return 200, [{"epoch": str(int(now)),
                       "timestamp": time.strftime("%H:%M:%S",
                                                  time.gmtime(now)),
@@ -1279,8 +1344,11 @@ class RestController:
                 svc.refresh()
         items = [results_by_index[name][j] for name, j in order]
         errors = any(next(iter(it.values())).get("error") for it in items)
-        return 200, {"took": int((time.monotonic() - t0) * 1000),
-                     "errors": errors, "items": items}
+        took = int((time.monotonic() - t0) * 1000)
+        from opensearch_tpu.common.telemetry import metrics
+        metrics().counter("bulk.items").inc(len(items))
+        metrics().histogram("bulk.request_ms").observe(float(took))
+        return 200, {"took": took, "errors": errors, "items": items}
 
     # -- search ------------------------------------------------------------
 
@@ -1693,9 +1761,13 @@ class RestController:
         for resp_idx, resp in enumerate(responses):
             for pos, h in enumerate(resp["hits"]["hits"]):
                 rows.append((h, resp_idx, pos))
+        from opensearch_tpu.common.telemetry import tracer
         from opensearch_tpu.search.executor import merge_hit_rows
 
-        all_hits = merge_hit_rows(rows, body.get("sort"))
+        with tracer().start_span("coordinator.reduce",
+                                 {"sources": len(responses),
+                                  "rows": len(rows)}):
+            all_hits = merge_hit_rows(rows, body.get("sort"))
         total = sum(r["hits"]["total"]["value"] for r in responses)
         scores = [r["hits"]["max_score"] for r in responses
                   if r["hits"]["max_score"] is not None]
@@ -1703,7 +1775,9 @@ class RestController:
                      for r in responses)
         return {
             "took": max((r["took"] for r in responses), default=0),
-            "timed_out": False,
+            # partial-results flag survives the coordinator reduce: one
+            # shard running out of budget marks the whole response
+            "timed_out": any(r.get("timed_out") for r in responses),
             "_shards": {"total": shards, "successful": shards,
                         "skipped": 0, "failed": 0},
             "hits": {"total": {"value": total, "relation": "eq"},
@@ -2060,7 +2134,8 @@ class RestController:
     def h_cat_tasks(self, req):
         return 200, [{"action": t.action,
                       "task_id": f"{self.node.node_id}:{t.id}",
-                      "type": "transport"}
+                      "type": "transport",
+                      "x_opaque_id": t.headers.get("X-Opaque-Id", "-")}
                      for t in sorted(self.node.task_manager.list(),
                                      key=lambda t: t.id)]
 

@@ -66,7 +66,8 @@ class _Handler(BaseHTTPRequestHandler):
                 status, payload = self.server.controller.dispatch(
                     self.command, split.path, params, body,
                     self.headers.get("Content-Type") or "",
-                    self.headers.get("Authorization") or "")
+                    self.headers.get("Authorization") or "",
+                    headers=dict(self.headers.items()))
             finally:
                 breaker.release(length)
         is_cat = split.path.startswith("/_cat") and params.get("format") != "json"
@@ -96,6 +97,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        opaque = self.headers.get("X-Opaque-Id")
+        if opaque:
+            # the reference echoes X-Opaque-Id on every response so
+            # clients can correlate (Task.X_OPAQUE_ID response header)
+            self.send_header("X-Opaque-Id", opaque)
         self.end_headers()
         if self.command != "HEAD":
             self.wfile.write(data)

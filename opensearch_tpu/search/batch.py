@@ -224,7 +224,10 @@ def plan_batches(searcher, bodies: list) -> tuple[dict, list]:
                 or body.get("aggregations") or body.get("min_score")
                 or body.get("highlight") or body.get("explain")
                 or body.get("docvalue_fields") or body.get("fields")
+                or body.get("timeout") is not None
                 or int(body.get("from", 0)) != 0):
+            # a timeout budget needs the sequential path's per-segment
+            # deadline checks — one fused batch program can't stop early
             fallback.append(pos)
             continue
         try:

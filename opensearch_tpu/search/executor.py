@@ -23,6 +23,8 @@ import opensearch_tpu.common.jaxenv  # noqa: F401
 import jax.numpy as jnp
 
 from opensearch_tpu.common.errors import IllegalArgumentError
+from opensearch_tpu.common.telemetry import metrics as _metrics
+from opensearch_tpu.common.telemetry import tracer as _tracer
 from opensearch_tpu.index.segment import (
     LONG_MISSING_MAX,
     LONG_MISSING_MIN,
@@ -37,6 +39,38 @@ from opensearch_tpu.search.query_dsl import parse_query
 _F32 = np.float32
 _I32 = np.int32
 _I32_MAX = 2**31 - 1
+
+
+class SearchDeadline:
+    """Per-request time budget (QueryPhase's timeout runnable analog).
+
+    Checked between per-segment device programs — the same granularity
+    as cancellation.  When the budget expires the query phase stops
+    launching segments and the response carries ``timed_out: true`` with
+    the partial results collected so far, like the reference's
+    TimeExceededException handling in QueryPhase.execute.
+    """
+
+    __slots__ = ("_deadline", "timed_out")
+
+    def __init__(self, timeout, t0: Optional[float] = None):
+        """``timeout``: "100ms"/"2s"-style or millis; None disables."""
+        self.timed_out = False
+        if timeout is None:
+            self._deadline = None
+            return
+        from opensearch_tpu.common.settings import parse_time
+        seconds = parse_time(timeout)
+        self._deadline = (None if seconds < 0
+                          else (t0 if t0 is not None
+                                else time.monotonic()) + seconds)
+
+    def expired(self) -> bool:
+        """True once the budget is spent; latches ``timed_out``."""
+        if self._deadline is not None and \
+                time.monotonic() >= self._deadline:
+            self.timed_out = True
+        return self.timed_out
 
 
 def _dummy_for(group: str, field: str, dseg: DeviceSegment, mapper):
@@ -181,8 +215,23 @@ class ShardSearcher:
         (QueryPhaseResultConsumer partial-reduce analog)."""
         body = body or {}
         t0 = time.monotonic()
+        with _tracer().start_span(
+                "shard.query_phase",
+                {"index": self.index_name, "shard": self.shard_id,
+                 "segments": len(self.segments)}):
+            resp = self._search_body(body, t0, agg_partials=agg_partials)
+        _metrics().histogram("search.query_ms").observe(
+            (time.monotonic() - t0) * 1000)
+        _metrics().counter("search.queries").inc()
+        if resp.get("timed_out"):
+            _metrics().counter("search.timed_out").inc()
+        return resp
+
+    def _search_body(self, body: dict, t0: float, *,
+                     agg_partials: bool = False) -> dict:
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
+        deadline = SearchDeadline(body.get("timeout"), t0)
         q = parse_query(body.get("query"))
         fetch_extras = None
         # request-size limits (docvalue_fields, rescore window, result
@@ -245,7 +294,8 @@ class ShardSearcher:
         aggs_json = body.get("aggs") or body.get("aggregations")
         # with aggs, the full-scores pass runs ONCE and feeds both the
         # top-k and the aggregations (no second device execution)
-        views = (list(self._run_full(plan, bind, needed, min_score))
+        views = (list(self._run_full(plan, bind, needed, min_score,
+                                     deadline=deadline))
                  if aggs_json and self.segments else None)
 
         if not self.segments:
@@ -259,11 +309,12 @@ class ShardSearcher:
                 rows, total, max_score = self._topk_from_views(views, k_want)
             else:
                 rows, total, max_score = self._topk(plan, bind, needed,
-                                                    k_want, min_score)
+                                                    k_want, min_score,
+                                                    deadline=deadline)
         else:
             rows, total, max_score = self._field_sorted(
                 plan, bind, needed, k_want, sort_specs, min_score, views,
-                search_after=search_after)
+                search_after=search_after, deadline=deadline)
         if rescore is not None and rows:
             rows, max_score = self._rescored(rows, rescore)
         rows = rows[from_: from_ + size]
@@ -281,12 +332,16 @@ class ShardSearcher:
             else:
                 aggregations = execu.run(aggs_json, seg_views)
 
-        hits = self._hits_from_rows(rows, source_spec, fetch_extras)
+        with _tracer().start_span("fetch_phase",
+                                  {"index": self.index_name,
+                                   "hits": len(rows)}), \
+                _metrics().time_ms("search.fetch_ms"):
+            hits = self._hits_from_rows(rows, source_spec, fetch_extras)
 
         took = int((time.monotonic() - t0) * 1000)
         resp = {
             "took": took,
-            "timed_out": False,
+            "timed_out": deadline.timed_out,
             "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
             "hits": {
                 "total": {"value": int(total), "relation": "eq"},
@@ -346,13 +401,16 @@ class ShardSearcher:
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
         k_want = from_ + size
+        deadline = SearchDeadline(body.get("timeout"), t0)
         conf = NormalizationConfig(body.get("_hybrid_pipeline"))
         per_query_rows = []
         max_total = 0
         for subq in q.queries:
+            if deadline.expired():
+                break            # partial: combine what completed
             plan, bind = compile_query(subq, self.ctx, scored=True)
             rows, tot, _mx = self._topk(plan, bind, plan.arrays(),
-                                        k_want, None)
+                                        k_want, None, deadline=deadline)
             per_query_rows.append(rows)
             max_total = max(max_total, int(tot))
         combined = conf.apply(per_query_rows, k_want)
@@ -363,7 +421,7 @@ class ShardSearcher:
         # bound beyond the largest sub-query's exact count
         return {
             "took": int((time.monotonic() - t0) * 1000),
-            "timed_out": False,
+            "timed_out": deadline.timed_out,
             "_shards": {"total": 1, "successful": 1, "skipped": 0,
                         "failed": 0},
             "hits": {"total": {"value": max_total, "relation": "gte"},
@@ -391,6 +449,9 @@ class ShardSearcher:
             for pos, (rows, total, max_score) in g.run(self).items():
                 body = bodies[pos] or {}
                 hits = self._hits_from_rows(rows, body.get("_source"))
+                # batched bodies never carry a [timeout] (plan_batches
+                # sends those to the sequential fallback, which owns the
+                # deadline checks), so false is exact here
                 results[pos] = {
                     "took": int((time.monotonic() - t0) * 1000),
                     "timed_out": False,
@@ -450,22 +511,30 @@ class ShardSearcher:
     # -- internals --------------------------------------------------------
 
     def _run_full(self, plan, bind, needed, min_score,
-                  can_match_skip=False):
+                  can_match_skip=False, deadline=None):
         """``can_match_skip`` is ONLY safe for consumers that don't index
         the yielded tuples by position (views/aggs paths align with
-        self.segments and must see every segment)."""
+        self.segments and must see every segment).  An expired
+        ``deadline`` stops the scan at the next segment boundary — the
+        same granularity as cancellation."""
         from opensearch_tpu.common.tasks import check_current
 
         ms = jnp.asarray(np.float32(-np.inf if min_score is None else min_score))
         for seg in self.segments:
             check_current()        # cancellation point per segment program
+            if deadline is not None and deadline.expired():
+                return
             if can_match_skip and not plan.can_match(bind, seg):
                 continue
-            dseg = seg.device()
-            A = build_arrays(dseg, needed, self.mapper,
-                             live=self.ctx.live_jnp(seg, dseg))
-            dims, ins = plan.prepare(bind, seg, dseg, self.ctx)
-            scores, matched = P.run_full(plan, dims, A, ins, ms)
+            with _tracer().start_span(
+                    "segment.dispatch",
+                    {"segment": seg.seg_id, "index": self.index_name,
+                     "shard": self.shard_id}):
+                dseg = seg.device()
+                A = build_arrays(dseg, needed, self.mapper,
+                                 live=self.ctx.live_jnp(seg, dseg))
+                dims, ins = plan.prepare(bind, seg, dseg, self.ctx)
+                scores, matched = P.run_full(plan, dims, A, ins, ms)
             yield seg, dseg, scores, matched
 
     def _merge_topk(self, per_seg, k_want, total, max_score):
@@ -479,13 +548,14 @@ class ShardSearcher:
                  "score": float(scores[i])} for i in order]
         return rows, total, (None if max_score == -np.inf else float(max_score))
 
-    def _topk(self, plan, bind, needed, k_want, min_score):
+    def _topk(self, plan, bind, needed, k_want, min_score, deadline=None):
         from opensearch_tpu.common.tasks import check_current
 
         if k_want == 0:            # size=0: counts only (aggs-style request)
             total = sum(int(np.asarray(m).sum()) for _s, _d, _sc, m
                         in self._run_full(plan, bind, needed, min_score,
-                                          can_match_skip=True))
+                                          can_match_skip=True,
+                                          deadline=deadline))
             return [], total, None
 
         # phase 1: DISPATCH every segment's program without a host sync —
@@ -498,14 +568,21 @@ class ShardSearcher:
         launched = []
         for si, seg in enumerate(self.segments):
             check_current()        # cancellation point per segment program
+            if deadline is not None and deadline.expired():
+                break              # partial top-k; response flags timed_out
             if not plan.can_match(bind, seg):
                 continue           # can-match skip: no staging, no program
-            dseg = seg.device()
-            A = build_arrays(dseg, needed, self.mapper,
-                             live=self.ctx.live_jnp(seg, dseg))
-            dims, ins = plan.prepare(bind, seg, dseg, self.ctx)
-            k = min(k_want, dseg.n_pad)
-            launched.append((si, *P.run_topk(plan, dims, k, A, ins, ms)))
+            with _tracer().start_span(
+                    "segment.dispatch",
+                    {"segment": seg.seg_id, "index": self.index_name,
+                     "shard": self.shard_id}):
+                dseg = seg.device()
+                A = build_arrays(dseg, needed, self.mapper,
+                                 live=self.ctx.live_jnp(seg, dseg))
+                dims, ins = plan.prepare(bind, seg, dseg, self.ctx)
+                k = min(k_want, dseg.n_pad)
+                launched.append((si, *P.run_topk(plan, dims, k, A, ins,
+                                                 ms)))
         # phase 2: ONE host-sync region over all segments' results
         per_seg = []
         total = 0
@@ -577,7 +654,8 @@ class ShardSearcher:
             f"sorting on field [{field}] of type [{ft.type_name}] is not supported")
 
     def _field_sorted(self, plan, bind, needed, k_want, sort_specs, min_score,
-                      views=None, row_filter=None, search_after=None):
+                      views=None, row_filter=None, search_after=None,
+                      deadline=None):
         """``k_want=None`` returns EVERY matched row (scroll
         materialization); ``row_filter(seg_i, local)`` implements sliced
         scans; ``search_after`` drops rows at-or-before the given sort
@@ -585,7 +663,8 @@ class ShardSearcher:
         rows = []
         total = 0
         if views is None:
-            views = self._run_full(plan, bind, needed, min_score)
+            views = self._run_full(plan, bind, needed, min_score,
+                                   deadline=deadline)
         for si, (seg, dseg, scores, matched) in enumerate(views):
             matched_np = np.asarray(matched)[: seg.n_docs]
             scores_np = np.asarray(scores)[: seg.n_docs]

@@ -176,7 +176,7 @@ class SnapshotsService:
                     f"{self.path_repo}")
         repo = Repository(name, type_, settings)
         # verify: a write+read round trip (VerifyRepositoryAction analog)
-        probe = f"verify-{int(time.time() * 1000)}"
+        probe = f"verify-{int(time.time() * 1000)}"  # wall-clock: unique name
         repo.root.write_blob(probe, b"ok")
         repo.root.delete_blob(probe)
         with self._lock:
@@ -259,7 +259,8 @@ class SnapshotsService:
         return sorted(set(out))
 
     def _do_create(self, repo: Repository, snapshot: str, body: dict) -> dict:
-        t0 = time.time()
+        t0 = time.time()   # wall-clock: start_time is a display timestamp
+        t0_mono = time.monotonic()    # duration must not jump with clock
         names = self._index_names(body.get("indices"))
         indices_meta = {}
         total_files = 0
@@ -283,12 +284,16 @@ class SnapshotsService:
                 "mappings": svc.mapper.to_mapping(),
                 "shards": shards_meta,
             }
+        duration_ms = int((time.monotonic() - t0_mono) * 1000)
         manifest = {
             "snapshot": snapshot,
             "state": "SUCCESS",
             "indices": indices_meta,
             "start_time_in_millis": int(t0 * 1000),
-            "end_time_in_millis": int(time.time() * 1000),
+            # end = start + monotonic duration: elapsed stays correct
+            # even when the wall clock steps mid-snapshot
+            "end_time_in_millis": int(t0 * 1000) + duration_ms,
+            "duration_in_millis": duration_ms,
             "total_files": total_files,
             "reused_files": reused_files,
         }
@@ -312,13 +317,14 @@ class SnapshotsService:
         if snapshot in (None, "_all", "*"):
             return {"snapshots": repo.list_snapshots()}
         m = repo.manifest(snapshot)
-        return {"snapshots": [{"snapshot": m["snapshot"],
-                               "state": m["state"],
-                               "indices": sorted(m["indices"]),
-                               "start_time_in_millis":
-                                   m["start_time_in_millis"],
-                               "end_time_in_millis":
-                                   m["end_time_in_millis"]}]}
+        out = {"snapshot": m["snapshot"],
+               "state": m["state"],
+               "indices": sorted(m["indices"]),
+               "start_time_in_millis": m["start_time_in_millis"],
+               "end_time_in_millis": m["end_time_in_millis"]}
+        if "duration_in_millis" in m:    # older manifests predate it
+            out["duration_in_millis"] = m["duration_in_millis"]
+        return {"snapshots": [out]}
 
     def delete_snapshot(self, repo_name: str, snapshot: str) -> dict:
         """Remove the snapshot, then garbage-collect blobs no other

@@ -154,6 +154,13 @@ class TransportService:
                        payload: Optional[dict] = None) -> Future:
         version = (self._peer_versions.get(target)
                    if action != HANDSHAKE else TRANSPORT_PROTOCOL_VERSION)
+        payload = dict(payload or {})
+        # thread-context propagation (the reference ships the ThreadContext
+        # headers — traceparent, X-Opaque-Id — inside every transport
+        # request so remote executions attribute and parent correctly)
+        hdrs = self._outbound_headers()
+        if hdrs:
+            payload["__headers__"] = hdrs
         with self._lock:
             self._req_counter += 1
             req_id = self._req_counter
@@ -162,7 +169,7 @@ class TransportService:
         try:
             self.transport.send(self.node_id, target,
                                 encode_frame(req_id, 0, action,
-                                             payload or {},
+                                             payload,
                                              version=version))
         except Exception as e:
             with self._lock:
@@ -214,14 +221,42 @@ class TransportService:
         except RuntimeError:
             pass   # executor shut down: frame raced our close()
 
+    @staticmethod
+    def _outbound_headers() -> dict:
+        from opensearch_tpu.common import tasks as taskmod
+        from opensearch_tpu.common.telemetry import tracer
+
+        hdrs: dict = {}
+        tracer().inject(hdrs)
+        task = taskmod.current()
+        if task is not None and task.headers.get("X-Opaque-Id"):
+            hdrs["X-Opaque-Id"] = task.headers["X-Opaque-Id"]
+        return hdrs
+
     def _run_handler(self, source: str, req_id: int, action: str,
                      payload: dict):
+        from opensearch_tpu.common.telemetry import tracer
+
         handler = self._handlers.get(action)
+        hdrs = payload.pop("__headers__", None) if isinstance(
+            payload, dict) else None
         try:
             if handler is None:
                 raise OpenSearchTpuError(
                     f"no handler for action [{action}]")
-            result = handler(payload)
+            parent = tracer().extract(hdrs)
+            if parent is not None:
+                # remote execution joins the caller's trace: a server
+                # span per handled request (OTel SpanKind.SERVER analog)
+                attrs = {"action": action, "source": source,
+                         "node": self.node_id}
+                if hdrs.get("X-Opaque-Id"):
+                    attrs["x_opaque_id"] = hdrs["X-Opaque-Id"]
+                with tracer().start_span(f"transport:{action}",
+                                         attributes=attrs, parent=parent):
+                    result = handler(payload)
+            else:
+                result = handler(payload)
             frame = encode_frame(req_id, STATUS_RESPONSE, action,
                                  result or {})
         except OpenSearchTpuError as e:

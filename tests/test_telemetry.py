@@ -1,0 +1,484 @@
+"""Telemetry spine: Tracer/MetricsRegistry SPI, end-to-end trace
+propagation over LocalTransport in cluster mode, slow logs with dynamic
+thresholds, timeout budgets with partial-results flagging, X-Opaque-Id
+task attribution, and the _nodes/stats | _nodes/trace surfaces."""
+
+import json
+import logging
+import subprocess
+import sys
+import time
+
+import pytest
+
+from opensearch_tpu.common.telemetry import (
+    MetricsRegistry,
+    SpanContext,
+    Tracer,
+    metrics,
+    tracer,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    from opensearch_tpu.indices import service as indices_mod
+    tracer().reset()
+    yield
+    tracer().reset()
+    indices_mod.SLOWLOG_DEFAULTS.clear()
+
+
+# -- tracer SPI -----------------------------------------------------------
+
+def test_span_nesting_and_trace_ids():
+    t = Tracer()
+    with t.start_span("outer", {"a": 1}) as outer:
+        assert t.current() is outer
+        with t.start_span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_span_id == outer.span_id
+    assert t.current() is None
+    spans = t.recent()
+    assert [s["name"] for s in spans] == ["outer", "inner"]
+    assert spans[0]["duration_in_nanos"] >= 0
+    assert spans[0]["attributes"] == {"a": 1}
+
+
+def test_traceparent_roundtrip_and_extract():
+    t = Tracer()
+    with t.start_span("root") as root:
+        hdrs = t.inject({})
+        assert hdrs["traceparent"] == \
+            f"00-{root.trace_id}-{root.span_id}-01"
+    ctx = Tracer.extract(hdrs)
+    assert ctx.trace_id == root.trace_id
+    assert ctx.span_id == root.span_id
+    # HTTP headers arrive with arbitrary casing
+    assert Tracer.extract({"Traceparent": hdrs["traceparent"]}) is not None
+    # malformed values are ignored, never raise
+    assert Tracer.extract({"traceparent": "junk"}) is None
+    assert Tracer.extract({"traceparent": "00-zz-bad-01"}) is None
+    assert SpanContext.from_traceparent(None) is None
+
+
+def test_explicit_parent_overrides_ambient():
+    t = Tracer()
+    remote = SpanContext("ab" * 16, "cd" * 8)
+    with t.start_span("local-root"):
+        with t.start_span("joined", parent=remote) as s:
+            assert s.trace_id == remote.trace_id
+            assert s.parent_span_id == remote.span_id
+
+
+def test_span_buffer_is_bounded():
+    t = Tracer(max_spans=10)
+    for i in range(50):
+        with t.start_span(f"s{i}"):
+            pass
+    spans = t.recent(limit=100)
+    assert len(spans) == 10
+    assert spans[0]["name"] == "s49"       # newest first
+
+
+def test_span_records_errors():
+    t = Tracer()
+    with pytest.raises(ValueError):
+        with t.start_span("boom"):
+            raise ValueError("nope")
+    assert "ValueError" in t.recent()[0]["error"]
+
+
+# -- metrics SPI ----------------------------------------------------------
+
+def test_counters_and_histogram_percentiles():
+    m = MetricsRegistry()
+    m.counter("c").inc()
+    m.counter("c").inc(4)
+    h = m.histogram("lat_ms")
+    for v in range(1, 101):          # 1..100 ms uniform
+        h.observe(float(v))
+    stats = m.stats()
+    assert stats["counters"]["c"] == 5
+    hs = stats["histograms"]["lat_ms"]
+    assert hs["count"] == 100
+    assert hs["max_in_millis"] == 100.0
+    p50 = hs["percentiles"]["50.0"]
+    p99 = hs["percentiles"]["99.0"]
+    assert 25 <= p50 <= 75           # bucket-interpolated estimate
+    assert p99 >= p50
+    assert p99 <= 250
+
+
+def test_histogram_empty_and_single():
+    m = MetricsRegistry()
+    h = m.histogram("x")
+    assert h.percentile(99) == 0.0
+    h.observe(3.0)
+    assert h.stats()["count"] == 1
+    assert h.stats()["percentiles"]["50.0"] <= 5.0
+
+
+def test_time_ms_context_manager():
+    m = MetricsRegistry()
+    with m.time_ms("block_ms"):
+        pass
+    assert m.histogram("block_ms").count == 1
+
+
+# -- cluster-mode trace propagation (the acceptance criterion) ------------
+
+def wait_until(pred, timeout=8.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    from opensearch_tpu.cluster.node import ClusterNode
+    from opensearch_tpu.transport.service import (LocalTransport,
+                                                  TransportService)
+    hub = LocalTransport.Hub()
+    ids = ["n0", "n1", "n2"]
+    nodes = {}
+    for nid in ids:
+        svc = TransportService(nid, LocalTransport(hub))
+        nodes[nid] = ClusterNode(nid, str(tmp_path / nid), svc, ids)
+    assert nodes["n0"].start_election()
+    wait_until(lambda: all(
+        nodes[i].coordinator.state().master_node == "n0" for i in ids))
+    yield hub, ids, nodes
+    for n in nodes.values():
+        n.stop()
+
+
+def test_cluster_search_spans_share_one_trace(cluster):
+    hub, ids, nodes = cluster
+    nodes["n0"].create_index("traced", {
+        "settings": {"number_of_shards": 6},
+        "mappings": {"properties": {"body": {"type": "text"}}}})
+    wait_until(lambda: all("traced" in nodes[i].indices for i in ids))
+    for i in range(30):
+        nodes["n0"].index_doc("traced", str(i), {"body": f"event {i}"})
+    nodes["n0"].refresh("traced")
+
+    tracer().reset()
+    resp = nodes["n0"].search("traced", {"query": {"match": {
+        "body": "event"}}, "size": 5})
+    assert resp["hits"]["total"]["value"] == 30
+    assert resp["timed_out"] is False
+
+    spans = tracer().recent(limit=500)
+    by_id = {s["span_id"]: s for s in spans}
+    coord = [s for s in spans if s["name"] == "search.coordinator"]
+    assert len(coord) == 1
+    root = coord[0]
+    assert root["parent_span_id"] is None
+    trace_id = root["trace_id"]
+
+    # the coordinator reduce ran under the same trace
+    reduces = [s for s in spans if s["name"] == "coordinator.reduce"]
+    assert len(reduces) == 1
+    assert reduces[0]["trace_id"] == trace_id
+    assert reduces[0]["parent_span_id"] == root["span_id"]
+
+    # one query phase per participating node (shards group per node),
+    # EVERY one under the coordinator's trace_id
+    qp = [s for s in spans if s["name"] == "shard.query_phase"]
+    assert len(qp) == len(ids)
+    assert all(s["trace_id"] == trace_id for s in qp)
+
+    # remote query phases parent through the transport server span,
+    # which parents directly under the coordinator span
+    remote_qp = 0
+    for s in qp:
+        parent = by_id.get(s["parent_span_id"])
+        if parent is None:
+            # parent must be the coordinator itself (local execution)
+            assert s["parent_span_id"] == root["span_id"]
+            continue
+        if parent["name"].startswith("transport:"):
+            remote_qp += 1
+            assert parent["trace_id"] == trace_id
+            assert parent["parent_span_id"] == root["span_id"]
+        else:
+            assert parent["span_id"] == root["span_id"]
+    assert remote_qp == 2            # 3 nodes, coordinator is local
+
+    # per-segment device dispatches joined the same trace
+    segs = [s for s in spans if s["name"] == "segment.dispatch"]
+    assert segs and all(s["trace_id"] == trace_id for s in segs)
+
+
+def test_cluster_timeout_flag_survives_reduce(cluster):
+    hub, ids, nodes = cluster
+    nodes["n0"].create_index("budget", {
+        "settings": {"number_of_shards": 3},
+        "mappings": {"properties": {"body": {"type": "text"}}}})
+    wait_until(lambda: all("budget" in nodes[i].indices for i in ids))
+    for i in range(12):
+        nodes["n0"].index_doc("budget", str(i), {"body": "x " * 5})
+    nodes["n0"].refresh("budget")
+    resp = nodes["n0"].search("budget", {
+        "query": {"match": {"body": "x"}}, "timeout": 0})
+    assert resp["timed_out"] is True
+
+
+# -- timeout budget on the shard path -------------------------------------
+
+@pytest.fixture
+def svc(tmp_path):
+    from opensearch_tpu.indices.service import IndexService
+    s = IndexService("t", str(tmp_path / "t"), {},
+                     {"properties": {"body": {"type": "text"},
+                                     "n": {"type": "long"}}})
+    for i in range(20):
+        s.index_doc(str(i), {"body": f"word {i}", "n": i})
+    s.refresh()
+    yield s
+    s.close()
+
+
+def test_search_timeout_partial_results(svc):
+    full = svc.search({"query": {"match": {"body": "word"}}})
+    assert full["timed_out"] is False
+    assert full["hits"]["total"]["value"] == 20
+
+    cut = svc.search({"query": {"match": {"body": "word"}},
+                      "timeout": 0})
+    assert cut["timed_out"] is True
+    # budget expired before the first segment: partial (empty) results
+    assert cut["hits"]["total"]["value"] == 0
+
+    # a generous budget never flags
+    ok = svc.search({"query": {"match": {"body": "word"}},
+                     "timeout": "30s"})
+    assert ok["timed_out"] is False
+    assert ok["hits"]["total"]["value"] == 20
+
+
+def test_sorted_and_agg_timeout_paths(svc):
+    cut = svc.search({"query": {"match": {"body": "word"}},
+                      "sort": [{"n": "asc"}], "timeout": 0})
+    assert cut["timed_out"] is True
+    cut = svc.search({"size": 0, "timeout": 0,
+                      "aggs": {"m": {"max": {"field": "n"}}}})
+    assert cut["timed_out"] is True
+
+
+def test_msearch_timeout_falls_back_to_sequential(svc):
+    out = svc.msearch([
+        {"query": {"match": {"body": "word"}}},
+        {"query": {"match": {"body": "word"}}, "timeout": 0}])
+    assert out[0]["timed_out"] is False
+    assert out[0]["hits"]["total"]["value"] == 20
+    assert out[1]["timed_out"] is True
+
+
+# -- slow logs ------------------------------------------------------------
+
+def test_indexing_slowlog_per_index_setting(tmp_path, caplog):
+    from opensearch_tpu.indices.service import IndexService
+    s = IndexService("w", str(tmp_path / "w"),
+                     {"indexing.slowlog.threshold.index.warn": "0ms"},
+                     {"properties": {"t": {"type": "text"}}})
+    with caplog.at_level(
+            logging.WARNING,
+            logger="opensearch_tpu.index.indexing.slowlog"):
+        s.index_doc("1", {"t": "hello"})
+    assert any("took" in r.getMessage() for r in caplog.records)
+    s.close()
+
+
+def test_slowlog_dynamic_update_and_cluster_default(tmp_path):
+    """_cluster/settings sets the fleet default; a per-index
+    PUT /{index}/_settings overrides it (reference layering)."""
+    from opensearch_tpu.node import Node
+    node = Node(str(tmp_path / "n"), port=0).start()
+    try:
+        rest = node.rest
+        st, _ = rest.dispatch("PUT", "/slowidx", {}, json.dumps({
+            "mappings": {"properties": {"t": {"type": "text"}}}
+        }).encode())
+        assert st == 200
+        st, _ = rest.dispatch(
+            "PUT", "/slowidx/_doc/1", {},
+            json.dumps({"t": "hello"}).encode())
+        assert st in (200, 201)
+        rest.dispatch("POST", "/slowidx/_refresh", {}, None)
+
+        logger = logging.getLogger("opensearch_tpu.index.search.slowlog")
+        records = []
+
+        class Grab(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+        h = Grab(level=logging.DEBUG)
+        logger.addHandler(h)
+        logger.setLevel(logging.DEBUG)
+        try:
+            body = json.dumps({"query": {"match": {"t": "hello"}}}).encode()
+            # no thresholds anywhere: silent
+            rest.dispatch("POST", "/slowidx/_search", {}, body)
+            assert not records
+
+            # cluster-level default catches every index
+            st, _ = rest.dispatch("PUT", "/_cluster/settings", {},
+                                  json.dumps({"transient": {
+                                      "search.slowlog.threshold.query"
+                                      ".warn": "0ms"}}).encode())
+            assert st == 200
+            rest.dispatch("POST", "/slowidx/_search", {}, body)
+            assert len(records) == 1
+            assert records[0].levelno == logging.WARNING
+
+            # per-index override disables it for this index
+            st, _ = rest.dispatch(
+                "PUT", "/slowidx/_settings", {},
+                json.dumps({"index": {
+                    "search.slowlog.threshold.query.warn": "-1"
+                }}).encode())
+            assert st == 200
+            rest.dispatch("POST", "/slowidx/_search", {}, body)
+            assert len(records) == 1       # no new record
+
+            # reset the cluster default (null resets, like the reference)
+            st, _ = rest.dispatch("PUT", "/_cluster/settings", {},
+                                  json.dumps({"transient": {
+                                      "search.slowlog.threshold.query"
+                                      ".warn": None}}).encode())
+            assert st == 200
+            from opensearch_tpu.indices.service import SLOWLOG_DEFAULTS
+            assert "search.slowlog.threshold.query.warn" \
+                not in SLOWLOG_DEFAULTS
+        finally:
+            logger.removeHandler(h)
+            logger.setLevel(logging.NOTSET)
+    finally:
+        node.stop()
+
+
+# -- X-Opaque-Id ----------------------------------------------------------
+
+def test_x_opaque_id_reaches_task_and_cat_tasks(tmp_path):
+    from opensearch_tpu.node import Node
+    node = Node(str(tmp_path / "n"), port=0).start()
+    try:
+        # the _tasks request lists ITSELF, so its own headers echo back
+        st, body = node.rest.dispatch(
+            "GET", "/_tasks", {}, None,
+            headers={"X-Opaque-Id": "req-42"})
+        assert st == 200
+        tasks = next(iter(body["nodes"].values()))["tasks"]
+        assert any(t.get("headers", {}).get("X-Opaque-Id") == "req-42"
+                   for t in tasks.values())
+
+        st, rows = node.rest.dispatch(
+            "GET", "/_cat/tasks", {}, None,
+            headers={"x-opaque-id": "req-43"})   # case-insensitive
+        assert st == 200
+        assert any(r.get("x_opaque_id") == "req-43" for r in rows)
+    finally:
+        node.stop()
+
+
+# -- REST surfaces --------------------------------------------------------
+
+def test_rest_traceparent_honored_and_stats_histograms(tmp_path):
+    from opensearch_tpu.node import Node
+    node = Node(str(tmp_path / "n"), port=0).start()
+    try:
+        rest = node.rest
+        rest.dispatch("PUT", "/obs", {}, json.dumps({
+            "mappings": {"properties": {"t": {"type": "text"}}}
+        }).encode())
+        rest.dispatch("PUT", "/obs/_doc/1", {},
+                      json.dumps({"t": "hello world"}).encode())
+        rest.dispatch("POST", "/obs/_refresh", {}, None)
+
+        tracer().reset()
+        incoming = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        st, _ = rest.dispatch(
+            "POST", "/obs/_search", {},
+            json.dumps({"query": {"match": {"t": "hello"}}}).encode(),
+            headers={"traceparent": incoming})
+        assert st == 200
+        spans = tracer().recent(limit=200)
+        roots = [s for s in spans if s["name"].startswith("rest:")]
+        assert roots and all(s["trace_id"] == "ab" * 16 for s in roots)
+        # the REST root continues the CLIENT's trace
+        assert roots[-1]["parent_span_id"] == "cd" * 8
+        # the shard query phase nests under the same client trace
+        qp = [s for s in spans if s["name"] == "shard.query_phase"]
+        assert qp and all(s["trace_id"] == "ab" * 16 for s in qp)
+
+        # _nodes/stats: telemetry section with non-zero latency counts
+        st, body = rest.dispatch("GET", "/_nodes/stats", {}, None)
+        assert st == 200
+        tele = next(iter(body["nodes"].values()))["telemetry"]
+        hist = tele["histograms"]["search.query_ms"]
+        assert hist["count"] >= 1
+        assert "50.0" in hist["percentiles"]
+        assert "99.0" in hist["percentiles"]
+        assert tele["histograms"]["indexing.index_ms"]["count"] >= 1
+        assert tele["counters"]["search.queries"] >= 1
+
+        # _nodes/trace: the debug span dump, filterable by trace_id
+        st, body = rest.dispatch("GET", "/_nodes/trace",
+                                 {"trace_id": "ab" * 16}, None)
+        assert st == 200
+        spans = next(iter(body["nodes"].values()))["spans"]
+        assert spans and all(s["trace_id"] == "ab" * 16 for s in spans)
+
+        # hot threads includes this very thread's stack
+        st, body = rest.dispatch("GET", "/_nodes/hot_threads", {}, None)
+        assert st == 200
+        text = next(iter(body["nodes"].values()))["hot_threads"]
+        assert "thread [" in text and "h_hot_threads" in text
+    finally:
+        node.stop()
+
+
+def test_write_path_metrics(tmp_path):
+    from opensearch_tpu.node import Node
+    node = Node(str(tmp_path / "n"), port=0).start()
+    try:
+        before = metrics().histogram("translog.sync_ms").count
+        node.rest.dispatch("PUT", "/wm/_doc/1", {},
+                           json.dumps({"v": 1}).encode())
+        node.rest.dispatch("POST", "/wm/_refresh", {}, None)
+        assert metrics().histogram("translog.sync_ms").count > before
+        assert metrics().histogram("indexing.refresh_ms").count >= 1
+    finally:
+        node.stop()
+
+
+# -- monotonic lint (the tier-1 CI hook) ----------------------------------
+
+def test_check_monotonic_lint_passes():
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools",
+                                      "check_monotonic.py")],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_check_monotonic_lint_catches_violations(tmp_path):
+    bad = tmp_path / "pkg"
+    bad.mkdir()
+    (bad / "mod.py").write_text(
+        "import time\nt0 = time.time()\n"
+        "ok = time.time()  # wall-clock: timestamp\n")
+    out = subprocess.run(
+        [sys.executable, "tools/check_monotonic.py", str(bad)],
+        capture_output=True, text=True,
+        cwd=__file__.rsplit("/tests/", 1)[0])
+    assert out.returncode == 1
+    assert "mod.py:2" in out.stdout
+    assert "mod.py:3" not in out.stdout

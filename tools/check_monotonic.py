@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Lint: wall-clock reads must be annotated, durations must be monotonic.
+
+``time.time()`` is only legitimate for *timestamps* (display, epoch
+columns, unique names).  Using it for elapsed-time measurement silently
+corrupts latency metrics whenever the wall clock steps (NTP slew, VM
+suspend) — the class of bug this PR's telemetry work exists to measure
+away.  Every remaining ``time.time()`` call site in ``opensearch_tpu/``
+must therefore carry a ``# wall-clock`` annotation on the same line or
+the line above, asserting a human decided a timestamp is intended.
+New un-annotated call sites fail tier-1 (tests/test_telemetry.py runs
+this check).
+
+Usage: python tools/check_monotonic.py [root]   (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+CALL = re.compile(r"\btime\.time\(\)")
+ANNOTATION = "# wall-clock"
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        lines = f.readlines()
+    problems = []
+    for i, line in enumerate(lines):
+        if not CALL.search(line):
+            continue
+        stripped = line.strip()
+        if stripped.startswith("#"):
+            continue                     # commented-out code
+        prev = lines[i - 1] if i > 0 else ""
+        if ANNOTATION in line or ANNOTATION in prev:
+            continue
+        problems.append(
+            f"{path}:{i + 1}: time.time() without a '{ANNOTATION}' "
+            "annotation — use time.monotonic() for durations, or "
+            "annotate why a wall timestamp is intended")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    root = argv[1] if len(argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "opensearch_tpu")
+    problems = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                problems.extend(check_file(os.path.join(dirpath, name)))
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} un-annotated time.time() call site(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
