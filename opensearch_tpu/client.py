@@ -366,8 +366,13 @@ class OpenSearch:
         return self.transport.perform_request(
             "POST", f"/{index}/_update/{id}", params, body)
 
-    def search(self, index=None, body=None, params=None):
+    def search(self, index=None, body=None, params=None,
+               allow_partial_search_results=None):
         path = (f"/{_idx(index)}/_search" if index else "/_search")
+        if allow_partial_search_results is not None:
+            params = dict(params or {})
+            params["allow_partial_search_results"] = \
+                allow_partial_search_results
         return self.transport.perform_request("POST", path, params,
                                               body or {})
 

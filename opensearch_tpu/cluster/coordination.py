@@ -27,10 +27,13 @@ from __future__ import annotations
 
 import random
 import threading
+import zlib as _zlib
 from enum import Enum
 from typing import Callable, Optional
 
-from opensearch_tpu.common.errors import OpenSearchTpuError
+from opensearch_tpu.common.errors import (NodeDisconnectedError,
+                                          OpenSearchTpuError)
+from opensearch_tpu.cluster import fault_detection as fd
 from opensearch_tpu.cluster.state import ClusterState, allocate_shards
 from opensearch_tpu.transport.service import TransportService
 
@@ -38,6 +41,8 @@ PREVOTE = "internal:cluster/coordination/prevote"
 JOIN = "internal:cluster/coordination/join"
 PUBLISH = "internal:cluster/coordination/publish"
 COMMIT = "internal:cluster/coordination/commit"
+# legacy fault-detection action names (pre fault_detection.py); still
+# registered so mixed-version peers keep getting answers
 LEADER_CHECK = "internal:cluster/coordination/leader_check"
 FOLLOWER_CHECK = "internal:cluster/coordination/follower_check"
 
@@ -61,7 +66,7 @@ class Coordinator:
                  voting_nodes: list[str], node_info: Optional[dict] = None,
                  on_apply: Optional[Callable[[ClusterState], None]] = None,
                  check_interval: float = 1.0, check_retries: int = 3,
-                 gateway=None):
+                 check_timeout: float = 2.0, gateway=None):
         self.node_id = node_id
         self.transport = transport
         # bootstrap voting configuration; once states carry a `voting`
@@ -100,14 +105,28 @@ class Coordinator:
         self._check_failures: dict[str, int] = {}
         self._stopped = False
         self._timer: Optional[threading.Timer] = None
+        # fault detection proper lives in cluster/fault_detection.py; the
+        # failure counters are SHARED so _leader_alive sees what the
+        # checkers see
+        fd_settings = fd.FaultDetectionSettings(
+            interval=check_interval, timeout=check_timeout,
+            retries=check_retries)
+        self.follower_checker = fd.FollowerChecker(
+            transport, node_id, fd_settings, self._check_failures,
+            self._on_follower_failure)
+        self.leader_checker = fd.LeaderChecker(
+            transport, node_id, fd_settings, self._check_failures,
+            self._on_leader_failure)
 
         t = transport
         t.register_handler(PREVOTE, self._on_prevote)
         t.register_handler(JOIN, self._on_join)
         t.register_handler(PUBLISH, self._on_publish)
         t.register_handler(COMMIT, self._on_commit)
-        t.register_handler(LEADER_CHECK, self._on_leader_check)
-        t.register_handler(FOLLOWER_CHECK, self._on_follower_check)
+        for action in (LEADER_CHECK, fd.LEADER_CHECK):
+            t.register_handler(action, self._on_leader_check)
+        for action in (FOLLOWER_CHECK, fd.FOLLOWER_CHECK):
+            t.register_handler(action, self._on_follower_check)
 
     # -- helpers ----------------------------------------------------------
 
@@ -327,20 +346,34 @@ class Coordinator:
         local = self._on_publish({"state": payload})   # accept locally first
         if local.get("accepted"):
             acked.add(self.node_id)
+        from opensearch_tpu.common.retry import retry_call
+
+        def publish_to(peer):
+            if diff is not None:
+                r = self.transport.send_request(peer, PUBLISH,
+                                                {"diff": diff},
+                                                timeout=5.0)
+                if not r.get("accepted") and r.get("need_full"):
+                    # receiver holds a different base: full state
+                    r = self.transport.send_request(
+                        peer, PUBLISH, {"state": payload}, timeout=5.0)
+                return r
+            return self.transport.send_request(peer, PUBLISH,
+                                               {"state": payload},
+                                               timeout=5.0)
+
         for peer in targets:
             try:
-                if diff is not None:
-                    r = self.transport.send_request(peer, PUBLISH,
-                                                    {"diff": diff},
-                                                    timeout=5.0)
-                    if not r.get("accepted") and r.get("need_full"):
-                        # receiver holds a different base: full state
-                        r = self.transport.send_request(
-                            peer, PUBLISH, {"state": payload}, timeout=5.0)
-                else:
-                    r = self.transport.send_request(peer, PUBLISH,
-                                                    {"state": payload},
-                                                    timeout=5.0)
+                # one fast retry on a dropped frame: a transient blip
+                # must not demote a healthy leader over a lost quorum.
+                # Only disconnects retry — a RECEIVE timeout already
+                # spent its 5s budget and blocking publication further
+                # helps nobody
+                r = retry_call("publication",
+                               lambda peer=peer: publish_to(peer),
+                               retry_on=(NodeDisconnectedError,),
+                               max_attempts=2, base_delay=0.02,
+                               seed=_zlib.crc32(peer.encode()))
                 if r.get("accepted"):
                     ok_nodes.append(peer)
                     acked.add(peer)
@@ -423,16 +456,33 @@ class Coordinator:
     def _on_leader_check(self, payload: dict) -> dict:
         # follower asks: are you still my leader?
         with self._lock:
-            return {"leader": self.mode == Mode.LEADER,
-                    "term": self.current_term}
+            return self.leader_checker.handle_check(
+                payload, is_leader=self.mode == Mode.LEADER,
+                term=self.current_term)
 
     def _on_follower_check(self, payload: dict) -> dict:
         # leader asks follower: still following me in this term?  The
         # applied version rides along for the LagDetector.
         with self._lock:
-            ok = (payload["term"] == self.current_term
-                  and self.mode == Mode.FOLLOWER)
-            return {"ok": ok, "version": self.committed.version}
+            return self.follower_checker.handle_check(
+                payload, term=self.current_term,
+                is_follower=self.mode == Mode.FOLLOWER,
+                applied_version=self.committed.version)
+
+    def _on_follower_failure(self, peer: str, reason: str):
+        """FollowerChecker verdict: publish a state removing the node
+        (allocate_shards promotes its replicas on the way out)."""
+        try:
+            self.remove_node(peer)
+        except CoordinationError:
+            pass   # lost the lead mid-round; the new leader re-detects
+
+    def _on_leader_failure(self, leader: str):
+        """LeaderChecker verdict: the master is gone — become candidate
+        and re-elect."""
+        with self._lock:
+            self.mode = Mode.CANDIDATE
+        self.start_election()
 
     def run_checks_once(self):
         """One failure-detection round (scheduled repeatedly in production,
@@ -442,49 +492,9 @@ class Coordinator:
             state = self.committed
             term = self.current_term
         if mode == Mode.LEADER:
-            for peer in [n for n in state.nodes if n != self.node_id]:
-                lagging = False
-                try:
-                    r = self.transport.send_request(
-                        peer, FOLLOWER_CHECK, {"term": term}, timeout=2.0)
-                    ok = r.get("ok")
-                    # LagDetector (coordination/LagDetector.java): a
-                    # follower that acks checks but never APPLIES the
-                    # published state is as gone as a dead one — it
-                    # would serve stale reads forever
-                    lagging = bool(ok) and (int(r.get("version",
-                                                      state.version))
-                                            < state.version)
-                except OpenSearchTpuError:
-                    ok = False
-                if ok and not lagging:
-                    self._check_failures.pop(peer, None)
-                else:
-                    n = self._check_failures.get(peer, 0) + 1
-                    self._check_failures[peer] = n
-                    if n >= self.check_retries:
-                        self._check_failures.pop(peer, None)
-                        try:
-                            self.remove_node(peer)
-                        except CoordinationError:
-                            pass
+            self.follower_checker.check_round(state, term)
         elif mode == Mode.FOLLOWER and state.master_node:
-            leader = state.master_node
-            try:
-                r = self.transport.send_request(leader, LEADER_CHECK, {},
-                                                timeout=2.0)
-                ok = r.get("leader")
-            except OpenSearchTpuError:
-                ok = False
-            if ok:
-                self._check_failures.pop(leader, None)
-            else:
-                n = self._check_failures.get(leader, 0) + 1
-                self._check_failures[leader] = n
-                if n >= self.check_retries:
-                    with self._lock:
-                        self.mode = Mode.CANDIDATE
-                    self.start_election()
+            self.leader_checker.check_round(state.master_node)
         elif mode == Mode.CANDIDATE:
             self.start_election()
 

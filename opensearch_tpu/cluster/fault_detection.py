@@ -1,0 +1,157 @@
+"""Node fault detection: the leader checks its followers, followers
+check their leader.
+
+Analog of ``cluster/coordination/FollowersChecker.java`` (:48 — the
+``internal:coordination/fault_detection/follower_check`` action, its
+interval/timeout/retry settings) and ``LeaderChecker.java`` (:63, the
+``leader_check`` twin).  Both checkers ping over the ordinary
+TransportService; after ``retries`` CONSECUTIVE failures the follower
+checker hands the dead node to the coordinator (which publishes a state
+update removing it — replica promotion rides on ``allocate_shards``),
+and the leader checker demotes the local node to candidate and triggers
+an election.
+
+The failure counters live in a dict SHARED with the coordinator
+(``Coordinator._check_failures``) so election gating
+(``_leader_alive``) keeps seeing the same evidence the checkers do.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+
+FOLLOWER_CHECK = "internal:coordination/fault_detection/follower_check"
+LEADER_CHECK = "internal:coordination/fault_detection/leader_check"
+
+
+class FaultDetectionSettings:
+    """The three knobs both checkers share (the reference's
+    ``cluster.fault_detection.{follower,leader}_check.{interval,timeout,
+    retry_count}`` settings, collapsed to one group at this fidelity)."""
+
+    def __init__(self, interval: float = 1.0, timeout: float = 2.0,
+                 retries: int = 3):
+        self.interval = float(interval)
+        self.timeout = float(timeout)
+        self.retries = int(retries)
+
+    @staticmethod
+    def from_settings(s: Optional[dict]) -> "FaultDetectionSettings":
+        s = s or {}
+        return FaultDetectionSettings(
+            interval=float(s.get("cluster.fault_detection.check.interval",
+                                 1.0)),
+            timeout=float(s.get("cluster.fault_detection.check.timeout",
+                                2.0)),
+            retries=int(s.get("cluster.fault_detection.check.retry_count",
+                              3)))
+
+
+class FollowerChecker:
+    """Leader side: ping every node in the committed state each round;
+    a node that fails ``retries`` consecutive rounds (unreachable, wrong
+    term, or applying states too slowly — the LagDetector fold-in) is
+    reported to ``on_node_failure``."""
+
+    def __init__(self, transport, node_id: str,
+                 settings: FaultDetectionSettings,
+                 failures: dict,
+                 on_node_failure: Callable[[str, str], None]):
+        self.transport = transport
+        self.node_id = node_id
+        self.settings = settings
+        self._failures = failures        # peer -> consecutive failures
+        self.on_node_failure = on_node_failure
+        self._lock = threading.Lock()
+
+    def handle_check(self, payload: dict, *, term: int,
+                     is_follower: bool, applied_version: int) -> dict:
+        """Follower side of the ping: am I following you in this term?
+        The applied version rides along for lag detection."""
+        return {"ok": payload.get("term") == term and is_follower,
+                "version": applied_version}
+
+    def check_round(self, state, term: int) -> list:
+        """One round over the follower set; returns nodes failed THIS
+        round (after their retry budget ran out)."""
+        from opensearch_tpu.common.telemetry import metrics
+
+        dead = []
+        for peer in [n for n in state.nodes if n != self.node_id]:
+            lagging = False
+            try:
+                r = self.transport.send_request(
+                    peer, FOLLOWER_CHECK, {"term": term},
+                    timeout=self.settings.timeout)
+                ok = r.get("ok")
+                # LagDetector (coordination/LagDetector.java): a
+                # follower that acks checks but never APPLIES the
+                # published state is as gone as a dead one — it would
+                # serve stale reads forever
+                lagging = bool(ok) and (int(r.get("version",
+                                                  state.version))
+                                        < state.version)
+            except OpenSearchTpuError:
+                ok = False
+            with self._lock:
+                if ok and not lagging:
+                    self._failures.pop(peer, None)
+                    continue
+                n = self._failures.get(peer, 0) + 1
+                self._failures[peer] = n
+                exhausted = n >= self.settings.retries
+                if exhausted:
+                    self._failures.pop(peer, None)
+            if exhausted:
+                metrics().counter("fault_detection.follower.failed").inc()
+                reason = "lagging" if lagging else "disconnected"
+                dead.append(peer)
+                self.on_node_failure(peer, reason)
+        return dead
+
+
+class LeaderChecker:
+    """Follower side: ping the elected leader each round; after
+    ``retries`` consecutive failures call ``on_leader_failure`` (the
+    coordinator demotes to candidate and re-elects)."""
+
+    def __init__(self, transport, node_id: str,
+                 settings: FaultDetectionSettings,
+                 failures: dict,
+                 on_leader_failure: Callable[[str], None]):
+        self.transport = transport
+        self.node_id = node_id
+        self.settings = settings
+        self._failures = failures
+        self.on_leader_failure = on_leader_failure
+        self._lock = threading.Lock()
+
+    def handle_check(self, payload: dict, *, is_leader: bool,
+                     term: int) -> dict:
+        return {"leader": is_leader, "term": term}
+
+    def check_round(self, leader: str) -> bool:
+        """One ping; returns True when the leader just got declared
+        dead (the caller re-elects)."""
+        from opensearch_tpu.common.telemetry import metrics
+
+        try:
+            r = self.transport.send_request(
+                leader, LEADER_CHECK, {}, timeout=self.settings.timeout)
+            ok = r.get("leader")
+        except OpenSearchTpuError:
+            ok = False
+        with self._lock:
+            if ok:
+                self._failures.pop(leader, None)
+                return False
+            n = self._failures.get(leader, 0) + 1
+            self._failures[leader] = n
+            if n < self.settings.retries:
+                return False
+        metrics().counter("fault_detection.leader.failed").inc()
+        self.on_leader_failure(leader)
+        return True

@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import os
 import threading
+import zlib
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Optional
 
 from opensearch_tpu.search.executor import merge_hit_rows
@@ -31,11 +33,37 @@ from opensearch_tpu.common.errors import (
     ShardNotFoundError,
     VersionConflictError,
 )
+from opensearch_tpu.common.retry import retry_call
 from opensearch_tpu.cluster.coordination import CoordinationError, Coordinator
 from opensearch_tpu.cluster.state import (ClusterState, allocate_shards,
                                           copies_of)
 from opensearch_tpu.indices.service import IndexService
-from opensearch_tpu.transport.service import TransportService
+from opensearch_tpu.transport.service import (ReceiveTimeoutError,
+                                              RemoteTransportError,
+                                              TransportService)
+
+# remote error types that are the CLIENT's fault: failing over to
+# another copy would just repeat the same deterministic rejection, so
+# these re-raise instead of degrading to a counted shard failure
+_CLIENT_ERROR_TYPES = frozenset({
+    "parsing_exception", "illegal_argument_exception",
+    "action_request_validation_exception", "mapper_parsing_exception",
+    "index_not_found_exception"})
+
+
+def _degradable_search_error(exc: BaseException) -> bool:
+    """Is this shard-level failure one the coordinator may paper over
+    (retry the next copy / count in ``_shards.failed``)?"""
+    from opensearch_tpu.common import breakers
+    from opensearch_tpu.common.errors import CircuitBreakingError
+
+    if isinstance(exc, (NodeDisconnectedError, ReceiveTimeoutError,
+                        ShardNotFoundError, CircuitBreakingError,
+                        breakers.CircuitBreakingError)):
+        return True
+    if isinstance(exc, RemoteTransportError):
+        return exc.remote_type not in _CLIENT_ERROR_TYPES
+    return False
 
 A_CREATE_INDEX = "cluster:admin/index/create"
 A_DELETE_INDEX = "cluster:admin/index/delete"
@@ -199,10 +227,19 @@ class ClusterNode:
             if svc is not None:
                 # offer op-based recovery: our highest applied seq-no
                 local_ckpt = svc.engine_for(shard)._seq_no
-            resp = self.transport.send_request(
-                primary, A_START_RECOVERY,
-                {"index": index, "shard": shard, "node": self.node_id,
-                 "local_checkpoint": local_ckpt}, timeout=30.0)
+            # transient drops during recovery retry in place: restarting
+            # the whole recovery from the next cluster-state application
+            # is far more expensive than one more RPC
+            resp = retry_call(
+                "recovery.start",
+                lambda: self.transport.send_request(
+                    primary, A_START_RECOVERY,
+                    {"index": index, "shard": shard,
+                     "node": self.node_id,
+                     "local_checkpoint": local_ckpt}, timeout=30.0),
+                max_attempts=3, base_delay=0.1, max_delay=1.0,
+                budget_s=90.0, seed=zlib.crc32(
+                    f"{self.node_id}/{index}/{shard}".encode()))
             svc = self.indices.get(index)
             if svc is None:
                 return
@@ -222,8 +259,12 @@ class ClusterNode:
             if master == self.node_id:
                 self._h_shard_recovered(payload)
             else:
-                self.transport.send_request(master, A_SHARD_RECOVERED,
-                                            payload, timeout=10.0)
+                retry_call(
+                    "recovery.report",
+                    lambda: self.transport.send_request(
+                        master, A_SHARD_RECOVERED, payload, timeout=10.0),
+                    max_attempts=2, base_delay=0.05,
+                    seed=zlib.crc32(self.node_id.encode()))
             with self._lock:
                 self._recovered.add((index, shard))
         except OpenSearchTpuError:
@@ -467,7 +508,22 @@ class ClusterNode:
             in_sync = set(entry.get("in_sync") or [])
             for rep, fut in futures:
                 try:
-                    fut.result(timeout=10.0)
+                    try:
+                        fut.result(timeout=10.0)
+                    except (NodeDisconnectedError, ReceiveTimeoutError,
+                            FuturesTimeout):
+                        # transient blip: re-send with bounded backoff
+                        # before evicting the copy — replica ops are
+                        # seq-no idempotent, so a duplicate of a frame
+                        # that DID land is harmless
+                        retry_call(
+                            "replication",
+                            lambda rep=rep: self.transport.send_request(
+                                rep, A_REPLICATE_OP, rep_payload,
+                                timeout=10.0),
+                            max_attempts=2, base_delay=0.05,
+                            max_delay=0.5, budget_s=15.0,
+                            seed=zlib.crc32(rep.encode()))
                     # the ack advances the replica's retention lease —
                     # translog history stays bounded by the slowest
                     # replica's checkpoint (RetentionLease renewal)
@@ -613,27 +669,65 @@ class ClusterNode:
 
     # -- search (scatter-gather) -------------------------------------------
 
+    def _copy_candidates(self, entry: dict) -> list[str]:
+        """Shard-copy failover order: the LOCAL in-sync copy first
+        (degenerate adaptive replica selection), then the primary, then
+        in-sync replicas.  Copies still in peer recovery are excluded —
+        they would silently answer from an empty engine
+        (AbstractSearchAsyncAction's ShardIterator over active copies)."""
+        in_sync = set(entry.get("in_sync") or [])
+        order = [n for n in copies_of(entry) if n in in_sync]
+        if not order and entry.get("primary"):
+            # transitional states (stale promotion mid-flight) may leave
+            # an empty in-sync set; the primary is still the best copy
+            order = [entry["primary"]]
+        if self.node_id in order:
+            order.remove(self.node_id)
+            order.insert(0, self.node_id)
+        return order
+
+    def _query_group(self, node: str, payload: dict) -> dict:
+        """One shard-group query phase RPC (local short-circuit)."""
+        if node == self.node_id:
+            return self._h_search_shards(payload)
+        fut = self.transport.submit_request(node, A_SEARCH_SHARDS, payload)
+        try:
+            return fut.result(timeout=30.0)
+        except FuturesTimeout:
+            raise ReceiveTimeoutError(
+                f"[{node}][{A_SEARCH_SHARDS}] timed out") from None
+
     def search(self, index: str, body: Optional[dict] = None) -> dict:
-        """Coordinator side: group the index's shards by owning node, one
-        RPC per node, merge top-k on this node."""
-        body = body or {}
+        """Coordinator side: group shards by their preferred copy's node,
+        one RPC per node; a failed node sends its shards to their NEXT
+        copy (per-shard failover iterators); shards whose every copy
+        failed degrade to ``_shards.failed`` entries when partial
+        results are allowed, and the survivors' top-k merges on this
+        node."""
+        from opensearch_tpu.common.telemetry import metrics, tracer
+        from opensearch_tpu.search import executor as _exec
+
+        body = dict(body or {})
+        allow_partial = body.pop("allow_partial_search_results", None)
+        if allow_partial is None:
+            allow_partial = _exec.DEFAULT_ALLOW_PARTIAL_RESULTS
+        allow_partial = bool(allow_partial)
         state = self.coordinator.state()
         routing = state.routing.get(index)
         if routing is None:
             raise IndexNotFoundError(index)
-        # one copy per shard: prefer a local IN-SYNC copy (a replica still
-        # in peer recovery is empty), else the primary (degenerate
-        # adaptive replica selection, ref node/ResponseCollectorService.java)
-        by_node: dict[str, list[int]] = {}
+        candidates: dict[int, list[str]] = {}
+        failures: list[dict] = []
         for shard, entry in enumerate(routing):
-            copies = copies_of(entry)
-            if not copies:
-                raise ShardNotFoundError(f"[{index}][{shard}] unassigned")
-            in_sync = entry.get("in_sync") or []
-            target = (self.node_id
-                      if self.node_id in copies and self.node_id in in_sync
-                      else copies[0])
-            by_node.setdefault(target, []).append(shard)
+            cands = self._copy_candidates(entry)
+            if not cands:
+                exc = ShardNotFoundError(f"[{index}][{shard}] unassigned")
+                if not allow_partial:
+                    raise exc
+                failures.append(_exec.shard_failure_entry(
+                    index, shard, None, exc))
+                continue
+            candidates[shard] = cands
 
         aggs_requested = bool(body.get("aggs") or body.get("aggregations"))
 
@@ -643,26 +737,53 @@ class ClusterNode:
         sub["from"] = 0
         sub["size"] = from_ + size
 
-        from opensearch_tpu.common.telemetry import tracer
-
         # coordinator span: the scatter RPCs inject its trace context, so
         # every remote shard query phase parents under this trace
         with tracer().start_span(
                 "search.coordinator",
                 {"index": index, "node": self.node_id,
-                 "shards": len(routing), "nodes": len(by_node)}):
+                 "shards": len(routing)}):
             responses = []
-            futures = []
-            for node, shards in by_node.items():
-                payload = {"index": index, "shards": shards, "body": sub,
-                           "agg_partials": aggs_requested}
-                if node == self.node_id:
-                    responses.append(self._h_search_shards(payload))
-                else:
-                    futures.append(self.transport.submit_request(
-                        node, A_SEARCH_SHARDS, payload))
-            for fut in futures:
-                responses.append(fut.result(timeout=30.0))
+            attempt = {shard: 0 for shard in candidates}
+            pending = set(candidates)
+            while pending:
+                by_node: dict[str, list[int]] = {}
+                for shard in sorted(pending):
+                    node = candidates[shard][attempt[shard]]
+                    by_node.setdefault(node, []).append(shard)
+                for node, shards in by_node.items():
+                    payload = {"index": index, "shards": shards,
+                               "body": sub,
+                               "agg_partials": aggs_requested}
+                    try:
+                        responses.append(self._query_group(node, payload))
+                        pending.difference_update(shards)
+                        continue
+                    except OpenSearchTpuError as exc:
+                        if not _degradable_search_error(exc):
+                            raise   # client errors (bad query) stay 4xx
+                        last = exc
+                    # the whole group fails over: each of its shards
+                    # advances to its next copy; a shard out of copies
+                    # becomes a counted failure
+                    for shard in shards:
+                        attempt[shard] += 1
+                        if attempt[shard] < len(candidates[shard]):
+                            metrics().counter(
+                                "search.shard_failover").inc()
+                            continue
+                        pending.discard(shard)
+                        metrics().counter("search.shard_failures").inc()
+                        failures.append(_exec.shard_failure_entry(
+                            index, shard, node, last))
+            if failures and not allow_partial:
+                from opensearch_tpu.common.errors import \
+                    SearchPhaseExecutionError
+                raise SearchPhaseExecutionError(
+                    "query",
+                    f"{len(failures)} of {len(routing)} shards failed "
+                    f"and [allow_partial_search_results] is false",
+                    failures)
 
             total = 0
             max_score = None
@@ -686,8 +807,7 @@ class ClusterNode:
             # one shard running out of budget flags the whole response
             "timed_out": any(resp["resp"].get("timed_out")
                              for resp in responses),
-            "_shards": {"total": n_shards, "successful": n_shards,
-                        "skipped": 0, "failed": 0},
+            "_shards": _exec.shards_section(n_shards, failures),
             "hits": {"total": {"value": total, "relation": "eq"},
                      "max_score": max_score,
                      "hits": all_hits[from_: from_ + size]},
@@ -748,6 +868,12 @@ class ClusterNode:
                 "handshake with [%s] failed: %s", peer, e)
 
     def stop(self):
+        # idempotent: a test teardown stopping an already-stopped node
+        # (or one whose start_election never ran) must return at once
+        with self._lock:
+            if getattr(self, "_node_stopped", False):
+                return
+            self._node_stopped = True
         self.coordinator.stop()
         with self._lock:
             for svc in self.indices.values():

@@ -159,6 +159,39 @@ class ShardNotFoundError(ResourceNotFoundError):
 
 
 class NodeDisconnectedError(OpenSearchTpuError):
-    """Transport-level peer failure (transport/NodeDisconnectedException)."""
+    """Transport-level peer failure (transport/NodeDisconnectedException).
 
-    status = 500
+    503, not 500: the condition is transient from the caller's side —
+    retry against another copy / later — and the REST layer surfaces it
+    as service-unavailable with the error type intact."""
+
+    status = 503
+
+
+class NoShardAvailableError(OpenSearchTpuError):
+    """Every copy of a shard failed (NoShardAvailableActionException)."""
+
+    wire_name = "no_shard_available_action_exception"
+    status = 503
+
+
+class SearchPhaseExecutionError(OpenSearchTpuError):
+    """Shard failures the coordinator could not paper over — raised when
+    partial results are disallowed (``allow_partial_search_results:
+    false``) or no shard answered at all
+    (action/search/SearchPhaseExecutionException)."""
+
+    wire_name = "search_phase_execution_exception"
+    status = 503
+
+    def __init__(self, phase: str, reason: str,
+                 shard_failures: "list[dict] | None" = None):
+        super().__init__(reason)
+        self.phase = phase
+        self.shard_failures = shard_failures or []
+
+    def to_xcontent(self) -> dict:
+        out = super().to_xcontent()
+        out["error"]["phase"] = self.phase
+        out["error"]["failed_shards"] = self.shard_failures
+        return out

@@ -1,0 +1,169 @@
+"""RetryableAction: budget-capped exponential backoff with deterministic
+seeded jitter.
+
+Analog of ``action/support/RetryableAction.java`` (tryAction/
+onFailure/retry scheduling) and ``action/bulk/BackoffPolicy.java``
+(exponentialBackoff): a transient transport failure — dropped frame,
+broken pipe, timed-out peer — is retried with growing delays until
+either the attempt count or the wall budget is exhausted, then the last
+error surfaces.  Everything is measured on the monotonic clock and the
+jitter is drawn from a *seeded* RNG so fault-injection tests replay the
+exact same schedule every run.
+
+Counters land in the PR-1 MetricsRegistry (``retry.<name>.attempts`` /
+``retry.<name>.retries`` / ``retry.<name>.exhausted``) and every attempt
+runs under a ``retry:<name>`` span carrying the attempt number.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+from opensearch_tpu.common.errors import NodeDisconnectedError
+
+
+def _transport_retryables() -> tuple:
+    # late import: transport/service.py imports common.errors, and
+    # ReceiveTimeoutError lives next to the transports
+    import concurrent.futures
+    from opensearch_tpu.transport.service import ReceiveTimeoutError
+    # concurrent.futures.TimeoutError is NOT the builtin before 3.11
+    return (NodeDisconnectedError, ReceiveTimeoutError, TimeoutError,
+            concurrent.futures.TimeoutError)
+
+
+class BackoffPolicy:
+    """Delay schedule for retries: ``base * multiplier**n`` capped at
+    ``max_delay``, with full-range deterministic jitter (the seeded-RNG
+    variant of the reference's equal-jitter backoff).
+
+    ``budget_s`` caps the TOTAL time an action may spend across attempts
+    (sleeps included) so a retry loop can never outlive its caller's own
+    timeout — the retryable-replication analog of
+    ``indices.replication.retry_timeout``.
+    """
+
+    def __init__(self, name: str = "action", base_delay: float = 0.05,
+                 multiplier: float = 2.0, max_delay: float = 2.0,
+                 max_attempts: int = 4, budget_s: Optional[float] = None,
+                 jitter: float = 0.2, seed: int = 0):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.name = name
+        self.base_delay = float(base_delay)
+        self.multiplier = float(multiplier)
+        self.max_delay = float(max_delay)
+        self.max_attempts = int(max_attempts)
+        self.budget_s = budget_s
+        self.jitter = float(jitter)
+        self.seed = int(seed)
+
+    def delays(self):
+        """Deterministic delay sequence for attempts 2..max_attempts.
+        A fresh seeded RNG per call: two actions sharing one policy see
+        the identical schedule (reproducibility over spread)."""
+        rng = random.Random(self.seed)
+        d = self.base_delay
+        for _ in range(self.max_attempts - 1):
+            base = min(d, self.max_delay)
+            # jitter shrinks the delay only (never beyond max_delay) and
+            # is drawn deterministically from the seeded stream
+            yield base * (1.0 - self.jitter * rng.random())
+            d *= self.multiplier
+
+
+class RetryExhaustedError(NodeDisconnectedError):
+    """All attempts failed; carries the last underlying error."""
+
+    def __init__(self, name: str, attempts: int, last: BaseException):
+        super().__init__(
+            f"[{name}] failed after {attempts} attempt(s): {last}")
+        self.last = last
+
+
+class RetryableAction:
+    """Run ``fn`` with retries per ``policy``.
+
+    ``retry_on`` defaults to the transport-transient trio
+    (NodeDisconnectedError / ReceiveTimeoutError / TimeoutError); any
+    other exception propagates immediately — a version conflict or a
+    validation error must never be hammered.
+    """
+
+    def __init__(self, name: str, fn: Callable, policy: BackoffPolicy,
+                 retry_on: Optional[tuple] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self.fn = fn
+        self.policy = policy
+        self.retry_on = retry_on or _transport_retryables()
+        self._sleep = sleep
+        self._clock = clock
+
+    def run(self):
+        from opensearch_tpu.common.telemetry import metrics, tracer
+
+        t0 = self._clock()
+        budget = self.policy.budget_s
+        attempts = 0
+        last: Optional[BaseException] = None
+        schedule = self.policy.delays()
+        while True:
+            attempts += 1
+            metrics().counter(f"retry.{self.name}.attempts").inc()
+            try:
+                with tracer().start_span(f"retry:{self.name}",
+                                         {"attempt": attempts}):
+                    return self.fn()
+            except self.retry_on as e:   # noqa: PERF203 — retry boundary
+                last = e
+            delay = next(schedule, None)
+            out_of_budget = (budget is not None
+                             and self._clock() - t0
+                             + (delay or 0.0) > budget)
+            if delay is None or out_of_budget:
+                metrics().counter(f"retry.{self.name}.exhausted").inc()
+                raise RetryExhaustedError(self.name, attempts, last) \
+                    from last
+            metrics().counter(f"retry.{self.name}.retries").inc()
+            self._sleep(delay)   # backoff: schedule from BackoffPolicy
+
+
+def retry_call(name: str, fn: Callable,
+               policy: Optional[BackoffPolicy] = None,
+               retry_on: Optional[tuple] = None, **policy_kw):
+    """One-line form: ``retry_call("replicate", fn, max_attempts=3)``."""
+    if policy is None:
+        policy = BackoffPolicy(name=name, **policy_kw)
+    return RetryableAction(name, fn, policy, retry_on=retry_on).run()
+
+
+class Deadline:
+    """Monotonic-clock deadline for bounded wait loops: carry one of
+    these (or a BackoffPolicy) instead of sleeping bare in a loop — the
+    ``tools/check_sleep_loops.py`` lint enforces the annotation."""
+
+    __slots__ = ("_until",)
+
+    def __init__(self, seconds: float):
+        self._until = time.monotonic() + seconds
+
+    def expired(self) -> bool:
+        return time.monotonic() >= self._until
+
+    def remaining(self) -> float:
+        return max(0.0, self._until - time.monotonic())
+
+    def wait_until(self, pred: Callable[[], bool],
+                   poll: float = 0.02) -> bool:
+        """Poll ``pred`` until true or the deadline expires."""
+        ev = threading.Event()
+        while not self.expired():
+            if pred():
+                return True
+            ev.wait(min(poll, self.remaining()))   # deadline-bounded
+        return pred()

@@ -95,6 +95,9 @@ class Node:
             min_value=0, dynamic=True)
         identity_enabled = Setting.bool_setting(
             "identity.enabled", False, dynamic=True)
+        allow_partial = Setting.bool_setting(
+            "search.default_allow_partial_search_results", True,
+            dynamic=True)
         alloc_enable = Setting.str_setting(
             "cluster.routing.allocation.enable", "all", dynamic=True,
             choices=("all", "primaries", "new_primaries", "none"))
@@ -115,7 +118,14 @@ class Node:
             Settings(stored),
             [max_buckets, auto_create, max_scroll, cache_size,
              identity_enabled, alloc_enable, backpressure_mode,
-             max_keep_alive, default_keep_alive])
+             max_keep_alive, default_keep_alive, allow_partial])
+        from opensearch_tpu.search import executor as executor_mod
+        self.cluster_settings.add_settings_update_consumer(
+            allow_partial,
+            lambda v: setattr(executor_mod,
+                              "DEFAULT_ALLOW_PARTIAL_RESULTS", bool(v)))
+        executor_mod.DEFAULT_ALLOW_PARTIAL_RESULTS = bool(
+            self.cluster_settings.get(allow_partial))
         self.cluster_settings.add_settings_update_consumer(
             max_keep_alive,
             lambda v: setattr(self.contexts, "max_keep_alive_s", v))
@@ -229,6 +239,12 @@ class Node:
         return self
 
     def stop(self):
+        # idempotent (and safe when start() never ran): double-stop in a
+        # test teardown must not re-close engines or hang on the HTTP
+        # server's shutdown handshake
+        if getattr(self, "_stopped", False):
+            return
+        self._stopped = True
         self.http.stop()
         self.indices.close()
         self.thread_pool.shutdown()

@@ -257,7 +257,16 @@ class RestController:
                 "reason": f"no handler found for uri [{path}] and method "
                           f"[{method}]"}, "status": 400}
         except OpenSearchTpuError as e:
+            # transport-layer failures (NodeDisconnectedError /
+            # ReceiveTimeoutError / NoMasterError) carry status 503 on
+            # the class: the condition is retryable and the serialized
+            # body keeps the precise error.type for clients
             return e.status, e.to_xcontent()
+        except (TimeoutError, ConnectionError) as e:
+            # stdlib-level transport failures get the same 503 treatment
+            return 503, {"error": {"type": "node_disconnected_exception",
+                                   "reason": f"{type(e).__name__}: {e}"},
+                         "status": 503}
         except Exception as e:  # noqa: BLE001 — the REST boundary
             return 500, {"error": {"type": "internal_server_error",
                                    "reason": f"{type(e).__name__}: {e}"},
@@ -1536,7 +1545,7 @@ class RestController:
         "track_scores", "scroll", "slice", "pit", "timeout",
         "terminate_after", "version", "seq_no_primary_term",
         "indices_boost", "stored_fields", "post_filter",
-        "_hybrid_pipeline"})
+        "_hybrid_pipeline", "allow_partial_search_results"})
 
     def h_search(self, req):
         body = req.json({}) or {}
@@ -1566,6 +1575,13 @@ class RestController:
             body["size"] = int(req.param("size"))
         if req.param("from") is not None:
             body["from"] = int(req.param("from"))
+        if req.param("allow_partial_search_results") is not None:
+            # request param wins over the dynamic cluster default
+            # (search.default_allow_partial_search_results); consumed by
+            # the cluster coordinator's scatter phase
+            body["allow_partial_search_results"] = \
+                str(req.param("allow_partial_search_results")).lower() \
+                != "false"
         src_spec = self._bulk_source_param(req)
         if src_spec is not None:
             body["_source"] = src_spec     # URL params override the body

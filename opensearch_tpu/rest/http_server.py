@@ -122,7 +122,13 @@ class HttpServer:
         self._thread.start()
 
     def stop(self):
-        self.httpd.shutdown()
+        """Idempotent, and safe WITHOUT a prior start():
+        ``ThreadingHTTPServer.shutdown()`` blocks forever unless
+        ``serve_forever`` is actually running, so it is only called when
+        the serving thread exists."""
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            self.httpd.shutdown()
         self.httpd.server_close()
-        if self._thread:
-            self._thread.join(timeout=5)
+        if thread is not None:
+            thread.join(timeout=5)

@@ -40,6 +40,38 @@ _F32 = np.float32
 _I32 = np.int32
 _I32_MAX = 2**31 - 1
 
+# Cluster-wide default for ``allow_partial_search_results`` (the
+# reference's dynamic ``search.default_allow_partial_search_results``
+# setting): a request-level value wins; the REST layer updates this via
+# _cluster/settings, and the cluster coordinator reads it at scatter
+# time.  True = a dead shard copy degrades the response
+# (``_shards.failed`` + ``failures[]``) instead of failing it.
+DEFAULT_ALLOW_PARTIAL_RESULTS = True
+
+
+def shards_section(total: int, failures: "Optional[list]" = None,
+                   skipped: int = 0) -> dict:
+    """The ``_shards`` response block, with the reference's shape: a
+    ``failures`` array only when something actually failed."""
+    failures = failures or []
+    out = {"total": int(total),
+           "successful": int(total) - len(failures),
+           "skipped": int(skipped), "failed": len(failures)}
+    if failures:
+        out["failures"] = list(failures)
+    return out
+
+
+def shard_failure_entry(index: str, shard: int, node: "Optional[str]",
+                        exc: BaseException) -> dict:
+    """One ``_shards.failures[]`` element (ShardSearchFailure analog):
+    carries the REMOTE error type when the failure crossed the wire."""
+    err_type = getattr(exc, "remote_type", None) \
+        or getattr(exc, "error_type", None) \
+        or type(exc).__name__
+    return {"shard": int(shard), "index": index, "node": node,
+            "reason": {"type": err_type, "reason": str(exc)}}
+
 
 class SearchDeadline:
     """Per-request time budget (QueryPhase's timeout runnable analog).
@@ -342,7 +374,7 @@ class ShardSearcher:
         resp = {
             "took": took,
             "timed_out": deadline.timed_out,
-            "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
+            "_shards": shards_section(1),
             "hits": {
                 "total": {"value": int(total), "relation": "eq"},
                 "max_score": max_score,
@@ -422,8 +454,7 @@ class ShardSearcher:
         return {
             "took": int((time.monotonic() - t0) * 1000),
             "timed_out": deadline.timed_out,
-            "_shards": {"total": 1, "successful": 1, "skipped": 0,
-                        "failed": 0},
+            "_shards": shards_section(1),
             "hits": {"total": {"value": max_total, "relation": "gte"},
                      "max_score": (combined[0]["score"] if combined
                                    else None),
@@ -455,8 +486,7 @@ class ShardSearcher:
                 results[pos] = {
                     "took": int((time.monotonic() - t0) * 1000),
                     "timed_out": False,
-                    "_shards": {"total": 1, "successful": 1, "skipped": 0,
-                                "failed": 0},
+                    "_shards": shards_section(1),
                     "hits": {"total": {"value": int(total),
                                        "relation": "eq"},
                              "max_score": max_score, "hits": hits},

@@ -1,0 +1,192 @@
+"""Deterministic fault injection over the LocalTransport hub.
+
+Analog of the test framework's ``MockTransportService`` +
+``NetworkDisruption`` (test/framework .../test/transport/
+MockTransportService.java, .../test/disruption/NetworkDisruption.java):
+first-class drop / delay / duplicate / disconnect rules that match on
+the transport ACTION NAME (glob patterns), scoped one-shot or sticky,
+with every probabilistic choice drawn from a seeded RNG — the same seed
+replays the same fault schedule, so every fault-tolerance test in this
+repo is reproducible bit-for-bit.
+
+Usage::
+
+    hub = LocalTransport.Hub()
+    faults = FaultInjector(hub, seed=42)
+    faults.drop("indices:data/read/search*", target="n2", times=1)
+    faults.delay(0.2, action="internal:coordination/*")
+    faults.disconnect("n2")          # full partition
+    faults.heal("n2")                # lift it
+    faults.clear()                   # lift everything
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import random
+import threading
+from typing import Optional
+
+from opensearch_tpu.common.errors import NodeDisconnectedError
+from opensearch_tpu.transport.service import Directive, peek_action
+
+
+class _Rule:
+    """One installed fault: match → act, ``times``-bounded or sticky."""
+
+    def __init__(self, injector: "FaultInjector", action: str,
+                 source: Optional[str], target: Optional[str],
+                 probability: float, times: Optional[int]):
+        self.injector = injector
+        self.action = action
+        self.source = source
+        self.target = target
+        self.probability = float(probability)
+        self.remaining = times           # None = sticky
+        self._lock = threading.Lock()
+
+    def matches(self, src: str, dst: str, frame: bytes) -> bool:
+        if self.source is not None and src != self.source:
+            return False
+        if self.target is not None and dst != self.target:
+            return False
+        if self.action not in ("*", None):
+            act = peek_action(frame)
+            # exact match first: real action names contain glob
+            # metacharacters ("...shard[r]"), which fnmatch would
+            # otherwise read as a character class
+            if act != self.action \
+                    and not fnmatch.fnmatch(act, self.action):
+                return False
+        with self._lock:
+            if self.remaining is not None and self.remaining <= 0:
+                return False
+            if self.probability < 1.0 \
+                    and self.injector._random() >= self.probability:
+                return False
+            if self.remaining is not None:
+                self.remaining -= 1
+        return True
+
+    def __call__(self, src: str, dst: str, frame: bytes):
+        if self.matches(src, dst, frame):
+            return self.act(src, dst)
+        return None
+
+    def act(self, src: str, dst: str):   # pragma: no cover - overridden
+        return None
+
+
+class _Drop(_Rule):
+    def __init__(self, *a, silent: bool = False):
+        super().__init__(*a)
+        self.silent = silent
+
+    def act(self, src, dst):
+        if self.silent:
+            # swallow: the sender's future just never resolves (times
+            # out) — the lost-frame failure mode, vs. the fast-failing
+            # connection-refused one below
+            return Directive(copies=0)
+        raise NodeDisconnectedError(
+            f"[fault_injection] dropped frame {src}->{dst}")
+
+
+class _Delay(_Rule):
+    def __init__(self, *a, seconds: float):
+        super().__init__(*a)
+        self.seconds = float(seconds)
+
+    def act(self, src, dst):
+        return self.seconds
+
+
+class _Duplicate(_Rule):
+    def __init__(self, *a, copies: int = 2):
+        super().__init__(*a)
+        self.copies = int(copies)
+
+    def act(self, src, dst):
+        return Directive(copies=self.copies)
+
+
+class FaultInjector:
+    """Installs/uninstalls rules on a ``LocalTransport.Hub``; every
+    random draw comes from one seeded stream guarded by a lock, so a
+    fixed seed gives a fixed schedule regardless of which fault fires
+    first."""
+
+    def __init__(self, hub, seed: int = 0):
+        self.hub = hub
+        self.seed = int(seed)
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
+        self._installed: list = []
+        self._partitions: dict[str, object] = {}
+
+    def _random(self) -> float:
+        with self._rng_lock:
+            return self._rng.random()
+
+    def _install(self, rule):
+        self.hub.add_rule(rule)
+        self._installed.append(rule)
+        return rule
+
+    # -- faults ------------------------------------------------------------
+
+    def drop(self, action: str = "*", source: Optional[str] = None,
+             target: Optional[str] = None, probability: float = 1.0,
+             times: Optional[int] = None, silent: bool = False):
+        """Drop matching frames.  ``silent=True`` swallows them (the
+        sender times out); default raises at send time (the sender sees
+        a NodeDisconnectedError immediately)."""
+        return self._install(_Drop(self, action, source, target,
+                                   probability, times, silent=silent))
+
+    def delay(self, seconds: float, action: str = "*",
+              source: Optional[str] = None, target: Optional[str] = None,
+              probability: float = 1.0, times: Optional[int] = None):
+        return self._install(_Delay(self, action, source, target,
+                                    probability, times, seconds=seconds))
+
+    def duplicate(self, action: str = "*", source: Optional[str] = None,
+                  target: Optional[str] = None, probability: float = 1.0,
+                  times: Optional[int] = None, copies: int = 2):
+        """Deliver matching frames ``copies`` times — the at-least-once
+        hazard handlers must tolerate (idempotency probes)."""
+        return self._install(_Duplicate(self, action, source, target,
+                                        probability, times, copies=copies))
+
+    def disconnect(self, node_id: str):
+        """Full partition: everything to/from ``node_id`` fails fast."""
+        if node_id in self._partitions:
+            return self._partitions[node_id]
+        rule = self.hub.disconnect(node_id)
+        self._installed.append(rule)
+        self._partitions[node_id] = rule
+        return rule
+
+    def heal(self, node_id: str) -> bool:
+        """Lift a ``disconnect`` partition."""
+        rule = self._partitions.pop(node_id, None)
+        if rule is None:
+            return False
+        self._installed.remove(rule)
+        return self.hub.remove_rule(rule)
+
+    def remove(self, rule) -> bool:
+        if rule in self._installed:
+            self._installed.remove(rule)
+        for nid, r in list(self._partitions.items()):
+            if r is rule:
+                del self._partitions[nid]
+        return self.hub.remove_rule(rule)
+
+    def clear(self):
+        """Uninstall every rule THIS injector added (other hub rules are
+        left alone, unlike ``hub.clear_rules``)."""
+        for rule in self._installed:
+            self.hub.remove_rule(rule)
+        self._installed.clear()
+        self._partitions.clear()

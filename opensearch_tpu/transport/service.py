@@ -25,6 +25,7 @@ import threading
 import time
 import uuid
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Callable, Optional
 
 from opensearch_tpu.common.errors import (
@@ -44,7 +45,9 @@ COMPRESS_THRESHOLD = 1024     # bytes; small frames ship raw
 
 
 class ReceiveTimeoutError(OpenSearchTpuError):
-    status = 500
+    # 503: the peer may come back — retryable, unlike a true 500
+    # (the REST layer surfaces these as service-unavailable)
+    status = 503
 
 
 class RemoteTransportError(OpenSearchTpuError):
@@ -84,6 +87,22 @@ def decode_frame(body: bytes, status: int = 0):
     action = inp.read_string()
     payload = inp.read_value()
     return version, action, payload
+
+
+def peek_action(frame: bytes) -> str:
+    """Action name of a full wire frame (marker + length prefix included)
+    WITHOUT materializing the payload — what the fault-injection rules
+    pattern-match on.  Response frames carry the request's action too, so
+    rules apply symmetrically to both directions."""
+    import zlib
+
+    status = frame[14]
+    body = frame[15:]
+    if status & STATUS_COMPRESSED:
+        body = zlib.decompress(body)
+    inp = StreamInput(body)
+    inp.read_vint()                      # protocol version
+    return inp.read_string()
 
 
 class TransportService:
@@ -184,7 +203,10 @@ class TransportService:
         fut = self.submit_request(target, action, payload)
         try:
             return fut.result(timeout=timeout)
-        except TimeoutError:
+        # concurrent.futures.TimeoutError only aliases the builtin from
+        # 3.11 — catch both or silently-dropped frames crash the caller
+        # instead of mapping to ReceiveTimeoutError
+        except (TimeoutError, FuturesTimeout):
             # drop the correlation slot or every lost response leaks one
             with self._lock:
                 for req_id, pending in list(self._pending.items()):
@@ -296,30 +318,58 @@ class Transport:
         raise NotImplementedError
 
 
+class Directive:
+    """What a hub rule may return: pass the frame along after ``delay``
+    seconds, delivered ``copies`` times (0 = silently swallow — the
+    drop-without-error variant; raising from the rule keeps meaning
+    drop-with-send-error).  Plain floats still mean delay-only, so old
+    rules keep working."""
+
+    __slots__ = ("delay", "copies")
+
+    def __init__(self, delay: float = 0.0, copies: int = 1):
+        self.delay = float(delay)
+        self.copies = int(copies)
+
+
 class LocalTransport(Transport):
     """In-process hub: every node's TransportService registers here;
     sends are direct calls on the receiver (on the receiver's executor).
-    Rules make it the disruption-testing harness."""
+    Rules make it the disruption-testing harness (see
+    ``testing/fault_injection.py`` for the first-class API)."""
 
     class Hub:
         def __init__(self):
             self.nodes: dict[str, TransportService] = {}
-            self.rules: list[Callable[[str, str, bytes], Optional[float]]] = []
+            self.rules: list[Callable[[str, str, bytes],
+                                      "Optional[float | Directive]"]] = []
             self.lock = threading.Lock()
 
         def add_rule(self, rule):
             """rule(source, target, frame) -> None=pass, float=delay
-            seconds, raise=drop."""
-            self.rules.append(rule)
+            seconds, Directive=delay/duplicate/swallow, raise=drop.
+            Returns the rule so callers can ``remove_rule`` it later."""
+            with self.lock:
+                self.rules.append(rule)
+            return rule
+
+        def remove_rule(self, rule) -> bool:
+            with self.lock:
+                try:
+                    self.rules.remove(rule)
+                    return True
+                except ValueError:
+                    return False
 
         def clear_rules(self):
-            self.rules.clear()
+            with self.lock:
+                self.rules.clear()
 
         def disconnect(self, node_id: str):
             def rule(src, dst, frame):
                 if src == node_id or dst == node_id:
                     raise NodeDisconnectedError(f"[{node_id}] partitioned")
-            self.add_rule(rule)
+            return self.add_rule(rule)
 
     def __init__(self, hub: "LocalTransport.Hub"):
         self.hub = hub
@@ -332,18 +382,28 @@ class LocalTransport(Transport):
 
     def send(self, source: str, target: str, frame: bytes):
         delay = 0.0
-        for rule in list(self.hub.rules):
+        copies = 1
+        with self.hub.lock:
+            rules = list(self.hub.rules)
+        for rule in rules:
             d = rule(source, target, frame)
-            if d:
+            if isinstance(d, Directive):
+                delay = max(delay, d.delay)
+                copies = (0 if 0 in (copies, d.copies)
+                          else max(copies, d.copies))
+            elif d:
                 delay = max(delay, float(d))
         svc = self.hub.nodes.get(target)
         if svc is None:
             raise NodeDisconnectedError(f"unknown node [{target}]")
+        if copies == 0:
+            return                       # swallowed: caller times out
 
         def deliver():
             if delay:
                 time.sleep(delay)
-            svc.handle_frame(source, frame[6:])   # strip marker+len
+            for _ in range(copies):
+                svc.handle_frame(source, frame[6:])   # strip marker+len
         threading.Thread(target=deliver, daemon=True).start()
 
     def close(self, node_id: str):
@@ -365,6 +425,10 @@ class TcpTransport(Transport):
         self._lock = threading.Lock()            # guards the maps only
         self._target_locks: dict[str, threading.Lock] = {}
         self._running = True
+        # accepted inbound connections + their reader threads, so
+        # close() can tear them down instead of leaking daemons
+        self._inbound: list[socket.socket] = []
+        self._readers: list[threading.Thread] = []
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True,
             name=f"tcp-accept-{self.port}")
@@ -384,8 +448,13 @@ class TcpTransport(Transport):
                 conn, _addr = self._server.accept()
             except OSError:
                 return
-            threading.Thread(target=self._read_loop, args=(conn,),
-                             daemon=True).start()
+            t = threading.Thread(target=self._read_loop, args=(conn,),
+                                 daemon=True,
+                                 name=f"tcp-read-{self.port}")
+            with self._lock:
+                self._inbound.append(conn)
+                self._readers.append(t)
+            t.start()
 
     def _read_loop(self, conn: socket.socket):
         try:
@@ -433,31 +502,60 @@ class TcpTransport(Transport):
         # head-of-line-block traffic to healthy peers
         with self._lock:
             tlock = self._target_locks.setdefault(target, threading.Lock())
-        with tlock:
+
+        def attempt():
+            """(Re)connect if needed and write; a broken pipe drops the
+            cached connection and surfaces OSError for the retry loop."""
             with self._lock:
                 conn = self._conns.get(target)
-            for _attempt in (1, 2):
-                if conn is None:
-                    conn = self._connect(target)
-                    with self._lock:
-                        self._conns[target] = conn
-                try:
-                    conn.sendall(wire)
-                    return
-                except OSError:
-                    conn.close()
-                    with self._lock:
-                        self._conns.pop(target, None)
-                    conn = None
-            raise NodeDisconnectedError(f"[{target}] connection failed")
+            if conn is None:
+                conn = self._connect(target)
+                with self._lock:
+                    self._conns[target] = conn
+            try:
+                conn.sendall(wire)
+            except OSError:
+                conn.close()
+                with self._lock:
+                    self._conns.pop(target, None)
+                raise
+
+        from opensearch_tpu.common.retry import (RetryExhaustedError,
+                                                 retry_call)
+        with tlock:
+            try:
+                # bounded reconnect-per-send: a first broken pipe (peer
+                # restarted, connection idled out) retries with backoff
+                # instead of failing the caller outright
+                retry_call("tcp.send", attempt, retry_on=(OSError,),
+                           max_attempts=3, base_delay=0.05,
+                           max_delay=0.5, budget_s=2.0,
+                           seed=struct.unpack(">I", wire[2:6])[0])
+            except RetryExhaustedError as e:
+                raise NodeDisconnectedError(
+                    f"[{target}] connection failed: {e.last}") from e.last
 
     def close(self, node_id: str):
+        if not self._running:
+            return                       # idempotent
         self._running = False
         try:
             self._server.close()
         except OSError:
             pass
         with self._lock:
-            for conn in self._conns.values():
-                conn.close()
+            conns = list(self._conns.values()) + list(self._inbound)
             self._conns.clear()
+            self._inbound.clear()
+            readers = list(self._readers)
+            self._readers.clear()
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            conn.close()
+        # reader threads exit once their sockets die; join briefly so a
+        # stopped node leaves no busy daemons behind
+        for t in readers:
+            t.join(timeout=1.0)

@@ -1,0 +1,712 @@
+"""Fault-tolerant cluster spine: retryable actions, fault detection,
+per-shard search failover with partial results, and the deterministic
+fault-injection harness driving it all (seeded → replayable)."""
+
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from opensearch_tpu.cluster import fault_detection as fd
+from opensearch_tpu.cluster.node import (A_REPLICATE_OP, A_SEARCH_SHARDS,
+                                         ClusterNode)
+from opensearch_tpu.common.errors import (NodeDisconnectedError,
+                                          SearchPhaseExecutionError)
+from opensearch_tpu.common.retry import (BackoffPolicy, Deadline,
+                                         RetryableAction,
+                                         RetryExhaustedError, retry_call)
+from opensearch_tpu.common.telemetry import metrics
+from opensearch_tpu.testing.fault_injection import FaultInjector
+from opensearch_tpu.transport.service import (LocalTransport,
+                                              ReceiveTimeoutError,
+                                              TcpTransport,
+                                              TransportService,
+                                              encode_frame, peek_action)
+
+
+def wait_until(pred, timeout=8.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:   # deadline-bounded poll
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+# -- RetryableAction (common/retry.py) ------------------------------------
+
+def test_backoff_schedule_is_deterministic_and_capped():
+    a = list(BackoffPolicy(base_delay=0.1, multiplier=2.0, max_delay=0.5,
+                           max_attempts=6, seed=7).delays())
+    b = list(BackoffPolicy(base_delay=0.1, multiplier=2.0, max_delay=0.5,
+                           max_attempts=6, seed=7).delays())
+    c = list(BackoffPolicy(base_delay=0.1, multiplier=2.0, max_delay=0.5,
+                           max_attempts=6, seed=8).delays())
+    assert a == b                        # same seed, same schedule
+    assert a != c                        # different seed, different jitter
+    assert len(a) == 5                   # attempts-1 sleeps
+    assert all(0 < d <= 0.5 for d in a)  # jitter never exceeds max_delay
+    # exponential growth up to the cap (jitter shrinks by at most 20%)
+    assert a[1] > a[0] * 1.2
+
+
+def test_retry_succeeds_after_transient_failures():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise NodeDisconnectedError("blip")
+        return "ok"
+
+    slept = []
+    action = RetryableAction(
+        "t1", flaky, BackoffPolicy(base_delay=0.01, max_attempts=4,
+                                   seed=1),
+        sleep=slept.append)
+    assert action.run() == "ok"
+    assert calls["n"] == 3 and len(slept) == 2
+
+
+def test_retry_exhausts_and_carries_last_error():
+    before = metrics().counter("retry.t2.exhausted").value
+
+    def dead():
+        raise ReceiveTimeoutError("never")
+
+    with pytest.raises(RetryExhaustedError) as ei:
+        retry_call("t2", dead, max_attempts=3, base_delay=0.0)
+    assert isinstance(ei.value.last, ReceiveTimeoutError)
+    assert metrics().counter("retry.t2.exhausted").value == before + 1
+    assert metrics().counter("retry.t2.attempts").value >= 3
+
+
+def test_retry_budget_cap_uses_monotonic_clock():
+    now = {"t": 100.0}
+
+    def clock():
+        return now["t"]
+
+    def sleep(d):
+        now["t"] += d
+
+    def dead():
+        now["t"] += 0.4                 # each attempt burns 0.4s
+        raise NodeDisconnectedError("down")
+
+    action = RetryableAction(
+        "t3", dead,
+        BackoffPolicy(base_delay=0.3, multiplier=1.0, max_attempts=50,
+                      budget_s=1.0, jitter=0.0, seed=0),
+        sleep=sleep, clock=clock)
+    with pytest.raises(RetryExhaustedError):
+        action.run()
+    # the budget stopped it long before the 50-attempt ceiling
+    assert now["t"] - 100.0 < 2.5
+
+
+def test_retry_does_not_touch_non_retryable_errors():
+    def bad():
+        raise ValueError("bug, not blip")
+
+    with pytest.raises(ValueError):
+        retry_call("t4", bad, max_attempts=5, base_delay=0.0)
+
+
+def test_deadline_bounds_polling():
+    d = Deadline(0.2)
+    assert not d.expired() and d.remaining() > 0
+    assert d.wait_until(lambda: True)
+    assert Deadline(0.05).wait_until(lambda: False) is False
+
+
+# -- fault-injection harness ----------------------------------------------
+
+def make_pair():
+    hub = LocalTransport.Hub()
+    a = TransportService("node_a", LocalTransport(hub))
+    b = TransportService("node_b", LocalTransport(hub))
+    b.register_handler("ping", lambda p: {"pong": True})
+    b.register_handler("other", lambda p: {"ok": True})
+    return hub, a, b
+
+
+def test_peek_action_reads_frames_without_payload():
+    frame = encode_frame(3, 0, "indices:data/read/x", {"q": 1})
+    assert peek_action(frame) == "indices:data/read/x"
+    # compressed frames decode too
+    big = encode_frame(4, 0, "act", {"blob": "x" * 4096})
+    assert peek_action(big) == "act"
+
+
+def test_drop_one_shot_then_heals():
+    hub, a, b = make_pair()
+    try:
+        faults = FaultInjector(hub, seed=1)
+        faults.drop("ping", times=1)
+        with pytest.raises(NodeDisconnectedError):
+            a.send_request("node_b", "ping", {}, timeout=2.0)
+        # one-shot: the very next send passes
+        assert a.send_request("node_b", "ping", {},
+                              timeout=5.0)["pong"] is True
+    finally:
+        a.close()
+        b.close()
+
+
+def test_drop_matches_action_pattern_only():
+    hub, a, b = make_pair()
+    try:
+        faults = FaultInjector(hub, seed=1)
+        faults.drop("ping*")
+        assert a.send_request("node_b", "other", {}, timeout=5.0)["ok"]
+        with pytest.raises(NodeDisconnectedError):
+            a.send_request("node_b", "ping", {}, timeout=2.0)
+        faults.clear()
+        assert a.send_request("node_b", "ping", {}, timeout=5.0)["pong"]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_silent_drop_times_out_instead_of_failing_fast():
+    hub, a, b = make_pair()
+    try:
+        FaultInjector(hub, seed=1).drop("ping", times=1, silent=True)
+        with pytest.raises(ReceiveTimeoutError):
+            a.send_request("node_b", "ping", {}, timeout=0.3)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_delay_and_duplicate_rules():
+    hub, a, b = make_pair()
+    try:
+        faults = FaultInjector(hub, seed=1)
+        faults.delay(0.15, action="ping", times=1)
+        t0 = time.monotonic()
+        assert a.send_request("node_b", "ping", {}, timeout=5.0)["pong"]
+        assert time.monotonic() - t0 >= 0.15
+        # duplicated request frames run the handler twice; the duplicate
+        # RESPONSE is dropped by request-id correlation, so the caller
+        # still sees exactly one answer
+        seen = []
+        b.register_handler("count", lambda p: (seen.append(1),
+                                               {"n": len(seen)})[1])
+        faults.duplicate(action="count", times=1)
+        assert a.send_request("node_b", "count", {},
+                              timeout=5.0)["n"] >= 1
+        assert wait_until(lambda: len(seen) == 2)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_probabilistic_drop_is_seed_deterministic():
+    def pattern(seed):
+        hub, a, b = make_pair()
+        try:
+            # source-scoped so only REQUEST frames draw from the seeded
+            # stream (responses carry the same action on the way back)
+            FaultInjector(hub, seed=seed).drop("ping", probability=0.5,
+                                               source="node_a")
+            out = []
+            for _ in range(12):
+                try:
+                    a.send_request("node_b", "ping", {}, timeout=2.0)
+                    out.append("ok")
+                except NodeDisconnectedError:
+                    out.append("drop")
+            return out
+        finally:
+            a.close()
+            b.close()
+
+    p1, p2, p3 = pattern(42), pattern(42), pattern(7)
+    assert p1 == p2                      # same seed → same schedule
+    assert "ok" in p1 and "drop" in p1   # and it actually mixes
+    assert p1 != p3
+
+
+def test_disconnect_and_heal():
+    hub, a, b = make_pair()
+    try:
+        faults = FaultInjector(hub, seed=1)
+        faults.disconnect("node_b")
+        with pytest.raises(NodeDisconnectedError):
+            a.send_request("node_b", "ping", {}, timeout=2.0)
+        assert faults.heal("node_b")
+        assert a.send_request("node_b", "ping", {}, timeout=5.0)["pong"]
+        assert not faults.heal("node_b")   # second heal is a no-op
+    finally:
+        a.close()
+        b.close()
+
+
+# -- cluster fixture -------------------------------------------------------
+
+@pytest.fixture
+def cluster(tmp_path):
+    hub = LocalTransport.Hub()
+    ids = ["n0", "n1", "n2"]
+    nodes = {}
+    for nid in ids:
+        svc = TransportService(nid, LocalTransport(hub))
+        nodes[nid] = ClusterNode(nid, str(tmp_path / nid), svc, ids)
+    assert nodes["n0"].start_election()
+    wait_until(lambda: all(
+        nodes[i].coordinator.state().master_node == "n0" for i in ids))
+    yield hub, ids, nodes
+    for n in nodes.values():
+        n.stop()
+
+
+def _in_sync_full(nodes, leader, index):
+    routing = nodes[leader].coordinator.state().routing.get(index, [])
+    return routing and all(
+        set(e["in_sync"]) == {e["primary"], *e["replicas"]}
+        and len(e["replicas"]) >= 1 for e in routing)
+
+
+# -- the acceptance bar: kill a node mid-search ---------------------------
+
+def test_kill_node_mid_search_partial_then_promotion(cluster):
+    """Disconnecting a data node mid-_search yields a successful response
+    (hits from surviving copies, `_shards` reported), the fault detector
+    evicts the node within its check budget, and replicas are promoted —
+    all under the fault-injection harness with a fixed seed."""
+    hub, ids, nodes = cluster
+    nodes["n0"].create_index("ha", {
+        "settings": {"number_of_shards": 4, "number_of_replicas": 1},
+        "mappings": {"properties": {"v": {"type": "long"}}}})
+    assert wait_until(lambda: _in_sync_full(nodes, "n0", "ha"))
+    for i in range(24):
+        nodes["n0"].index_doc("ha", str(i), {"v": i})
+    nodes["n0"].refresh("ha")
+
+    faults = FaultInjector(hub, seed=42)
+    faults.disconnect("n2")
+
+    # coordinate from a survivor that does NOT hold a copy of some
+    # n2-primary shard — its first candidate for that shard is n2
+    # itself, so the scatter MUST exercise the failover path
+    from opensearch_tpu.cluster.state import copies_of
+    routing0 = nodes["n0"].coordinator.state().routing["ha"]
+    coord = next(n for n in ("n0", "n1")
+                 if any(e["primary"] == "n2" and n not in copies_of(e)
+                        for e in routing0))
+
+    # search goes through: every shard hosted on n2 fails over to its
+    # surviving in-sync copy; nothing is lost
+    resp = nodes[coord].search("ha", {"query": {"match_all": {}},
+                                      "size": 50})
+    assert resp["hits"]["total"]["value"] == 24
+    assert len(resp["hits"]["hits"]) == 24
+    assert resp["timed_out"] is False
+    shards = resp["_shards"]
+    assert shards["total"] == 4
+    assert shards["successful"] == 4     # failover, not failure
+    assert shards["failed"] == 0
+    assert metrics().counter("search.shard_failover").value > 0
+
+    # fault detector: the leader declares n2 dead within its retry
+    # budget and publishes a state without it; replicas promote
+    retries = nodes["n0"].coordinator.follower_checker.settings.retries
+    for _ in range(retries):
+        nodes["n0"].coordinator.run_checks_once()
+    assert wait_until(
+        lambda: "n2" not in nodes["n0"].coordinator.state().nodes)
+    routing = nodes["n0"].coordinator.state().routing["ha"]
+    assert all(e["primary"] in ("n0", "n1") for e in routing)
+    # reads and writes keep working on the promoted copies
+    for i in range(24):
+        assert nodes["n0"].get_doc("ha", str(i))["_source"] == {"v": i}
+    assert nodes["n0"].index_doc("ha", "x", {"v": 99})["result"] == \
+        "created"
+
+
+def test_search_partial_results_when_no_copy_survives(cluster):
+    """No replicas: a dead node's shards have nowhere to fail over —
+    `allow_partial_search_results` decides between a degraded response
+    with `_shards.failures[]` and a 503-class error."""
+    hub, ids, nodes = cluster
+    nodes["n0"].create_index("frail", {
+        "settings": {"number_of_shards": 6, "number_of_replicas": 0},
+        "mappings": {"properties": {"v": {"type": "long"}}}})
+    wait_until(lambda: all("frail" in nodes[i].indices for i in ids))
+    for i in range(30):
+        nodes["n0"].index_doc("frail", str(i), {"v": i})
+    nodes["n0"].refresh("frail")
+    routing = nodes["n0"].coordinator.state().routing["frail"]
+    lost = [s for s, e in enumerate(routing) if e["primary"] == "n2"]
+    assert lost, "allocator should place shards on n2"
+
+    FaultInjector(hub, seed=42).disconnect("n2")
+    resp = nodes["n0"].search("frail", {
+        "query": {"match_all": {}}, "size": 50,
+        "allow_partial_search_results": True})
+    shards = resp["_shards"]
+    assert shards["total"] == 6
+    assert shards["failed"] == len(lost)
+    assert shards["successful"] == 6 - len(lost)
+    assert {f["shard"] for f in shards["failures"]} == set(lost)
+    for f in shards["failures"]:
+        assert f["index"] == "frail" and f["node"] == "n2"
+        assert f["reason"]["type"] == "node_disconnected_exception"
+    # survivors' hits all came back
+    assert resp["hits"]["total"]["value"] == 30 - sum(
+        1 for i in range(30)
+        if routing[nodes["n0"]._shard_for("frail", str(i))]["primary"]
+        == "n2")
+
+    with pytest.raises(SearchPhaseExecutionError) as ei:
+        nodes["n0"].search("frail", {
+            "query": {"match_all": {}},
+            "allow_partial_search_results": False})
+    assert ei.value.status == 503
+    assert ei.value.shard_failures
+
+
+def test_breaker_trip_degrades_to_shard_failure(cluster):
+    """A tripped circuit breaker during one node's shard query phase
+    fails over to another copy (or degrades to a counted shard failure)
+    instead of failing the whole search."""
+    from opensearch_tpu.common.breakers import CircuitBreakingError
+    hub, ids, nodes = cluster
+    nodes["n0"].create_index("cb", {
+        "settings": {"number_of_shards": 3, "number_of_replicas": 1},
+        "mappings": {"properties": {"v": {"type": "long"}}}})
+    assert wait_until(lambda: _in_sync_full(nodes, "n0", "cb"))
+    for i in range(12):
+        nodes["n0"].index_doc("cb", str(i), {"v": i})
+    nodes["n0"].refresh("cb")
+
+    def tripped(payload):
+        raise CircuitBreakingError("[request] Data too large (simulated)")
+    nodes["n1"].transport.register_handler(A_SEARCH_SHARDS, tripped)
+
+    # coordinate from n0: shards preferring n1 fail over to their other
+    # copy; all hits survive
+    resp = nodes["n0"].search("cb", {"query": {"match_all": {}},
+                                     "size": 50})
+    assert resp["hits"]["total"]["value"] == 12
+    assert resp["_shards"]["failed"] == 0
+
+
+def test_replication_retries_transient_drop_without_evicting(cluster):
+    """A one-shot dropped replication frame is retried and acked — the
+    replica must NOT be kicked out of the in-sync set over a blip."""
+    hub, ids, nodes = cluster
+    nodes["n0"].create_index("rep", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 1},
+        "mappings": {"properties": {"v": {"type": "long"}}}})
+    assert wait_until(lambda: _in_sync_full(nodes, "n0", "rep"))
+    entry = nodes["n0"].coordinator.state().routing["rep"][0]
+    replica = entry["replicas"][0]
+    before = metrics().counter("retry.replication.attempts").value
+
+    faults = FaultInjector(hub, seed=3)
+    faults.drop(A_REPLICATE_OP, times=1)
+    r = nodes["n0"].index_doc("rep", "d1", {"v": 1})
+    assert r["result"] == "created"
+    assert metrics().counter("retry.replication.attempts").value > before
+    # the blip did not evict the replica
+    entry = nodes["n0"].coordinator.state().routing["rep"][0]
+    assert replica in entry["in_sync"]
+    # and the op actually landed on the replica (realtime GET from it)
+    assert nodes[replica].get_doc("rep", "d1")["_source"] == {"v": 1}
+
+
+def test_duplicated_replication_op_is_idempotent(cluster):
+    """At-least-once delivery: a duplicated replica op must not corrupt
+    versions (seq-no gated apply)."""
+    hub, ids, nodes = cluster
+    nodes["n0"].create_index("dup", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 1}})
+    assert wait_until(lambda: _in_sync_full(nodes, "n0", "dup"))
+    FaultInjector(hub, seed=5).duplicate(action=A_REPLICATE_OP)
+    for i in range(5):
+        nodes["n0"].index_doc("dup", "k", {"v": i})
+    replica = nodes["n0"].coordinator.state().routing["dup"][0][
+        "replicas"][0]
+    doc = nodes[replica].get_doc("dup", "k")
+    assert doc["_source"] == {"v": 4} and doc["_version"] == 5
+
+
+# -- fault detection (cluster/fault_detection.py) -------------------------
+
+def test_fault_detection_actions_registered(cluster):
+    hub, ids, nodes = cluster
+    r = nodes["n1"].transport.send_request("n0", fd.LEADER_CHECK, {},
+                                           timeout=5.0)
+    assert r["leader"] is True
+    term = nodes["n0"].coordinator.current_term
+    r = nodes["n0"].transport.send_request(
+        "n1", fd.FOLLOWER_CHECK, {"term": term}, timeout=5.0)
+    assert r["ok"] is True and "version" in r
+
+
+def test_followers_reelect_when_leader_dies(cluster):
+    hub, ids, nodes = cluster
+    FaultInjector(hub, seed=9).disconnect("n0")
+    retries = nodes["n1"].coordinator.leader_checker.settings.retries
+    for _ in range(retries + 1):
+        nodes["n1"].coordinator.run_checks_once()
+        nodes["n2"].coordinator.run_checks_once()
+    assert wait_until(lambda: any(
+        nodes[i].coordinator.is_leader() for i in ("n1", "n2")))
+    new_leader = [i for i in ("n1", "n2")
+                  if nodes[i].coordinator.is_leader()][0]
+    assert wait_until(lambda: nodes[new_leader].coordinator.state()
+                      .master_node == new_leader)
+
+
+def test_configurable_check_budget(tmp_path):
+    """check_retries=1 evicts after a single failed round — the
+    configured budget, not a hard-coded one."""
+    hub = LocalTransport.Hub()
+    ids = ["a", "b"]
+    nodes = {}
+    for nid in ids:
+        svc = TransportService(nid, LocalTransport(hub))
+        node = ClusterNode(nid, str(tmp_path / nid), svc, ids)
+        node.coordinator.check_retries = 1
+        node.coordinator.follower_checker.settings.retries = 1
+        node.coordinator.leader_checker.settings.retries = 1
+        nodes[nid] = node
+    try:
+        assert nodes["a"].start_election()
+        assert wait_until(lambda: "b" in
+                          nodes["a"].coordinator.state().nodes)
+        FaultInjector(hub, seed=1).disconnect("b")
+        nodes["a"].coordinator.run_checks_once()
+        assert wait_until(lambda: "b" not in
+                          nodes["a"].coordinator.state().nodes)
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+# -- lifecycle hangs -------------------------------------------------------
+
+def _returns_promptly(fn, timeout=5.0):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    t.join(timeout)
+    return not t.is_alive()
+
+
+def test_node_stop_without_start_does_not_hang(tmp_path):
+    from opensearch_tpu.node import Node
+    node = Node(str(tmp_path / "n"), port=0)   # never .start()ed
+    assert _returns_promptly(node.stop), "stop() hung without start()"
+
+
+def test_node_stop_is_idempotent(tmp_path):
+    from opensearch_tpu.node import Node
+    node = Node(str(tmp_path / "n"), port=0).start()
+    assert _returns_promptly(node.stop)
+    assert _returns_promptly(node.stop), "second stop() hung"
+
+
+def test_cluster_node_stop_is_idempotent(tmp_path):
+    hub = LocalTransport.Hub()
+    svc = TransportService("solo", LocalTransport(hub))
+    node = ClusterNode("solo", str(tmp_path / "solo"), svc, ["solo"])
+    assert _returns_promptly(node.stop)
+    assert _returns_promptly(node.stop)
+
+
+# -- REST status mapping ---------------------------------------------------
+
+def test_transport_failures_surface_as_503(tmp_path):
+    from opensearch_tpu.cluster.node import NoMasterError
+    from opensearch_tpu.node import Node
+    node = Node(str(tmp_path / "n"), port=0)
+    try:
+        cases = {
+            "/_boom_disconnect": NodeDisconnectedError("[n2] gone"),
+            "/_boom_timeout": ReceiveTimeoutError("[n2] timed out"),
+            "/_boom_nomaster": NoMasterError("no elected cluster manager"),
+        }
+        for path, exc in cases.items():
+            def handler(req, exc=exc):
+                raise exc
+            node.rest.register("GET", path, handler)
+            # catch-all /{index} routes register earlier: put ours first
+            node.rest.routes.insert(0, node.rest.routes.pop())
+            status, body = node.rest.dispatch("GET", path, {}, None)
+            assert status == 503, (path, status, body)
+            assert body["status"] == 503
+            assert body["error"]["type"].endswith("_exception")
+    finally:
+        node.stop()
+
+
+def test_allow_partial_dynamic_cluster_setting(tmp_path):
+    """search.default_allow_partial_search_results is a dynamic cluster
+    setting feeding the coordinator's scatter default."""
+    from opensearch_tpu.node import Node
+    from opensearch_tpu.search import executor as executor_mod
+    node = Node(str(tmp_path / "n"), port=0)
+    try:
+        assert executor_mod.DEFAULT_ALLOW_PARTIAL_RESULTS is True
+        node.update_cluster_settings(transient={
+            "search.default_allow_partial_search_results": False})
+        assert executor_mod.DEFAULT_ALLOW_PARTIAL_RESULTS is False
+        node.update_cluster_settings(transient={
+            "search.default_allow_partial_search_results": None})
+        assert executor_mod.DEFAULT_ALLOW_PARTIAL_RESULTS is True
+    finally:
+        executor_mod.DEFAULT_ALLOW_PARTIAL_RESULTS = True
+        node.stop()
+
+
+def test_rest_search_accepts_allow_partial_param(tmp_path):
+    from opensearch_tpu.node import Node
+    node = Node(str(tmp_path / "n"), port=0)
+    try:
+        node.rest.dispatch("PUT", "/idx/_doc/1", {}, b'{"v": 1}')
+        node.rest.dispatch("POST", "/idx/_refresh", {}, None)
+        status, resp = node.rest.dispatch(
+            "POST", "/idx/_search",
+            {"allow_partial_search_results": "false"}, b"{}")
+        assert status == 200
+        assert resp["_shards"]["failed"] == 0
+        # body-level key is tolerated too (strict parser allows it)
+        status, _ = node.rest.dispatch(
+            "POST", "/idx/_search", {},
+            b'{"allow_partial_search_results": true}')
+        assert status == 200
+    finally:
+        node.stop()
+
+
+# -- circuit breakers under concurrency ------------------------------------
+
+def test_breaker_service_concurrent_accounting_never_leaks():
+    from opensearch_tpu.common.breakers import (CircuitBreakerService,
+                                                CircuitBreakingError)
+    svc = CircuitBreakerService({"breaker.total.limit": 1 << 20,
+                                 "breaker.request.limit": 512 << 10,
+                                 "breaker.fielddata.limit": 512 << 10,
+                                 "breaker.inflight.limit": 512 << 10})
+    errors = []
+
+    def worker(breaker, n_iter, chunk):
+        for _ in range(n_iter):
+            try:
+                breaker.add_estimate(chunk, label="t")
+            except CircuitBreakingError:
+                continue               # tripped: nothing was reserved
+            if breaker.used < 0:
+                errors.append("negative usage")
+            breaker.release(chunk)
+
+    threads = [threading.Thread(
+        target=worker,
+        args=(b, 300, 64 << 10), daemon=True)
+        for b in (svc.request, svc.fielddata, svc.in_flight)
+        for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errors
+    # all reservations were released: zero bytes leaked anywhere
+    assert svc.request.used == 0
+    assert svc.fielddata.used == 0
+    assert svc.in_flight.used == 0
+    assert svc.stats()["parent"]["estimated_size_in_bytes"] == 0
+
+
+def test_breaker_release_never_goes_negative():
+    from opensearch_tpu.common.breakers import CircuitBreakerService
+    svc = CircuitBreakerService()
+    svc.request.add_estimate(10, label="x")
+    svc.request.release(1000)            # over-release clamps at zero
+    assert svc.request.used == 0
+
+
+# -- TcpTransport robustness ----------------------------------------------
+
+def test_tcp_send_survives_stale_connection():
+    """A cached connection broken behind our back (peer restart, idle
+    reset) reconnects within the bounded retry instead of failing the
+    first send."""
+    ta = TcpTransport()
+    tb = TcpTransport()
+    a = TransportService("node_a", ta)
+    b = TransportService("node_b", tb)
+    try:
+        ta.add_node("node_b", "127.0.0.1", tb.port)
+        tb.add_node("node_a", "127.0.0.1", ta.port)
+        b.register_handler("ping", lambda p: {"pong": True})
+        assert a.send_request("node_b", "ping", {}, timeout=5.0)["pong"]
+        # sabotage the cached outbound socket
+        ta._conns["node_b"].close()
+        assert a.send_request("node_b", "ping", {}, timeout=5.0)["pong"]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_tcp_close_joins_reader_threads():
+    ta = TcpTransport()
+    tb = TcpTransport()
+    a = TransportService("node_a", ta)
+    b = TransportService("node_b", tb)
+    ta.add_node("node_b", "127.0.0.1", tb.port)
+    tb.add_node("node_a", "127.0.0.1", ta.port)
+    b.register_handler("ping", lambda p: {"pong": True})
+    assert a.send_request("node_b", "ping", {}, timeout=5.0)["pong"]
+    assert tb._readers, "handshake+ping should have spawned readers"
+    readers = list(ta._readers) + list(tb._readers)
+    a.close()
+    b.close()
+    assert wait_until(lambda: not any(t.is_alive() for t in readers),
+                      timeout=3.0)
+    # double-close is a no-op
+    ta.close("node_a")
+    tb.close("node_b")
+
+
+# -- sleep-loop lint (the tier-1 CI hook) ---------------------------------
+
+def _repo_root():
+    import os
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_check_sleep_loops_lint_passes():
+    import os
+    out = subprocess.run(
+        [sys.executable, os.path.join(_repo_root(), "tools",
+                                      "check_sleep_loops.py")],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_check_sleep_loops_lint_catches_violations(tmp_path):
+    bad = tmp_path / "pkg"
+    bad.mkdir()
+    (bad / "mod.py").write_text(
+        "import time\n"
+        "def poll():\n"
+        "    while True:\n"
+        "        time.sleep(0.1)\n"
+        "def bounded(deadline):\n"
+        "    while not deadline.expired():\n"
+        "        time.sleep(0.1)  # deadline: bounded by caller\n"
+        "def once():\n"
+        "    time.sleep(0.1)\n")
+    out = subprocess.run(
+        [sys.executable, "tools/check_sleep_loops.py", str(bad)],
+        capture_output=True, text=True, cwd=_repo_root())
+    assert out.returncode == 1
+    assert "mod.py:4" in out.stdout
+    assert "mod.py:7" not in out.stdout      # annotated
+    assert "mod.py:9" not in out.stdout      # not in a loop

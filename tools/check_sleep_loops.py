@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Lint: polling loops must be deadline- or backoff-bounded.
+
+A bare ``time.sleep(...)`` inside a ``while``/``for`` loop is an
+unbounded polling loop waiting to happen: when the condition it polls
+never turns true (a dead peer, a lost frame) the loop spins forever and
+the retry path it implements has no budget.  Every sleep call inside a
+loop body under ``opensearch_tpu/`` must therefore either go through
+``common/retry.py`` (BackoffPolicy/Deadline, which are budget-capped on
+the monotonic clock) or carry a ``# backoff`` / ``# deadline``
+annotation on the same line or the line above, asserting a human
+checked the loop is bounded.
+
+Sibling of ``check_monotonic.py``; new un-annotated sites fail tier-1
+(tests/test_fault_tolerance.py runs this check).
+
+Usage: python tools/check_sleep_loops.py [root]   (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ANNOTATIONS = ("# backoff", "# deadline")
+
+
+def _sleep_calls_in_loops(tree: ast.AST) -> list[int]:
+    """Line numbers of ``time.sleep``/bare ``sleep``/``Event.wait``-free
+    sleep calls lexically inside a While/For body."""
+    out = []
+
+    def walk(node: ast.AST, in_loop: bool):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            is_sleep = (isinstance(fn, ast.Attribute)
+                        and fn.attr == "sleep") or \
+                       (isinstance(fn, ast.Name) and fn.id == "sleep")
+            if is_sleep and in_loop:
+                out.append(node.lineno)
+        entering_loop = isinstance(node, (ast.While, ast.For,
+                                          ast.AsyncFor))
+        # a nested function/class restarts the scope: its loops count on
+        # their own, but an outer loop does not taint the inner def
+        resets = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef, ast.Lambda))
+        for child in ast.iter_child_nodes(node):
+            walk(child, (in_loop or entering_loop) and not resets)
+
+    walk(tree, False)
+    return out
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error ({e.msg})"]
+    lines = src.splitlines()
+    problems = []
+    for lineno in _sleep_calls_in_loops(tree):
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        prev = lines[lineno - 2] if lineno >= 2 else ""
+        if any(a in line or a in prev for a in ANNOTATIONS):
+            continue
+        problems.append(
+            f"{path}:{lineno}: sleep() inside a loop without a "
+            "'# backoff' or '# deadline' annotation — bound it with "
+            "common/retry.py (BackoffPolicy/Deadline) or annotate why "
+            "the loop cannot spin forever")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    root = argv[1] if len(argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "opensearch_tpu")
+    problems = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                problems.extend(check_file(os.path.join(dirpath, name)))
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} unbounded sleep-in-loop site(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
