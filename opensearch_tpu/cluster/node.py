@@ -838,17 +838,36 @@ class ClusterNode:
         if svc is None:
             raise ShardNotFoundError(
                 f"[{payload['index']}] has no shards on this node")
-        from opensearch_tpu.search.executor import ShardSearcher
-        segs = []
-        for shard_id in payload["shards"]:
-            engine = svc.engine_for(shard_id)
-            segs.extend(engine.acquire_searcher().segments)
-        searcher = ShardSearcher(segs, svc.mapper, index_name=svc.name)
-        resp = searcher.search(
-            payload.get("body") or {},
-            agg_partials=bool(payload.get("agg_partials")))
-        svc._maybe_slowlog(payload.get("body") or {}, resp)
-        return {"resp": resp}
+        body = dict(payload.get("body") or {})
+        explicit_cache = body.pop("request_cache", None)
+        agg_partials = bool(payload.get("agg_partials"))
+        shard_ids = sorted(payload["shards"])
+
+        def compute() -> dict:
+            from opensearch_tpu.search.executor import ShardSearcher
+            segs = []
+            for shard_id in shard_ids:
+                engine = svc.engine_for(shard_id)
+                segs.extend(engine.acquire_searcher().segments)
+            searcher = ShardSearcher(segs, svc.mapper,
+                                     index_name=svc.name)
+            return {"resp": searcher.search(body,
+                                            agg_partials=agg_partials)}
+
+        # data-node request cache: remote coordinators' repeated query
+        # phases hit here without re-executing (the hit/miss counts land
+        # on THIS node's shard copies — key includes the local service's
+        # uuid and reader generation)
+        if svc.should_cache_request(body, explicit_cache, agg_partials):
+            from opensearch_tpu.indices.request_cache import request_cache
+            out, _hit = request_cache().get_or_compute(
+                index=svc.name, svc_uuid=svc.uuid,
+                shard_key=",".join(map(str, shard_ids)),
+                reader_gen=svc._reader_gen, body=body, compute=compute)
+        else:
+            out = compute()
+        svc._maybe_slowlog(body, out["resp"])
+        return out
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -340,7 +340,8 @@ class DeviceSegment:
                 "value_docs": jnp.asarray(pad1(dv.value_docs, v_pad, self.n_docs)),
                 "exists": jnp.asarray(pad1(dv.exists, n_pad, False)),
             }
-        self._live_cache: dict[int, object] = {}
+        # bounded-cache: one staged copy per live-bitmap version, freed
+        self._live_cache: dict[int, object] = {}  # with its PIT searcher
         self._ann_staged: dict[int, tuple] = {}
         self.live = self.live_jnp(seg.live)
 
@@ -350,6 +351,7 @@ class DeviceSegment:
 
         cache = getattr(self, "_nested_cache", None)
         if cache is None:
+            # bounded-cache: at most one entry per nested mapping path
             cache = self._nested_cache = {}
         if path in cache:
             return cache[path]

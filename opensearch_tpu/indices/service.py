@@ -168,6 +168,12 @@ class IndexService:
         self._lock = threading.RLock()
         self._searcher: Optional[ShardSearcher] = None
         self._mesh_searcher = None
+        # search-visibility generation: bumped whenever the searchable
+        # segment set may have changed (refresh / checkpoint install /
+        # shard set change / mapping change).  The request cache keys on
+        # it, so stale entries stop matching the moment anything moves
+        # (IndicesRequestCache's reader-generation key).
+        self._reader_gen = 0
 
     def _open_shard(self, shard_id: int) -> InternalEngine:
         return InternalEngine(os.path.join(self.data_path, str(shard_id)),
@@ -382,8 +388,13 @@ class IndexService:
     # -- search -----------------------------------------------------------
 
     def _dirty(self):
+        from opensearch_tpu.indices.request_cache import request_cache
         with self._lock:
             self._searcher = None
+            self._reader_gen += 1
+        # eager cleanup: the generation bump already unreachable-izes the
+        # old entries; dropping them keeps memory tracking visibility
+        request_cache().invalidate_service(self.uuid)
 
     def refresh(self):
         for engine in self.shards:
@@ -547,9 +558,20 @@ class IndexService:
                                self.get_mapping().get("mappings"))
 
     def index_setting(self, key: str, default):
-        """Per-index setting lookup accepting both the dotted and bare
-        key forms the create body may use."""
+        """Per-index setting lookup accepting the dotted, bare, and
+        nested-object key forms the create body may use."""
         v = self.settings.get(f"index.{key}", self.settings.get(key))
+        if v is None:
+            for root in (self.settings.get("index"), self.settings):
+                node = root
+                for part in key.split("."):
+                    node = (node.get(part)
+                            if isinstance(node, dict) else None)
+                    if node is None:
+                        break
+                if node is not None:
+                    v = node
+                    break
         return default if v is None else v
 
     def _check_search_limits(self, body: dict):
@@ -616,8 +638,24 @@ class IndexService:
 
     def search(self, body: Optional[dict] = None, *,
                agg_partials: bool = False) -> dict:
-        body = body or {}
+        body = dict(body or {})
+        # request-level cache directive (the ?request_cache= param; the
+        # REST layer validated it) must not leak into execution or the
+        # cache key
+        explicit_cache = body.pop("request_cache", None)
         self._check_search_limits(body)
+        if self.should_cache_request(body, explicit_cache, agg_partials):
+            from opensearch_tpu.indices.request_cache import request_cache
+            resp, _hit = request_cache().get_or_compute(
+                index=self.name, svc_uuid=self.uuid, shard_key="_local",
+                reader_gen=self._reader_gen, body=body,
+                compute=lambda: self._execute_search(body, agg_partials))
+        else:
+            resp = self._execute_search(body, agg_partials)
+        self._maybe_slowlog(body, resp)
+        return resp
+
+    def _execute_search(self, body: dict, agg_partials: bool) -> dict:
         if not agg_partials and self._use_mesh(body):
             resp = self._mesh_search(body)
         else:
@@ -625,8 +663,26 @@ class IndexService:
         resp["_shards"] = {"total": self.num_shards,
                            "successful": self.num_shards,
                            "skipped": 0, "failed": 0}
-        self._maybe_slowlog(body, resp)
         return resp
+
+    def should_cache_request(self, body: dict, explicit,
+                             agg_partials: bool = False) -> bool:
+        """IndicesRequestCache admission policy (the reference's
+        canCache): profile/PIT never cache; an explicit request-level
+        ``request_cache`` wins over the ``index.requests.cache.enable``
+        index setting; by default only hit-less (size=0) requests cache,
+        like the reference."""
+        if agg_partials:
+            return False         # device partials aren't serializable
+        if body.get("profile") or body.get("pit"):
+            return False
+        if explicit is not None:
+            return bool(explicit)
+        enabled = str(self.index_setting(
+            "requests.cache.enable", True)).lower() != "false"
+        size = int(body.get("size", 10)
+                   if body.get("size") is not None else 10)
+        return enabled and size == 0
 
     def _slowlog_threshold(self, key: str):
         """Per-index setting (either [index.]-prefixed or bare) over the
@@ -779,16 +835,20 @@ class IndexService:
         return sum(e.doc_count() for e in self.shards)
 
     def stats(self) -> dict:
+        from opensearch_tpu.indices.request_cache import request_cache
         return {
             "docs": {"count": self.doc_count()},
             "shards": {"total": self.num_shards},
             "segments": {"count": sum(len(e.segments) for e in self.shards)},
+            "request_cache": request_cache().stats_for_index(self.name),
         }
 
     def put_mapping(self, mapping: dict):
         self._check_write_block()   # schema must match the snapshot
         self.mapper.merge(mapping)
         self.save_meta()
+        # a mapping change can alter how cached requests would compile
+        self._dirty()
 
     def get_mapping(self) -> dict:
         return {"mappings": self.mapper.to_mapping()}
@@ -802,8 +862,10 @@ class IndexService:
         }}}
 
     def close(self):
+        from opensearch_tpu.indices.request_cache import request_cache
         for engine in self.shards:
             engine.close()
+        request_cache().invalidate_service(self.uuid)
 
 
 class IndicesService:

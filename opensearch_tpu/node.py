@@ -114,11 +114,22 @@ class Node:
             "search.max_keep_alive", 24 * 3600.0, dynamic=True)
         default_keep_alive = Setting.time_setting(
             "search.default_keep_alive", 300.0, dynamic=True)
+        from opensearch_tpu.indices.request_cache import (
+            DEFAULT_MAX_BYTES, request_cache)
+        req_cache_size = Setting.byte_size_setting(
+            "indices.requests.cache.size", DEFAULT_MAX_BYTES,
+            dynamic=True)
         self.cluster_settings = SettingsRegistry(
             Settings(stored),
             [max_buckets, auto_create, max_scroll, cache_size,
              identity_enabled, alloc_enable, backpressure_mode,
-             max_keep_alive, default_keep_alive, allow_partial])
+             max_keep_alive, default_keep_alive, allow_partial,
+             req_cache_size])
+        self.cluster_settings.add_settings_update_consumer(
+            req_cache_size,
+            lambda v: request_cache().set_max_bytes(int(v)))
+        request_cache().set_max_bytes(
+            int(self.cluster_settings.get(req_cache_size)))
         from opensearch_tpu.search import executor as executor_mod
         self.cluster_settings.add_settings_update_consumer(
             allow_partial,

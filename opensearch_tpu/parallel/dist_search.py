@@ -164,6 +164,7 @@ class MeshSearcher:
             raise ValueError(
                 f"mesh has {len(self.devices)} devices for "
                 f"{len(self.shards)} shards")
+        # bounded-cache: one compiled merge program per distinct k
         self._merge_cache: dict[int, object] = {}
         # per-(device, segment) staging cache (seg.device() would pin to
         # the default device; mesh copies are staged per device) — kept

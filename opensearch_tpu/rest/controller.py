@@ -418,6 +418,8 @@ class RestController:
         r("GET", "/{index}/_stats", self.h_index_stats)
         r("POST", "/{index}/_refresh", self.h_refresh)
         r("GET", "/{index}/_refresh", self.h_refresh)
+        r("POST", "/_cache/clear", self.h_cache_clear)
+        r("POST", "/{index}/_cache/clear", self.h_cache_clear)
         r("POST", "/{index}/_flush", self.h_flush)
         r("POST", "/{index}/_forcemerge", self.h_forcemerge)
         r("GET", "/{index}/_count", self.h_count)
@@ -537,6 +539,7 @@ class RestController:
     def h_nodes_stats(self, req):
         from opensearch_tpu.common.breakers import breaker_service
         from opensearch_tpu.common.telemetry import metrics
+        from opensearch_tpu.indices.request_cache import request_cache
         # probe on read: stats reflect CURRENT disk health, not boot-time
         self.node.fs_health.check()
         indices = self.node.indices.indices
@@ -544,7 +547,8 @@ class RestController:
             self.node.node_id: {
                 "name": self.node.name,
                 "indices": {"docs": {"count": sum(
-                    s.doc_count() for s in indices.values())}},
+                    s.doc_count() for s in indices.values())},
+                    "request_cache": request_cache().stats()},
                 "breakers": breaker_service().stats(),
                 "tasks": {"count": len(self.node.task_manager.list())},
                 "thread_pool": self.node.thread_pool.stats(),
@@ -680,6 +684,24 @@ class RestController:
             svc.refresh()
         n = sum(s.num_shards for s in services)
         return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+    def h_cache_clear(self, req):
+        """POST [/{index}]/_cache/clear (RestClearIndicesCacheAction):
+        ``?request=false`` skips the request cache — the only cache type
+        with a clear hook here; fielddata/query params are accepted and
+        ignored like unsupported cache types in the reference."""
+        from opensearch_tpu.indices.request_cache import request_cache
+        expr = req.path_params.get("index")
+        services = (self.node.indices.resolve(expr) if expr
+                    else list(self.node.indices.indices.values()))
+        clear_request = (req.param("request") is None
+                         or req.flag("request"))
+        if clear_request:
+            for svc in services:
+                request_cache().clear(index=svc.name)
+        n = sum(s.num_shards for s in services)
+        return 200, {"_shards": {"total": n, "successful": n,
+                                 "failed": 0}}
 
     def h_flush(self, req):
         svc = self.node.indices.get(req.path_params["index"])
@@ -1667,6 +1689,21 @@ class RestController:
             conf = self.node.search_pipelines.hybrid_conf(pid)
             if conf is not None:
                 body["_hybrid_pipeline"] = conf
+        # request-cache directive: strict boolean (a typo like
+        # request_cache=tru must 400, not silently disable caching —
+        # RestRequest.paramAsBoolean semantics)
+        rc = req.param("request_cache")
+        if rc is not None:
+            if str(rc).lower() not in ("true", "false"):
+                raise IllegalArgumentError(
+                    f"Failed to parse value [{rc}] of parameter "
+                    "[request_cache] as only [true] or [false] are "
+                    "allowed.")
+            body["request_cache"] = str(rc).lower() == "true"
+        if "request_cache" in body and \
+                not isinstance(body["request_cache"], bool):
+            raise IllegalArgumentError(
+                "[request_cache] must be a boolean")
         # PIT search: the body names a held reader; no index in the path
         if body.get("pit"):
             return 200, self._pit_search(body)
@@ -1682,9 +1719,10 @@ class RestController:
             if body.get("size") == 0:
                 raise IllegalArgumentError(
                     "[size] cannot be [0] in a scroll context")
-            if req.param("request_cache") == "true":
+            if body.get("request_cache"):
                 raise IllegalArgumentError(
                     "[request_cache] cannot be used in a scroll context")
+            body.pop("request_cache", None)
             if int(body.get("from", 0) or 0) > 0:
                 raise IllegalArgumentError(
                     "`from` parameter must be set to 0 when `scroll` is "

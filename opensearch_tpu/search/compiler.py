@@ -516,16 +516,17 @@ def _host_run_scored(ctx, q):
 def _ord_per_doc(seg, field) -> dict:
     """doc -> term for a single-valued hidden ordinal column, cached on
     the segment (segments are immutable)."""
-    cache = getattr(seg, "_join_col_cache", None)
-    if cache is None:
-        cache = seg._join_col_cache = {}
+    from opensearch_tpu.common.cache import attached_cache
+    cache = attached_cache(seg, "_join_col_cache",
+                           name="query.join_columns",
+                           max_weight=32 << 20, breaker="fielddata")
     out = cache.get(field)
     if out is None:
         dv = seg.ordinal_dv.get(field)
         out = {} if dv is None else {
             int(d): dv.ord_terms[o]
             for d, o in zip(dv.value_docs, dv.ords) if o >= 0}
-        cache[field] = out
+        cache.put(field, out)
     return out
 
 

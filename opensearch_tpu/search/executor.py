@@ -162,21 +162,26 @@ def build_arrays(dseg: DeviceSegment, needed, mapper, live=None):
     array groups (absent fields get all-inactive dummies).  ``live`` is the
     caller's point-in-time staged live mask (defaults to the segment's
     construction-time state)."""
+    from opensearch_tpu.common.cache import attached_cache
+
     A = {"live": dseg.live if live is None else live}
     sources = {"postings": dseg.postings, "numeric": dseg.numeric,
                "ordinal": dseg.ordinal, "vector": dseg.vector,
                "geo": dseg.geo}
-    cache = getattr(dseg, "_dummy_cache", None)
-    if cache is None:
-        cache = {}
-        dseg._dummy_cache = cache
+    # per-device-segment dummy-array cache: bounded + accounted against
+    # the fielddata breaker (these live in device memory with the real
+    # columns); the weakref finalizer releases the accounting when the
+    # staging is dropped
+    cache = attached_cache(dseg, "_dummy_cache",
+                           name="query.dummy_arrays",
+                           max_weight=32 << 20, breaker="fielddata")
     for group, field in sorted(needed):
         entry = sources[group].get(field)
         if entry is None:
             entry = cache.get((group, field))
             if entry is None:
                 entry = _dummy_for(group, field, dseg, mapper)
-                cache[(group, field)] = entry
+                cache.put((group, field), entry)
         A.setdefault(group, {})[field] = {
             k: v for k, v in entry.items() if k != "n_ords"}
     return A

@@ -785,14 +785,16 @@ class ObjTermsPlan:
         if col is None:
             return None
         if self.kind == "ordinal":
-            cache = getattr(block, "_term_to_ord", None)
-            if cache is None:
-                cache = block._term_to_ord = {}
+            from opensearch_tpu.common.cache import attached_cache
+            cache = attached_cache(block, "_term_to_ord",
+                                   name="query.term_ords",
+                                   max_weight=8 << 20,
+                                   breaker="fielddata")
             term_to_ord = cache.get(self.field)
             if term_to_ord is None:
                 ord_terms, _ords, _objs = block.ordinal[self.field]
-                term_to_ord = cache[self.field] = {
-                    t: o for o, t in enumerate(ord_terms)}
+                term_to_ord = {t: o for o, t in enumerate(ord_terms)}
+                cache.put(self.field, term_to_ord)
             wanted = [term_to_ord[t] for t in bind["values"]
                       if t in term_to_ord]
             if not wanted:

@@ -47,9 +47,10 @@ class Suggester:
         """term -> df across the context's segments (cached on the
         searcher context: segments are immutable, so one scan serves
         every suggester until the searcher is reopened)."""
-        cache = getattr(self.ctx, "_suggest_vocab", None)
-        if cache is None:
-            cache = self.ctx._suggest_vocab = {}
+        from opensearch_tpu.common.cache import attached_cache
+        cache = attached_cache(self.ctx, "_suggest_vocab",
+                               name="suggest.vocab",
+                               max_weight=32 << 20, breaker="fielddata")
         vocab = cache.get(field)
         if vocab is not None:
             return vocab
@@ -62,7 +63,7 @@ class Suggester:
                 df = int(pf.df[tid])
                 if df > 0:
                     out[term] = out.get(term, 0) + df
-        cache[field] = out
+        cache.put(field, out)
         return out
 
     def _candidates(self, term: str, vocab: dict, max_edits: int,
@@ -194,16 +195,17 @@ def completion_suggest(ctx, prefix: str, spec: dict) -> list[dict]:
         if dv is None or not dv.ord_terms:
             continue
         # ord -> docs, built once per (immutable) segment+field
-        cache = getattr(seg, "_completion_cache", None)
-        if cache is None:
-            cache = seg._completion_cache = {}
+        from opensearch_tpu.common.cache import attached_cache
+        cache = attached_cache(seg, "_completion_cache",
+                               name="suggest.completion",
+                               max_weight=16 << 20, breaker="fielddata")
         docs_of = cache.get(field)
         if docs_of is None:
             docs_of = {}
             for d, o in zip(dv.value_docs, dv.ords):
                 if o >= 0:
                     docs_of.setdefault(int(o), []).append(int(d))
-            cache[field] = docs_of
+            cache.put(field, docs_of)
         weights = seg.completion_weights.get(field, {})
         lo = bisect.bisect_left(dv.ord_terms, prefix)
         for o in range(lo, len(dv.ord_terms)):

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Lint: no new unbounded dict caches outside common/cache.py.
+
+The ``obj._x_cache = {}`` idiom is an unbounded, unaccounted memory
+leak waiting for a big tenant: nothing evicts it, no circuit breaker
+sees it, no stats surface reports it.  This engine's sanctioned cache
+primitive is ``opensearch_tpu.common.cache.Cache`` (weighted LRU,
+breaker-accounted, telemetry-wired) with ``attached_cache`` for the
+per-object pattern.
+
+Rule: an assignment whose target name contains "cache" (attribute or
+plain name, plus annotated assignments) and whose value is a dict
+literal / comprehension or a ``dict()``/``OrderedDict()``/
+``defaultdict()`` call — anywhere under ``opensearch_tpu/`` except
+``common/cache.py`` — must either migrate to the cache primitive or
+carry a ``# bounded-cache`` annotation (same line or the line above)
+explaining why the mapping cannot grow without bound.
+
+Sibling of ``check_monotonic.py`` / ``check_sleep_loops.py``; new
+un-annotated sites fail tier-1 (tests/test_request_cache.py runs this).
+
+Usage: python tools/check_ad_hoc_caches.py [root]   (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ANNOTATION = "# bounded-cache"
+EXEMPT_SUFFIXES = (os.path.join("common", "cache.py"),)
+
+_DICT_CTORS = {"dict", "OrderedDict", "defaultdict", "WeakValueDictionary",
+               "WeakKeyDictionary"}
+
+
+def _is_dict_valued(value: ast.AST) -> bool:
+    if isinstance(value, (ast.Dict, ast.DictComp)):
+        return True
+    if isinstance(value, ast.Call):
+        fn = value.func
+        name = fn.id if isinstance(fn, ast.Name) else getattr(fn, "attr", "")
+        return name in _DICT_CTORS
+    return False
+
+
+def _target_cache_name(target: ast.AST) -> str | None:
+    if isinstance(target, ast.Attribute) and "cache" in target.attr.lower():
+        return target.attr
+    if isinstance(target, ast.Name) and "cache" in target.id.lower():
+        return target.id
+    return None
+
+
+def _violations(tree: ast.AST) -> list[tuple[int, str]]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if not _is_dict_valued(value):
+            continue
+        for target in targets:
+            name = _target_cache_name(target)
+            if name is not None:
+                out.append((node.lineno, name))
+    return out
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error ({e.msg})"]
+    lines = src.splitlines()
+    problems = []
+    for lineno, name in _violations(tree):
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        prev = lines[lineno - 2] if lineno >= 2 else ""
+        if ANNOTATION in line or ANNOTATION in prev:
+            continue
+        problems.append(
+            f"{path}:{lineno}: [{name}] assigned a raw dict — an "
+            "unbounded, unaccounted cache.  Use opensearch_tpu.common."
+            "cache.Cache / attached_cache (weighted LRU + breaker "
+            f"accounting), or annotate with '{ANNOTATION}' and why the "
+            "mapping is bounded")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    root = argv[1] if len(argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "opensearch_tpu")
+    problems = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            if any(path.endswith(sfx) for sfx in EXEMPT_SUFFIXES):
+                continue
+            problems.extend(check_file(path))
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} unbounded dict-cache site(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
