@@ -56,10 +56,15 @@ def _degradable_search_error(exc: BaseException) -> bool:
     (retry the next copy / count in ``_shards.failed``)?"""
     from opensearch_tpu.common import breakers
     from opensearch_tpu.common.errors import CircuitBreakingError
+    from opensearch_tpu.common.tasks import TaskCancelledException
 
+    # a shard task cancelled under it (backpressure duress, parent ban)
+    # degrades to a counted failure: the coordinator returns the partial
+    # results it has instead of hanging or failing the whole search
     if isinstance(exc, (NodeDisconnectedError, ReceiveTimeoutError,
                         ShardNotFoundError, CircuitBreakingError,
-                        breakers.CircuitBreakingError)):
+                        breakers.CircuitBreakingError,
+                        TaskCancelledException)):
         return True
     if isinstance(exc, RemoteTransportError):
         return exc.remote_type not in _CLIENT_ERROR_TYPES
@@ -79,6 +84,10 @@ A_FETCH_SEGMENTS = "indices:admin/replication/segments"
 A_START_RECOVERY = "internal:index/shard/recovery/start"
 A_FAIL_COPY = "internal:cluster/shard/failure"
 A_SHARD_RECOVERED = "internal:cluster/shard/started"
+# parent-task ban broadcast (TaskCancellationService's
+# internal:admin/tasks/ban): a cancelled coordinator search reaps its
+# remote shard tasks instead of leaving them running
+A_BAN_PARENT = "internal:admin/tasks/ban"
 
 
 class NoMasterError(CoordinationError):
@@ -93,6 +102,15 @@ class ClusterNode:
         os.makedirs(data_path, exist_ok=True)
         self.transport = transport
         self.indices: dict[str, IndexService] = {}
+        # every shard-level search runs as a registered, cancellable
+        # task with a parent id (the coordinator's), so _tasks-style
+        # cancellation and backpressure reach remote work
+        from opensearch_tpu.common.tasks import TaskManager
+        from opensearch_tpu.search.backpressure import \
+            SearchBackpressureService
+        self.task_manager = TaskManager(node_id)
+        self.search_backpressure = SearchBackpressureService(
+            self.task_manager)
         # data-node write admission (the same per-shard byte accounting
         # the single-node path gets from IndicesService)
         from opensearch_tpu.common.indexing_pressure import IndexingPressure
@@ -124,6 +142,7 @@ class ClusterNode:
         t.register_handler(A_START_RECOVERY, self._h_start_recovery)
         t.register_handler(A_FAIL_COPY, self._h_fail_copy)
         t.register_handler(A_SHARD_RECOVERED, self._h_shard_recovered)
+        t.register_handler(A_BAN_PARENT, self._h_ban_parent)
         # restart: reopen local shards from the restored committed state
         # right away (the GatewayAllocator's on-disk-copy path) so engines
         # replay their translogs before any routing decisions arrive.
@@ -144,7 +163,9 @@ class ClusterNode:
             if (peer != self.node_id
                     and peer not in self.transport._peer_versions):
                 threading.Thread(target=self._handshake_peer,
-                                 args=(peer,), daemon=True).start()
+                                 args=(peer,), daemon=True,
+                                 name=f"handshake-{self.node_id}-{peer}"
+                                 ).start()
         to_promote: list[tuple] = []
         to_recover: list[tuple] = []
         with self._lock:
@@ -667,6 +688,36 @@ class ClusterNode:
         engine = svc.engine_for(payload["shard"])
         return {"blobs": engine.segments_blobs(payload["seg_ids"])}
 
+    # -- task cancellation propagation -------------------------------------
+
+    def _h_ban_parent(self, payload: dict) -> dict:
+        """Ban (or lift the ban on) a parent task id: running children
+        are cancelled, late-registering children arrive pre-cancelled
+        (ref TaskCancellationService.BanParentTaskRequest)."""
+        pid = payload["parent_task_id"]
+        if payload.get("ban", True):
+            cancelled = self.task_manager.ban_parent(
+                pid, payload.get("reason", "parent task was cancelled"))
+            return {"cancelled": len(cancelled)}
+        self.task_manager.unban_parent(pid)
+        return {"cancelled": 0}
+
+    def _broadcast_ban(self, parent_id: str, nodes, reason: str,
+                       ban: bool = True) -> None:
+        """Fire-and-forget ban/unban to every node that (may) run
+        children of ``parent_id``; the local manager is hit directly."""
+        payload = {"parent_task_id": parent_id, "reason": reason,
+                   "ban": ban}
+        for node in nodes:
+            try:
+                if node == self.node_id:
+                    self._h_ban_parent(payload)
+                else:
+                    self.transport.submit_request(node, A_BAN_PARENT,
+                                                  payload)
+            except Exception:  # noqa: BLE001 — best effort per node
+                pass
+
     # -- search (scatter-gather) -------------------------------------------
 
     def _copy_candidates(self, entry: dict) -> list[str]:
@@ -704,7 +755,7 @@ class ClusterNode:
         failed degrade to ``_shards.failed`` entries when partial
         results are allowed, and the survivors' top-k merges on this
         node."""
-        from opensearch_tpu.common.telemetry import metrics, tracer
+        from opensearch_tpu.common import tasks as taskmod
         from opensearch_tpu.search import executor as _exec
 
         body = dict(body or {})
@@ -731,6 +782,40 @@ class ClusterNode:
 
         aggs_requested = bool(body.get("aggs") or body.get("aggregations"))
 
+        # the coordinator search is itself a registered, cancellable
+        # task; its id is the parent id every remote shard task carries,
+        # and cancelling it broadcasts a ban to every involved node
+        task = self.task_manager.register(
+            "indices:data/read/search", f"search [{index}]")
+        token = taskmod.set_current(task)
+        parent_id = f"{self.node_id}:{task.id}"
+        involved = sorted({n for cands in candidates.values()
+                           for n in cands})
+        task.add_cancellation_listener(
+            lambda: self._broadcast_ban(
+                parent_id, involved,
+                f"coordinator task [{parent_id}] was cancelled: "
+                f"{task.cancel_reason}"))
+        try:
+            return self._search_scatter(
+                index, body, routing, candidates, failures,
+                allow_partial, aggs_requested, task, parent_id)
+        finally:
+            taskmod.reset_current(token)
+            self.task_manager.unregister(task)
+            if task.cancelled:
+                # lift the bans so the parent id doesn't pin a ban slot
+                # on nodes that will never see another child of it
+                self._broadcast_ban(parent_id, involved, "completed",
+                                    ban=False)
+
+    def _search_scatter(self, index, body, routing, candidates, failures,
+                        allow_partial, aggs_requested, task, parent_id):
+        from opensearch_tpu.common.tasks import TaskCancelledException
+        from opensearch_tpu.common.telemetry import metrics, tracer
+        from opensearch_tpu.search import executor as _exec
+        from opensearch_tpu.search.executor import merge_hit_rows
+
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
         sub = dict(body)
@@ -747,6 +832,18 @@ class ClusterNode:
             attempt = {shard: 0 for shard in candidates}
             pending = set(candidates)
             while pending:
+                if task.cancelled:
+                    # cancelled mid-scatter: stop issuing RPCs, count the
+                    # un-queried shards as failures and return what we
+                    # have (the ban broadcast reaps in-flight children)
+                    exc = TaskCancelledException(
+                        f"task [{parent_id}] was cancelled: "
+                        f"{task.cancel_reason}")
+                    for shard in sorted(pending):
+                        failures.append(_exec.shard_failure_entry(
+                            index, shard, None, exc))
+                    pending.clear()
+                    break
                 by_node: dict[str, list[int]] = {}
                 for shard in sorted(pending):
                     node = candidates[shard][attempt[shard]]
@@ -754,7 +851,8 @@ class ClusterNode:
                 for node, shards in by_node.items():
                     payload = {"index": index, "shards": shards,
                                "body": sub,
-                               "agg_partials": aggs_requested}
+                               "agg_partials": aggs_requested,
+                               "parent_task_id": parent_id}
                     try:
                         responses.append(self._query_group(node, payload))
                         pending.difference_update(shards)
@@ -834,6 +932,8 @@ class ClusterNode:
         return out
 
     def _h_search_shards(self, payload: dict) -> dict:
+        from opensearch_tpu.common import tasks as taskmod
+
         svc = self.indices.get(payload["index"])
         if svc is None:
             raise ShardNotFoundError(
@@ -842,6 +942,24 @@ class ClusterNode:
         explicit_cache = body.pop("request_cache", None)
         agg_partials = bool(payload.get("agg_partials"))
         shard_ids = sorted(payload["shards"])
+        # the shard query phase runs as a registered child task: a
+        # banned/cancelled parent stops it at the next segment boundary,
+        # and its resource usage shows up in this node's task list
+        task = self.task_manager.register(
+            A_SEARCH_SHARDS,
+            f"shards {shard_ids} of [{payload['index']}]",
+            parent_task_id=payload.get("parent_task_id"))
+        token = taskmod.set_current(task)
+        try:
+            task.ensure_not_cancelled()    # parent already banned?
+            return self._search_shards_body(svc, body, explicit_cache,
+                                            agg_partials, shard_ids)
+        finally:
+            taskmod.reset_current(token)
+            self.task_manager.unregister(task)
+
+    def _search_shards_body(self, svc, body, explicit_cache,
+                            agg_partials, shard_ids) -> dict:
 
         def compute() -> dict:
             from opensearch_tpu.search.executor import ShardSearcher

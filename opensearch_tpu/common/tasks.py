@@ -7,6 +7,15 @@ TaskCancellationService.java).  Long device work cooperates by calling
 granularity as the reference's CancellableBulkScorer checking between
 Lucene leaf scorers — so a runaway query stops at the next segment
 boundary instead of holding the device until completion.
+
+PR 4 adds the TaskResourceTrackingService half (ref
+tasks/TaskResourceTrackingService.java): each task accumulates CPU time
+(``time.thread_time`` deltas taken at the same cooperative checkpoints
+that check cancellation), elapsed time, and a heap estimate charged
+against the request circuit breaker — the numbers the search
+backpressure service ranks runaway queries by — plus parent-task bans
+(ref TaskManager.setBan) so a coordinator-side cancellation propagates
+to the shard tasks it spawned on other nodes.
 """
 
 from __future__ import annotations
@@ -14,7 +23,7 @@ from __future__ import annotations
 import contextvars
 import threading
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from opensearch_tpu.common.errors import OpenSearchTpuError
 
@@ -23,10 +32,14 @@ _current: "contextvars.ContextVar[Optional[Task]]" = \
 
 
 def set_current(task: "Task"):
+    task.start_thread_tracking()
     return _current.set(task)
 
 
 def reset_current(token) -> None:
+    t = _current.get()
+    if t is not None:
+        t.stop_thread_tracking()
     _current.reset(token)
 
 
@@ -35,10 +48,23 @@ def current() -> "Optional[Task]":
 
 
 def check_current() -> None:
-    """Cooperative cancellation point — cheap no-op without a task."""
+    """Cooperative cancellation point — cheap no-op without a task.
+    Doubles as the resource-tracking checkpoint: the reference samples
+    thread CPU at the same points it checks for cancellation."""
     t = _current.get()
     if t is not None:
+        t.record_checkpoint()
         t.ensure_not_cancelled()
+
+
+def charge_current(obj_or_bytes, label: str = "<task>") -> int:
+    """Charge a heap estimate to the current task (no-op without one).
+    Raises CircuitBreakingError when the request breaker would trip —
+    the same degrade-per-shard path any breaker trip takes."""
+    t = _current.get()
+    if t is None:
+        return 0
+    return t.charge_heap(obj_or_bytes, label=label)
 
 
 class TaskCancelledException(OpenSearchTpuError):
@@ -48,7 +74,8 @@ class TaskCancelledException(OpenSearchTpuError):
 class Task:
     def __init__(self, task_id: int, action: str, description: str,
                  cancellable: bool = True,
-                 headers: Optional[dict] = None):
+                 headers: Optional[dict] = None,
+                 parent_task_id: Optional[str] = None):
         self.id = task_id
         self.action = action
         self.description = description
@@ -57,17 +84,58 @@ class Task:
         # from the REST request into every task it spawns — ref
         # tasks/Task.java HEADERS_TO_COPY)
         self.headers: dict = dict(headers or {})
+        # "node_id:task_id" of the task that spawned this one on the
+        # coordinator (ref Task.getParentTaskId) — the ban key
+        self.parent_task_id = parent_task_id
         self.start_time_millis = int(time.time() * 1000)  # wall-clock: timestamp
         self._start = time.monotonic()
         self._cancelled = threading.Event()
         self.cancel_reason: Optional[str] = None
+        self._listeners: list[Callable[[], None]] = []
+        # -- resource tracking (TaskResourceTrackingService analog) ----
+        self._res_lock = threading.Lock()
+        self._cpu_nanos = 0
+        self._cpu_base: dict[int, float] = {}   # thread id -> thread_time
+        self._heap_bytes = 0
+        self._heap_peak = 0
+        self._checkpoints = 0
+
+    # -- cancellation ------------------------------------------------------
 
     def cancel(self, reason: str = "by user request"):
         if not self.cancellable:
             raise OpenSearchTpuError(
                 f"task [{self.id}] is not cancellable")
         self.cancel_reason = reason
+        already = self._cancelled.is_set()
         self._cancelled.set()
+        if not already:
+            self._run_listeners()
+
+    def add_cancellation_listener(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` once when this task is cancelled (immediately if it
+        already was) — the reference's CancellableTask listener used to
+        propagate bans and free held contexts."""
+        run_now = False
+        with self._res_lock:
+            if self._cancelled.is_set():
+                run_now = True
+            else:
+                self._listeners.append(fn)
+        if run_now:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — listener isolation
+                pass
+
+    def _run_listeners(self) -> None:
+        with self._res_lock:
+            listeners, self._listeners = self._listeners, []
+        for fn in listeners:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — listener isolation
+                pass
 
     @property
     def cancelled(self) -> bool:
@@ -78,6 +146,93 @@ class Task:
             raise TaskCancelledException(
                 f"task [{self.id}] was cancelled: {self.cancel_reason}")
 
+    # -- resource tracking -------------------------------------------------
+
+    def start_thread_tracking(self) -> None:
+        """Baseline this thread's CPU clock; deltas accumulate at each
+        cooperative checkpoint.  A task may execute on several threads
+        over its life (REST thread, transport executor) — each tracks
+        its own baseline."""
+        with self._res_lock:
+            self._cpu_base[threading.get_ident()] = time.thread_time()
+
+    def stop_thread_tracking(self) -> None:
+        tid = threading.get_ident()
+        with self._res_lock:
+            base = self._cpu_base.pop(tid, None)
+            if base is not None:
+                self._cpu_nanos += max(
+                    0, int((time.thread_time() - base) * 1e9))
+
+    def record_checkpoint(self) -> None:
+        """Fold the calling thread's CPU delta into the task total (the
+        reference refreshes ThreadMXBean CPU numbers at the same
+        cancellation checkpoints)."""
+        tid = threading.get_ident()
+        now = time.thread_time()
+        with self._res_lock:
+            base = self._cpu_base.get(tid)
+            if base is not None:
+                self._cpu_nanos += max(0, int((now - base) * 1e9))
+            self._cpu_base[tid] = now
+            self._checkpoints += 1
+
+    def add_cpu_nanos(self, nanos: int) -> None:
+        """Explicit CPU attribution (device programs burn accelerator
+        time the host thread clock never sees; tests charge synthetic
+        usage deterministically)."""
+        with self._res_lock:
+            self._cpu_nanos += int(nanos)
+
+    def charge_heap(self, obj_or_bytes, label: str = "<task>") -> int:
+        """Reserve a heap estimate against the request breaker on behalf
+        of this task; released in full when the task unregisters."""
+        from opensearch_tpu.common.breakers import breaker_service
+        from opensearch_tpu.common.cache import estimate_weight
+
+        n = (int(obj_or_bytes) if isinstance(obj_or_bytes, (int, float))
+             else estimate_weight(obj_or_bytes))
+        if n <= 0:
+            return 0
+        breaker_service().request.add_estimate(
+            n, label=f"task [{self.id}] {label}")
+        with self._res_lock:
+            self._heap_bytes += n
+            self._heap_peak = max(self._heap_peak, self._heap_bytes)
+        return n
+
+    def release_resources(self) -> None:
+        """Give back every breaker byte this task reserved (unregister
+        path — mirrors TaskResourceTrackingService.stopTracking)."""
+        from opensearch_tpu.common.breakers import breaker_service
+        with self._res_lock:
+            n, self._heap_bytes = self._heap_bytes, 0
+            self._cpu_base.clear()
+        if n:
+            breaker_service().request.release(n)
+
+    @property
+    def cpu_time_nanos(self) -> int:
+        with self._res_lock:
+            return self._cpu_nanos
+
+    @property
+    def elapsed_nanos(self) -> int:
+        return int((time.monotonic() - self._start) * 1e9)
+
+    @property
+    def heap_bytes(self) -> int:
+        with self._res_lock:
+            return self._heap_bytes
+
+    def resource_stats(self) -> dict:
+        with self._res_lock:
+            return {"cpu_time_in_nanos": self._cpu_nanos,
+                    "elapsed_time_in_nanos": self.elapsed_nanos,
+                    "heap_size_in_bytes": self._heap_bytes,
+                    "peak_heap_size_in_bytes": self._heap_peak,
+                    "checkpoints": self._checkpoints}
+
     def info(self) -> dict:
         out = {"id": self.id, "action": self.action,
                "description": self.description,
@@ -85,30 +240,48 @@ class Task:
                "cancelled": self.cancelled,
                "start_time_in_millis": self.start_time_millis,
                "running_time_in_nanos": int(
-                   (time.monotonic() - self._start) * 1e9)}
+                   (time.monotonic() - self._start) * 1e9),
+               "resource_stats": self.resource_stats()}
+        if self.parent_task_id:
+            out["parent_task_id"] = self.parent_task_id
         if self.headers:
             out["headers"] = dict(self.headers)
         return out
 
 
 class TaskManager:
+    # bans are removed when the parent completes; the cap bounds damage
+    # if an unban frame is lost (oldest bans fall off first)
+    MAX_BANS = 1000
+
     def __init__(self, node_name: str = "node"):
         self.node_name = node_name
         self._lock = threading.Lock()
         self._tasks: dict[int, Task] = {}
         self._next = 0
+        # parent_task_id -> ban reason (ref TaskManager.banedParents):
+        # children registered AFTER the ban arrive pre-cancelled
+        self._bans: dict[str, str] = {}
 
     def register(self, action: str, description: str = "",
                  cancellable: bool = True,
-                 headers: Optional[dict] = None) -> Task:
+                 headers: Optional[dict] = None,
+                 parent_task_id: Optional[str] = None) -> Task:
         with self._lock:
             self._next += 1
             t = Task(self._next, action, description, cancellable,
-                     headers=headers)
+                     headers=headers, parent_task_id=parent_task_id)
             self._tasks[t.id] = t
-            return t
+            ban = (self._bans.get(parent_task_id)
+                   if parent_task_id else None)
+        if ban is not None and cancellable:
+            # the race the reference closes with setBan: the ban beat
+            # the child registration, so the child never starts work
+            t.cancel(f"parent task was cancelled [{ban}]")
+        return t
 
     def unregister(self, task: Task):
+        task.release_resources()
         with self._lock:
             self._tasks.pop(task.id, None)
 
@@ -143,3 +316,31 @@ class TaskManager:
                 t.cancel(reason)
                 out.append(t)
         return out
+
+    # -- parent bans (coordinator → data-node cancellation) ----------------
+
+    def ban_parent(self, parent_task_id: str,
+                   reason: str = "parent task was cancelled") -> list[Task]:
+        """Cancel every registered child of ``parent_task_id`` and record
+        the ban so late-arriving children are cancelled on registration
+        (ref TaskCancellationService.setBanOnNodes)."""
+        with self._lock:
+            while len(self._bans) >= self.MAX_BANS:
+                self._bans.pop(next(iter(self._bans)))
+            self._bans[parent_task_id] = reason
+            children = [t for t in self._tasks.values()
+                        if t.parent_task_id == parent_task_id]
+        out = []
+        for t in children:
+            if t.cancellable and not t.cancelled:
+                t.cancel(reason)
+                out.append(t)
+        return out
+
+    def unban_parent(self, parent_task_id: str) -> bool:
+        with self._lock:
+            return self._bans.pop(parent_task_id, None) is not None
+
+    def banned_parents(self) -> dict:
+        with self._lock:
+            return dict(self._bans)

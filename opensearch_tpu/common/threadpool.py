@@ -19,6 +19,9 @@ from opensearch_tpu.common.errors import OpenSearchTpuError
 
 class RejectedExecutionError(OpenSearchTpuError):
     status = 429
+    # the REST layer maps this to 429 + Retry-After (overload is
+    # transient by definition; tell clients when to come back)
+    retry_after_seconds = 1
 
 
 class _Pool:

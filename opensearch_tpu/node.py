@@ -53,6 +53,10 @@ class Node:
         self.contexts = ReaderContextRegistry()
         self.search_pipelines = SearchPipelineService(data_path)
         self.task_manager = TaskManager(name)
+        from opensearch_tpu.search.backpressure import \
+            SearchBackpressureService
+        self.search_backpressure = SearchBackpressureService(
+            self.task_manager, self.thread_pool)
         from opensearch_tpu.security.identity import IdentityService
         self.identity = IdentityService(data_path)
         self._init_cluster_settings()
@@ -110,6 +114,21 @@ class Node:
         backpressure_mode = Setting(
             "search_backpressure.mode", "monitor_only", str,
             validator=_bp_mode_check, dynamic=True)
+        bp_cpu = Setting.float_setting(
+            "search_backpressure.node_duress.cpu_threshold", 0.9,
+            min_value=0.0, dynamic=True)
+        bp_heap = Setting.float_setting(
+            "search_backpressure.node_duress.heap_threshold", 0.85,
+            min_value=0.0, dynamic=True)
+        bp_queue = Setting.int_setting(
+            "search_backpressure.node_duress.search_queue_threshold",
+            500, min_value=1, dynamic=True)
+        bp_streak = Setting.int_setting(
+            "search_backpressure.node_duress.num_successive_breaches",
+            3, min_value=1, dynamic=True)
+        bp_max_cc = Setting.int_setting(
+            "search_backpressure.max_concurrent_searches", 256,
+            min_value=1, dynamic=True)
         max_keep_alive = Setting.time_setting(
             "search.max_keep_alive", 24 * 3600.0, dynamic=True)
         default_keep_alive = Setting.time_setting(
@@ -123,8 +142,24 @@ class Node:
             Settings(stored),
             [max_buckets, auto_create, max_scroll, cache_size,
              identity_enabled, alloc_enable, backpressure_mode,
+             bp_cpu, bp_heap, bp_queue, bp_streak, bp_max_cc,
              max_keep_alive, default_keep_alive, allow_partial,
              req_cache_size])
+        # search backpressure: the mode setting was validated-but-dead
+        # before this PR — now every flip (and the node_duress knobs)
+        # reaches the live service immediately, and persisted values
+        # replay at boot (SearchBackpressureSettings' consumers)
+        bp = self.search_backpressure
+        for setting, consumer in (
+                (backpressure_mode, bp.set_mode),
+                (bp_cpu, bp.set_cpu_threshold),
+                (bp_heap, bp.set_heap_threshold),
+                (bp_queue, bp.set_queue_threshold),
+                (bp_streak, bp.set_num_successive_breaches),
+                (bp_max_cc, bp.set_max_concurrent_searches)):
+            self.cluster_settings.add_settings_update_consumer(
+                setting, consumer)
+            consumer(self.cluster_settings.get(setting))
         self.cluster_settings.add_settings_update_consumer(
             req_cache_size,
             lambda v: request_cache().set_max_bytes(int(v)))
@@ -244,6 +279,10 @@ class Node:
                 "(the reference's security plugin requires TLS here)",
                 self.host)
         self.http.start()
+        # overload monitor: evaluates node duress on a cadence even when
+        # no new searches arrive to tick it (SearchBackpressureService's
+        # scheduled run)
+        self.search_backpressure.start_monitor()
         # re-run persistent tasks that never completed (crash between
         # submit and completion); executors are idempotent
         self.persistent_tasks.resume_incomplete()
@@ -256,6 +295,7 @@ class Node:
         if getattr(self, "_stopped", False):
             return
         self._stopped = True
+        self.search_backpressure.stop_monitor()
         self.http.stop()
         self.indices.close()
         self.thread_pool.shutdown()

@@ -180,9 +180,17 @@ class RestController:
     def dispatch(self, method: str, path: str, params: dict,
                  body: Optional[bytes], content_type: str = "",
                  authorization: str = "",
-                 headers: Optional[dict] = None) -> tuple[int, dict]:
+                 headers: Optional[dict] = None,
+                 response_headers: Optional[dict] = None
+                 ) -> tuple[int, dict]:
+        """``response_headers``: optional out-channel the HTTP layer
+        passes so error mappings can attach headers (Retry-After on
+        backpressure rejections) without changing the return shape."""
+        import contextlib
+
         from opensearch_tpu.common import tasks as taskmod
         from opensearch_tpu.common.telemetry import metrics, tracer
+        from opensearch_tpu.common.threadpool import RejectedExecutionError
 
         headers = headers or {}
         # request attribution: X-Opaque-Id threads into the task and all
@@ -233,8 +241,18 @@ class RestController:
                                              self.node.name)}
                     if opaque_id:
                         attrs["x_opaque_id"] = opaque_id
+                    # search admission: a permit gate at the REST edge —
+                    # saturated nodes reject (429 + Retry-After) instead
+                    # of queueing unboundedly (the search_backpressure
+                    # admission-control half)
+                    bp = getattr(self.node, "search_backpressure", None)
+                    admission = (bp.admission.acquire(handler_name)
+                                 if bp is not None and action in (
+                                     "indices:data/read/search",
+                                     "indices:data/read/msearch")
+                                 else contextlib.nullcontext())
                     try:
-                        with tracer().start_span(
+                        with admission, tracer().start_span(
                                 f"rest:{action}", attributes=attrs,
                                 parent=tracer().extract(headers)) as span, \
                                 metrics().time_ms("rest.request_ms"):
@@ -257,6 +275,18 @@ class RestController:
                 "reason": f"no handler found for uri [{path}] and method "
                           f"[{method}]"}, "status": 400}
         except OpenSearchTpuError as e:
+            # overload rejections (thread-pool RejectedExecutionError,
+            # admission/backpressure SearchRejectedError) ship a
+            # Retry-After header and count in search.rejected so clients
+            # and dashboards see the shed load, not just 429s
+            from opensearch_tpu.search.backpressure import \
+                SearchRejectedError
+            if isinstance(e, (RejectedExecutionError,
+                              SearchRejectedError)):
+                metrics().counter("search.rejected").inc()
+                if response_headers is not None:
+                    response_headers["Retry-After"] = str(
+                        int(getattr(e, "retry_after_seconds", 1)))
             # transport-layer failures (NodeDisconnectedError /
             # ReceiveTimeoutError / NoMasterError) carry status 503 on
             # the class: the condition is retryable and the serialized
@@ -556,6 +586,10 @@ class RestController:
                 "file_cache": self.node.indices.file_cache.stats(),
                 "indexing_pressure":
                     self.node.indices.indexing_pressure.stats(),
+                # overload-protection observability: duress trackers,
+                # cancellation accounting, admission gate occupancy
+                "search_backpressure":
+                    self.node.search_backpressure.stats(),
                 "os": _os_stats(),
                 "process": _process_stats(),
                 # counters + latency histograms with p50/p90/p99 readout
@@ -1518,7 +1552,19 @@ class RestController:
         if not isinstance(ctx, ScrollContext):
             raise ValidationError(
                 f"id [{scroll_id}] is a point-in-time, not a scroll")
+        self._close_context_on_cancel(scroll_id)
         return 200, self._scroll_response(ctx, scroll_id)
+
+    def _close_context_on_cancel(self, context_id: str) -> None:
+        """Cancelling the task that owns a scroll/PIT page closes the
+        live reader context at once — releasing its breaker reservation
+        — instead of waiting for keep-alive reaping (the reference frees
+        the reader context when the scroll task is cancelled)."""
+        from opensearch_tpu.common import tasks as taskmod
+        task = taskmod.current()
+        if task is not None:
+            task.add_cancellation_listener(
+                lambda: self.node.contexts.close(context_id))
 
     def h_scroll_clear(self, req):
         body = req.json({}) or {}
@@ -1870,6 +1916,7 @@ class RestController:
         except OpenSearchTpuError:
             ctx.release()
             raise
+        self._close_context_on_cancel(scroll_id)
         return self._scroll_response(ctx, scroll_id)
 
     def _pit_search(self, body):
@@ -1885,6 +1932,7 @@ class RestController:
         if not isinstance(ctx, PitContext):
             raise ValidationError(
                 f"id [{pit_id}] is a scroll, not a point-in-time")
+        self._close_context_on_cancel(pit_id)
         sub = {k: v for k, v in body.items() if k != "pit"}
         resp = ctx.searcher.search(sub)
         resp["pit_id"] = pit_id

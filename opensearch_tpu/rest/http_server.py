@@ -51,6 +51,7 @@ class _Handler(BaseHTTPRequestHandler):
         # (the reference's in_flight_requests breaker / IndexingPressure
         # admission check)
         breaker = breaker_service().in_flight
+        extra_headers: dict = {}
         try:
             breaker.add_estimate(length, label=f"<http_request> "
                                                f"{split.path}")
@@ -60,6 +61,7 @@ class _Handler(BaseHTTPRequestHandler):
             # request line
             self.close_connection = True
             status, payload = 429, e.to_xcontent()
+            extra_headers["Retry-After"] = "1"
         else:
             try:
                 body = self.rfile.read(length) if length else b""
@@ -67,7 +69,8 @@ class _Handler(BaseHTTPRequestHandler):
                     self.command, split.path, params, body,
                     self.headers.get("Content-Type") or "",
                     self.headers.get("Authorization") or "",
-                    headers=dict(self.headers.items()))
+                    headers=dict(self.headers.items()),
+                    response_headers=extra_headers)
             finally:
                 breaker.release(length)
         is_cat = split.path.startswith("/_cat") and params.get("format") != "json"
@@ -97,6 +100,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in extra_headers.items():
+            # error-mapping headers (Retry-After on 429 rejections)
+            self.send_header(k, str(v))
         opaque = self.headers.get("X-Opaque-Id")
         if opaque:
             # the reference echoes X-Opaque-Id on every response so

@@ -1,0 +1,417 @@
+"""Search backpressure: node duress detection, runaway-query
+cancellation, and admission control.
+
+Analog of the reference's ``search.backpressure`` subsystem (ref
+search/backpressure/SearchBackpressureService.java,
+SearchBackpressureSettings, trackers/NodeDuressTrackers.java,
+trackers/TaskResourceUsageTrackers.java): a periodic monitor decides the
+node is *in duress* (circuit-breaker pressure, search thread-pool queue
+depth, CPU load — each behind an injectable probe so tests drive it
+deterministically) and, once the duress persists for
+``num_successive_breaches`` evaluations, picks the most
+resource-consuming cancellable search tasks and cancels them —
+rate-limited by a token bucket so a storm of small queries is not mass
+cancelled (``cancellation_burst``/``cancellation_rate``).  In
+``monitor_only`` mode eligible tasks are only counted; ``disabled``
+turns the whole loop off.  ``SearchAdmissionController`` is the edge
+half: a concurrent-search permit gate that rejects with 429 +
+``Retry-After`` *before* work queues unboundedly (the reference's
+admission control at the RestController/coordinator boundary).
+
+Everything observable lands in ``stats()`` → ``_nodes/stats``
+``search_backpressure``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Callable, Optional
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+
+MODES = ("disabled", "monitor_only", "enforced")
+
+#: task actions the backpressure service may cancel (search family only:
+#: writes and admin tasks are never sacrificed to search overload)
+SEARCH_ACTION_PREFIXES = ("indices:data/read/search",
+                          "indices:data/read/msearch",
+                          "indices:data/read/scroll")
+
+
+class SearchRejectedError(OpenSearchTpuError):
+    """Admission-control rejection: the node is saturated and queueing
+    would only grow the backlog.  429 + Retry-After, like the
+    reference's OpenSearchRejectedExecutionException mapping."""
+    status = 429
+    retry_after_seconds = 1
+
+
+def _is_search_task(task) -> bool:
+    return any(task.action.startswith(p) for p in SEARCH_ACTION_PREFIXES)
+
+
+class TokenBucket:
+    """Deterministic rate limiter on an injectable monotonic clock (ref
+    search/backpressure/stats/../TokenBucket.java)."""
+
+    def __init__(self, rate: float, burst: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._clock = clock
+        self._tokens = float(burst)
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def request(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+
+class DuressTracker:
+    """One node-duress signal: probe() -> current value, breached when
+    value >= threshold.  Probes are plain callables so tests inject
+    synthetic load (ref trackers/NodeDuressTrackers.NodeDuressTracker)."""
+
+    def __init__(self, name: str, probe: Callable[[], float],
+                 threshold: float):
+        self.name = name
+        self.probe = probe
+        self.threshold = float(threshold)
+        self.breach_count = 0
+
+    def check(self) -> bool:
+        try:
+            value = float(self.probe())
+        except Exception:  # noqa: BLE001 — a broken probe is "no duress"
+            value = 0.0
+        self.last_value = value
+        if value >= self.threshold:
+            self.breach_count += 1
+            return True
+        return False
+
+    def stats(self) -> dict:
+        return {"threshold": self.threshold,
+                "current": getattr(self, "last_value", 0.0),
+                "breach_count": self.breach_count}
+
+
+def _breaker_pressure() -> float:
+    """Parent-breaker utilization in [0, 1] — the heap-usage stand-in
+    (device/host budgets are what this engine actually runs out of)."""
+    from opensearch_tpu.common.breakers import breaker_service
+    svc = breaker_service()
+    used = sum(b.used for b in svc.parent._children)
+    return used / svc.parent.limit if svc.parent.limit else 0.0
+
+
+def _default_cpu_load() -> float:
+    """1-minute load average per core; 0.0 where unsupported."""
+    import os
+    try:
+        return os.getloadavg()[0] / (os.cpu_count() or 1)
+    except (OSError, AttributeError):
+        return 0.0
+
+
+class SearchAdmissionController:
+    """Concurrent-search permit gate at the REST/coordinator edge: a
+    request either gets a permit immediately or is rejected with 429 —
+    never queued (the reference rejects from the search thread pool's
+    bounded queue; this gate fails faster and with Retry-After)."""
+
+    def __init__(self, service: "SearchBackpressureService",
+                 max_concurrent: int = 256):
+        self._service = service
+        self.max_concurrent = int(max_concurrent)
+        self._inflight = 0
+        self.rejected_count = 0
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def acquire(self, kind: str = "search"):
+        self._service.maybe_tick()
+        with self._lock:
+            reason = None
+            if self._inflight >= self.max_concurrent:
+                reason = (f"too many concurrent searches "
+                          f"[{self._inflight}] >= "
+                          f"[{self.max_concurrent}]")
+            elif (self._service.mode == "enforced"
+                    and self._service.in_duress()):
+                reason = "node is in duress"
+            if reason is not None:
+                self.rejected_count += 1
+                raise SearchRejectedError(
+                    f"rejected execution of [{kind}]: {reason}; reduce "
+                    "concurrency or retry after the Retry-After interval")
+            self._inflight += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"current": self._inflight,
+                    "max_concurrent": self.max_concurrent,
+                    "rejected_count": self.rejected_count}
+
+
+class SearchBackpressureService:
+    """The monitor half.  ``run_once()`` is one deterministic evaluation
+    tick; production paces it via ``maybe_tick()`` on the admission path
+    and (optionally) ``start_monitor()``'s background thread."""
+
+    def __init__(self, task_manager, thread_pool=None, *,
+                 mode: str = "monitor_only",
+                 clock: Callable[[], float] = time.monotonic,
+                 cpu_load_fn: Optional[Callable[[], float]] = None,
+                 cpu_threshold: float = 0.9,
+                 heap_threshold: float = 0.85,
+                 queue_threshold: int = 500,
+                 num_successive_breaches: int = 3,
+                 cancellation_rate: float = 1.0,
+                 cancellation_burst: float = 10.0,
+                 max_cancellations_per_tick: int = 1,
+                 max_concurrent_searches: int = 256,
+                 interval_s: float = 1.0,
+                 task_cpu_nanos_threshold: int = int(15e9),
+                 task_heap_bytes_threshold: int = 64 << 20,
+                 task_elapsed_nanos_threshold: int = int(30e9)):
+        self.task_manager = task_manager
+        self.thread_pool = thread_pool
+        self._mode = mode
+        self._clock = clock
+        self.interval_s = float(interval_s)
+        self.num_successive_breaches = int(num_successive_breaches)
+        self.max_cancellations_per_tick = int(max_cancellations_per_tick)
+        self.task_cpu_nanos_threshold = int(task_cpu_nanos_threshold)
+        self.task_heap_bytes_threshold = int(task_heap_bytes_threshold)
+        self.task_elapsed_nanos_threshold = int(task_elapsed_nanos_threshold)
+        self._bucket = TokenBucket(cancellation_rate, cancellation_burst,
+                                   clock)
+        self.trackers = {
+            "heap_usage": DuressTracker("heap_usage", _breaker_pressure,
+                                        heap_threshold),
+            "search_queue": DuressTracker(
+                "search_queue", self._search_queue_depth, queue_threshold),
+            "cpu_usage": DuressTracker(
+                "cpu_usage", cpu_load_fn or _default_cpu_load,
+                cpu_threshold),
+        }
+        self._lock = threading.Lock()
+        self._streak = 0
+        self._forced_duress = 0        # testing seam (fault injection)
+        self._last_tick = None
+        self.cancellation_count = 0
+        self.monitor_only_count = 0
+        self.limit_reached_count = 0
+        self._tracker_cancellations = {"cpu_usage": 0, "heap_usage": 0,
+                                       "elapsed_time": 0}
+        self.admission = SearchAdmissionController(
+            self, max_concurrent=max_concurrent_searches)
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    # -- settings (dynamic _cluster/settings consumers land here) ---------
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    def set_mode(self, mode: str) -> None:
+        if mode not in MODES:
+            raise OpenSearchTpuError(
+                f"Invalid SearchBackpressureMode: {mode}")
+        self._mode = mode
+
+    def set_max_concurrent_searches(self, n: int) -> None:
+        self.admission.max_concurrent = int(n)
+
+    def set_cpu_threshold(self, v: float) -> None:
+        self.trackers["cpu_usage"].threshold = float(v)
+
+    def set_heap_threshold(self, v: float) -> None:
+        self.trackers["heap_usage"].threshold = float(v)
+
+    def set_queue_threshold(self, v: int) -> None:
+        self.trackers["search_queue"].threshold = float(v)
+
+    def set_num_successive_breaches(self, v: int) -> None:
+        self.num_successive_breaches = int(v)
+
+    # -- duress evaluation -------------------------------------------------
+
+    def _search_queue_depth(self) -> float:
+        if self.thread_pool is None:
+            return 0.0
+        try:
+            return float(self.thread_pool.executor("search").stats()["queue"])
+        except OpenSearchTpuError:
+            return 0.0
+
+    def force_duress(self, ticks: int = 1) -> None:
+        """Deterministic duress simulation: the next ``ticks``
+        evaluations read as in-duress regardless of the real probes
+        (used by testing/fault_injection.py)."""
+        with self._lock:
+            self._forced_duress = int(ticks)
+
+    def in_duress(self) -> bool:
+        """Did the breach streak reach the configured threshold?"""
+        with self._lock:
+            return self._streak >= self.num_successive_breaches
+
+    def maybe_tick(self) -> None:
+        """Run at most one evaluation per ``interval_s`` — the pacing the
+        admission path gives the monitor without a dedicated thread."""
+        now = self._clock()
+        with self._lock:
+            if (self._last_tick is not None
+                    and now - self._last_tick < self.interval_s):
+                return
+            self._last_tick = now
+        self.run_once()
+
+    def run_once(self) -> dict:
+        """One monitor evaluation: update duress streak; under sustained
+        duress rank the cancellable search tasks by resource usage and
+        act per mode.  Returns what happened (for tests/logs)."""
+        if self._mode == "disabled":
+            return {"duress": False, "cancelled": []}
+        with self._lock:
+            if self._forced_duress > 0:
+                self._forced_duress -= 1
+                breached = True
+            else:
+                breached = False
+        if not breached:
+            breached = any(t.check() for t in self.trackers.values())
+        with self._lock:
+            self._streak = self._streak + 1 if breached else 0
+            if self._streak < self.num_successive_breaches:
+                return {"duress": False, "cancelled": []}
+        victims = self._eligible_tasks()
+        cancelled = []
+        for task, dominant in victims[: self.max_cancellations_per_tick]:
+            if self._mode == "monitor_only":
+                with self._lock:
+                    self.monitor_only_count += 1
+                continue
+            if not self._bucket.request():
+                with self._lock:
+                    self.limit_reached_count += 1
+                continue
+            task.cancel(
+                "cancelled by search backpressure: node under duress, "
+                f"task exceeded [{dominant}] threshold "
+                f"(cpu={task.cpu_time_nanos}ns, "
+                f"heap={task.heap_bytes}b)")
+            with self._lock:
+                self.cancellation_count += 1
+                self._tracker_cancellations[dominant] += 1
+            cancelled.append(task)
+        from opensearch_tpu.common.telemetry import metrics
+        if cancelled:
+            metrics().counter("search_backpressure.cancellations").inc(
+                len(cancelled))
+        return {"duress": True, "cancelled": cancelled}
+
+    def _eligible_tasks(self) -> list:
+        """(task, dominant-tracker) pairs over every cancellable,
+        not-yet-cancelled search task exceeding a per-task resource
+        threshold, most expensive first (the reference's
+        TaskResourceUsageTrackers election)."""
+        out = []
+        for t in self.task_manager.list():
+            if not t.cancellable or t.cancelled or not _is_search_task(t):
+                continue
+            cpu, heap, elapsed = (t.cpu_time_nanos, t.heap_bytes,
+                                  t.elapsed_nanos)
+            over = []
+            if cpu >= self.task_cpu_nanos_threshold:
+                over.append(("cpu_usage", cpu / self.task_cpu_nanos_threshold))
+            if heap >= self.task_heap_bytes_threshold:
+                over.append(("heap_usage",
+                             heap / self.task_heap_bytes_threshold))
+            if elapsed >= self.task_elapsed_nanos_threshold:
+                over.append(("elapsed_time",
+                             elapsed / self.task_elapsed_nanos_threshold))
+            if not over:
+                continue
+            # dominant tracker = largest relative overshoot; rank tasks
+            # by that same measure so "the top resource consumer" is
+            # well defined and deterministic
+            dominant, score = max(over, key=lambda kv: kv[1])
+            out.append((score, t.id, t, dominant))
+        out.sort(key=lambda e: (-e[0], e[1]))
+        return [(t, dominant) for _s, _id, t, dominant in out]
+
+    # -- background monitor (optional; tests drive run_once directly) -----
+
+    def start_monitor(self) -> None:
+        if self._monitor is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.run_once()
+                except Exception:  # noqa: BLE001 — monitor must survive
+                    pass
+        self._monitor = threading.Thread(
+            target=loop, name="search-backpressure-monitor", daemon=True)
+        self._monitor.start()
+
+    def stop_monitor(self) -> None:
+        monitor, self._monitor = self._monitor, None
+        if monitor is not None:
+            self._stop.set()
+            monitor.join(timeout=5)
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        # admission stats gather BEFORE taking the service lock: the
+        # admission gate's acquire() path holds its own lock while it
+        # consults in_duress() (service lock) — taking the locks in the
+        # opposite order here would deadlock
+        admission_stats = self.admission.stats()
+        with self._lock:
+            return {
+                "mode": self._mode,
+                "cancellation_count": self.cancellation_count,
+                "monitor_only_count": self.monitor_only_count,
+                "limit_reached_count": self.limit_reached_count,
+                "node_duress": {
+                    "streak": self._streak,
+                    "in_duress": (self._streak
+                                  >= self.num_successive_breaches),
+                    "trackers": {name: t.stats()
+                                 for name, t in self.trackers.items()},
+                },
+                "search_task": {
+                    "resource_tracker_cancellations":
+                        dict(self._tracker_cancellations),
+                    "thresholds": {
+                        "cpu_time_nanos": self.task_cpu_nanos_threshold,
+                        "heap_bytes": self.task_heap_bytes_threshold,
+                        "elapsed_time_nanos":
+                            self.task_elapsed_nanos_threshold,
+                    },
+                },
+                "admission_control": admission_stats,
+            }

@@ -573,11 +573,18 @@ class ShardSearcher:
             yield seg, dseg, scores, matched
 
     def _merge_topk(self, per_seg, k_want, total, max_score):
+        from opensearch_tpu.common.tasks import charge_current
+
         if not per_seg:
             return [], 0, None
         scores = np.concatenate([p[0] for p in per_seg])
         segi = np.concatenate([p[1] for p in per_seg])
         local = np.concatenate([p[2] for p in per_seg])
+        # the host-side merge buffers are this task's transient heap:
+        # charged to the request breaker (released at task unregister)
+        # so the backpressure service can rank queries by real cost
+        charge_current(scores.nbytes + segi.nbytes + local.nbytes,
+                       "search top-k merge")
         order = np.lexsort((local, segi, -scores))[:k_want]
         rows = [{"seg": int(segi[i]), "local": int(local[i]),
                  "score": float(scores[i])} for i in order]
@@ -924,6 +931,11 @@ class ShardSearcher:
         order = np.lexsort((local, segi, -sc))
         rows = [{"seg": int(segi[i]), "local": int(local[i]),
                  "score": float(sc[i])} for i in order]
+        # full-materialization cost (scroll creation) attributed to the
+        # owning task — the rows themselves move to the ScrollContext's
+        # own breaker reservation when a context adopts them
+        from opensearch_tpu.common.tasks import charge_current
+        charge_current(len(rows) * 96, "scan rows")
         return rows, total
 
 

@@ -110,6 +110,22 @@ class _Duplicate(_Rule):
         return Directive(copies=self.copies)
 
 
+class _Stall(_Rule):
+    """Hold matching frames on an Event instead of a wall-clock delay:
+    the frame is delivered the instant ``release()`` fires — the
+    deterministic slow-node primitive (no sleeps, no timing slop)."""
+
+    def __init__(self, *a):
+        super().__init__(*a)
+        self.gate = threading.Event()
+
+    def act(self, src, dst):
+        return Directive(gate=self.gate)
+
+    def release(self):
+        self.gate.set()
+
+
 class FaultInjector:
     """Installs/uninstalls rules on a ``LocalTransport.Hub``; every
     random draw comes from one seeded stream guarded by a lock, so a
@@ -157,6 +173,22 @@ class FaultInjector:
         hazard handlers must tolerate (idempotency probes)."""
         return self._install(_Duplicate(self, action, source, target,
                                         probability, times, copies=copies))
+
+    def stall(self, action: str = "*", source: Optional[str] = None,
+              target: Optional[str] = None, probability: float = 1.0,
+              times: Optional[int] = None) -> _Stall:
+        """Hold matching frames until the returned rule's ``release()``
+        is called (delivery is event-driven, not timed)."""
+        return self._install(_Stall(self, action, source, target,
+                                    probability, times))
+
+    def induce_search_duress(self, service, ticks: int = 1) -> None:
+        """Deterministic duress simulation: force the given
+        SearchBackpressureService's next ``ticks`` evaluations to read
+        as node-in-duress, bypassing the real probes — the fault
+        harness's answer to 'make this node overloaded NOW' without
+        burning real CPU or heap."""
+        service.force_duress(ticks)
 
     def disconnect(self, node_id: str):
         """Full partition: everything to/from ``node_id`` fails fast."""

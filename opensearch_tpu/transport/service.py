@@ -322,14 +322,18 @@ class Directive:
     """What a hub rule may return: pass the frame along after ``delay``
     seconds, delivered ``copies`` times (0 = silently swallow — the
     drop-without-error variant; raising from the rule keeps meaning
-    drop-with-send-error).  Plain floats still mean delay-only, so old
-    rules keep working."""
+    drop-with-send-error).  ``gate`` is a ``threading.Event`` the
+    delivery thread waits on first (bounded) — the deterministic "hold
+    this frame until the test says so" stall used by the fault-injection
+    harness.  Plain floats still mean delay-only, so old rules keep
+    working."""
 
-    __slots__ = ("delay", "copies")
+    __slots__ = ("delay", "copies", "gate")
 
-    def __init__(self, delay: float = 0.0, copies: int = 1):
+    def __init__(self, delay: float = 0.0, copies: int = 1, gate=None):
         self.delay = float(delay)
         self.copies = int(copies)
+        self.gate = gate
 
 
 class LocalTransport(Transport):
@@ -383,6 +387,7 @@ class LocalTransport(Transport):
     def send(self, source: str, target: str, frame: bytes):
         delay = 0.0
         copies = 1
+        gates = []
         with self.hub.lock:
             rules = list(self.hub.rules)
         for rule in rules:
@@ -391,6 +396,8 @@ class LocalTransport(Transport):
                 delay = max(delay, d.delay)
                 copies = (0 if 0 in (copies, d.copies)
                           else max(copies, d.copies))
+                if d.gate is not None:
+                    gates.append(d.gate)
             elif d:
                 delay = max(delay, float(d))
         svc = self.hub.nodes.get(target)
@@ -400,11 +407,14 @@ class LocalTransport(Transport):
             return                       # swallowed: caller times out
 
         def deliver():
+            for g in gates:
+                g.wait(timeout=30.0)     # fault-injection stall gate
             if delay:
                 time.sleep(delay)
             for _ in range(copies):
                 svc.handle_frame(source, frame[6:])   # strip marker+len
-        threading.Thread(target=deliver, daemon=True).start()
+        threading.Thread(target=deliver, daemon=True,
+                         name=f"local-deliver-{source}-{target}").start()
 
     def close(self, node_id: str):
         with self.hub.lock:

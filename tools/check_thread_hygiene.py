@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Lint: every thread must be named and have an explicit daemon policy.
+
+An anonymous ``threading.Thread(...)`` shows up in ``_nodes/hot_threads``
+as ``Thread-37`` — useless for attributing a wedged node — and a thread
+whose daemon flag was never decided either blocks interpreter shutdown
+(non-daemon default) or silently dies mid-write (daemon) depending on
+what the author forgot.  Every ``threading.Thread(...)`` construction in
+``opensearch_tpu/`` must therefore pass BOTH ``name=`` and ``daemon=``
+explicitly, or carry a ``# thread-ok`` annotation on the same line or
+the line above asserting a human decided the defaults are right.
+
+Sibling of ``check_monotonic.py`` / ``check_sleep_loops.py`` /
+``check_ad_hoc_caches.py``; new un-annotated sites fail tier-1
+(tests/test_backpressure.py runs this check).
+
+Usage: python tools/check_thread_hygiene.py [root]   (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ANNOTATION = "# thread-ok"
+
+
+def _thread_calls(tree: ast.AST) -> list[tuple[int, set[str]]]:
+    """(lineno, keyword-names) for every Thread(...) construction."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        is_thread = (isinstance(fn, ast.Attribute) and fn.attr == "Thread") \
+            or (isinstance(fn, ast.Name) and fn.id == "Thread")
+        if not is_thread:
+            continue
+        out.append((node.lineno,
+                    {kw.arg for kw in node.keywords if kw.arg}))
+    return out
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+    lines = src.splitlines()
+    problems = []
+    for lineno, kwargs in _thread_calls(tree):
+        missing = {"name", "daemon"} - kwargs
+        if not missing:
+            continue
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        prev = lines[lineno - 2] if lineno >= 2 else ""
+        if ANNOTATION in line or ANNOTATION in prev:
+            continue
+        problems.append(
+            f"{path}:{lineno}: threading.Thread(...) without explicit "
+            f"{sorted(missing)} — name threads for hot_threads "
+            "attribution and decide the daemon policy, or annotate "
+            f"with '{ANNOTATION}'")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    root = argv[1] if len(argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "opensearch_tpu")
+    problems = []
+    for dirpath, _dirs, files in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for fname in sorted(files):
+            if fname.endswith(".py"):
+                problems.extend(check_file(os.path.join(dirpath, fname)))
+    for p in problems:
+        print(p)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
