@@ -225,6 +225,26 @@ def main():
     from opensearch_tpu.mapping.mapper import DocumentMapper
     from opensearch_tpu.search.executor import ShardSearcher
 
+    def hot_path_counters():
+        """Compile/prune behavior for the phase lines: plan-cache reuse,
+        block-max pruning, and live XLA program counts (a growing
+        program count across reps == retracing in the hot path)."""
+        from opensearch_tpu.common.telemetry import metrics
+        from opensearch_tpu.search import batch as batch_mod
+        from opensearch_tpu.search import plan as plan_mod
+
+        m = metrics()
+        return {
+            "plan_cache_hits": m.counter("search.plan_cache.hits").value,
+            "plan_cache_misses":
+                m.counter("search.plan_cache.misses").value,
+            "segments_pruned":
+                m.counter("search.segments_pruned").value,
+            "batched_programs":
+                batch_mod.batch_impact_union_topk._cache_size(),
+            "seq_programs": plan_mod.run_topk._cache_size(),
+        }
+
     seg = make_segment(raw)
     mapper = DocumentMapper({"properties": {"body": {"type": "text"}}})
     searcher = ShardSearcher([seg], mapper, index_name="bench")
@@ -253,7 +273,8 @@ def main():
     phase_report("batched", {
         "platform": platform, "qps": round(qps, 1), "batch": batch,
         "compile_s": round(compile_s, 1),
-        "vs_baseline": round(qps / baseline_qps, 3)})
+        "vs_baseline": round(qps / baseline_qps, 3),
+        **hot_path_counters()})
 
     # -- phase: sequential (latency path; ~4 budget-bucket compiles) ------
     t0 = time.monotonic()
@@ -274,7 +295,8 @@ def main():
     p99 = float(np.percentile(lat_ms, 99))
     phase_report("sequential", {
         "platform": platform, "qps": round(qps_seq, 1),
-        "p50_ms": round(p50, 3), "p99_ms": round(p99, 3)})
+        "p50_ms": round(p50, 3), "p99_ms": round(p99, 3),
+        **hot_path_counters()})
 
     print(json.dumps(final_line(
         qps=qps, baseline_qps=baseline_qps, platform=platform,

@@ -226,6 +226,58 @@ class Segment:
     def source(self, local_id: int) -> dict:
         return json.loads(self.sources[local_id])
 
+    def impact_table(self, field: str, avgdl: float,
+                     k1: float = 1.2, b: float = 0.75):
+        """Host-side per-posting BM25 impacts + per-term BLOCK-MAX
+        metadata for ``field``, as ``(impacts f32 [P], max f32 [T])``.
+
+        ``impacts[p] = tf/(tf + k1*(1-b + b*dl/avgdl))`` — the eager
+        BM25S precompute; the float32 operation order matches
+        ``ops/bm25.py::compute_impacts`` bit-for-bit so the host and
+        device scoring paths produce identical scores.  ``max[t]`` is
+        the segment-block maximum per term (the BMW/MaxScore
+        upper-bound table of the reference's ``ImpactsEnum``, ref
+        org.apache.lucene.index.Impacts), consumed by
+        ``plan.max_score_bound`` to skip segments that provably cannot
+        beat a min_score / running top-k threshold.
+
+        Keyed by (field, avgdl): a refresh/merge changes the shard
+        avgdl through the reader-generation bump, so stale tables stop
+        being requested and LRU out."""
+        pf = self.postings.get(field)
+        if pf is None:
+            return None
+        from opensearch_tpu.common.cache import attached_cache
+        cache = attached_cache(self, "_impact_table_cache",
+                               name="segment.impact_table",
+                               max_weight=256 << 20, breaker="fielddata")
+        key = (field, float(np.float32(avgdl)), k1, b)
+        out = cache.get(key)
+        if out is None:
+            T = len(pf.offsets) - 1
+            imp = np.zeros(0, dtype=np.float32)
+            mx = np.zeros(T, dtype=np.float32)
+            if len(pf.tfs):
+                dl = pf.doc_lens[pf.doc_ids]
+                norm = np.float32(k1) * (np.float32(1.0 - b)
+                                         + np.float32(b) * dl
+                                         / np.float32(avgdl))
+                imp = (pf.tfs / (pf.tfs + norm)).astype(np.float32)
+                lens = np.diff(pf.offsets)
+                starts = np.minimum(pf.offsets[:-1], len(imp) - 1)
+                mx = np.where(lens > 0,
+                              np.maximum.reduceat(imp, starts),
+                              np.float32(0.0))
+            out = (imp, mx)
+            cache.put(key, out)
+        return out
+
+    def max_impacts(self, field: str, avgdl: float,
+                    k1: float = 1.2, b: float = 0.75):
+        """Per-term block-max impacts (see ``impact_table``)."""
+        table = self.impact_table(field, avgdl, k1, b)
+        return None if table is None else table[1]
+
     def device(self) -> "DeviceSegment":
         if self._device is None:
             self._device = DeviceSegment(self)
@@ -344,6 +396,36 @@ class DeviceSegment:
         self._live_cache: dict[int, object] = {}  # with its PIT searcher
         self._ann_staged: dict[int, tuple] = {}
         self.live = self.live_jnp(seg.live)
+
+    def impacts(self, field: str, avgdl: float):
+        """Staged per-posting BM25 impact column for ``field``, indexed
+        exactly like ``postings[field]["tfs"]`` (padded slots are 0).
+
+        Staged from the HOST impact table (``Segment.impact_table``) so
+        the device scoring path and the CPU-backend host fast path read
+        bit-identical impacts, and cached per (field, avgdl).  avgdl is
+        the only query-time input: a refresh/merge that changes it does
+        so through the reader-generation bump (new searcher, new
+        ShardContext stats), so the old keys stop being requested and
+        LRU out — staleness is structurally impossible."""
+        p = self.postings.get(field)
+        from opensearch_tpu.common.cache import attached_cache
+        cache = attached_cache(self, "_impact_cache",
+                               name="segment.impacts",
+                               max_weight=256 << 20, breaker="fielddata")
+        key = (field, float(np.float32(avgdl)))
+        imp = cache.get(key)
+        if imp is None:
+            import jax.numpy as jnp
+            if p is None:
+                imp = jnp.zeros(8, jnp.float32)
+            else:
+                host_imp, _mx = self.seg.impact_table(field, avgdl)
+                padded = np.zeros(p["tfs"].shape[0], np.float32)
+                padded[: len(host_imp)] = host_imp
+                imp = jnp.asarray(padded)
+            cache.put(key, imp)
+        return imp
 
     def nested_staged(self, path: str) -> Optional[dict]:
         """Padded device arrays for one nested block (lazy, cached)."""

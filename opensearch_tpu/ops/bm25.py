@@ -8,11 +8,16 @@ data-parallel program over the whole segment:
     CSR gather of the query terms' postings  ->  BM25 per posting
     ->  scatter-add into a dense per-doc score vector  ->  lax.top_k
 
-No pruning is needed: scoring *every* posting of the query terms is a
-handful of fused HBM-bandwidth-bound ops, and ``top_k`` replaces the
-priority queue.  This is the BM25S formulation (see PAPERS.md) with
-query-time idf so scores stay consistent across segments (Lucene computes
-collection-wide stats in IndexSearcher, not per segment).
+This is the BM25S formulation (see PAPERS.md): the tf-side factor
+``tf / (tf + k1*(1-b + b*dl/avgdl))`` depends only on segment data plus
+the shard-level ``avgdl``, so it is eagerly precomputed ONCE per
+(field, avgdl) into a per-posting ``impacts`` column
+(``compute_impacts``, staged by ``DeviceSegment.impacts``).  Query-time
+scoring then degenerates to gather + weighted scatter-add — no per-query
+norm arithmetic, no ``doc_lens`` gather.  Query-time global ``idf``
+stays a multiplier so scores remain exactly consistent across segments
+(Lucene computes collection-wide stats in IndexSearcher, not per
+segment).
 
 All functions here are pure jnp and shape-static; the search executor
 composes and ``jit``s them with bucketed shapes.
@@ -21,19 +26,58 @@ composes and ``jit``s them with bucketed shapes.
 from __future__ import annotations
 
 import math
+from functools import partial
 
 import opensearch_tpu.common.jaxenv  # noqa: F401
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 K1_DEFAULT = 1.2
 B_DEFAULT = 0.75
 
+# Backend-specialized lowering for the scored term-bag hot path.
+# XLA:CPU lowers scatter-add to a scalar loop (~50ns/update measured on
+# avx512 hosts whose tuning carries prefer-no-scatter), which makes the
+# per-posting score accumulation 10-25x slower than the same placement
+# as a vectorized host fancy-index add.  On the CPU backend the term-bag
+# top-k therefore runs host-side over the SAME precomputed impact table
+# (Segment.impact_table — bit-identical to the staged device column);
+# accelerator backends keep the XLA kernels.  None = decide from the
+# active backend; tests force True/False to exercise either path.
+HOST_SCORING = None
+_HOST_AUTO = None
+
+
+def host_scoring_enabled() -> bool:
+    if HOST_SCORING is not None:
+        return bool(HOST_SCORING)
+    global _HOST_AUTO
+    if _HOST_AUTO is None:
+        _HOST_AUTO = jax.default_backend() == "cpu"
+    return _HOST_AUTO
+
 
 def idf(df: int, n_docs: int) -> float:
     """Lucene BM25Similarity idf: ln(1 + (N - df + 0.5) / (df + 0.5))."""
     return math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
+
+
+@partial(jax.jit, static_argnames=("k1", "b"))
+def compute_impacts(tfs, doc_ids, doc_lens, avgdl, *,
+                    k1: float = K1_DEFAULT, b: float = B_DEFAULT):
+    """Per-posting BM25 impact ``tf / (tf + k1*(1-b + b*dl/avgdl))``.
+
+    Everything here is segment data except ``avgdl`` (shard-level, a
+    traced scalar so a stats change never recompiles).  Padded posting
+    slots carry tf=0 and decode to impact 0.  float32 end to end — the
+    score-parity tests pin this expression bitwise, so keep the
+    operation order in sync with the numpy reference in
+    tests/test_impacts.py."""
+    dl = doc_lens[doc_ids]
+    norm = k1 * (1.0 - b + b * dl / avgdl)
+    return tfs / (tfs + norm)
 
 
 def gather_postings(offsets, doc_ids, tfs, term_ids, term_active, *,
@@ -104,6 +148,40 @@ def bm25_score_count(offsets, doc_ids, tfs, doc_lens, term_ids, term_active,
     contrib = idfs[slot] * weights[slot] * tf / (tf + norm)
     scores = jnp.zeros(n_pad, jnp.float32).at[d].add(
         jnp.where(valid, contrib, 0.0))
+    return scores, count
+
+
+def impact_scores(offsets, doc_ids, impacts, term_ids, term_active,
+                  idfs, weights, *, n_pad: int, budget: int):
+    """Dense per-doc BM25 scores from PRECOMPUTED impacts: pure gather +
+    weighted scatter-add, no norm recomputation.  ``impacts`` is the
+    staged per-posting column (``compute_impacts``), indexed exactly
+    like ``tfs``.  Fast path for required<=1 bags with positive
+    weights: score > 0 iff the doc matched, so no count scatter runs."""
+    d, imp, slot, valid = gather_postings(
+        offsets, doc_ids, impacts, term_ids, term_active,
+        budget=budget, pad_doc=n_pad - 1)
+    base = idfs[slot] * imp
+    contrib = jnp.where(valid, weights[slot] * base, 0.0)
+    return jnp.zeros(n_pad, jnp.float32).at[d].add(contrib)
+
+
+def impact_score_count(offsets, doc_ids, impacts, term_ids, term_active,
+                       idfs, weights, *, n_pad: int, budget: int,
+                       scored: bool):
+    """Impact-path variant of ``bm25_score_count``: one gather, score
+    scatter from precomputed impacts + matched-slot count scatter (AND /
+    minimum_should_match semantics).  With ``scored=False`` only the
+    count scatter runs (filter context)."""
+    d, imp, slot, valid = gather_postings(
+        offsets, doc_ids, impacts, term_ids, term_active,
+        budget=budget, pad_doc=n_pad - 1)
+    count = jnp.zeros(n_pad, jnp.int32).at[d].add(valid.astype(jnp.int32))
+    if not scored:
+        return jnp.zeros(n_pad, jnp.float32), count
+    base = idfs[slot] * imp
+    contrib = jnp.where(valid, weights[slot] * base, 0.0)
+    scores = jnp.zeros(n_pad, jnp.float32).at[d].add(contrib)
     return scores, count
 
 

@@ -578,7 +578,18 @@ class RestController:
                 "name": self.node.name,
                 "indices": {"docs": {"count": sum(
                     s.doc_count() for s in indices.values())},
-                    "request_cache": request_cache().stats()},
+                    "request_cache": request_cache().stats(),
+                    # query-hot-path observability: compiled-plan reuse
+                    # and block-max segment pruning (PR-1 registry
+                    # counters fed by ShardSearcher)
+                    "search": {
+                        "plan_cache": {
+                            "hits": metrics().counter(
+                                "search.plan_cache.hits").value,
+                            "misses": metrics().counter(
+                                "search.plan_cache.misses").value},
+                        "segments_pruned": metrics().counter(
+                            "search.segments_pruned").value}},
                 "breakers": breaker_service().stats(),
                 "tasks": {"count": len(self.node.task_manager.list())},
                 "thread_pool": self.node.thread_pool.stats(),

@@ -2,17 +2,46 @@
 
 The reference gets throughput from many concurrent search threads each
 running the doc-at-a-time hot loop (ContextIndexSearcher.java:318 under
-the ``search`` threadpool).  The TPU equivalent is batching: a [Q, T]
-block of term-bag queries is one vmapped gather->score->scatter->top_k
-program — a single dispatch amortizes host<->device latency (decisive
-when the chip sits behind a tunnel) and keeps the MXU/VPU busy with
-wide, regular work instead of Q tiny kernels.
+the ``search`` threadpool).  The TPU equivalent is batching: a block of
+term-bag queries is one gather->score->scatter->top_k program — a single
+dispatch amortizes host<->device latency (decisive when the chip sits
+behind a tunnel) and keeps the MXU/VPU busy with wide, regular work
+instead of Q tiny kernels.
 
 Served via ``ShardSearcher.msearch`` (the ``_msearch`` REST analog, ref
 action/search/TransportMultiSearchAction.java): bodies that compile to a
 plain scored term-bag (match / term / multi-term OR-AND) take the batched
 kernel; anything else falls back to the sequential path per body —
-semantics are identical either way (same kernels, same tie-breaks).
+semantics are identical either way (same impacts, same tie-breaks).
+
+Round-6 kernel shape (impact-ordered scoring): the round-5 kernel
+scattered per-posting BM25 into a dense ``[n_pad, T]`` doc x term matrix
+and ran TWO ``[Q,T] @ [T,n_pad]`` einsums (scores + AND counts) — the
+memory-bound core of the whole path (the 2-D scatter alone was ~60% of
+batch wall time on CPU).  Now:
+
+  1. gather the PRECOMPUTED impacts of the batch's distinct terms once
+     (``DeviceSegment.impacts`` — no per-posting norm math, no doc_lens
+     gather);
+  2. ONE flat 1-D scatter-add of ``idf * impact`` into a
+     ``[T * n_pad]`` arena (a 1-D scatter is ~6x cheaper than the same
+     updates through a 2-D index);
+  3. per-query-term weighted ROW gathers accumulate straight into the
+     ``[Q, n_pad]`` score block — each query touches only its OWN few
+     term rows (contiguous, cache-friendly) instead of a [Q,T]x[T,n]
+     matmul over the whole union;
+  4. the matched-count side is built the same way, and is SKIPPED
+     entirely (static flag) when every query in the group is a plain OR
+     bag — scores > 0 is then exactly the match mask;
+  5. batched ``lax.top_k`` over [Q, n_pad].
+
+Accumulation order per (query, doc) equals the sequential kernel's
+(term order within the query), so batched and sequential scores are
+byte-identical — the property tests/test_impacts.py pins.
+
+Group inputs (union slots, per-query term rows) are cached on the
+searcher keyed by the group's value signature, so a REPEATED msearch
+batch does zero host-side assembly.
 """
 
 from __future__ import annotations
@@ -26,6 +55,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from opensearch_tpu.common.telemetry import metrics as _metrics
 from opensearch_tpu.index.segment import pad_bucket, pad_pow2
 from opensearch_tpu.ops import bm25 as bm25_ops
 
@@ -33,61 +63,50 @@ _I32 = np.int32
 _F32 = np.float32
 
 
-@partial(jax.jit, static_argnames=("n_pad", "budget", "k"))
-def batch_bm25_union_topk(offsets, doc_ids, tfs, doc_lens, live,
-                          union_tids, union_active, union_idfs,
-                          weights, act, required, avgdl,
-                          *, n_pad: int, budget: int, k: int):
+@partial(jax.jit, static_argnames=("n_pad", "budget", "k", "need_counts"))
+def batch_impact_union_topk(offsets, doc_ids, impacts, live,
+                            union_tids, union_active, union_idfs,
+                            qslots, qweights, qact, required,
+                            *, n_pad: int, budget: int, k: int,
+                            need_counts: bool):
     """Score Q term-bag queries against one segment in ONE program via
-    the union-of-terms formulation.
-
-    The naive vmap (round 4) gathered every query's postings separately,
-    so a 64-query batch either compiled one program per budget bucket
-    (compile explosion) or paid the heaviest query's gather budget 64
-    times (work explosion — the r4 throughput inversion).  Instead:
-
-      1. gather the postings of the ~T DISTINCT terms of the whole batch
-         once (``budget`` >= sum of their dfs — each posting touched once
-         per batch, not once per query);
-      2. scatter per-posting BM25 base scores idf*tf/(tf+norm) into a
-         dense [n_pad, T] doc x term matrix;
-      3. one [Q,T] @ [T,n_pad] matmul applies every query's term weights
-         — exactly the shape the MXU wants — and a second matmul over the
-         presence matrix counts matched terms for AND /
-         minimum_should_match semantics;
-      4. batched ``lax.top_k`` over [Q, n_pad].
-
-    ``union_tids``/``union_active``/``union_idfs`` are [T]; ``weights``
-    (boost-scaled, accumulated over duplicate query terms) and ``act``
-    (occurrence counts, so duplicated terms still satisfy AND) are
-    [Q, T]; ``required`` is [Q].  Returns (vals [Q, k], idx [Q, k],
-    totals [Q], maxes [Q]).
-    """
-    d, tf, slot, valid = bm25_ops.gather_postings(
-        offsets, doc_ids, tfs, union_tids, union_active,
+    the union-of-terms + precomputed-impacts formulation (see module
+    docstring).  ``union_tids``/``union_active``/``union_idfs`` are [T];
+    ``qslots``/``qweights``/``qact`` are [Q, TQ] — query q's j-th term
+    as a union slot, its boost weight, and its occurrence count (0 on
+    padding, so duplicate terms keep satisfying AND); ``required`` is
+    [Q] (inf on padding rows).  Returns (vals [Q, k], idx [Q, k],
+    totals [Q], maxes [Q])."""
+    d, imp, slot, valid = bm25_ops.gather_postings(
+        offsets, doc_ids, impacts, union_tids, union_active,
         budget=budget, pad_doc=n_pad - 1)
-    dl = doc_lens[d]
-    norm = bm25_ops.K1_DEFAULT * (1.0 - bm25_ops.B_DEFAULT
-                                  + bm25_ops.B_DEFAULT * dl / avgdl)
-    base = union_idfs[slot] * tf / (tf + norm)
+    base = jnp.where(valid, union_idfs[slot] * imp, 0.0)
     t_pad = union_tids.shape[0]
-    dense = jnp.zeros((n_pad, t_pad), jnp.float32).at[d, slot].add(
-        jnp.where(valid, base, 0.0))
-    pres = jnp.zeros((n_pad, t_pad), jnp.float32).at[d, slot].add(
-        jnp.where(valid, 1.0, 0.0))
-    scores = jnp.einsum("qt,nt->qn", weights, dense,
-                        preferred_element_type=jnp.float32)
-    counts = jnp.einsum("qt,nt->qn", act,
-                        (pres > 0).astype(jnp.float32),
-                        preferred_element_type=jnp.float32)
-    matched = (counts >= required[:, None].astype(jnp.float32)) & live[None, :]
+    flat_idx = slot.astype(jnp.int64) * n_pad + d
+    dense = jnp.zeros(t_pad * n_pad, jnp.float32).at[flat_idx].add(
+        base).reshape(t_pad, n_pad)
+    q_pad, tq = qslots.shape
+    scores = jnp.zeros((q_pad, n_pad), jnp.float32)
+    for j in range(tq):
+        scores = scores + qweights[:, j: j + 1] * dense[qslots[:, j], :]
+    if need_counts:
+        pres = jnp.zeros(t_pad * n_pad, jnp.float32).at[flat_idx].add(
+            valid.astype(jnp.float32)).reshape(t_pad, n_pad)
+        counts = jnp.zeros((q_pad, n_pad), jnp.float32)
+        for j in range(tq):
+            counts = counts + qact[:, j: j + 1] * jnp.minimum(
+                pres[qslots[:, j], :], 1.0)
+        matched = (counts >= required[:, None]) & live[None, :]
+    else:
+        # every query is a positive-weight OR bag: score > 0 iff matched
+        matched = (scores > 0.0) & live[None, :]
     key = jnp.where(matched, scores, -jnp.inf)
     vals, idx = lax.top_k(key, k)
     return vals, idx, matched.sum(axis=1), jnp.max(key, axis=1)
 
 
 class BatchGroup:
-    """Queries sharing (field, k) — batched into one [Q, T] program per
+    """Queries sharing (field, k) — batched into one program per
     segment."""
 
     def __init__(self, field: str, k: int):
@@ -105,35 +124,35 @@ class BatchGroup:
         self.idfs.append(np.asarray(bind["idfs"], _F32))
         self.weights.append(np.asarray(bind["weights"], _F32))
         self.required.append(int(bind["required"]))
+        self.avgdl = float(bind["avgdl"])
 
-    def run(self, searcher) -> dict:
-        """Execute against every segment; returns {pos: (rows, total,
-        max_score)} in the sequential path's row format.
+    def signature(self) -> tuple:
+        """Value identity of the batch: same signature -> identical
+        staged inputs (idfs/avgdl derive from the searcher's stats, and
+        the prep cache lives ON that searcher)."""
+        return (self.field, self.k, tuple(self.terms),
+                tuple(tuple(float(x) for x in w) for w in self.weights),
+                tuple(self.required))
 
-        The union-of-terms kernel (``batch_bm25_union_topk``) gathers
-        each DISTINCT term of the batch once per segment and scores all
-        queries with one matmul, so total gather work is the union of
-        the batch's postings — independent of Q — and the whole batch is
-        ONE XLA program per (t_pad, q_pad, budget, k).  Round-4's
-        per-query vmap paid either a compile per budget bucket or the
-        heaviest budget x Q in wasted gathers (the throughput
-        inversion)."""
+    def _prepare(self, searcher) -> dict:
+        """Host-side assembly of the per-segment union/query inputs —
+        everything that does NOT depend on the live bitmap, staged once
+        and reused for every identical batch against this searcher."""
         Q = len(self.positions)
-        k = self.k
-        avgdl = searcher.ctx.field_stats(self.field).avgdl
-        # device handles per segment LAUNCH; host-synced once at the end
-        # (4 D2H transfers per segment, not 4 per query per segment — the
-        # tunnel's RTT makes tiny per-query transfers the next bottleneck)
-        from opensearch_tpu.common.tasks import check_current
-
-        launches = []             # (seg_order, vals[Q,k], idx, tot, mx)
         q_pad = pad_pow2(Q, minimum=8)
+        tq = pad_pow2(max((len(t) for t in self.terms), default=1),
+                      minimum=1)
+        need_counts = any(r != 1 for r in self.required) \
+            or any((w <= 0).any() for w in self.weights) \
+            or any((i <= 0).any() for i in self.idfs)
+        req = np.full(q_pad, np.inf, _F32)   # padding rows match nothing
+        req[:Q] = self.required
+        req_j = jnp.asarray(req)
+        segs = []
+        pruned = 0
         for seg_order, seg in enumerate(searcher.segments):
-            check_current()    # cancellation point per segment program
-            dseg = seg.device()
             pf = seg.postings.get(self.field)
-            p = dseg.postings.get(self.field)
-            if pf is None or p is None:
+            if pf is None or seg.device().postings.get(self.field) is None:
                 continue
             # distinct terms of the whole batch -> union slots
             slot_of: dict[int, int] = {}
@@ -144,36 +163,144 @@ class BatchGroup:
                     if tid >= 0 and tid not in slot_of:
                         slot_of[tid] = len(slot_of)
                         budget += int(pf.df[tid])
+            if not slot_of:
+                # no query term exists in this segment: nothing can
+                # match, skip without staging or dispatch
+                pruned += 1
+                continue
             t_pad = pad_pow2(len(slot_of), minimum=8)
             union_tids = np.zeros(t_pad, _I32)
             union_active = np.zeros(t_pad, bool)
             union_idfs = np.zeros(t_pad, _F32)
-            weights = np.zeros((q_pad, t_pad), _F32)
-            act = np.zeros((q_pad, t_pad), _F32)
+            qslots = np.zeros((q_pad, tq), _I32)
+            qweights = np.zeros((q_pad, tq), _F32)
+            qact = np.zeros((q_pad, tq), _F32)
             for tid, si in slot_of.items():
                 union_tids[si] = tid
                 union_active[si] = True
             for qi, terms in enumerate(self.terms):
+                j = 0
                 for ti, t in enumerate(terms):
                     tid = pf.term_id(t)
                     if tid < 0:
                         continue
                     si = slot_of[tid]
-                    union_idfs[si] = self.idfs[qi][ti]   # idf is per term
-                    weights[qi, si] += self.weights[qi][ti]
-                    act[qi, si] += 1.0   # occurrence count: duplicate
-                    # terms keep satisfying AND (required counts slots)
+                    union_idfs[si] = self.idfs[qi][ti]  # idf is per term
+                    qslots[qi, j] = si
+                    qweights[qi, j] = self.weights[qi][ti]
+                    qact[qi, j] = 1.0   # occurrences: duplicate terms
+                    j += 1              # keep satisfying AND
+            segs.append((seg_order, {
+                "union_tids": jnp.asarray(union_tids),
+                "union_active": jnp.asarray(union_active),
+                "union_idfs": jnp.asarray(union_idfs),
+                "qslots": jnp.asarray(qslots),
+                "qweights": jnp.asarray(qweights),
+                "qact": jnp.asarray(qact),
+                "budget": pad_bucket(budget),
+            }))
+        if pruned:
+            _metrics().counter("search.segments_pruned").inc(pruned)
+        return {"need_counts": need_counts, "required": req_j,
+                "segs": segs, "q_pad": q_pad}
+
+    def _bind(self, qi: int) -> dict:
+        return {"terms": self.terms[qi], "idfs": self.idfs[qi],
+                "weights": self.weights[qi],
+                "required": self.required[qi], "avgdl": self.avgdl}
+
+    def _run_host(self, searcher) -> dict:
+        """CPU-backend batch execution: every query scores host-side
+        via ``TermBagPlan.host_topk`` over the shared per-segment impact
+        tables — byte-identical to the sequential path by construction
+        (same function, same accumulation order).  See ops/bm25.py
+        ``host_scoring_enabled`` for why XLA:CPU scatter loses to the
+        host here."""
+        from opensearch_tpu.common.tasks import check_current
+        from opensearch_tpu.search.plan import TermBagPlan
+
+        plan = TermBagPlan(field=self.field, scored=True)
+        acc = {pos: {"v": [], "s": [], "l": [], "tot": 0, "mx": -np.inf}
+               for pos in self.positions}
+        pruned = 0
+        for seg_order, seg in enumerate(searcher.segments):
+            check_current()    # cancellation point per segment
+            pf = seg.postings.get(self.field)
+            if pf is None:
+                continue
+            if not any(pf.term_id(t) >= 0
+                       for terms in self.terms for t in terms):
+                # no query term exists here: skip without scoring
+                pruned += 1
+                continue
+            live = searcher.ctx.lives[id(seg)]
+            for qi, pos in enumerate(self.positions):
+                vals, idx, tot, mx = plan.host_topk(
+                    self._bind(qi), seg, live,
+                    min(self.k, seg.n_docs), None)
+                a = acc[pos]
+                a["v"].append(vals)
+                a["s"].append(np.full(len(vals), seg_order, _I32))
+                a["l"].append(idx)
+                a["tot"] += int(tot)
+                a["mx"] = max(a["mx"], float(mx))
+        if pruned:
+            _metrics().counter("search.segments_pruned").inc(pruned)
+        out = {}
+        for pos in self.positions:
+            a = acc[pos]
+            if not a["v"]:
+                out[pos] = ([], 0, None)
+                continue
+            v = np.concatenate(a["v"])
+            s = np.concatenate(a["s"])
+            l = np.concatenate(a["l"])
+            order = np.lexsort((l, s, -v))[: self.k]
+            rows = [{"seg": int(s[i]), "local": int(l[i]),
+                     "score": float(v[i])} for i in order]
+            out[pos] = (rows, a["tot"],
+                        None if a["mx"] == -np.inf else float(a["mx"]))
+        return out
+
+    def run(self, searcher) -> dict:
+        """Execute against every segment; returns {pos: (rows, total,
+        max_score)} in the sequential path's row format.
+
+        On the CPU backend the whole batch scores host-side
+        (``_run_host``).  Otherwise: device handles per segment LAUNCH;
+        host-synced once at the end (4 D2H transfers per segment, not 4
+        per query per segment — the tunnel's RTT makes tiny per-query
+        transfers the next bottleneck)."""
+        from opensearch_tpu.common.cache import attached_cache
+        from opensearch_tpu.common.tasks import check_current
+
+        if bm25_ops.host_scoring_enabled():
+            return self._run_host(searcher)
+        cache = attached_cache(searcher, "_batch_prep_cache",
+                               name="search.batch_prep",
+                               max_weight=64 << 20,
+                               breaker="fielddata")
+        sig = self.signature()
+        prep = cache.get(sig)
+        if prep is None:
+            prep = self._prepare(searcher)
+            cache.put(sig, prep)
+        launches = []             # (seg_order, vals[Q,k], idx, tot, mx)
+        for seg_order, sp in prep["segs"]:
+            check_current()    # cancellation point per segment program
+            seg = searcher.segments[seg_order]
+            dseg = seg.device()
+            impacts = dseg.impacts(self.field, self.avgdl)
             live = searcher.ctx.live_jnp(seg, dseg)
-            kk = min(k, dseg.n_pad)
-            req = np.full(q_pad, np.inf, _F32)  # padding rows match nothing
-            req[:Q] = self.required
-            vals, idx, tot, mx = batch_bm25_union_topk(
-                p["offsets"], p["doc_ids"], p["tfs"], p["doc_lens"],
-                live, jnp.asarray(union_tids), jnp.asarray(union_active),
-                jnp.asarray(union_idfs), jnp.asarray(weights),
-                jnp.asarray(act), jnp.asarray(req),
-                jnp.asarray(np.float32(avgdl)),
-                n_pad=dseg.n_pad, budget=pad_bucket(budget), k=kk)
+            kk = min(self.k, dseg.n_pad)
+            vals, idx, tot, mx = batch_impact_union_topk(
+                dseg.postings[self.field]["offsets"],
+                dseg.postings[self.field]["doc_ids"],
+                impacts, live, sp["union_tids"], sp["union_active"],
+                sp["union_idfs"], sp["qslots"], sp["qweights"],
+                sp["qact"], prep["required"],
+                n_pad=dseg.n_pad, budget=sp["budget"], k=kk,
+                need_counts=prep["need_counts"])
             launches.append((seg_order, vals, idx, tot, mx))
         # ONE host sync region: convert whole launches after the dispatch loop
         synced = [(so, np.asarray(v), np.asarray(i), np.asarray(t),
@@ -210,11 +337,11 @@ def plan_batches(searcher, bodies: list) -> tuple[dict, list]:
 
     Returns ({(field, k): BatchGroup}, [positions needing the sequential
     path]).  Batchable = scored term-bag (TermBagPlan) with no sort /
-    aggs / min_score / source filtering beyond defaults.
+    aggs / min_score / source filtering beyond defaults.  Compilation
+    goes through the searcher's plan cache, so repeated bodies do zero
+    parse/compile work here.
     """
     from opensearch_tpu.search import plan as P
-    from opensearch_tpu.search.compiler import compile_query
-    from opensearch_tpu.search.query_dsl import parse_query
 
     groups: dict = {}
     fallback = []
@@ -231,8 +358,7 @@ def plan_batches(searcher, bodies: list) -> tuple[dict, list]:
             fallback.append(pos)
             continue
         try:
-            plan, bind = compile_query(parse_query(body.get("query")),
-                                       searcher.ctx, scored=True)
+            plan, bind = searcher.compiled(body.get("query"), scored=True)
         except Exception:
             fallback.append(pos)
             continue

@@ -31,6 +31,7 @@ from opensearch_tpu.index.segment import (
     DeviceSegment,
     Segment,
 )
+from opensearch_tpu.ops import bm25 as bm25_ops
 from opensearch_tpu.search import plan as P
 from opensearch_tpu.search.compiler import ShardContext, compile_query
 from opensearch_tpu.search.fetch import filter_source
@@ -217,6 +218,20 @@ def _parse_sort(spec) -> Optional[list[dict]]:
     return out
 
 
+_MS_NEG_INF = None
+
+
+def _min_score_scalar(min_score):
+    """Staged min_score scalar; the common None case reuses one device
+    constant instead of a fresh 4-byte H2D transfer per query."""
+    global _MS_NEG_INF
+    if min_score is None:
+        if _MS_NEG_INF is None:
+            _MS_NEG_INF = jnp.asarray(np.float32(-np.inf))
+        return _MS_NEG_INF
+    return jnp.asarray(np.float32(min_score))
+
+
 class ShardSearcher:
     """Immutable point-in-time view over a shard's segments (the
     Engine.Searcher / reader-context analog, ref search/SearchService.java:986)."""
@@ -229,6 +244,87 @@ class ShardSearcher:
         self.shard_id = shard_id
         self.ctx = ShardContext(self.segments, mapper)
 
+    # -- compiled-plan / prepared-bindings caches -------------------------
+
+    def compiled(self, query_json: Optional[dict], scored: bool = True,
+                 with_key: bool = False):
+        """(plan, bind) for a raw query body through the searcher's plan
+        cache, keyed on the canonicalized JSON (key order in the body
+        never misses).  The searcher is an immutable point-in-time view,
+        so entries can never go stale — a refresh builds a NEW searcher
+        (the PR-3 reader-generation bump) and this cache dies with the
+        old one.  A repeated query shape therefore does zero
+        parse/compile work (`search.plan_cache.hits`)."""
+        from opensearch_tpu.common.cache import attached_cache
+
+        try:
+            ckey = (json.dumps(query_json, sort_keys=True,
+                               separators=(",", ":")), scored)
+        except (TypeError, ValueError):
+            ckey = None
+        if ckey is not None:
+            cache = attached_cache(self, "_plan_cache",
+                                   name="search.plan",
+                                   max_weight=16 << 20,
+                                   breaker="fielddata")
+            out = cache.get(ckey)
+            if out is not None:
+                _metrics().counter("search.plan_cache.hits").inc()
+                return (out, ckey) if with_key else out
+        _metrics().counter("search.plan_cache.misses").inc()
+        out = compile_query(parse_query(query_json), self.ctx,
+                            scored=scored)
+        if ckey is not None:
+            cache.put(ckey, out)
+        return (out, ckey) if with_key else out
+
+    @staticmethod
+    def _prep_weight(key, value) -> int:
+        """Prepared-bindings weigher: large staged columns referenced
+        from the ins pytree (impacts et al.) are owned and accounted by
+        the device-segment caches — charging their full nbytes here
+        would thrash the cache on shared references, so anything over
+        1 MiB is capped at 1 MiB."""
+        from opensearch_tpu.common.cache import estimate_weight
+
+        total = estimate_weight(key)
+
+        def walk(v):
+            nonlocal total
+            nbytes = getattr(v, "nbytes", None)
+            if nbytes is not None:
+                total += min(int(nbytes), 1 << 20)
+            elif isinstance(v, (tuple, list)):
+                for x in v:
+                    walk(x)
+            elif isinstance(v, dict):
+                for x in v.values():
+                    walk(x)
+            else:
+                total += 8
+        walk(value)
+        return total
+
+    def _prepared(self, plan, bind, seg, dseg, ckey):
+        """``plan.prepare``'s per-(plan, segment) static products —
+        padded term ids, staged impact references, device scalars —
+        cached so a repeated query shape does zero host-side prepare
+        work (and zero H2D transfers) per segment."""
+        if ckey is None:
+            return plan.prepare(bind, seg, dseg, self.ctx)
+        from opensearch_tpu.common.cache import attached_cache
+
+        cache = attached_cache(self, "_prep_cache",
+                               name="search.prepare",
+                               max_weight=64 << 20, breaker="fielddata",
+                               weigher=self._prep_weight)
+        key = (ckey, id(seg))
+        out = cache.get(key)
+        if out is None:
+            out = plan.prepare(bind, seg, dseg, self.ctx)
+            cache.put(key, out)
+        return out
+
     # -- public API -------------------------------------------------------
 
     def doc_count(self) -> int:
@@ -237,10 +333,14 @@ class ShardSearcher:
     def count(self, query_json: Optional[dict] = None) -> int:
         if not self.segments:
             return 0
-        plan, bind = compile_query(parse_query(query_json), self.ctx, scored=False)
+        (plan, bind), ckey = self.compiled(query_json, scored=False,
+                                           with_key=True)
         needed = plan.arrays()
         total = 0
-        for seg, dseg, scores, matched in self._run_full(plan, bind, needed, None):
+        # can_match skip is safe here: count only sums, so segments the
+        # plan provably can't match contribute nothing either way
+        for seg, dseg, scores, matched in self._run_full(
+                plan, bind, needed, None, can_match_skip=True, ckey=ckey):
             total += int(np.asarray(matched).sum())
         return total
 
@@ -269,7 +369,7 @@ class ShardSearcher:
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
         deadline = SearchDeadline(body.get("timeout"), t0)
-        q = parse_query(body.get("query"))
+        q_json = body.get("query")
         fetch_extras = None
         # request-size limits (docvalue_fields, rescore window, result
         # window) are enforced by IndexService._check_search_limits with
@@ -279,10 +379,13 @@ class ShardSearcher:
             fetch_extras = {"highlight": body.get("highlight"),
                             "explain": bool(body.get("explain")),
                             "docvalue_fields": body.get("docvalue_fields"),
-                            "fields": body.get("fields"), "query": q}
-        from opensearch_tpu.search.query_dsl import HybridQuery
-        if isinstance(q, HybridQuery):
-            return self._hybrid_search(body, q, t0, fetch_extras)
+                            "fields": body.get("fields"),
+                            "query": parse_query(q_json)}
+        if isinstance(q_json, dict) and "hybrid" in q_json:
+            from opensearch_tpu.search.query_dsl import HybridQuery
+            q = parse_query(q_json)
+            if isinstance(q, HybridQuery):
+                return self._hybrid_search(body, q, t0, fetch_extras)
         sort_specs = _parse_sort(body.get("sort"))
         min_score = body.get("min_score")
         source_spec = body.get("_source")
@@ -311,9 +414,15 @@ class ShardSearcher:
         needs_scores = (sort_specs is None
                         or any(s["field"] == "_score" for s in sort_specs)
                         or min_score is not None)
-        plan, bind = compile_query(q, self.ctx, scored=needs_scores)
+        (plan, bind), ckey = self.compiled(q_json, scored=needs_scores,
+                                           with_key=True)
         needed = plan.arrays()
         k_want = from_ + size
+        # with exact totals waived, block-max pruning may also skip
+        # segments that cannot beat the running k-th score (the
+        # reference's track_total_hits=false contract: totals become a
+        # lower bound, flagged with relation "gte")
+        allow_kth_prune = body.get("track_total_hits") is False
 
         rescore = body.get("rescore")
         collapse = body.get("collapse")
@@ -332,9 +441,10 @@ class ShardSearcher:
         # with aggs, the full-scores pass runs ONCE and feeds both the
         # top-k and the aggregations (no second device execution)
         views = (list(self._run_full(plan, bind, needed, min_score,
-                                     deadline=deadline))
+                                     deadline=deadline, ckey=ckey))
                  if aggs_json and self.segments else None)
 
+        total_is_lower_bound = False
         if not self.segments:
             rows, total, max_score = [], 0, None
         elif collapse is not None:
@@ -345,13 +455,14 @@ class ShardSearcher:
             if views is not None:
                 rows, total, max_score = self._topk_from_views(views, k_want)
             else:
-                rows, total, max_score = self._topk(plan, bind, needed,
-                                                    k_want, min_score,
-                                                    deadline=deadline)
+                rows, total, max_score, total_is_lower_bound = self._topk(
+                    plan, bind, needed, k_want, min_score,
+                    deadline=deadline, ckey=ckey,
+                    allow_kth_prune=allow_kth_prune)
         else:
             rows, total, max_score = self._field_sorted(
                 plan, bind, needed, k_want, sort_specs, min_score, views,
-                search_after=search_after, deadline=deadline)
+                search_after=search_after, deadline=deadline, ckey=ckey)
         if rescore is not None and rows:
             rows, max_score = self._rescored(rows, rescore)
         rows = rows[from_: from_ + size]
@@ -381,7 +492,9 @@ class ShardSearcher:
             "timed_out": deadline.timed_out,
             "_shards": shards_section(1),
             "hits": {
-                "total": {"value": int(total), "relation": "eq"},
+                "total": {"value": int(total),
+                          "relation": ("gte" if total_is_lower_bound
+                                       else "eq")},
                 "max_score": max_score,
                 "hits": hits,
             },
@@ -446,8 +559,9 @@ class ShardSearcher:
             if deadline.expired():
                 break            # partial: combine what completed
             plan, bind = compile_query(subq, self.ctx, scored=True)
-            rows, tot, _mx = self._topk(plan, bind, plan.arrays(),
-                                        k_want, None, deadline=deadline)
+            rows, tot, _mx, _lb = self._topk(plan, bind, plan.arrays(),
+                                             k_want, None,
+                                             deadline=deadline)
             per_query_rows.append(rows)
             max_total = max(max_total, int(tot))
         combined = conf.apply(per_query_rows, k_want)
@@ -546,7 +660,7 @@ class ShardSearcher:
     # -- internals --------------------------------------------------------
 
     def _run_full(self, plan, bind, needed, min_score,
-                  can_match_skip=False, deadline=None):
+                  can_match_skip=False, deadline=None, ckey=None):
         """``can_match_skip`` is ONLY safe for consumers that don't index
         the yielded tuples by position (views/aggs paths align with
         self.segments and must see every segment).  An expired
@@ -554,12 +668,13 @@ class ShardSearcher:
         same granularity as cancellation."""
         from opensearch_tpu.common.tasks import check_current
 
-        ms = jnp.asarray(np.float32(-np.inf if min_score is None else min_score))
+        ms = _min_score_scalar(min_score)
         for seg in self.segments:
             check_current()        # cancellation point per segment program
             if deadline is not None and deadline.expired():
                 return
             if can_match_skip and not plan.can_match(bind, seg):
+                _metrics().counter("search.segments_pruned").inc()
                 continue
             with _tracer().start_span(
                     "segment.dispatch",
@@ -568,7 +683,7 @@ class ShardSearcher:
                 dseg = seg.device()
                 A = build_arrays(dseg, needed, self.mapper,
                                  live=self.ctx.live_jnp(seg, dseg))
-                dims, ins = plan.prepare(bind, seg, dseg, self.ctx)
+                dims, ins = self._prepared(plan, bind, seg, dseg, ckey)
                 scores, matched = P.run_full(plan, dims, A, ins, ms)
             yield seg, dseg, scores, matched
 
@@ -590,15 +705,26 @@ class ShardSearcher:
                  "score": float(scores[i])} for i in order]
         return rows, total, (None if max_score == -np.inf else float(max_score))
 
-    def _topk(self, plan, bind, needed, k_want, min_score, deadline=None):
+    def _topk(self, plan, bind, needed, k_want, min_score, deadline=None,
+              ckey=None, allow_kth_prune=False):
+        """Returns (rows, total, max_score, total_is_lower_bound).
+
+        Block-max pruning: segments whose ``plan.max_score_bound`` can't
+        reach ``min_score`` are skipped exactly (such docs are excluded
+        from hits AND totals anyway).  With ``allow_kth_prune`` (the
+        request waived exact totals via track_total_hits=false),
+        segments that can't beat the running k-th score are skipped too
+        — the k-th score is harvested opportunistically from programs
+        that already finished, never blocking the async dispatch
+        pipeline."""
         from opensearch_tpu.common.tasks import check_current
 
         if k_want == 0:            # size=0: counts only (aggs-style request)
             total = sum(int(np.asarray(m).sum()) for _s, _d, _sc, m
                         in self._run_full(plan, bind, needed, min_score,
                                           can_match_skip=True,
-                                          deadline=deadline))
-            return [], total, None
+                                          deadline=deadline, ckey=ckey))
+            return [], total, None, False
 
         # phase 1: DISPATCH every segment's program without a host sync —
         # jax's async dispatch runs them back to back on the device while
@@ -606,38 +732,94 @@ class ShardSearcher:
         # search answer in the XLA model; ref search/query/
         # ConcurrentQueryPhaseSearcher.java gets the same overlap from
         # slice threads)
-        ms = jnp.asarray(np.float32(-np.inf if min_score is None else min_score))
-        launched = []
+        ms = _min_score_scalar(min_score)
+        ms_host = None if min_score is None else float(min_score)
+        # CPU-backend fast path: scored term bags run host-side over the
+        # precomputed impact tables (see ops/bm25.py host_scoring_enabled)
+        host_fast = (bm25_ops.host_scoring_enabled()
+                     and getattr(plan, "scored", False)
+                     and getattr(plan, "host_topk", None) is not None)
+        launched = []              # [si, vals, idx, tot, mx, synced_vals]
+        kth = None                 # running k-th best (harvested, host)
+        total_is_lower_bound = False
         for si, seg in enumerate(self.segments):
             check_current()        # cancellation point per segment program
             if deadline is not None and deadline.expired():
                 break              # partial top-k; response flags timed_out
             if not plan.can_match(bind, seg):
+                _metrics().counter("search.segments_pruned").inc()
                 continue           # can-match skip: no staging, no program
+            if ms_host is not None or kth is not None:
+                bound = plan.max_score_bound(bind, seg)
+                if ms_host is not None and bound < ms_host:
+                    # exact: docs below min_score never count in totals
+                    _metrics().counter("search.segments_pruned").inc()
+                    continue
+                if kth is not None and bound <= kth:
+                    # the k-th holder dispatched earlier, so it wins any
+                    # tie at exactly `bound` (seg-asc tie-break); totals
+                    # become a lower bound
+                    _metrics().counter("search.segments_pruned").inc()
+                    total_is_lower_bound = True
+                    continue
             with _tracer().start_span(
                     "segment.dispatch",
                     {"segment": seg.seg_id, "index": self.index_name,
                      "shard": self.shard_id}):
-                dseg = seg.device()
-                A = build_arrays(dseg, needed, self.mapper,
-                                 live=self.ctx.live_jnp(seg, dseg))
-                dims, ins = plan.prepare(bind, seg, dseg, self.ctx)
-                k = min(k_want, dseg.n_pad)
-                launched.append((si, *P.run_topk(plan, dims, k, A, ins,
-                                                 ms)))
+                if host_fast:
+                    vals, idx, tot, mx = plan.host_topk(
+                        bind, seg, self.ctx.lives[id(seg)],
+                        min(k_want, seg.n_docs), min_score)
+                    launched.append([si, vals, idx, tot, mx, vals])
+                else:
+                    dseg = seg.device()
+                    A = build_arrays(dseg, needed, self.mapper,
+                                     live=self.ctx.live_jnp(seg, dseg))
+                    dims, ins = self._prepared(plan, bind, seg, dseg,
+                                               ckey)
+                    k = min(k_want, dseg.n_pad)
+                    launched.append([si, *P.run_topk(plan, dims, k, A,
+                                                     ins, ms), None])
+            if allow_kth_prune and len(launched) >= 1 \
+                    and si + 1 < len(self.segments):
+                kth = self._harvest_kth(launched, k_want, kth)
         # phase 2: ONE host-sync region over all segments' results
         per_seg = []
         total = 0
         max_score = -np.inf
-        for si, vals, idx, tot, mx in launched:
-            vals = np.asarray(vals)
+        for si, vals, idx, tot, mx, synced in launched:
+            vals = synced if synced is not None else np.asarray(vals)
             idx = np.asarray(idx)
             keep = vals > -np.inf
             per_seg.append((vals[keep], np.full(int(keep.sum()), si, _I32),
                             idx[keep]))
             total += int(tot)
             max_score = max(max_score, float(mx))
-        return self._merge_topk(per_seg, k_want, total, max_score)
+        rows, total, max_score = self._merge_topk(per_seg, k_want, total,
+                                                  max_score)
+        return rows, total, max_score, total_is_lower_bound
+
+    @staticmethod
+    def _harvest_kth(launched, k_want, kth):
+        """Update the running k-th best score from programs that ALREADY
+        finished — ``is_ready()`` results live on the host, so reading
+        them never blocks the dispatch pipeline (the MaxScore running
+        threshold, fed at async-dispatch granularity)."""
+        ready = []
+        for entry in launched:
+            if entry[5] is None and getattr(entry[1], "is_ready",
+                                            lambda: False)():
+                entry[5] = np.asarray(entry[1])      # sync-ok (is_ready)
+            if entry[5] is not None:
+                ready.append(entry[5])
+        if not ready:
+            return kth
+        vals = np.concatenate(ready).ravel()
+        vals = vals[vals > -np.inf]
+        if len(vals) < k_want:
+            return kth
+        cand = float(np.partition(vals, -k_want)[-k_want])  # sync-ok
+        return cand if kth is None or cand > kth else kth
 
     def _topk_from_views(self, views, k_want):
         """Top-k out of an already-run full-scores pass (aggs requests)."""
@@ -697,7 +879,7 @@ class ShardSearcher:
 
     def _field_sorted(self, plan, bind, needed, k_want, sort_specs, min_score,
                       views=None, row_filter=None, search_after=None,
-                      deadline=None):
+                      deadline=None, ckey=None):
         """``k_want=None`` returns EVERY matched row (scroll
         materialization); ``row_filter(seg_i, local)`` implements sliced
         scans; ``search_after`` drops rows at-or-before the given sort
@@ -706,7 +888,7 @@ class ShardSearcher:
         total = 0
         if views is None:
             views = self._run_full(plan, bind, needed, min_score,
-                                   deadline=deadline)
+                                   deadline=deadline, ckey=ckey)
         for si, (seg, dseg, scores, matched) in enumerate(views):
             matched_np = np.asarray(matched)[: seg.n_docs]
             scores_np = np.asarray(scores)[: seg.n_docs]
@@ -775,8 +957,7 @@ class ShardSearcher:
         qw = float(q.get("query_weight", 1.0))
         rw = float(q.get("rescore_query_weight", 1.0))
         mode = str(q.get("score_mode", "total"))
-        rplan, rbind = compile_query(parse_query(rq_json), self.ctx,
-                                     scored=True)
+        rplan, rbind = self.compiled(rq_json, scored=True)
         rneeded = rplan.arrays()
         # per-segment rescore scores, read only at the window's docs
         seg_scores: dict[int, np.ndarray] = {}
@@ -897,10 +1078,10 @@ class ShardSearcher:
         if _precompiled is not None:
             plan, bind, needed = _precompiled
         else:
-            q = parse_query(body.get("query"))
             needs_scores = sort_specs is None or min_score is not None \
                 or any(s["field"] == "_score" for s in sort_specs)
-            plan, bind = compile_query(q, self.ctx, scored=needs_scores)
+            plan, bind = self.compiled(body.get("query"),
+                                       scored=needs_scores)
             needed = plan.arrays()
         if not self.segments:
             return [], 0

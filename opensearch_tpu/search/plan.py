@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import bisect
 import fnmatch
+import math
 import re
 from dataclasses import dataclass
 from functools import partial
@@ -80,6 +81,29 @@ class Plan:
         conservative: returning True is always safe."""
         return True
 
+    def max_score_bound(self, bind, seg) -> float:
+        """Safe UPPER bound on any single doc's score in this segment —
+        the MaxScore/BMW pruning surface over the per-term block-max
+        impact metadata (``Segment.max_impacts``).  The executor skips
+        segments whose bound cannot reach the min_score / running k-th
+        score.  Returning ``math.inf`` (the default) is always safe;
+        finite bounds carry a small multiplicative margin so float32
+        kernel rounding can never make a real score exceed them."""
+        return math.inf
+
+
+# float32 kernel rounding can nudge a real score a few ulp above the
+# float64 host-side bound arithmetic; inflating every finite bound by
+# this factor keeps pruning strictly conservative.
+_BOUND_MARGIN = 1.0001
+
+
+def _boost_bound(self, bind, seg) -> float:
+    """max_score_bound for constant-score plans: the boost IS the only
+    possible score."""
+    b = float(bind["boost"])
+    return b * _BOUND_MARGIN if b >= 0 else math.inf
+
 
 @dataclass(frozen=True)
 class MatchAllPlan(Plan):
@@ -91,6 +115,8 @@ class MatchAllPlan(Plan):
         n_pad = A["live"].shape[0]
         return jnp.full(n_pad, boost, jnp.float32), jnp.ones(n_pad, bool)
 
+    max_score_bound = _boost_bound
+
 
 @dataclass(frozen=True)
 class MatchNonePlan(Plan):
@@ -100,6 +126,9 @@ class MatchNonePlan(Plan):
     def eval(self, A, dims, ins):
         n_pad = A["live"].shape[0]
         return jnp.zeros(n_pad, jnp.float32), jnp.zeros(n_pad, bool)
+
+    def max_score_bound(self, bind, seg) -> float:
+        return 0.0
 
 
 @dataclass(frozen=True)
@@ -124,6 +153,77 @@ class TermBagPlan(Plan):
         # a doc can match at most `present` distinct query terms here
         return present >= max(int(bind.get("required", 1)), 1)
 
+    def max_score_bound(self, bind, seg):
+        if not self.scored:
+            return 0.0                   # filter context scores are 0
+        pf = seg.postings.get(self.field)
+        if pf is None:
+            return 0.0
+        mi = seg.max_impacts(self.field, bind["avgdl"])
+        total = 0.0
+        for t, idf_v, w in zip(bind["terms"], bind["idfs"],
+                               bind["weights"]):
+            if w < 0:
+                return math.inf          # negative weights: no bound
+            tid = pf.term_id(t)
+            if tid >= 0:
+                total += float(idf_v) * float(w) * float(mi[tid])
+        return total * _BOUND_MARGIN
+
+    def host_topk(self, bind, seg, live, k: int, min_score=None):
+        """CPU-backend fast path: score this bag host-side from the
+        segment's precomputed impact table (``Segment.impact_table``)
+        and return ``(vals f32 [m<=k], idx i32 [m], total, max_score)``
+        with ``run_topk``'s exact semantics — float32 contributions in
+        the same multiply order as the device kernel, in-order per-term
+        accumulation, live/min_score masking excluded from totals, and
+        ``lax.top_k``'s tie-break (score desc, then LOWER doc id).
+
+        Used instead of a device dispatch when
+        ``bm25_ops.host_scoring_enabled()`` — see ops/bm25.py on why
+        scatter-heavy scoring is lowered host-side on XLA:CPU."""
+        n = seg.n_docs
+        pf = seg.postings.get(self.field)
+        if pf is None:
+            return (np.empty(0, _F32), np.empty(0, _I32), 0, -np.inf)
+        imp, _mx = seg.impact_table(self.field, bind["avgdl"])
+        idfs = np.asarray(bind["idfs"], _F32)
+        weights = np.asarray(bind["weights"], _F32)
+        required = int(bind["required"])
+        fast = (required == 1 and bool((weights > 0).all())
+                and bool((idfs > 0).all()))
+        scores = np.zeros(n, _F32)
+        counts = None if fast else np.zeros(n, np.int32)
+        for t, idf_v, w in zip(bind["terms"], idfs, weights):
+            tid = pf.term_id(t)
+            if tid < 0:
+                continue
+            e0, e1 = int(pf.offsets[tid]), int(pf.offsets[tid + 1])
+            d = pf.doc_ids[e0:e1]
+            # doc ids are unique within one postings list: plain fancy-
+            # index add accumulates in gather order, matching the
+            # device scatter bit-for-bit
+            scores[d] += w * (idf_v * imp[e0:e1])
+            if counts is not None:
+                counts[d] += 1
+        matched = (scores > 0.0 if counts is None
+                   else counts >= required)
+        matched &= live[:n]
+        if min_score is not None:
+            matched &= scores >= np.float32(min_score)
+        midx = np.flatnonzero(matched)
+        total = len(midx)
+        if total == 0:
+            return (np.empty(0, _F32), np.empty(0, _I32), 0, -np.inf)
+        mscores = scores[midx]
+        mx = float(mscores.max())
+        if total > k:
+            kth = np.partition(mscores, -k)[-k]
+            midx = midx[mscores >= kth]
+        order = np.lexsort((midx, -scores[midx]))[:k]
+        sel = midx[order]
+        return scores[sel], sel.astype(_I32), total, mx
+
     def prepare(self, bind, seg, dseg, ctx):
         terms = bind["terms"]
         pf = seg.postings.get(self.field)
@@ -137,23 +237,45 @@ class TermBagPlan(Plan):
                 tids[i] = tid
                 active[i] = True
                 budget += int(pf.df[tid])
+        if not self.scored:
+            ins = (jnp.asarray(tids), jnp.asarray(active),
+                   _scalar(bind["required"], _I32))
+            return (t_pad, pad_bucket(budget), False), ins
+        idfs = np.asarray(bind["idfs"], _F32)
+        weights = np.asarray(bind["weights"], _F32)
+        # fast path: a plain OR bag with positive idf*weight scores > 0
+        # exactly on matched docs, so the matched-count scatter (half the
+        # kernel's scatter traffic) is skipped entirely
+        fast = (int(bind["required"]) == 1
+                and bool((weights > 0).all()) and bool((idfs > 0).all()))
         ins = (jnp.asarray(tids), jnp.asarray(active),
-               _pad_np(bind["idfs"], t_pad, 0.0, _F32),
-               _pad_np(bind["weights"], t_pad, 0.0, _F32),
-               _scalar(bind["avgdl"], _F32),
+               _pad_np(idfs, t_pad, 0.0, _F32),
+               _pad_np(weights, t_pad, 0.0, _F32),
+               dseg.impacts(self.field, bind["avgdl"]),
                _scalar(bind["required"], _I32))
-        return (t_pad, pad_bucket(budget)), ins
+        return (t_pad, pad_bucket(budget), fast), ins
 
     def eval(self, A, dims, ins):
-        t_pad, budget = dims
-        tids, active, idfs, weights, avgdl, required = ins
+        t_pad, budget, fast = dims
         p = A["postings"][self.field]
         n_pad = A["live"].shape[0]
-        scores, count = bm25_ops.bm25_score_count(
-            p["offsets"], p["doc_ids"], p["tfs"], p["doc_lens"],
-            tids, active, idfs, weights, avgdl,
-            n_pad=n_pad, budget=budget, scored=self.scored)
-        matched = count >= required
+        if not self.scored:
+            tids, active, required = ins
+            count = bm25_ops.match_count(
+                p["offsets"], p["doc_ids"], p["tfs"], tids, active,
+                n_pad=n_pad, budget=budget)
+            return jnp.zeros(n_pad, jnp.float32), count >= required
+        tids, active, idfs, weights, impacts, required = ins
+        if fast:
+            scores = bm25_ops.impact_scores(
+                p["offsets"], p["doc_ids"], impacts, tids, active,
+                idfs, weights, n_pad=n_pad, budget=budget)
+            matched = scores > 0.0
+        else:
+            scores, count = bm25_ops.impact_score_count(
+                p["offsets"], p["doc_ids"], impacts, tids, active,
+                idfs, weights, n_pad=n_pad, budget=budget, scored=True)
+            matched = count >= required
         return jnp.where(matched, scores, 0.0), matched
 
 
@@ -174,6 +296,13 @@ class PhrasePlan(Plan):
             return False
         # an exact phrase needs EVERY term present
         return all(pf.term_id(t) >= 0 for t in bind["terms"])
+
+    def max_score_bound(self, bind, seg):
+        if not self.scored:
+            return 0.0
+        # tf/(tf+norm) < 1 always (norm >= k1*(1-b) > 0)
+        return (float(bind["idf_sum"]) * float(bind["boost"])
+                * _BOUND_MARGIN)
 
     def prepare(self, bind, seg, dseg, ctx):
         terms = bind["terms"]
@@ -235,6 +364,12 @@ class SpanNearPlan(Plan):
         if pf is None:
             return False
         return all(pf.term_id(t) >= 0 for t in bind["terms"])
+
+    def max_score_bound(self, bind, seg):
+        if not self.scored:
+            return 0.0
+        return (float(bind["idf_sum"]) * float(bind["boost"])
+                * _BOUND_MARGIN)
 
     def prepare(self, bind, seg, dseg, ctx):
         terms = bind["terms"]
@@ -667,6 +802,19 @@ class BoolPlan(Plan):
                        for c, b in zip(self.should, binds[nm: nm + ns]))
         return True
 
+    def max_score_bound(self, bind, seg):
+        binds = bind["children"]
+        nm, ns = len(self.must), len(self.should)
+        boost = float(bind["boost"])
+        if boost < 0:
+            return math.inf
+        total = 0.0
+        for c, b in zip(self.must, binds[:nm]):
+            total += c.max_score_bound(b, seg)
+        for c, b in zip(self.should, binds[nm: nm + ns]):
+            total += c.max_score_bound(b, seg)
+        return total * boost * _BOUND_MARGIN
+
     def arrays(self):
         out = frozenset()
         for c in self._children():
@@ -720,6 +868,18 @@ class DisMaxPlan(Plan):
         return any(c.can_match(b, seg)
                    for c, b in zip(self.children, bind["children"]))
 
+    def max_score_bound(self, bind, seg):
+        boost = float(bind["boost"])
+        tie = float(bind["tie_breaker"])
+        if boost < 0 or tie < 0 or tie > 1:
+            return math.inf
+        bounds = [c.max_score_bound(b, seg)
+                  for c, b in zip(self.children, bind["children"])]
+        if not bounds:
+            return 0.0
+        best = max(bounds)
+        return (best + tie * (sum(bounds) - best)) * boost * _BOUND_MARGIN
+
     def prepare(self, bind, seg, dseg, ctx):
         cdims, cins = _prepare_children(
             self.children, bind["children"], seg, dseg, ctx)
@@ -752,6 +912,8 @@ class ConstScorePlan(Plan):
 
     def can_match(self, bind, seg):
         return self.child.can_match(bind["child"], seg)
+
+    max_score_bound = _boost_bound
 
     def prepare(self, bind, seg, dseg, ctx):
         cdims, cins = self.child.prepare(bind["child"], seg, dseg, ctx)
@@ -971,6 +1133,16 @@ class BoostingPlan(Plan):
     def can_match(self, bind, seg):
         return self.positive.can_match(bind["children"][0], seg)
 
+    def max_score_bound(self, bind, seg):
+        boost = float(bind["boost"])
+        if boost < 0:
+            return math.inf
+        pos = self.positive.max_score_bound(bind["children"][0], seg)
+        # negative_boost is usually in [0, 1); a larger value could
+        # amplify demoted docs, so bound by whichever factor is bigger
+        return (pos * boost * max(1.0, float(bind["negative_boost"]))
+                * _BOUND_MARGIN)
+
     def prepare(self, bind, seg, dseg, ctx):
         cdims, cins = _prepare_children(
             (self.positive, self.negative), bind["children"],
@@ -1016,19 +1188,19 @@ class TermsSetPlan(Plan):
         ins = (jnp.asarray(tids), jnp.asarray(active),
                _pad_np(bind["idfs"], t_pad, 0.0, _F32),
                _pad_np(bind["weights"], t_pad, 0.0, _F32),
-               _scalar(bind["avgdl"], _F32))
+               dseg.impacts(self.field, bind["avgdl"]))
         return (t_pad, pad_bucket(budget)), ins
 
     def eval(self, A, dims, ins):
         t_pad, budget = dims
-        tids, active, idfs, weights, avgdl = ins
+        tids, active, idfs, weights, impacts = ins
         p = A["postings"][self.field]
         msm = A["numeric"][self.msm_field]
         n_pad = A["live"].shape[0]
-        scores, count = bm25_ops.bm25_score_count(
-            p["offsets"], p["doc_ids"], p["tfs"], p["doc_lens"],
-            tids, active, idfs, weights, avgdl,
-            n_pad=n_pad, budget=budget, scored=self.scored)
+        scores, count = bm25_ops.impact_score_count(
+            p["offsets"], p["doc_ids"], impacts, tids, active,
+            idfs, weights, n_pad=n_pad, budget=budget,
+            scored=self.scored)
         # per-doc minimum from the doc's own field; docs without the
         # field never match (the reference skips them)
         required = jnp.where(msm["exists"],
@@ -1431,6 +1603,16 @@ class FunctionScorePlan(Plan):
         out = (out * boost).astype(jnp.float32)
         matched = matched & (out >= min_score)
         return jnp.where(matched, out, 0.0), matched
+
+
+# constant-score leaves: the boost is the only score either of these
+# families can produce, so it IS the block-max bound
+for _cls in (NumericTermsPlan, NumericRangePlan, OrdinalRangePlan,
+             PostingsMaskPlan, TermRangeMaskPlan, ExpandTermsPlan,
+             ExistsPlan, MaskPlan, NestedPlan, GeoDistancePlan,
+             GeoPolygonPlan, GeoBoxPlan):
+    _cls.max_score_bound = _boost_bound
+del _cls
 
 
 def _edit_distance_le(a: str, b: str, k: int) -> bool:

@@ -61,10 +61,10 @@ def test_batched_single_program_per_batch():
     pairs = bench.gen_query_terms(64)
     queries = [{"query": {"match": {"body": f"t{a} t{b}"}}, "size": 10}
                for a, b in pairs]
-    before = batch_mod.batch_bm25_union_topk._cache_size()
+    before = batch_mod.batch_impact_union_topk._cache_size()
     s.msearch(queries)
     s.msearch(queries)          # identical batch: no new programs
-    after = batch_mod.batch_bm25_union_topk._cache_size()
+    after = batch_mod.batch_impact_union_topk._cache_size()
     assert after - before <= 1, (
         f"one 64-query batch compiled {after - before} programs "
         "(per-query budget bucketing is back?)")

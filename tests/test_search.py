@@ -497,7 +497,11 @@ def test_min_score_restricts_total(corpus):
     searcher, oracle = corpus
     q = {"match": {"body": "alpha"}}
     scores = sorted(oracle.eval(q).values(), reverse=True)
-    cutoff = scores[len(scores) // 2]
+    # place the cutoff strictly BELOW an attained value so float32
+    # engine scores (the oracle is float64) can never straddle it: a
+    # cutoff landing exactly on a tied score would make the expected
+    # count depend on last-ulp rounding, not on min_score semantics
+    cutoff = scores[len(scores) // 2] * (1.0 - 1e-6)
     resp = searcher.search({"query": q, "size": 3, "min_score": cutoff})
     expected_total = sum(1 for s in scores if s >= cutoff)
     assert resp["hits"]["total"]["value"] == expected_total
