@@ -66,7 +66,8 @@ class Coordinator:
                  voting_nodes: list[str], node_info: Optional[dict] = None,
                  on_apply: Optional[Callable[[ClusterState], None]] = None,
                  check_interval: float = 1.0, check_retries: int = 3,
-                 check_timeout: float = 2.0, gateway=None):
+                 check_timeout: float = 2.0, gateway=None,
+                 load_provider=None, on_node_load=None):
         self.node_id = node_id
         self.transport = transport
         # bootstrap voting configuration; once states carry a `voting`
@@ -111,12 +112,18 @@ class Coordinator:
         fd_settings = fd.FaultDetectionSettings(
             interval=check_interval, timeout=check_timeout,
             retries=check_retries)
+        # both checkers piggyback the node's load snapshot on their ping
+        # responses and surface the peer's to on_node_load — the
+        # freshness fallback adaptive replica selection leans on when no
+        # search traffic is reaching a node
         self.follower_checker = fd.FollowerChecker(
             transport, node_id, fd_settings, self._check_failures,
-            self._on_follower_failure)
+            self._on_follower_failure, load_provider=load_provider,
+            on_node_load=on_node_load)
         self.leader_checker = fd.LeaderChecker(
             transport, node_id, fd_settings, self._check_failures,
-            self._on_leader_failure)
+            self._on_leader_failure, load_provider=load_provider,
+            on_node_load=on_node_load)
 
         t = transport
         t.register_handler(PREVOTE, self._on_prevote)

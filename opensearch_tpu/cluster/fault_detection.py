@@ -59,20 +59,36 @@ class FollowerChecker:
     def __init__(self, transport, node_id: str,
                  settings: FaultDetectionSettings,
                  failures: dict,
-                 on_node_failure: Callable[[str, str], None]):
+                 on_node_failure: Callable[[str, str], None],
+                 load_provider: Optional[Callable[[], dict]] = None,
+                 on_node_load: Optional[Callable[[str, dict],
+                                                 None]] = None):
         self.transport = transport
         self.node_id = node_id
         self.settings = settings
         self._failures = failures        # peer -> consecutive failures
         self.on_node_failure = on_node_failure
+        # adaptive-selection piggyback: pings double as a freshness
+        # fallback for per-node load (duress flag, queue depth) so the
+        # coordinator's ResponseCollectorService stays current even when
+        # no searches are flowing to a node
+        self.load_provider = load_provider
+        self.on_node_load = on_node_load
         self._lock = threading.Lock()
 
     def handle_check(self, payload: dict, *, term: int,
                      is_follower: bool, applied_version: int) -> dict:
         """Follower side of the ping: am I following you in this term?
-        The applied version rides along for lag detection."""
-        return {"ok": payload.get("term") == term and is_follower,
-                "version": applied_version}
+        The applied version rides along for lag detection, the local
+        load snapshot for adaptive replica selection."""
+        out = {"ok": payload.get("term") == term and is_follower,
+               "version": applied_version}
+        if self.load_provider is not None:
+            try:
+                out["load"] = self.load_provider()
+            except Exception:  # noqa: BLE001 — load is best-effort
+                pass
+        return out
 
     def check_round(self, state, term: int) -> list:
         """One round over the follower set; returns nodes failed THIS
@@ -87,6 +103,8 @@ class FollowerChecker:
                     peer, FOLLOWER_CHECK, {"term": term},
                     timeout=self.settings.timeout)
                 ok = r.get("ok")
+                if self.on_node_load is not None and r.get("load"):
+                    self.on_node_load(peer, r["load"])
                 # LagDetector (coordination/LagDetector.java): a
                 # follower that acks checks but never APPLIES the
                 # published state is as gone as a dead one — it would
@@ -121,17 +139,28 @@ class LeaderChecker:
     def __init__(self, transport, node_id: str,
                  settings: FaultDetectionSettings,
                  failures: dict,
-                 on_leader_failure: Callable[[str], None]):
+                 on_leader_failure: Callable[[str], None],
+                 load_provider: Optional[Callable[[], dict]] = None,
+                 on_node_load: Optional[Callable[[str, dict],
+                                                 None]] = None):
         self.transport = transport
         self.node_id = node_id
         self.settings = settings
         self._failures = failures
         self.on_leader_failure = on_leader_failure
+        self.load_provider = load_provider
+        self.on_node_load = on_node_load
         self._lock = threading.Lock()
 
     def handle_check(self, payload: dict, *, is_leader: bool,
                      term: int) -> dict:
-        return {"leader": is_leader, "term": term}
+        out = {"leader": is_leader, "term": term}
+        if self.load_provider is not None:
+            try:
+                out["load"] = self.load_provider()
+            except Exception:  # noqa: BLE001 — load is best-effort
+                pass
+        return out
 
     def check_round(self, leader: str) -> bool:
         """One ping; returns True when the leader just got declared
@@ -142,6 +171,8 @@ class LeaderChecker:
             r = self.transport.send_request(
                 leader, LEADER_CHECK, {}, timeout=self.settings.timeout)
             ok = r.get("leader")
+            if self.on_node_load is not None and r.get("load"):
+                self.on_node_load(leader, r["load"])
         except OpenSearchTpuError:
             ok = False
         with self._lock:

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 import zlib
 from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Optional
@@ -111,6 +112,19 @@ class ClusterNode:
         self.task_manager = TaskManager(node_id)
         self.search_backpressure = SearchBackpressureService(
             self.task_manager)
+        # coordinator-side adaptive replica selection: per-node response/
+        # service/queue EWMAs fed by scatter responses and fault-detection
+        # pings (cluster/response_collector.py)
+        from opensearch_tpu.cluster.response_collector import (
+            Ewma, ResponseCollectorService)
+        self.response_collector = ResponseCollectorService()
+        # this node's own query-phase service time, piggybacked on every
+        # search[shards] response and ping so coordinators can separate
+        # execution cost from queueing/transport delay
+        self._service_time_ewma = Ewma()
+        # shard query-phase RPC budget (tests shrink it so timeout-path
+        # assertions stay fast)
+        self.search_rpc_timeout = 30.0
         # data-node write admission (the same per-shard byte accounting
         # the single-node path gets from IndicesService)
         from opensearch_tpu.common.indexing_pressure import IndexingPressure
@@ -122,7 +136,9 @@ class ClusterNode:
         self.coordinator = Coordinator(
             node_id, transport, voting_nodes,
             node_info={"name": node_id}, on_apply=self._apply_state,
-            gateway=self.gateway)
+            gateway=self.gateway,
+            load_provider=self._load_stats,
+            on_node_load=self.response_collector.record_ping_load)
         # (index, shard) -> "primary" | "replica" as applied locally
         self._roles: dict[tuple, str] = {}
         # (index, shard) replica copies that completed peer recovery in
@@ -166,6 +182,10 @@ class ClusterNode:
                                  args=(peer,), daemon=True,
                                  name=f"handshake-{self.node_id}-{peer}"
                                  ).start()
+        # evicted nodes take their adaptive-selection stats with them —
+        # a rejoining node starts from a clean slate, not a stale EWMA
+        for gone in self.response_collector.tracked() - set(state.nodes):
+            self.response_collector.remove_node(gone)
         to_promote: list[tuple] = []
         to_recover: list[tuple] = []
         with self._lock:
@@ -720,12 +740,38 @@ class ClusterNode:
 
     # -- search (scatter-gather) -------------------------------------------
 
-    def _copy_candidates(self, entry: dict) -> list[str]:
-        """Shard-copy failover order: the LOCAL in-sync copy first
-        (degenerate adaptive replica selection), then the primary, then
-        in-sync replicas.  Copies still in peer recovery are excluded —
-        they would silently answer from an empty engine
-        (AbstractSearchAsyncAction's ShardIterator over active copies)."""
+    def _load_stats(self) -> dict:
+        """This node's load snapshot, piggybacked on every search[shards]
+        response and fault-detection ping — the evidence coordinators
+        rank shard copies with (ResponseCollectorService ingestion
+        format)."""
+        tasks = self.task_manager.list()
+        with self._lock:
+            service_ewma = self._service_time_ewma.value
+        return {
+            "node": self.node_id,
+            "duress": self.search_backpressure.in_duress(),
+            "queue_size": sum(
+                1 for t in tasks
+                if t.action.startswith("indices:data/read/search")),
+            "active_tasks": len(tasks),
+            "service_time_ewma_nanos": int(service_ewma or 0),
+        }
+
+    def _copy_candidates(self, entry: dict, spill: int = 0) -> list[str]:
+        """Shard-copy dispatch/failover order.  Legacy order — LOCAL
+        in-sync copy first, then the primary, then in-sync replicas —
+        is the no-evidence baseline; with response samples recorded the
+        C3 rank reorders copies (adaptive replica selection,
+        OperationRouting.rankShardsAndUpdateStats), nodes in duress
+        derank to the back but stay as copies of last resort, and
+        ``spill`` rotates msearch batch members across the healthy
+        copies so a burst spreads over replicas.  Copies still in peer
+        recovery are excluded — they would silently answer from an empty
+        engine (AbstractSearchAsyncAction's ShardIterator)."""
+        from opensearch_tpu.cluster import response_collector as rc
+        from opensearch_tpu.common.telemetry import metrics
+
         in_sync = set(entry.get("in_sync") or [])
         order = [n for n in copies_of(entry) if n in in_sync]
         if not order and entry.get("primary"):
@@ -735,27 +781,65 @@ class ClusterNode:
         if self.node_id in order:
             order.remove(self.node_id)
             order.insert(0, self.node_id)
-        return order
+        if not rc.ADAPTIVE_ENABLED or len(order) < 2:
+            return order
+        ranked, rerouted = self.response_collector.rank_copies(order)
+        if rerouted:
+            metrics().counter("search.replica_selection.reroutes").inc()
+        if spill:
+            # round-robin the healthy prefix: msearch batch member i
+            # starts at healthy copy i % n (replica spill)
+            healthy = [n for n in ranked
+                       if not self.response_collector.in_duress(n)]
+            if len(healthy) > 1:
+                k = spill % len(healthy)
+                ranked = (healthy[k:] + healthy[:k]
+                          + [n for n in ranked if n not in healthy])
+        return ranked
 
     def _query_group(self, node: str, payload: dict) -> dict:
-        """One shard-group query phase RPC (local short-circuit)."""
-        if node == self.node_id:
-            return self._h_search_shards(payload)
-        fut = self.transport.submit_request(node, A_SEARCH_SHARDS, payload)
+        """One shard-group query phase RPC (local short-circuit).  The
+        measured response time and the piggybacked load snapshot feed
+        the response collector; degradable failures penalize the node's
+        EWMA so repeated timeouts actually derank the copy."""
+        collector = self.response_collector
+        collector.incr_outstanding(node)
+        start = time.monotonic()
         try:
-            return fut.result(timeout=30.0)
-        except FuturesTimeout:
-            raise ReceiveTimeoutError(
-                f"[{node}][{A_SEARCH_SHARDS}] timed out") from None
+            if node == self.node_id:
+                resp = self._h_search_shards(payload)
+            else:
+                fut = self.transport.submit_request(node, A_SEARCH_SHARDS,
+                                                    payload)
+                try:
+                    resp = fut.result(timeout=self.search_rpc_timeout)
+                except FuturesTimeout:
+                    raise ReceiveTimeoutError(
+                        f"[{node}][{A_SEARCH_SHARDS}] timed out") from None
+        except OpenSearchTpuError as exc:
+            if _degradable_search_error(exc):
+                collector.record_failure(
+                    node, (time.monotonic() - start) * 1e9)
+            raise
+        finally:
+            collector.decr_outstanding(node)
+        collector.record_response(node, (time.monotonic() - start) * 1e9,
+                                  resp.get("node_load"))
+        return resp
 
-    def search(self, index: str, body: Optional[dict] = None) -> dict:
+    def search(self, index: str, body: Optional[dict] = None, *,
+               _spill: int = 0) -> dict:
         """Coordinator side: group shards by their preferred copy's node,
         one RPC per node; a failed node sends its shards to their NEXT
         copy (per-shard failover iterators); shards whose every copy
         failed degrade to ``_shards.failed`` entries when partial
         results are allowed, and the survivors' top-k merges on this
-        node."""
+        node.  ``_spill`` is the msearch batch-member index — it rotates
+        each shard's healthy copies so a batch spreads over replicas."""
+        from opensearch_tpu.cluster import response_collector as rc
         from opensearch_tpu.common import tasks as taskmod
+        from opensearch_tpu.common.errors import NodeDuressError
+        from opensearch_tpu.common.telemetry import metrics
         from opensearch_tpu.search import executor as _exec
 
         body = dict(body or {})
@@ -770,7 +854,7 @@ class ClusterNode:
         candidates: dict[int, list[str]] = {}
         failures: list[dict] = []
         for shard, entry in enumerate(routing):
-            cands = self._copy_candidates(entry)
+            cands = self._copy_candidates(entry, spill=_spill)
             if not cands:
                 exc = ShardNotFoundError(f"[{index}][{shard}] unassigned")
                 if not allow_partial:
@@ -779,6 +863,23 @@ class ClusterNode:
                     index, shard, None, exc))
                 continue
             candidates[shard] = cands
+        # coordinator-side load shedding: a shard whose EVERY in-sync
+        # copy reports duress fails fast into _shards.failures[] instead
+        # of queueing onto a collapsing node (only under partial-results
+        # semantics — with allow_partial=false the client asked for
+        # all-or-nothing, so we still try)
+        if allow_partial and rc.SHED_ON_DURESS:
+            for shard in sorted(candidates):
+                cands = candidates[shard]
+                if not all(self.response_collector.in_duress(n)
+                           for n in cands):
+                    continue
+                metrics().counter("search.replica_selection.sheds").inc()
+                failures.append(_exec.shard_failure_entry(
+                    index, shard, cands[0], NodeDuressError(
+                        f"[{index}][{shard}] shed: all in-sync copies "
+                        f"{cands} report duress")))
+                del candidates[shard]
 
         aggs_requested = bool(body.get("aggs") or body.get("aggregations"))
 
@@ -808,6 +909,25 @@ class ClusterNode:
                 # on nodes that will never see another child of it
                 self._broadcast_ban(parent_id, involved, "completed",
                                     ban=False)
+
+    def msearch(self, index: str, bodies: list) -> dict:
+        """Batched scatter (_msearch at cluster scope): sub-request i
+        passes its batch index as the spill offset, so a same-index
+        burst round-robins over each shard's healthy copies instead of
+        piling onto the single preferred one (the reference spreads
+        load via ARS rank updates per request; with batches arriving
+        faster than EWMAs move, explicit rotation is the deterministic
+        equivalent).  Errors are per sub-request, like REST _msearch."""
+        responses: list = []
+        for i, body in enumerate(bodies):
+            try:
+                responses.append(self.search(index, dict(body or {}),
+                                             _spill=i))
+            except OpenSearchTpuError as e:
+                responses.append({"error": {"type": e.error_type,
+                                            "reason": e.reason},
+                                  "status": e.status})
+        return {"responses": responses}
 
     def _search_scatter(self, index, body, routing, candidates, failures,
                         allow_partial, aggs_requested, task, parent_id):
@@ -950,10 +1070,18 @@ class ClusterNode:
             f"shards {shard_ids} of [{payload['index']}]",
             parent_task_id=payload.get("parent_task_id"))
         token = taskmod.set_current(task)
+        start = time.monotonic()
         try:
             task.ensure_not_cancelled()    # parent already banned?
-            return self._search_shards_body(svc, body, explicit_cache,
-                                            agg_partials, shard_ids)
+            out = dict(self._search_shards_body(
+                svc, body, explicit_cache, agg_partials, shard_ids))
+            with self._lock:
+                self._service_time_ewma.add(
+                    (time.monotonic() - start) * 1e9)
+            # piggyback AFTER the (byte-stable) cached body so load is
+            # always current, never frozen into a cache entry
+            out["node_load"] = self._load_stats()
+            return out
         finally:
             taskmod.reset_current(token)
             self.task_manager.unregister(task)
@@ -994,6 +1122,11 @@ class ClusterNode:
 
     def start(self):
         self.coordinator.start()
+        # duress must be detected BETWEEN admissions too: the monitor
+        # thread evaluates the trackers on a cadence even when no new
+        # searches arrive to tick them (previously admission-path-only,
+        # so an idle-but-saturated node never noticed it recovered)
+        self.search_backpressure.start_monitor()
         return self
 
     def _handshake_peer(self, peer: str):
@@ -1011,6 +1144,9 @@ class ClusterNode:
             if getattr(self, "_node_stopped", False):
                 return
             self._node_stopped = True
+        # bounded join (stop_monitor joins with a timeout): node teardown
+        # must never hang on the backpressure monitor thread
+        self.search_backpressure.stop_monitor()
         self.coordinator.stop()
         with self._lock:
             for svc in self.indices.values():

@@ -1,0 +1,278 @@
+"""Coordinator-side response statistics for adaptive replica selection.
+
+Analog of ``node/ResponseCollectorService.java`` + the C3 rank math in
+``ComputedNodeStats`` (ref OperationRouting.rankShardsAndUpdateStats):
+the coordinator keeps, per data node, exponentially weighted moving
+averages of
+
+- the **response time** it measured around each shard query-phase RPC,
+- the **service time** the node itself reported for executing the phase
+  (piggybacked on the response, so queueing and transport delay are
+  separable from execution cost), and
+- the node's **search queue depth** (piggybacked too),
+
+plus the node's self-reported **duress** flag (PR-4's
+SearchBackpressureService verdict) with a freshness horizon.  Shard
+copies are ranked with the C3 formula (Suresh et al., NSDI'15 — the
+reference's adaptive replica selection): lower rank = better copy.
+Nodes in duress are deranked but retained (they still serve as the copy
+of last resort); nodes the coordinator has no response sample for rank
+at the mean, so a stable sort preserves the legacy
+primary-then-replicas order until real evidence arrives.
+
+Every timing decision flows through the injectable ``clock`` so tests
+drive EWMA decay and duress expiry deterministically —
+``tools/check_monotonic.py`` enforces that this module never reads a
+clock directly (tier-1).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+#: smoothing factor for every EWMA (the reference's
+#: ExponentiallyWeightedMovingAverage alpha in ResponseCollectorService)
+ALPHA = 0.3
+
+#: exponent on the estimated queue length in the C3 rank — cubing makes
+#: queue growth dominate once a node falls behind (queueAdjustmentFactor)
+QUEUE_ADJUSTMENT_FACTOR = 3.0
+
+#: a duress flag older than this many (injectable-clock) seconds is
+#: stale: the node gets probed again instead of being shed forever
+DURESS_TTL_S = 5.0
+
+#: dynamic cluster settings (search.replica_selection.*) land on these
+#: module globals like executor.DEFAULT_ALLOW_PARTIAL_RESULTS does —
+#: consumers read them per search, so a settings flip is immediate
+ADAPTIVE_ENABLED = True
+SHED_ON_DURESS = True
+
+
+class Ewma:
+    """Exponentially weighted moving average; ``value`` is ``None``
+    until the first sample (distinguishes "no evidence" from "fast")."""
+
+    __slots__ = ("alpha", "value")
+
+    def __init__(self, alpha: float = ALPHA,
+                 initial: Optional[float] = None):
+        self.alpha = float(alpha)
+        self.value = initial
+
+    def add(self, sample: float) -> float:
+        if self.value is None:
+            self.value = float(sample)
+        else:
+            self.value = (self.alpha * float(sample)
+                          + (1.0 - self.alpha) * self.value)
+        return self.value
+
+
+class NodeStatistics:
+    """One tracked node's EWMAs + duress flag (ComputedNodeStats)."""
+
+    __slots__ = ("node_id", "queue_size", "response_time_nanos",
+                 "service_time_nanos", "duress", "duress_updated",
+                 "last_update", "failure_count", "response_count",
+                 "outstanding")
+
+    def __init__(self, node_id: str, now: float):
+        self.node_id = node_id
+        self.queue_size = Ewma()
+        self.response_time_nanos = Ewma()
+        self.service_time_nanos = Ewma()
+        self.duress = False
+        self.duress_updated = now
+        self.last_update = now
+        self.failure_count = 0
+        self.response_count = 0
+        self.outstanding = 0
+
+
+class ResponseCollectorService:
+    """Per-node statistics registry feeding ``rank_copies`` (adaptive
+    replica selection) and ``_nodes/stats`` ``adaptive_selection``."""
+
+    def __init__(self,
+                 clock: Callable[[], float] = time.monotonic,  # clock-default
+                 duress_ttl_s: float = DURESS_TTL_S):
+        self._clock = clock
+        self.duress_ttl_s = float(duress_ttl_s)
+        self._nodes: dict[str, NodeStatistics] = {}
+        self._lock = threading.Lock()
+
+    # -- ingestion ---------------------------------------------------------
+
+    def _entry(self, node: str) -> NodeStatistics:
+        st = self._nodes.get(node)
+        if st is None:
+            st = self._nodes[node] = NodeStatistics(node, self._clock())
+        return st
+
+    def _absorb_load(self, st: NodeStatistics, load: Optional[dict]):
+        """Fold a piggybacked load snapshot (search response or fault-
+        detection ping) into the node's stats.  Caller holds the lock."""
+        if not load:
+            return
+        now = self._clock()
+        if "queue_size" in load:
+            st.queue_size.add(float(load["queue_size"]))
+        svc = load.get("service_time_ewma_nanos")
+        if svc:
+            st.service_time_nanos.add(float(svc))
+        if "duress" in load:
+            st.duress = bool(load["duress"])
+            st.duress_updated = now
+        st.last_update = now
+
+    def record_response(self, node: str, response_time_nanos: float,
+                        load: Optional[dict] = None) -> None:
+        """One successful query-phase RPC: coordinator-measured response
+        time plus whatever the node piggybacked."""
+        with self._lock:
+            st = self._entry(node)
+            st.response_time_nanos.add(float(response_time_nanos))
+            st.response_count += 1
+            st.last_update = self._clock()
+            self._absorb_load(st, load)
+
+    def record_failure(self, node: str, elapsed_nanos: float) -> None:
+        """A failed/timed-out RPC penalizes the node's response EWMA:
+        the sample is the time the coordinator *wasted* (doubled, so a
+        string of timeouts actually deranks the copy instead of
+        averaging against stale fast samples)."""
+        with self._lock:
+            st = self._entry(node)
+            prev = st.response_time_nanos.value or 0.0
+            st.response_time_nanos.add(max(2.0 * float(elapsed_nanos),
+                                           2.0 * prev))
+            st.failure_count += 1
+            st.last_update = self._clock()
+
+    def record_ping_load(self, node: str, load: Optional[dict]) -> None:
+        """Freshness fallback: fault-detection pings carry the same load
+        snapshot, so duress/queue stay current on idle coordinators."""
+        with self._lock:
+            self._absorb_load(self._entry(node), load)
+
+    def record_duress(self, node: str, in_duress: bool) -> None:
+        """Direct seam (tests, local observations)."""
+        with self._lock:
+            st = self._entry(node)
+            st.duress = bool(in_duress)
+            st.duress_updated = self._clock()
+            st.last_update = st.duress_updated
+
+    def incr_outstanding(self, node: str) -> None:
+        with self._lock:
+            self._entry(node).outstanding += 1
+
+    def decr_outstanding(self, node: str) -> None:
+        with self._lock:
+            st = self._nodes.get(node)
+            if st is not None and st.outstanding > 0:
+                st.outstanding -= 1
+
+    def remove_node(self, node: str) -> None:
+        """A node that left the cluster takes its stats with it."""
+        with self._lock:
+            self._nodes.pop(node, None)
+
+    def tracked(self) -> set:
+        with self._lock:
+            return set(self._nodes)
+
+    # -- ranking -----------------------------------------------------------
+
+    def in_duress(self, node: str) -> bool:
+        with self._lock:
+            return self._in_duress_locked(node)
+
+    def _in_duress_locked(self, node: str) -> bool:
+        st = self._nodes.get(node)
+        if st is None or not st.duress:
+            return False
+        # stale flags expire: a shed copy must get re-probed eventually
+        return (self._clock() - st.duress_updated) <= self.duress_ttl_s
+
+    def _rank_locked(self, node: str, clients: int) -> Optional[float]:
+        """C3 rank (lower = better); ``None`` until the coordinator has
+        at least one measured response for the node."""
+        st = self._nodes.get(node)
+        if st is None or st.response_time_nanos.value is None:
+            return None
+        r_ms = st.response_time_nanos.value / 1e6
+        mu = st.service_time_nanos.value
+        mu_ms = max((mu if mu else st.response_time_nanos.value) / 1e6,
+                    1e-3)
+        q_bar = st.queue_size.value or 0.0
+        q_hat = 1.0 + st.outstanding * max(clients, 1) + q_bar
+        return (r_ms - 1.0 / mu_ms
+                + (q_hat ** QUEUE_ADJUSTMENT_FACTOR) / mu_ms)
+
+    def rank(self, node: str) -> Optional[float]:
+        with self._lock:
+            return self._rank_locked(node, len(self._nodes))
+
+    def rank_copies(self, candidates: list) -> tuple:
+        """Order shard copies best-first: healthy before duress
+        (derank-but-retain), then by C3 rank.  Unranked nodes sit at the
+        mean of the known ranks, and the sort is stable, so with no
+        evidence the caller's legacy order survives untouched.  Returns
+        ``(ordered, rerouted)`` — ``rerouted`` is True when adaptive
+        selection changed the preferred copy."""
+        with self._lock:
+            clients = len(self._nodes)
+            ranks = {n: self._rank_locked(n, clients) for n in candidates}
+            duress = {n: self._in_duress_locked(n) for n in candidates}
+            # unranked candidates sit at the FLEET mean (every tracked
+            # node, not just this shard's copies): an unprobed replica
+            # must beat a copy the coordinator has watched fall behind,
+            # and must not displace copies performing at par (the
+            # reference's adjusted-stats exploration)
+            all_known = [r for r in (self._rank_locked(n, clients)
+                                     for n in self._nodes)
+                         if r is not None]
+        known = [v for v in ranks.values() if v is not None]
+        if not known and not any(duress.values()):
+            return list(candidates), False
+        mean = sum(all_known) / len(all_known) if all_known else 0.0
+        ordered = sorted(candidates, key=lambda n: (
+            duress[n], mean if ranks[n] is None else ranks[n]))
+        return ordered, bool(ordered and candidates
+                             and ordered[0] != candidates[0])
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """The ``_nodes/stats`` ``adaptive_selection`` block: per tracked
+        node, the EWMAs (ms), current rank, duress verdict, and sample
+        freshness (the reference's AdaptiveSelectionStats)."""
+        with self._lock:
+            now = self._clock()
+            clients = len(self._nodes)
+            out = {}
+            for node, st in sorted(self._nodes.items()):
+                rank = self._rank_locked(node, clients)
+                out[node] = {
+                    "rank": None if rank is None else round(rank, 3),
+                    "in_duress": self._in_duress_locked(node),
+                    "outstanding_requests": st.outstanding,
+                    "avg_queue_size":
+                        None if st.queue_size.value is None
+                        else round(st.queue_size.value, 2),
+                    "avg_response_time_ms":
+                        None if st.response_time_nanos.value is None
+                        else round(st.response_time_nanos.value / 1e6, 3),
+                    "avg_service_time_ms":
+                        None if st.service_time_nanos.value is None
+                        else round(st.service_time_nanos.value / 1e6, 3),
+                    "response_count": st.response_count,
+                    "failure_count": st.failure_count,
+                    "since_last_update_s":
+                        round(max(0.0, now - st.last_update), 3),
+                }
+            return out

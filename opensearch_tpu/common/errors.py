@@ -175,6 +175,17 @@ class NoShardAvailableError(OpenSearchTpuError):
     status = 503
 
 
+class NodeDuressError(OpenSearchTpuError):
+    """Coordinator-side load shed: every in-sync copy of the shard
+    reported duress, so the query phase fails fast into
+    ``_shards.failures[]`` instead of queueing onto a collapsing node
+    (429-class — the client should back off and retry)."""
+
+    wire_name = "node_duress_exception"
+    status = 429
+    retry_after_seconds = 1
+
+
 class SearchPhaseExecutionError(OpenSearchTpuError):
     """Shard failures the coordinator could not paper over — raised when
     partial results are disallowed (``allow_partial_search_results:

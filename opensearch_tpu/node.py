@@ -59,6 +59,12 @@ class Node:
             self.task_manager, self.thread_pool)
         from opensearch_tpu.security.identity import IdentityService
         self.identity = IdentityService(data_path)
+        # adaptive-selection stats surface (_nodes/stats, _cat/nodes);
+        # populated by the cluster coordinator's scatter path — a
+        # single-node deployment exposes an empty (but present) block
+        from opensearch_tpu.cluster.response_collector import \
+            ResponseCollectorService
+        self.response_collector = ResponseCollectorService()
         self._init_cluster_settings()
         from opensearch_tpu.common.persistent_tasks import \
             PersistentTasksService
@@ -129,6 +135,10 @@ class Node:
         bp_max_cc = Setting.int_setting(
             "search_backpressure.max_concurrent_searches", 256,
             min_value=1, dynamic=True)
+        ars_enabled = Setting.bool_setting(
+            "search.replica_selection.adaptive", True, dynamic=True)
+        ars_shed = Setting.bool_setting(
+            "search.replica_selection.shed_on_duress", True, dynamic=True)
         max_keep_alive = Setting.time_setting(
             "search.max_keep_alive", 24 * 3600.0, dynamic=True)
         default_keep_alive = Setting.time_setting(
@@ -143,6 +153,7 @@ class Node:
             [max_buckets, auto_create, max_scroll, cache_size,
              identity_enabled, alloc_enable, backpressure_mode,
              bp_cpu, bp_heap, bp_queue, bp_streak, bp_max_cc,
+             ars_enabled, ars_shed,
              max_keep_alive, default_keep_alive, allow_partial,
              req_cache_size])
         # search backpressure: the mode setting was validated-but-dead
@@ -160,6 +171,19 @@ class Node:
             self.cluster_settings.add_settings_update_consumer(
                 setting, consumer)
             consumer(self.cluster_settings.get(setting))
+        # adaptive replica selection knobs land on module globals the
+        # cluster coordinator reads per search (same idiom as
+        # DEFAULT_ALLOW_PARTIAL_RESULTS below)
+        from opensearch_tpu.cluster import response_collector as rc_mod
+        self.cluster_settings.add_settings_update_consumer(
+            ars_enabled,
+            lambda v: setattr(rc_mod, "ADAPTIVE_ENABLED", bool(v)))
+        self.cluster_settings.add_settings_update_consumer(
+            ars_shed,
+            lambda v: setattr(rc_mod, "SHED_ON_DURESS", bool(v)))
+        rc_mod.ADAPTIVE_ENABLED = bool(
+            self.cluster_settings.get(ars_enabled))
+        rc_mod.SHED_ON_DURESS = bool(self.cluster_settings.get(ars_shed))
         self.cluster_settings.add_settings_update_consumer(
             req_cache_size,
             lambda v: request_cache().set_max_bytes(int(v)))

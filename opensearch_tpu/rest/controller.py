@@ -601,6 +601,17 @@ class RestController:
                 # cancellation accounting, admission gate occupancy
                 "search_backpressure":
                     self.node.search_backpressure.stats(),
+                # coordinator-side adaptive replica selection: per-node
+                # EWMAs, C3 ranks, duress verdicts, and the reroute/shed
+                # counters (ResponseCollectorService / the reference's
+                # AdaptiveSelectionStats in _nodes/stats)
+                "adaptive_selection": {
+                    "nodes": self.node.response_collector.stats(),
+                    "reroutes": metrics().counter(
+                        "search.replica_selection.reroutes").value,
+                    "sheds": metrics().counter(
+                        "search.replica_selection.sheds").value,
+                },
                 "os": _os_stats(),
                 "process": _process_stats(),
                 # counters + latency histograms with p50/p90/p99 readout
@@ -2185,8 +2196,22 @@ class RestController:
         return AnalysisRegistry()
 
     def h_cat_nodes(self, req):
-        return 200, [{"name": self.node.name, "node.role": "dimr",
-                      "master": "*", "ip": "127.0.0.1"}]
+        """One row per known node; ``search.rank``/``search.duress``
+        expose which copies this coordinator currently prefers (lowest
+        rank wins — the _cat operator view of adaptive_selection)."""
+        ars = self.node.response_collector.stats()
+
+        def row(name, stats, master="-"):
+            rank = (stats or {}).get("rank")
+            return {"name": name, "node.role": "dimr", "master": master,
+                    "ip": "127.0.0.1",
+                    "search.rank": "-" if rank is None else f"{rank:.3f}",
+                    "search.duress":
+                        str(bool((stats or {}).get("in_duress"))).lower()}
+        rows = [row(self.node.name, ars.get(self.node.name), master="*")]
+        rows.extend(row(n, s) for n, s in sorted(ars.items())
+                    if n != self.node.name)
+        return 200, rows
 
     def h_cat_aliases(self, req):
         rows = []

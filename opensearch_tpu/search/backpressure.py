@@ -377,10 +377,17 @@ class SearchBackpressureService:
         self._monitor.start()
 
     def stop_monitor(self) -> None:
+        # bounded join: teardown must return even if a tick is wedged in
+        # a probe — the thread is a daemon, so a missed join can't block
+        # process exit either
         monitor, self._monitor = self._monitor, None
         if monitor is not None:
             self._stop.set()
             monitor.join(timeout=5)
+
+    def monitor_alive(self) -> bool:
+        monitor = self._monitor
+        return monitor is not None and monitor.is_alive()
 
     # -- observability -----------------------------------------------------
 
@@ -390,9 +397,12 @@ class SearchBackpressureService:
         # consults in_duress() (service lock) — taking the locks in the
         # opposite order here would deadlock
         admission_stats = self.admission.stats()
+        monitor_alive = self.monitor_alive()
         with self._lock:
             return {
                 "mode": self._mode,
+                "monitor": {"running": monitor_alive,
+                            "interval_s": self.interval_s},
                 "cancellation_count": self.cancellation_count,
                 "monitor_only_count": self.monitor_only_count,
                 "limit_reached_count": self.limit_reached_count,

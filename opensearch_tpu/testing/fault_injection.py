@@ -182,6 +182,17 @@ class FaultInjector:
         return self._install(_Stall(self, action, source, target,
                                     probability, times))
 
+    def slow_search_node(self, node_id: str, seconds: float,
+                         times: Optional[int] = None):
+        """Degrade one data node's shard query phase: every
+        ``indices:data/read/search[shards]`` frame TO ``node_id`` is
+        delayed — the canonical adaptive-replica-selection scenario (the
+        coordinator should derank the node and reroute to healthy
+        copies)."""
+        from opensearch_tpu.cluster.node import A_SEARCH_SHARDS
+        return self.delay(seconds, action=A_SEARCH_SHARDS,
+                          target=node_id, times=times)
+
     def induce_search_duress(self, service, ticks: int = 1) -> None:
         """Deterministic duress simulation: force the given
         SearchBackpressureService's next ``ticks`` evaluations to read

@@ -11,6 +11,13 @@ the line above, asserting a human decided a timestamp is intended.
 New un-annotated call sites fail tier-1 (tests/test_telemetry.py runs
 this check).
 
+Injectable-clock modules (``INJECTABLE_CLOCK_MODULES``) get a stricter
+rule: even ``time.monotonic`` is banned there, because their timing
+logic (EWMA decay, duress-flag freshness) must be drivable by a fake
+clock in deterministic tests.  The only allowed reference is the
+injectable default parameter, annotated ``# clock-default`` on the same
+line or the line above.
+
 Usage: python tools/check_monotonic.py [root]   (exit 0 = clean)
 """
 
@@ -23,24 +30,38 @@ import sys
 CALL = re.compile(r"\btime\.time\(\)")
 ANNOTATION = "# wall-clock"
 
+# relative paths (under the scanned root) whose timing logic must flow
+# exclusively through an injectable clock parameter
+INJECTABLE_CLOCK_MODULES = {
+    os.path.join("cluster", "response_collector.py"),
+}
+MONO = re.compile(r"\btime\.monotonic\b")
+CLOCK_ANNOTATION = "# clock-default"
 
-def check_file(path: str) -> list[str]:
+
+def check_file(path: str, strict_clock: bool = False) -> list[str]:
     with open(path, encoding="utf-8") as f:
         lines = f.readlines()
     problems = []
     for i, line in enumerate(lines):
-        if not CALL.search(line):
-            continue
         stripped = line.strip()
         if stripped.startswith("#"):
             continue                     # commented-out code
         prev = lines[i - 1] if i > 0 else ""
-        if ANNOTATION in line or ANNOTATION in prev:
-            continue
-        problems.append(
-            f"{path}:{i + 1}: time.time() without a '{ANNOTATION}' "
-            "annotation — use time.monotonic() for durations, or "
-            "annotate why a wall timestamp is intended")
+        if CALL.search(line) and ANNOTATION not in line \
+                and ANNOTATION not in prev:
+            problems.append(
+                f"{path}:{i + 1}: time.time() without a '{ANNOTATION}' "
+                "annotation — use time.monotonic() for durations, or "
+                "annotate why a wall timestamp is intended")
+        if strict_clock and MONO.search(line) \
+                and CLOCK_ANNOTATION not in line \
+                and CLOCK_ANNOTATION not in prev:
+            problems.append(
+                f"{path}:{i + 1}: direct time.monotonic reference in an "
+                "injectable-clock module — route it through the clock "
+                f"parameter, or annotate the default with "
+                f"'{CLOCK_ANNOTATION}'")
     return problems
 
 
@@ -51,12 +72,16 @@ def main(argv: list[str]) -> int:
     problems = []
     for dirpath, _dirnames, filenames in os.walk(root):
         for name in sorted(filenames):
-            if name.endswith(".py"):
-                problems.extend(check_file(os.path.join(dirpath, name)))
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root)
+            problems.extend(check_file(
+                path, strict_clock=rel in INJECTABLE_CLOCK_MODULES))
     for p in problems:
         print(p)
     if problems:
-        print(f"{len(problems)} un-annotated time.time() call site(s)")
+        print(f"{len(problems)} clock-discipline violation(s)")
     return 1 if problems else 0
 
 
