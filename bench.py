@@ -119,12 +119,11 @@ def make_segment(raw):
 
 
 def gen_query_terms(n_queries: int, seed: int = 7):
-    rng = np.random.default_rng(seed)
-    pairs = []
-    for _ in range(n_queries):
-        a, b = (rng.zipf(1.3, size=2) - 1).clip(0, VOCAB_SIZE - 1)
-        pairs.append((int(a), int(b)))
-    return pairs
+    # the seeded zipf query log lives in the soak harness now (the soak
+    # workload and this bench measure the SAME traffic shape); identical
+    # draws to the pre-refactor inline version
+    from opensearch_tpu.testing.workload import zipf_query_log
+    return zipf_query_log(n_queries, VOCAB_SIZE, seed=seed)
 
 
 def numpy_bm25_baseline(raw, pairs, k: int = 10) -> dict:
@@ -298,10 +297,60 @@ def main():
         "p50_ms": round(p50, 3), "p99_ms": round(p99, 3),
         **hot_path_counters()})
 
+    # -- phase: soak (chaos SLO scenario over a 3-node cluster) -----------
+    # runs LAST so a wedge here cannot cost the phases above; failures
+    # are reported as a phase line, never swallowed
+    if os.environ.get("OSTPU_BENCH_SOAK", "1") != "0":
+        try:
+            run_soak_phase(platform)
+        except Exception as e:  # noqa: BLE001 — report, keep the bench
+            phase_report("soak", {"platform": platform,
+                                  "error": f"{type(e).__name__}: {e}"})
+
     print(json.dumps(final_line(
         qps=qps, baseline_qps=baseline_qps, platform=platform,
         extra={"qps_sequential": round(qps_seq, 1), "p50_ms": round(p50, 3),
                "p99_ms": round(p99, 3), "batch": batch, "n_docs": n_docs})))
+
+
+def run_soak_phase(platform: str):
+    """Chaos-soak SLO line: a seeded mixed workload (this bench's zipf
+    query shape + bulk/refresh + aggs + paged walks + msearch) drives a
+    3-node in-process cluster through a seeded fault schedule (node
+    kill + re-election, slow node, drop/stall, induced duress, network
+    partition), and the SLO verdicts + degradation counters land in the
+    phases file — the robustness spine (PRs 2/4/6) as a bench
+    trajectory, not just tests (ROADMAP item 5)."""
+    import tempfile
+    import shutil as _shutil
+
+    from opensearch_tpu.testing.workload import run_soak
+
+    n_ops = int(os.environ.get("OSTPU_BENCH_SOAK_OPS", 96))
+    root = tempfile.mkdtemp(prefix="bench-soak-")
+    t0 = time.monotonic()
+    try:
+        report = run_soak(root, seed=42, n_ops=n_ops)
+    finally:
+        _shutil.rmtree(root, ignore_errors=True)
+    chaos = report["chaos"]
+    conv = next((v for v in report["verdicts"]
+                 if v["slo"] == "convergence"), {})
+    phase_report("soak", {
+        "platform": platform, "wall_s": round(time.monotonic() - t0, 1),
+        "ops": chaos["ops"], "slo_ok": report["slo_ok"],
+        **{f"p99_{k}_ms": v for k, v in sorted(chaos["p99_ms"].items())},
+        "rejection_rate": round(chaos["rejected"] / max(chaos["ops"], 1),
+                                4),
+        "sheds": chaos["sheds"], "reroutes": chaos["reroutes"],
+        "failovers": chaos["failovers"],
+        "recoveries": chaos["recoveries"],
+        "client_retries": chaos["client_retries"],
+        "partial_results": chaos["partial_results"],
+        "unexpected_errors": len(chaos["unexpected_errors"]),
+        "convergence": bool(conv.get("ok")),
+        "doc_count": chaos["final_state"].get("doc_count"),
+    })
 
 
 def final_line(*, qps, baseline_qps, platform, extra=None):

@@ -783,18 +783,37 @@ class ClusterNode:
             order.insert(0, self.node_id)
         if not rc.ADAPTIVE_ENABLED or len(order) < 2:
             return order
-        ranked, rerouted = self.response_collector.rank_copies(order)
+        collector = self.response_collector
+        ranked, rerouted = collector.rank_copies(order)
         if rerouted:
             metrics().counter("search.replica_selection.reroutes").inc()
         if spill:
             # round-robin the healthy prefix: msearch batch member i
             # starts at healthy copy i % n (replica spill)
             healthy = [n for n in ranked
-                       if not self.response_collector.in_duress(n)]
+                       if not collector.in_duress(n)]
             if len(healthy) > 1:
                 k = spill % len(healthy)
                 ranked = (healthy[k:] + healthy[:k]
                           + [n for n in ranked if n not in healthy])
+        elif rc.SPILL_OUTSTANDING > 0:
+            # single-search spill: the C3 rank only moves once response
+            # samples land, but outstanding counts move per RPC — a
+            # burst of plain _search requests rotates off the preferred
+            # copy the moment it has too many in flight, instead of
+            # queueing behind the EWMA's reaction time
+            pref = ranked[0]
+            if collector.outstanding(pref) > rc.SPILL_OUTSTANDING:
+                alts = [n for n in ranked[1:]
+                        if not collector.in_duress(n)]
+                if alts:
+                    alt = min(alts, key=collector.outstanding)
+                    if collector.outstanding(alt) \
+                            < collector.outstanding(pref):
+                        ranked.remove(alt)
+                        ranked.insert(0, alt)
+                        metrics().counter(
+                            "search.replica_selection.reroutes").inc()
         return ranked
 
     def _query_group(self, node: str, payload: dict) -> dict:
@@ -836,10 +855,6 @@ class ClusterNode:
         results are allowed, and the survivors' top-k merges on this
         node.  ``_spill`` is the msearch batch-member index — it rotates
         each shard's healthy copies so a batch spreads over replicas."""
-        from opensearch_tpu.cluster import response_collector as rc
-        from opensearch_tpu.common import tasks as taskmod
-        from opensearch_tpu.common.errors import NodeDuressError
-        from opensearch_tpu.common.telemetry import metrics
         from opensearch_tpu.search import executor as _exec
 
         body = dict(body or {})
@@ -847,6 +862,23 @@ class ClusterNode:
         if allow_partial is None:
             allow_partial = _exec.DEFAULT_ALLOW_PARTIAL_RESULTS
         allow_partial = bool(allow_partial)
+        # coordinator-scope admission: the scatter holds a permit from
+        # the SAME gate the REST edge uses, so cluster searches and HTTP
+        # searches share one concurrency budget (and one occupancy
+        # signal for the shed decision below); saturation rejects with
+        # 429 here instead of queueing scatters unboundedly
+        with self.search_backpressure.admission.acquire("search"):
+            return self._search_admitted(index, body, allow_partial,
+                                         _spill)
+
+    def _search_admitted(self, index: str, body: dict,
+                         allow_partial: bool, _spill: int) -> dict:
+        from opensearch_tpu.cluster import response_collector as rc
+        from opensearch_tpu.common import tasks as taskmod
+        from opensearch_tpu.common.errors import NodeDuressError
+        from opensearch_tpu.common.telemetry import metrics
+        from opensearch_tpu.search import executor as _exec
+
         state = self.coordinator.state()
         routing = state.routing.get(index)
         if routing is None:
@@ -867,14 +899,21 @@ class ClusterNode:
         # copy reports duress fails fast into _shards.failures[] instead
         # of queueing onto a collapsing node (only under partial-results
         # semantics — with allow_partial=false the client asked for
-        # all-or-nothing, so we still try)
-        if allow_partial and rc.SHED_ON_DURESS:
+        # all-or-nothing, so we still try).  The decision consults the
+        # admission gate's occupancy: below the configured fraction the
+        # coordinator still has capacity to try a duressed copy as a
+        # last resort; at/above it the shed fails fast, and draws from
+        # the same rejection budget as the gate's 429s
+        admission = self.search_backpressure.admission
+        if allow_partial and rc.SHED_ON_DURESS \
+                and admission.occupancy() >= rc.SHED_OCCUPANCY:
             for shard in sorted(candidates):
                 cands = candidates[shard]
                 if not all(self.response_collector.in_duress(n)
                            for n in cands):
                     continue
                 metrics().counter("search.replica_selection.sheds").inc()
+                admission.record_shed()
                 failures.append(_exec.shard_failure_entry(
                     index, shard, cands[0], NodeDuressError(
                         f"[{index}][{shard}] shed: all in-sync copies "

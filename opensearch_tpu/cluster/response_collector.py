@@ -50,6 +50,19 @@ DURESS_TTL_S = 5.0
 ADAPTIVE_ENABLED = True
 SHED_ON_DURESS = True
 
+#: single-search replica spill: a plain ``_search`` scatter rotates off
+#: the preferred copy once the coordinator already has more than this
+#: many outstanding query-phase RPCs against it (0 disables — msearch
+#: batch rotation is unaffected either way)
+SPILL_OUTSTANDING = 8
+
+#: duress sheds consult the coordinator's own admission-gate occupancy:
+#: a shard whose every copy reports duress is shed only when occupancy
+#: >= this fraction — below it the coordinator has capacity to try the
+#: duressed copy as a last resort.  0.0 = always shed (legacy PR-6
+#: behavior); 1.0 = only shed at the 429 edge
+SHED_OCCUPANCY = 0.0
+
 
 class Ewma:
     """Exponentially weighted moving average; ``value`` is ``None``
@@ -175,6 +188,13 @@ class ResponseCollectorService:
             st = self._nodes.get(node)
             if st is not None and st.outstanding > 0:
                 st.outstanding -= 1
+
+    def outstanding(self, node: str) -> int:
+        """Coordinator-side in-flight query-phase RPCs against ``node``
+        (the C3 q̂ ingredient; also the single-search spill signal)."""
+        with self._lock:
+            st = self._nodes.get(node)
+            return 0 if st is None else st.outstanding
 
     def remove_node(self, node: str) -> None:
         """A node that left the cluster takes its stats with it."""

@@ -303,7 +303,9 @@ class InternalEngine:
         return OpResult(str(doc_id), seq_no, version, "deleted")
 
     def ensure_synced(self):
-        """Durability barrier before acking (Translog.ensureSynced analog)."""
+        """Durability barrier before acking (Translog.ensureSynced analog).
+        Safe to call from concurrent write RPCs: the translog serializes
+        its own sync/checkpoint internally."""
         self.translog.sync()
 
     # -- replica mode (segment replication, NRTReplicationEngine analog) --

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import zlib
 from typing import Iterator, Optional
 
@@ -58,6 +59,11 @@ class Translog:
         self._file = open(self._gen_path(self.generation), "ab")
         self._synced_offset = synced
         self._ops_since_sync = 0
+        # serializes sync()'s fsync + checkpoint replace: concurrent
+        # write RPCs each call ensure_synced() before acking, and two
+        # unserialized checkpoint writers race the same .ckp.tmp rename
+        # (found by the chaos-soak harness's concurrent bulk workload)
+        self._sync_lock = threading.Lock()
 
     @staticmethod
     def _truncate_torn_tail(path: str, synced_offset: int = 0):
@@ -173,16 +179,17 @@ class Translog:
         high-water mark in the checkpoint, like the reference's per-sync
         Checkpoint file — recovery uses it to tell acked-data corruption
         (fatal) from unacked-tail garbage (truncatable)."""
-        if self._ops_since_sync == 0 and \
-                self._synced_offset == self._file.tell():
-            return   # already durable: skip the double fsync per op
-        from opensearch_tpu.common.telemetry import metrics
-        with metrics().time_ms("translog.sync_ms"):
-            self._file.flush()
-            os.fsync(self._file.fileno())
-            self._synced_offset = self._file.tell()
-            self._ops_since_sync = 0
-            self._write_checkpoint()
+        with self._sync_lock:
+            if self._ops_since_sync == 0 and \
+                    self._synced_offset == self._file.tell():
+                return   # already durable: skip the double fsync per op
+            from opensearch_tpu.common.telemetry import metrics
+            with metrics().time_ms("translog.sync_ms"):
+                self._file.flush()
+                os.fsync(self._file.fileno())
+                self._synced_offset = self._file.tell()
+                self._ops_since_sync = 0
+                self._write_checkpoint()
 
     def roll_generation(self):
         """Start a new generation file (pre-commit, rollGeneration analog)."""

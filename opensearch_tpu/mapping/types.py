@@ -59,16 +59,19 @@ def format_date_millis(millis: int) -> str:
 
 
 def parse_ip_long(value: Any) -> int:
-    """IPs are stored as a single int64 doc value ordered like the
-    reference (16-byte comparison with v4 embedded at ``::ffff:0:0/96``,
-    so ``::1`` < any v4 < global-unicast v6).  The 128-bit form is
-    monotone-compressed: values below 2^49 (every v4-mapped address and
-    the low v6 space) keep full precision; higher v6 addresses keep
-    their top 62 bits (range comparisons there are coarse — exact term
-    matches ride the inverted index, which keeps the canonical string).
-    """
+    """IPs are stored as a single int64 doc value with an
+    order-preserving encoding: every v4 address sits in the negative
+    range (``int(addr) - 2^32``), every v6 address in the non-negative
+    one, so v4 < ``::`` < the whole v6 space and each family keeps its
+    natural order.  The 128-bit v6 form is monotone-compressed: values
+    below 2^49 (the low v6 space, including v4-mapped ``::ffff:0:0/96``
+    literals) keep full precision; higher v6 addresses keep their top
+    62 bits (range comparisons there are coarse — exact term matches
+    ride the inverted index, which keeps the canonical string)."""
     addr = ipaddress.ip_address(str(value))
-    v = ((0xFFFF << 32) | int(addr)) if addr.version == 4 else int(addr)
+    if addr.version == 4:
+        return int(addr) - (1 << 32)
+    v = int(addr)
     if v < (1 << 49):
         return v
     return (1 << 49) + (v >> 66)

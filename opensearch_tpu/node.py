@@ -139,6 +139,12 @@ class Node:
             "search.replica_selection.adaptive", True, dynamic=True)
         ars_shed = Setting.bool_setting(
             "search.replica_selection.shed_on_duress", True, dynamic=True)
+        ars_spill = Setting.int_setting(
+            "search.replica_selection.spill_outstanding", 8,
+            min_value=0, dynamic=True)
+        ars_shed_occ = Setting.float_setting(
+            "search.replica_selection.shed_occupancy", 0.0,
+            min_value=0.0, dynamic=True)
         max_keep_alive = Setting.time_setting(
             "search.max_keep_alive", 24 * 3600.0, dynamic=True)
         default_keep_alive = Setting.time_setting(
@@ -153,7 +159,7 @@ class Node:
             [max_buckets, auto_create, max_scroll, cache_size,
              identity_enabled, alloc_enable, backpressure_mode,
              bp_cpu, bp_heap, bp_queue, bp_streak, bp_max_cc,
-             ars_enabled, ars_shed,
+             ars_enabled, ars_shed, ars_spill, ars_shed_occ,
              max_keep_alive, default_keep_alive, allow_partial,
              req_cache_size])
         # search backpressure: the mode setting was validated-but-dead
@@ -181,9 +187,19 @@ class Node:
         self.cluster_settings.add_settings_update_consumer(
             ars_shed,
             lambda v: setattr(rc_mod, "SHED_ON_DURESS", bool(v)))
+        self.cluster_settings.add_settings_update_consumer(
+            ars_spill,
+            lambda v: setattr(rc_mod, "SPILL_OUTSTANDING", int(v)))
+        self.cluster_settings.add_settings_update_consumer(
+            ars_shed_occ,
+            lambda v: setattr(rc_mod, "SHED_OCCUPANCY", float(v)))
         rc_mod.ADAPTIVE_ENABLED = bool(
             self.cluster_settings.get(ars_enabled))
         rc_mod.SHED_ON_DURESS = bool(self.cluster_settings.get(ars_shed))
+        rc_mod.SPILL_OUTSTANDING = int(
+            self.cluster_settings.get(ars_spill))
+        rc_mod.SHED_OCCUPANCY = float(
+            self.cluster_settings.get(ars_shed_occ))
         self.cluster_settings.add_settings_update_consumer(
             req_cache_size,
             lambda v: request_cache().set_max_bytes(int(v)))

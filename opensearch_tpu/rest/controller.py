@@ -611,6 +611,13 @@ class RestController:
                         "search.replica_selection.reroutes").value,
                     "sheds": metrics().counter(
                         "search.replica_selection.sheds").value,
+                    # the unified overload budget: edge 429s and
+                    # coordinator duress sheds draw from ONE admission
+                    # gate, so its occupancy/rejection ledger shows up
+                    # here too (same numbers as search_backpressure's
+                    # admission_control block, by construction)
+                    "budget":
+                        self.node.search_backpressure.admission.stats(),
                 },
                 "os": _os_stats(),
                 "process": _process_stats(),

@@ -136,7 +136,26 @@ class SearchAdmissionController:
         self.max_concurrent = int(max_concurrent)
         self._inflight = 0
         self.rejected_count = 0
+        # coordinator-side duress sheds draw from the SAME budget as
+        # edge 429s: one client-visible-rejection ledger, one occupancy
+        # signal (ROADMAP item 4's unified overload budget)
+        self.shed_count = 0
         self._lock = threading.Lock()
+
+    def occupancy(self) -> float:
+        """Permit-gate utilization in [0, 1] — the shared overload
+        signal coordinator shed decisions consult."""
+        with self._lock:
+            if self.max_concurrent <= 0:
+                return 1.0
+            return self._inflight / self.max_concurrent
+
+    def record_shed(self, n: int = 1) -> None:
+        """A coordinator-side duress shed counted against this gate's
+        rejection budget (429s and sheds are the same client-visible
+        degradation, so they share one ledger)."""
+        with self._lock:
+            self.shed_count += int(n)
 
     @contextlib.contextmanager
     def acquire(self, kind: str = "search"):
@@ -164,9 +183,14 @@ class SearchAdmissionController:
 
     def stats(self) -> dict:
         with self._lock:
+            occupancy = (self._inflight / self.max_concurrent
+                         if self.max_concurrent > 0 else 1.0)
             return {"current": self._inflight,
                     "max_concurrent": self.max_concurrent,
-                    "rejected_count": self.rejected_count}
+                    "occupancy": round(occupancy, 4),
+                    "rejected_count": self.rejected_count,
+                    "shed_count": self.shed_count,
+                    "rejected_total": self.rejected_count + self.shed_count}
 
 
 class SearchBackpressureService:

@@ -1472,22 +1472,18 @@ def _c_intervals(q, ctx, scored):
             return compile_query(dsl.BoolQuery(must=wrapped,
                                                boost=q.boost),
                                  ctx, scored)
-        if kind in ("prefix", "wildcard", "regexp", "fuzzy"):
+        if kind in ("prefix", "wildcard", "regexp"):
             # multi-term rules expand against the term dictionary and
             # compile as a should-of-1 over the expansions
-            # (IntervalsSourceProvider's Prefix/Wildcard/Regexp/Fuzzy)
+            # (IntervalsSourceProvider's Prefix/Wildcard/Regexp; the
+            # reference has no fuzzy interval source, so `fuzzy` — an
+            # edit-distance expansion with no positional semantics here —
+            # is rejected below rather than silently over-matching)
             import re as _re
 
             if kind == "prefix":
                 pat = str(body.get("prefix", ""))
                 terms = _expand_prefix_terms(ctx, q.field, pat, 128)
-            elif kind == "fuzzy":
-                term = str(body.get("term", ""))
-                return compile_query(dsl.FuzzyQuery(
-                    field=q.field, value=term,
-                    fuzziness=str(body.get("fuzziness", "AUTO")),
-                    prefix_length=int(body.get("prefix_length", 0)),
-                    boost=q.boost), ctx, scored)
             else:
                 pat = str(body.get("pattern", ""))
                 flags = (_re.IGNORECASE
@@ -1516,7 +1512,7 @@ def _c_intervals(q, ctx, scored):
                 minimum_should_match="1", boost=q.boost), ctx, scored)
         raise IllegalArgumentError(
             f"[intervals] unsupported rule [{kind}] — supported: "
-            "match, any_of, all_of, prefix, wildcard, regexp, fuzzy")
+            "match, any_of, all_of, prefix, wildcard, regexp")
 
     return compile_rule(q.rule)
 

@@ -17,6 +17,8 @@ Usage::
     faults.delay(0.2, action="internal:coordination/*")
     faults.disconnect("n2")          # full partition
     faults.heal("n2")                # lift it
+    faults.partition({"n0"}, {"n1", "n2"})   # symmetric two-sided split
+    faults.heal_partition()          # reconnect the halves
     faults.clear()                   # lift everything
 """
 
@@ -126,6 +128,24 @@ class _Stall(_Rule):
         self.gate.set()
 
 
+class _Partition:
+    """Symmetric network split: frames CROSSING the cut (either
+    direction) fail fast; traffic within each side flows normally — the
+    ``NetworkDisruption.TwoPartitions`` analog (a ``disconnect`` is the
+    degenerate one-node-vs-everyone case)."""
+
+    def __init__(self, side_a, side_b):
+        self.side_a = frozenset(side_a)
+        self.side_b = frozenset(side_b)
+
+    def __call__(self, src: str, dst: str, frame: bytes):
+        if (src in self.side_a and dst in self.side_b) \
+                or (src in self.side_b and dst in self.side_a):
+            raise NodeDisconnectedError(
+                f"[fault_injection] partition cut {src}->{dst}")
+        return None
+
+
 class FaultInjector:
     """Installs/uninstalls rules on a ``LocalTransport.Hub``; every
     random draw comes from one seeded stream guarded by a lock, so a
@@ -139,6 +159,7 @@ class FaultInjector:
         self._rng_lock = threading.Lock()
         self._installed: list = []
         self._partitions: dict[str, object] = {}
+        self._group_partitions: list[_Partition] = []
 
     def _random(self) -> float:
         with self._rng_lock:
@@ -210,6 +231,29 @@ class FaultInjector:
         self._partitions[node_id] = rule
         return rule
 
+    def partition(self, side_a, side_b) -> _Partition:
+        """Symmetric split between two node groups: every frame crossing
+        the cut fails fast in BOTH directions, while each side keeps
+        talking internally (so a minority side can still try — and fail —
+        to reach quorum).  Returns the rule; ``heal_partition()`` lifts
+        it (or all of them)."""
+        rule = _Partition(side_a, side_b)
+        self._install(rule)
+        self._group_partitions.append(rule)
+        return rule
+
+    def heal_partition(self, rule: Optional[_Partition] = None) -> bool:
+        """Lift one ``partition()`` (or every installed one)."""
+        victims = ([rule] if rule is not None
+                   else list(self._group_partitions))
+        healed = False
+        for r in victims:
+            if r in self._group_partitions:
+                self._group_partitions.remove(r)
+                self._installed.remove(r)
+                healed = self.hub.remove_rule(r) or healed
+        return healed
+
     def heal(self, node_id: str) -> bool:
         """Lift a ``disconnect`` partition."""
         rule = self._partitions.pop(node_id, None)
@@ -224,6 +268,8 @@ class FaultInjector:
         for nid, r in list(self._partitions.items()):
             if r is rule:
                 del self._partitions[nid]
+        if rule in self._group_partitions:
+            self._group_partitions.remove(rule)
         return self.hub.remove_rule(rule)
 
     def clear(self):
@@ -233,3 +279,4 @@ class FaultInjector:
             self.hub.remove_rule(rule)
         self._installed.clear()
         self._partitions.clear()
+        self._group_partitions.clear()

@@ -1,0 +1,784 @@
+"""Chaos-soak harness: seeded mixed workload + fault schedule + SLOs.
+
+Analog of the reference's nightly benchmark/disruption runs (the
+OpenSearch-benchmark mixed workloads driven against a cluster that
+`NetworkDisruption`-style tests are killing underneath) collapsed into
+one deterministic in-process subsystem:
+
+- ``MixedWorkload``: a seeded generator of interleaved operation
+  classes — zipf BM25 queries (the same query-log shape ``bench.py``
+  measures), bulk ingest + refresh, ``date_histogram``/``terms``
+  aggregations, scroll-style paged walks, and msearch batches.
+- ``FaultSchedule``: a seeded schedule of fault directives pinned to
+  operation indices (never wall clock): kill-the-leader + re-election,
+  ``slow_search_node``, drop/stall rules, induced duress, and a
+  symmetric network ``partition()`` — all via
+  ``testing/fault_injection.py`` over the LocalTransport hub.
+- ``SoakRunner``: drives a multi-node ``ClusterNode`` cluster through
+  the workload while executing the schedule, collects per-op-class
+  latency histograms plus rejection/shed/partial/retry accounting from
+  the PR-1 metrics registry, and evaluates declarative SLOs: p99 per op
+  class, a client-visible-error budget (429s and partial results are
+  allowed degradation; unexpected 5xx budget is zero), and a post-fault
+  convergence invariant — after the schedule drains, doc count and a
+  content checksum must match an uninjected control run.
+
+The same seed replays the same op stream, the same fault schedule, and
+the same SLO verdicts — the regression gate ROADMAP item 5 asks for,
+enforced in tier-1 via ``tests/test_soak.py`` and recorded as a
+``soak`` phase line in ``bench_phases.jsonl`` by ``bench.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import shutil
+import tempfile
+import threading
+import time
+import zlib
+from typing import Callable, Optional
+
+import numpy as np
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+from opensearch_tpu.common.telemetry import Histogram, metrics
+
+#: transport failures a real client retries (retryable 503 class);
+#: anything else client-visible above 399 that is not a 429 counts
+#: against the zero-unexpected-error budget
+_RETRYABLE_TYPES = ("node_disconnected_exception",
+                    "receive_timeout_transport_exception",
+                    "no_master_exception", "coordination_exception")
+
+
+def _bump(ctx: dict, key: str, n: int = 1) -> None:
+    """Locked counter increment — the full configuration runs ops on a
+    worker pool, so the run context's tallies must not race."""
+    with ctx["lock"]:
+        ctx[key] += n
+
+
+def zipf_query_log(n_queries: int, vocab_size: int,
+                   seed: int = 7, a: float = 1.3) -> list:
+    """Seeded zipf query log: ``n_queries`` two-term BM25 queries over a
+    ranked vocabulary — the exact sampling ``bench.py`` measures with
+    (bench imports THIS function), reused here so soak traffic has the
+    same term-frequency shape as the flagship benchmark."""
+    rng = np.random.default_rng(seed)
+    pairs = []
+    for _ in range(n_queries):
+        x, y = (rng.zipf(a, size=2) - 1).clip(0, vocab_size - 1)
+        pairs.append((int(x), int(y)))
+    return pairs
+
+
+class SoakConfig:
+    """Declarative soak scenario: workload mix, cluster shape, fault
+    schedule knobs, and SLOs.  ``smoke()`` is the fixed-seed tier-1
+    configuration (small, deterministic, seconds); ``full()`` is the
+    production soak marked ``slow`` in the test suite."""
+
+    def __init__(self, *, seed: int = 42, n_ops: int = 48,
+                 n_docs: int = 24, bulk_size: int = 3,
+                 vocab_size: int = 48, index: str = "soak",
+                 shards: int = 2, replicas: int = 1,
+                 node_ids: tuple = ("n0", "n1", "n2"),
+                 client: str = "n1", concurrency: int = 1,
+                 search_rpc_timeout: float = 0.5,
+                 max_retries: int = 6,
+                 faults_enabled: bool = True,
+                 control_run: bool = True,
+                 schedule: Optional[list] = None,
+                 slos: Optional[dict] = None):
+        self.seed = int(seed)
+        self.n_ops = int(n_ops)
+        self.n_docs = int(n_docs)
+        self.bulk_size = int(bulk_size)
+        self.vocab_size = int(vocab_size)
+        self.index = index
+        self.shards = int(shards)
+        self.replicas = int(replicas)
+        self.node_ids = tuple(node_ids)
+        self.client = client
+        self.concurrency = int(concurrency)
+        self.search_rpc_timeout = float(search_rpc_timeout)
+        self.max_retries = int(max_retries)
+        self.faults_enabled = bool(faults_enabled)
+        self.control_run = bool(control_run)
+        # an explicit directive list overrides the seeded generator —
+        # focused scenarios (partition-only round-trips, single-fault
+        # repros) reuse the whole runner
+        self.schedule = schedule
+        self.slos = slos if slos is not None else {
+            # generous CI-safe p99 bounds: the verdicts must be
+            # deterministic across runs/hosts; the OBSERVED p99 is what
+            # the bench trajectory tracks run over run
+            "p99_ms": {"search": 10_000.0, "msearch": 20_000.0,
+                       "bulk": 10_000.0, "agg": 15_000.0,
+                       "scroll": 15_000.0},
+            "max_rejection_rate": 0.5,
+            "max_unexpected_errors": 0,
+            "require_convergence": True,
+        }
+
+    @classmethod
+    def smoke(cls, **overrides) -> "SoakConfig":
+        return cls(**overrides)
+
+    @classmethod
+    def full(cls, **overrides) -> "SoakConfig":
+        base = {"n_ops": 400, "n_docs": 400, "bulk_size": 10,
+                "vocab_size": 2000, "concurrency": 4}
+        base.update(overrides)
+        return cls(**base)
+
+
+class MixedWorkload:
+    """Seeded mixed-operation stream.  Every op is a plain dict (class +
+    parameters), so the stream is inspectable, replayable, and identical
+    across runs with the same config."""
+
+    CLASSES = ("search", "msearch", "bulk", "agg", "scroll")
+
+    def __init__(self, config: SoakConfig):
+        self.config = config
+        self._rng = random.Random(config.seed)
+        self._doc_seq = config.n_docs          # ids after the seed corpus
+        self._queries = zipf_query_log(
+            max(64, config.n_ops * 2), config.vocab_size,
+            seed=config.seed)
+        self._qi = 0
+        self.tags = [f"tag{i}" for i in range(8)]
+
+    # -- documents ---------------------------------------------------------
+
+    def make_doc(self, i: int) -> dict:
+        """Deterministic per-id document: zipf text body, a timestamp
+        walking forward one minute per doc (date_histogram fodder), a
+        zipf-ish tag (terms-agg fodder), and a sortable long."""
+        rng = random.Random((self.config.seed << 20) ^ i)
+        n_terms = rng.randint(4, 10)
+        body = " ".join(
+            f"t{min(int(rng.paretovariate(1.3)) - 1, self.config.vocab_size - 1)}"
+            for _ in range(n_terms))
+        return {"body": body,
+                "ts": 1_700_000_000_000 + i * 60_000,
+                "tag": self.tags[min(int(rng.paretovariate(1.5)) - 1,
+                                     len(self.tags) - 1)],
+                "v": i}
+
+    def seed_docs(self) -> list:
+        return [(str(i), self.make_doc(i)) for i in range(self.config.n_docs)]
+
+    # -- operations --------------------------------------------------------
+
+    def _next_query(self) -> dict:
+        a, b = self._queries[self._qi % len(self._queries)]
+        self._qi += 1
+        return {"query": {"match": {"body": f"t{a} t{b}"}}, "size": 10}
+
+    def _op(self, kind: str) -> dict:
+        if kind == "search":
+            return {"op": "search", "body": self._next_query()}
+        if kind == "msearch":
+            return {"op": "msearch",
+                    "bodies": [self._next_query() for _ in range(4)]}
+        if kind == "bulk":
+            docs = []
+            for _ in range(self.config.bulk_size):
+                i = self._doc_seq
+                self._doc_seq += 1
+                docs.append((str(i), self.make_doc(i)))
+            delete_id = None
+            if self._rng.random() < 0.2 and self._doc_seq > 4:
+                # delete an early seed doc (deterministic victim), the
+                # mixed-workload CRUD shape; convergence tracks it too
+                delete_id = str(self._rng.randrange(4))
+            return {"op": "bulk", "docs": docs, "delete": delete_id,
+                    "refresh": self._rng.random() < 0.5}
+        if kind == "agg":
+            if self._rng.random() < 0.5:
+                aggs = {"per_hour": {"date_histogram": {
+                    "field": "ts", "fixed_interval": "1h"}}}
+            else:
+                aggs = {"tags": {"terms": {"field": "tag", "size": 8}}}
+            return {"op": "agg",
+                    "body": {"query": {"match_all": {}}, "size": 0,
+                             "aggs": aggs}}
+        if kind == "scroll":
+            return {"op": "scroll", "page_size": 8, "max_pages": 3}
+        raise ValueError(kind)
+
+    def ops(self) -> list:
+        """The full seeded op stream: weighted mix, search-heavy like
+        the reference's default benchmark workloads."""
+        weights = {"search": 0.40, "msearch": 0.15, "bulk": 0.20,
+                   "agg": 0.15, "scroll": 0.10}
+        kinds = list(weights)
+        cum = np.cumsum([weights[k] for k in kinds])
+        out = []
+        for _ in range(self.config.n_ops):
+            r = self._rng.random()
+            kind = kinds[int(np.searchsorted(cum, r))]
+            out.append(self._op(kind))
+        return out
+
+
+class FaultSchedule:
+    """Seeded fault directives pinned to op indices.  A directive is a
+    dict ``{"step": i, "fault": name, ...params}``; the runner applies
+    every directive whose step equals the index of the op about to
+    execute, so the interleaving is a pure function of the seed."""
+
+    @staticmethod
+    def generate(config: SoakConfig) -> list:
+        rng = random.Random(config.seed ^ 0x5EED)
+        n = config.n_ops
+        client = config.client
+        others = [nid for nid in config.node_ids if nid != client]
+        slow_victim = rng.choice(others)
+        drop_victim = rng.choice(others)
+        stall_victim = rng.choice(others)
+        # duress on the two non-client nodes: every shard with both
+        # copies there becomes sheddable once the coordinator learns
+        duress_victims = others[:2]
+        # partition isolates a non-client follower; the kill targets the
+        # elected leader (re-election is the point)
+        part_victim = next(nid for nid in others if nid != "n0") \
+            if "n0" in others else rng.choice(others)
+        # seeded jitter on each slot (clamped monotone so paired
+        # directives — stall/release, induce/clear, partition/heal,
+        # kill/restart — keep their order): where a fault lands in the
+        # op stream is part of the schedule the seed replays
+        jitter = max(1, n // 24)
+        at: list = []
+        for f in (0.10, 0.20, 0.30, 0.40, 0.48,
+                  0.60, 0.66, 0.76, 0.84, 0.94):
+            base = max(1, int(n * f)) + rng.randint(0, jitter)
+            at.append(min(max(at[-1] if at else 1, base), n - 1))
+        return [
+            {"step": at[0], "fault": "slow_node", "node": slow_victim,
+             "seconds": 0.05, "times": 2},
+            {"step": at[1], "fault": "drop_write", "node": drop_victim,
+             "times": 1},
+            {"step": at[2], "fault": "stall_search", "node": stall_victim,
+             "times": 2},
+            {"step": at[3], "fault": "release_stall"},
+            {"step": at[4], "fault": "induce_duress",
+             "nodes": list(duress_victims)},
+            {"step": at[5], "fault": "clear_duress",
+             "nodes": list(duress_victims)},
+            {"step": at[6], "fault": "partition", "node": part_victim},
+            {"step": at[7], "fault": "heal_partition",
+             "node": part_victim},
+            {"step": at[8], "fault": "kill_leader"},
+            {"step": at[9], "fault": "restart_killed"},
+        ]
+
+
+class SoakRunner:
+    """Drives the cluster through the workload + schedule, twice when a
+    control run is requested: once uninjected (the convergence
+    reference) and once under chaos.  ``run()`` returns the full report
+    — SLO verdicts included, breaches REPORTED, never swallowed."""
+
+    def __init__(self, data_path: Optional[str] = None,
+                 config: Optional[SoakConfig] = None):
+        self.config = config or SoakConfig.smoke()
+        self._own_dir = data_path is None
+        self.data_path = data_path or tempfile.mkdtemp(prefix="soak-")
+
+    # -- cluster plumbing --------------------------------------------------
+
+    def _wait(self, pred: Callable[[], bool], timeout: float = 20.0,
+              what: str = "condition") -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:   # deadline
+            if pred():
+                return
+            time.sleep(0.02)                 # deadline
+        raise SoakHarnessError(f"soak harness: timed out waiting for {what}")
+
+    def _build_node(self, hub, nid: str, root: str):
+        from opensearch_tpu.cluster.node import ClusterNode
+        from opensearch_tpu.transport.service import (LocalTransport,
+                                                      TransportService)
+        svc = TransportService(nid, LocalTransport(hub))
+        node = ClusterNode(nid, f"{root}/{nid}", svc,
+                           list(self.config.node_ids))
+        # neutralize the real CPU probe: only SCHEDULED duress may fire
+        # (a loaded CI host must not leak nondeterminism into verdicts)
+        node.search_backpressure.trackers["cpu_usage"].probe = lambda: 0.0
+        node.search_rpc_timeout = self.config.search_rpc_timeout
+        return node
+
+    def _in_sync_full(self, nodes, leader: str) -> bool:
+        state = nodes[leader].coordinator.state()
+        routing = state.routing.get(self.config.index, [])
+        want_repl = min(self.config.replicas, len(state.nodes) - 1)
+        return bool(routing) and all(
+            e.get("primary")
+            and set(e["in_sync"]) == {e["primary"], *e["replicas"]}
+            and len(e["replicas"]) >= want_repl for e in routing)
+
+    # -- fault directives --------------------------------------------------
+
+    def _apply_fault(self, d: dict, ctx: dict) -> None:
+        from opensearch_tpu.cluster.node import A_SEARCH_SHARDS, A_WRITE_SHARD
+        faults = ctx["faults"]
+        nodes = ctx["nodes"]
+        fault = d["fault"]
+        ctx["applied"].append(dict(d))
+        if fault == "slow_node":
+            faults.slow_search_node(d["node"], d["seconds"],
+                                    times=d.get("times"))
+        elif fault == "drop_write":
+            faults.drop(A_WRITE_SHARD, target=d["node"],
+                        times=d.get("times", 1))
+        elif fault == "stall_search":
+            ctx["stall"] = faults.stall(A_SEARCH_SHARDS, target=d["node"],
+                                        times=d.get("times"))
+        elif fault == "release_stall":
+            rule = ctx.pop("stall", None)
+            if rule is not None:
+                rule.release()
+                faults.remove(rule)
+        elif fault == "induce_duress":
+            for nid in d["nodes"]:
+                bp = nodes[nid].search_backpressure
+                ctx["saved_breaches"][nid] = bp.num_successive_breaches
+                bp.num_successive_breaches = 1
+                faults.induce_search_duress(bp, ticks=1_000_000)
+                bp.run_once()
+        elif fault == "clear_duress":
+            client = nodes[ctx["client"]]
+            for nid in d["nodes"]:
+                bp = nodes[nid].search_backpressure
+                bp.force_duress(0)
+                bp.run_once()                 # streak resets
+                bp.num_successive_breaches = \
+                    ctx["saved_breaches"].pop(nid, 3)
+                # deterministic flag heal on the coordinator (the
+                # record_duress seam) — TTL expiry is wall-clock and the
+                # shed path never re-probes a fully-shed shard
+                client.response_collector.record_duress(nid, False)
+            leader = ctx["leader"]
+            if leader in nodes:
+                nodes[leader].coordinator.run_checks_once()
+            _bump(ctx, "recoveries")
+        elif fault == "partition":
+            victim = d["node"]
+            sides = ([victim],
+                     [n for n in nodes if n != victim])
+            ctx["partition"] = faults.partition(*sides)
+            self._evict(ctx, victim)
+        elif fault == "heal_partition":
+            rule = ctx.pop("partition", None)
+            if rule is not None:
+                faults.heal_partition(rule)
+            self._readmit(ctx, d["node"])
+        elif fault == "kill_leader":
+            victim = ctx["leader"]
+            ctx["killed"] = victim
+            nodes[victim].stop()
+            nodes.pop(victim)
+            client = ctx["client"]
+
+            # survivors must OBSERVE the leader dead (failed
+            # leader-check rounds) before they grant a pre-vote, then
+            # the client (never a kill victim) stands for election
+            def elected() -> bool:
+                for nid, node in nodes.items():
+                    retries = \
+                        node.coordinator.leader_checker.settings.retries
+                    for _ in range(retries + 1):
+                        node.coordinator.run_checks_once()
+                return nodes[client].coordinator.start_election()
+            self._wait(elected, what="re-election after leader kill")
+            ctx["leader"] = client
+            self._evict(ctx, victim)
+            _bump(ctx, "recoveries")
+        elif fault == "restart_killed":
+            victim = ctx.pop("killed", None)
+            if victim is not None:
+                hub = ctx["hub"]
+                node = self._build_node(hub, victim, ctx["root"])
+                ctx["nodes"][victim] = node
+                self._readmit(ctx, victim)
+        else:
+            raise ValueError(f"unknown fault directive [{fault}]")
+
+    def _evict(self, ctx: dict, victim: str) -> None:
+        """Drive the leader's fault detection until the victim leaves
+        the cluster state and surviving copies are promoted."""
+        nodes = ctx["nodes"]
+        leader = ctx["leader"]
+        retries = nodes[leader].coordinator.follower_checker.settings.retries
+
+        def gone():
+            for _ in range(retries + 1):
+                nodes[leader].coordinator.run_checks_once()
+            return victim not in nodes[leader].coordinator.state().nodes
+        self._wait(gone, what=f"eviction of [{victim}]")
+        self._wait(lambda: self._in_sync_full(nodes, leader),
+                   what=f"promotion after [{victim}] eviction")
+
+    def _readmit(self, ctx: dict, victim: str) -> None:
+        """Re-add an evicted/restarted node and wait for peer recovery
+        to bring its copies back in sync."""
+        nodes = ctx["nodes"]
+        leader = ctx["leader"]
+        nodes[leader].coordinator.add_node(victim, {"name": victim})
+        self._wait(lambda: victim in
+                   nodes[ctx["client"]].coordinator.state().nodes,
+                   what=f"[{victim}] rejoining")
+        self._wait(lambda: self._in_sync_full(nodes, leader),
+                   timeout=30.0,
+                   what=f"recovery after [{victim}] rejoined")
+        _bump(ctx, "recoveries")
+
+    # -- op execution ------------------------------------------------------
+
+    def _execute(self, op: dict, ctx: dict) -> dict:
+        client = ctx["nodes"][ctx["client"]]
+        index = self.config.index
+        kind = op["op"]
+        if kind in ("search", "agg"):
+            resp = client.search(index, dict(op["body"]))
+            return {"partial": resp["_shards"]["failed"] > 0}
+        if kind == "msearch":
+            out = client.msearch(index,
+                                 [dict(b) for b in op["bodies"]])
+            partial = False
+            for sub in out["responses"]:
+                err = sub.get("error")
+                if err is not None:
+                    status = sub.get("status", 500)
+                    if status == 429:
+                        _bump(ctx, "rejected")
+                    else:
+                        raise SoakUnexpectedError(
+                            f"msearch sub-request failed: {err}")
+                elif sub["_shards"]["failed"] > 0:
+                    partial = True
+            return {"partial": partial}
+        if kind == "bulk":
+            for doc_id, source in op["docs"]:
+                self._write_with_retry(
+                    ctx, lambda d=doc_id, s=source:
+                    client.index_doc(index, d, s))
+            if op.get("delete"):
+                self._write_with_retry(
+                    ctx, lambda: client.delete_doc(index, op["delete"]))
+            if op.get("refresh"):
+                self._write_with_retry(
+                    ctx, lambda: client.refresh(index))
+            return {"partial": False}
+        if kind == "scroll":
+            from_, partial = 0, False
+            for _ in range(op["max_pages"]):
+                resp = client.search(index, {
+                    "query": {"match_all": {}},
+                    "size": op["page_size"], "from": from_,
+                    "sort": [{"v": "asc"}]})
+                partial = partial or resp["_shards"]["failed"] > 0
+                got = len(resp["hits"]["hits"])
+                from_ += got
+                if got < op["page_size"]:
+                    break
+            return {"partial": partial}
+        raise ValueError(kind)
+
+    def _retryable(self, exc: OpenSearchTpuError) -> bool:
+        from opensearch_tpu.common.errors import NodeDisconnectedError
+        from opensearch_tpu.transport.service import (ReceiveTimeoutError,
+                                                      RemoteTransportError)
+        if isinstance(exc, (NodeDisconnectedError, ReceiveTimeoutError)):
+            return True
+        if isinstance(exc, RemoteTransportError):
+            return exc.remote_type in _RETRYABLE_TYPES
+        return getattr(exc, "error_type", "") in _RETRYABLE_TYPES \
+            or getattr(exc, "status", 0) == 503
+
+    def _write_with_retry(self, ctx: dict, fn: Callable[[], dict]):
+        """Client-side bounded write retry (the reference client's
+        retry-on-503): a transient transport failure retries after the
+        cluster reconverges; exhaustion is an unexpected error."""
+        last: Optional[BaseException] = None
+        for attempt in range(self.config.max_retries + 1):
+            try:
+                return fn()
+            except OpenSearchTpuError as exc:
+                if not self._retryable(exc):
+                    raise
+                last = exc
+                _bump(ctx, "client_retries")
+                # reconvergence beat: the leader's checks evict dead
+                # copies so the retry routes around them
+                leader = ctx["leader"]
+                if leader in ctx["nodes"]:
+                    ctx["nodes"][leader].coordinator.run_checks_once()
+                time.sleep(0.01 * (attempt + 1))   # backoff
+        raise SoakUnexpectedError(
+            f"write retries exhausted: {type(last).__name__}: {last}")
+
+    def _run_op(self, i: int, op: dict, ctx: dict) -> None:
+        hist = ctx["hists"][op["op"]]
+        t0 = time.monotonic()
+        try:
+            out = self._execute(op, ctx)
+            if out.get("partial"):
+                _bump(ctx, "partial_results")
+        except SoakUnexpectedError as exc:
+            ctx["unexpected"].append(f"op {i} [{op['op']}]: {exc}")
+        except OpenSearchTpuError as exc:
+            if getattr(exc, "status", 0) == 429:
+                _bump(ctx, "rejected")
+            elif self._retryable(exc) and op["op"] != "bulk":
+                # reads fail over internally; a residual transport error
+                # after failover is retried ONCE like a real client...
+                try:
+                    _bump(ctx, "client_retries")
+                    out = self._execute(op, ctx)
+                    if out.get("partial"):
+                        _bump(ctx, "partial_results")
+                except OpenSearchTpuError as exc2:
+                    ctx["unexpected"].append(
+                        f"op {i} [{op['op']}]: "
+                        f"{type(exc2).__name__}: {exc2}")
+            else:
+                ctx["unexpected"].append(
+                    f"op {i} [{op['op']}]: {type(exc).__name__}: {exc}")
+        finally:
+            hist.observe((time.monotonic() - t0) * 1000.0)
+
+    # -- one full pass -----------------------------------------------------
+
+    def _counter_snapshot(self) -> dict:
+        return dict(metrics().stats()["counters"])
+
+    def _run_once(self, label: str, inject: bool) -> dict:
+        from opensearch_tpu.testing.fault_injection import FaultInjector
+        from opensearch_tpu.transport.service import LocalTransport
+
+        cfg = self.config
+        root = f"{self.data_path}/{label}"
+        hub = LocalTransport.Hub()
+        nodes = {nid: self._build_node(hub, nid, root)
+                 for nid in cfg.node_ids}
+        ctx = {
+            "lock": threading.Lock(),
+            "hub": hub, "nodes": nodes, "root": root,
+            "client": cfg.client, "leader": cfg.node_ids[0],
+            "faults": FaultInjector(hub, seed=cfg.seed),
+            "applied": [], "saved_breaches": {},
+            "rejected": 0, "partial_results": 0, "client_retries": 0,
+            "recoveries": 0, "unexpected": [],
+            "hists": {k: Histogram(f"soak.{k}")
+                      for k in ("search", "msearch", "bulk", "agg",
+                                "scroll")},
+        }
+        before = self._counter_snapshot()
+        workload = MixedWorkload(cfg)
+        schedule = ((cfg.schedule if cfg.schedule is not None
+                     else FaultSchedule.generate(cfg))
+                    if inject else [])
+        by_step: dict[int, list] = {}
+        for d in schedule:
+            by_step.setdefault(d["step"], []).append(d)
+        try:
+            if not nodes[ctx["leader"]].start_election():
+                raise SoakHarnessError("initial election failed")
+            self._wait(lambda: all(
+                nodes[i].coordinator.state().master_node == ctx["leader"]
+                for i in nodes), what="initial leader convergence")
+            nodes[ctx["client"]].create_index(cfg.index, {
+                "settings": {"number_of_shards": cfg.shards,
+                             "number_of_replicas": cfg.replicas},
+                "mappings": {"properties": {
+                    "body": {"type": "text"},
+                    "ts": {"type": "date"},
+                    "tag": {"type": "keyword"},
+                    "v": {"type": "long"}}}})
+            self._wait(lambda: self._in_sync_full(nodes, ctx["leader"]),
+                       what="initial shard allocation")
+            for doc_id, source in workload.seed_docs():
+                nodes[ctx["client"]].index_doc(cfg.index, doc_id, source)
+            nodes[ctx["client"]].refresh(cfg.index)
+
+            ops = workload.ops()
+            if cfg.concurrency <= 1:
+                for i, op in enumerate(ops):
+                    for d in by_step.get(i, []):
+                        self._apply_fault(d, ctx)
+                    self._run_op(i, op, ctx)
+            else:
+                self._run_concurrent(ops, by_step, ctx)
+
+            # drain: lift every remaining fault, restart anything still
+            # dead, and wait for full in-sync recovery before measuring
+            stall = ctx.pop("stall", None)
+            if stall is not None:
+                stall.release()
+            ctx["faults"].clear()
+            for nid, bp_breaches in list(ctx["saved_breaches"].items()):
+                bp = nodes[nid].search_backpressure
+                bp.force_duress(0)
+                bp.run_once()
+                bp.num_successive_breaches = bp_breaches
+                del ctx["saved_breaches"][nid]
+            if ctx.get("killed"):
+                self._apply_fault({"fault": "restart_killed", "step": -1},
+                                  ctx)
+            self._wait(lambda: self._in_sync_full(nodes, ctx["leader"]),
+                       timeout=30.0, what="post-drain recovery")
+            self._write_with_retry(
+                ctx, lambda: nodes[ctx["client"]].refresh(cfg.index))
+            final = self._final_state(ctx)
+        finally:
+            for n in list(nodes.values()):
+                n.stop()
+        after = self._counter_snapshot()
+
+        def delta(name: str) -> int:
+            return after.get(name, 0) - before.get(name, 0)
+        return {
+            "label": label,
+            "schedule": [dict(d) for d in schedule],
+            "applied": ctx["applied"],
+            "ops": len(ops),
+            "latency_ms": {k: h.stats()
+                           for k, h in ctx["hists"].items()},
+            "p99_ms": {k: round(h.percentile(99), 3)
+                       for k, h in ctx["hists"].items()},
+            "rejected": ctx["rejected"],
+            "partial_results": ctx["partial_results"],
+            "client_retries": ctx["client_retries"],
+            "recoveries": ctx["recoveries"],
+            "unexpected_errors": list(ctx["unexpected"]),
+            "sheds": delta("search.replica_selection.sheds"),
+            "reroutes": delta("search.replica_selection.reroutes"),
+            "failovers": delta("search.shard_failover"),
+            "internal_retries": sum(
+                after.get(k, 0) - before.get(k, 0)
+                for k in after if k.startswith("retry.")
+                and k.endswith(".retries")),
+            "final_state": final,
+        }
+
+    def _run_concurrent(self, ops, by_step, ctx) -> None:
+        """Full-config mode: ops run on a small worker pool in chunks;
+        fault directives still apply at their op index, between chunks
+        (coarser interleaving — the smoke config stays sequential for
+        bit-exact determinism)."""
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(
+                max_workers=self.config.concurrency,
+                thread_name_prefix="soak-worker") as pool:
+            i = 0
+            while i < len(ops):
+                chunk = ops[i:i + self.config.concurrency]
+                for j in range(i, i + len(chunk)):
+                    for d in by_step.get(j, []):
+                        self._apply_fault(d, ctx)
+                futs = [pool.submit(self._run_op, i + j, op, ctx)
+                        for j, op in enumerate(chunk)]
+                for f in futs:
+                    f.result()
+                i += len(chunk)
+
+    def _final_state(self, ctx: dict) -> dict:
+        """Post-drain doc count + content checksum via the normal search
+        path, all-or-nothing (a shard that cannot answer here is a
+        convergence failure, reported as such)."""
+        client = ctx["nodes"][ctx["client"]]
+        try:
+            resp = client.search(self.config.index, {
+                "query": {"match_all": {}}, "size": 10_000,
+                "allow_partial_search_results": False})
+        except OpenSearchTpuError as exc:
+            return {"error": f"{type(exc).__name__}: {exc}"}
+        docs = sorted(
+            (h["_id"], json.dumps(h["_source"], sort_keys=True))
+            for h in resp["hits"]["hits"])
+        return {"doc_count": resp["hits"]["total"]["value"],
+                "checksum": zlib.crc32(
+                    json.dumps(docs).encode("utf-8"))}
+
+    # -- SLO evaluation ----------------------------------------------------
+
+    def _verdicts(self, chaos: dict, control: Optional[dict]) -> list:
+        slos = self.config.slos
+        verdicts = []
+        for klass, limit in sorted(
+                (slos.get("p99_ms") or {}).items()):
+            observed = chaos["p99_ms"].get(klass, 0.0)
+            verdicts.append({"slo": f"p99_ms.{klass}",
+                             "limit": limit, "observed": observed,
+                             "ok": observed <= limit})
+        total_ops = max(chaos["ops"], 1)
+        rate = round(chaos["rejected"] / total_ops, 4)
+        max_rate = slos.get("max_rejection_rate", 1.0)
+        verdicts.append({"slo": "rejection_rate", "limit": max_rate,
+                         "observed": rate, "ok": rate <= max_rate})
+        budget = slos.get("max_unexpected_errors", 0)
+        verdicts.append({
+            "slo": "unexpected_errors", "limit": budget,
+            "observed": len(chaos["unexpected_errors"]),
+            "ok": len(chaos["unexpected_errors"]) <= budget})
+        if slos.get("require_convergence", True) and control is not None:
+            ok = (chaos["final_state"] == control["final_state"]
+                  and "error" not in chaos["final_state"])
+            verdicts.append({
+                "slo": "convergence",
+                "limit": control["final_state"],
+                "observed": chaos["final_state"], "ok": ok})
+        return verdicts
+
+    def run(self) -> dict:
+        """Control pass (when configured) then chaos pass, then SLO
+        evaluation.  Always returns the report; ``slo_ok`` is the single
+        pass/fail bit and ``verdicts`` carries every breach."""
+        try:
+            control = (self._run_once("control", inject=False)
+                       if self.config.control_run
+                       and self.config.faults_enabled else None)
+            chaos = self._run_once(
+                "chaos", inject=self.config.faults_enabled)
+            verdicts = self._verdicts(chaos, control)
+            return {
+                "seed": self.config.seed,
+                "config": {"n_ops": self.config.n_ops,
+                           "n_docs": self.config.n_docs,
+                           "nodes": list(self.config.node_ids),
+                           "shards": self.config.shards,
+                           "replicas": self.config.replicas,
+                           "faults_enabled": self.config.faults_enabled},
+                "control": control,
+                "chaos": chaos,
+                "verdicts": verdicts,
+                "slo_ok": all(v["ok"] for v in verdicts),
+            }
+        finally:
+            if self._own_dir:
+                shutil.rmtree(self.data_path, ignore_errors=True)
+
+
+class SoakHarnessError(OpenSearchTpuError):
+    """The harness itself failed (timeout waiting on cluster plumbing) —
+    distinct from an SLO breach, which is REPORTED in the verdicts."""
+
+
+class SoakUnexpectedError(OpenSearchTpuError):
+    """A client-visible failure outside the allowed degradation classes
+    (429 / partial results) — draws against the zero-5xx budget."""
+
+
+def run_soak(data_path: Optional[str] = None, *,
+             full: bool = False, **overrides) -> dict:
+    """One-call entry point (bench.py's ``soak`` phase)."""
+    cfg = (SoakConfig.full(**overrides) if full
+           else SoakConfig.smoke(**overrides))
+    return SoakRunner(data_path, cfg).run()

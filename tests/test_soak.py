@@ -1,0 +1,422 @@
+"""Chaos-soak harness (PR 7; ROADMAP item 5): seeded mixed workload +
+fault schedule + SLO verdicts over a 3-node ClusterNode cluster — the
+regression gate that turns the robustness spine (PRs 2/4/6) into a
+recorded bench trajectory.  Plus the PR's satellites: single-search
+replica spill, the unified shed/admission budget, and the seeded-RNG
+lint."""
+
+import importlib.util
+import json
+import subprocess
+import sys
+
+import pytest
+
+from opensearch_tpu.cluster import response_collector as rc
+from opensearch_tpu.cluster.node import ClusterNode
+from opensearch_tpu.cluster.state import copies_of
+from opensearch_tpu.common.telemetry import metrics
+from opensearch_tpu.node import Node
+from opensearch_tpu.testing.workload import (FaultSchedule, MixedWorkload,
+                                             SoakConfig, SoakRunner,
+                                             run_soak, zipf_query_log)
+from opensearch_tpu.transport.service import (LocalTransport,
+                                              TransportService)
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+TOOLS = REPO + "/tools"
+
+
+# -- workload generator determinism ----------------------------------------
+
+def test_workload_stream_is_seed_deterministic():
+    a = MixedWorkload(SoakConfig(seed=11)).ops()
+    b = MixedWorkload(SoakConfig(seed=11)).ops()
+    c = MixedWorkload(SoakConfig(seed=12)).ops()
+    assert a == b
+    assert a != c
+    # every op class shows up in the mix
+    assert {op["op"] for op in a} == {"search", "msearch", "bulk",
+                                      "agg", "scroll"}
+
+
+def test_fault_schedule_is_seed_deterministic():
+    s1 = FaultSchedule.generate(SoakConfig(seed=42))
+    s2 = FaultSchedule.generate(SoakConfig(seed=42))
+    s3 = FaultSchedule.generate(SoakConfig(seed=43))
+    assert s1 == s2
+    assert s1 != s3
+    faults = [d["fault"] for d in s1]
+    # the full chaos menu, kill-and-recover included
+    assert {"slow_node", "drop_write", "stall_search", "induce_duress",
+            "partition", "heal_partition", "kill_leader",
+            "restart_killed"} <= set(faults)
+    # steps are sorted and inside the op stream
+    steps = [d["step"] for d in s1]
+    assert steps == sorted(steps)
+    assert all(0 <= s < SoakConfig().n_ops for s in steps)
+
+
+def test_zipf_query_log_matches_bench_shape():
+    log = zipf_query_log(16, 1000, seed=7)
+    assert log == zipf_query_log(16, 1000, seed=7)
+    assert all(0 <= a < 1000 and 0 <= b < 1000 for a, b in log)
+
+
+# -- the acceptance bar: fixed-seed smoke soak ------------------------------
+
+def test_smoke_soak_deterministic_verdicts_and_convergence(tmp_path):
+    """Same seed ⇒ identical fault schedule and identical SLO verdicts
+    across two full runs; zero unexpected 5xx; and the post-fault
+    convergence check (doc count + checksum vs the uninjected control
+    run) passes with a killed-and-recovered node in the schedule."""
+    r1 = run_soak(str(tmp_path / "a"), seed=42)
+    r2 = run_soak(str(tmp_path / "b"), seed=42)
+
+    assert r1["chaos"]["schedule"] == r2["chaos"]["schedule"]
+    v1 = [(v["slo"], v["ok"]) for v in r1["verdicts"]]
+    v2 = [(v["slo"], v["ok"]) for v in r2["verdicts"]]
+    assert v1 == v2
+
+    # client-visible-error budget: 429/partial allowed, 5xx budget zero
+    assert r1["chaos"]["unexpected_errors"] == []
+    assert r1["slo_ok"], r1["verdicts"]
+
+    # the schedule really killed and recovered a node (plus a partition
+    # round-trip) and the cluster converged with the control run anyway
+    applied = {d["fault"] for d in r1["chaos"]["applied"]}
+    assert {"kill_leader", "restart_killed",
+            "partition", "heal_partition"} <= applied
+    conv = next(v for v in r1["verdicts"] if v["slo"] == "convergence")
+    assert conv["ok"], conv
+    assert r1["chaos"]["final_state"] == r1["control"]["final_state"]
+    assert r1["chaos"]["final_state"]["doc_count"] > 0
+    # degradation was actually exercised, not absent
+    assert r1["chaos"]["recoveries"] >= 3
+    assert r1["chaos"]["reroutes"] > 0
+
+
+def test_partition_heal_roundtrip_converges(tmp_path):
+    """A focused partition→heal schedule: the isolated follower is
+    evicted, its copies promote, writes route around it, the heal
+    re-admits it, peer recovery catches it up, and doc count + checksum
+    match the uninjected control run."""
+    cfg = SoakConfig(seed=5, n_ops=16, schedule=[
+        {"step": 3, "fault": "partition", "node": "n2"},
+        {"step": 9, "fault": "heal_partition", "node": "n2"}])
+    r = SoakRunner(str(tmp_path), cfg).run()
+    assert [d["fault"] for d in r["chaos"]["applied"]] == \
+        ["partition", "heal_partition"]
+    assert r["chaos"]["unexpected_errors"] == []
+    conv = next(v for v in r["verdicts"] if v["slo"] == "convergence")
+    assert conv["ok"], conv
+    assert r["chaos"]["recoveries"] >= 1
+
+
+def test_slo_breach_is_reported_not_swallowed(tmp_path):
+    """An unmeetable p99 SLO must surface as a failed verdict and flip
+    slo_ok — the runner records breaches, it never raises them away or
+    hides them."""
+    cfg = SoakConfig(seed=42, n_ops=10, control_run=False, slos={
+        "p99_ms": {"search": 0.0001},
+        "max_rejection_rate": 1.0,
+        "max_unexpected_errors": 1_000,
+        "require_convergence": False})
+    r = SoakRunner(str(tmp_path), cfg).run()
+    assert r["slo_ok"] is False
+    breached = [v for v in r["verdicts"] if not v["ok"]]
+    assert breached
+    assert breached[0]["slo"] == "p99_ms.search"
+    assert breached[0]["observed"] > breached[0]["limit"]
+
+
+def test_bench_soak_phase_emits_slo_line(tmp_path, monkeypatch):
+    """bench.py's `soak` phase appends one SLO line (p99 per op class,
+    rejection_rate, sheds, reroutes, recoveries, convergence) to the
+    phases file — the bench-trajectory surface of this harness."""
+    phases = tmp_path / "phases.jsonl"
+    monkeypatch.setenv("OSTPU_BENCH_PHASES", str(phases))
+    monkeypatch.setenv("OSTPU_BENCH_SOAK_OPS", "24")
+    spec = importlib.util.spec_from_file_location("bench_under_test",
+                                                  REPO + "/bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench.run_soak_phase("cpu")
+    lines = [json.loads(ln) for ln in phases.read_text().splitlines()]
+    assert len(lines) == 1
+    line = lines[0]
+    assert line["phase"] == "soak"
+    assert {"slo_ok", "rejection_rate", "sheds", "reroutes",
+            "recoveries", "convergence",
+            "p99_search_ms", "p99_bulk_ms"} <= set(line)
+    assert line["unexpected_errors"] == 0
+    assert line["convergence"] is True
+
+
+@pytest.mark.slow
+def test_full_soak_configuration(tmp_path):
+    """The production-sized soak (more ops, bigger corpus, concurrent
+    workers) — the nightly gate; tier-1 runs the smoke configuration
+    above instead."""
+    r = run_soak(str(tmp_path), full=True, seed=42)
+    assert r["chaos"]["unexpected_errors"] == []
+    conv = next(v for v in r["verdicts"] if v["slo"] == "convergence")
+    assert conv["ok"], conv
+    assert r["slo_ok"], r["verdicts"]
+
+
+# -- satellite: single-search replica spill ---------------------------------
+
+def test_single_search_spill_rotates_off_busy_preferred(tmp_path):
+    """A plain _search scatter rotates off the preferred copy once its
+    outstanding-request count exceeds search.replica_selection.
+    spill_outstanding, counted under the reroutes metric."""
+    hub = LocalTransport.Hub()
+    svc = TransportService("a", LocalTransport(hub))
+    node = ClusterNode("a", str(tmp_path / "a"), svc, ["a"])
+    try:
+        entry = {"primary": "b", "replicas": ["c"],
+                 "in_sync": ["b", "c"], "primary_term": 1}
+        collector = node.response_collector
+        # below the threshold: legacy order stands
+        assert node._copy_candidates(entry) == ["b", "c"]
+        for _ in range(rc.SPILL_OUTSTANDING + 1):
+            collector.incr_outstanding("b")
+        before = metrics().counter(
+            "search.replica_selection.reroutes").value
+        assert node._copy_candidates(entry) == ["c", "b"]   # spilled
+        assert metrics().counter(
+            "search.replica_selection.reroutes").value == before + 1
+        # msearch batch members keep their own rotation (spill offset)
+        assert node._copy_candidates(entry, spill=1) == ["c", "b"]
+        # both copies equally busy: no pointless rotation
+        for _ in range(rc.SPILL_OUTSTANDING + 1):
+            collector.incr_outstanding("c")
+        assert node._copy_candidates(entry) == ["b", "c"]
+        # disabled via the dynamic knob
+        rc.SPILL_OUTSTANDING = 0
+        try:
+            for _ in range(20):
+                collector.incr_outstanding("b")
+            assert node._copy_candidates(entry) == ["b", "c"]
+        finally:
+            rc.SPILL_OUTSTANDING = 8     # module global: always restore
+    finally:
+        node.stop()
+
+
+def test_spill_and_shed_occupancy_dynamic_settings(tmp_path):
+    node = Node(str(tmp_path / "node"), port=0)
+    try:
+        assert rc.SPILL_OUTSTANDING == 8 and rc.SHED_OCCUPANCY == 0.0
+        node.update_cluster_settings(transient={
+            "search.replica_selection.spill_outstanding": 3,
+            "search.replica_selection.shed_occupancy": 0.75})
+        assert rc.SPILL_OUTSTANDING == 3
+        assert rc.SHED_OCCUPANCY == 0.75
+        node.update_cluster_settings(transient={
+            "search.replica_selection.spill_outstanding": None,
+            "search.replica_selection.shed_occupancy": None})
+        assert rc.SPILL_OUTSTANDING == 8 and rc.SHED_OCCUPANCY == 0.0
+    finally:
+        rc.SPILL_OUTSTANDING = 8         # module globals: always restore
+        rc.SHED_OCCUPANCY = 0.0
+        node.stop()
+
+
+# -- satellite: unified shed/admission budget -------------------------------
+
+def wait_until(pred, timeout=8.0):
+    import time
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:   # deadline
+        if pred():
+            return True
+        time.sleep(0.05)                     # deadline
+    return False
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    hub = LocalTransport.Hub()
+    ids = ["n0", "n1", "n2"]
+    nodes = {}
+    for nid in ids:
+        svc = TransportService(nid, LocalTransport(hub))
+        node = ClusterNode(nid, str(tmp_path / nid), svc, ids)
+        node.search_backpressure.trackers["cpu_usage"].probe = lambda: 0.0
+        nodes[nid] = node
+    assert nodes["n0"].start_election()
+    assert wait_until(lambda: all(
+        nodes[i].coordinator.state().master_node == "n0" for i in ids))
+    yield hub, ids, nodes
+    for n in nodes.values():
+        n.stop()
+
+
+def test_shed_consults_admission_occupancy(cluster):
+    """Below search.replica_selection.shed_occupancy the coordinator
+    still tries an all-duress shard as a last resort; at/above it the
+    shard sheds fast — and the shed draws from the SAME rejection
+    ledger as the admission gate's edge 429s."""
+    hub, ids, nodes = cluster
+    nodes["n0"].create_index("budget", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 1},
+        "mappings": {"properties": {"v": {"type": "long"}}}})
+    assert wait_until(lambda: all(
+        set(e["in_sync"]) == {e["primary"], *e["replicas"]}
+        for e in nodes["n0"].coordinator.state().routing.get("budget",
+                                                             [{}])
+        if e))
+    for i in range(8):
+        nodes["n0"].index_doc("budget", str(i), {"v": i})
+    nodes["n0"].refresh("budget")
+    entry = nodes["n0"].coordinator.state().routing["budget"][0]
+    coord = next(i for i in ids if i not in copies_of(entry))
+    assert coord != "n0", "allocator change broke this test's setup"
+    node = nodes[coord]
+
+    def seed_duress():
+        for nid in copies_of(entry):
+            node.response_collector.record_duress(nid, True)
+
+    try:
+        rc.SHED_OCCUPANCY = 0.9
+        seed_duress()
+        # idle coordinator (occupancy ≈ 0): last-resort try, not a shed
+        r = node.search("budget", {"query": {"match_all": {}},
+                                   "size": 10})
+        assert r["_shards"]["failed"] == 0
+        assert r["hits"]["total"]["value"] == 8
+
+        # saturate the gate to 90%: the same search now sheds, and the
+        # shed lands on the admission controller's shared ledger
+        admission = node.search_backpressure.admission
+        admission.max_concurrent = 10
+        import contextlib
+        seed_duress()
+        sheds_before = admission.stats()["shed_count"]
+        with contextlib.ExitStack() as stack:
+            for _ in range(9):
+                stack.enter_context(admission.acquire("held"))
+            assert admission.occupancy() == pytest.approx(0.9)
+            r = node.search("budget", {"query": {"match_all": {}}})
+        assert r["_shards"]["failed"] == 1
+        assert r["_shards"]["failures"][0]["reason"]["type"] == \
+            "node_duress_exception"
+        stats = admission.stats()
+        assert stats["shed_count"] == sheds_before + 1
+        assert stats["rejected_total"] == \
+            stats["rejected_count"] + stats["shed_count"]
+    finally:
+        rc.SHED_OCCUPANCY = 0.0          # module global: always restore
+
+
+def test_cluster_search_draws_from_admission_budget(cluster):
+    """Coordinator-scope searches hold a permit from the same gate the
+    REST edge uses: a saturated gate 429s the scatter instead of
+    queueing it."""
+    from opensearch_tpu.search.backpressure import SearchRejectedError
+
+    hub, ids, nodes = cluster
+    nodes["n0"].create_index("adm", {
+        "settings": {"number_of_shards": 1},
+        "mappings": {"properties": {"v": {"type": "long"}}}})
+    assert wait_until(lambda: all(
+        "adm" in nodes[i].coordinator.state().indices for i in ids))
+    nodes["n0"].index_doc("adm", "1", {"v": 1})
+    nodes["n0"].refresh("adm")
+    admission = nodes["n0"].search_backpressure.admission
+    admission.max_concurrent = 1
+    try:
+        with admission.acquire("held"):
+            with pytest.raises(SearchRejectedError):
+                nodes["n0"].search("adm", {"query": {"match_all": {}}})
+        # permit released: service resumes
+        r = nodes["n0"].search("adm", {"query": {"match_all": {}}})
+        assert r["hits"]["total"]["value"] == 1
+    finally:
+        admission.max_concurrent = 256
+
+
+def test_nodes_stats_exposes_shared_budget(tmp_path):
+    """The unified budget surfaces in _nodes/stats under BOTH
+    search_backpressure (admission_control) and adaptive_selection
+    (budget) — same numbers, one gate."""
+    node = Node(str(tmp_path / "node"), port=0)
+    try:
+        node.search_backpressure.admission.record_shed(2)
+        status, resp = node.rest.dispatch("GET", "/_nodes/stats", {},
+                                          None)
+        assert status == 200
+        stats = resp["nodes"][node.node_id]
+        bp_block = stats["search_backpressure"]["admission_control"]
+        ars_block = stats["adaptive_selection"]["budget"]
+        assert bp_block == ars_block
+        assert ars_block["shed_count"] == 2
+        assert ars_block["rejected_total"] == \
+            ars_block["rejected_count"] + 2
+        assert "occupancy" in ars_block
+    finally:
+        node.stop()
+
+
+# -- satellite: symmetric partition directive -------------------------------
+
+def test_partition_is_symmetric_and_healable():
+    from opensearch_tpu.common.errors import NodeDisconnectedError
+    from opensearch_tpu.testing.fault_injection import FaultInjector
+
+    hub = LocalTransport.Hub()
+    a = TransportService("a", LocalTransport(hub))
+    b = TransportService("b", LocalTransport(hub))
+    c = TransportService("c", LocalTransport(hub))
+    for svc in (a, b, c):
+        svc.register_handler("ping", lambda payload: {"pong": True})
+    try:
+        faults = FaultInjector(hub, seed=3)
+        rule = faults.partition({"a"}, {"b", "c"})
+        for src, dst in (("a", "b"), ("b", "a"), ("a", "c")):
+            with pytest.raises(NodeDisconnectedError):
+                {"a": a, "b": b, "c": c}[src].send_request(
+                    dst, "ping", {}, timeout=2.0)
+        # intra-side traffic is untouched
+        assert b.send_request("c", "ping", {}, timeout=5.0)["pong"]
+        assert faults.heal_partition(rule)
+        assert a.send_request("b", "ping", {}, timeout=5.0)["pong"]
+        assert not faults.heal_partition(rule)   # second heal no-ops
+    finally:
+        a.close()
+        b.close()
+        c.close()
+
+
+# -- seeded-RNG lint (tier-1 CI hook) ---------------------------------------
+
+def test_check_seeded_rng_lint_passes_repo():
+    out = subprocess.run(
+        [sys.executable, TOOLS + "/check_seeded_rng.py"],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_check_seeded_rng_lint_catches_violations(tmp_path):
+    (tmp_path / "bad.py").write_text(
+        "import random\n"
+        "import numpy as np\n"
+        "r1 = random.Random()\n"                       # line 3: flagged
+        "r2 = random.Random(42)\n"
+        "r3 = np.random.default_rng()\n"               # line 5: flagged
+        "r4 = np.random.default_rng(seed=7)\n"
+        "r5 = random.Random()  # seeded-elsewhere\n"
+        "# seeded-elsewhere\n"
+        "r6 = np.random.default_rng()\n")
+    out = subprocess.run(
+        [sys.executable, TOOLS + "/check_seeded_rng.py", str(tmp_path)],
+        capture_output=True, text=True)
+    assert out.returncode == 1
+    assert "bad.py:3" in out.stdout
+    assert "bad.py:5" in out.stdout
+    assert "bad.py:4" not in out.stdout
+    assert "bad.py:7" not in out.stdout
+    assert "bad.py:9" not in out.stdout

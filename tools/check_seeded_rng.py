@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Lint: the chaos/bench harness may only use SEEDED randomness.
+
+The soak harness's whole contract is replayability: the same seed must
+produce the same op stream, the same fault schedule, and the same SLO
+verdicts (tests/test_soak.py asserts it).  One unseeded
+``random.Random()`` or ``np.random.default_rng()`` anywhere in the
+harness silently breaks that — the run still "works", it just stops
+being a regression gate.  So under ``opensearch_tpu/testing/`` and in
+``bench.py``, every RNG construction must pass an explicit seed
+argument, or carry a ``# seeded-elsewhere`` annotation on the same line
+or the line above (for RNGs that are re-seeded before use).
+
+Sibling of ``check_monotonic.py``/``check_sleep_loops.py``; new
+un-seeded sites fail tier-1 (tests/test_soak.py runs this check).
+
+Usage: python tools/check_seeded_rng.py [root ...]   (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ANNOTATION = "# seeded-elsewhere"
+
+#: constructor names whose no-argument form yields an OS-entropy RNG
+RNG_CTORS = ("Random", "default_rng", "RandomState", "SystemRandom")
+
+
+def _unseeded_rng_calls(tree: ast.AST) -> list[int]:
+    """Line numbers of RNG constructions with no seed argument."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if name not in RNG_CTORS:
+            continue
+        seeded = bool(node.args) or any(
+            kw.arg in ("seed", "x") for kw in node.keywords)
+        if not seeded:
+            out.append(node.lineno)
+    return out
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error ({e.msg})"]
+    lines = src.splitlines()
+    problems = []
+    for lineno in _unseeded_rng_calls(tree):
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        prev = lines[lineno - 2] if lineno >= 2 else ""
+        if ANNOTATION in line or ANNOTATION in prev:
+            continue
+        problems.append(
+            f"{path}:{lineno}: RNG constructed without an explicit seed "
+            "in a replayable-harness module — pass a seed, or annotate "
+            f"'{ANNOTATION}' if it is re-seeded before use")
+    return problems
+
+
+def _default_roots() -> list[str]:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return [os.path.join(repo, "opensearch_tpu", "testing"),
+            os.path.join(repo, "bench.py")]
+
+
+def main(argv: list[str]) -> int:
+    roots = argv[1:] or _default_roots()
+    problems = []
+    for root in roots:
+        if os.path.isfile(root):
+            problems.extend(check_file(root))
+            continue
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    problems.extend(check_file(
+                        os.path.join(dirpath, name)))
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} unseeded RNG site(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
