@@ -67,7 +67,8 @@ class Coordinator:
                  on_apply: Optional[Callable[[ClusterState], None]] = None,
                  check_interval: float = 1.0, check_retries: int = 3,
                  check_timeout: float = 2.0, gateway=None,
-                 load_provider=None, on_node_load=None):
+                 load_provider=None, on_node_load=None,
+                 health_provider=None):
         self.node_id = node_id
         self.transport = transport
         # bootstrap voting configuration; once states carry a `voting`
@@ -78,6 +79,10 @@ class Coordinator:
         self.check_interval = check_interval
         self.check_retries = check_retries
         self.gateway = gateway          # GatewayStateStore | None
+        # node-health gate (FsHealthService wiring): an UNHEALTHY node
+        # must neither stand for election nor keep the lead — the
+        # reference's NodeHealthService veto in Coordinator/PreVote
+        self.health_provider = health_provider
 
         self.mode = Mode.CANDIDATE
         self.current_term = 0
@@ -177,9 +182,20 @@ class Coordinator:
 
     # -- election ---------------------------------------------------------
 
+    def _node_unhealthy(self) -> bool:
+        try:
+            return (self.health_provider is not None
+                    and not self.health_provider())
+        except Exception:  # noqa: BLE001 — a broken probe must not wedge
+            return False
+
     def start_election(self) -> bool:
         """Pre-vote, then solicit joins for term+1.  Returns True if this
-        node became leader."""
+        node became leader.  An unhealthy node (failed fsync probe)
+        refuses to stand — electing a leader that can't persist votes or
+        accepted states voids every durability argument."""
+        if self._node_unhealthy():
+            return False
         with self._lock:
             if self._stopped or self.mode == Mode.LEADER:
                 return self.mode == Mode.LEADER
@@ -498,6 +514,14 @@ class Coordinator:
             mode = self.mode
             state = self.committed
             term = self.current_term
+        if mode == Mode.LEADER and self._node_unhealthy():
+            # abdicate: a leader whose disk stopped taking writes cannot
+            # safely persist accepted states; stepping down lets a
+            # healthy node win the next election (elections gate on
+            # health, so THIS node won't immediately re-stand)
+            with self._lock:
+                self.mode = Mode.CANDIDATE
+            return
         if mode == Mode.LEADER:
             self.follower_checker.check_round(state, term)
         elif mode == Mode.FOLLOWER and state.master_node:

@@ -97,7 +97,7 @@ class FollowerChecker:
 
         dead = []
         for peer in [n for n in state.nodes if n != self.node_id]:
-            lagging = False
+            lagging = unhealthy = False
             try:
                 r = self.transport.send_request(
                     peer, FOLLOWER_CHECK, {"term": term},
@@ -112,10 +112,17 @@ class FollowerChecker:
                 lagging = bool(ok) and (int(r.get("version",
                                                   state.version))
                                         < state.version)
+                # FsHealth piggyback (the reference's
+                # NodeHealthCheckFailureException on follower checks): a
+                # node whose disk stopped taking writes answers pings
+                # fine but cannot durably hold data — after the same
+                # retry budget it leaves the cluster like a dead one
+                unhealthy = bool(ok) and (
+                    (r.get("load") or {}).get("fs_healthy") is False)
             except OpenSearchTpuError:
                 ok = False
             with self._lock:
-                if ok and not lagging:
+                if ok and not lagging and not unhealthy:
                     self._failures.pop(peer, None)
                     continue
                 n = self._failures.get(peer, 0) + 1
@@ -125,7 +132,8 @@ class FollowerChecker:
                     self._failures.pop(peer, None)
             if exhausted:
                 metrics().counter("fault_detection.follower.failed").inc()
-                reason = "lagging" if lagging else "disconnected"
+                reason = ("unhealthy" if unhealthy
+                          else "lagging" if lagging else "disconnected")
                 dead.append(peer)
                 self.on_node_failure(peer, reason)
         return dead

@@ -34,10 +34,12 @@ from opensearch_tpu.common.errors import (
     ShardNotFoundError,
     VersionConflictError,
 )
+from opensearch_tpu.common.fshealth import FsHealthService
 from opensearch_tpu.common.retry import retry_call
 from opensearch_tpu.cluster.coordination import CoordinationError, Coordinator
 from opensearch_tpu.cluster.state import (ClusterState, allocate_shards,
                                           copies_of)
+from opensearch_tpu.index.store import CorruptIndexError
 from opensearch_tpu.indices.service import IndexService
 from opensearch_tpu.transport.service import (ReceiveTimeoutError,
                                               RemoteTransportError,
@@ -61,10 +63,13 @@ def _degradable_search_error(exc: BaseException) -> bool:
 
     # a shard task cancelled under it (backpressure duress, parent ban)
     # degrades to a counted failure: the coordinator returns the partial
-    # results it has instead of hanging or failing the whole search
+    # results it has instead of hanging or failing the whole search.
+    # A locally-poisoned copy (CorruptIndexError) fails over the same
+    # way a remote one does — another copy has the data
     if isinstance(exc, (NodeDisconnectedError, ReceiveTimeoutError,
                         ShardNotFoundError, CircuitBreakingError,
                         breakers.CircuitBreakingError,
+                        CorruptIndexError,
                         TaskCancelledException)):
         return True
     if isinstance(exc, RemoteTransportError):
@@ -131,6 +136,11 @@ class ClusterNode:
         self.indexing_pressure = IndexingPressure(
             int(os.environ.get("OSTPU_INDEXING_PRESSURE_LIMIT", 64 << 20)))
         self._lock = threading.RLock()
+        # disk-health probe: its verdict piggybacks on fault-detection
+        # pings (leader evicts an unhealthy data node) and gates this
+        # node's own election eligibility (FsHealthService wiring)
+        self.fs_health = FsHealthService(data_path)
+        self.fs_health_interval = 5.0
         from opensearch_tpu.cluster.gateway import GatewayStateStore
         self.gateway = GatewayStateStore(os.path.join(data_path, "_state"))
         self.coordinator = Coordinator(
@@ -138,13 +148,19 @@ class ClusterNode:
             node_info={"name": node_id}, on_apply=self._apply_state,
             gateway=self.gateway,
             load_provider=self._load_stats,
-            on_node_load=self.response_collector.record_ping_load)
+            on_node_load=self.response_collector.record_ping_load,
+            health_provider=lambda: self.fs_health.healthy)
         # (index, shard) -> "primary" | "replica" as applied locally
         self._roles: dict[tuple, str] = {}
         # (index, shard) replica copies that completed peer recovery in
         # THIS process (an engine reopened after restart must re-recover)
         self._recovered: set[tuple] = set()
         self._recovering: set[tuple] = set()
+        # (index, shard) copies whose corruption failover is in flight:
+        # every applied state re-sees the poisoned engine until the
+        # reset lands, and a second handler's reset would wipe the
+        # re-recovered copy
+        self._corrupt_handling: set[tuple] = set()
         t = transport
         t.register_handler(A_CREATE_INDEX, self._h_create_index)
         t.register_handler(A_DELETE_INDEX, self._h_delete_index)
@@ -188,6 +204,7 @@ class ClusterNode:
             self.response_collector.remove_node(gone)
         to_promote: list[tuple] = []
         to_recover: list[tuple] = []
+        to_fail_corrupt: list[tuple] = []
         with self._lock:
             for index, meta in state.indices.items():
                 routing = state.routing.get(index, [])
@@ -220,6 +237,16 @@ class ClusterNode:
                     entry = routing[s]
                     prev = self._roles.get((index, s))
                     self._roles[(index, s)] = role
+                    engine = svc.local_shards.get(s)
+                    if (engine is not None
+                            and engine.corruption is not None
+                            and (index, s) not in self._corrupt_handling):
+                        # a copy that failed store verification at open
+                        # (restart over bit rot) runs the corruption
+                        # failover instead of serving errors forever
+                        to_fail_corrupt.append((index, s,
+                                                engine.corruption))
+                        continue
                     if role == "primary":
                         if prev == "replica":
                             # failover promotion: replay buffered ops
@@ -252,6 +279,11 @@ class ClusterNode:
                 target=self._run_recovery, args=(index, s, primary),
                 daemon=True,
                 name=f"recovery-{self.node_id}-{index}-{s}").start()
+        for index, s, exc in to_fail_corrupt:
+            threading.Thread(
+                target=self._on_corruption, args=(index, s, exc),
+                daemon=True,
+                name=f"corruption-{self.node_id}-{index}-{s}").start()
 
     # -- peer recovery (replica side) -------------------------------------
 
@@ -262,37 +294,52 @@ class ClusterNode:
         then report recovered so the master adds us to the in-sync set
         (ref indices/recovery/RecoverySourceHandler.java:105,
         ReplicationTracker.markAllocationIdAsInSync:1533)."""
+        from opensearch_tpu.common.telemetry import metrics
         try:
             svc = self.indices.get(index)
             local_ckpt = -1
             if svc is not None:
                 # offer op-based recovery: our highest applied seq-no
                 local_ckpt = svc.engine_for(shard)._seq_no
-            # transient drops during recovery retry in place: restarting
-            # the whole recovery from the next cluster-state application
-            # is far more expensive than one more RPC
-            resp = retry_call(
-                "recovery.start",
-                lambda: self.transport.send_request(
-                    primary, A_START_RECOVERY,
-                    {"index": index, "shard": shard,
-                     "node": self.node_id,
-                     "local_checkpoint": local_ckpt}, timeout=30.0),
-                max_attempts=3, base_delay=0.1, max_delay=1.0,
-                budget_s=90.0, seed=zlib.crc32(
-                    f"{self.node_id}/{index}/{shard}".encode()))
-            svc = self.indices.get(index)
-            if svc is None:
-                return
-            engine = svc.engine_for(shard)
-            if resp.get("mode") == "ops":
-                # retention-lease fast path: replay the missed ops, no
-                # file copy (RecoverySourceHandler phase-2-only recovery)
-                for op in resp["ops"]:
-                    engine.apply_replica_op(op)
-                engine.refresh()
-            else:
-                engine.install_checkpoint(resp["ckpt"], resp["blobs"])
+            for install_attempt in range(3):
+                # transient drops during recovery retry in place:
+                # restarting the whole recovery from the next
+                # cluster-state application is far more expensive than
+                # one more RPC
+                resp = retry_call(
+                    "recovery.start",
+                    lambda: self.transport.send_request(
+                        primary, A_START_RECOVERY,
+                        {"index": index, "shard": shard,
+                         "node": self.node_id,
+                         "local_checkpoint": local_ckpt}, timeout=30.0),
+                    max_attempts=3, base_delay=0.1, max_delay=1.0,
+                    budget_s=90.0, seed=zlib.crc32(
+                        f"{self.node_id}/{index}/{shard}".encode()))
+                svc = self.indices.get(index)
+                if svc is None:
+                    return
+                engine = svc.engine_for(shard)
+                try:
+                    if resp.get("mode") == "ops":
+                        # retention-lease fast path: replay the missed
+                        # ops, no file copy (RecoverySourceHandler
+                        # phase-2-only recovery)
+                        for op in resp["ops"]:
+                            engine.apply_replica_op(op)
+                        engine.refresh()
+                    else:
+                        engine.install_checkpoint(resp["ckpt"],
+                                                  resp["blobs"])
+                    break
+                except CorruptIndexError:
+                    # a blob damaged in flight (or on the primary's way
+                    # out) must be RE-REQUESTED, not installed: the
+                    # verify in segment_from_blobs already rejected it
+                    # before any engine state changed
+                    metrics().counter("recovery.corrupt_blobs").inc()
+                    if install_attempt == 2:
+                        raise
             svc.invalidate_searcher()
             master = self._master()
             payload = {"index": index, "shard": shard,
@@ -360,9 +407,12 @@ class ClusterNode:
         return {"acknowledged": True}
 
     def _h_fail_copy(self, payload: dict) -> dict:
-        """Master: drop a failed replica copy from the shard group and
+        """Master: drop a failed shard copy from the group and
         re-allocate a replacement (ReplicationOperation's fail-shard call
-        to the cluster manager)."""
+        to the cluster manager).  A failed PRIMARY (corruption) promotes
+        an in-sync replica under a bumped term — the old lineage is
+        fenced out; with no safe copy to promote the group is flagged
+        corrupted and surfaces red in cluster health."""
         index, shard, node = (payload["index"], payload["shard"],
                               payload["node"])
 
@@ -373,6 +423,26 @@ class ClusterNode:
             if entries is None or shard >= len(entries):
                 return state
             e = entries[shard]
+            if node == e.get("primary"):
+                if not payload.get("corrupted"):
+                    return state   # only corruption fails a live primary
+                safe = [r for r in (e.get("replicas") or [])
+                        if r in (e.get("in_sync") or []) and r != node]
+                if not safe:
+                    # nothing safe to promote: keep the copy (its data,
+                    # corrupt as it is, is all that exists) but mark the
+                    # group so health goes red instead of lying green
+                    e["corrupted"] = True
+                    return state.with_(routing=routing)
+                promo = safe[0]
+                e["primary"] = promo
+                e["replicas"] = [r for r in e["replicas"] if r != promo]
+                e["in_sync"] = [n for n in e["in_sync"]
+                                if n != node and n in (
+                                    [promo] + e["replicas"])]
+                e["primary_term"] = int(e.get("primary_term", 1)) + 1
+                e.pop("corrupted", None)
+                return allocate_shards(state.with_(routing=routing))
             if node not in (e.get("replicas") or []):
                 return state
             e["replicas"] = [r for r in e["replicas"] if r != node]
@@ -596,13 +666,17 @@ class ClusterNode:
                     # non-in-sync copies are still recovering: best effort
         return {"_index": index, "_id": r.doc_id,
                 "_version": r.version, "_seq_no": r.seq_no,
+                # the ROUTING entry's term, not a hardcoded 1: fencing
+                # (promotions bump it) is observable to clients
+                "_primary_term": int(entry.get("primary_term", 1)),
                 "result": r.result, "_shard": shard}
 
     def _report_failed_copy(self, index: str, shard: int,
-                            node: str) -> bool:
+                            node: str, corrupted: bool = False) -> bool:
         try:
             master = self._master()
-            payload = {"index": index, "shard": shard, "node": node}
+            payload = {"index": index, "shard": shard, "node": node,
+                       "corrupted": corrupted}
             if master == self.node_id:
                 self._h_fail_copy(payload)
             else:
@@ -611,6 +685,99 @@ class ClusterNode:
             return True
         except OpenSearchTpuError:
             return False   # master unreachable
+
+    # -- corruption-driven copy failover (Store.verify / CorruptedFile) ----
+
+    def verify_local_stores(self, index: Optional[str] = None) -> list:
+        """Checksum every local shard copy's on-disk files against its
+        commit manifests (``Store.verify``).  A copy that fails runs the
+        corruption failover: marker written (by the engine), copy
+        reported via ``A_FAIL_COPY``, local data dropped, recovery from
+        the primary re-triggered by the resulting cluster state."""
+        reports = []
+        for name, svc in list(self.indices.items()):
+            if index is not None and name != index:
+                continue
+            for shard_id, engine in sorted(list(
+                    svc.local_shards.items())):
+                try:
+                    engine.verify_store()
+                except CorruptIndexError as exc:
+                    reports.append({"index": name, "shard": shard_id,
+                                    "corrupted": True, "reason": str(exc)})
+                    self._on_corruption(name, shard_id, exc)
+                except OpenSearchTpuError:
+                    continue   # closed mid-iteration
+                else:
+                    reports.append({"index": name, "shard": shard_id,
+                                    "corrupted": False})
+        return reports
+
+    def _on_corruption(self, index: str, shard: int,
+                       exc: CorruptIndexError):
+        """One copy's corruption verdict → the cluster-level response.
+        Replica: report itself failed, drop the local copy, let the
+        published state re-run peer recovery from the primary.  Primary:
+        fail the shard so the master promotes an in-sync replica under a
+        bumped term.  Either way the local data is only discarded AFTER
+        the master acknowledged the failure — if no master is reachable
+        the marker stays and the copy keeps refusing reads rather than
+        destroying the only evidence."""
+        from opensearch_tpu.common.telemetry import metrics
+
+        key = (index, shard)
+        with self._lock:
+            if key in self._corrupt_handling:
+                return
+            self._corrupt_handling.add(key)
+        try:
+            self._handle_corruption(index, shard, exc, metrics)
+        finally:
+            with self._lock:
+                self._corrupt_handling.discard(key)
+
+    def _handle_corruption(self, index: str, shard: int,
+                           exc: CorruptIndexError, metrics):
+        metrics().counter("store.corruptions").inc()
+        role = self._roles.get((index, shard))
+        if role is None:
+            return
+        if not self._report_failed_copy(index, shard, self.node_id,
+                                        corrupted=True):
+            return   # no master: keep the marker, stay read-refusing
+        svc = self.indices.get(index)
+        if svc is None:
+            return
+        if role == "primary":
+            # promotion happened (or the group went red); whether this
+            # node still holds a copy is the NEW state's call — dropping
+            # the corrupt files happens when that state assigns us a
+            # fresh replica slot (reset below) or removes the shard
+            state = self.coordinator.state()
+            entry = (state.routing.get(index) or [None] * (shard + 1))[shard]
+            if entry is not None and entry.get("primary") == self.node_id:
+                return   # no safe copy existed: red, data retained
+        svc.reset_local_shard(shard)
+        with self._lock:
+            self._recovered.discard((index, shard))
+        # nudge recovery immediately when the (already-published) state
+        # still lists us as a replica copy — otherwise the next applied
+        # state triggers it
+        try:
+            entry = self._entry(index, shard)
+        except OpenSearchTpuError:
+            return
+        if (self.node_id in (entry.get("replicas") or [])
+                and entry.get("primary")
+                and entry["primary"] != self.node_id):
+            with self._lock:
+                if (index, shard) in self._recovering:
+                    return
+                self._recovering.add((index, shard))
+            threading.Thread(
+                target=self._run_recovery,
+                args=(index, shard, entry["primary"]), daemon=True,
+                name=f"re-recovery-{self.node_id}-{index}-{shard}").start()
 
     def _h_replicate_op(self, payload: dict) -> dict:
         svc = self.indices.get(payload["index"])
@@ -696,7 +863,14 @@ class ClusterNode:
                 {"index": index, "shard": shard, "seg_ids": missing},
                 timeout=30.0)
             blobs = resp["blobs"]
-        engine.install_checkpoint(ckpt, blobs)
+        try:
+            engine.install_checkpoint(ckpt, blobs)
+        except CorruptIndexError:
+            # damaged in flight: refuse the install (nothing mutated) and
+            # catch up on the next published checkpoint or peer recovery
+            from opensearch_tpu.common.telemetry import metrics
+            metrics().counter("recovery.corrupt_blobs").inc()
+            raise
         svc.invalidate_searcher()
         return {"acknowledged": True}
 
@@ -751,6 +925,7 @@ class ClusterNode:
         return {
             "node": self.node_id,
             "duress": self.search_backpressure.in_duress(),
+            "fs_healthy": self.fs_health.healthy,
             "queue_size": sum(
                 1 for t in tasks
                 if t.action.startswith("indices:data/read/search")),
@@ -1154,6 +1329,78 @@ class ClusterNode:
         svc._maybe_slowlog(body, out["resp"])
         return out
 
+    # -- health / cat surfaces --------------------------------------------
+
+    def cluster_health(self) -> dict:
+        """Cluster-scope ``_cluster/health``: red when any shard group
+        has no assigned primary or is flagged corrupted, yellow when
+        replica slots are unfilled or out of sync, green otherwise.
+        Local corruption markers ride along so a red verdict names its
+        evidence."""
+        state = self.coordinator.state()
+        active = unassigned = corrupted = 0
+        status = "green"
+        for index, entries in state.routing.items():
+            for e in entries:
+                if e.get("primary"):
+                    active += 1 + len(e.get("replicas") or [])
+                else:
+                    unassigned += 1
+                    status = "red"
+                if e.get("corrupted"):
+                    corrupted += 1
+                    status = "red"
+                elif status == "green" and (
+                        set(e.get("in_sync") or [])
+                        != set(copies_of(e))):
+                    status = "yellow"
+        local_markers = {
+            name: {str(s): m for s, m in svc.corrupted_shards().items()}
+            for name, svc in self.indices.items()
+            if svc.corrupted_shards()}
+        if local_markers and status == "green":
+            status = "red"
+        out = {
+            "cluster_name": state.cluster_name,
+            "status": status,
+            "number_of_nodes": len(state.nodes),
+            "number_of_data_nodes": len(state.nodes),
+            "active_shards": active,
+            "unassigned_shards": unassigned,
+            "corrupted_shards": corrupted + sum(
+                len(v) for v in local_markers.values()),
+        }
+        if local_markers:
+            out["corruption_markers"] = local_markers
+        return out
+
+    def cat_indices(self) -> list:
+        """Cluster-scope ``_cat/indices`` rows with a real per-index
+        health column (red on unassigned-primary/corruption)."""
+        state = self.coordinator.state()
+        rows = []
+        for index in sorted(state.indices):
+            entries = state.routing.get(index, [])
+            health = "green"
+            for e in entries:
+                if not e.get("primary") or e.get("corrupted"):
+                    health = "red"
+                    break
+                if set(e.get("in_sync") or []) != set(copies_of(e)):
+                    health = "yellow"
+            svc = self.indices.get(index)
+            if svc is not None and svc.corrupted_shards():
+                health = "red"
+            meta = state.indices[index]
+            rows.append({
+                "health": health, "status": "open", "index": index,
+                "pri": str(int((meta.get("settings") or {})
+                               .get("number_of_shards", 1))),
+                "rep": str(int((meta.get("settings") or {})
+                               .get("number_of_replicas", 0))),
+            })
+        return rows
+
     # -- lifecycle ---------------------------------------------------------
 
     def start_election(self) -> bool:
@@ -1166,6 +1413,12 @@ class ClusterNode:
         # searches arrive to tick them (previously admission-path-only,
         # so an idle-but-saturated node never noticed it recovered)
         self.search_backpressure.start_monitor()
+        # periodic disk probe: an fsync that starts failing between
+        # stats reads still flips fs_healthy, which the next
+        # fault-detection ping carries to the leader
+        self.fs_health.check()
+        self.fs_health.start_probe(self.fs_health_interval,
+                                   name=f"fshealth-{self.node_id}")
         return self
 
     def _handshake_peer(self, peer: str):
@@ -1186,6 +1439,7 @@ class ClusterNode:
         # bounded join (stop_monitor joins with a timeout): node teardown
         # must never hang on the backpressure monitor thread
         self.search_backpressure.stop_monitor()
+        self.fs_health.stop_probe()
         self.coordinator.stop()
         with self._lock:
             for svc in self.indices.values():

@@ -19,13 +19,20 @@ from typing import Optional
 class FsHealthService:
     PROBE_FILE = ".es_temp_file"          # the reference's probe name
 
-    def __init__(self, data_path: str):
+    def __init__(self, data_path: str,
+                 slow_path_logging_threshold_ms: Optional[float] = 5000.0):
         self.data_path = data_path
+        # a probe slower than this marks the node unhealthy too — the
+        # reference's fs_health.slow_path_logging_threshold: a disk that
+        # takes seconds per fsync is as gone as one returning EIO
+        self.slow_path_logging_threshold_ms = slow_path_logging_threshold_ms
         self._lock = threading.Lock()
         self._healthy = True
         self._last_error: Optional[str] = None
         self._last_check_ms: Optional[int] = None
         self._last_probe_elapsed_ms: Optional[int] = None
+        self._probe_stop: Optional[threading.Event] = None
+        self._probe_thread: Optional[threading.Thread] = None
 
     def check(self) -> bool:
         """One write+fsync probe; updates and returns health.  The probe
@@ -44,12 +51,49 @@ class FsHealthService:
         except OSError as e:
             ok, err = False, f"{type(e).__name__}: {e}"
         elapsed_ms = int((time.monotonic() - t0) * 1000)
+        if (ok and self.slow_path_logging_threshold_ms is not None
+                and elapsed_ms > self.slow_path_logging_threshold_ms):
+            ok = False
+            err = (f"fsync probe took {elapsed_ms}ms, above the "
+                   f"{self.slow_path_logging_threshold_ms}ms slow-path "
+                   "threshold")
         with self._lock:
             self._healthy = ok
             self._last_error = err
             self._last_check_ms = int(time.time() * 1000)  # wall-clock: timestamp
             self._last_probe_elapsed_ms = elapsed_ms
         return ok
+
+    # -- periodic probe (the reference's scheduled monitorFSHealth) --------
+
+    def start_probe(self, interval_s: float = 5.0, name: str = "fshealth"):
+        """Run ``check()`` on a cadence in a daemon thread — disk death
+        must be noticed BETWEEN stats reads, not just when somebody asks
+        (the gap the module docstring promised and nothing implemented)."""
+        with self._lock:
+            if self._probe_thread is not None:
+                return
+            stop = self._probe_stop = threading.Event()
+
+        def loop():
+            while not stop.wait(interval_s):
+                try:
+                    self.check()
+                except Exception:  # noqa: BLE001 — probe must never die
+                    pass
+        t = threading.Thread(target=loop, name=f"{name}-probe", daemon=True)
+        with self._lock:
+            self._probe_thread = t
+        t.start()
+
+    def stop_probe(self, timeout: float = 2.0):
+        with self._lock:
+            stop, t = self._probe_stop, self._probe_thread
+            self._probe_stop = self._probe_thread = None
+        if stop is not None:
+            stop.set()
+        if t is not None:
+            t.join(timeout=timeout)
 
     @property
     def healthy(self) -> bool:

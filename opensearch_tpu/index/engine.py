@@ -28,12 +28,16 @@ from opensearch_tpu.common.errors import (
 )
 from opensearch_tpu.index.segment import Segment, SegmentWriter
 from opensearch_tpu.index.store import (
+    CorruptIndexError,
     delete_segment_files,
+    find_corruption_markers,
     load_segment,
     save_live,
     save_segment,
     segment_from_blobs,
     segment_to_blobs,
+    verify_segment,
+    write_corruption_marker,
 )
 from opensearch_tpu.index.translog import Translog
 from opensearch_tpu.mapping.mapper import DocumentMapper, ParsedDocument
@@ -54,6 +58,7 @@ class OpResult:
     seq_no: int
     version: int
     result: str                      # created | updated | deleted | not_found
+    primary_term: int = 1            # the term the op executed under
 
 
 class InternalEngine:
@@ -73,6 +78,10 @@ class InternalEngine:
         self.primary_term = 1
         self._lock = threading.RLock()
         self._closed = False
+        # set when the on-disk store failed verification (marker found or
+        # checksum mismatch): the engine refuses reads/writes so a corrupt
+        # copy can never serve wrong data (Store.failIfCorrupted)
+        self.corruption: Optional[CorruptIndexError] = None
         self.segments: list[Segment] = []
         self._hot: list[Optional[ParsedDocument]] = []
         self._version_map: dict[str, VersionEntry] = {}
@@ -111,17 +120,35 @@ class InternalEngine:
 
     def _recover(self):
         """Load the last commit point, then replay translog ops newer than
-        it (RecoverySourceHandler phase-2 analog for the local shard)."""
+        it (RecoverySourceHandler phase-2 analog for the local shard).
+
+        A store with a corruption marker, or one whose checksums fail on
+        load, does NOT open: ``self.corruption`` carries the verdict and
+        every read/write raises it until the copy is dropped and
+        re-recovered (Store.failIfCorrupted / CorruptedFileException)."""
         commit_path = os.path.join(self.data_path, self.COMMIT_FILE)
+        seg_dir = os.path.join(self.data_path, "segments")
+        markers = find_corruption_markers(seg_dir)
+        if markers:
+            self.corruption = CorruptIndexError(
+                f"[{self.index_name}][{self.shard_id}] store is marked "
+                f"corrupted: {markers[0].get('reason', 'unknown')}")
+            return
         committed_seq = -1
         if os.path.exists(commit_path):
             with open(commit_path) as f:
                 commit = json.load(f)
             committed_seq = commit["max_seq_no"]
             self._seg_counter = commit.get("seg_counter", 0)
-            seg_dir = os.path.join(self.data_path, "segments")
             for seg_id in commit["segments"]:
-                seg = load_segment(seg_dir, seg_id)
+                try:
+                    seg = load_segment(seg_dir, seg_id)
+                except CorruptIndexError as e:
+                    write_corruption_marker(seg_dir, seg_id, str(e))
+                    self.corruption = e
+                    self.segments = []
+                    self._persisted_segments.clear()
+                    return
                 self.segments.append(seg)
                 self._persisted_segments.add(seg_id)
             self._seq_no = committed_seq
@@ -158,6 +185,34 @@ class InternalEngine:
     def _ensure_open(self):
         if self._closed:
             raise EngineClosedError(f"engine for [{self.index_name}] is closed")
+        if self.corruption is not None:
+            raise self.corruption
+
+    def verify_store(self):
+        """Full checksum pass over every persisted segment's on-disk
+        files (Store.verify analog).  Detected corruption writes a
+        ``corrupted_<seg>`` marker, poisons the engine, and raises —
+        the caller (ClusterNode) runs the copy-failover protocol."""
+        with self._lock:
+            if self._closed:
+                raise EngineClosedError(
+                    f"engine for [{self.index_name}] is closed")
+            if self.corruption is not None:
+                raise self.corruption
+            seg_dir = os.path.join(self.data_path, "segments")
+            markers = find_corruption_markers(seg_dir)
+            if markers:
+                self.corruption = CorruptIndexError(
+                    f"[{self.index_name}][{self.shard_id}] store is marked "
+                    f"corrupted: {markers[0].get('reason', 'unknown')}")
+                raise self.corruption
+            for seg_id in sorted(self._persisted_segments):
+                try:
+                    verify_segment(seg_dir, seg_id)
+                except CorruptIndexError as e:
+                    write_corruption_marker(seg_dir, seg_id, str(e))
+                    self.corruption = e
+                    raise
 
     # -- version plumbing -------------------------------------------------
 
@@ -260,7 +315,8 @@ class InternalEngine:
         if record:
             self.translog.add_encoded(encoded)
         return OpResult(str(doc_id), seq_no, version,
-                        "updated" if existed else "created")
+                        "updated" if existed else "created",
+                        primary_term=self.primary_term)
 
     def _tombstone_segments(self, doc_id: str):
         for seg in reversed(self.segments):
@@ -279,7 +335,8 @@ class InternalEngine:
             self._check_conflicts(doc_id, entry, if_seq_no, if_primary_term,
                                   version, version_type)
             if entry is None or entry.deleted:
-                return OpResult(str(doc_id), self._seq_no, 1, "not_found")
+                return OpResult(str(doc_id), self._seq_no, 1, "not_found",
+                                primary_term=self.primary_term)
             new_version = (version
                            if version_type in ("external", "external_gte")
                            else entry.version + 1)
@@ -300,7 +357,8 @@ class InternalEngine:
         if record:
             self.translog.add({"op": "delete", "id": str(doc_id),
                                "seq_no": seq_no, "version": version})
-        return OpResult(str(doc_id), seq_no, version, "deleted")
+        return OpResult(str(doc_id), seq_no, version, "deleted",
+                        primary_term=self.primary_term)
 
     def ensure_synced(self):
         """Durability barrier before acking (Translog.ensureSynced analog).

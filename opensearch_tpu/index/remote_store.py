@@ -92,6 +92,47 @@ def read_manifest(repo, index_name: str, shard_id) -> Optional[dict]:
         return None
 
 
+def install_segment_files(seg_dir: str, files: list, read_blob,
+                          on_corrupt=None) -> int:
+    """Verify-and-materialize content-addressed blobs into a shard's
+    segment directory — shared by remote-store restore and snapshot
+    restore.  Every blob is re-hashed against its content address BEFORE
+    any byte reaches a final file name (the dedup key doubles as the
+    integrity check, like the reference re-verifying
+    StoreFileMetadata checksums on restore); a mismatch raises via
+    ``on_corrupt(name, blob)`` (default: RemoteStoreError).  Segment
+    commit manifests are regenerated from the verified bytes so the
+    restored store is checksum-verifiable from its first open."""
+    import hashlib
+
+    from opensearch_tpu.index import store as _store
+
+    os.makedirs(seg_dir, exist_ok=True)
+    entries: dict[str, dict] = {}
+    for fmeta in files:
+        name = fmeta["name"]
+        validate_manifest_name(name)
+        data = read_blob(fmeta["blob"])
+        digest = hashlib.sha256(data).hexdigest()
+        if digest != fmeta["blob"]:
+            if on_corrupt is not None:
+                raise on_corrupt(name, fmeta["blob"])
+            raise RemoteStoreError(
+                f"blob [{fmeta['blob']}] for [{name}] failed content "
+                f"verification (sha256 [{digest}]) — not installing it")
+        _store.write_durable(os.path.join(seg_dir, name), data)
+        entries[name] = _store.file_checksum(data)
+    by_seg: dict[str, dict] = {}
+    for name, cksum in entries.items():
+        for suffix in (".json", ".npz", ".src"):
+            if name.endswith(suffix):
+                by_seg.setdefault(name[: -len(suffix)], {})[name] = cksum
+    for seg_id, seg_entries in sorted(by_seg.items()):
+        if len(seg_entries) == 3:        # complete data-file set only
+            _store.write_segment_manifest(seg_dir, seg_id, seg_entries)
+    return len(files)
+
+
 def validate_manifest_name(name: str) -> str:
     """Manifest-supplied file names join into the shard directory — the
     same rule FsBlobContainer._path enforces for blob names (no path
@@ -114,16 +155,7 @@ def restore_shard(repo, index_name: str, shard_id,
         raise ResourceNotFoundError(
             f"no remote store manifest for [{index_name}][{shard_id}]")
     seg_dir = os.path.join(shard_dir, "segments")
-    os.makedirs(seg_dir, exist_ok=True)
-    for fmeta in manifest["files"]:
-        validate_manifest_name(fmeta["name"])
-        data = repo.blobs.read_blob(fmeta["blob"])
-        tmp = os.path.join(seg_dir, fmeta["name"] + ".tmp")
-        with open(tmp, "wb") as f:
-            f.write(data)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, os.path.join(seg_dir, fmeta["name"]))
+    install_segment_files(seg_dir, manifest["files"], repo.blobs.read_blob)
     commit = dict(manifest["commit"])
     tmp = os.path.join(shard_dir, "commit.json.tmp")
     with open(tmp, "w") as f:

@@ -6,10 +6,25 @@ concatenated _source bytes per segment.  Arrays are written exactly as the
 in-memory Segment holds them (the device staging re-pads on load), and the
 live-docs bitmap is rewritten in place on delete-commit like Lucene's
 ``.liv`` files.
+
+Durability + integrity (the ``CodecUtil.checkFooter`` / ``Store.verify``
+analogs): every segment commit writes its data files tmp+fsync+rename and
+then commits them with ONE atomic rename of a ``<seg_id>.manifest`` file
+recording the length and CRC32 of every data file — a crash anywhere in
+the sequence leaves either no manifest (the segment never existed) or a
+manifest whose files all verify.  ``load_segment`` / ``verify_segment``
+check every byte against the manifest before decoding and raise
+``CorruptIndexError`` naming the offending file; the ``.liv`` sidecar
+(rewritten on delete-commit, so it can't live in the immutable manifest)
+carries its own CRC32 footer-style header instead.  A detected corruption
+is recorded as a ``corrupted_<seg_id>.json`` marker in the segment
+directory (``Store.markStoreCorrupted`` / ``CorruptedFileException``) and
+a marked store refuses to open until the copy is dropped and re-recovered.
 """
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import zlib
@@ -96,13 +111,120 @@ def _segment_encode(seg: Segment):
 
 CODECS = ("default", "best_compression")
 
+MANIFEST_SUFFIX = ".manifest"
+_DATA_SUFFIXES = (".json", ".npz", ".src")
+
+
+def file_checksum(data: bytes) -> dict:
+    """The per-file integrity record the manifest carries (CodecUtil
+    footer analog: length + CRC32 over the whole payload)."""
+    return {"length": len(data), "crc32": zlib.crc32(data) & 0xFFFFFFFF}
+
+
+def write_durable(path: str, data: bytes):
+    """tmp + fsync + atomic rename — the only sanctioned way a file
+    reaches its final name in the segment store."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def write_segment_manifest(dirpath: str, seg_id: str, entries: dict):
+    """Commit point of a segment: one atomic rename installing the
+    manifest that names every data file with its length + CRC32."""
+    payload = json.dumps({"seg_id": seg_id, "files": entries},
+                         sort_keys=True).encode()
+    write_durable(os.path.join(dirpath, seg_id + MANIFEST_SUFFIX), payload)
+
+
+def read_segment_manifest(dirpath: str, seg_id: str):
+    p = os.path.join(dirpath, seg_id + MANIFEST_SUFFIX)
+    if not os.path.exists(p):
+        return None     # pre-manifest directory (legacy, unverifiable)
+    try:
+        with open(p, "rb") as f:
+            m = json.loads(f.read().decode())
+        if not isinstance(m.get("files"), dict):
+            raise ValueError("manifest has no [files] map")
+        return m
+    except (OSError, ValueError) as e:
+        raise CorruptIndexError(
+            f"segment manifest [{seg_id}{MANIFEST_SUFFIX}] is unreadable: "
+            f"{e}") from e
+
+
+def _verify_bytes(name: str, data: bytes, want: dict):
+    got = file_checksum(data)
+    if got["length"] != int(want["length"]):
+        raise CorruptIndexError(
+            f"segment file [{name}] length mismatch: manifest records "
+            f"{want['length']} bytes, found {got['length']}")
+    if got["crc32"] != int(want["crc32"]):
+        raise CorruptIndexError(
+            f"segment file [{name}] checksum mismatch: manifest records "
+            f"crc32 [{want['crc32']:08x}], found [{got['crc32']:08x}]")
+
+
+def _read_verified(dirpath: str, name: str, manifest) -> bytes:
+    path = os.path.join(dirpath, name)
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        raise CorruptIndexError(
+            f"cannot read segment file [{name}]: {e}") from e
+    if manifest is not None:
+        want = manifest["files"].get(name)
+        if want is None:
+            raise CorruptIndexError(
+                f"segment file [{name}] is not recorded in its manifest")
+        _verify_bytes(name, data, want)
+    return data
+
+
+def _encode_liv(live: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, live)
+    payload = buf.getvalue()
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    return f"{crc:08x}".encode() + payload
+
+
+def _decode_liv(seg_id: str, data: bytes) -> np.ndarray:
+    """The .liv sidecar is rewritten on every delete-commit, so it lives
+    OUTSIDE the immutable manifest and carries its own CRC32 header
+    (8 hex bytes) — legacy raw ``np.save`` payloads (starting with the
+    numpy magic, never valid hex) load unverified."""
+    head = data[:8]
+    try:
+        expected = int(head, 16)
+    except ValueError:
+        return np.load(io.BytesIO(data)).copy()   # legacy, unverifiable
+    payload = data[8:]
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != expected:
+        raise CorruptIndexError(
+            f"segment file [{seg_id}.liv] checksum mismatch")
+    try:
+        return np.load(io.BytesIO(payload)).copy()
+    except ValueError as e:
+        raise CorruptIndexError(
+            f"segment file [{seg_id}.liv] is undecodable: {e}") from e
+
 
 def save_segment(seg: Segment, dirpath: str, codec: str = "default"):
     """``codec`` mirrors the reference's two stored-field codecs (ref
     index/codec/CodecService.java:46 — LZ4 "default" vs zstd/DEFLATE
     "best_compression", the index.codec setting): best_compression
     deflates the arrays (compressed npz) and the _source blob, trading
-    write CPU for disk; the read path is self-describing via meta."""
+    write CPU for disk; the read path is self-describing via meta.
+
+    Commit discipline: data files land tmp+fsync+rename (invisible to
+    readers — nothing references them yet), then the manifest rename is
+    the single atomic commit point.  A crash between any two steps
+    leaves the previous committed state fully intact."""
     if codec not in CODECS:
         raise OpenSearchTpuError(f"unknown codec [{codec}]")
     os.makedirs(dirpath, exist_ok=True)
@@ -111,71 +233,137 @@ def save_segment(seg: Segment, dirpath: str, codec: str = "default"):
     if compress:
         meta["src_codec"] = "zlib"
         src_bytes = zlib.compress(src_bytes, 6)
-    base = os.path.join(dirpath, seg.seg_id)
-    with open(base + ".src.tmp", "wb") as f:
-        f.write(src_bytes)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(base + ".src.tmp", base + ".src")
-    with open(base + ".npz.tmp", "wb") as f:
-        (np.savez_compressed if compress else np.savez)(f, **arrays)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(base + ".npz.tmp", base + ".npz")
-    with open(base + ".json.tmp", "w") as f:
-        json.dump(meta, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(base + ".json.tmp", base + ".json")
+    buf = io.BytesIO()
+    (np.savez_compressed if compress else np.savez)(buf, **arrays)
+    entries = {}
+    for suffix, data in ((".src", src_bytes), (".npz", buf.getvalue()),
+                         (".json", json.dumps(meta).encode())):
+        name = seg.seg_id + suffix
+        write_durable(os.path.join(dirpath, name), data)
+        entries[name] = file_checksum(data)
+    write_segment_manifest(dirpath, seg.seg_id, entries)
 
 
 def save_live(seg: Segment, dirpath: str):
-    """Rewrite only the live-docs bitmap (Lucene .liv analog)."""
-    base = os.path.join(dirpath, seg.seg_id)
-    with open(base + ".liv.tmp", "wb") as f:
-        np.save(f, seg.live)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(base + ".liv.tmp", base + ".liv")
+    """Rewrite only the live-docs bitmap (Lucene .liv analog); the CRC
+    header makes the file self-verifying (see ``_decode_liv``)."""
+    write_durable(os.path.join(dirpath, seg.seg_id + ".liv"),
+                  _encode_liv(seg.live))
 
 
 def load_segment(dirpath: str, seg_id: str) -> Segment:
-    base = os.path.join(dirpath, seg_id)
+    """Read, VERIFY (against the commit manifest), then decode — a
+    checksum mismatch raises ``CorruptIndexError`` naming the file
+    before any bytes are interpreted (Store.verify-on-open)."""
+    manifest = read_segment_manifest(dirpath, seg_id)
     try:
-        with open(base + ".json") as f:
-            meta = json.load(f)
-        z = np.load(base + ".npz")
-        with open(base + ".src", "rb") as f:
-            src_blob = f.read()
+        json_b = _read_verified(dirpath, seg_id + ".json", manifest)
+        npz_b = _read_verified(dirpath, seg_id + ".npz", manifest)
+        src_blob = _read_verified(dirpath, seg_id + ".src", manifest)
+        meta = json.loads(json_b.decode())
+        z = np.load(io.BytesIO(npz_b))
         if meta.get("src_codec") == "zlib":
             src_blob = zlib.decompress(src_blob)
+    except CorruptIndexError:
+        raise
     except (OSError, ValueError, zlib.error) as e:
         raise CorruptIndexError(f"cannot read segment [{seg_id}]: {e}") from e
     seg = _segment_decode(seg_id, meta, z, src_blob)
-    if os.path.exists(base + ".liv"):
-        seg.live = np.load(base + ".liv").copy()
+    liv_path = os.path.join(dirpath, seg_id + ".liv")
+    if os.path.exists(liv_path):
+        with open(liv_path, "rb") as f:
+            seg.live = _decode_liv(seg_id, f.read())
     return seg
+
+
+def verify_segment(dirpath: str, seg_id: str) -> bool:
+    """Checksum-only pass over a committed segment's on-disk files —
+    the ``Store.verify`` analog (no decoding, no allocation of decoded
+    structures).  Returns False when the segment predates manifests
+    (nothing to verify against); raises ``CorruptIndexError`` naming
+    the first bad file."""
+    manifest = read_segment_manifest(dirpath, seg_id)
+    liv_path = os.path.join(dirpath, seg_id + ".liv")
+    if os.path.exists(liv_path):
+        with open(liv_path, "rb") as f:
+            _decode_liv(seg_id, f.read())
+    if manifest is None:
+        return False
+    for name in sorted(manifest["files"]):
+        _read_verified(dirpath, name, manifest)
+    return True
+
+
+# -- corruption markers (Store.markStoreCorrupted analog) -------------------
+
+_MARKER_PREFIX = "corrupted_"
+
+
+def write_corruption_marker(dirpath: str, seg_id: str, reason: str):
+    """Persist the verdict so the store refuses to reopen until the copy
+    is dropped and re-recovered (Store.failIfCorrupted)."""
+    os.makedirs(dirpath, exist_ok=True)
+    write_durable(
+        os.path.join(dirpath, f"{_MARKER_PREFIX}{seg_id}.json"),
+        json.dumps({"segment": seg_id, "reason": reason},
+                   sort_keys=True).encode())
+
+
+def find_corruption_markers(dirpath: str) -> list[dict]:
+    out = []
+    if not os.path.isdir(dirpath):
+        return out
+    for fname in sorted(os.listdir(dirpath)):
+        if not fname.startswith(_MARKER_PREFIX) \
+                or not fname.endswith(".json") or fname.endswith(".tmp"):
+            continue
+        try:
+            with open(os.path.join(dirpath, fname), "rb") as f:
+                out.append(json.loads(f.read().decode()))
+        except (OSError, ValueError):
+            out.append({"segment": fname[len(_MARKER_PREFIX):-len(".json")],
+                        "reason": "unreadable corruption marker"})
+    return out
+
+
+def clear_corruption_markers(dirpath: str):
+    if not os.path.isdir(dirpath):
+        return
+    for fname in list(os.listdir(dirpath)):
+        if fname.startswith(_MARKER_PREFIX) and fname.endswith(".json"):
+            os.remove(os.path.join(dirpath, fname))
+
+
+# -- wire serialization (recovery / segment replication file copy) ----------
 
 
 def segment_to_blobs(seg: Segment) -> dict:
     """Serialize a segment to wire-shippable blobs {json, npz, src} — the
     'file copy' unit of segment replication and peer recovery phase 1
-    (ref indices/recovery/RecoverySourceHandler.java:105)."""
-    import io
-
+    (ref indices/recovery/RecoverySourceHandler.java:105).  Each blob's
+    length + CRC32 travels alongside, so the receiving replica verifies
+    the copy before installing it (RecoveryTarget's per-chunk checksum)."""
     arrays, meta, src_bytes = _segment_encode(seg)
     buf = io.BytesIO()
     np.savez(buf, **arrays)
-    return {"json": json.dumps(meta).encode(), "npz": buf.getvalue(),
-            "src": src_bytes}
+    blobs = {"json": json.dumps(meta).encode(), "npz": buf.getvalue(),
+             "src": src_bytes}
+    blobs["checksums"] = {k: file_checksum(v) for k, v in blobs.items()}
+    return blobs
 
 
 def segment_from_blobs(blobs: dict) -> Segment:
-    import io
-
+    checksums = blobs.get("checksums")
     try:
+        if checksums is not None:
+            for part in ("json", "npz", "src"):
+                want = checksums.get(part)
+                if want is not None:
+                    _verify_bytes(f"<wire>.{part}", blobs[part], want)
         meta = json.loads(blobs["json"].decode())
         z = np.load(io.BytesIO(blobs["npz"]))
+    except CorruptIndexError:
+        raise
     except (KeyError, ValueError) as e:
         raise CorruptIndexError(f"cannot decode segment blobs: {e}") from e
     return _segment_decode(meta["seg_id"], meta, z, blobs["src"])
@@ -245,7 +433,9 @@ def _segment_decode(seg_id: str, meta: dict, z, src_blob: bytes) -> Segment:
 
 
 def delete_segment_files(dirpath: str, seg_id: str):
-    for ext in (".npz", ".json", ".src", ".liv"):
+    # manifest first: once it's gone the segment is uncommitted, so a
+    # crash mid-deletion can't leave a manifest naming missing files
+    for ext in (MANIFEST_SUFFIX, ".npz", ".json", ".src", ".liv"):
         p = os.path.join(dirpath, seg_id + ext)
         if os.path.exists(p):
             os.remove(p)

@@ -56,6 +56,8 @@ class Translog:
         if ckp is not None and ckp.get("generation") == self.generation:
             synced = int(ckp.get("synced_offset", 0))
         self._truncate_torn_tail(self._gen_path(self.generation), synced)
+        # append-only WAL: durability comes from sync()'s fsync +
+        # checkpoint high-water mark, CRC recovery # non-durable-ok
         self._file = open(self._gen_path(self.generation), "ab")
         self._synced_offset = synced
         self._ops_since_sync = 0
@@ -128,6 +130,8 @@ class Translog:
                 f"the fsync high-water mark ({synced_offset}) — acked ops "
                 "are corrupt, refusing to truncate them away")
         if good_end < len(data):
+            # in-place truncation of an UNACKED tail: the fsync below
+            # persists it; rename can't shorten # non-durable-ok
             with open(path, "r+b") as f:
                 f.truncate(good_end)
                 f.flush()
@@ -196,6 +200,7 @@ class Translog:
         self.sync()
         self._file.close()
         self.generation += 1
+        # non-durable-ok: fresh append-only generation (see __init__)
         self._file = open(self._gen_path(self.generation), "ab")
         self._synced_offset = 0
         self._write_checkpoint()

@@ -201,6 +201,39 @@ class IndexService:
                 self._searcher = None
                 self._mesh_searcher = None
 
+    def reset_local_shard(self, shard_id: int):
+        """Drop a shard copy's on-disk state entirely and reopen empty —
+        the corruption-failover primitive: a copy that failed store
+        verification is discarded (corruption markers included) and
+        re-recovered from the primary (the reference deletes the shard
+        directory before re-allocating a failed copy there)."""
+        import shutil
+        with self._lock:
+            engine = self.local_shards.pop(shard_id, None)
+            if engine is not None:
+                engine.close()
+            shutil.rmtree(os.path.join(self.data_path, str(shard_id)),
+                          ignore_errors=True)
+            self.local_shards[shard_id] = self._open_shard(shard_id)
+            self._searcher = None
+            self._mesh_searcher = None
+            self._reader_gen += 1
+
+    def corrupted_shards(self) -> dict:
+        """shard_id -> corruption markers/verdicts for local copies that
+        failed store verification (the red-status evidence
+        ``_cluster/health`` and ``_cat/indices`` surface)."""
+        from opensearch_tpu.index.store import find_corruption_markers
+        out = {}
+        for sid, engine in sorted(self.local_shards.items()):
+            markers = find_corruption_markers(
+                os.path.join(engine.data_path, "segments"))
+            if engine.corruption is not None and not markers:
+                markers = [{"reason": str(engine.corruption)}]
+            if markers:
+                out[sid] = markers
+        return out
+
     # -- routing ----------------------------------------------------------
 
     def route_shard(self, doc_id: str, routing: Optional[str] = None) -> int:
@@ -309,13 +342,16 @@ class IndexService:
                     results.append({action: {
                         "_index": self.name, "_id": r.doc_id,
                         "_version": r.version, "_seq_no": r.seq_no,
+                        "_primary_term": r.primary_term,
                         "result": r.result,
                         "status": 201 if r.result == "created" else 200}})
                 elif action == "delete":
                     r = self.delete_doc(doc_id, routing=params.get("routing"))
                     results.append({"delete": {
                         "_index": self.name, "_id": r.doc_id,
-                        "_version": r.version, "result": r.result,
+                        "_version": r.version, "_seq_no": r.seq_no,
+                        "_primary_term": r.primary_term,
+                        "result": r.result,
                         "status": 404 if r.result == "not_found" else 200}})
                 elif action == "update":
                     cur = self.get_doc(doc_id, params.get("routing"))

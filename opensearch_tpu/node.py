@@ -323,6 +323,12 @@ class Node:
         # no new searches arrive to tick it (SearchBackpressureService's
         # scheduled run)
         self.search_backpressure.start_monitor()
+        # periodic disk probe (FsHealthService.monitorFSHealth's schedule):
+        # health was previously only refreshed when _nodes/stats was read —
+        # a dead disk between reads went unnoticed
+        self.fs_health.start_probe(
+            float(os.environ.get("OSTPU_FSHEALTH_INTERVAL", "5.0")),
+            name=f"fshealth-{self.name}")
         # re-run persistent tasks that never completed (crash between
         # submit and completion); executors are idempotent
         self.persistent_tasks.resume_incomplete()
@@ -336,6 +342,7 @@ class Node:
             return
         self._stopped = True
         self.search_backpressure.stop_monitor()
+        self.fs_health.stop_probe()
         self.http.stop()
         self.indices.close()
         self.thread_pool.shutdown()

@@ -491,7 +491,20 @@ class RestController:
                          for s in indices.values())
         active = sum(s.num_shards for s in indices.values())
         status = "yellow" if unassigned else "green"
+        # a shard copy that failed store verification (corruption
+        # marker on disk) makes the cluster red — Store.verify /
+        # CorruptedFileException surfaced the way the reference fails
+        # the shard
+        corrupted = {name: sorted(svc.corrupted_shards())
+                     for name, svc in indices.items()
+                     if svc.corrupted_shards()}
+        if corrupted:
+            status = "red"
+        extra = ({"corrupted_shards": sum(len(v)
+                                          for v in corrupted.values())}
+                 if corrupted else {})
         return 200, {
+            **extra,
             "cluster_name": self.node.cluster_name,
             "status": status,
             "timed_out": False,
@@ -661,7 +674,8 @@ class RestController:
     def h_cat_indices(self, req):
         rows = []
         for name, svc in sorted(self.node.indices.indices.items()):
-            rows.append({"health": "green", "status": "open", "index": name,
+            health = "red" if svc.corrupted_shards() else "green"
+            rows.append({"health": health, "status": "open", "index": name,
                          "uuid": svc.uuid, "pri": str(svc.num_shards),
                          "rep": str(svc.num_replicas),
                          "docs.count": str(svc.doc_count())})
@@ -1095,7 +1109,9 @@ class RestController:
         status = 201 if r.result == "created" else 200
         out = {"_index": svc.name, "_id": r.doc_id,
                "_version": r.version, "_seq_no": r.seq_no,
-               "_primary_term": 1, "result": r.result,
+               # the engine's REAL primary term (bumped on promotion),
+               # not a hardcoded 1 — fencing is observable to clients
+               "_primary_term": r.primary_term, "result": r.result,
                "_shards": {"total": 1, "successful": 1, "failed": 0}}
         if forced:
             out["forced_refresh"] = True
@@ -1161,7 +1177,8 @@ class RestController:
                          "_shards": {"total": 1, "successful": 1,
                                      "failed": 0}}
         out = {"_index": name, "_id": r.doc_id, "_version": r.version,
-               "_seq_no": r.seq_no, "result": "deleted",
+               "_seq_no": r.seq_no, "_primary_term": r.primary_term,
+               "result": "deleted",
                "_shards": {"total": 1, "successful": 1, "failed": 0}}
         if forced:
             out["forced_refresh"] = True
@@ -1231,7 +1248,7 @@ class RestController:
         r = svc.index_doc(doc_id, merged, routing=req.param("routing"), **kw)
         forced = self._maybe_refresh(svc, req, doc_id=r.doc_id)
         out = {"_index": name, "_id": r.doc_id, "_version": r.version,
-               "_seq_no": r.seq_no,
+               "_seq_no": r.seq_no, "_primary_term": r.primary_term,
                "result": "created" if created else "updated",
                "_shards": {"total": 1, "successful": 1, "failed": 0}}
         if forced:

@@ -54,6 +54,16 @@ class InvalidSnapshotNameError(ValidationError):
     pass
 
 
+class SnapshotRestoreError(OpenSearchTpuError):
+    """A snapshot blob failed content verification on restore — the
+    repository bit-rotted under us (the reference's
+    SnapshotRestoreException over a CorruptedFileException): the bad
+    blob is NAMED and nothing of it is installed."""
+
+    wire_name = "snapshot_restore_exception"
+    status = 500
+
+
 def collect_referenced_blobs(repo, snapshots: Optional[list] = None) -> set:
     """Every blob hash ANY consumer of the shared content-addressed space
     still needs: snapshot manifests AND remote-store shard manifests.
@@ -411,15 +421,18 @@ class SnapshotsService:
                     os.replace(tmp, os.path.join(shard_dir,
                                                  "remote_ref.json"))
                 else:
-                    for fmeta in smeta["files"]:
-                        data = repo.blobs.read_blob(fmeta["blob"])
-                        tmp = os.path.join(seg_dir, fmeta["name"] + ".tmp")
-                        with open(tmp, "wb") as f:
-                            f.write(data)
-                            f.flush()
-                            os.fsync(f.fileno())
-                        os.replace(tmp,
-                                   os.path.join(seg_dir, fmeta["name"]))
+                    # every blob is re-hashed against its content
+                    # address before installing: a bit-rotted repository
+                    # surfaces as snapshot_restore_exception naming the
+                    # blob instead of materializing a corrupt shard
+                    from opensearch_tpu.index.remote_store import \
+                        install_segment_files
+                    install_segment_files(
+                        seg_dir, smeta["files"], repo.blobs.read_blob,
+                        on_corrupt=lambda fname, blob: SnapshotRestoreError(
+                            f"[{repo_name}:{snapshot}] blob [{blob}] for "
+                            f"file [{fname}] failed checksum verification "
+                            "— refusing to install it"))
                 commit = dict(smeta["commit"])
                 # the restored translog starts empty at the commit's
                 # generation (flush-before-snapshot trimmed it)

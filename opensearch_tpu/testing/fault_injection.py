@@ -25,8 +25,10 @@ Usage::
 from __future__ import annotations
 
 import fnmatch
+import os
 import random
 import threading
+import time
 from typing import Optional
 
 from opensearch_tpu.common.errors import NodeDisconnectedError
@@ -280,3 +282,255 @@ class FaultInjector:
         self._installed.clear()
         self._partitions.clear()
         self._group_partitions.clear()
+
+
+# ---------------------------------------------------------------------------
+# Disk fault injection (the MockFileSystem / disruptive-FS analog)
+# ---------------------------------------------------------------------------
+
+
+class _DiskRule:
+    """One installed disk fault: matches (op, absolute path) by fnmatch
+    pattern, ``times``-bounded or sticky, probability drawn from the
+    injector's seeded stream — the same Directive idioms as the
+    transport rules above."""
+
+    def __init__(self, injector: "DiskFaultInjector", op: str,
+                 pattern: str, probability: float, times: Optional[int],
+                 **params):
+        self.injector = injector
+        self.op = op                     # read | write | fsync
+        self.pattern = pattern
+        self.probability = float(probability)
+        self.remaining = times           # None = sticky
+        self.params = params
+        self._lock = threading.Lock()
+
+    def matches(self, op: str, path: str) -> bool:
+        if op != self.op:
+            return False
+        if path != self.pattern and not fnmatch.fnmatch(path, self.pattern):
+            return False
+        with self._lock:
+            if self.remaining is not None and self.remaining <= 0:
+                return False
+            if self.probability < 1.0 \
+                    and self.injector._random() >= self.probability:
+                return False
+            if self.remaining is not None:
+                self.remaining -= 1
+        return True
+
+
+class _CorruptedReader:
+    """File-object proxy serving pre-corrupted bytes; supports the
+    read/iterate/context-manager surface the store and json/numpy
+    loaders use."""
+
+    def __init__(self, path: str, data: bytes, text: bool):
+        import io
+        self.name = path
+        self._buf = (io.StringIO(data.decode("utf-8", "replace"))
+                     if text else io.BytesIO(data))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __iter__(self):
+        return iter(self._buf)
+
+    def __getattr__(self, name):
+        return getattr(self._buf, name)
+
+
+class DiskFaultInjector:
+    """Deterministic disk-level fault injection: while active, patches
+    ``builtins.open`` and ``os.fsync`` so files whose ABSOLUTE PATH
+    matches an installed rule misbehave — bit-flips and truncation on
+    read, EIO/ENOSPC on write or fsync, slow fsync — everything else
+    passes through untouched.  Every probabilistic choice and corruption
+    offset comes from one seeded stream, so a fixed seed replays the
+    same damage.
+
+    Usage::
+
+        disk = DiskFaultInjector(seed=7)
+        disk.corrupt_read(f"{data}/segments/*.npz", times=1)
+        disk.fail_fsync(f"{data}/*", err=errno.EIO)
+        with disk:                       # activate() / deactivate()
+            ...
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
+        self._rules: list[_DiskRule] = []
+        self._rules_lock = threading.Lock()
+        self._active = False
+        self._real_open = None
+        self._real_fsync = None
+        self._fd_paths: dict[int, str] = {}
+
+    def _random(self) -> float:
+        with self._rng_lock:
+            return self._rng.random()
+
+    def _randrange(self, n: int) -> int:
+        with self._rng_lock:
+            return self._rng.randrange(n)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def activate(self) -> "DiskFaultInjector":
+        import builtins
+        if self._active:
+            return self
+        self._active = True
+        self._real_open = builtins.open
+        self._real_fsync = os.fsync
+        builtins.open = self._open
+        os.fsync = self._fsync
+        return self
+
+    def deactivate(self):
+        import builtins
+        if not self._active:
+            return
+        builtins.open = self._real_open
+        os.fsync = self._real_fsync
+        self._active = False
+        self._fd_paths.clear()
+
+    __enter__ = activate
+
+    def __exit__(self, *exc):
+        self.deactivate()
+        return False
+
+    # -- rules -------------------------------------------------------------
+
+    def _install(self, rule: _DiskRule) -> _DiskRule:
+        with self._rules_lock:
+            self._rules.append(rule)
+        return rule
+
+    def corrupt_read(self, pattern: str, times: Optional[int] = None,
+                     probability: float = 1.0,
+                     mode: str = "bitflip") -> _DiskRule:
+        """Serve damaged bytes when a matching file is opened for
+        reading: ``bitflip`` XORs one seeded byte, ``truncate`` cuts the
+        tail at a seeded offset — the two bit-rot shapes checksum
+        verification must catch."""
+        if mode not in ("bitflip", "truncate"):
+            raise ValueError(f"unknown corruption mode [{mode}]")
+        return self._install(_DiskRule(self, "read", pattern, probability,
+                                       times, mode=mode))
+
+    def fail_read(self, pattern: str, err: Optional[int] = None,
+                  times: Optional[int] = None,
+                  probability: float = 1.0) -> _DiskRule:
+        """EIO (or ``err``) when a matching file is opened for reading."""
+        import errno
+        return self._install(_DiskRule(self, "read", pattern, probability,
+                                       times, err=err or errno.EIO))
+
+    def fail_write(self, pattern: str, err: Optional[int] = None,
+                   times: Optional[int] = None,
+                   probability: float = 1.0) -> _DiskRule:
+        """EIO (or ``err``) when a matching file is opened for writing."""
+        import errno
+        return self._install(_DiskRule(self, "write", pattern, probability,
+                                       times, err=err or errno.EIO))
+
+    def enospc(self, pattern: str, times: Optional[int] = None,
+               probability: float = 1.0) -> _DiskRule:
+        """Disk-full on write — the classic slow-death failure mode."""
+        import errno
+        return self.fail_write(pattern, err=errno.ENOSPC, times=times,
+                               probability=probability)
+
+    def fail_fsync(self, pattern: str, err: Optional[int] = None,
+                   times: Optional[int] = None,
+                   probability: float = 1.0) -> _DiskRule:
+        """EIO (or ``err``) from ``os.fsync`` on a matching file — the
+        fault FsHealthService's probe exists to notice."""
+        import errno
+        return self._install(_DiskRule(self, "fsync", pattern, probability,
+                                       times, err=err or errno.EIO))
+
+    def slow_fsync(self, pattern: str, seconds: float,
+                   times: Optional[int] = None,
+                   probability: float = 1.0) -> _DiskRule:
+        """Delay ``os.fsync`` on a matching file (degrading-disk shape:
+        the write path stalls before it fails)."""
+        return self._install(_DiskRule(self, "fsync", pattern, probability,
+                                       times, seconds=float(seconds)))
+
+    def remove(self, rule: _DiskRule) -> bool:
+        with self._rules_lock:
+            if rule in self._rules:
+                self._rules.remove(rule)
+                return True
+        return False
+
+    def clear(self):
+        with self._rules_lock:
+            self._rules.clear()
+
+    # -- patched entry points ----------------------------------------------
+
+    def _match(self, op: str, path: str) -> Optional[_DiskRule]:
+        with self._rules_lock:
+            rules = list(self._rules)
+        for rule in rules:
+            if rule.matches(op, path):
+                return rule
+        return None
+
+    def _corrupt(self, data: bytes, mode: str) -> bytes:
+        if not data:
+            return data
+        if mode == "truncate":
+            return data[: self._randrange(len(data))]
+        i = self._randrange(len(data))
+        return data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:]
+
+    def _open(self, file, mode="r", *args, **kwargs):
+        real = self._real_open
+        if not isinstance(file, (str, bytes, os.PathLike)):
+            return real(file, mode, *args, **kwargs)
+        path = os.path.abspath(os.fsdecode(file))
+        writing = any(c in mode for c in "wax+")
+        rule = self._match("write" if writing else "read", path)
+        if rule is not None and "err" in rule.params:
+            raise OSError(rule.params["err"],
+                          "[fault_injection] injected disk error", path)
+        if rule is not None and not writing and "mode" in rule.params:
+            with real(path, "rb") as f:
+                data = f.read()
+            return _CorruptedReader(path, self._corrupt(
+                data, rule.params["mode"]), text="b" not in mode)
+        f = real(file, mode, *args, **kwargs)
+        try:
+            self._fd_paths[f.fileno()] = path
+        except (OSError, ValueError, AttributeError):
+            pass
+        return f
+
+    def _fsync(self, fd):
+        path = self._fd_paths.get(fd)
+        if path is not None:
+            rule = self._match("fsync", path)
+            if rule is not None:
+                if "seconds" in rule.params:
+                    time.sleep(rule.params["seconds"])
+                else:
+                    raise OSError(rule.params["err"],
+                                  "[fault_injection] injected fsync error",
+                                  path)
+        return self._real_fsync(fd)

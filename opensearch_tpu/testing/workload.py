@@ -32,6 +32,7 @@ enforced in tier-1 via ``tests/test_soak.py`` and recorded as a
 from __future__ import annotations
 
 import json
+import os
 import random
 import shutil
 import tempfile
@@ -249,13 +250,15 @@ class FaultSchedule:
         part_victim = next(nid for nid in others if nid != "n0") \
             if "n0" in others else rng.choice(others)
         # seeded jitter on each slot (clamped monotone so paired
-        # directives — stall/release, induce/clear, partition/heal,
-        # kill/restart — keep their order): where a fault lands in the
-        # op stream is part of the schedule the seed replays
+        # directives — stall/release, induce/clear, unhealthy/heal,
+        # partition/heal, kill/restart — keep their order): where a
+        # fault lands in the op stream is part of the schedule the seed
+        # replays.  Disk faults (corrupt_segment, disk_unhealthy) ride
+        # the same schedule — the fault class PRs 2-7 couldn't inject.
         jitter = max(1, n // 24)
         at: list = []
-        for f in (0.10, 0.20, 0.30, 0.40, 0.48,
-                  0.60, 0.66, 0.76, 0.84, 0.94):
+        for f in (0.08, 0.16, 0.24, 0.32, 0.38, 0.46, 0.54,
+                  0.60, 0.68, 0.76, 0.84, 0.90, 0.96):
             base = max(1, int(n * f)) + rng.randint(0, jitter)
             at.append(min(max(at[-1] if at else 1, base), n - 1))
         return [
@@ -266,15 +269,22 @@ class FaultSchedule:
             {"step": at[2], "fault": "stall_search", "node": stall_victim,
              "times": 2},
             {"step": at[3], "fault": "release_stall"},
-            {"step": at[4], "fault": "induce_duress",
+            # disk fault 1: a seeded bit-flip in one replica's committed
+            # segment file — detection, A_FAIL_COPY, drop + re-recovery
+            {"step": at[4], "fault": "corrupt_segment"},
+            {"step": at[5], "fault": "induce_duress",
              "nodes": list(duress_victims)},
-            {"step": at[5], "fault": "clear_duress",
+            {"step": at[6], "fault": "clear_duress",
              "nodes": list(duress_victims)},
-            {"step": at[6], "fault": "partition", "node": part_victim},
-            {"step": at[7], "fault": "heal_partition",
+            # disk fault 2: a node whose fsync probe starts failing is
+            # evicted by the leader (FsHealth piggyback), then healed
+            {"step": at[7], "fault": "disk_unhealthy"},
+            {"step": at[8], "fault": "disk_heal"},
+            {"step": at[9], "fault": "partition", "node": part_victim},
+            {"step": at[10], "fault": "heal_partition",
              "node": part_victim},
-            {"step": at[8], "fault": "kill_leader"},
-            {"step": at[9], "fault": "restart_killed"},
+            {"step": at[11], "fault": "kill_leader"},
+            {"step": at[12], "fault": "restart_killed"},
         ]
 
 
@@ -368,6 +378,35 @@ class SoakRunner:
             if leader in nodes:
                 nodes[leader].coordinator.run_checks_once()
             _bump(ctx, "recoveries")
+        elif fault == "corrupt_segment":
+            self._corrupt_segment(ctx, d)
+        elif fault == "disk_unhealthy":
+            from opensearch_tpu.common.fshealth import FsHealthService
+            from opensearch_tpu.testing.fault_injection import \
+                DiskFaultInjector
+            victim = d.get("node") or next(
+                nid for nid in sorted(nodes)
+                if nid not in (ctx["leader"], ctx["client"]))
+            disk = DiskFaultInjector(seed=self.config.seed ^ 0xD15C)
+            disk.fail_fsync(os.path.join(nodes[victim].data_path,
+                                         FsHealthService.PROBE_FILE))
+            disk.activate()
+            ctx["disk"] = disk
+            ctx["disk_victim"] = victim
+            ctx["applied"][-1]["node"] = victim
+            nodes[victim].fs_health.check()      # probe sees the fault
+            # the unhealthy verdict piggybacks on the next follower
+            # checks; after the retry budget the leader evicts the node
+            # and reroutes its copies (zero client-visible failures)
+            self._evict(ctx, victim)
+        elif fault == "disk_heal":
+            disk = ctx.pop("disk", None)
+            if disk is not None:
+                disk.deactivate()
+            victim = ctx.pop("disk_victim", None)
+            if victim is not None and victim in nodes:
+                nodes[victim].fs_health.check()  # healthy again
+                self._readmit(ctx, victim)
         elif fault == "partition":
             victim = d["node"]
             sides = ([victim],
@@ -409,6 +448,54 @@ class SoakRunner:
                 self._readmit(ctx, victim)
         else:
             raise ValueError(f"unknown fault directive [{fault}]")
+
+    def _corrupt_segment(self, ctx: dict, d: dict) -> None:
+        """Disk-fault directive: flush one in-sync replica copy, flip a
+        seeded byte in one of its committed segment files, then run
+        store verification — the copy must detect the damage, fail
+        itself via ``A_FAIL_COPY``, drop its local data, and re-recover
+        from the primary before the workload proceeds."""
+        cfg = self.config
+        nodes = ctx["nodes"]
+        state = nodes[ctx["leader"]].coordinator.state()
+        routing = state.routing.get(cfg.index, [])
+        victim = shard = None
+        for nid in sorted(nodes):
+            if nid == ctx["client"]:
+                continue
+            for s, e in enumerate(routing):
+                if nid in (e.get("replicas") or []) \
+                        and nid in (e.get("in_sync") or []):
+                    victim, shard = nid, s
+                    break
+            if victim is not None:
+                break
+        if victim is None:
+            return                        # no in-sync replica to damage
+        engine = nodes[victim].indices[cfg.index].engine_for(shard)
+        engine.flush()                    # put the copy's files on disk
+        seg_dir = os.path.join(engine.data_path, "segments")
+        targets = [f for f in sorted(os.listdir(seg_dir))
+                   if f.endswith((".npz", ".src", ".json"))]
+        if not targets:
+            return
+        rng = random.Random(cfg.seed ^ 0xB17F11)
+        path = os.path.join(seg_dir, rng.choice(targets))
+        with open(path, "rb") as f:
+            data = bytearray(f.read())
+        if not data:
+            return
+        data[rng.randrange(len(data))] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(bytes(data))
+        applied = ctx["applied"][-1]
+        applied["node"], applied["shard"] = victim, shard
+        report = nodes[victim].verify_local_stores(cfg.index)
+        applied["detected"] = any(r.get("corrupted") for r in report)
+        self._wait(lambda: self._in_sync_full(nodes, ctx["leader"]),
+                   timeout=30.0,
+                   what=f"re-recovery after corrupting [{victim}]")
+        _bump(ctx, "recoveries")
 
     def _evict(self, ctx: dict, victim: str) -> None:
         """Drive the leader's fault detection until the victim leaves
@@ -623,6 +710,15 @@ class SoakRunner:
             if stall is not None:
                 stall.release()
             ctx["faults"].clear()
+            disk = ctx.pop("disk", None)
+            if disk is not None:
+                disk.deactivate()
+            disk_victim = ctx.pop("disk_victim", None)
+            if disk_victim is not None and disk_victim in nodes:
+                nodes[disk_victim].fs_health.check()
+                if disk_victim not in \
+                        nodes[ctx["leader"]].coordinator.state().nodes:
+                    self._readmit(ctx, disk_victim)
             for nid, bp_breaches in list(ctx["saved_breaches"].items()):
                 bp = nodes[nid].search_backpressure
                 bp.force_duress(0)
@@ -638,6 +734,9 @@ class SoakRunner:
                 ctx, lambda: nodes[ctx["client"]].refresh(cfg.index))
             final = self._final_state(ctx)
         finally:
+            disk = ctx.pop("disk", None)
+            if disk is not None:     # exception path: unpatch open/fsync
+                disk.deactivate()
             for n in list(nodes.values()):
                 n.stop()
         after = self._counter_snapshot()

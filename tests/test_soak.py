@@ -47,10 +47,12 @@ def test_fault_schedule_is_seed_deterministic():
     assert s1 == s2
     assert s1 != s3
     faults = [d["fault"] for d in s1]
-    # the full chaos menu, kill-and-recover included
+    # the full chaos menu: kill-and-recover AND the disk fault class
+    # (corrupt segment + unhealthy fsync, PR-8's storage faults)
     assert {"slow_node", "drop_write", "stall_search", "induce_duress",
             "partition", "heal_partition", "kill_leader",
-            "restart_killed"} <= set(faults)
+            "restart_killed", "corrupt_segment", "disk_unhealthy",
+            "disk_heal"} <= set(faults)
     # steps are sorted and inside the op stream
     steps = [d["step"] for d in s1]
     assert steps == sorted(steps)
@@ -83,10 +85,16 @@ def test_smoke_soak_deterministic_verdicts_and_convergence(tmp_path):
     assert r1["slo_ok"], r1["verdicts"]
 
     # the schedule really killed and recovered a node (plus a partition
-    # round-trip) and the cluster converged with the control run anyway
+    # round-trip AND both disk faults: a corrupted-then-re-recovered
+    # segment and an unhealthy-fsync eviction) and the cluster converged
+    # with the control run anyway
     applied = {d["fault"] for d in r1["chaos"]["applied"]}
-    assert {"kill_leader", "restart_killed",
-            "partition", "heal_partition"} <= applied
+    assert {"kill_leader", "restart_killed", "partition",
+            "heal_partition", "corrupt_segment", "disk_unhealthy",
+            "disk_heal"} <= applied
+    corrupt = next(d for d in r1["chaos"]["applied"]
+                   if d["fault"] == "corrupt_segment")
+    assert corrupt.get("detected"), corrupt
     conv = next(v for v in r1["verdicts"] if v["slo"] == "convergence")
     assert conv["ok"], conv
     assert r1["chaos"]["final_state"] == r1["control"]["final_state"]

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Lint: storage-layer file writes must be crash-safe.
+
+The storage fault-tolerance work (checksummed segment manifests, atomic
+commit renames, torn-write recovery) only holds if EVERY write under the
+durable roots follows the tmp + fsync + atomic-rename discipline — one
+bare ``open(path, "w")`` that writes a final name in place reintroduces
+the torn-file window the whole subsystem exists to close.
+
+Rule: any ``open()`` call with a literal write mode (containing ``w``,
+``a``, ``x`` or ``+``) inside ``opensearch_tpu/index/``,
+``opensearch_tpu/snapshots/`` or ``opensearch_tpu/cluster/gateway.py``
+must live in a function whose body shows the full durable-write pattern
+— a ``".tmp"`` staging name, an ``fsync``, and an ``os.replace`` — or
+carry a ``# non-durable-ok`` annotation on the same line or the line
+above (for writes that are durable by other means: the translog's
+append-only generation file is fsynced by ``sync()`` and recovered by
+CRC-based torn-tail truncation, not by rename).
+
+Sibling of ``check_monotonic.py``/``check_seeded_rng.py``; new
+non-durable sites fail tier-1 (tests/test_storage_faults.py runs this).
+
+Usage: python tools/check_durable_writes.py [root ...]   (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ANNOTATION = "# non-durable-ok"
+
+_WRITE_CHARS = set("wax+")
+
+
+def _literal_mode(node: ast.Call):
+    """The mode string of an ``open()`` call, when statically knowable."""
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return "r"                      # default mode: read
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None                         # dynamic mode: not checkable
+
+
+def _write_opens(tree: ast.AST) -> list[int]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if name != "open":
+            continue
+        mode = _literal_mode(node)
+        if mode and _WRITE_CHARS & set(mode):
+            out.append(node.lineno)
+    return out
+
+
+def _enclosing_function_src(tree: ast.AST, src_lines: list[str],
+                            lineno: int) -> str:
+    """Source text of the innermost function containing ``lineno``
+    (module text when the write is at top level)."""
+    best = None
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= lineno <= end:
+                if best is None or node.lineno > best.lineno:
+                    best = node
+    if best is None:
+        return "\n".join(src_lines)
+    return "\n".join(src_lines[best.lineno - 1:
+                               getattr(best, "end_lineno", best.lineno)])
+
+
+def _durable_pattern(fn_src: str) -> bool:
+    return (".tmp" in fn_src and "fsync" in fn_src
+            and ("os.replace" in fn_src or "os.rename" in fn_src))
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error ({e.msg})"]
+    lines = src.splitlines()
+    problems = []
+    for lineno in _write_opens(tree):
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        prev = lines[lineno - 2] if lineno >= 2 else ""
+        if ANNOTATION in line or ANNOTATION in prev:
+            continue
+        if _durable_pattern(_enclosing_function_src(tree, lines, lineno)):
+            continue
+        problems.append(
+            f"{path}:{lineno}: file write without tmp + fsync + "
+            "atomic-rename in a durable-storage module — stage to a "
+            "'.tmp' name, fsync, os.replace (see store.write_durable), "
+            f"or annotate '{ANNOTATION}' if durability is provided "
+            "another way")
+    return problems
+
+
+def _default_roots() -> list[str]:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return [os.path.join(repo, "opensearch_tpu", "index"),
+            os.path.join(repo, "opensearch_tpu", "snapshots"),
+            os.path.join(repo, "opensearch_tpu", "cluster", "gateway.py")]
+
+
+def main(argv: list[str]) -> int:
+    roots = argv[1:] or _default_roots()
+    problems = []
+    for root in roots:
+        if os.path.isfile(root):
+            problems.extend(check_file(root))
+            continue
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    problems.extend(check_file(
+                        os.path.join(dirpath, name)))
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} non-durable write site(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
