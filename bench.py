@@ -297,6 +297,16 @@ def main():
         "p50_ms": round(p50, 3), "p99_ms": round(p99, 3),
         **hot_path_counters()})
 
+    # -- phase: profile (phase-attributed overhead + top phase costs) -----
+    # where the time actually goes: the sequential queries re-run with
+    # profile:true, so the trajectory records per-phase attribution and
+    # the Profile API's own cost (profiled vs unprofiled p50 delta)
+    try:
+        run_profile_phase(searcher, queries, seq_n, p50, platform, batch)
+    except Exception as e:  # noqa: BLE001 — report, keep the bench
+        phase_report("profile", {"platform": platform,
+                                 "error": f"{type(e).__name__}: {e}"})
+
     # -- phase: soak (chaos SLO scenario over a 3-node cluster) -----------
     # runs LAST so a wedge here cannot cost the phases above; failures
     # are reported as a phase line, never swallowed
@@ -311,6 +321,44 @@ def main():
         qps=qps, baseline_qps=baseline_qps, platform=platform,
         extra={"qps_sequential": round(qps_seq, 1), "p50_ms": round(p50, 3),
                "p99_ms": round(p99, 3), "batch": batch, "n_docs": n_docs})))
+
+
+def run_profile_phase(searcher, queries, seq_n: int, p50_plain: float,
+                      platform: str, batch: int):
+    """Profile-API phase line: re-runs the sequential query sample with
+    ``profile: true`` and reports (a) ``profile_overhead`` — the
+    profiled-vs-unprofiled p50 delta, i.e. what observability costs —
+    and (b) the top-3 phase costs summed across the sample, so
+    ``bench_phases.jsonl`` finally records WHERE the time goes
+    (compile/prepare/dispatch/reduce/fetch), not just totals.  One
+    profiled msearch batch rides along to pin the coalesced-group
+    attribution on the batched path."""
+    lat = []
+    totals: dict = {}
+    for q in queries[:seq_n]:
+        t0 = time.monotonic()
+        resp = searcher.search(dict(q, profile=True))
+        lat.append(time.monotonic() - t0)
+        bd = resp["profile"]["shards"][0]["searches"][0]["query"][0][
+            "breakdown"]
+        for key, v in bd.items():
+            if not key.endswith("_count"):
+                totals[key] = totals.get(key, 0) + v
+    p50_prof = float(np.percentile(np.asarray(lat) * 1e3, 50))
+    top3 = sorted(totals.items(), key=lambda kv: -kv[1])[:3]
+    bresp = searcher.msearch(
+        [dict(q, profile=True) for q in queries[:batch]])
+    bengine = bresp[0]["profile"]["shards"][0]["engine"]
+    phase_report("profile", {
+        "platform": platform,
+        "n_queries": len(lat),
+        "p50_ms": round(p50_prof, 3),
+        "profile_overhead": round(p50_prof - p50_plain, 3),
+        "top_phases": [{"phase": key, "time_in_nanos": int(v)}
+                       for key, v in top3],
+        "batched_execution_path": bengine.get("execution_path"),
+        "batched_xla_compiles": bengine.get("xla_compiles"),
+    })
 
 
 def run_soak_phase(platform: str):

@@ -107,6 +107,11 @@ class Transport:
                 with urllib.request.urlopen(
                         req, timeout=self.timeout) as resp:
                     payload = resp.read()
+                    ctype = resp.headers.get("Content-Type") or ""
+                    if payload and "json" not in ctype:
+                        # text surfaces (/_metrics Prometheus
+                        # exposition, _cat tables) pass through verbatim
+                        return payload.decode(errors="replace")
                     return json.loads(payload) if payload else {}
             except urllib.error.HTTPError as e:
                 payload = e.read()
@@ -307,6 +312,12 @@ class NodesClient(_Namespace):
         return self.transport.perform_request(
             "GET", "/_nodes/hot_threads", params)
 
+    def flight_recorder(self, params=None):
+        """Recent flight-recorder captures (slow-log trips, soak SLO
+        breaches): GET /_nodes/flight_recorder."""
+        return self.transport.perform_request(
+            "GET", "/_nodes/flight_recorder", params)
+
 
 class OpenSearch:
     """Drop-in analog of ``opensearchpy.OpenSearch`` for this node."""
@@ -335,6 +346,11 @@ class OpenSearch:
 
     def info(self):
         return self.transport.perform_request("GET", "/")
+
+    def metrics(self) -> str:
+        """Prometheus text exposition (GET /_metrics) — returns the
+        scrape body verbatim."""
+        return self.transport.perform_request("GET", "/_metrics")
 
     def index(self, index, body, id=None, params=None):  # noqa: A002
         if id is None:

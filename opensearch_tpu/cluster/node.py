@@ -933,7 +933,8 @@ class ClusterNode:
             "service_time_ewma_nanos": int(service_ewma or 0),
         }
 
-    def _copy_candidates(self, entry: dict, spill: int = 0) -> list[str]:
+    def _copy_candidates(self, entry: dict, spill: int = 0,
+                         prov: "Optional[dict]" = None) -> list[str]:
         """Shard-copy dispatch/failover order.  Legacy order — LOCAL
         in-sync copy first, then the primary, then in-sync replicas —
         is the no-evidence baseline; with response samples recorded the
@@ -943,7 +944,12 @@ class ClusterNode:
         ``spill`` rotates msearch batch members across the healthy
         copies so a burst spreads over replicas.  Copies still in peer
         recovery are excluded — they would silently answer from an empty
-        engine (AbstractSearchAsyncAction's ShardIterator)."""
+        engine (AbstractSearchAsyncAction's ShardIterator).
+
+        ``prov`` (profiled requests only) is filled with the selection
+        provenance — legacy order, whether adaptive selection rerouted
+        the preferred copy, and the spill rotation — so the Profile API
+        can report WHY a copy was chosen."""
         from opensearch_tpu.cluster import response_collector as rc
         from opensearch_tpu.common.telemetry import metrics
 
@@ -956,12 +962,19 @@ class ClusterNode:
         if self.node_id in order:
             order.remove(self.node_id)
             order.insert(0, self.node_id)
+        if prov is not None:
+            prov["legacy_order"] = list(order)
+            prov["spill"] = int(spill)
         if not rc.ADAPTIVE_ENABLED or len(order) < 2:
+            if prov is not None:
+                prov["rerouted"] = False
             return order
         collector = self.response_collector
         ranked, rerouted = collector.rank_copies(order)
         if rerouted:
             metrics().counter("search.replica_selection.reroutes").inc()
+        if prov is not None:
+            prov["rerouted"] = bool(rerouted)
         if spill:
             # round-robin the healthy prefix: msearch batch member i
             # starts at healthy copy i % n (replica spill)
@@ -989,6 +1002,11 @@ class ClusterNode:
                         ranked.insert(0, alt)
                         metrics().counter(
                             "search.replica_selection.reroutes").inc()
+        if prov is not None:
+            # spill rotation / outstanding-count spill also count as a
+            # changed preference
+            prov["rerouted"] = prov["rerouted"] or (
+                bool(ranked) and ranked[0] != order[0])
         return ranked
 
     def _query_group(self, node: str, payload: dict) -> dict:
@@ -1060,8 +1078,16 @@ class ClusterNode:
             raise IndexNotFoundError(index)
         candidates: dict[int, list[str]] = {}
         failures: list[dict] = []
+        # copy-selection provenance, kept ONLY for profiled requests
+        # (the Profile API's reroute/spill attribution)
+        profile_prov: "Optional[dict]" = \
+            {} if body.get("profile") else None
         for shard, entry in enumerate(routing):
-            cands = self._copy_candidates(entry, spill=_spill)
+            shard_prov = {} if profile_prov is not None else None
+            cands = self._copy_candidates(entry, spill=_spill,
+                                          prov=shard_prov)
+            if profile_prov is not None:
+                profile_prov[shard] = shard_prov
             if not cands:
                 exc = ShardNotFoundError(f"[{index}][{shard}] unassigned")
                 if not allow_partial:
@@ -1114,7 +1140,8 @@ class ClusterNode:
         try:
             return self._search_scatter(
                 index, body, routing, candidates, failures,
-                allow_partial, aggs_requested, task, parent_id)
+                allow_partial, aggs_requested, task, parent_id,
+                profile_prov=profile_prov)
         finally:
             taskmod.reset_current(token)
             self.task_manager.unregister(task)
@@ -1144,7 +1171,8 @@ class ClusterNode:
         return {"responses": responses}
 
     def _search_scatter(self, index, body, routing, candidates, failures,
-                        allow_partial, aggs_requested, task, parent_id):
+                        allow_partial, aggs_requested, task, parent_id,
+                        profile_prov=None):
         from opensearch_tpu.common.tasks import TaskCancelledException
         from opensearch_tpu.common.telemetry import metrics, tracer
         from opensearch_tpu.search import executor as _exec
@@ -1155,6 +1183,8 @@ class ClusterNode:
         sub = dict(body)
         sub["from"] = 0
         sub["size"] = from_ + size
+        profiling = bool(body.get("profile"))
+        t_scatter = time.monotonic() if profiling else 0.0
 
         # coordinator span: the scatter RPCs inject its trace context, so
         # every remote shard query phase parents under this trace
@@ -1163,6 +1193,9 @@ class ClusterNode:
                 {"index": index, "node": self.node_id,
                  "shards": len(routing)}):
             responses = []
+            resp_meta = []      # parallels responses: (node, shards) —
+            # kept always (two small tuples per RPC) so the profile
+            # merge below can attribute each section to its copy
             attempt = {shard: 0 for shard in candidates}
             pending = set(candidates)
             while pending:
@@ -1189,6 +1222,7 @@ class ClusterNode:
                                "parent_task_id": parent_id}
                     try:
                         responses.append(self._query_group(node, payload))
+                        resp_meta.append((node, list(shards)))
                         pending.difference_update(shards)
                         continue
                     except OpenSearchTpuError as exc:
@@ -1228,10 +1262,15 @@ class ClusterNode:
                 ms = r["hits"]["max_score"]
                 if ms is not None and (max_score is None or ms > max_score):
                     max_score = ms
+            scatter_s = (time.monotonic() - t_scatter) if profiling \
+                else 0.0
+            t_reduce = time.monotonic() if profiling else 0.0
             with tracer().start_span("coordinator.reduce",
                                      {"sources": len(responses),
                                       "rows": len(rows)}):
                 all_hits = merge_hit_rows(rows, body.get("sort"))
+            reduce_s = (time.monotonic() - t_reduce) if profiling \
+                else 0.0
         n_shards = len(routing)
         out = {
             "took": max((resp["resp"]["took"] for resp in responses),
@@ -1257,13 +1296,52 @@ class ClusterNode:
             from opensearch_tpu.search.suggest import merge_suggest
             out["suggest"] = merge_suggest(
                 [resp["resp"].get("suggest") for resp in responses])
-        if body.get("profile"):
-            shards = []
-            for resp in responses:
-                shards.extend((resp["resp"].get("profile") or {})
-                              .get("shards") or [])
-            out["profile"] = {"shards": shards}
+        if profiling:
+            out["profile"] = self._merge_profiles(
+                responses, resp_meta, profile_prov, attempt,
+                scatter_s, reduce_s, failures)
         return out
+
+    def _merge_profiles(self, responses, resp_meta, profile_prov,
+                        attempt, scatter_s, reduce_s, failures) -> dict:
+        """Coordinator-side profile merge: each remote shard section is
+        annotated with the copy that actually served it — chosen node,
+        its current C3 rank and duress verdict, failover attempts, and
+        the reroute/spill provenance recorded at copy-selection time —
+        then a ``coordinator`` block adds the scatter/reduce split only
+        this node can measure."""
+        collector = self.response_collector
+        sections = []
+        for (node, shards), resp in zip(resp_meta, responses):
+            rank = collector.rank(node)
+            group = {
+                "node": node,
+                "shards": list(shards),
+                "c3_rank": None if rank is None else round(rank, 3),
+                "in_duress": collector.in_duress(node),
+                "failover_attempts": max(
+                    (attempt.get(s, 0) for s in shards), default=0),
+            }
+            if profile_prov is not None:
+                prov = [dict(profile_prov.get(s) or {}, shard=s)
+                        for s in shards if profile_prov.get(s)]
+                if prov:
+                    group["selection"] = prov
+            for sec in (resp["resp"].get("profile") or {}) \
+                    .get("shards") or []:
+                sec = dict(sec)
+                sec["shard_group"] = group
+                sections.append(sec)
+        return {
+            "shards": sections,
+            "coordinator": {
+                "node": self.node_id,
+                "scatter_time_in_nanos": int(scatter_s * 1e9),
+                "reduce_time_in_nanos": int(reduce_s * 1e9),
+                "sources": len(responses),
+                "failed_shards": len(failures),
+            },
+        }
 
     def _h_search_shards(self, payload: dict) -> dict:
         from opensearch_tpu.common import tasks as taskmod

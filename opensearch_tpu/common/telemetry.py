@@ -282,9 +282,20 @@ class Histogram:
             cum += c
         return hi
 
-    def stats(self) -> dict:
+    def bucket_counts(self) -> tuple:
+        """Consistent snapshot of the raw histogram state:
+        ``(bucket_upper_bounds, per_bucket_counts, count, sum_ms)`` —
+        the last count is the +inf overflow bucket.  Both the JSON
+        ``stats()`` readout and the Prometheus exposition render from
+        THIS, so the two surfaces always report the same data."""
         with self._lock:
-            count, total, mx = self._count, self._sum, self._max
+            return (self.buckets, list(self._counts), self._count,
+                    self._sum)
+
+    def stats(self) -> dict:
+        buckets, counts, count, total = self.bucket_counts()
+        with self._lock:
+            mx = self._max
         out = {"count": count,
                "sum_in_millis": round(total, 3),
                "max_in_millis": round(mx, 3)}
@@ -294,6 +305,16 @@ class Histogram:
                 "50.0": round(self.percentile(50), 3),
                 "90.0": round(self.percentile(90), 3),
                 "99.0": round(self.percentile(99), 3)}
+            # cumulative buckets (Prometheus ``le`` semantics): the raw
+            # data behind the percentile estimates, so dashboards can
+            # aggregate histograms across nodes correctly
+            cum = 0
+            rendered = []
+            for le, c in zip(buckets, counts):
+                cum += c
+                rendered.append({"le": le, "count": cum})
+            rendered.append({"le": "+Inf", "count": count})
+            out["buckets"] = rendered
         return out
 
 
@@ -330,6 +351,8 @@ class MetricsRegistry:
         try:
             yield
         finally:
+            # pass-through: the metric-name lint enforces the CALLER's
+            # literal, not this helper  # metric-name-ok
             self.histogram(name).observe((time.monotonic() - t0) * 1000)
 
     def stats(self) -> dict:
@@ -341,10 +364,100 @@ class MetricsRegistry:
                 "histograms": {n: h.stats()
                                for n, h in sorted(histograms.items())}}
 
+    def prometheus_text(self) -> str:
+        """Render the full registry in the Prometheus text exposition
+        format (version 0.0.4): counters as ``<name>_total``, histograms
+        as cumulative ``_bucket{le=...}`` series + ``_sum``/``_count``.
+        Dotted metric names map to underscore-separated Prometheus
+        names; histogram values are milliseconds (suffix ``_ms``).
+        Served by ``GET /_metrics`` — the scrape surface for the same
+        data ``_nodes/stats`` ``telemetry`` reports as JSON."""
+        import re as _re
+
+        def pn(name: str) -> str:
+            return _re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+        def num(v: float) -> str:
+            return f"{v:.10g}"
+
+        with self._lock:
+            counters = sorted(self._counters.items())
+            histograms = sorted(self._histograms.items())
+        lines = []
+        for name, c in counters:
+            p = pn(name) + "_total"
+            lines.append(f"# HELP {p} Counter [{name}]")
+            lines.append(f"# TYPE {p} counter")
+            lines.append(f"{p} {c.value}")
+        for name, h in histograms:
+            p = pn(name)
+            if not p.endswith("_ms"):    # unit suffix, never doubled
+                p += "_ms"
+            buckets, per_bucket, count, total = h.bucket_counts()
+            lines.append(f"# HELP {p} Latency histogram [{name}] "
+                         "(milliseconds)")
+            lines.append(f"# TYPE {p} histogram")
+            cum = 0
+            for le, n in zip(buckets, per_bucket):
+                cum += n
+                lines.append(f'{p}_bucket{{le="{num(le)}"}} {cum}')
+            lines.append(f'{p}_bucket{{le="+Inf"}} {count}')
+            lines.append(f"{p}_sum {num(total)}")
+            lines.append(f"{p}_count {count}")
+        return "\n".join(lines) + "\n"
+
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._histograms.clear()
+
+
+class FlightRecorder:
+    """Bounded ring of diagnostic captures taken the moment something
+    already went wrong — a search slow-log threshold tripped, or a soak
+    SLO breached (testing/workload.py attaches the capture to the
+    breach verdict).  Each capture snapshots the recent finished spans
+    and the counter registry, plus the trigger's own detail (slow query
+    source, the slow query's profile when it ran with ``profile:true``,
+    the breached SLO's limit/observed pair) — so a breach verdict ships
+    with diagnosable evidence instead of a bare boolean.
+
+    Always-on and cheap at steady state: recording only happens on
+    trigger, the ring is bounded, and reads (``GET
+    /_nodes/flight_recorder``) copy snapshots, never live state.
+    """
+
+    def __init__(self, max_captures: int = 32, span_limit: int = 64):
+        self._ring: "deque[dict]" = deque(maxlen=max_captures)
+        self._lock = threading.Lock()
+        self.span_limit = int(span_limit)
+
+    def record(self, trigger: str, reason: str,
+               detail: Optional[dict] = None) -> dict:
+        capture = {
+            "trigger": trigger,
+            "reason": reason,
+            "timestamp_in_millis": int(time.time() * 1000),  # wall-clock
+            "spans": tracer().recent(self.span_limit),
+            "counters": dict(metrics().stats()["counters"]),
+        }
+        if detail:
+            capture["detail"] = detail
+        with self._lock:
+            self._ring.append(capture)
+        metrics().counter("flight_recorder.captures").inc()
+        return capture
+
+    def captures(self, limit: int = 32) -> list[dict]:
+        """Most recent captures, newest first."""
+        with self._lock:
+            out = list(self._ring)
+        out.reverse()
+        return out[: max(0, int(limit))]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
 
 
 # -- process-wide defaults (the breaker_service() singleton pattern) -----
@@ -354,6 +467,7 @@ class MetricsRegistry:
 
 _tracer = Tracer()
 _metrics = MetricsRegistry()
+_flight_recorder = FlightRecorder()
 
 
 def tracer() -> Tracer:
@@ -362,3 +476,7 @@ def tracer() -> Tracer:
 
 def metrics() -> MetricsRegistry:
     return _metrics
+
+
+def flight_recorder() -> FlightRecorder:
+    return _flight_recorder

@@ -746,6 +746,22 @@ class IndexService:
                     "source[%s]", self.name, took,
                     str(bool(resp.get("timed_out"))).lower(),
                     json.dumps(body.get("query") or {})[:256])
+                # a tripped slow log is a flight-recorder trigger: the
+                # capture carries the query source and — when the slow
+                # query ran with profile:true — its phase breakdown,
+                # so the slow query is diagnosable after the fact
+                from opensearch_tpu.common.telemetry import \
+                    flight_recorder
+                detail = {"index": self.name, "took_ms": int(took),
+                          "level": level,
+                          "source": json.dumps(
+                              body.get("query") or {})[:256]}
+                if resp.get("profile"):
+                    detail["profile"] = resp["profile"]
+                flight_recorder().record(
+                    "slow_log",
+                    f"[{self.name}] search took {took}ms >= "
+                    f"{level} threshold [{raw}]", detail)
                 break
 
     def _maybe_indexing_slowlog(self, took_ms: int, doc_id: str,
@@ -785,6 +801,10 @@ class IndexService:
         if len(self.local_shards) < 2:
             return False
         if body.get("sort") is not None:
+            return False
+        if body.get("profile"):
+            # phase attribution instruments the host pipeline; profiled
+            # requests route there (hits are parity-tested identical)
             return False
         q = body.get("query")
         if isinstance(q, dict) and "hybrid" in q:

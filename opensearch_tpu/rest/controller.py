@@ -141,6 +141,19 @@ def _total_hits_as_int(resp: dict):
             _total_hits_as_int(sub)
 
 
+class PlainText:
+    """Marker payload: the HTTP layer writes ``text`` verbatim with the
+    given content type instead of running x-content negotiation — the
+    Prometheus exposition format is text, not JSON."""
+
+    __slots__ = ("text", "content_type")
+
+    def __init__(self, text: str,
+                 content_type: str = "text/plain; charset=UTF-8"):
+        self.text = text
+        self.content_type = content_type
+
+
 class Route:
     def __init__(self, method: str, pattern: str, handler: Callable):
         self.method = method
@@ -314,6 +327,8 @@ class RestController:
         r("GET", "/_nodes/stats", self.h_nodes_stats)
         r("GET", "/_nodes/trace", self.h_nodes_trace)
         r("GET", "/_nodes/hot_threads", self.h_hot_threads)
+        r("GET", "/_nodes/flight_recorder", self.h_flight_recorder)
+        r("GET", "/_metrics", self.h_metrics)
         r("GET", "/_cluster/settings", self.h_cluster_get_settings)
         r("PUT", "/_cluster/settings", self.h_cluster_put_settings)
         r("GET", "/_cat/indices", self.h_cat_indices)
@@ -650,6 +665,27 @@ class RestController:
                      "nodes": {self.node.node_id: {
                          "name": self.node.name,
                          "spans": spans}}}
+
+    def h_metrics(self, req):
+        """Prometheus text exposition of the full MetricsRegistry —
+        counters as ``*_total``, latency histograms as cumulative
+        ``_bucket{le=...}`` + ``_sum``/``_count`` (milliseconds).  The
+        same underlying data ``_nodes/stats`` serves as JSON."""
+        from opensearch_tpu.common.telemetry import metrics
+        return 200, PlainText(
+            metrics().prometheus_text(),
+            content_type="text/plain; version=0.0.4; charset=utf-8")
+
+    def h_flight_recorder(self, req):
+        """Recent flight-recorder captures (slow-log trips, soak SLO
+        breaches): spans + counters snapshotted at trigger time."""
+        from opensearch_tpu.common.telemetry import flight_recorder
+        limit = int(req.param("size", 32))
+        return 200, {"cluster_name": self.node.cluster_name,
+                     "nodes": {self.node.node_id: {
+                         "name": self.node.name,
+                         "captures":
+                             flight_recorder().captures(limit)}}}
 
     def h_hot_threads(self, req):
         """Per-thread stack dump (RestNodesHotThreadsAction analog over
@@ -1910,6 +1946,8 @@ class RestController:
         from opensearch_tpu.common.telemetry import tracer
         from opensearch_tpu.search.executor import merge_hit_rows
 
+        profiling = bool(body.get("profile"))
+        t_reduce = time.monotonic() if profiling else 0.0
         with tracer().start_span("coordinator.reduce",
                                  {"sources": len(responses),
                                   "rows": len(rows)}):
@@ -1919,7 +1957,7 @@ class RestController:
                   if r["hits"]["max_score"] is not None]
         shards = sum(r.get("_shards", {}).get("total", 1)
                      for r in responses)
-        return {
+        out = {
             "took": max((r["took"] for r in responses), default=0),
             # partial-results flag survives the coordinator reduce: one
             # shard running out of budget marks the whole response
@@ -1930,6 +1968,21 @@ class RestController:
                      "max_score": max(scores) if scores else None,
                      "hits": all_hits[from_: from_ + size]},
         }
+        if profiling:
+            # profile merge: per-source shard sections concatenate (each
+            # already carries its engine attribution), the coordinator
+            # block adds the merge cost only this layer can measure
+            sections = []
+            for r in responses:
+                sections.extend((r.get("profile") or {})
+                                .get("shards") or [])
+            out["profile"] = {
+                "shards": sections,
+                "coordinator": {
+                    "sources": len(responses),
+                    "reduce_time_in_nanos": int(
+                        (time.monotonic() - t_reduce) * 1e9)}}
+        return out
 
     def _open_scroll(self, req, body, scroll):
         """First scroll page: pin a searcher snapshot, materialize the
@@ -2006,12 +2059,6 @@ class RestController:
             from opensearch_tpu.search.suggest import merge_suggest
             out["suggest"] = merge_suggest(
                 [r.get("suggest") for r in responses])
-        if body.get("profile"):
-            shards = []
-            for r in responses:
-                shards.extend((r.get("profile") or {}).get("shards")
-                              or [])
-            out["profile"] = {"shards": shards}
         return out
 
     # -- cluster settings / aliases / templates / analyze ------------------

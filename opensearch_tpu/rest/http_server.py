@@ -73,8 +73,14 @@ class _Handler(BaseHTTPRequestHandler):
                     response_headers=extra_headers)
             finally:
                 breaker.release(length)
+        from opensearch_tpu.rest.controller import PlainText
         is_cat = split.path.startswith("/_cat") and params.get("format") != "json"
-        if is_cat and isinstance(payload, list):
+        if isinstance(payload, PlainText):
+            # verbatim text surface (Prometheus /_metrics exposition):
+            # no x-content negotiation, the payload IS the wire format
+            data = payload.text.encode()
+            ctype = payload.content_type
+        elif is_cat and isinstance(payload, list):
             data = _cat_table(payload, want_header="v" in params,
                               columns=params.get("h"))
             ctype = "text/plain; charset=UTF-8"

@@ -209,22 +209,27 @@ class BatchGroup:
                 "weights": self.weights[qi],
                 "required": self.required[qi], "avgdl": self.avgdl}
 
-    def _run_host(self, searcher) -> dict:
+    def _run_host(self, searcher, prof=None) -> dict:
         """CPU-backend batch execution: every query scores host-side
         via ``TermBagPlan.host_topk`` over the shared per-segment impact
         tables — byte-identical to the sequential path by construction
         (same function, same accumulation order).  See ops/bm25.py
         ``host_scoring_enabled`` for why XLA:CPU scatter loses to the
         host here."""
+        import time
+
         from opensearch_tpu.common.tasks import check_current
         from opensearch_tpu.search.plan import TermBagPlan
 
+        if prof is not None:
+            prof.set("execution_path", "host_batched")
         plan = TermBagPlan(field=self.field, scored=True)
         acc = {pos: {"v": [], "s": [], "l": [], "tot": 0, "mx": -np.inf}
                for pos in self.positions}
         pruned = 0
         for seg_order, seg in enumerate(searcher.segments):
             check_current()    # cancellation point per segment
+            t_seg = time.monotonic() if prof is not None else 0.0
             pf = seg.postings.get(self.field)
             if pf is None:
                 continue
@@ -232,6 +237,9 @@ class BatchGroup:
                        for terms in self.terms for t in terms):
                 # no query term exists here: skip without scoring
                 pruned += 1
+                if prof is not None:
+                    prof.seg_pruned(seg.seg_id, "pruned_can_match",
+                                    time.monotonic() - t_seg)
                 continue
             live = searcher.ctx.lives[id(seg)]
             for qi, pos in enumerate(self.positions):
@@ -244,8 +252,11 @@ class BatchGroup:
                 a["l"].append(idx)
                 a["tot"] += int(tot)
                 a["mx"] = max(a["mx"], float(mx))
+            if prof is not None:
+                prof.seg_scanned(seg.seg_id, time.monotonic() - t_seg)
         if pruned:
             _metrics().counter("search.segments_pruned").inc(pruned)
+        t_red = time.monotonic() if prof is not None else 0.0
         out = {}
         for pos in self.positions:
             a = acc[pos]
@@ -260,9 +271,11 @@ class BatchGroup:
                      "score": float(v[i])} for i in order]
             out[pos] = (rows, a["tot"],
                         None if a["mx"] == -np.inf else float(a["mx"]))
+        if prof is not None:
+            prof.add("reduce", time.monotonic() - t_red)
         return out
 
-    def run(self, searcher) -> dict:
+    def run(self, searcher, prof=None) -> dict:
         """Execute against every segment; returns {pos: (rows, total,
         max_score)} in the sequential path's row format.
 
@@ -270,12 +283,18 @@ class BatchGroup:
         (``_run_host``).  Otherwise: device handles per segment LAUNCH;
         host-synced once at the end (4 D2H transfers per segment, not 4
         per query per segment — the tunnel's RTT makes tiny per-query
-        transfers the next bottleneck)."""
+        transfers the next bottleneck).  ``prof`` is the shared GROUP
+        profiler (see ShardSearcher.msearch)."""
+        import time
+
         from opensearch_tpu.common.cache import attached_cache
         from opensearch_tpu.common.tasks import check_current
 
         if bm25_ops.host_scoring_enabled():
-            return self._run_host(searcher)
+            return self._run_host(searcher, prof=prof)
+        if prof is not None:
+            prof.set("execution_path", "device_batched")
+            t_prep = time.monotonic()
         cache = attached_cache(searcher, "_batch_prep_cache",
                                name="search.batch_prep",
                                max_weight=64 << 20,
@@ -283,11 +302,24 @@ class BatchGroup:
         sig = self.signature()
         prep = cache.get(sig)
         if prep is None:
+            if prof is not None:
+                prof.set("batch_prep_cache", "miss")
             prep = self._prepare(searcher)
             cache.put(sig, prep)
+        elif prof is not None:
+            prof.set("batch_prep_cache", "hit")
+        if prof is not None:
+            prof.add("prepare", time.monotonic() - t_prep)
+            # segments the union prep dropped never dispatch: no query
+            # term exists there (the batch path's can-match analog)
+            staged = {so for so, _sp in prep["segs"]}
+            for so, seg in enumerate(searcher.segments):
+                if so not in staged:
+                    prof.seg_pruned(seg.seg_id, "pruned_can_match", 0.0)
         launches = []             # (seg_order, vals[Q,k], idx, tot, mx)
         for seg_order, sp in prep["segs"]:
             check_current()    # cancellation point per segment program
+            t_seg = time.monotonic() if prof is not None else 0.0
             seg = searcher.segments[seg_order]
             dseg = seg.device()
             impacts = dseg.impacts(self.field, self.avgdl)
@@ -302,7 +334,10 @@ class BatchGroup:
                 n_pad=dseg.n_pad, budget=sp["budget"], k=kk,
                 need_counts=prep["need_counts"])
             launches.append((seg_order, vals, idx, tot, mx))
+            if prof is not None:
+                prof.seg_scanned(seg.seg_id, time.monotonic() - t_seg)
         # ONE host sync region: convert whole launches after the dispatch loop
+        t_red = time.monotonic() if prof is not None else 0.0
         synced = [(so, np.asarray(v), np.asarray(i), np.asarray(t),
                    np.asarray(m)) for so, v, i, t, m in launches]
         out = {}
@@ -329,6 +364,8 @@ class BatchGroup:
                      "score": float(v[i])} for i in order]
             out[pos] = (rows, total,
                         None if max_score == -np.inf else float(max_score))
+        if prof is not None:
+            prof.add("reduce", time.monotonic() - t_red)
         return out
 
 

@@ -157,13 +157,23 @@ def _ip_cidr_bind(value: str, boost: float) -> dict:
             "hi": parse_ip_long(net.broadcast_address), "boost": boost}
 
 
-def compile_query(q: dsl.Query, ctx: ShardContext, scored: bool = True):
-    """Returns (plan, bind)."""
+def compile_query(q: dsl.Query, ctx: ShardContext, scored: bool = True,
+                  prof=None):
+    """Returns (plan, bind).  ``prof`` (a QueryProfiler) times the plan
+    construction into the ``compile`` phase and records the root plan
+    type — the compiler is a profile feeder, never a consumer."""
     fn = _COMPILERS.get(type(q))
     if fn is None:
         raise IllegalArgumentError(
             f"query type [{type(q).__name__}] is not supported")
-    return fn(q, ctx, scored)
+    if prof is None:
+        return fn(q, ctx, scored)
+    import time
+    t0 = time.monotonic()
+    out = fn(q, ctx, scored)
+    prof.add("compile", time.monotonic() - t0)
+    prof.set("query_type", type(q).__name__)
+    return out
 
 
 def _c_match_all(q, ctx, scored):

@@ -247,16 +247,19 @@ class ShardSearcher:
     # -- compiled-plan / prepared-bindings caches -------------------------
 
     def compiled(self, query_json: Optional[dict], scored: bool = True,
-                 with_key: bool = False):
+                 with_key: bool = False, prof=None):
         """(plan, bind) for a raw query body through the searcher's plan
         cache, keyed on the canonicalized JSON (key order in the body
         never misses).  The searcher is an immutable point-in-time view,
         so entries can never go stale — a refresh builds a NEW searcher
         (the PR-3 reader-generation bump) and this cache dies with the
         old one.  A repeated query shape therefore does zero
-        parse/compile work (`search.plan_cache.hits`)."""
+        parse/compile work (`search.plan_cache.hits`).  ``prof`` (a
+        QueryProfiler) times the cache lookup / parse / compile and
+        records the hit-vs-miss attribution."""
         from opensearch_tpu.common.cache import attached_cache
 
+        t_lookup = time.monotonic() if prof is not None else 0.0
         try:
             ckey = (json.dumps(query_json, sort_keys=True,
                                separators=(",", ":")), scored)
@@ -268,12 +271,24 @@ class ShardSearcher:
                                    max_weight=16 << 20,
                                    breaker="fielddata")
             out = cache.get(ckey)
+            if prof is not None:
+                prof.add("plan_cache", time.monotonic() - t_lookup)
             if out is not None:
                 _metrics().counter("search.plan_cache.hits").inc()
+                if prof is not None:
+                    prof.set("plan_cache", "hit")
                 return (out, ckey) if with_key else out
+        elif prof is not None:
+            prof.add("plan_cache", time.monotonic() - t_lookup)
         _metrics().counter("search.plan_cache.misses").inc()
-        out = compile_query(parse_query(query_json), self.ctx,
-                            scored=scored)
+        if prof is not None:
+            prof.set("plan_cache", "miss")
+            with prof.phase("rewrite"):
+                q = parse_query(query_json)
+            out = compile_query(q, self.ctx, scored=scored, prof=prof)
+        else:
+            out = compile_query(parse_query(query_json), self.ctx,
+                                scored=scored)
         if ckey is not None:
             cache.put(ckey, out)
         return (out, ckey) if with_key else out
@@ -305,13 +320,18 @@ class ShardSearcher:
         walk(value)
         return total
 
-    def _prepared(self, plan, bind, seg, dseg, ckey):
+    def _prepared(self, plan, bind, seg, dseg, ckey, prof=None):
         """``plan.prepare``'s per-(plan, segment) static products —
         padded term ids, staged impact references, device scalars —
         cached so a repeated query shape does zero host-side prepare
-        work (and zero H2D transfers) per segment."""
+        work (and zero H2D transfers) per segment.  ``prof`` records
+        prepare time and the per-segment prepared-bindings hit/miss."""
         if ckey is None:
-            return plan.prepare(bind, seg, dseg, self.ctx)
+            if prof is None:
+                return plan.prepare(bind, seg, dseg, self.ctx)
+            prof.inc("prepared_misses")
+            with prof.phase("prepare"):
+                return plan.prepare(bind, seg, dseg, self.ctx)
         from opensearch_tpu.common.cache import attached_cache
 
         cache = attached_cache(self, "_prep_cache",
@@ -321,8 +341,15 @@ class ShardSearcher:
         key = (ckey, id(seg))
         out = cache.get(key)
         if out is None:
-            out = plan.prepare(bind, seg, dseg, self.ctx)
+            if prof is not None:
+                prof.inc("prepared_misses")
+                with prof.phase("prepare"):
+                    out = plan.prepare(bind, seg, dseg, self.ctx)
+            else:
+                out = plan.prepare(bind, seg, dseg, self.ctx)
             cache.put(key, out)
+        elif prof is not None:
+            prof.inc("prepared_hits")
         return out
 
     # -- public API -------------------------------------------------------
@@ -352,11 +379,19 @@ class ShardSearcher:
         (QueryPhaseResultConsumer partial-reduce analog)."""
         body = body or {}
         t0 = time.monotonic()
+        prof = None
+        if body.get("profile"):
+            # plan-time guard: the profiler exists ONLY for profiled
+            # requests; every downstream instrumentation point checks
+            # ``prof is not None`` (zero cost when profile is absent)
+            from opensearch_tpu.search.profile import QueryProfiler
+            prof = QueryProfiler()
         with _tracer().start_span(
                 "shard.query_phase",
                 {"index": self.index_name, "shard": self.shard_id,
                  "segments": len(self.segments)}):
-            resp = self._search_body(body, t0, agg_partials=agg_partials)
+            resp = self._search_body(body, t0, agg_partials=agg_partials,
+                                     prof=prof)
         _metrics().histogram("search.query_ms").observe(
             (time.monotonic() - t0) * 1000)
         _metrics().counter("search.queries").inc()
@@ -365,7 +400,7 @@ class ShardSearcher:
         return resp
 
     def _search_body(self, body: dict, t0: float, *,
-                     agg_partials: bool = False) -> dict:
+                     agg_partials: bool = False, prof=None) -> dict:
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
         deadline = SearchDeadline(body.get("timeout"), t0)
@@ -415,7 +450,7 @@ class ShardSearcher:
                         or any(s["field"] == "_score" for s in sort_specs)
                         or min_score is not None)
         (plan, bind), ckey = self.compiled(q_json, scored=needs_scores,
-                                           with_key=True)
+                                           with_key=True, prof=prof)
         needed = plan.arrays()
         k_want = from_ + size
         # with exact totals waived, block-max pruning may also skip
@@ -441,7 +476,8 @@ class ShardSearcher:
         # with aggs, the full-scores pass runs ONCE and feeds both the
         # top-k and the aggregations (no second device execution)
         views = (list(self._run_full(plan, bind, needed, min_score,
-                                     deadline=deadline, ckey=ckey))
+                                     deadline=deadline, ckey=ckey,
+                                     prof=prof))
                  if aggs_json and self.segments else None)
 
         total_is_lower_bound = False
@@ -453,16 +489,18 @@ class ShardSearcher:
                 collapse, views, search_after=search_after)
         elif sort_specs is None:
             if views is not None:
-                rows, total, max_score = self._topk_from_views(views, k_want)
+                rows, total, max_score = self._topk_from_views(
+                    views, k_want, prof=prof)
             else:
                 rows, total, max_score, total_is_lower_bound = self._topk(
                     plan, bind, needed, k_want, min_score,
                     deadline=deadline, ckey=ckey,
-                    allow_kth_prune=allow_kth_prune)
+                    allow_kth_prune=allow_kth_prune, prof=prof)
         else:
             rows, total, max_score = self._field_sorted(
                 plan, bind, needed, k_want, sort_specs, min_score, views,
-                search_after=search_after, deadline=deadline, ckey=ckey)
+                search_after=search_after, deadline=deadline, ckey=ckey,
+                prof=prof)
         if rescore is not None and rows:
             rows, max_score = self._rescored(rows, rescore)
         rows = rows[from_: from_ + size]
@@ -480,11 +518,14 @@ class ShardSearcher:
             else:
                 aggregations = execu.run(aggs_json, seg_views)
 
+        t_fetch = time.monotonic() if prof is not None else 0.0
         with _tracer().start_span("fetch_phase",
                                   {"index": self.index_name,
                                    "hits": len(rows)}), \
                 _metrics().time_ms("search.fetch_ms"):
             hits = self._hits_from_rows(rows, source_spec, fetch_extras)
+        if prof is not None:
+            prof.add("fetch", time.monotonic() - t_fetch)
 
         took = int((time.monotonic() - t0) * 1000)
         resp = {
@@ -499,24 +540,17 @@ class ShardSearcher:
                 "hits": hits,
             },
         }
-        if body.get("profile"):
-            # phase-level breakdown (search/profile/query/QueryProfiler
-            # analog at program granularity: the device runs fused
-            # programs, so per-collector callbacks don't exist)
-            resp["profile"] = {"shards": [{
-                "id": f"[{self.index_name}][{self.shard_id}]",
-                "searches": [{"query": [{
-                    "type": type(plan).__name__,
-                    "description": json.dumps(body.get("query") or {})[:200],
-                    "time_in_nanos": int((time.monotonic() - t0) * 1e9),
-                    "children": []}],
-                    "rewrite_time": 0,
-                    "collector": [{
-                        "name": "SimpleTopDocsCollector",
-                        "reason": "search_top_hits",
-                        "time_in_nanos": int(
-                            (time.monotonic() - t0) * 1e9)}]}],
-            }]}
+        if prof is not None:
+            # real phase-attributed profile (search/profile/query/
+            # QueryProfiler analog at program granularity: the device
+            # runs fused programs, so per-collector callbacks don't
+            # exist — phases are the host-side stages around them)
+            from opensearch_tpu.search.profile import describe_plan
+            resp["profile"] = {"shards": [prof.shard_section(
+                self.index_name, self.shard_id,
+                plan_type=type(plan).__name__,
+                description=describe_plan(plan, bind),
+                total_segments=len(self.segments))]}
         if aggregations is not None:
             resp["aggregations"] = aggregations
         if partials is not None:
@@ -596,9 +630,29 @@ class ShardSearcher:
         groups, fallback = plan_batches(self, bodies)
         results: list = [None] * len(bodies)
         for g in groups.values():
-            for pos, (rows, total, max_score) in g.run(self).items():
+            gprof = None
+            if any((bodies[p] or {}).get("profile") for p in g.positions):
+                # ONE profiler per coalesced group: members share the
+                # group's phase timings by construction (that sharing IS
+                # the batch-coalescing attribution)
+                from opensearch_tpu.search.profile import QueryProfiler
+                gprof = QueryProfiler()
+                # members were parsed/compiled during batch planning
+                # (through the plan cache, counted in the
+                # search.plan_cache.* metrics) — per-member hit/miss is
+                # not attributable after coalescing
+                gprof.set("plan_cache", "batched")
+                gprof.set("batch", {
+                    "field": g.field, "k": g.k,
+                    "queries": len(g.positions),
+                    "positions": list(g.positions)})
+            for pos, (rows, total, max_score) in \
+                    g.run(self, prof=gprof).items():
                 body = bodies[pos] or {}
+                t_fetch = time.monotonic() if gprof is not None else 0.0
                 hits = self._hits_from_rows(rows, body.get("_source"))
+                if gprof is not None:
+                    gprof.add("fetch", time.monotonic() - t_fetch)
                 # batched bodies never carry a [timeout] (plan_batches
                 # sends those to the sequential fallback, which owns the
                 # deadline checks), so false is exact here
@@ -610,6 +664,15 @@ class ShardSearcher:
                                        "relation": "eq"},
                              "max_score": max_score, "hits": hits},
                 }
+                if gprof is not None and body.get("profile"):
+                    results[pos]["profile"] = {"shards": [
+                        gprof.shard_section(
+                            self.index_name, self.shard_id,
+                            plan_type="TermBagPlan",
+                            description=(f"batched[{g.field}] "
+                                         f"member {pos} of "
+                                         f"{len(g.positions)}"),
+                            total_segments=len(self.segments))]}
         for pos in fallback:
             results[pos] = self.search(bodies[pos])
         return results
@@ -660,7 +723,8 @@ class ShardSearcher:
     # -- internals --------------------------------------------------------
 
     def _run_full(self, plan, bind, needed, min_score,
-                  can_match_skip=False, deadline=None, ckey=None):
+                  can_match_skip=False, deadline=None, ckey=None,
+                  prof=None):
         """``can_match_skip`` is ONLY safe for consumers that don't index
         the yielded tuples by position (views/aggs paths align with
         self.segments and must see every segment).  An expired
@@ -673,9 +737,17 @@ class ShardSearcher:
             check_current()        # cancellation point per segment program
             if deadline is not None and deadline.expired():
                 return
+            t_seg = time.monotonic() if prof is not None else 0.0
             if can_match_skip and not plan.can_match(bind, seg):
                 _metrics().counter("search.segments_pruned").inc()
+                if prof is not None:
+                    prof.seg_pruned(seg.seg_id, "pruned_can_match",
+                                    time.monotonic() - t_seg)
                 continue
+            # phases stay disjoint: prepare time is measured inside
+            # _prepared, so the dispatch share is the remainder
+            prep0 = (prof.phases.get("prepare", 0.0)
+                     if prof is not None else 0.0)
             with _tracer().start_span(
                     "segment.dispatch",
                     {"segment": seg.seg_id, "index": self.index_name,
@@ -683,8 +755,13 @@ class ShardSearcher:
                 dseg = seg.device()
                 A = build_arrays(dseg, needed, self.mapper,
                                  live=self.ctx.live_jnp(seg, dseg))
-                dims, ins = self._prepared(plan, bind, seg, dseg, ckey)
+                dims, ins = self._prepared(plan, bind, seg, dseg, ckey,
+                                           prof=prof)
                 scores, matched = P.run_full(plan, dims, A, ins, ms)
+            if prof is not None:
+                prof.seg_scanned(seg.seg_id, max(
+                    0.0, time.monotonic() - t_seg
+                    - (prof.phases.get("prepare", 0.0) - prep0)))
             yield seg, dseg, scores, matched
 
     def _merge_topk(self, per_seg, k_want, total, max_score):
@@ -706,7 +783,7 @@ class ShardSearcher:
         return rows, total, (None if max_score == -np.inf else float(max_score))
 
     def _topk(self, plan, bind, needed, k_want, min_score, deadline=None,
-              ckey=None, allow_kth_prune=False):
+              ckey=None, allow_kth_prune=False, prof=None):
         """Returns (rows, total, max_score, total_is_lower_bound).
 
         Block-max pruning: segments whose ``plan.max_score_bound`` can't
@@ -720,10 +797,22 @@ class ShardSearcher:
         from opensearch_tpu.common.tasks import check_current
 
         if k_want == 0:            # size=0: counts only (aggs-style request)
+            inner = ("can_match", "dispatch", "prepare")
+            if prof is not None:
+                t_red = time.monotonic()
+                spent0 = sum(prof.phases.get(p, 0.0) for p in inner)
             total = sum(int(np.asarray(m).sum()) for _s, _d, _sc, m
                         in self._run_full(plan, bind, needed, min_score,
                                           can_match_skip=True,
-                                          deadline=deadline, ckey=ckey))
+                                          deadline=deadline, ckey=ckey,
+                                          prof=prof))
+            if prof is not None:
+                # the generator's own phases were recorded inline; the
+                # residual host-side sum is the reduce share
+                spent = sum(prof.phases.get(p, 0.0)
+                            for p in inner) - spent0
+                prof.add("reduce", max(
+                    0.0, time.monotonic() - t_red - spent))
             return [], total, None, False
 
         # phase 1: DISPATCH every segment's program without a host sync —
@@ -739,6 +828,8 @@ class ShardSearcher:
         host_fast = (bm25_ops.host_scoring_enabled()
                      and getattr(plan, "scored", False)
                      and getattr(plan, "host_topk", None) is not None)
+        if prof is not None:
+            prof.set("execution_path", "host" if host_fast else "device")
         launched = []              # [si, vals, idx, tot, mx, synced_vals]
         kth = None                 # running k-th best (harvested, host)
         total_is_lower_bound = False
@@ -746,22 +837,38 @@ class ShardSearcher:
             check_current()        # cancellation point per segment program
             if deadline is not None and deadline.expired():
                 break              # partial top-k; response flags timed_out
+            t_seg = time.monotonic() if prof is not None else 0.0
             if not plan.can_match(bind, seg):
                 _metrics().counter("search.segments_pruned").inc()
+                if prof is not None:
+                    prof.seg_pruned(seg.seg_id, "pruned_can_match",
+                                    time.monotonic() - t_seg)
                 continue           # can-match skip: no staging, no program
             if ms_host is not None or kth is not None:
                 bound = plan.max_score_bound(bind, seg)
                 if ms_host is not None and bound < ms_host:
                     # exact: docs below min_score never count in totals
                     _metrics().counter("search.segments_pruned").inc()
+                    if prof is not None:
+                        prof.seg_pruned(seg.seg_id, "pruned_min_score",
+                                        time.monotonic() - t_seg)
                     continue
                 if kth is not None and bound <= kth:
                     # the k-th holder dispatched earlier, so it wins any
                     # tie at exactly `bound` (seg-asc tie-break); totals
                     # become a lower bound
                     _metrics().counter("search.segments_pruned").inc()
+                    if prof is not None:
+                        prof.seg_pruned(seg.seg_id, "pruned_kth",
+                                        time.monotonic() - t_seg)
                     total_is_lower_bound = True
                     continue
+            if prof is not None:
+                # decision cost so far is can_match; the dispatch share
+                # starts here and excludes _prepared's own prepare phase
+                prof.add("can_match", time.monotonic() - t_seg)
+                t_disp = time.monotonic()
+                prep0 = prof.phases.get("prepare", 0.0)
             with _tracer().start_span(
                     "segment.dispatch",
                     {"segment": seg.seg_id, "index": self.index_name,
@@ -776,14 +883,19 @@ class ShardSearcher:
                     A = build_arrays(dseg, needed, self.mapper,
                                      live=self.ctx.live_jnp(seg, dseg))
                     dims, ins = self._prepared(plan, bind, seg, dseg,
-                                               ckey)
+                                               ckey, prof=prof)
                     k = min(k_want, dseg.n_pad)
                     launched.append([si, *P.run_topk(plan, dims, k, A,
                                                      ins, ms), None])
+            if prof is not None:
+                prof.seg_scanned(seg.seg_id, max(
+                    0.0, time.monotonic() - t_disp
+                    - (prof.phases.get("prepare", 0.0) - prep0)))
             if allow_kth_prune and len(launched) >= 1 \
                     and si + 1 < len(self.segments):
                 kth = self._harvest_kth(launched, k_want, kth)
         # phase 2: ONE host-sync region over all segments' results
+        t_red = time.monotonic() if prof is not None else 0.0
         per_seg = []
         total = 0
         max_score = -np.inf
@@ -797,6 +909,8 @@ class ShardSearcher:
             max_score = max(max_score, float(mx))
         rows, total, max_score = self._merge_topk(per_seg, k_want, total,
                                                   max_score)
+        if prof is not None:
+            prof.add("reduce", time.monotonic() - t_red)
         return rows, total, max_score, total_is_lower_bound
 
     @staticmethod
@@ -821,8 +935,11 @@ class ShardSearcher:
         cand = float(np.partition(vals, -k_want)[-k_want])  # sync-ok
         return cand if kth is None or cand > kth else kth
 
-    def _topk_from_views(self, views, k_want):
+    def _topk_from_views(self, views, k_want, prof=None):
         """Top-k out of an already-run full-scores pass (aggs requests)."""
+        if prof is not None:
+            with prof.phase("reduce"):
+                return self._topk_from_views(views, k_want)
         per_seg = []
         total = 0
         max_score = -np.inf
@@ -879,16 +996,21 @@ class ShardSearcher:
 
     def _field_sorted(self, plan, bind, needed, k_want, sort_specs, min_score,
                       views=None, row_filter=None, search_after=None,
-                      deadline=None, ckey=None):
+                      deadline=None, ckey=None, prof=None):
         """``k_want=None`` returns EVERY matched row (scroll
         materialization); ``row_filter(seg_i, local)`` implements sliced
         scans; ``search_after`` drops rows at-or-before the given sort
         tuple (PIT pagination)."""
         rows = []
         total = 0
+        _inner = ("can_match", "dispatch", "prepare")
+        if prof is not None:
+            t_sort = time.monotonic()
+            spent0 = sum(prof.phases.get(p, 0.0) for p in _inner)
         if views is None:
             views = self._run_full(plan, bind, needed, min_score,
-                                   deadline=deadline, ckey=ckey)
+                                   deadline=deadline, ckey=ckey,
+                                   prof=prof)
         for si, (seg, dseg, scores, matched) in enumerate(views):
             matched_np = np.asarray(matched)[: seg.n_docs]
             scores_np = np.asarray(scores)[: seg.n_docs]
@@ -941,6 +1063,12 @@ class ShardSearcher:
                     sv, int) else sv)
             out.append({"seg": row["seg"], "local": row["local"],
                         "score": None, "sort": vals})
+        if prof is not None:
+            # host-side key build + comparator sort is the reduce share
+            # (segment scan phases were recorded inline by _run_full)
+            spent = sum(prof.phases.get(p, 0.0) for p in _inner) - spent0
+            prof.add("reduce", max(
+                0.0, time.monotonic() - t_sort - spent))
         return out, total, None
 
     def _rescored(self, rows, rescore):

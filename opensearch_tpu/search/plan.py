@@ -91,6 +91,27 @@ class Plan:
         kernel rounding can never make a real score exceed them."""
         return math.inf
 
+    def describe(self, bind) -> str:
+        """Compact structural description for the Profile API's query
+        section (``Query.toString()`` analog): the plan's static fields
+        plus bind cardinalities — never document data.  The profiler
+        truncates to 200 chars, so nesting may clip."""
+        import dataclasses
+        parts = [f"{f.name}={getattr(self, f.name)!r}"
+                 for f in dataclasses.fields(self)]
+        if isinstance(bind, dict):
+            for key in ("terms", "values"):
+                v = bind.get(key)
+                if isinstance(v, (list, tuple)) and v:
+                    shown = ",".join(str(x) for x in v[:8])
+                    more = ",…" if len(v) > 8 else ""
+                    parts.append(f"{key}=[{shown}{more}]")
+            for key in ("queries", "children"):
+                v = bind.get(key)
+                if isinstance(v, (list, tuple)):
+                    parts.append(f"{key}#{len(v)}")
+        return f"{type(self).__name__}({', '.join(parts)})"
+
 
 # float32 kernel rounding can nudge a real score a few ulp above the
 # float64 host-side bound arithmetic; inflating every finite bound by

@@ -836,6 +836,31 @@ class SoakRunner:
                 "observed": chaos["final_state"], "ok": ok})
         return verdicts
 
+    def _capture_breaches(self, verdicts: list, chaos: dict) -> None:
+        """Every breached SLO verdict gets a flight-recorder capture
+        attached — recent spans + counter snapshot + the breach's own
+        limit/observed pair — so a red verdict ships with diagnosable
+        evidence, not just a boolean (the captures are also retrievable
+        later via ``GET /_nodes/flight_recorder``).  Determinism note:
+        the smoke suite compares ``(slo, ok)`` pairs, never the capture
+        payloads, which carry timestamps by design."""
+        from opensearch_tpu.common.telemetry import flight_recorder
+        for v in verdicts:
+            if v["ok"]:
+                continue
+            v["flight_recorder"] = flight_recorder().record(
+                "slo_breach",
+                f"soak SLO [{v['slo']}] breached",
+                detail={"slo": v["slo"], "limit": v["limit"],
+                        "observed": v["observed"],
+                        "seed": self.config.seed,
+                        "applied_faults": [
+                            {"step": d.get("step"),
+                             "fault": d.get("fault")}
+                            for d in chaos.get("applied", [])],
+                        "unexpected_errors":
+                            list(chaos.get("unexpected_errors", []))})
+
     def run(self) -> dict:
         """Control pass (when configured) then chaos pass, then SLO
         evaluation.  Always returns the report; ``slo_ok`` is the single
@@ -847,6 +872,7 @@ class SoakRunner:
             chaos = self._run_once(
                 "chaos", inject=self.config.faults_enabled)
             verdicts = self._verdicts(chaos, control)
+            self._capture_breaches(verdicts, chaos)
             return {
                 "seed": self.config.seed,
                 "config": {"n_ops": self.config.n_ops,

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Lint: metric names must be dotted lowercase STATIC string literals.
+
+A metric name built from request or document data (an f-string over a
+query term, a ``%``/``.format`` over a doc field) creates one
+counter/histogram PER DISTINCT VALUE — an unbounded-cardinality
+explosion that bloats the registry forever (instruments are
+register-once, never evicted), wrecks the ``/_metrics`` Prometheus
+exposition, and can leak document contents into dashboards.
+
+Rule: every ``<expr>.counter(...)`` / ``<expr>.histogram(...)`` call
+site in ``opensearch_tpu/`` and ``bench.py`` must pass a literal string
+matching ``^[a-z][a-z0-9_]*(\\.[a-z0-9_]+)+$`` as its first argument.
+The few legitimately parameterized sites (per-cache, per-retry-action
+names drawn from a BOUNDED set of code-level identifiers) carry a
+``# metric-name-ok`` annotation on the same line or the line above.
+
+Sibling of ``check_monotonic.py`` / ``check_hot_path_sync.py`` et al;
+new un-annotated sites fail tier-1 (tests/test_profile.py runs this
+check).
+
+Usage: python tools/check_metric_names.py [root ...]   (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+ANNOTATION = "# metric-name-ok"
+NAME_RX = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+_METHODS = {"counter", "histogram"}
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+    lines = src.splitlines()
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute) or fn.attr not in _METHODS:
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        ok = (isinstance(arg, ast.Constant)
+              and isinstance(arg.value, str)
+              and NAME_RX.match(arg.value) is not None)
+        if ok:
+            continue
+        lineno = node.lineno
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        prev = lines[lineno - 2] if lineno >= 2 else ""
+        if ANNOTATION in line or ANNOTATION in prev:
+            continue
+        what = (f"non-literal or malformed metric name"
+                if not isinstance(arg, ast.Constant)
+                else f"metric name {arg.value!r}")
+        problems.append(
+            f"{path}:{lineno}: {what} in .{fn.attr}(...) — metric names "
+            "must be dotted lowercase static string literals (cardinality "
+            f"explosion guard); annotate bounded sites with "
+            f"'{ANNOTATION}'")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    roots = argv[1:] or [os.path.join(repo, "opensearch_tpu"),
+                         os.path.join(repo, "bench.py")]
+    problems = []
+    for root in roots:
+        if os.path.isfile(root):
+            problems.extend(check_file(root))
+            continue
+        for dirpath, _dirs, files in os.walk(root):
+            if "__pycache__" in dirpath:
+                continue
+            for fname in sorted(files):
+                if fname.endswith(".py"):
+                    problems.extend(
+                        check_file(os.path.join(dirpath, fname)))
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} metric-name violation(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
