@@ -307,6 +307,14 @@ def main():
         phase_report("profile", {"platform": platform,
                                  "error": f"{type(e).__name__}: {e}"})
 
+    # -- phase: insights (always-on attribution overhead + workload
+    # coalescability) -----------------------------------------------------
+    try:
+        run_insights_phase(searcher, queries, seq_n, platform, batch)
+    except Exception as e:  # noqa: BLE001 — report, keep the bench
+        phase_report("insights", {"platform": platform,
+                                  "error": f"{type(e).__name__}: {e}"})
+
     # -- phase: soak (chaos SLO scenario over a 3-node cluster) -----------
     # runs LAST so a wedge here cannot cost the phases above; failures
     # are reported as a phase line, never swallowed
@@ -358,6 +366,64 @@ def run_profile_phase(searcher, queries, seq_n: int, p50_plain: float,
                        for key, v in top3],
         "batched_execution_path": bengine.get("execution_path"),
         "batched_xla_compiles": bengine.get("xla_compiles"),
+    })
+
+
+def run_insights_phase(searcher, queries, seq_n: int,
+                       platform: str, batch: int):
+    """Query-insights phase line: the sequential zipf sample re-runs
+    with an insight sink + recording service installed (the always-on
+    production configuration) and reports (a) ``insights_overhead_pct``
+    — the recorded-vs-plain sequential p50 delta, the cost of always-on
+    attribution — and (b) the measured COALESCABILITY of this bench's
+    zipf workload per plan signature: the continuous batcher's sizing
+    input (ROADMAP item 1), finally measured instead of assumed."""
+    from opensearch_tpu.search import insights as insights_mod
+    from opensearch_tpu.search.insights import QueryInsightsService
+
+    svc = QueryInsightsService(node_id="bench", ring_capacity=512,
+                               max_signatures=256)
+    # fair overhead comparison: re-measure the PLAIN p50 back-to-back
+    # with the recorded run (the sequential phase's p50 was taken in a
+    # different cache/thermal state minutes earlier — at sub-ms p50
+    # that skew dwarfs the recording cost being measured)
+    plain = []
+    for q in queries[:seq_n]:
+        t0 = time.monotonic()
+        searcher.search(q)
+        plain.append(time.monotonic() - t0)
+    p50_plain = float(np.percentile(np.asarray(plain) * 1e3, 50))
+    lat = []
+    for q in queries[:seq_n]:
+        t0 = time.monotonic()
+        with insights_mod.collecting() as sink:
+            searcher.search(q)
+        for rec in sink:
+            svc.record(rec)
+        lat.append(time.monotonic() - t0)
+    # one recorded msearch batch rides along: the batched-member records
+    # carry the coalesced group size the report below surfaces
+    with insights_mod.collecting() as sink:
+        searcher.msearch(queries[:batch])
+    for rec in sink:
+        svc.record(rec)
+    p50_ins = float(np.percentile(np.asarray(lat) * 1e3, 50))
+    coalesc = svc.coalescability()
+    top = svc.top(by="latency", n=3)
+    stats = svc.stats()
+    phase_report("insights", {
+        "platform": platform,
+        "n_queries": len(lat),
+        "p50_ms": round(p50_ins, 3),
+        "insights_overhead_pct": round(
+            (p50_ins - p50_plain) / p50_plain * 100, 2)
+        if p50_plain else 0.0,
+        "coalescable_fraction": coalesc["coalescable_fraction"],
+        "coalesce_window_ms": coalesc["window_ms"],
+        "distinct_signatures": stats["signatures"],
+        "records": stats["records"],
+        "top_signatures": coalesc["top_signatures"][:3],
+        "slowest_signature": top[0]["signature"] if top else None,
     })
 
 

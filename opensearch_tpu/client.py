@@ -239,6 +239,14 @@ class CatClient(_Namespace):
         path = f"/_cat/count/{_idx(index)}" if index else "/_cat/count"
         return self.transport.perform_request("GET", path, p)
 
+    def recovery(self, index=None, params=None):
+        """Per-shard recovery state + the recovery.* metric family
+        (corrupt-blob re-requests, retry accounting)."""
+        p = {"format": "json", **(params or {})}
+        path = (f"/_cat/recovery/{_idx(index)}" if index
+                else "/_cat/recovery")
+        return self.transport.perform_request("GET", path, p)
+
 
 class SnapshotClient(_Namespace):
     def create_repository(self, repository, body, params=None):
@@ -351,6 +359,13 @@ class OpenSearch:
         """Prometheus text exposition (GET /_metrics) — returns the
         scrape body verbatim."""
         return self.transport.perform_request("GET", "/_metrics")
+
+    def insights_top_queries(self, params=None):
+        """Always-on top-N query attribution + per-plan-signature
+        workload stats (GET /_insights/top_queries); ``by`` ranks by
+        latency|cpu|heap, ``size`` bounds the list."""
+        return self.transport.perform_request(
+            "GET", "/_insights/top_queries", params)
 
     def index(self, index, body, id=None, params=None):  # noqa: A002
         if id is None:

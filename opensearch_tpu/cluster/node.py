@@ -25,6 +25,7 @@ import zlib
 from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Optional
 
+from opensearch_tpu.search import insights as insights_mod
 from opensearch_tpu.search.executor import merge_hit_rows
 
 from opensearch_tpu.common.errors import (
@@ -94,6 +95,7 @@ A_SHARD_RECOVERED = "internal:cluster/shard/started"
 # internal:admin/tasks/ban): a cancelled coordinator search reaps its
 # remote shard tasks instead of leaving them running
 A_BAN_PARENT = "internal:admin/tasks/ban"
+A_INSIGHTS = "cluster:monitor/insights/top_queries"
 
 
 class NoMasterError(CoordinationError):
@@ -130,6 +132,11 @@ class ClusterNode:
         # shard query-phase RPC budget (tests shrink it so timeout-path
         # assertions stay fast)
         self.search_rpc_timeout = 30.0
+        # always-on query insights: this node records both the shard
+        # query phases it executes (data-node role) and the scatters it
+        # coordinates; top_queries() below fans the sections in
+        from opensearch_tpu.search.insights import QueryInsightsService
+        self.insights = QueryInsightsService(node_id=node_id)
         # data-node write admission (the same per-shard byte accounting
         # the single-node path gets from IndicesService)
         from opensearch_tpu.common.indexing_pressure import IndexingPressure
@@ -175,6 +182,7 @@ class ClusterNode:
         t.register_handler(A_FAIL_COPY, self._h_fail_copy)
         t.register_handler(A_SHARD_RECOVERED, self._h_shard_recovered)
         t.register_handler(A_BAN_PARENT, self._h_ban_parent)
+        t.register_handler(A_INSIGHTS, self._h_insights)
         # restart: reopen local shards from the restored committed state
         # right away (the GatewayAllocator's on-disk-copy path) so engines
         # replay their translogs before any routing decisions arrive.
@@ -1125,9 +1133,17 @@ class ClusterNode:
 
         # the coordinator search is itself a registered, cancellable
         # task; its id is the parent id every remote shard task carries,
-        # and cancelling it broadcasts a ban to every involved node
+        # and cancelling it broadcasts a ban to every involved node.
+        # Client-attribution headers copy down from the enclosing task
+        # (the reference's HEADERS_TO_COPY) so X-Opaque-Id reaches the
+        # scatter payloads and this node's insight records
+        outer = taskmod.current()
+        outer_opaque = (outer.headers.get("X-Opaque-Id")
+                        if outer is not None else None)
         task = self.task_manager.register(
-            "indices:data/read/search", f"search [{index}]")
+            "indices:data/read/search", f"search [{index}]",
+            headers=({"X-Opaque-Id": outer_opaque}
+                     if outer_opaque else None))
         token = taskmod.set_current(task)
         parent_id = f"{self.node_id}:{task.id}"
         involved = sorted({n for cands in candidates.values()
@@ -1178,6 +1194,7 @@ class ClusterNode:
         from opensearch_tpu.search import executor as _exec
         from opensearch_tpu.search.executor import merge_hit_rows
 
+        opaque_id = task.headers.get("X-Opaque-Id")
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
         sub = dict(body)
@@ -1220,6 +1237,12 @@ class ClusterNode:
                                "body": sub,
                                "agg_partials": aggs_requested,
                                "parent_task_id": parent_id}
+                    if opaque_id:
+                        # client attribution travels with the shard
+                        # query phase so data-node insight records (and
+                        # _tasks) name the client, not just the
+                        # coordinator
+                        payload["opaque_id"] = opaque_id
                     try:
                         responses.append(self._query_group(node, payload))
                         resp_meta.append((node, list(shards)))
@@ -1300,6 +1323,33 @@ class ClusterNode:
             out["profile"] = self._merge_profiles(
                 responses, resp_meta, profile_prov, attempt,
                 scatter_s, reduce_s, failures)
+        # coordinator-level insight record: the SCATTER is this node's
+        # workload evidence (data nodes recorded their own query
+        # phases); outcome classification covers the degradations only
+        # this layer sees — duress sheds and partial results
+        if failures and any(
+                (f.get("reason") or {}).get("type")
+                == "node_duress_exception" for f in failures):
+            outcome = "shed"
+        elif failures:
+            outcome = "partial"
+        elif out["timed_out"]:
+            outcome = "timeout"
+        else:
+            outcome = "ok"
+        task.record_checkpoint()
+        rs = task.resource_stats()
+        self.insights.record(
+            {"signature": insights_mod.canonical_query(
+                body.get("query")),
+             "scored": insights_mod.scored_for_body(body),
+             "took_ms": float(out.get("took", 0)),
+             "execution_path": "scatter", "plan_cache": "none",
+             "index": index},
+            opaque_id=opaque_id,
+            cpu_nanos=int(rs.get("cpu_time_in_nanos", 0)),
+            heap_bytes=int(rs.get("peak_heap_size_in_bytes", 0)),
+            outcome=outcome)
         return out
 
     def _merge_profiles(self, responses, resp_meta, profile_prov,
@@ -1357,16 +1407,32 @@ class ClusterNode:
         # the shard query phase runs as a registered child task: a
         # banned/cancelled parent stops it at the next segment boundary,
         # and its resource usage shows up in this node's task list
+        opaque_id = payload.get("opaque_id")
         task = self.task_manager.register(
             A_SEARCH_SHARDS,
             f"shards {shard_ids} of [{payload['index']}]",
-            parent_task_id=payload.get("parent_task_id"))
+            parent_task_id=payload.get("parent_task_id"),
+            headers={"X-Opaque-Id": opaque_id} if opaque_id else None)
         token = taskmod.set_current(task)
         start = time.monotonic()
         try:
             task.ensure_not_cancelled()    # parent already banned?
-            out = dict(self._search_shards_body(
-                svc, body, explicit_cache, agg_partials, shard_ids))
+            # data-node insight scope: the shard query phase this node
+            # executes is ITS workload evidence (the coordinator records
+            # the scatter separately); records gain the task's CPU/heap
+            # and the client attribution threaded through the payload
+            with insights_mod.collecting() as sink:
+                out = dict(self._search_shards_body(
+                    svc, body, explicit_cache, agg_partials, shard_ids))
+            task.record_checkpoint()
+            rs = task.resource_stats()
+            for rec in sink:
+                self.insights.record(
+                    rec, opaque_id=opaque_id,
+                    cpu_nanos=int(rs.get("cpu_time_in_nanos", 0))
+                    // max(1, len(sink)),
+                    heap_bytes=int(rs.get(
+                        "peak_heap_size_in_bytes", 0)))
             with self._lock:
                 self._service_time_ewma.add(
                     (time.monotonic() - start) * 1e9)
@@ -1398,13 +1464,60 @@ class ClusterNode:
         # uuid and reader generation)
         if svc.should_cache_request(body, explicit_cache, agg_partials):
             from opensearch_tpu.indices.request_cache import request_cache
-            out, _hit = request_cache().get_or_compute(
+            out, hit = request_cache().get_or_compute(
                 index=svc.name, svc_uuid=svc.uuid,
                 shard_key=",".join(map(str, shard_ids)),
                 reader_gen=svc._reader_gen, body=body, compute=compute)
+            if hit:
+                insights_mod.emit(
+                    signature=insights_mod.canonical_query(
+                        body.get("query")),
+                    scored=insights_mod.scored_for_body(body),
+                    took_ms=float(out["resp"].get("took", 0)),
+                    execution_path="cached", plan_cache="hit",
+                    request_cache="hit", index=svc.name)
+            else:
+                insights_mod.annotate_last(request_cache="miss",
+                                           index=svc.name)
         else:
             out = compute()
+            insights_mod.annotate_last(request_cache="bypass",
+                                       index=svc.name)
         svc._maybe_slowlog(body, out["resp"])
+        return out
+
+    # -- query insights fan-in ---------------------------------------------
+
+    def _h_insights(self, payload: dict) -> dict:
+        """Serve this node's insights section to a fanning-in
+        coordinator."""
+        return {"section": self.insights.section(
+            by=payload.get("by", "latency"), n=payload.get("n"))}
+
+    def top_queries(self, by: str = "latency",
+                    n: Optional[int] = None) -> dict:
+        """Cluster-wide ``_insights/top_queries``: fan the per-node
+        sections in from every cluster member and merge them
+        provenance-annotated (PR 9's profile-merge discipline — each
+        entry names the node that recorded it; unreachable nodes are
+        REPORTED in ``failed_nodes``, never silently dropped)."""
+        n = self.insights.top_n if n is None else max(1, int(n))
+        state = self.coordinator.state()
+        sections: dict[str, dict] = {}
+        for nid in sorted(state.nodes):
+            if nid == self.node_id:
+                sections[nid] = self.insights.section(by=by, n=n)
+                continue
+            try:
+                resp = self.transport.send_request(
+                    nid, A_INSIGHTS, {"by": by, "n": n}, timeout=5.0)
+                sections[nid] = resp.get("section") or {
+                    "error": "empty section"}
+            except (OpenSearchTpuError, TimeoutError,
+                    ConnectionError) as e:
+                sections[nid] = {"error": f"{type(e).__name__}: {e}"}
+        out = insights_mod.merge_sections(sections, by=by, n=n)
+        out["coordinator"] = self.node_id
         return out
 
     # -- health / cat surfaces --------------------------------------------

@@ -400,8 +400,8 @@ class MetricsRegistry:
             cum = 0
             for le, n in zip(buckets, per_bucket):
                 cum += n
-                lines.append(f'{p}_bucket{{le="{num(le)}"}} {cum}')
-            lines.append(f'{p}_bucket{{le="+Inf"}} {count}')
+                lines.append(f'{p}_bucket{{le="{num(le)}"}} {cum}')  # label-ok: le values are the fixed code-level bucket bounds
+            lines.append(f'{p}_bucket{{le="+Inf"}} {count}')  # label-ok: constant +Inf bound
             lines.append(f"{p}_sum {num(total)}")
             lines.append(f"{p}_count {count}")
         return "\n".join(lines) + "\n"

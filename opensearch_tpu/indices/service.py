@@ -680,14 +680,30 @@ class IndexService:
         # cache key
         explicit_cache = body.pop("request_cache", None)
         self._check_search_limits(body)
+        from opensearch_tpu.search import insights
         if self.should_cache_request(body, explicit_cache, agg_partials):
             from opensearch_tpu.indices.request_cache import request_cache
-            resp, _hit = request_cache().get_or_compute(
+            resp, hit = request_cache().get_or_compute(
                 index=self.name, svc_uuid=self.uuid, shard_key="_local",
                 reader_gen=self._reader_gen, body=body,
                 compute=lambda: self._execute_search(body, agg_partials))
+            if hit:
+                # the executor never ran: synthesize the insight record
+                # here (the cache hit IS the workload evidence)
+                insights.emit(
+                    signature=insights.canonical_query(
+                        body.get("query")),
+                    scored=insights.scored_for_body(body),
+                    took_ms=float(resp.get("took", 0)),
+                    execution_path="cached", plan_cache="hit",
+                    request_cache="hit", index=self.name)
+            else:
+                insights.annotate_last(request_cache="miss",
+                                       index=self.name)
         else:
             resp = self._execute_search(body, agg_partials)
+            insights.annotate_last(request_cache="bypass",
+                                   index=self.name)
         self._maybe_slowlog(body, resp)
         return resp
 
@@ -814,7 +830,27 @@ class IndexService:
         return len(jax.devices()) >= len(self.local_shards)
 
     def _mesh_search(self, body: dict) -> dict:
-        from opensearch_tpu.parallel.dist_search import MeshSearcher
+        from opensearch_tpu.search import insights
+        try:
+            from opensearch_tpu.parallel import dist_search
+            if not dist_search.MESH_AVAILABLE:
+                raise ImportError("no shard_map in this jax")
+            MeshSearcher = dist_search.MeshSearcher
+        except ImportError:
+            # graceful degradation: a jax without any shard_map spelling
+            # (see parallel/dist_search.py) must not 500 the request —
+            # the host scatter below preserves mesh semantics (per-shard
+            # scoring stats, coordinator-order merge) minus the ICI
+            # collective, and the fallback is a counted, alertable event
+            from opensearch_tpu.common.telemetry import metrics
+            metrics().counter("search.mesh.fallback").inc()
+            with insights.suppressed():
+                resp = self._host_scatter_search(body)
+            insights.emit(
+                signature=insights.canonical_query(body.get("query")),
+                scored=True, took_ms=float(resp.get("took", 0)),
+                execution_path="mesh_fallback", plan_cache="miss")
+            return resp
 
         with self._lock:
             shards = [self.local_shards[s].acquire_searcher()
@@ -829,14 +865,24 @@ class IndexService:
             ms = self._mesh_searcher
         aggs_json = body.get("aggs") or body.get("aggregations")
         if not aggs_json and not body.get("suggest"):
-            return ms.search(body)
+            resp = ms.search(body)
+            insights.emit(
+                signature=insights.canonical_query(body.get("query")),
+                scored=True, took_ms=float(resp.get("took", 0)),
+                execution_path="mesh", plan_cache="miss")
+            return resp
         if (aggs_json and not body.get("suggest")
                 and int(body.get("size", 10)) == 0
                 and body.get("min_score") is None
                 and ms.supports_mesh_aggs(aggs_json)):
             # the metric-agg family reduces ON the mesh (one ICI
             # collective), never serializing per-shard partials
-            return ms.mesh_metric_aggs(body, aggs_json)
+            resp = ms.mesh_metric_aggs(body, aggs_json)
+            insights.emit(
+                signature=insights.canonical_query(body.get("query")),
+                scored=False, took_ms=float(resp.get("took", 0)),
+                execution_path="mesh", plan_cache="miss")
+            return resp
         # device-collective top-k + host-side per-shard partial collect,
         # reduced exactly like the cross-node coordinator (the agg columns
         # are host/default-device resident; the mesh carries the scored
@@ -854,8 +900,11 @@ class IndexService:
             if body.get(key) is not None:
                 collect_body[key] = body[key]
         size0 = int(body.get("size", 10)) == 0
-        shard_resps = [s.search(collect_body, agg_partials=True)
-                       for s in shards]
+        with insights.suppressed():
+            # per-shard collect legs of ONE mesh search: the mesh-level
+            # record below is the arrival, not its scatter legs
+            shard_resps = [s.search(collect_body, agg_partials=True)
+                           for s in shards]
         partials = [r.get("aggregation_partials") or {} for r in shard_resps]
         if size0:
             total = sum(r["hits"]["total"]["value"] for r in shard_resps)
@@ -869,6 +918,60 @@ class IndexService:
                                            "suggest")})
         if aggs_json:
             resp["aggregations"] = reduce_aggs(aggs_json, partials)
+        if body.get("suggest"):
+            resp["suggest"] = merge_suggest(
+                [r.get("suggest") for r in shard_resps])
+        insights.emit(
+            signature=insights.canonical_query(body.get("query")),
+            scored=not size0, took_ms=float(resp.get("took", 0)),
+            execution_path="mesh", plan_cache="miss")
+        return resp
+
+    def _host_scatter_search(self, body: dict) -> dict:
+        """Mesh-unavailable fallback: the same scatter-gather the device
+        collective performs, on the host — every local shard queries its
+        OWN searcher (per-shard scoring stats, query_then_fetch
+        semantics identical to the mesh and the multi-node coordinator)
+        and the top-k merges with the coordinator's tie-break order."""
+        from opensearch_tpu.search.aggs import reduce_aggs
+        from opensearch_tpu.search.executor import merge_hit_rows
+        from opensearch_tpu.search.suggest import merge_suggest
+
+        t0 = time.monotonic()
+        size = int(body.get("size", 10)
+                   if body.get("size") is not None else 10)
+        from_ = int(body.get("from", 0) or 0)
+        aggs_json = body.get("aggs") or body.get("aggregations")
+        sub = dict(body)
+        sub["from"] = 0
+        sub["size"] = from_ + size
+        with self._lock:
+            searchers = [self.local_shards[s].acquire_searcher()
+                         for s in sorted(self.local_shards)]
+        shard_resps = [s.search(sub, agg_partials=bool(aggs_json))
+                       for s in searchers]
+        rows = []
+        total = 0
+        max_score = None
+        for si, r in enumerate(shard_resps):
+            for pos, h in enumerate(r["hits"]["hits"]):
+                rows.append((h, si, pos))
+            total += r["hits"]["total"]["value"]
+            ms_ = r["hits"]["max_score"]
+            if ms_ is not None and (max_score is None or ms_ > max_score):
+                max_score = ms_
+        all_hits = merge_hit_rows(rows, body.get("sort"))
+        resp = {
+            "took": int((time.monotonic() - t0) * 1000),
+            "timed_out": any(r.get("timed_out") for r in shard_resps),
+            "hits": {"total": {"value": total, "relation": "eq"},
+                     "max_score": max_score,
+                     "hits": all_hits[from_: from_ + size]},
+        }
+        if aggs_json:
+            resp["aggregations"] = reduce_aggs(
+                aggs_json, [r.get("aggregation_partials") or {}
+                            for r in shard_resps])
         if body.get("suggest"):
             resp["suggest"] = merge_suggest(
                 [r.get("suggest") for r in shard_resps])

@@ -65,6 +65,11 @@ class Node:
         from opensearch_tpu.cluster.response_collector import \
             ResponseCollectorService
         self.response_collector = ResponseCollectorService()
+        # always-on top-N query attribution + per-plan-signature
+        # workload stats (GET /_insights/top_queries, _nodes/stats
+        # query_insights, /_metrics labeled series)
+        from opensearch_tpu.search.insights import QueryInsightsService
+        self.insights = QueryInsightsService(node_id=self.node_id)
         self._init_cluster_settings()
         from opensearch_tpu.common.persistent_tasks import \
             PersistentTasksService
@@ -149,6 +154,15 @@ class Node:
             "search.max_keep_alive", 24 * 3600.0, dynamic=True)
         default_keep_alive = Setting.time_setting(
             "search.default_keep_alive", 300.0, dynamic=True)
+        ins_enabled = Setting.bool_setting(
+            "search.insights.enabled", True, dynamic=True)
+        ins_top_n = Setting.int_setting(
+            "search.insights.top_n", 10, min_value=1, dynamic=True)
+        ins_window = Setting.time_setting(
+            "search.insights.window", 300.0, dynamic=True)
+        ins_coalesce = Setting.float_setting(
+            "search.insights.coalesce_window_ms", 10.0,
+            min_value=0.0, dynamic=True)
         from opensearch_tpu.indices.request_cache import (
             DEFAULT_MAX_BYTES, request_cache)
         req_cache_size = Setting.byte_size_setting(
@@ -161,7 +175,19 @@ class Node:
              bp_cpu, bp_heap, bp_queue, bp_streak, bp_max_cc,
              ars_enabled, ars_shed, ars_spill, ars_shed_occ,
              max_keep_alive, default_keep_alive, allow_partial,
-             req_cache_size])
+             req_cache_size, ins_enabled, ins_top_n, ins_window,
+             ins_coalesce])
+        # query-insights knobs reach the live service immediately and
+        # persisted values replay at boot
+        ins = self.insights
+        for setting, consumer in (
+                (ins_enabled, ins.set_enabled),
+                (ins_top_n, ins.set_top_n),
+                (ins_window, ins.set_window_s),
+                (ins_coalesce, ins.set_coalesce_window_ms)):
+            self.cluster_settings.add_settings_update_consumer(
+                setting, consumer)
+            consumer(self.cluster_settings.get(setting))
         # search backpressure: the mode setting was validated-but-dead
         # before this PR — now every flip (and the node_duress knobs)
         # reaches the live service immediately, and persisted values

@@ -28,9 +28,36 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
-from opensearch_tpu.ops import bm25 as bm25_ops
+# jax moved shard_map out of experimental (and renamed the replication
+# checker kwarg check_rep -> check_vma) across the versions this engine
+# supports; normalize on one callable so the mesh path works on both.
+# When neither spelling exists the mesh is unavailable and
+# IndexService._mesh_search degrades to the host scatter path (counted
+# in search.mesh.fallback) instead of crashing the request.
+try:
+    from jax import shard_map as _shard_map_impl
+    _CHECK_KW = "check_vma"
+except ImportError:                    # pre-0.6 jax: experimental module
+    try:
+        from jax.experimental.shard_map import shard_map as _shard_map_impl
+        _CHECK_KW = "check_rep"
+    except ImportError:
+        _shard_map_impl = None
+        _CHECK_KW = None
+
+MESH_AVAILABLE = _shard_map_impl is not None
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    if _shard_map_impl is None:
+        raise ImportError("no shard_map in this jax installation")
+    kw = {_CHECK_KW: check_vma}
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kw)
+
+
+from opensearch_tpu.ops import bm25 as bm25_ops   # noqa: E402
 
 
 def make_mesh(n_devices: int, axis: str = "shards") -> Mesh:

@@ -264,14 +264,26 @@ class RestController:
                                      "indices:data/read/search",
                                      "indices:data/read/msearch")
                                  else contextlib.nullcontext())
+                    from opensearch_tpu.search import insights
+                    searchish = action in ("indices:data/read/search",
+                                           "indices:data/read/msearch")
                     try:
                         with admission, tracer().start_span(
                                 f"rest:{action}", attributes=attrs,
                                 parent=tracer().extract(headers)) as span, \
-                                metrics().time_ms("rest.request_ms"):
+                                metrics().time_ms("rest.request_ms"), \
+                                insights.collecting() as sink:
                             metrics().counter("rest.requests").inc()
                             status, resp = route.handler(req)
                             span.set_attribute("http.status", status)
+                        if searchish and sink:
+                            # edge-side insight enrichment: the records
+                            # the execution layers emitted gain what
+                            # only this layer knows — the client's
+                            # X-Opaque-Id, the task's measured CPU/heap,
+                            # and the response-level outcome
+                            self._record_insights(sink, resp, status,
+                                                  task, opaque_id)
                         if params.get("rest_total_hits_as_int") == "true" \
                                 and isinstance(resp, dict):
                             _total_hits_as_int(resp)
@@ -297,6 +309,12 @@ class RestController:
             if isinstance(e, (RejectedExecutionError,
                               SearchRejectedError)):
                 metrics().counter("search.rejected").inc()
+                insights = getattr(self.node, "insights", None)
+                if insights is not None:
+                    # rejected before any plan existed: counted in the
+                    # insights totals (shed load is workload evidence),
+                    # never a ring entry
+                    insights.record_rejected()
                 if response_headers is not None:
                     response_headers["Retry-After"] = str(
                         int(getattr(e, "retry_after_seconds", 1)))
@@ -315,6 +333,39 @@ class RestController:
                                    "reason": f"{type(e).__name__}: {e}"},
                          "status": 500}
 
+    def _record_insights(self, sink: list, resp, status: int, task,
+                         opaque_id) -> None:
+        """Drain one request's emitted insight records into the node's
+        QueryInsightsService, enriched with edge-only attribution."""
+        service = getattr(self.node, "insights", None)
+        if service is None or not service.enabled:
+            return
+        # fold un-checkpointed CPU into the task before reading it
+        task.record_checkpoint()
+        rs = task.resource_stats()
+        cpu = int(rs.get("cpu_time_in_nanos", 0))
+        heap = int(rs.get("peak_heap_size_in_bytes", 0))
+        outcome = None
+        if isinstance(resp, dict):
+            shards = resp.get("_shards") or {}
+            if status >= 500:
+                outcome = "error"
+            elif status == 429:
+                outcome = "429"
+            elif resp.get("timed_out"):
+                outcome = "timeout"
+            elif shards.get("failed"):
+                failures = shards.get("failures") or []
+                outcome = ("shed" if any(
+                    (f.get("reason") or {}).get("type")
+                    == "node_duress_exception" for f in failures)
+                    else "partial")
+        n = len(sink) or 1
+        for rec in sink:
+            service.record(rec, opaque_id=opaque_id,
+                           cpu_nanos=cpu // n, heap_bytes=heap,
+                           outcome=outcome)
+
     # ------------------------------------------------------------------
 
     def _register_all(self):
@@ -328,6 +379,7 @@ class RestController:
         r("GET", "/_nodes/trace", self.h_nodes_trace)
         r("GET", "/_nodes/hot_threads", self.h_hot_threads)
         r("GET", "/_nodes/flight_recorder", self.h_flight_recorder)
+        r("GET", "/_insights/top_queries", self.h_insights_top_queries)
         r("GET", "/_metrics", self.h_metrics)
         r("GET", "/_cluster/settings", self.h_cluster_get_settings)
         r("PUT", "/_cluster/settings", self.h_cluster_put_settings)
@@ -647,12 +699,53 @@ class RestController:
                     "budget":
                         self.node.search_backpressure.admission.stats(),
                 },
+                # always-on workload attribution: record totals, rollup
+                # cardinality, and the coalescability fraction (full
+                # detail at GET /_insights/top_queries)
+                "query_insights": self.node.insights.stats(),
+                # recovery observability: the recovery.* metric family
+                # (incl. PR 8's corrupt-blob re-requests) + per-shard
+                # store state, the JSON face of GET /_cat/recovery
+                "recovery": self._recovery_stats(),
                 "os": _os_stats(),
                 "process": _process_stats(),
                 # counters + latency histograms with p50/p90/p99 readout
                 # (the telemetry SPI's MetricsRegistry surface)
                 "telemetry": metrics().stats(),
             }}}
+
+    def _recovery_stats(self) -> dict:
+        from opensearch_tpu.common.telemetry import metrics
+
+        m = metrics()
+        shards = []
+        for svc in sorted(self.node.indices.indices.values(),
+                          key=lambda s: s.name):
+            corrupted = svc.corrupted_shards()
+            for shard_id in sorted(svc.local_shards):
+                row = {"index": svc.name, "shard": shard_id,
+                       "type": "store",
+                       "stage": ("corrupted"
+                                 if shard_id in corrupted else "done")}
+                if shard_id in corrupted:
+                    row["corruption"] = corrupted[shard_id]
+                shards.append(row)
+        return {
+            "corrupt_blobs": m.counter("recovery.corrupt_blobs").value,
+            "retries": {
+                name: {
+                    # metric-name-ok: bounded recovery action names
+                    "attempts": m.counter(
+                        f"retry.recovery.{name}.attempts").value,
+                    # metric-name-ok: bounded recovery action names
+                    "retries": m.counter(
+                        f"retry.recovery.{name}.retries").value,
+                    # metric-name-ok: bounded recovery action names
+                    "exhausted": m.counter(
+                        f"retry.recovery.{name}.exhausted").value,
+                } for name in ("start", "report")},
+            "shards": shards,
+        }
 
     def h_nodes_trace(self, req):
         """Recent finished spans from the bounded in-memory exporter —
@@ -669,12 +762,35 @@ class RestController:
     def h_metrics(self, req):
         """Prometheus text exposition of the full MetricsRegistry —
         counters as ``*_total``, latency histograms as cumulative
-        ``_bucket{le=...}`` + ``_sum``/``_count`` (milliseconds).  The
-        same underlying data ``_nodes/stats`` serves as JSON."""
+        ``_bucket{le=...}`` + ``_sum``/``_count`` (milliseconds) — plus
+        the query-insights per-signature series (signature is always a
+        LABEL drawn from the bounded top-N path, never a metric name).
+        The same underlying data ``_nodes/stats`` serves as JSON."""
         from opensearch_tpu.common.telemetry import metrics
+        text = metrics().prometheus_text()
+        insights = getattr(self.node, "insights", None)
+        if insights is not None:
+            text += insights.prometheus_text()
         return 200, PlainText(
-            metrics().prometheus_text(),
+            text,
             content_type="text/plain; version=0.0.4; charset=utf-8")
+
+    def h_insights_top_queries(self, req):
+        """Always-on top-N query attribution + per-plan-signature
+        workload stats (``GET /_insights/top_queries``): ranked by
+        ``?by=latency|cpu|heap``, with the per-signature rollups and
+        the coalescability report the continuous batcher sizes from.
+        Single-node deployments serve their local section in the same
+        fan-in shape the cluster coordinator's merge produces."""
+        from opensearch_tpu.search.insights import merge_sections
+        by = req.param("by", "latency")
+        n = req.param("size") or req.param("n")
+        n = int(n) if n is not None else self.node.insights.top_n
+        section = self.node.insights.section(by=by, n=n)
+        merged = merge_sections({self.node.node_id: section},
+                                by=by, n=n)
+        merged["cluster_name"] = self.node.cluster_name
+        return 200, merged
 
     def h_flight_recorder(self, req):
         """Recent flight-recorder captures (slow-log trips, soak SLO
@@ -2314,18 +2430,31 @@ class RestController:
         return 200, rows
 
     def h_cat_recovery(self, req):
+        """Per-shard recovery state + the recovery.* metric family
+        (corrupt-blob re-requests, retry accounting) — the _cat face of
+        the ``recovery`` section in _nodes/stats."""
+        from opensearch_tpu.common.telemetry import metrics
+        m = metrics()
+        corrupt_blobs = str(m.counter("recovery.corrupt_blobs").value)
+        retries = str(
+            m.counter("retry.recovery.start.retries").value
+            + m.counter("retry.recovery.report.retries").value)
         rows = []
         targets = (self.node.indices.resolve(req.path_params["index"])
                    if req.path_params.get("index")
                    else self.node.indices.indices.values())
         for svc in sorted(targets, key=lambda s: s.name):
+            corrupted = svc.corrupted_shards()
             for shard_id, _engine in sorted(svc.local_shards.items()):
+                stage = "corrupted" if shard_id in corrupted else "done"
                 rows.append({"index": svc.name, "shard": str(shard_id),
-                             "type": "store", "stage": "done",
+                             "type": "store", "stage": stage,
                              "source_node": "-",
                              "target_node": self.node.name,
                              "files_percent": "100.0%",
-                             "bytes_percent": "100.0%"})
+                             "bytes_percent": "100.0%",
+                             "corrupt_blobs": corrupt_blobs,
+                             "retries": retries})
         return 200, rows
 
     def h_cat_repositories(self, req):

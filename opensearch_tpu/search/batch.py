@@ -117,6 +117,9 @@ class BatchGroup:
         self.idfs: list[np.ndarray] = []
         self.weights: list[np.ndarray] = []
         self.required: list[int] = []
+        # group-level scanned/pruned counts of the last run() — shared
+        # by every member's insight record (one pass served the group)
+        self.last_stats = {"pruned": 0, "scanned": 0}
 
     def add(self, pos: int, bind: dict):
         self.positions.append(pos)
@@ -227,6 +230,7 @@ class BatchGroup:
         acc = {pos: {"v": [], "s": [], "l": [], "tot": 0, "mx": -np.inf}
                for pos in self.positions}
         pruned = 0
+        scanned = 0
         for seg_order, seg in enumerate(searcher.segments):
             check_current()    # cancellation point per segment
             t_seg = time.monotonic() if prof is not None else 0.0
@@ -252,10 +256,14 @@ class BatchGroup:
                 a["l"].append(idx)
                 a["tot"] += int(tot)
                 a["mx"] = max(a["mx"], float(mx))
+            scanned += 1
             if prof is not None:
                 prof.seg_scanned(seg.seg_id, time.monotonic() - t_seg)
         if pruned:
             _metrics().counter("search.segments_pruned").inc(pruned)
+        # group-level attribution the msearch member insight records
+        # carry (shared by construction — ONE pass served the group)
+        self.last_stats = {"pruned": pruned, "scanned": scanned}
         t_red = time.monotonic() if prof is not None else 0.0
         out = {}
         for pos in self.positions:
@@ -316,6 +324,9 @@ class BatchGroup:
             for so, seg in enumerate(searcher.segments):
                 if so not in staged:
                     prof.seg_pruned(seg.seg_id, "pruned_can_match", 0.0)
+        self.last_stats = {
+            "pruned": len(searcher.segments) - len(prep["segs"]),
+            "scanned": len(prep["segs"])}
         launches = []             # (seg_order, vals[Q,k], idx, tot, mx)
         for seg_order, sp in prep["segs"]:
             check_current()    # cancellation point per segment program

@@ -32,6 +32,7 @@ from opensearch_tpu.index.segment import (
     Segment,
 )
 from opensearch_tpu.ops import bm25 as bm25_ops
+from opensearch_tpu.search import insights
 from opensearch_tpu.search import plan as P
 from opensearch_tpu.search.compiler import ShardContext, compile_query
 from opensearch_tpu.search.fetch import filter_source
@@ -247,7 +248,7 @@ class ShardSearcher:
     # -- compiled-plan / prepared-bindings caches -------------------------
 
     def compiled(self, query_json: Optional[dict], scored: bool = True,
-                 with_key: bool = False, prof=None):
+                 with_key: bool = False, prof=None, iattrs=None):
         """(plan, bind) for a raw query body through the searcher's plan
         cache, keyed on the canonicalized JSON (key order in the body
         never misses).  The searcher is an immutable point-in-time view,
@@ -277,10 +278,14 @@ class ShardSearcher:
                 _metrics().counter("search.plan_cache.hits").inc()
                 if prof is not None:
                     prof.set("plan_cache", "hit")
+                if iattrs is not None:
+                    iattrs["plan_cache"] = "hit"
                 return (out, ckey) if with_key else out
         elif prof is not None:
             prof.add("plan_cache", time.monotonic() - t_lookup)
         _metrics().counter("search.plan_cache.misses").inc()
+        if iattrs is not None:
+            iattrs["plan_cache"] = "miss"
         if prof is not None:
             prof.set("plan_cache", "miss")
             with prof.phase("rewrite"):
@@ -449,8 +454,13 @@ class ShardSearcher:
         needs_scores = (sort_specs is None
                         or any(s["field"] == "_score" for s in sort_specs)
                         or min_score is not None)
+        # always-on insight attribution: a few dict writes per query
+        # (never per segment sync), drained by whatever edge installed
+        # an insight sink — see search/insights.py emit()
+        ia = {"plan_cache": "miss", "pruned": 0, "scanned": 0}
         (plan, bind), ckey = self.compiled(q_json, scored=needs_scores,
-                                           with_key=True, prof=prof)
+                                           with_key=True, prof=prof,
+                                           iattrs=ia)
         needed = plan.arrays()
         k_want = from_ + size
         # with exact totals waived, block-max pruning may also skip
@@ -477,7 +487,7 @@ class ShardSearcher:
         # top-k and the aggregations (no second device execution)
         views = (list(self._run_full(plan, bind, needed, min_score,
                                      deadline=deadline, ckey=ckey,
-                                     prof=prof))
+                                     prof=prof, iattrs=ia))
                  if aggs_json and self.segments else None)
 
         total_is_lower_bound = False
@@ -495,7 +505,8 @@ class ShardSearcher:
                 rows, total, max_score, total_is_lower_bound = self._topk(
                     plan, bind, needed, k_want, min_score,
                     deadline=deadline, ckey=ckey,
-                    allow_kth_prune=allow_kth_prune, prof=prof)
+                    allow_kth_prune=allow_kth_prune, prof=prof,
+                    iattrs=ia)
         else:
             rows, total, max_score = self._field_sorted(
                 plan, bind, needed, k_want, sort_specs, min_score, views,
@@ -528,6 +539,19 @@ class ShardSearcher:
             prof.add("fetch", time.monotonic() - t_fetch)
 
         took = int((time.monotonic() - t0) * 1000)
+        insights.emit(
+            signature=ckey[0] if ckey is not None else None,
+            scored=needs_scores,
+            took_ms=(time.monotonic() - t0) * 1000,
+            execution_path=ia.get(
+                "execution_path",
+                "host" if (bm25_ops.host_scoring_enabled()
+                           and getattr(plan, "scored", False)
+                           and getattr(plan, "host_topk", None)
+                           is not None) else "device"),
+            plan_cache=ia["plan_cache"],
+            pruned=ia["pruned"], scanned=ia["scanned"],
+            timed_out=deadline.timed_out)
         resp = {
             "took": took,
             "timed_out": deadline.timed_out,
@@ -602,6 +626,12 @@ class ShardSearcher:
         rows = combined[from_: from_ + size]
         hits = self._hits_from_rows(rows, body.get("_source"),
                                     fetch_extras)
+        insights.emit(
+            signature=insights.canonical_query(body.get("query")),
+            scored=True,
+            took_ms=(time.monotonic() - t0) * 1000,
+            execution_path="device", plan_cache="miss",
+            timed_out=deadline.timed_out)
         # per-sub-query top-k truncation means the union is a lower
         # bound beyond the largest sub-query's exact count
         return {
@@ -664,6 +694,23 @@ class ShardSearcher:
                                        "relation": "eq"},
                              "max_score": max_score, "hits": hits},
                 }
+                # one insight record per coalesced member: its OWN plan
+                # signature (members of a (field, k) group still differ
+                # by terms) + the group size — the measured coalescing
+                # the continuous batcher's sizing report aggregates
+                insights.emit(
+                    signature=insights.canonical_query(
+                        body.get("query")),
+                    scored=True,
+                    took_ms=(time.monotonic() - t0) * 1000,
+                    execution_path=(
+                        "host_batched"
+                        if bm25_ops.host_scoring_enabled()
+                        else "device_batched"),
+                    plan_cache="batched",
+                    pruned=g.last_stats["pruned"],
+                    scanned=g.last_stats["scanned"],
+                    batched=len(g.positions))
                 if gprof is not None and body.get("profile"):
                     results[pos]["profile"] = {"shards": [
                         gprof.shard_section(
@@ -724,7 +771,7 @@ class ShardSearcher:
 
     def _run_full(self, plan, bind, needed, min_score,
                   can_match_skip=False, deadline=None, ckey=None,
-                  prof=None):
+                  prof=None, iattrs=None):
         """``can_match_skip`` is ONLY safe for consumers that don't index
         the yielded tuples by position (views/aggs paths align with
         self.segments and must see every segment).  An expired
@@ -740,6 +787,8 @@ class ShardSearcher:
             t_seg = time.monotonic() if prof is not None else 0.0
             if can_match_skip and not plan.can_match(bind, seg):
                 _metrics().counter("search.segments_pruned").inc()
+                if iattrs is not None:
+                    iattrs["pruned"] += 1
                 if prof is not None:
                     prof.seg_pruned(seg.seg_id, "pruned_can_match",
                                     time.monotonic() - t_seg)
@@ -758,6 +807,8 @@ class ShardSearcher:
                 dims, ins = self._prepared(plan, bind, seg, dseg, ckey,
                                            prof=prof)
                 scores, matched = P.run_full(plan, dims, A, ins, ms)
+            if iattrs is not None:
+                iattrs["scanned"] += 1
             if prof is not None:
                 prof.seg_scanned(seg.seg_id, max(
                     0.0, time.monotonic() - t_seg
@@ -783,7 +834,7 @@ class ShardSearcher:
         return rows, total, (None if max_score == -np.inf else float(max_score))
 
     def _topk(self, plan, bind, needed, k_want, min_score, deadline=None,
-              ckey=None, allow_kth_prune=False, prof=None):
+              ckey=None, allow_kth_prune=False, prof=None, iattrs=None):
         """Returns (rows, total, max_score, total_is_lower_bound).
 
         Block-max pruning: segments whose ``plan.max_score_bound`` can't
@@ -805,7 +856,7 @@ class ShardSearcher:
                         in self._run_full(plan, bind, needed, min_score,
                                           can_match_skip=True,
                                           deadline=deadline, ckey=ckey,
-                                          prof=prof))
+                                          prof=prof, iattrs=iattrs))
             if prof is not None:
                 # the generator's own phases were recorded inline; the
                 # residual host-side sum is the reduce share
@@ -828,6 +879,8 @@ class ShardSearcher:
         host_fast = (bm25_ops.host_scoring_enabled()
                      and getattr(plan, "scored", False)
                      and getattr(plan, "host_topk", None) is not None)
+        if iattrs is not None:
+            iattrs["execution_path"] = "host" if host_fast else "device"
         if prof is not None:
             prof.set("execution_path", "host" if host_fast else "device")
         launched = []              # [si, vals, idx, tot, mx, synced_vals]
@@ -840,6 +893,8 @@ class ShardSearcher:
             t_seg = time.monotonic() if prof is not None else 0.0
             if not plan.can_match(bind, seg):
                 _metrics().counter("search.segments_pruned").inc()
+                if iattrs is not None:
+                    iattrs["pruned"] += 1
                 if prof is not None:
                     prof.seg_pruned(seg.seg_id, "pruned_can_match",
                                     time.monotonic() - t_seg)
@@ -849,6 +904,8 @@ class ShardSearcher:
                 if ms_host is not None and bound < ms_host:
                     # exact: docs below min_score never count in totals
                     _metrics().counter("search.segments_pruned").inc()
+                    if iattrs is not None:
+                        iattrs["pruned"] += 1
                     if prof is not None:
                         prof.seg_pruned(seg.seg_id, "pruned_min_score",
                                         time.monotonic() - t_seg)
@@ -858,6 +915,8 @@ class ShardSearcher:
                     # tie at exactly `bound` (seg-asc tie-break); totals
                     # become a lower bound
                     _metrics().counter("search.segments_pruned").inc()
+                    if iattrs is not None:
+                        iattrs["pruned"] += 1
                     if prof is not None:
                         prof.seg_pruned(seg.seg_id, "pruned_kth",
                                         time.monotonic() - t_seg)
@@ -887,6 +946,8 @@ class ShardSearcher:
                     k = min(k_want, dseg.n_pad)
                     launched.append([si, *P.run_topk(plan, dims, k, A,
                                                      ins, ms), None])
+            if iattrs is not None:
+                iattrs["scanned"] += 1
             if prof is not None:
                 prof.seg_scanned(seg.seg_id, max(
                     0.0, time.monotonic() - t_disp

@@ -733,6 +733,17 @@ class SoakRunner:
             self._write_with_retry(
                 ctx, lambda: nodes[ctx["client"]].refresh(cfg.index))
             final = self._final_state(ctx)
+            # snapshot the client/coordinator node's query-insights
+            # section while the cluster is still alive: an SLO breach
+            # capture below ships WITH the workload evidence (which
+            # query shapes were hot when the SLO went red)
+            query_insights = {
+                "top_queries": nodes[ctx["client"]].insights.top(
+                    by="latency", n=5),
+                "coalescability":
+                    nodes[ctx["client"]].insights.coalescability(),
+                "totals": nodes[ctx["client"]].insights.stats(),
+            }
         finally:
             disk = ctx.pop("disk", None)
             if disk is not None:     # exception path: unpatch open/fsync
@@ -765,6 +776,7 @@ class SoakRunner:
                 for k in after if k.startswith("retry.")
                 and k.endswith(".retries")),
             "final_state": final,
+            "query_insights": query_insights,
         }
 
     def _run_concurrent(self, ops, by_step, ctx) -> None:
@@ -859,7 +871,12 @@ class SoakRunner:
                              "fault": d.get("fault")}
                             for d in chaos.get("applied", [])],
                         "unexpected_errors":
-                            list(chaos.get("unexpected_errors", []))})
+                            list(chaos.get("unexpected_errors", [])),
+                        # the top-queries snapshot taken while the
+                        # cluster was alive: WHAT was running when the
+                        # SLO went red, by plan signature
+                        "query_insights":
+                            chaos.get("query_insights") or {}})
 
     def run(self) -> dict:
         """Control pass (when configured) then chaos pass, then SLO

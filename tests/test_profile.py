@@ -361,7 +361,9 @@ def test_cluster_profile_merge_matches_single_node(tmp_path):
 # -- /_metrics Prometheus exposition ----------------------------------------
 
 _PROM_LINE = re.compile(
-    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{le=\"[^\"]+\"\})? "
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})? "
     r"[-+]?[0-9]+(\.[0-9]+)?([eE][-+]?[0-9]+)?$")
 
 

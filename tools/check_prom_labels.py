@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Lint: Prometheus label values must come from a bounded set.
+
+The metric-name lint (``check_metric_names.py``) keeps request data out
+of metric NAMES; with PR 10's query-insights exposition the registry
+grew its first LABELED series — and a label value derived from request
+data (a raw query string, a user id, a document field) is the same
+cardinality explosion wearing a different hat: one time series per
+distinct value, unbounded scrape growth, and request contents leaking
+into dashboards.
+
+Rule: any string literal (including f-string fragments — where a
+rendered ``{label="`` appears as a literal part) in ``opensearch_tpu/``
+or ``bench.py`` that opens a Prometheus label block
+(``{name="`` after brace-unescaping) marks a label-emission site.
+Every such site must carry a ``# label-ok`` annotation on the same
+line or the line above, stating why the value is bounded — the
+sanctioned path is the query-insights top-N ring, where every label
+value is a 12-hex plan-signature hash or a node id, capped by the
+ring/rollup sizes (search/insights.py).  Histogram ``le=`` bounds and
+other code-level constants annotate the same way.
+
+Sibling of ``check_metric_names.py``; new un-annotated sites fail
+tier-1 (tests/test_insights.py runs this check).
+
+Usage: python tools/check_prom_labels.py [root ...]   (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+ANNOTATION = "# label-ok"
+# the start of a Prometheus label block: {name=" — JSON object literals
+# ({"key": ...) don't match because their quote precedes the name
+LABEL_RX = re.compile(r"\{[a-zA-Z_][a-zA-Z0-9_]*=\"")
+
+
+def _string_parts(node):
+    """Every literal string fragment under ``node`` (plain constants and
+    the constant parts of f-strings)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node, node.value
+    elif isinstance(node, ast.JoinedStr):
+        for part in node.values:
+            if isinstance(part, ast.Constant) and isinstance(part.value,
+                                                             str):
+                yield node, part.value
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+    lines = src.splitlines()
+    problems = []
+    seen: set[int] = set()
+    for node in ast.walk(tree):
+        for holder, text in _string_parts(node):
+            if not LABEL_RX.search(text):
+                continue
+            lineno = holder.lineno
+            if lineno in seen:
+                continue
+            seen.add(lineno)
+            # multi-line expressions: accept the annotation anywhere
+            # between the expression's first line and its end line + 1
+            end = getattr(holder, "end_lineno", lineno) or lineno
+            window = lines[max(0, lineno - 2): min(len(lines), end + 1)]
+            if any(ANNOTATION in ln for ln in window):
+                continue
+            problems.append(
+                f"{path}:{lineno}: Prometheus label block "
+                f"{LABEL_RX.search(text).group(0)!r}... built from a "
+                "string literal — label values must come from a bounded "
+                "set (the insights top-N signature path or code-level "
+                f"constants); annotate the site with '{ANNOTATION}: "
+                "<why bounded>'")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    roots = argv[1:] or [os.path.join(repo, "opensearch_tpu"),
+                         os.path.join(repo, "bench.py")]
+    problems = []
+    for root in roots:
+        if os.path.isfile(root):
+            problems.extend(check_file(root))
+            continue
+        for dirpath, _dirs, files in os.walk(root):
+            if "__pycache__" in dirpath:
+                continue
+            for fname in sorted(files):
+                if fname.endswith(".py"):
+                    problems.extend(
+                        check_file(os.path.join(dirpath, fname)))
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} prometheus-label violation(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
