@@ -315,6 +315,14 @@ def main():
         phase_report("insights", {"platform": platform,
                                   "error": f"{type(e).__name__}: {e}"})
 
+    # -- phase: device (residency ledger, transfer split, forced budget
+    # eviction) -----------------------------------------------------------
+    try:
+        run_device_phase(searcher, queries, seq_n, platform)
+    except Exception as e:  # noqa: BLE001 — report, keep the bench
+        phase_report("device", {"platform": platform,
+                                "error": f"{type(e).__name__}: {e}"})
+
     # -- phase: soak (chaos SLO scenario over a 3-node cluster) -----------
     # runs LAST so a wedge here cannot cost the phases above; failures
     # are reported as a phase line, never swallowed
@@ -425,6 +433,71 @@ def run_insights_phase(searcher, queries, seq_n: int,
         "top_signatures": coalesc["top_signatures"][:3],
         "slowest_signature": top[0]["signature"] if top else None,
     })
+
+
+def run_device_phase(searcher, queries, seq_n: int, platform: str):
+    """Device-memory budget line (ROADMAP item 5): how many bytes the
+    query path keeps device-resident, what the host↔device transfer
+    traffic looks like split stage vs fetch-back, and what happens when
+    a ``device.memory.budget_bytes`` smaller than the footprint forces
+    LRU-dispatch eviction — footprint vs qps measured, not asserted.
+    Runs the DEVICE kernels even on the CPU backend (host fast-path off
+    for the phase) so the staged footprint and eviction machinery are
+    exercised everywhere the bench runs.  Returns the reported dict."""
+    from opensearch_tpu.common.device_ledger import device_ledger
+    from opensearch_tpu.ops import bm25 as bm25_ops
+
+    led = device_ledger()
+    prev_budget = led.budget_bytes
+    prev_host = bm25_ops.HOST_SCORING
+    bm25_ops.HOST_SCORING = False
+    try:
+        sample = queries[: min(seq_n, 50)]
+        for q in sample:                       # stage + warm
+            searcher.search(q)
+        stats0 = led.stats()
+        resident = stats0["resident_bytes"]
+        t0 = time.monotonic()
+        for q in sample:
+            searcher.search(q)
+        unconstrained_s = time.monotonic() - t0
+        # force the budget below the footprint: the LRU-dispatch segment
+        # unstages and scored term-bags degrade to the host tables
+        led.set_budget(max(1, resident // 2))
+        t0 = time.monotonic()
+        for q in sample:
+            searcher.search(q)
+        constrained_s = time.monotonic() - t0
+        stats1 = led.stats()
+        data = {
+            "platform": platform,
+            "n_queries": len(sample),
+            "resident_bytes": resident,
+            "resident_segments": stats0["resident_segments"],
+            "budget_bytes": stats1["budget"]["budget_bytes"],
+            "evictions": stats1["budget"]["evictions"],
+            "evicted_bytes": stats1["budget"]["evicted_bytes"],
+            "restages": stats1["budget"]["restages"],
+            "host_fallbacks": stats1["budget"]["host_fallbacks"],
+            "transfer_stage_bytes": stats1["transfers"]["stage"]["bytes"],
+            "transfer_stage_ops": stats1["transfers"]["stage"]["ops"],
+            "transfer_fetch_bytes": stats1["transfers"]["fetch"]["bytes"],
+            "transfer_fetch_ops": stats1["transfers"]["fetch"]["ops"],
+            "qps_unconstrained": round(
+                len(sample) / unconstrained_s, 1) if unconstrained_s
+            else 0.0,
+            "qps_budget_constrained": round(
+                len(sample) / constrained_s, 1) if constrained_s
+            else 0.0,
+            "xla_kernels": stats1["compile_registry"]["kernels"],
+            "compile_unavailable":
+                stats1["compile_registry"]["unavailable"],
+        }
+        phase_report("device", data)
+        return data
+    finally:
+        bm25_ops.HOST_SCORING = prev_host
+        led.set_budget(prev_budget)
 
 
 def run_soak_phase(platform: str):

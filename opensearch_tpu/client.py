@@ -247,6 +247,13 @@ class CatClient(_Namespace):
                 else "/_cat/recovery")
         return self.transport.perform_request("GET", path, p)
 
+    def segments(self, params=None):
+        """Per-segment rows with doc counts and HOST/DEVICE footprint
+        columns (``size`` = host array bytes, ``size.device`` = bytes
+        the residency ledger currently holds staged)."""
+        p = {"format": "json", **(params or {})}
+        return self.transport.perform_request("GET", "/_cat/segments", p)
+
 
 class SnapshotClient(_Namespace):
     def create_repository(self, repository, body, params=None):
@@ -325,6 +332,15 @@ class NodesClient(_Namespace):
         breaches): GET /_nodes/flight_recorder."""
         return self.transport.perform_request(
             "GET", "/_nodes/flight_recorder", params)
+
+    def device(self, params=None):
+        """The ``device`` section of ``_nodes/stats`` per node: the
+        residency ledger's per-index rollups, host↔device transfer
+        counters (stage vs fetch-back), device-memory budget/eviction
+        accounting, and the per-kernel XLA compile registry."""
+        out = self.stats(params)
+        return {nid: n.get("device", {})
+                for nid, n in (out.get("nodes") or {}).items()}
 
 
 class OpenSearch:

@@ -1,0 +1,585 @@
+"""Device-resident memory & transfer observability: the staging ledger.
+
+Until this module, nothing in the system could answer "what is on the
+device, how many bytes, who staged it, and when was it last used" — the
+fielddata breaker counted an *estimate* at segment-staging time and the
+rest was assertion.  ROADMAP items 1 (continuous batching) and 5
+(quantized device-resident indices at 10-100x corpus scale) both need a
+measured device-memory budget line; GPUSparse (arxiv 2606.26441) treats
+accelerator-resident index layout and transfer cost as first-class
+engineering quantities.  This ledger makes them measurable here:
+
+- **Residency ledger** — ALL device staging flows through it: every
+  ``DeviceSegment`` array family (postings, impacts, doc values, live
+  masks, nested blocks, ANN structures), the batched-msearch group
+  arrays, and the mesh path's ``jax.device_put``.  Each entry records
+  its owner (index/shard/segment/field/kind), exact staged nbytes, the
+  staging tick, and per-owner dispatch count + last-dispatch tick.
+  ``tools/check_device_staging.py`` (tier-1) rejects raw staging calls
+  outside this module in ``index/``/``search/``/``parallel/``/``ops/``.
+- **Transfer accounting** — host→device (stage) and device→host
+  (fetch-back) byte/op/time counters, fed into the MetricsRegistry so
+  ``/_metrics`` scrapes them and ``_nodes/stats`` reports them.
+- **Compile registry** — per-kernel XLA program counts behind a
+  version-tolerant ``_cache_size`` shim (jit's private introspection
+  moved across jax versions; a missing attribute degrades to a counted
+  ``unavailable`` instead of breaking the profiler).
+- **Budget enforcement** — the first consumer: a dynamic
+  ``device.memory.budget_bytes`` setting; when resident bytes exceed
+  it, the least-recently-dispatched sealed segment stagings are
+  unstaged (counted evictions, fielddata-breaker release).  Evicted
+  scored term-bags degrade byte-identically to the host impact-table
+  path (``TermBagPlan.host_topk`` — the PR-5 parity invariant); other
+  plans restage on demand (counted restages).  This is the seed of
+  ROADMAP item 5's host↔device paging.
+
+The ledger is process-global (like the breaker service and the metrics
+registry): in-process multi-node tests share one ledger, which is the
+honest model — they also share one device.  Tests reset it via
+``device_ledger().reset()``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import weakref
+from typing import Callable, Optional
+
+from opensearch_tpu.common.telemetry import metrics as _metrics
+
+# entry kinds a DeviceSegment stages (the "array families" of the
+# tentpole); batch/mesh/other producers add their own kinds
+SEGMENT_KINDS = ("postings", "numeric", "ordinal", "vector", "geo",
+                 "impacts", "live", "nested", "ann")
+
+
+def host_footprint(seg, per_field: bool = False):
+    """Host-side footprint of one ``Segment`` in bytes — THE source of
+    truth for "how big is this segment" (replaces the hand-rolled
+    estimate ``DeviceSegment`` used for its breaker charge and the
+    ad-hoc doc-values math ``GET /_cat/fielddata`` did inline).
+
+    Returns total bytes, or ``{(kind, field): bytes}`` with
+    ``per_field=True``.  Pure numpy accounting; never touches jax.
+    """
+    out: dict[tuple, int] = {}
+
+    def put(kind, field, *arrays):
+        n = sum(int(getattr(a, "nbytes", 0)) for a in arrays
+                if a is not None)
+        if n:
+            out[(kind, field)] = out.get((kind, field), 0) + n
+
+    for name, pf in seg.postings.items():
+        put("postings", name, pf.offsets, pf.doc_ids, pf.tfs,
+            pf.pos_offsets, pf.positions, pf.doc_lens, pf.df, pf.present)
+    for name, dv in seg.numeric_dv.items():
+        put("numeric", name, dv.offsets, dv.values, dv.value_docs,
+            dv.minv, dv.maxv, dv.exists)
+    for name, dv in seg.ordinal_dv.items():
+        put("ordinal", name, dv.offsets, dv.ords, dv.value_docs,
+            dv.min_ord, dv.max_ord, dv.exists)
+    for name, dv in seg.vector_dv.items():
+        put("vector", name, dv.values, dv.exists)
+    for name, dv in seg.geo_dv.items():
+        put("geo", name, dv.offsets, dv.lats, dv.lons, dv.value_docs,
+            dv.exists)
+    if per_field:
+        return out
+    return sum(out.values())
+
+
+class KernelCompileRegistry:
+    """Per-kernel XLA compile/retrace registry: every jit entry point of
+    the query path registers here, and ``counts()`` reads each one's
+    live compiled-program count through a version-tolerant shim around
+    jit's private ``_cache_size`` — generalizing the profiler's one-off
+    delta so a jax upgrade that drops the introspection degrades the
+    metric (counted ``unavailable``) instead of breaking the Profile
+    API."""
+
+    # default query-path kernels, resolved lazily (import cycles during
+    # bootstrap are the same reason profile.py resolved them lazily)
+    _DEFAULTS = (
+        ("plan.run_topk", "opensearch_tpu.search.plan", "run_topk"),
+        ("plan.run_full", "opensearch_tpu.search.plan", "run_full"),
+        ("plan.topk_from_scores", "opensearch_tpu.search.plan",
+         "topk_from_scores"),
+        ("batch.batch_impact_union_topk", "opensearch_tpu.search.batch",
+         "batch_impact_union_topk"),
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._kernels: dict[str, object] = {}
+        self._defaults_loaded = False
+
+    def register(self, name: str, fn) -> None:
+        with self._lock:
+            self._kernels[name] = fn
+
+    def _ensure_defaults(self) -> None:
+        if self._defaults_loaded:
+            return
+        import importlib
+        loaded = {}
+        for name, mod, attr in self._DEFAULTS:
+            try:
+                fn = getattr(importlib.import_module(mod), attr)
+            except Exception:      # partial import cycle during bootstrap
+                return             # retry on the next read
+            loaded[name] = fn
+        with self._lock:
+            for name, fn in loaded.items():
+                self._kernels.setdefault(name, fn)
+            self._defaults_loaded = True
+
+    @staticmethod
+    def _cache_size_of(fn) -> Optional[int]:
+        """The version-tolerant ``_cache_size`` shim: None when this jax
+        doesn't expose compiled-program introspection for ``fn``."""
+        size = getattr(fn, "_cache_size", None)
+        if size is None:
+            return None
+        try:
+            return int(size())
+        except Exception:          # introspection changed shape again
+            return None
+
+    def counts(self) -> dict:
+        """{"kernels": {name: programs}, "unavailable": n, "total": n}
+        — kernels whose introspection is gone are listed under
+        ``unavailable`` (counted, never raising)."""
+        self._ensure_defaults()
+        with self._lock:
+            kernels = dict(self._kernels)
+        out: dict[str, int] = {}
+        unavailable = 0
+        for name in sorted(kernels):
+            n = self._cache_size_of(kernels[name])
+            if n is None:
+                unavailable += 1
+            else:
+                out[name] = n
+        return {"kernels": out, "unavailable": unavailable,
+                "total": sum(out.values())}
+
+    def program_count(self) -> int:
+        """Total live compiled programs across registered kernels (the
+        profiler's ``xla_compiles`` delta source)."""
+        return self.counts()["total"]
+
+
+class _Group:
+    """One staging owner's ledger entries — normally one DeviceSegment's
+    whole array family set; also one batch-prep group or one mesh
+    placement.  The group is the eviction unit: "unstage the
+    least-recently-dispatched segment" means closing its group."""
+
+    __slots__ = ("index", "shard", "segment", "entries", "staged_tick",
+                 "dispatches", "last_dispatch_tick", "sealed",
+                 "evict_cb", "_gid", "__weakref__")
+
+    def __init__(self, index: str, shard, segment: str,
+                 evict_cb: Optional[Callable] = None):
+        self.index = index
+        self.shard = shard
+        self.segment = segment
+        self.entries: dict[tuple, int] = {}   # (kind, field, name) -> nbytes
+        self.staged_tick = 0
+        self.dispatches = 0
+        self.last_dispatch_tick = 0
+        self.sealed = False                   # unsealed groups never evict
+        self.evict_cb = evict_cb              # None -> not evictable
+
+    def nbytes(self) -> int:
+        return sum(self.entries.values())
+
+    def to_dict(self) -> dict:
+        by_kind: dict[str, int] = {}
+        for (kind, _f, _n), b in self.entries.items():
+            by_kind[kind] = by_kind.get(kind, 0) + b
+        return {"index": self.index, "shard": self.shard,
+                "segment": self.segment, "bytes": self.nbytes(),
+                "entries": len(self.entries),
+                "by_kind": dict(sorted(by_kind.items())),
+                "staged_tick": self.staged_tick,
+                "dispatches": self.dispatches,
+                "last_dispatch_tick": self.last_dispatch_tick,
+                "evictable": self.evict_cb is not None and self.sealed}
+
+
+class GroupCloser:
+    """Keep one of these inside a cache entry that owns a staging group
+    (dicts are not weakref-able, so ``tether`` can't watch them): when
+    the entry is evicted or garbage collected, the sentinel closes the
+    group and its ledger entries disappear with the staged arrays."""
+
+    __slots__ = ("_ledger", "_group")
+
+    def __init__(self, ledger: "DeviceResidencyLedger", group: "_Group"):
+        self._ledger = ledger
+        self._group = group
+
+    def __del__(self):
+        try:
+            self._ledger.close_group(self._group)
+        except Exception:
+            pass
+
+
+class DeviceResidencyLedger:
+    """The device residency + transfer ledger (module docstring)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._groups: "dict[int, _Group]" = {}
+        self._next_id = itertools.count(1)
+        self._tick = itertools.count(1)
+        self.budget_bytes: Optional[int] = None
+        self.evictions = 0
+        self.restages = 0
+        self.host_fallbacks = 0
+        self._evicted_bytes = 0
+        self._transfers = {
+            "stage": {"bytes": 0, "ops": 0, "seconds": 0.0},
+            "fetch": {"bytes": 0, "ops": 0, "seconds": 0.0}}
+
+    # -- group lifecycle ---------------------------------------------------
+
+    def open_group(self, *, index: str = "-", shard=0, segment: str = "-",
+                   evict: Optional[Callable] = None) -> _Group:
+        """New (unsealed) staging group.  ``evict`` is the unstage
+        callback the budget enforcer may call; groups without one are
+        accounted but never evicted (batch/mesh stagings whose lifetime
+        is owned by their caches)."""
+        g = _Group(index, shard, segment, evict_cb=evict)
+        g.staged_tick = next(self._tick)
+        gid = next(self._next_id)
+        with self._lock:
+            self._groups[gid] = g
+        g._gid = gid  # type: ignore[attr-defined]
+        return g
+
+    def tether(self, owner, group: _Group) -> None:
+        """Close ``group`` automatically when ``owner`` (a weakref-able
+        object — e.g. a DeviceSegment) is garbage collected, so a
+        refreshed-away staging cannot leak ledger entries."""
+        weakref.finalize(owner, self._forget,
+                         getattr(group, "_gid", -1))
+
+    def _forget(self, gid: int) -> None:
+        with self._lock:
+            self._groups.pop(gid, None)
+
+    def seal(self, group: _Group) -> None:
+        """Mark the group fully staged — only sealed groups are eviction
+        candidates (never unstage a segment mid-construction)."""
+        group.sealed = True
+        self._enforce(protect=group)
+
+    def close_group(self, group: _Group) -> None:
+        """Explicit removal (eviction or owner teardown)."""
+        self._forget(getattr(group, "_gid", -1))
+
+    # -- staging (H2D) -----------------------------------------------------
+
+    def stage(self, group: Optional[_Group], host_array, *, kind: str,
+              field: str = "", name: str = ""):
+        """THE sanctioned host→device staging call: performs the
+        transfer (``jnp.asarray``), times it, and records the entry
+        under ``group`` with the exact staged nbytes.  Returns the
+        device array."""
+        import jax.numpy as jnp
+
+        t0 = time.monotonic()
+        out = jnp.asarray(host_array)      # staging-ok: the ledger itself
+        dt = time.monotonic() - t0
+        self._record(group, (kind, field, name),
+                     int(getattr(host_array, "nbytes", None)
+                         or out.nbytes), dt)
+        return out
+
+    def device_put(self, group: Optional[_Group], value, sharding=None,
+                   *, kind: str = "mesh", field: str = "",
+                   name: str = ""):
+        """Sanctioned ``jax.device_put`` (the mesh placement path)."""
+        import jax
+
+        t0 = time.monotonic()
+        out = jax.device_put(value, sharding)  # staging-ok: the ledger itself
+        dt = time.monotonic() - t0
+        self._record(group, (kind, field, name),
+                     int(getattr(value, "nbytes", None) or 0), dt)
+        return out
+
+    def adopt(self, group: _Group, arrays, *, kind: str,
+              field: str = "", name: str = "") -> None:
+        """Account already-staged device arrays (ANN structures staged
+        by their own builders) without re-performing the transfer."""
+        total = 0
+        stackk = [arrays]
+        while stackk:
+            v = stackk.pop()
+            nb = getattr(v, "nbytes", None)
+            if nb is not None:
+                total += int(nb)
+            elif isinstance(v, (tuple, list)):
+                stackk.extend(v)
+            elif isinstance(v, dict):
+                stackk.extend(v.values())
+        self._record(group, (kind, field, name), total, 0.0)
+
+    def _record(self, group: Optional[_Group], key: tuple, nbytes: int,
+                seconds: float) -> None:
+        prev = 0
+        with self._lock:
+            if group is not None:
+                prev = group.entries.get(key)
+                group.entries[key] = nbytes
+            t = self._transfers["stage"]
+            t["bytes"] += nbytes
+            t["ops"] += 1
+            t["seconds"] += seconds
+        _metrics().counter("device.transfer.stage.bytes").inc(nbytes)
+        _metrics().counter("device.transfer.stage.ops").inc()
+        if group is not None and prev is None and group.sealed:
+            # post-seal additions (impacts/live staged lazily) can push
+            # past the budget too
+            self._enforce(protect=group)
+
+    def drop(self, group: _Group, *, kind: str, field: str = "",
+             name: str = "") -> None:
+        """Remove one entry (its device array was dropped by the owning
+        cache — e.g. a live-mask snapshot LRU'ing out)."""
+        with self._lock:
+            group.entries.pop((kind, field, name), None)
+
+    # -- dispatch + fetch-back accounting ----------------------------------
+
+    def record_dispatch(self, group: Optional[_Group]) -> None:
+        """One device program consumed this group's arrays — the LRU
+        signal budget eviction orders by."""
+        if group is None:
+            return
+        with self._lock:
+            group.dispatches += 1
+            group.last_dispatch_tick = next(self._tick)
+
+    def record_fetch(self, nbytes: int, seconds: float) -> None:
+        """Device→host result readback (the sync regions of the query
+        path and the mesh merge)."""
+        with self._lock:
+            t = self._transfers["fetch"]
+            t["bytes"] += int(nbytes)
+            t["ops"] += 1
+            t["seconds"] += seconds
+        _metrics().counter("device.transfer.fetch.bytes").inc(int(nbytes))
+        _metrics().counter("device.transfer.fetch.ops").inc()
+
+    def record_restage(self) -> None:
+        """A previously evicted segment was staged again (demand
+        paging's fault counter)."""
+        with self._lock:
+            self.restages += 1
+        _metrics().counter("device.restages").inc()
+
+    def record_host_fallback(self) -> None:
+        """An evicted segment scored on the host impact tables instead
+        of restaging (the byte-identical degradation path)."""
+        with self._lock:
+            self.host_fallbacks += 1
+        _metrics().counter("device.host_fallback").inc()
+
+    # -- budget enforcement ------------------------------------------------
+
+    def set_budget(self, budget_bytes: Optional[int]) -> None:
+        """Dynamic ``device.memory.budget_bytes`` consumer; 0/None =
+        unlimited.  Applies immediately."""
+        b = int(budget_bytes) if budget_bytes else 0
+        self.budget_bytes = b if b > 0 else None
+        self._enforce()
+
+    def _enforce(self, protect: Optional[_Group] = None) -> None:
+        """Unstage least-recently-dispatched sealed groups until
+        resident bytes fit the budget.  ``protect`` (the group just
+        staged) is never evicted — evicting the staging you are in the
+        middle of serving would livelock demand paging."""
+        budget = self.budget_bytes
+        if budget is None:
+            return
+        while True:
+            with self._lock:
+                resident = sum(g.nbytes() for g in self._groups.values())
+                if resident <= budget:
+                    return
+                victims = [g for g in self._groups.values()
+                           if g.sealed and g.evict_cb is not None
+                           and g is not protect]
+                if not victims:
+                    return          # nothing evictable: stay over budget
+                victim = min(victims,
+                             key=lambda g: (g.last_dispatch_tick,
+                                            g.staged_tick))
+                freed = victim.nbytes()
+                self.evictions += 1
+                self._evicted_bytes += freed
+                cb = victim.evict_cb
+                victim.evict_cb = None    # never evict twice
+            _metrics().counter("device.evictions").inc()
+            _metrics().counter("device.evicted.bytes").inc(freed)
+            try:
+                cb()                      # releases the breaker charge
+            finally:
+                self.close_group(victim)
+
+    # -- readout -----------------------------------------------------------
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(g.nbytes() for g in self._groups.values())
+
+    def transfer_snapshot(self) -> tuple[int, int]:
+        """(stage_bytes, fetch_bytes) monotonic totals — per-query
+        attribution takes deltas (the insights transfer_bytes field)."""
+        with self._lock:
+            return (self._transfers["stage"]["bytes"],
+                    self._transfers["fetch"]["bytes"])
+
+    def device_footprint(self, seg) -> int:
+        """Currently staged bytes of one ``Segment`` (0 when it is not
+        device-resident)."""
+        dseg = getattr(seg, "_device", None)
+        group = getattr(dseg, "_ledger_group", None)
+        if group is None:
+            return 0
+        with self._lock:
+            return group.nbytes()
+
+    def stats(self) -> dict:
+        """The ``_nodes/stats`` ``device`` section body: residency
+        rollups per index, transfer counters, budget/eviction
+        accounting, and the per-kernel compile registry."""
+        with self._lock:
+            groups = list(self._groups.values())
+            transfers = {
+                side: {"bytes": t["bytes"], "ops": t["ops"],
+                       "time_ms": round(t["seconds"] * 1000.0, 3)}
+                for side, t in self._transfers.items()}
+            budget = self.budget_bytes
+            ev, evb = self.evictions, self._evicted_bytes
+            rs, hf = self.restages, self.host_fallbacks
+        per_index: dict[str, dict] = {}
+        resident = 0
+        dispatches = 0
+        for g in groups:
+            b = g.nbytes()
+            resident += b
+            dispatches += g.dispatches
+            ix = per_index.setdefault(
+                g.index, {"bytes": 0, "segments": 0, "dispatches": 0})
+            ix["bytes"] += b
+            ix["segments"] += 1
+            ix["dispatches"] += g.dispatches
+        return {
+            "resident_bytes": resident,
+            "resident_segments": len(groups),
+            "dispatches": dispatches,
+            "budget": {
+                "budget_bytes": budget or 0,
+                "evictions": ev,
+                "evicted_bytes": evb,
+                "restages": rs,
+                "host_fallbacks": hf,
+            },
+            "transfers": transfers,
+            "indices": dict(sorted(per_index.items())),
+            "compile_registry": kernel_registry().counts(),
+            "backend": _backend_memory_stats(),
+        }
+
+    def segments(self) -> list[dict]:
+        """Per-group detail rows (debug surface; `_cat/segments` reads
+        footprints through ``device_footprint`` instead)."""
+        with self._lock:
+            groups = sorted(self._groups.values(),
+                            key=lambda g: (g.index, str(g.shard),
+                                           g.segment))
+        return [g.to_dict() for g in groups]
+
+    def prometheus_text(self) -> str:
+        """Gauge exposition for the scrape surface (counters already
+        flow through the MetricsRegistry)."""
+        s = self.stats()
+        lines = [
+            "# HELP opensearch_tpu_device_resident_bytes "
+            "Device-resident ledger bytes",
+            "# TYPE opensearch_tpu_device_resident_bytes gauge",
+            f"opensearch_tpu_device_resident_bytes {s['resident_bytes']}",
+            "# HELP opensearch_tpu_device_budget_bytes "
+            "Configured device memory budget (0 = unlimited)",
+            "# TYPE opensearch_tpu_device_budget_bytes gauge",
+            "opensearch_tpu_device_budget_bytes "
+            f"{s['budget']['budget_bytes']}",
+            "# HELP opensearch_tpu_device_resident_segments "
+            "Device-resident staging groups",
+            "# TYPE opensearch_tpu_device_resident_segments gauge",
+            "opensearch_tpu_device_resident_segments "
+            f"{s['resident_segments']}",
+        ]
+        lines.append(
+            "# HELP opensearch_tpu_device_index_resident_bytes "
+            "Device-resident bytes per index")
+        lines.append(
+            "# TYPE opensearch_tpu_device_index_resident_bytes gauge")
+        for ix, row in s["indices"].items():
+            ixv = (str(ix).replace("\\", "\\\\").replace('"', '\\"')
+                   .replace("\n", "\\n"))
+            lines.append(
+                f'opensearch_tpu_device_index_resident_bytes'
+                f'{{index="{ixv}"}} {row["bytes"]}')  # label-ok: bounded by index count
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        """Test hook: forget all groups and zero the counters (the
+        staged arrays themselves stay owned by their segments)."""
+        with self._lock:
+            self._groups.clear()
+            self.budget_bytes = None
+            self.evictions = self.restages = self.host_fallbacks = 0
+            self._evicted_bytes = 0
+            for t in self._transfers.values():
+                t["bytes"] = t["ops"] = 0
+                t["seconds"] = 0.0
+
+
+def _backend_memory_stats() -> dict:
+    """``jax`` device ``memory_stats()`` where the backend provides it
+    (TPU/GPU do; CPU returns None) — the allocator's own view next to
+    the ledger's."""
+    try:
+        import jax
+        dev = jax.devices()[0]
+        raw = dev.memory_stats()
+        if not raw:
+            return {"available": False, "platform": dev.platform}
+        keep = {k: int(v) for k, v in raw.items()
+                if isinstance(v, (int, float)) and (
+                    "bytes" in k or "allocs" in k)}
+        return {"available": True, "platform": dev.platform, **keep}
+    except Exception:
+        return {"available": False}
+
+
+_ledger = DeviceResidencyLedger()
+_registry = KernelCompileRegistry()
+
+
+def device_ledger() -> DeviceResidencyLedger:
+    return _ledger
+
+
+def kernel_registry() -> KernelCompileRegistry:
+    return _registry

@@ -570,6 +570,11 @@ class InternalEngine:
         with self._lock:
             self._ensure_open()
             if self._searcher is None:
+                for seg in self.segments:
+                    # ledger-owner attribution: a staged segment reports
+                    # who it belongs to in _nodes/stats `device`
+                    seg.index_name = self.index_name
+                    seg.shard_id = self.shard_id
                 self._searcher = ShardSearcher(
                     list(self.segments), self.mapper,
                     index_name=self.index_name, shard_id=self.shard_id)

@@ -176,6 +176,15 @@ class Segment:
         self.nested: dict[str, NestedBlock] = {}
         self.live = np.ones(n_docs, dtype=bool)
         self._device: Optional["DeviceSegment"] = None
+        # set True when the device-memory budget unstaged this segment
+        # (common/device_ledger.py): scored term-bags then score on the
+        # host impact tables byte-identically; anything else restages
+        # on demand (counted in device.restages)
+        self._device_evicted = False
+        # ledger-owner attribution, tagged by the owning engine when a
+        # searcher is acquired (bench/tests may leave the defaults)
+        self.index_name = "-"
+        self.shard_id = 0
         # trained ANN structures, lazily built per (field, method) — the
         # segment is immutable so one training pass serves every query
         # (the k-NN plugin trains at graph-build/flush time; ref
@@ -280,6 +289,14 @@ class Segment:
 
     def device(self) -> "DeviceSegment":
         if self._device is None:
+            if self._device_evicted:
+                # demand paging's fault path: a budget-evicted segment
+                # is being staged again (a plan without a host fallback
+                # needs the device arrays back)
+                from opensearch_tpu.common.device_ledger import \
+                    device_ledger
+                device_ledger().record_restage()
+                self._device_evicted = False
             self._device = DeviceSegment(self)
         return self._device
 
@@ -294,42 +311,59 @@ class DeviceSegment:
 
     def __init__(self, seg: Segment):
         import opensearch_tpu.common.jaxenv  # noqa: F401
-        import jax.numpy as jnp
 
         self.seg = seg
         self.n_docs = seg.n_docs
         self.n_pad = pad_pow2(seg.n_docs + 1)
         n_pad = self.n_pad
-        # HBM budget: estimate the staged footprint from the HOST arrays
-        # (padding roughly doubles worst-case; x2 covers it) and charge
-        # the fielddata breaker BEFORE any device allocation — an
-        # oversized staging is rejected as 429, not an OOM
-        # (FileCache/fielddata-breaker analog)
+        # HBM budget: the breaker estimate comes from the ONE footprint
+        # source of truth (device_ledger.host_footprint; padding roughly
+        # doubles worst-case, x2 covers it), charged BEFORE any device
+        # allocation — an oversized staging is rejected as 429, not an
+        # OOM (FileCache/fielddata-breaker analog)
         from opensearch_tpu.common.breakers import breaker_service
-        est = 0
-        for pf in seg.postings.values():
-            est += (pf.doc_ids.nbytes + pf.tfs.nbytes + pf.offsets.nbytes
-                    + pf.doc_lens.nbytes + pf.positions.nbytes
-                    + pf.pos_offsets.nbytes)
-        for dv in seg.numeric_dv.values():
-            est += dv.values.nbytes + dv.minv.nbytes + dv.maxv.nbytes
-        for dv in seg.ordinal_dv.values():
-            est += dv.ords.nbytes + dv.min_ord.nbytes + dv.max_ord.nbytes
-        for dv in seg.vector_dv.values():
-            est += dv.values.nbytes
-        for dv in seg.geo_dv.values():
-            est += dv.lats.nbytes + dv.lons.nbytes
-        self._breaker_bytes = est * 2
+        from opensearch_tpu.common.device_ledger import (device_ledger,
+                                                         host_footprint)
+        self._breaker_bytes = host_footprint(seg) * 2
         breaker = breaker_service().fielddata
         breaker.add_estimate(self._breaker_bytes,
                              label=f"segment [{seg.seg_id}] staging")
         import weakref
-        weakref.finalize(self, breaker.release, self._breaker_bytes)
+        # idempotent release handle: fires on GC, or EARLY when the
+        # device-memory budget unstages this segment (finalize runs once)
+        self._breaker_fin = weakref.finalize(self, breaker.release,
+                                             self._breaker_bytes)
+        # residency ledger: every staged array below is recorded under
+        # this group (owner = index/shard/segment); the evict callback
+        # is how `device.memory.budget_bytes` unstages us — the Segment
+        # flips to its host fallback and the breaker charge releases
+        led = self._ledger = device_ledger()
+        seg_ref = weakref.ref(seg)
+        dseg_ref = weakref.ref(self)
+
+        def _unstage():
+            s = seg_ref()
+            d = dseg_ref()
+            if s is not None and (d is None or s._device is d):
+                s._device = None
+                s._device_evicted = True
+            if d is not None:
+                d._breaker_fin()
+
+        group = self._ledger_group = led.open_group(
+            index=getattr(seg, "index_name", "-"),
+            shard=getattr(seg, "shard_id", 0),
+            segment=seg.seg_id, evict=_unstage)
+        led.tether(self, group)
 
         def pad1(a: np.ndarray, size: int, fill) -> np.ndarray:
             out = np.full(size, fill, dtype=a.dtype)
             out[: len(a)] = a
             return out
+
+        def stage(arr, kind, field, name):
+            return led.stage(group, arr, kind=kind, field=field,
+                             name=name)
 
         self.postings: dict[str, dict] = {}
         for name, pf in seg.postings.items():
@@ -340,39 +374,64 @@ class DeviceSegment:
             t_pad = pad_pow2(len(pf.offsets))
             pos_pad = pad_pow2(len(pf.positions))
             self.postings[name] = {
-                "offsets": jnp.asarray(pad1(pf.offsets, t_pad, pf.offsets[-1])),
-                "doc_ids": jnp.asarray(pad1(pf.doc_ids, p_pad, self.n_docs)),
-                "tfs": jnp.asarray(pad1(pf.tfs, p_pad, 0.0)),
-                "doc_lens": jnp.asarray(pad1(pf.doc_lens, n_pad, 1.0)),
+                "offsets": stage(pad1(pf.offsets, t_pad, pf.offsets[-1]),
+                                 "postings", name, "offsets"),
+                "doc_ids": stage(pad1(pf.doc_ids, p_pad, self.n_docs),
+                                 "postings", name, "doc_ids"),
+                "tfs": stage(pad1(pf.tfs, p_pad, 0.0),
+                             "postings", name, "tfs"),
+                "doc_lens": stage(pad1(pf.doc_lens, n_pad, 1.0),
+                                  "postings", name, "doc_lens"),
                 # positions CSR for phrase matching (pos_offsets is per
                 # posting entry, so a term's positions are one contiguous
                 # slice of ``positions``).
-                "pos_offsets": jnp.asarray(
+                "pos_offsets": stage(
                     pad1(pf.pos_offsets, pad_pow2(len(pf.pos_offsets)),
-                         pf.pos_offsets[-1] if len(pf.pos_offsets) else 0)),
-                "positions": jnp.asarray(pad1(pf.positions, pos_pad, 0)),
-                "field_exists": jnp.asarray(pad1(pf.present, n_pad, False)),
+                         pf.pos_offsets[-1] if len(pf.pos_offsets) else 0),
+                    "postings", name, "pos_offsets"),
+                "positions": stage(pad1(pf.positions, pos_pad, 0),
+                                   "postings", name, "positions"),
+                "field_exists": stage(pad1(pf.present, n_pad, False),
+                                      "postings", name, "field_exists"),
             }
         self.numeric: dict[str, dict] = {}
         for name, dv in seg.numeric_dv.items():
             v_pad = pad_pow2(len(dv.values))
             vals = dv.values
             self.numeric[name] = {
-                "values": jnp.asarray(pad1(vals, v_pad, 0)),
-                "value_docs": jnp.asarray(pad1(dv.value_docs, v_pad, self.n_docs)),
-                "minv": jnp.asarray(pad1(dv.minv, n_pad, LONG_MISSING_MAX if dv.kind == "long" else np.inf)),
-                "maxv": jnp.asarray(pad1(dv.maxv, n_pad, LONG_MISSING_MIN if dv.kind == "long" else -np.inf)),
-                "exists": jnp.asarray(pad1(dv.exists, n_pad, False)),
+                "values": stage(pad1(vals, v_pad, 0),
+                                "numeric", name, "values"),
+                "value_docs": stage(
+                    pad1(dv.value_docs, v_pad, self.n_docs),
+                    "numeric", name, "value_docs"),
+                "minv": stage(
+                    pad1(dv.minv, n_pad,
+                         LONG_MISSING_MAX if dv.kind == "long"
+                         else np.inf),
+                    "numeric", name, "minv"),
+                "maxv": stage(
+                    pad1(dv.maxv, n_pad,
+                         LONG_MISSING_MIN if dv.kind == "long"
+                         else -np.inf),
+                    "numeric", name, "maxv"),
+                "exists": stage(pad1(dv.exists, n_pad, False),
+                                "numeric", name, "exists"),
             }
         self.ordinal: dict[str, dict] = {}
         for name, dv in seg.ordinal_dv.items():
             v_pad = pad_pow2(len(dv.ords))
             self.ordinal[name] = {
-                "ords": jnp.asarray(pad1(dv.ords, v_pad, -1)),
-                "value_docs": jnp.asarray(pad1(dv.value_docs, v_pad, self.n_docs)),
-                "min_ord": jnp.asarray(pad1(dv.min_ord, n_pad, -1)),
-                "max_ord": jnp.asarray(pad1(dv.max_ord, n_pad, -1)),
-                "exists": jnp.asarray(pad1(dv.exists, n_pad, False)),
+                "ords": stage(pad1(dv.ords, v_pad, -1),
+                              "ordinal", name, "ords"),
+                "value_docs": stage(
+                    pad1(dv.value_docs, v_pad, self.n_docs),
+                    "ordinal", name, "value_docs"),
+                "min_ord": stage(pad1(dv.min_ord, n_pad, -1),
+                                 "ordinal", name, "min_ord"),
+                "max_ord": stage(pad1(dv.max_ord, n_pad, -1),
+                                 "ordinal", name, "max_ord"),
+                "exists": stage(pad1(dv.exists, n_pad, False),
+                                "ordinal", name, "exists"),
                 "n_ords": len(dv.ord_terms),
             }
         self.vector: dict[str, dict] = {}
@@ -380,22 +439,32 @@ class DeviceSegment:
             vals = np.zeros((n_pad, dv.dim), dtype=np.float32)
             vals[: len(dv.values)] = dv.values
             self.vector[name] = {
-                "values": jnp.asarray(vals),
-                "exists": jnp.asarray(pad1(dv.exists, n_pad, False)),
+                "values": stage(vals, "vector", name, "values"),
+                "exists": stage(pad1(dv.exists, n_pad, False),
+                                "vector", name, "exists"),
             }
         self.geo: dict[str, dict] = {}
         for name, dv in seg.geo_dv.items():
             v_pad = pad_pow2(len(dv.lats))
             self.geo[name] = {
-                "lats": jnp.asarray(pad1(dv.lats, v_pad, 0.0)),
-                "lons": jnp.asarray(pad1(dv.lons, v_pad, 0.0)),
-                "value_docs": jnp.asarray(pad1(dv.value_docs, v_pad, self.n_docs)),
-                "exists": jnp.asarray(pad1(dv.exists, n_pad, False)),
+                "lats": stage(pad1(dv.lats, v_pad, 0.0),
+                              "geo", name, "lats"),
+                "lons": stage(pad1(dv.lons, v_pad, 0.0),
+                              "geo", name, "lons"),
+                "value_docs": stage(
+                    pad1(dv.value_docs, v_pad, self.n_docs),
+                    "geo", name, "value_docs"),
+                "exists": stage(pad1(dv.exists, n_pad, False),
+                                "geo", name, "exists"),
             }
         # bounded-cache: one staged copy per live-bitmap version, freed
         self._live_cache: dict[int, object] = {}  # with its PIT searcher
         self._ann_staged: dict[int, tuple] = {}
         self.live = self.live_jnp(seg.live)
+        # fully staged: from here on the group is a budget-eviction
+        # candidate (lazily staged impacts/live/nested entries keep
+        # accruing into it)
+        led.seal(group)
 
     def impacts(self, field: str, avgdl: float):
         """Staged per-posting BM25 impact column for ``field``, indexed
@@ -423,14 +492,14 @@ class DeviceSegment:
                 host_imp, _mx = self.seg.impact_table(field, avgdl)
                 padded = np.zeros(p["tfs"].shape[0], np.float32)
                 padded[: len(host_imp)] = host_imp
-                imp = jnp.asarray(padded)
+                imp = self._ledger.stage(
+                    self._ledger_group, padded, kind="impacts",
+                    field=field, name=f"avgdl={key[1]:.6g}")
             cache.put(key, imp)
         return imp
 
     def nested_staged(self, path: str) -> Optional[dict]:
         """Padded device arrays for one nested block (lazy, cached)."""
-        import jax.numpy as jnp
-
         cache = getattr(self, "_nested_cache", None)
         if cache is None:
             # bounded-cache: at most one entry per nested mapping path
@@ -442,33 +511,37 @@ class DeviceSegment:
             cache[path] = None
             return None
 
-        def pad1(a, size, fill):
+        def pad1(a, size, fill, name=""):
             out = np.full(size, fill, dtype=a.dtype)
             out[: len(a)] = a
-            return jnp.asarray(out)
+            return self._ledger.stage(self._ledger_group, out,
+                                      kind="nested", field=path,
+                                      name=name)
 
         n_obj_pad = pad_pow2(block.n_objs + 1)
         staged = {
             "n_obj_pad": n_obj_pad,
             # padding objects belong to the parent dead slot
             "obj_to_doc": pad1(block.obj_to_doc, n_obj_pad,
-                               self.n_pad - 1),
+                               self.n_pad - 1, "obj_to_doc"),
             "obj_valid": pad1(np.ones(block.n_objs, bool), n_obj_pad,
-                              False),
+                              False, "obj_valid"),
             "numeric": {}, "ordinal": {},
         }
         for f, (values, value_objs) in block.numeric.items():
             v_pad = pad_pow2(len(values))
             staged["numeric"][f] = {
-                "values": pad1(values, v_pad, 0.0),
-                "value_objs": pad1(value_objs, v_pad, n_obj_pad - 1),
+                "values": pad1(values, v_pad, 0.0, f"{f}/values"),
+                "value_objs": pad1(value_objs, v_pad, n_obj_pad - 1,
+                                   f"{f}/value_objs"),
                 "v_pad": v_pad,
             }
         for f, (ord_terms, ords, value_objs) in block.ordinal.items():
             v_pad = pad_pow2(len(ords))
             staged["ordinal"][f] = {
-                "ords": pad1(ords, v_pad, -1),
-                "value_objs": pad1(value_objs, v_pad, n_obj_pad - 1),
+                "ords": pad1(ords, v_pad, -1, f"{f}/ords"),
+                "value_objs": pad1(value_objs, v_pad, n_obj_pad - 1,
+                                   f"{f}/value_objs"),
                 "v_pad": v_pad,
             }
         cache[path] = staged
@@ -482,8 +555,15 @@ class DeviceSegment:
         if cached is None or cached[0] is not idx:
             cached = (idx, idx.device())
             if len(self._ann_staged) >= 4:
-                self._ann_staged.pop(next(iter(self._ann_staged)))
+                old = next(iter(self._ann_staged))
+                self._ann_staged.pop(old)
+                self._ledger.drop(self._ledger_group, kind="ann",
+                                  name=str(old))
             self._ann_staged[key] = cached
+            # ANN builders stage their own arrays (ops/ivf.py); the
+            # ledger adopts the accounting so residency stays exact
+            self._ledger.adopt(self._ledger_group, cached[1],
+                               kind="ann", name=str(key))
         return cached[1]
 
     def live_jnp(self, live_np: np.ndarray):
@@ -492,16 +572,19 @@ class DeviceSegment:
         snapshots keep resolving to their own staged copy).  The cache
         holds a strong reference to the keyed numpy array: id() keys are
         only valid while the object is alive."""
-        import jax.numpy as jnp
-
         key = id(live_np)
         cached = self._live_cache.get(key)
         if cached is None or cached[0] is not live_np:
             padded = np.zeros(self.n_pad, dtype=bool)
             padded[: len(live_np)] = live_np
-            cached = (live_np, jnp.asarray(padded))
+            cached = (live_np,
+                      self._ledger.stage(self._ledger_group, padded,
+                                         kind="live", name=str(key)))
             if len(self._live_cache) >= 4:
-                self._live_cache.pop(next(iter(self._live_cache)))
+                old = next(iter(self._live_cache))
+                self._live_cache.pop(old)
+                self._ledger.drop(self._ledger_group, kind="live",
+                                  name=str(old))
             self._live_cache[key] = cached
         return cached[1]
 

@@ -163,6 +163,11 @@ class Node:
         ins_coalesce = Setting.float_setting(
             "search.insights.coalesce_window_ms", 10.0,
             min_value=0.0, dynamic=True)
+        # measured device-memory budget: 0 = unlimited; exceeding it
+        # unstages least-recently-dispatched segments (ROADMAP item 5's
+        # host↔device paging seed, common/device_ledger.py)
+        device_budget = Setting.byte_size_setting(
+            "device.memory.budget_bytes", 0, dynamic=True)
         from opensearch_tpu.indices.request_cache import (
             DEFAULT_MAX_BYTES, request_cache)
         req_cache_size = Setting.byte_size_setting(
@@ -176,7 +181,15 @@ class Node:
              ars_enabled, ars_shed, ars_spill, ars_shed_occ,
              max_keep_alive, default_keep_alive, allow_partial,
              req_cache_size, ins_enabled, ins_top_n, ins_window,
-             ins_coalesce])
+             ins_coalesce, device_budget])
+        # device-memory budget reaches the residency ledger immediately
+        # (and persisted values replay at boot)
+        from opensearch_tpu.common.device_ledger import device_ledger
+        self.cluster_settings.add_settings_update_consumer(
+            device_budget,
+            lambda v: device_ledger().set_budget(int(v or 0)))
+        device_ledger().set_budget(
+            int(self.cluster_settings.get(device_budget) or 0))
         # query-insights knobs reach the live service immediately and
         # persisted values replay at boot
         ins = self.insights

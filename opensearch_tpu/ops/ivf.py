@@ -69,9 +69,9 @@ def train_kmeans(vectors: np.ndarray, valid: np.ndarray, n_clusters: int,
         raise ValueError("no valid vectors to train on")
     pick = rng.choice(valid_idx, size=n_clusters,
                       replace=len(valid_idx) < n_clusters)
-    centroids = jnp.asarray(vectors[pick], jnp.float32)
-    v = jnp.asarray(vectors, jnp.float32)
-    m = jnp.asarray(valid, bool)
+    centroids = jnp.asarray(vectors[pick], jnp.float32)  # staging-ok: adopted by DeviceSegment.ann_staged
+    v = jnp.asarray(vectors, jnp.float32)  # staging-ok: adopted by DeviceSegment.ann_staged
+    m = jnp.asarray(valid, bool)  # staging-ok: adopted by DeviceSegment.ann_staged
     assign = None
     for _ in range(iters):
         centroids, assign = _kmeans_step(v, m, centroids,
@@ -117,9 +117,9 @@ class IvfIndex:
                         nlist=nlist, c_pad=c_pad)
 
     def device(self):
-        return (jnp.asarray(self.centroids), jnp.asarray(self.grouped),
-                jnp.asarray(self.grouped_ids),
-                jnp.asarray(self.grouped_valid))
+        return (jnp.asarray(self.centroids), jnp.asarray(self.grouped),  # staging-ok: adopted by DeviceSegment.ann_staged
+                jnp.asarray(self.grouped_ids),  # staging-ok: adopted by DeviceSegment.ann_staged
+                jnp.asarray(self.grouped_valid))  # staging-ok: adopted by DeviceSegment.ann_staged
 
 
 def _space_scores(dots, v2, q, space: str):
@@ -227,10 +227,10 @@ class IvfPqIndex:
             nlist=nlist, c_pad=c_pad, m=m, dsub=dsub)
 
     def device(self):
-        return (jnp.asarray(self.centroids), jnp.asarray(self.codebooks),
-                jnp.asarray(self.grouped_codes),
-                jnp.asarray(self.grouped_ids),
-                jnp.asarray(self.grouped_valid))
+        return (jnp.asarray(self.centroids), jnp.asarray(self.codebooks),  # staging-ok: adopted by DeviceSegment.ann_staged
+                jnp.asarray(self.grouped_codes),  # staging-ok: adopted by DeviceSegment.ann_staged
+                jnp.asarray(self.grouped_ids),  # staging-ok: adopted by DeviceSegment.ann_staged
+                jnp.asarray(self.grouped_valid))  # staging-ok: adopted by DeviceSegment.ann_staged
 
 
 @partial(jax.jit, static_argnames=("k", "nprobe"))

@@ -75,8 +75,16 @@ def stack_shards(shard_list: list[dict]) -> dict:
 
 
 def put_on_mesh(stacked: dict, mesh: Mesh, axis: str = "shards") -> dict:
+    """Place shard-stacked host arrays on the mesh.  Routed through the
+    device ledger so the H2D transfer is byte-accounted (these are
+    per-query inputs, not resident state — the resident mesh copies are
+    the DeviceSegments MeshSearcher stages per device)."""
+    from opensearch_tpu.common.device_ledger import device_ledger
+
+    led = device_ledger()
     sharding = NamedSharding(mesh, P(axis))
-    return {k: jax.device_put(v, sharding) for k, v in stacked.items()}
+    return {k: led.device_put(None, v, sharding, kind="mesh", name=k)
+            for k, v in stacked.items()}
 
 
 def prepare_match_query(segments: list, field: str, terms: list[str]):
@@ -265,7 +273,7 @@ class MeshSearcher:
         reqs = parse_aggs(aggs_json)
         q = parse_query(body.get("query"))
         S = len(self.shards)
-        neg_inf = jnp.asarray(np.float32(-np.inf))
+        neg_inf = jnp.asarray(np.float32(-np.inf))  # staging-ok: scalar
         # phase 1: per-shard on-device partials, async-dispatched
         per_agg_parts: dict[str, list] = {r.name: [] for r in reqs}
         for si, shard in enumerate(self.shards):
@@ -308,10 +316,10 @@ class MeshSearcher:
                     # float64 partials: epoch-millis longs and >2^24
                     # counts must survive the collective bit-exact
                     per_agg_parts[r.name].append(jnp.stack(
-                        [jnp.asarray(s_, jnp.float64),
-                         jnp.asarray(c_, jnp.float64),
-                         jnp.asarray(mn_, jnp.float64),
-                         jnp.asarray(mx_, jnp.float64),
+                        [jnp.asarray(s_, jnp.float64),   # staging-ok: on-device scalars
+                         jnp.asarray(c_, jnp.float64),   # staging-ok: on-device scalars
+                         jnp.asarray(mn_, jnp.float64),  # staging-ok: on-device scalars
+                         jnp.asarray(mx_, jnp.float64),  # staging-ok: on-device scalars
                          total]).reshape(1, 5))
         # phase 2: ONE collective per agg over ICI
         sharding = NamedSharding(self.mesh, P(self.axis))
@@ -421,11 +429,17 @@ class MeshSearcher:
         fv, fi = merge(vals_g)
 
         # Phase 3: host-side fetch of the k winners (first host sync)
+        from opensearch_tpu.common.device_ledger import device_ledger
+        t_sync = _time.monotonic()
         fv = np.asarray(fv)
         fi = np.asarray(fi)
         rows_np = [(np.asarray(s_), np.asarray(l_))
                    for s_, l_ in shard_rows]
         total = int(sum(int(t) for t in totals))
+        device_ledger().record_fetch(
+            fv.nbytes + fi.nbytes
+            + sum(s_.nbytes + l_.nbytes for s_, l_ in rows_np),
+            _time.monotonic() - t_sync)
 
         hits = []
         source_spec = body.get("_source")

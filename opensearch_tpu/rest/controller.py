@@ -70,6 +70,17 @@ def _os_stats() -> dict:
             "mem": {"total_in_bytes": total, "free_in_bytes": free}}
 
 
+def _device_stats() -> dict:
+    """The ``device`` section of ``_nodes/stats``: the residency
+    ledger's rollups (common/device_ledger.py) — resident bytes per
+    index, host↔device transfer counters split stage vs fetch-back,
+    budget/eviction/restage accounting, and the per-kernel XLA compile
+    registry, next to the jax backend's own ``memory_stats()`` where
+    the platform provides it."""
+    from opensearch_tpu.common.device_ledger import device_ledger
+    return device_ledger().stats()
+
+
 def _process_stats() -> dict:
     """ProcessProbe analog: CURRENT rss from /proc statm (linux), peak
     rss from getrusage (kbytes on linux, bytes on darwin)."""
@@ -631,13 +642,23 @@ class RestController:
         }
 
     def h_cluster_stats(self, req):
+        from opensearch_tpu.common.device_ledger import device_ledger
         indices = self.node.indices.indices
+        dev = device_ledger().stats()
         return 200, {
             "cluster_name": self.node.cluster_name,
             "indices": {"count": len(indices),
                         "docs": {"count": sum(s.doc_count()
                                               for s in indices.values())}},
             "nodes": {"count": {"total": 1, "data": 1}},
+            # compact device-residency rollup (full detail per node in
+            # _nodes/stats `device`)
+            "device": {
+                "resident_bytes": dev["resident_bytes"],
+                "resident_segments": dev["resident_segments"],
+                "budget_bytes": dev["budget"]["budget_bytes"],
+                "evictions": dev["budget"]["evictions"],
+            },
         }
 
     def h_nodes_info(self, req):
@@ -703,6 +724,12 @@ class RestController:
                 # cardinality, and the coalescability fraction (full
                 # detail at GET /_insights/top_queries)
                 "query_insights": self.node.insights.stats(),
+                # device residency + transfer observability: ledger
+                # rollups per index, stage/fetch transfer counters, the
+                # device.memory.budget_bytes eviction accounting, the
+                # per-kernel compile registry, and the backend's own
+                # memory_stats() where the platform provides it
+                "device": _device_stats(),
                 # recovery observability: the recovery.* metric family
                 # (incl. PR 8's corrupt-blob re-requests) + per-shard
                 # store state, the JSON face of GET /_cat/recovery
@@ -766,11 +793,15 @@ class RestController:
         the query-insights per-signature series (signature is always a
         LABEL drawn from the bounded top-N path, never a metric name).
         The same underlying data ``_nodes/stats`` serves as JSON."""
+        from opensearch_tpu.common.device_ledger import device_ledger
         from opensearch_tpu.common.telemetry import metrics
         text = metrics().prometheus_text()
         insights = getattr(self.node, "insights", None)
         if insights is not None:
             text += insights.prometheus_text()
+        # device residency gauges (transfer/eviction counters already
+        # flow through the MetricsRegistry exposition above)
+        text += device_ledger().prometheus_text()
         return 200, PlainText(
             text,
             content_type="text/plain; version=0.0.4; charset=utf-8")
@@ -2418,6 +2449,14 @@ class RestController:
                      for n, t in sorted(self.node.indices.templates.items())]
 
     def h_cat_segments(self, req):
+        """Per-segment rows with HOST and DEVICE footprints: ``size``
+        is the host-side array footprint (device_ledger.host_footprint,
+        the one source of truth) and ``size.device`` the bytes the
+        residency ledger currently holds staged for the segment (0 when
+        it is host-only or was budget-evicted)."""
+        from opensearch_tpu.common.device_ledger import (device_ledger,
+                                                         host_footprint)
+        led = device_ledger()
         rows = []
         for name, svc in sorted(self.node.indices.indices.items()):
             for shard_id, engine in sorted(svc.local_shards.items()):
@@ -2426,7 +2465,10 @@ class RestController:
                                  "segment": seg.seg_id,
                                  "docs.count": str(seg.live_count()),
                                  "docs.deleted": str(
-                                     seg.n_docs - seg.live_count())})
+                                     seg.n_docs - seg.live_count()),
+                                 "size": str(host_footprint(seg)),
+                                 "size.device": str(
+                                     led.device_footprint(seg))})
         return 200, rows
 
     def h_cat_recovery(self, req):
@@ -2512,15 +2554,21 @@ class RestController:
                       "host": self.node.host, "ip": self.node.host}]
 
     def h_cat_fielddata(self, req):
+        """Per-field doc-value footprint from the ONE footprint source
+        of truth (device_ledger.host_footprint) instead of ad-hoc
+        ``nbytes`` math picking an arbitrary subset of the arrays."""
+        from opensearch_tpu.common.device_ledger import host_footprint
         rows = []
         for name, svc in sorted(self.node.indices.indices.items()):
             for engine in svc.shards:
                 for seg in engine.segments:
-                    for field, dv in sorted(seg.ordinal_dv.items()):
+                    per = host_footprint(seg, per_field=True)
+                    for (kind, field), nbytes in sorted(per.items()):
+                        if kind != "ordinal":
+                            continue
                         rows.append({
                             "node": self.node.name, "field": field,
-                            "size": str(dv.ords.nbytes
-                                        + dv.value_docs.nbytes)})
+                            "size": str(nbytes)})
         return 200, rows
 
     # -- task management ---------------------------------------------------

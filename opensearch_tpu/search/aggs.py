@@ -1243,7 +1243,7 @@ class AggregationExecutor:
                                 np.full(n_buckets, np.inf),
                                 np.full(n_buckets, -np.inf)]
                      for sub in msubs}
-        edges_j = jnp.asarray(edges)
+        edges_j = jnp.asarray(edges)  # staging-ok: per-request agg input
         for seg, dseg, matched in seg_views:
             col = self._dev_numeric(dseg, field)
             if col is None:
@@ -1301,7 +1301,7 @@ class AggregationExecutor:
         plan, bind = compile_query(parse_query(query_json), self.ctx,
                                    scored=False)
         needed = plan.arrays()
-        neg_inf = jnp.asarray(np.float32(-np.inf))
+        neg_inf = jnp.asarray(np.float32(-np.inf))  # staging-ok: per-request agg input
 
         def mask_fn(seg, dseg):
             A = build_arrays(dseg, needed, self.ctx.mapper,
@@ -1347,7 +1347,7 @@ class AggregationExecutor:
         plan, bind = compile_query(ExistsQuery(field=field), self.ctx,
                                    scored=False)
         needed = plan.arrays()
-        neg_inf = jnp.asarray(np.float32(-np.inf))
+        neg_inf = jnp.asarray(np.float32(-np.inf))  # staging-ok: per-request agg input
 
         def mask_fn(seg, dseg):
             A = build_arrays(dseg, needed, self.ctx.mapper,

@@ -55,6 +55,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from opensearch_tpu.common.device_ledger import \
+    device_ledger as _device_ledger
 from opensearch_tpu.common.telemetry import metrics as _metrics
 from opensearch_tpu.index.segment import pad_bucket, pad_pow2
 from opensearch_tpu.ops import bm25 as bm25_ops
@@ -140,7 +142,16 @@ class BatchGroup:
     def _prepare(self, searcher) -> dict:
         """Host-side assembly of the per-segment union/query inputs —
         everything that does NOT depend on the live bitmap, staged once
-        and reused for every identical batch against this searcher."""
+        and reused for every identical batch against this searcher.
+        All stagings are ledger-recorded under one ``batch_group``
+        owner whose lifetime follows this prep's cache entry."""
+        from opensearch_tpu.common.device_ledger import (GroupCloser,
+                                                         device_ledger)
+
+        led = device_ledger()
+        group = led.open_group(index=searcher.index_name,
+                               shard=searcher.shard_id,
+                               segment=f"msearch[{self.field},{self.k}]")
         Q = len(self.positions)
         q_pad = pad_pow2(Q, minimum=8)
         tq = pad_pow2(max((len(t) for t in self.terms), default=1),
@@ -150,7 +161,8 @@ class BatchGroup:
             or any((i <= 0).any() for i in self.idfs)
         req = np.full(q_pad, np.inf, _F32)   # padding rows match nothing
         req[:Q] = self.required
-        req_j = jnp.asarray(req)
+        req_j = led.stage(group, req, kind="batch_group",
+                          field=self.field, name="required")
         segs = []
         pruned = 0
         for seg_order, seg in enumerate(searcher.segments):
@@ -193,19 +205,37 @@ class BatchGroup:
                     qweights[qi, j] = self.weights[qi][ti]
                     qact[qi, j] = 1.0   # occurrences: duplicate terms
                     j += 1              # keep satisfying AND
+            sid = seg.seg_id
             segs.append((seg_order, {
-                "union_tids": jnp.asarray(union_tids),
-                "union_active": jnp.asarray(union_active),
-                "union_idfs": jnp.asarray(union_idfs),
-                "qslots": jnp.asarray(qslots),
-                "qweights": jnp.asarray(qweights),
-                "qact": jnp.asarray(qact),
+                "union_tids": led.stage(group, union_tids,
+                                        kind="batch_group",
+                                        field=self.field,
+                                        name=f"{sid}/union_tids"),
+                "union_active": led.stage(group, union_active,
+                                          kind="batch_group",
+                                          field=self.field,
+                                          name=f"{sid}/union_active"),
+                "union_idfs": led.stage(group, union_idfs,
+                                        kind="batch_group",
+                                        field=self.field,
+                                        name=f"{sid}/union_idfs"),
+                "qslots": led.stage(group, qslots, kind="batch_group",
+                                    field=self.field,
+                                    name=f"{sid}/qslots"),
+                "qweights": led.stage(group, qweights,
+                                      kind="batch_group",
+                                      field=self.field,
+                                      name=f"{sid}/qweights"),
+                "qact": led.stage(group, qact, kind="batch_group",
+                                  field=self.field, name=f"{sid}/qact"),
                 "budget": pad_bucket(budget),
             }))
         if pruned:
             _metrics().counter("search.segments_pruned").inc(pruned)
+        led.seal(group)
         return {"need_counts": need_counts, "required": req_j,
-                "segs": segs, "q_pad": q_pad}
+                "segs": segs, "q_pad": q_pad,
+                "_ledger": GroupCloser(led, group)}
 
     def _bind(self, qi: int) -> dict:
         return {"terms": self.terms[qi], "idfs": self.idfs[qi],
@@ -345,12 +375,20 @@ class BatchGroup:
                 n_pad=dseg.n_pad, budget=sp["budget"], k=kk,
                 need_counts=prep["need_counts"])
             launches.append((seg_order, vals, idx, tot, mx))
+            _device_ledger().record_dispatch(
+                getattr(dseg, "_ledger_group", None))
             if prof is not None:
                 prof.seg_scanned(seg.seg_id, time.monotonic() - t_seg)
         # ONE host sync region: convert whole launches after the dispatch loop
-        t_red = time.monotonic() if prof is not None else 0.0
+        t_sync = time.monotonic()
+        t_red = t_sync if prof is not None else 0.0
         synced = [(so, np.asarray(v), np.asarray(i), np.asarray(t),
                    np.asarray(m)) for so, v, i, t, m in launches]
+        if synced:
+            _device_ledger().record_fetch(
+                sum(v.nbytes + i.nbytes + t.nbytes + m.nbytes
+                    for _so, v, i, t, m in synced),
+                time.monotonic() - t_sync)
         out = {}
         for qi, pos in enumerate(self.positions):
             rows_v, rows_s, rows_l = [], [], []

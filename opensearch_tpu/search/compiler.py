@@ -511,7 +511,7 @@ def _host_run_scored(ctx, q):
 
     plan, bind = compile_query(q, ctx, scored=True)
     needed = plan.arrays()
-    neg_inf = jnp.asarray(np.float32(-np.inf))
+    neg_inf = jnp.asarray(np.float32(-np.inf))  # staging-ok: per-query input
     out = []
     for seg in ctx.segments:
         dseg = seg.device()
@@ -819,7 +819,7 @@ def _c_knn(q, ctx, scored):
     if q.filter is not None:
         filter_state = compile_query(q.filter, ctx, scored=False)
 
-    qvec_j = jnp.asarray(qvec)
+    qvec_j = jnp.asarray(qvec)  # staging-ok: per-query input
     # phase 1: dispatch every segment's device program, keep DEVICE arrays
     pending = []             # (seg_order, vals_dev, idx_dev)
     for seg_order, seg in enumerate(ctx.segments):
@@ -835,7 +835,7 @@ def _c_knn(q, ctx, scored):
             A = build_arrays(dseg, fplan.arrays(), ctx.mapper)
             dims, ins = fplan.prepare(fbind, seg, dseg, ctx)
             _s, fmask = P.run_full(fplan, dims, A, ins,
-                                   jnp.asarray(np.float32(-np.inf)))
+                                   jnp.asarray(np.float32(-np.inf)))  # staging-ok: per-query input
             valid = valid & fmask
         kk = min(q.k, dseg.n_pad)
         ann = (seg.ann_index(q.field, method)

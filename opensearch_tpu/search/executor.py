@@ -228,9 +228,15 @@ def _min_score_scalar(min_score):
     global _MS_NEG_INF
     if min_score is None:
         if _MS_NEG_INF is None:
+            # staging-ok: one cached 4-byte scalar constant
             _MS_NEG_INF = jnp.asarray(np.float32(-np.inf))
         return _MS_NEG_INF
-    return jnp.asarray(np.float32(min_score))
+    return jnp.asarray(np.float32(min_score))  # staging-ok: 4-byte scalar
+
+
+def _ledger():
+    from opensearch_tpu.common.device_ledger import device_ledger
+    return device_ledger()
 
 
 class ShardSearcher:
@@ -458,6 +464,7 @@ class ShardSearcher:
         # (never per segment sync), drained by whatever edge installed
         # an insight sink — see search/insights.py emit()
         ia = {"plan_cache": "miss", "pruned": 0, "scanned": 0}
+        xfer0 = _ledger().transfer_snapshot()
         (plan, bind), ckey = self.compiled(q_json, scored=needs_scores,
                                            with_key=True, prof=prof,
                                            iattrs=ia)
@@ -539,6 +546,7 @@ class ShardSearcher:
             prof.add("fetch", time.monotonic() - t_fetch)
 
         took = int((time.monotonic() - t0) * 1000)
+        xfer1 = _ledger().transfer_snapshot()
         insights.emit(
             signature=ckey[0] if ckey is not None else None,
             scored=needs_scores,
@@ -551,6 +559,7 @@ class ShardSearcher:
                            is not None) else "device"),
             plan_cache=ia["plan_cache"],
             pruned=ia["pruned"], scanned=ia["scanned"],
+            transfer_bytes=(xfer1[0] - xfer0[0]) + (xfer1[1] - xfer0[1]),
             timed_out=deadline.timed_out)
         resp = {
             "took": took,
@@ -676,8 +685,13 @@ class ShardSearcher:
                     "field": g.field, "k": g.k,
                     "queries": len(g.positions),
                     "positions": list(g.positions)})
-            for pos, (rows, total, max_score) in \
-                    g.run(self, prof=gprof).items():
+            xfer0 = _ledger().transfer_snapshot()
+            g_out = g.run(self, prof=gprof)
+            xfer1 = _ledger().transfer_snapshot()
+            # ONE batched pass served the whole group: its transfer
+            # bytes are shared group attribution, like last_stats
+            g_xfer = (xfer1[0] - xfer0[0]) + (xfer1[1] - xfer0[1])
+            for pos, (rows, total, max_score) in g_out.items():
                 body = bodies[pos] or {}
                 t_fetch = time.monotonic() if gprof is not None else 0.0
                 hits = self._hits_from_rows(rows, body.get("_source"))
@@ -710,6 +724,7 @@ class ShardSearcher:
                     plan_cache="batched",
                     pruned=g.last_stats["pruned"],
                     scanned=g.last_stats["scanned"],
+                    transfer_bytes=g_xfer,
                     batched=len(g.positions))
                 if gprof is not None and body.get("profile"):
                     results[pos]["profile"] = {"shards": [
@@ -807,6 +822,8 @@ class ShardSearcher:
                 dims, ins = self._prepared(plan, bind, seg, dseg, ckey,
                                            prof=prof)
                 scores, matched = P.run_full(plan, dims, A, ins, ms)
+            _ledger().record_dispatch(
+                getattr(dseg, "_ledger_group", None))
             if iattrs is not None:
                 iattrs["scanned"] += 1
             if prof is not None:
@@ -876,9 +893,9 @@ class ShardSearcher:
         ms_host = None if min_score is None else float(min_score)
         # CPU-backend fast path: scored term bags run host-side over the
         # precomputed impact tables (see ops/bm25.py host_scoring_enabled)
-        host_fast = (bm25_ops.host_scoring_enabled()
-                     and getattr(plan, "scored", False)
-                     and getattr(plan, "host_topk", None) is not None)
+        host_capable = (getattr(plan, "scored", False)
+                        and getattr(plan, "host_topk", None) is not None)
+        host_fast = bm25_ops.host_scoring_enabled() and host_capable
         if iattrs is not None:
             iattrs["execution_path"] = "host" if host_fast else "device"
         if prof is not None:
@@ -932,7 +949,17 @@ class ShardSearcher:
                     "segment.dispatch",
                     {"segment": seg.seg_id, "index": self.index_name,
                      "shard": self.shard_id}):
-                if host_fast:
+                # budget-evicted segments degrade to the SAME host
+                # impact-table scoring the CPU fast path uses — byte-
+                # identical to the device kernel (the PR-5 invariant),
+                # so eviction never changes results, only where they
+                # are computed (device_ledger host↔device paging seed)
+                use_host = host_fast or (
+                    host_capable
+                    and getattr(seg, "_device_evicted", False))
+                if use_host:
+                    if not host_fast:
+                        _ledger().record_host_fallback()
                     vals, idx, tot, mx = plan.host_topk(
                         bind, seg, self.ctx.lives[id(seg)],
                         min(k_want, seg.n_docs), min_score)
@@ -946,6 +973,8 @@ class ShardSearcher:
                     k = min(k_want, dseg.n_pad)
                     launched.append([si, *P.run_topk(plan, dims, k, A,
                                                      ins, ms), None])
+                    _ledger().record_dispatch(
+                        getattr(dseg, "_ledger_group", None))
             if iattrs is not None:
                 iattrs["scanned"] += 1
             if prof is not None:
@@ -956,18 +985,28 @@ class ShardSearcher:
                     and si + 1 < len(self.segments):
                 kth = self._harvest_kth(launched, k_want, kth)
         # phase 2: ONE host-sync region over all segments' results
-        t_red = time.monotonic() if prof is not None else 0.0
+        t_sync = time.monotonic()
+        t_red = t_sync if prof is not None else 0.0
         per_seg = []
         total = 0
         max_score = -np.inf
+        fetched_bytes = 0
         for si, vals, idx, tot, mx, synced in launched:
-            vals = synced if synced is not None else np.asarray(vals)
-            idx = np.asarray(idx)
+            if synced is None:                 # device result: D2H fetch
+                vals = np.asarray(vals)
+                idx = np.asarray(idx)
+                fetched_bytes += vals.nbytes + idx.nbytes + 16
+            else:
+                vals = synced
+                idx = np.asarray(idx)
             keep = vals > -np.inf
             per_seg.append((vals[keep], np.full(int(keep.sum()), si, _I32),
                             idx[keep]))
             total += int(tot)
             max_score = max(max_score, float(mx))
+        if fetched_bytes:
+            _ledger().record_fetch(fetched_bytes,
+                                   time.monotonic() - t_sync)
         rows, total, max_score = self._merge_topk(per_seg, k_want, total,
                                                   max_score)
         if prof is not None:

@@ -151,7 +151,7 @@ class _SignatureRollup:
                  "coalesced", "paths", "outcomes", "plan_cache_hits",
                  "request_cache_hits", "request_cache_total", "pruned",
                  "scanned", "cpu_nanos", "heap_peak", "clients",
-                 "batched_members")
+                 "batched_members", "transfer_bytes")
 
     def __init__(self, signature: str, source: str, scored: bool,
                  now: float):
@@ -177,6 +177,9 @@ class _SignatureRollup:
         self.heap_peak = 0
         self.clients: dict[str, int] = {}
         self.batched_members = 0
+        # host↔device bytes (stage + fetch-back) the device ledger
+        # attributed to this signature's executions
+        self.transfer_bytes = 0
 
     def add(self, rec: dict, now: float, coalesce_window_s: float) -> None:
         self.count += 1
@@ -203,6 +206,7 @@ class _SignatureRollup:
                 self.request_cache_hits += 1
         self.pruned += int(rec.get("pruned") or 0)
         self.scanned += int(rec.get("scanned") or 0)
+        self.transfer_bytes += int(rec.get("transfer_bytes") or 0)
         self.cpu_nanos += int(rec.get("cpu_nanos") or 0)
         self.heap_peak = max(self.heap_peak,
                              int(rec.get("heap_bytes") or 0))
@@ -244,6 +248,7 @@ class _SignatureRollup:
             "cpu_time_in_nanos": self.cpu_nanos,
             "peak_heap_in_bytes": self.heap_peak,
             "batched_members": self.batched_members,
+            "device_transfer_bytes": self.transfer_bytes,
         }
         if self.request_cache_total:
             out["request_cache"] = {

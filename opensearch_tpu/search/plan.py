@@ -49,14 +49,14 @@ _F32 = np.float32
 
 
 def _scalar(x, dtype):
-    return jnp.asarray(np.asarray(x, dtype=dtype))
+    return jnp.asarray(np.asarray(x, dtype=dtype))  # staging-ok: per-query input (prep-cache owned)
 
 
 def _pad_np(arr, size, fill, dtype):
     out = np.full(size, fill, dtype=dtype)
     a = np.asarray(arr, dtype=dtype)
     out[: len(a)] = a
-    return jnp.asarray(out)
+    return jnp.asarray(out)  # staging-ok: per-query input (prep-cache owned)
 
 
 # ---------------------------------------------------------------------------
@@ -259,7 +259,7 @@ class TermBagPlan(Plan):
                 active[i] = True
                 budget += int(pf.df[tid])
         if not self.scored:
-            ins = (jnp.asarray(tids), jnp.asarray(active),
+            ins = (jnp.asarray(tids), jnp.asarray(active),  # staging-ok: per-query input (prep-cache owned)
                    _scalar(bind["required"], _I32))
             return (t_pad, pad_bucket(budget), False), ins
         idfs = np.asarray(bind["idfs"], _F32)
@@ -269,7 +269,7 @@ class TermBagPlan(Plan):
         # kernel's scatter traffic) is skipped entirely
         fast = (int(bind["required"]) == 1
                 and bool((weights > 0).all()) and bool((idfs > 0).all()))
-        ins = (jnp.asarray(tids), jnp.asarray(active),
+        ins = (jnp.asarray(tids), jnp.asarray(active),  # staging-ok: per-query input (prep-cache owned)
                _pad_np(idfs, t_pad, 0.0, _F32),
                _pad_np(weights, t_pad, 0.0, _F32),
                dseg.impacts(self.field, bind["avgdl"]),
@@ -341,8 +341,8 @@ class PhrasePlan(Plan):
                 e0, e1 = int(pf.offsets[tid]), int(pf.offsets[tid + 1])
                 count = int(pf.pos_offsets[e1] - pf.pos_offsets[e0])
             budgets.append(pad_bucket(count, minimum=1024))
-        ins = (jnp.asarray(tids), jnp.asarray(active),
-               jnp.asarray(np.asarray(bind["positions"], _I32)),
+        ins = (jnp.asarray(tids), jnp.asarray(active),  # staging-ok: per-query input (prep-cache owned)
+               jnp.asarray(np.asarray(bind["positions"], _I32)),  # staging-ok: per-query input (prep-cache owned)
                _scalar(bind["idf_sum"], _F32),
                _scalar(bind["boost"], _F32),
                _scalar(bind["avgdl"], _F32))
@@ -408,7 +408,7 @@ class SpanNearPlan(Plan):
                 e0, e1 = int(pf.offsets[tid]), int(pf.offsets[tid + 1])
                 count = int(pf.pos_offsets[e1] - pf.pos_offsets[e0])
             budgets.append(pad_bucket(count, minimum=1024))
-        ins = (jnp.asarray(tids), jnp.asarray(active),
+        ins = (jnp.asarray(tids), jnp.asarray(active),  # staging-ok: per-query input (prep-cache owned)
                _scalar(bind["slop"], _I32), _scalar(bind["end"], _I32),
                _scalar(bind["idf_sum"], _F32),
                _scalar(bind["boost"], _F32),
@@ -568,7 +568,7 @@ class PostingsMaskPlan(Plan):
                 active[i] = True
                 budget += int(pf.df[tid])
         return ((t_pad, pad_bucket(budget)),
-                (jnp.asarray(tids), jnp.asarray(active),
+                (jnp.asarray(tids), jnp.asarray(active),  # staging-ok: per-query input (prep-cache owned)
                  _scalar(bind["boost"], _F32)))
 
     def eval(self, A, dims, ins):
@@ -701,7 +701,7 @@ class MaskPlan(Plan):
 
     def prepare(self, bind, seg, dseg, ctx):
         mask = bind["mask_fn"](seg, dseg)
-        return (), (jnp.asarray(mask), _scalar(bind["boost"], _F32))
+        return (), (jnp.asarray(mask), _scalar(bind["boost"], _F32))  # staging-ok: per-query input (prep-cache owned)
 
     def eval(self, A, dims, ins):
         mask, boost = ins
@@ -718,7 +718,7 @@ class ScoredMaskPlan(Plan):
 
     def prepare(self, bind, seg, dseg, ctx):
         scores, mask = bind["fn"](seg, dseg)
-        return (), (jnp.asarray(scores), jnp.asarray(mask))
+        return (), (jnp.asarray(scores), jnp.asarray(mask))  # staging-ok: per-query input (prep-cache owned)
 
     def eval(self, A, dims, ins):
         scores, mask = ins
@@ -1206,7 +1206,7 @@ class TermsSetPlan(Plan):
                 tids[i] = tid
                 active[i] = True
                 budget += int(pf.df[tid])
-        ins = (jnp.asarray(tids), jnp.asarray(active),
+        ins = (jnp.asarray(tids), jnp.asarray(active),  # staging-ok: per-query input (prep-cache owned)
                _pad_np(bind["idfs"], t_pad, 0.0, _F32),
                _pad_np(bind["weights"], t_pad, 0.0, _F32),
                dseg.impacts(self.field, bind["avgdl"]))
@@ -1246,8 +1246,8 @@ class DistanceFeaturePlan(Plan):
     def prepare(self, bind, seg, dseg, ctx):
         if self.kind == "geo":
             lat, lon = bind["origin"]
-            origin = (jnp.asarray(np.float64(lat)),
-                      jnp.asarray(np.float64(lon)))
+            origin = (jnp.asarray(np.float64(lat)),  # staging-ok: per-query input (prep-cache owned)
+                      jnp.asarray(np.float64(lon)))  # staging-ok: per-query input (prep-cache owned)
         else:
             origin = _scalar(bind["origin"], np.float64)
         return (), (origin, _scalar(bind["pivot"], np.float64),
@@ -1284,9 +1284,9 @@ class GeoDistancePlan(Plan):
         return frozenset({("geo", self.field)})
 
     def prepare(self, bind, seg, dseg, ctx):
-        return (), (jnp.asarray(np.float64(bind["lat"])),
-                    jnp.asarray(np.float64(bind["lon"])),
-                    jnp.asarray(np.float64(bind["distance_m"])),
+        return (), (jnp.asarray(np.float64(bind["lat"])),  # staging-ok: per-query input (prep-cache owned)
+                    jnp.asarray(np.float64(bind["lon"])),  # staging-ok: per-query input (prep-cache owned)
+                    jnp.asarray(np.float64(bind["distance_m"])),  # staging-ok: per-query input (prep-cache owned)
                     _scalar(bind["boost"], _F32))
 
     def eval(self, A, dims, ins):
@@ -1322,7 +1322,7 @@ class GeoPolygonPlan(Plan):
         plons = np.full(v_pad, lons[-1])
         plats[: len(lats)] = lats
         plons[: len(lons)] = lons
-        return ((v_pad,), (jnp.asarray(plats), jnp.asarray(plons),
+        return ((v_pad,), (jnp.asarray(plats), jnp.asarray(plons),  # staging-ok: per-query input (prep-cache owned)
                            _scalar(bind["boost"], _F32)))
 
     def eval(self, A, dims, ins):
@@ -1356,7 +1356,7 @@ class GeoBoxPlan(Plan):
         return frozenset({("geo", self.field)})
 
     def prepare(self, bind, seg, dseg, ctx):
-        return (), tuple(jnp.asarray(np.float64(bind[k]))
+        return (), tuple(jnp.asarray(np.float64(bind[k]))  # staging-ok: per-query input (prep-cache owned)
                          for k in ("top", "left", "bottom", "right")) + (
             _scalar(bind["boost"], _F32),)
 
@@ -1453,7 +1453,7 @@ class FunctionScorePlan(Plan):
                 import zlib
                 fb["salt"] = float(zlib.crc32(seg.seg_id.encode()))
             params = tuple(
-                jnp.asarray(np.float64(
+                jnp.asarray(np.float64(  # staging-ok: per-query input (prep-cache owned)
                     fb.get(name, self._PARAM_DEFAULTS.get(name, 0.0))))
                 for name in self._param_names(spec))
             i_i.append(params)

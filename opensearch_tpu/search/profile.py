@@ -52,25 +52,14 @@ _MAX_SEGMENT_RECORDS = 256
 def xla_program_count() -> int:
     """Live compiled-program count across the query-path jit entry
     points — a growing count across identical queries means the hot
-    path is retracing (the attribution bench.py tracks per phase)."""
-    total = 0
-    try:
-        from opensearch_tpu.search import batch as batch_mod
-        from opensearch_tpu.search import plan as plan_mod
-        fns = (plan_mod.run_topk, plan_mod.run_full,
-               plan_mod.topk_from_scores,
-               batch_mod.batch_impact_union_topk)
-    except Exception:       # partial import cycles during bootstrap
-        return 0
-    for fn in fns:
-        size = getattr(fn, "_cache_size", None)
-        if size is None:
-            continue
-        try:
-            total += int(size())
-        except Exception:   # jax version without introspection
-            continue
-    return total
+    path is retracing (the attribution bench.py tracks per phase).
+
+    Delegates to the per-kernel compile registry
+    (``common/device_ledger.kernel_registry``), whose version-tolerant
+    ``_cache_size`` shim degrades a removed jit introspection to a
+    counted ``unavailable`` instead of breaking the profiler."""
+    from opensearch_tpu.common.device_ledger import kernel_registry
+    return kernel_registry().program_count()
 
 
 class QueryProfiler:

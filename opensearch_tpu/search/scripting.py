@@ -78,7 +78,7 @@ class ScriptProgram:
         for name in self.param_names:
             v = self._params[name]
             try:
-                out.append(jnp.asarray(np.asarray(v, np.float32)))
+                out.append(jnp.asarray(np.asarray(v, np.float32)))  # staging-ok: script literal
             except (ValueError, TypeError):
                 raise ScriptException(
                     f"script param [{name}] is not numeric") from None
@@ -191,7 +191,7 @@ class _Evaluator(ast.NodeVisitor):
         raise ScriptException(f"unknown variable [{node.id}]")
 
     def visit_List(self, node):
-        return jnp.asarray([self.visit(e) for e in node.elts],
+        return jnp.asarray([self.visit(e) for e in node.elts],  # staging-ok: script literal
                            jnp.float32)
 
     visit_Tuple = visit_List

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Lint: device staging goes through the residency ledger.
+
+``common/device_ledger.py`` is the ONE place host arrays become device
+arrays: it records the owner, exact nbytes, and transfer time of every
+staging, and it is what lets `_nodes/stats` answer "what is on the
+device and who put it there" — a raw ``jax.device_put(...)`` or
+``jnp.asarray(...)`` elsewhere in the staging-bearing packages creates
+device-resident memory the ledger (and the device-memory budget
+enforcement built on it) cannot see.
+
+Scope: ``opensearch_tpu/index/``, ``search/``, ``parallel/``, ``ops/``.
+Flagged call patterns (line-based, like check_monotonic.py):
+
+- ``jax.device_put(``
+- ``jnp.asarray(`` / ``jax.numpy.asarray(``
+
+A deliberate non-resident staging — a 4-byte query scalar, a per-query
+input cached elsewhere, trace-time array creation inside a jitted
+function, or ANN-builder staging that the segment ledger ``adopt``s —
+carries a ``# staging-ok`` annotation on the same line or the line
+above.
+
+Sibling of ``check_hot_path_sync.py`` et al.; new un-annotated sites
+fail tier-1 (tests/test_device_ledger.py runs this check).
+
+Usage: python tools/check_device_staging.py [root]   (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+ANNOTATION = "# staging-ok"
+
+# directories (relative to the package root) whose staging is linted
+SCOPES = ("index", "search", "parallel", "ops")
+
+_PATTERNS = (
+    (re.compile(r"\bjax\.device_put\s*\("), "jax.device_put(...)"),
+    (re.compile(r"\bjnp\.asarray\s*\("), "jnp.asarray(...)"),
+    (re.compile(r"\bjax\.numpy\.asarray\s*\("), "jax.numpy.asarray(...)"),
+)
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    problems = []
+    for i, line in enumerate(lines):
+        for pat, what in _PATTERNS:
+            if not pat.search(line):
+                continue
+            prev = lines[i - 1] if i else ""
+            if ANNOTATION in line or ANNOTATION in prev:
+                continue
+            problems.append(
+                f"{path}:{i + 1}: raw {what} — device staging must go "
+                "through common/device_ledger.py (stage/device_put/"
+                "adopt) so residency and transfer accounting stay "
+                f"exact, or carry a '{ANNOTATION}' annotation on this "
+                "or the previous line")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    root = argv[1] if len(argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "opensearch_tpu")
+    problems = []
+    for scope in SCOPES:
+        scope_dir = os.path.join(root, scope)
+        if not os.path.isdir(scope_dir):
+            # linting a sample tree (the lint's own tests): scan root
+            scope_dir = root if scope == SCOPES[0] else None
+        if scope_dir is None:
+            continue
+        for dirpath, _dirs, files in os.walk(scope_dir):
+            if "__pycache__" in dirpath:
+                continue
+            for fname in sorted(files):
+                if not fname.endswith(".py"):
+                    continue
+                problems.extend(check_file(os.path.join(dirpath, fname)))
+    for p in sorted(set(problems)):
+        print(p)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
