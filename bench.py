@@ -297,6 +297,14 @@ def main():
         "p50_ms": round(p50, 3), "p99_ms": round(p99, 3),
         **hot_path_counters()})
 
+    # -- phase: continuous (REST-edge continuous batching under
+    # concurrent clients) -------------------------------------------------
+    try:
+        run_continuous_phase(searcher, queries, p50, platform)
+    except Exception as e:  # noqa: BLE001 — report, keep the bench
+        phase_report("continuous", {"platform": platform,
+                                    "error": f"{type(e).__name__}: {e}"})
+
     # -- phase: profile (phase-attributed overhead + top phase costs) -----
     # where the time actually goes: the sequential queries re-run with
     # profile:true, so the trajectory records per-phase attribution and
@@ -337,6 +345,132 @@ def main():
         qps=qps, baseline_qps=baseline_qps, platform=platform,
         extra={"qps_sequential": round(qps_seq, 1), "p50_ms": round(p50, 3),
                "p99_ms": round(p99, 3), "batch": batch, "n_docs": n_docs})))
+
+
+def run_continuous_phase(searcher, queries, p50_plain: float,
+                         platform: str):
+    """Continuous-batching phase line (ROADMAP item 1): N concurrent
+    client threads drive independent single searches through the
+    unified engine entry (the same ``QueryEngine.execute`` call the
+    REST edge routes to), and the line reports XLA dispatches per
+    query, realized batch occupancy, and p50/p99 under concurrency —
+    versus the sequential phase — plus the batcher-OFF sequential p50
+    so the bypass cost is measured, not asserted.  Acceptance bar:
+    < 1 dispatch per query at concurrency >= 16 with the batcher on,
+    and batcher-off sequential p50 within 5% of plain."""
+    import threading
+
+    from opensearch_tpu.common.telemetry import metrics
+    from opensearch_tpu.search import engine as engine_mod
+
+    class _Svc:
+        """Minimal service shim: the bench drives a bare ShardSearcher,
+        so the engine's service-scoped backends reduce to the batcher
+        (no mesh opt-in)."""
+
+        @staticmethod
+        def _use_mesh(body):
+            return False
+
+        @staticmethod
+        def _mesh_search(body):
+            raise RuntimeError("unreachable")
+
+    svc = _Svc()
+    eng = engine_mod.query_engine()
+    m = metrics()
+    conc = int(os.environ.get("OSTPU_BENCH_CONCURRENCY", 16))
+    n_total = min(len(queries), max(conc * 16, 128))
+    n_total = (n_total // conc) * conc
+    sample = queries[:n_total]
+
+    prev = (engine_mod.BATCHER_ENABLED, engine_mod.BATCHER_WINDOW_MS,
+            engine_mod.BATCHER_MAX_BATCH)
+    try:
+        # batcher ON under concurrency: each thread walks its own slice
+        engine_mod.BATCHER_ENABLED = True
+        engine_mod.BATCHER_WINDOW_MS = float(os.environ.get(
+            "OSTPU_BENCH_BATCH_WINDOW_MS", 4.0))
+        engine_mod.BATCHER_MAX_BATCH = 64
+        # warm the batch kernel's program shapes once
+        searcher.msearch([dict(q) for q in sample[:conc]])
+        b0 = m.counter("search.batcher.batched").value
+        d0 = m.counter("search.batcher.dispatches").value
+        y0 = m.counter("search.batcher.bypass").value
+        lat: list[float] = []
+        lat_lock = threading.Lock()
+
+        def client(tid: int):
+            mine = sample[tid::conc]
+            for q in mine:
+                t0 = time.monotonic()
+                eng.execute(searcher, dict(q), service=svc)
+                dt = time.monotonic() - t0
+                with lat_lock:
+                    lat.append(dt)
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    name=f"bench-client-{i}", daemon=True)
+                   for i in range(conc)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        batched = m.counter("search.batcher.batched").value - b0
+        groups = m.counter("search.batcher.dispatches").value - d0
+        bypass = m.counter("search.batcher.bypass").value - y0
+        solo = n_total - batched - bypass
+        dispatches = groups + solo + bypass
+        lat_ms = np.asarray(lat) * 1e3
+        occupancy = batched / groups if groups else 0.0
+
+        # batcher OFF, single-threaded: the bypass-cost regression
+        # check.  Plain (searcher.search) and engine-entry p50 are
+        # measured BACK-TO-BACK — the sequential phase's p50 was taken
+        # in a different cache/thermal state minutes earlier, and at
+        # sub-ms p50 that skew dwarfs the entry cost being measured
+        # (same rationale as the insights phase)
+        engine_mod.BATCHER_ENABLED = False
+        n_off = min(100, n_total)
+        plain = []
+        for q in sample[:n_off]:
+            t0 = time.monotonic()
+            searcher.search(dict(q))
+            plain.append(time.monotonic() - t0)
+        p50_plain_now = float(np.percentile(np.asarray(plain) * 1e3, 50))
+        off = []
+        for q in sample[:n_off]:
+            t0 = time.monotonic()
+            eng.execute(searcher, dict(q), service=svc)
+            off.append(time.monotonic() - t0)
+        p50_off = float(np.percentile(np.asarray(off) * 1e3, 50))
+
+        phase_report("continuous", {
+            "platform": platform,
+            "concurrency": conc,
+            "n_queries": n_total,
+            "qps": round(n_total / wall, 1),
+            "batched_members": int(batched),
+            "batch_dispatches": int(groups),
+            "solo": int(solo),
+            "bypass": int(bypass),
+            "dispatches_per_query": round(dispatches / n_total, 4),
+            "mean_batch_occupancy": round(occupancy, 2),
+            "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+            "window_ms": engine_mod.BATCHER_WINDOW_MS or 4.0,
+            "seq_p50_batcher_off_ms": round(p50_off, 3),
+            "seq_p50_plain_ms": round(p50_plain_now, 3),
+            "seq_p50_phase_ms": round(p50_plain, 3),
+            "seq_p50_off_delta_pct": round(
+                (p50_off - p50_plain_now) / p50_plain_now * 100, 2)
+            if p50_plain_now else 0.0,
+        })
+    finally:
+        (engine_mod.BATCHER_ENABLED, engine_mod.BATCHER_WINDOW_MS,
+         engine_mod.BATCHER_MAX_BATCH) = prev
 
 
 def run_profile_phase(searcher, queries, seq_n: int, p50_plain: float,

@@ -1448,6 +1448,7 @@ class ClusterNode:
                             agg_partials, shard_ids) -> dict:
 
         def compute() -> dict:
+            from opensearch_tpu.search.engine import query_engine
             from opensearch_tpu.search.executor import ShardSearcher
             segs = []
             for shard_id in shard_ids:
@@ -1455,8 +1456,12 @@ class ClusterNode:
                 segs.extend(engine.acquire_searcher().segments)
             searcher = ShardSearcher(segs, svc.mapper,
                                      index_name=svc.name)
-            return {"resp": searcher.search(body,
-                                            agg_partials=agg_partials)}
+            # the data-node query phase routes through the SAME unified
+            # engine entry as the REST edge (no service handle: this
+            # searcher is per-payload, so the mesh/batcher backends do
+            # not apply — the engine runs the plain lowering pipeline)
+            return {"resp": query_engine().execute(
+                searcher, body, agg_partials=agg_partials)}
 
         # data-node request cache: remote coordinators' repeated query
         # phases hit here without re-executing (the hit/miss counts land
@@ -1631,6 +1636,10 @@ class ClusterNode:
         # must never hang on the backpressure monitor thread
         self.search_backpressure.stop_monitor()
         self.fs_health.stop_probe()
+        # quiesce the (process-global) query-engine workers with a
+        # bounded join; another live node's next search respawns them
+        from opensearch_tpu.search.engine import query_engine
+        query_engine().shutdown()
         self.coordinator.stop()
         with self._lock:
             for svc in self.indices.values():

@@ -708,10 +708,15 @@ class IndexService:
         return resp
 
     def _execute_search(self, body: dict, agg_partials: bool) -> dict:
-        if not agg_partials and self._use_mesh(body):
-            resp = self._mesh_search(body)
-        else:
-            resp = self.searcher().search(body, agg_partials=agg_partials)
+        # ONE engine entry for every backend: the mesh router, the
+        # continuous batcher, the host fast path and the device kernels
+        # are decisions inside QueryEngine.execute, not separately-wired
+        # code paths here (search/engine.py; tools/check_execution_paths
+        # keeps new paths from bypassing it)
+        from opensearch_tpu.search.engine import query_engine
+        resp = query_engine().execute(self.searcher(), body,
+                                      agg_partials=agg_partials,
+                                      service=self)
         resp["_shards"] = {"total": self.num_shards,
                            "successful": self.num_shards,
                            "skipped": 0, "failed": 0}
@@ -979,8 +984,10 @@ class IndexService:
 
     def msearch(self, bodies: list) -> list[dict]:
         """Batched multi-search over the node-local searcher (term-bag
-        bodies share device programs — search/batch.py)."""
-        results = self.searcher().msearch(bodies)
+        bodies share device programs — search/batch.py), routed through
+        the unified engine entry."""
+        from opensearch_tpu.search.engine import query_engine
+        results = query_engine().msearch(self.searcher(), bodies)
         for r in results:
             r["_shards"] = {"total": self.num_shards,
                             "successful": self.num_shards,
@@ -988,7 +995,8 @@ class IndexService:
         return results
 
     def count(self, query: Optional[dict] = None) -> int:
-        return self.searcher().count(query)
+        from opensearch_tpu.search.engine import query_engine
+        return query_engine().count(self.searcher(), query)
 
     def doc_count(self) -> int:
         return sum(e.doc_count() for e in self.shards)

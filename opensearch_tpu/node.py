@@ -163,6 +163,16 @@ class Node:
         ins_coalesce = Setting.float_setting(
             "search.insights.coalesce_window_ms", 10.0,
             min_value=0.0, dynamic=True)
+        # continuous batcher at the REST edge (search/engine.py):
+        # window_ms 0 = auto-size from the measured insights coalesce
+        # window (the PR-10 coalescability report's Δt)
+        batcher_enabled = Setting.bool_setting(
+            "search.batcher.enabled", True, dynamic=True)
+        batcher_window = Setting.float_setting(
+            "search.batcher.window_ms", 0.0, min_value=0.0,
+            dynamic=True)
+        batcher_max = Setting.int_setting(
+            "search.batcher.max_batch", 64, min_value=2, dynamic=True)
         # measured device-memory budget: 0 = unlimited; exceeding it
         # unstages least-recently-dispatched segments (ROADMAP item 5's
         # host↔device paging seed, common/device_ledger.py)
@@ -181,7 +191,23 @@ class Node:
              ars_enabled, ars_shed, ars_spill, ars_shed_occ,
              max_keep_alive, default_keep_alive, allow_partial,
              req_cache_size, ins_enabled, ins_top_n, ins_window,
-             ins_coalesce, device_budget])
+             ins_coalesce, device_budget, batcher_enabled,
+             batcher_window, batcher_max])
+        # continuous-batcher knobs land on engine module globals (the
+        # DEFAULT_ALLOW_PARTIAL_RESULTS idiom); the insights coalesce
+        # window doubles as the batcher's auto window so the Δt always
+        # tracks the measured workload knob
+        from opensearch_tpu.search import engine as engine_mod
+        for setting, attr, conv in (
+                (batcher_enabled, "BATCHER_ENABLED", bool),
+                (batcher_window, "BATCHER_WINDOW_MS", float),
+                (batcher_max, "BATCHER_MAX_BATCH", int),
+                (ins_coalesce, "AUTO_WINDOW_MS", float)):
+            def _apply_eng(v, attr=attr, conv=conv):
+                setattr(engine_mod, attr, conv(v))
+            self.cluster_settings.add_settings_update_consumer(
+                setting, _apply_eng)
+            _apply_eng(self.cluster_settings.get(setting))
         # device-memory budget reaches the residency ledger immediately
         # (and persisted values replay at boot)
         from opensearch_tpu.common.device_ledger import device_ledger
@@ -384,6 +410,10 @@ class Node:
         self.fs_health.stop_probe()
         self.http.stop()
         self.indices.close()
+        # bounded-join the (process-global) query-engine workers; safe
+        # when never started, idempotent on double-stop
+        from opensearch_tpu.search.engine import query_engine
+        query_engine().shutdown()
         self.thread_pool.shutdown()
 
 

@@ -93,6 +93,16 @@ def prepare_match_query(segments: list, field: str, terms: list[str]):
     shards, so sharded scores match single-shard scores exactly — the
     DFS_QUERY_THEN_FETCH global-stats guarantee, ref search/dfs/DfsPhase.java).
 
+    Ported onto the PR-5 eager impact tables (ROADMAP item 1's mesh
+    leftover): instead of staging raw tfs + doc_lens and recomputing the
+    BM25 norm per query on every device, each shard stages its
+    PRECOMPUTED per-posting impact column (``Segment.impact_table`` at
+    the GLOBAL avgdl — bit-identical to what the host fast path and the
+    device kernels read), so the mesh query degenerates to the same
+    gather + idf-weighted scatter the unified engine lowers everywhere
+    else.  Byte-parity with the host path is pinned in
+    tests/test_dist_search.py.
+
     Returns (stacked dict [S, ...], meta dict with n_pad/budget/k-free dims).
     """
     from opensearch_tpu.index.segment import pad_pow2
@@ -130,21 +140,21 @@ def prepare_match_query(segments: list, field: str, terms: list[str]):
         sh = {
             "offsets": np.zeros(t_pad, np.int32),
             "doc_ids": np.full(p_pad, n_pad - 1, np.int32),
-            "tfs": np.zeros(p_pad, np.float32),
-            "doc_lens": np.ones(n_pad, np.float32),
+            "impacts": np.zeros(p_pad, np.float32),
             "tids": np.zeros(q_pad, np.int32),
             "active": np.zeros(q_pad, bool),
             "idfs": idfs,
             "weights": np.where(np.arange(q_pad) < len(terms), 1.0, 0.0
                                 ).astype(np.float32),
-            "avgdl": np.float32(avgdl),
         }
         if pf is not None:
+            # the shard's eager impact table at the GLOBAL avgdl: no
+            # per-query norm math ever reaches the mesh kernel
+            impacts, _mx = s.impact_table(field, avgdl)
             sh["offsets"][: len(pf.offsets)] = pf.offsets
             sh["offsets"][len(pf.offsets):] = pf.offsets[-1]
             sh["doc_ids"][: len(pf.doc_ids)] = pf.doc_ids
-            sh["tfs"][: len(pf.tfs)] = pf.tfs
-            sh["doc_lens"][: len(pf.doc_lens)] = pf.doc_lens
+            sh["impacts"][: len(impacts)] = impacts
             local_budget = 0
             for i, t in enumerate(terms):
                 tid = pf.term_id(t)
@@ -500,26 +510,29 @@ def sharded_metric_reduce(mesh: Mesh, axis: str = "shards"):
 _MESH_METRICS = {"sum", "avg", "min", "max", "value_count", "stats"}
 
 
-def sharded_bm25_topk(mesh: Mesh, *, n_pad: int, budget: int, k: int,
-                      axis: str = "shards"):
-    """Build the jitted one-step distributed query: every device scores its
-    own shard's postings block and the global top-k is reduced with an
-    all-gather over the mesh axis.
+def sharded_impact_topk(mesh: Mesh, *, n_pad: int, budget: int, k: int,
+                        axis: str = "shards"):
+    """Build the jitted one-step distributed query: every device scores
+    its own shard's postings block FROM ITS PRECOMPUTED IMPACT COLUMN
+    (no norm recomputation — the port of ROADMAP item 1's mesh
+    leftover) and the global top-k is reduced with an all-gather over
+    the mesh axis.
 
-    Inputs (per call): shard-stacked arrays [S, ...] for offsets/doc_ids/
-    tfs/doc_lens/term_ids/active/idfs and scalars replicated [S] for
-    avgdl.  Returns (scores[k], global_doc_ids[k]) replicated on all
-    devices; global doc id = shard * n_pad + local id, so ties break by
-    (score desc, shard asc, local doc asc) — the coordinator merge order.
+    Inputs (per call): the ``prepare_match_query`` shard-stacked arrays
+    [S, ...] for offsets/doc_ids/impacts/term_ids/active/idfs/weights.
+    Returns (scores[k], global_doc_ids[k]) replicated on all devices;
+    global doc id = shard * n_pad + local id, so ties break by
+    (score desc, shard asc, local doc asc) — the coordinator merge
+    order.  Scores are byte-identical to the host path's (same impact
+    table, same accumulation order), pinned in tests/test_dist_search.py.
     """
 
-    def local_step(offsets, doc_ids, tfs, doc_lens, tids, active, idfs,
-                   weights, avgdl):
+    def local_step(offsets, doc_ids, impacts, tids, active, idfs,
+                   weights):
         # shard_map hands each device a [1, ...] block — drop the axis
-        scores, _count = bm25_ops.bm25_score_count(
-            offsets[0], doc_ids[0], tfs[0], doc_lens[0], tids[0], active[0],
-            idfs[0], weights[0], avgdl[0],
-            n_pad=n_pad, budget=budget, scored=True)
+        scores = bm25_ops.impact_scores(  # engine-ok: mesh backend lowering of the unified engine
+            offsets[0], doc_ids[0], impacts[0], tids[0], active[0],
+            idfs[0], weights[0], n_pad=n_pad, budget=budget)
         vals, idx = lax.top_k(scores, k)
         shard = lax.axis_index(axis)
         gids = shard.astype(jnp.int64) * n_pad + idx
@@ -533,7 +546,7 @@ def sharded_bm25_topk(mesh: Mesh, *, n_pad: int, budget: int, k: int,
     # re-top-k on every device) but the varying-mesh-axes checker cannot
     # infer that statically.
     fn = shard_map(local_step, mesh=mesh,
-                   in_specs=(spec,) * 9,
+                   in_specs=(spec,) * 7,
                    out_specs=(P(), P()),
                    check_vma=False)
     return jax.jit(fn)

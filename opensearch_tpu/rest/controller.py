@@ -81,6 +81,13 @@ def _device_stats() -> dict:
     return device_ledger().stats()
 
 
+def _query_engine_stats() -> dict:
+    """The unified engine's `_nodes/stats` block (continuous batcher +
+    search threadpool accounting, search/engine.py)."""
+    from opensearch_tpu.search.engine import query_engine
+    return query_engine().stats()
+
+
 def _process_stats() -> dict:
     """ProcessProbe analog: CURRENT rss from /proc statm (linux), peak
     rss from getrusage (kbytes on linux, bytes on darwin)."""
@@ -724,6 +731,11 @@ class RestController:
                 # cardinality, and the coalescability fraction (full
                 # detail at GET /_insights/top_queries)
                 "query_insights": self.node.insights.stats(),
+                # the unified query engine: continuous-batcher
+                # accounting (members batched / bypasses / window
+                # waits / shared dispatches) + the bounded search
+                # threadpool (search/engine.py)
+                "search_engine": _query_engine_stats(),
                 # device residency + transfer observability: ledger
                 # rollups per index, stage/fetch transfer counters, the
                 # device.memory.budget_bytes eviction accounting, the

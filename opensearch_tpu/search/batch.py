@@ -261,34 +261,72 @@ class BatchGroup:
                for pos in self.positions}
         pruned = 0
         scanned = 0
-        for seg_order, seg in enumerate(searcher.segments):
-            check_current()    # cancellation point per segment
-            t_seg = time.monotonic() if prof is not None else 0.0
-            pf = seg.postings.get(self.field)
-            if pf is None:
-                continue
-            if not any(pf.term_id(t) >= 0
-                       for terms in self.terms for t in terms):
-                # no query term exists here: skip without scoring
-                pruned += 1
-                if prof is not None:
+        if prof is not None:
+            # profiled groups keep the serial segment-outer loop so the
+            # per-segment dispatch attribution includes scoring time
+            for seg_order, seg in enumerate(searcher.segments):
+                check_current()    # cancellation point per segment
+                t_seg = time.monotonic()
+                pf = seg.postings.get(self.field)
+                if pf is None:
+                    continue
+                if not any(pf.term_id(t) >= 0
+                           for terms in self.terms for t in terms):
+                    pruned += 1
                     prof.seg_pruned(seg.seg_id, "pruned_can_match",
                                     time.monotonic() - t_seg)
-                continue
-            live = searcher.ctx.lives[id(seg)]
-            for qi, pos in enumerate(self.positions):
-                vals, idx, tot, mx = plan.host_topk(
-                    self._bind(qi), seg, live,
-                    min(self.k, seg.n_docs), None)
-                a = acc[pos]
-                a["v"].append(vals)
-                a["s"].append(np.full(len(vals), seg_order, _I32))
-                a["l"].append(idx)
-                a["tot"] += int(tot)
-                a["mx"] = max(a["mx"], float(mx))
-            scanned += 1
-            if prof is not None:
+                    continue
+                live = searcher.ctx.lives[id(seg)]
+                for qi, pos in enumerate(self.positions):
+                    vals, idx, tot, mx = plan.host_topk(  # engine-ok: batch host backend
+                        self._bind(qi), seg, live,
+                        min(self.k, seg.n_docs), None)
+                    a = acc[pos]
+                    a["v"].append(vals)
+                    a["s"].append(np.full(len(vals), seg_order, _I32))
+                    a["l"].append(idx)
+                    a["tot"] += int(tot)
+                    a["mx"] = max(a["mx"], float(mx))
+                scanned += 1
                 prof.seg_scanned(seg.seg_id, time.monotonic() - t_seg)
+        else:
+            surviving = []         # (seg_order, seg, live)
+            for seg_order, seg in enumerate(searcher.segments):
+                check_current()    # cancellation point per segment
+                pf = seg.postings.get(self.field)
+                if pf is None:
+                    continue
+                if not any(pf.term_id(t) >= 0
+                           for terms in self.terms for t in terms):
+                    pruned += 1    # no query term here: skip scoring
+                    continue
+                surviving.append((seg_order, seg,
+                                  searcher.ctx.lives[id(seg)]))
+                scanned += 1
+
+            def score_member(qi):
+                bindq = self._bind(qi)
+                a = acc[self.positions[qi]]
+                for seg_order, seg, live in surviving:
+                    vals, idx, tot, mx = plan.host_topk(  # engine-ok: batch host backend
+                        bindq, seg, live, min(self.k, seg.n_docs), None)
+                    a["v"].append(vals)
+                    a["s"].append(np.full(len(vals), seg_order, _I32))
+                    a["l"].append(idx)
+                    a["tot"] += int(tot)
+                    a["mx"] = max(a["mx"], float(mx))
+
+            if len(self.positions) > 1 and surviving:
+                # members are independent: fan the per-member scoring
+                # loop across the engine threadpool (the batched-group
+                # analog of the executor's multi-segment host fan-out)
+                from opensearch_tpu.search.engine import query_engine
+                query_engine().pool.run_all(
+                    [(lambda qi=qi: score_member(qi))
+                     for qi in range(len(self.positions))])
+            else:
+                for qi in range(len(self.positions)):
+                    score_member(qi)
         if pruned:
             _metrics().counter("search.segments_pruned").inc(pruned)
         # group-level attribution the msearch member insight records
@@ -366,7 +404,7 @@ class BatchGroup:
             impacts = dseg.impacts(self.field, self.avgdl)
             live = searcher.ctx.live_jnp(seg, dseg)
             kk = min(self.k, dseg.n_pad)
-            vals, idx, tot, mx = batch_impact_union_topk(
+            vals, idx, tot, mx = batch_impact_union_topk(  # engine-ok: batch device backend
                 dseg.postings[self.field]["offsets"],
                 dseg.postings[self.field]["doc_ids"],
                 impacts, live, sp["union_tids"], sp["union_active"],
@@ -437,10 +475,19 @@ def plan_batches(searcher, bodies: list) -> tuple[dict, list]:
                 or body.get("aggregations") or body.get("min_score")
                 or body.get("highlight") or body.get("explain")
                 or body.get("docvalue_fields") or body.get("fields")
+                or body.get("collapse") or body.get("rescore")
+                or body.get("suggest") or body.get("search_after")
+                or body.get("stored_fields") or body.get("script_fields")
+                or body.get("post_filter")
+                or body.get("track_total_hits") is False
                 or body.get("timeout") is not None
                 or int(body.get("from", 0)) != 0):
             # a timeout budget needs the sequential path's per-segment
-            # deadline checks — one fused batch program can't stop early
+            # deadline checks — one fused batch program can't stop
+            # early; collapse/rescore/suggest shape the response beyond
+            # plain top-k; track_total_hits:false may legally return
+            # lower-bound totals sequentially (k-th pruning) which the
+            # exact batched totals would not reproduce
             fallback.append(pos)
             continue
         try:

@@ -735,8 +735,20 @@ class ShardSearcher:
                                          f"member {pos} of "
                                          f"{len(g.positions)}"),
                             total_segments=len(self.segments))]}
-        for pos in fallback:
-            results[pos] = self.search(bodies[pos])
+        if len(fallback) > 1:
+            # non-coalescable members fan out over the engine's bounded
+            # search threadpool — the sequential host fast path
+            # parallelizes across cores instead of serializing behind
+            # one request thread (overflow runs inline, same semantics)
+            from opensearch_tpu.search.engine import query_engine
+            outs = query_engine().pool.run_all(
+                [(lambda b=bodies[pos]: self.search(b))
+                 for pos in fallback])
+            for pos, r in zip(fallback, outs):
+                results[pos] = r
+        else:
+            for pos in fallback:
+                results[pos] = self.search(bodies[pos])
         return results
 
     def _hits_from_rows(self, rows, source_spec, fetch_extras=None):
@@ -900,6 +912,18 @@ class ShardSearcher:
             iattrs["execution_path"] = "host" if host_fast else "device"
         if prof is not None:
             prof.set("execution_path", "host" if host_fast else "device")
+        if (host_fast and prof is None and not allow_kth_prune
+                and (deadline is None or deadline._deadline is None)
+                and len(self.segments) > 1):
+            # multi-segment host fast path: per-segment scoring is pure
+            # host work with no async-dispatch overlap to exploit, so it
+            # fans out across cores on the engine threadpool instead of
+            # serializing on this thread.  Gated off the paths whose
+            # semantics are scan-order-dependent (k-th-score pruning,
+            # deadlines) and off profiled requests (exact per-phase
+            # attribution) — those keep the sequential loop below.
+            return self._topk_host_parallel(plan, bind, k_want,
+                                            min_score, ms_host, iattrs)
         launched = []              # [si, vals, idx, tot, mx, synced_vals]
         kth = None                 # running k-th best (harvested, host)
         total_is_lower_bound = False
@@ -960,7 +984,7 @@ class ShardSearcher:
                 if use_host:
                     if not host_fast:
                         _ledger().record_host_fallback()
-                    vals, idx, tot, mx = plan.host_topk(
+                    vals, idx, tot, mx = plan.host_topk(  # engine-ok: host fast-path backend
                         bind, seg, self.ctx.lives[id(seg)],
                         min(k_want, seg.n_docs), min_score)
                     launched.append([si, vals, idx, tot, mx, vals])
@@ -1012,6 +1036,62 @@ class ShardSearcher:
         if prof is not None:
             prof.add("reduce", time.monotonic() - t_red)
         return rows, total, max_score, total_is_lower_bound
+
+    def _topk_host_parallel(self, plan, bind, k_want, min_score,
+                            ms_host, iattrs):
+        """Host fast path over many segments, scored concurrently on the
+        engine threadpool.  Pruning decisions (can-match, min_score
+        block-max) run up front on this thread — they are cheap and
+        deterministic per segment — then each surviving segment's
+        ``host_topk`` runs as one pool task; the merge is the same
+        ``_merge_topk`` the sequential path uses, so results are
+        byte-identical to a sequential scan."""
+        from opensearch_tpu.common.tasks import check_current
+        from opensearch_tpu.search.engine import query_engine
+
+        cand = []
+        for si, seg in enumerate(self.segments):
+            check_current()        # cancellation point per segment
+            if not plan.can_match(bind, seg):
+                _metrics().counter("search.segments_pruned").inc()
+                if iattrs is not None:
+                    iattrs["pruned"] += 1
+                continue
+            if ms_host is not None \
+                    and plan.max_score_bound(bind, seg) < ms_host:
+                _metrics().counter("search.segments_pruned").inc()
+                if iattrs is not None:
+                    iattrs["pruned"] += 1
+                continue
+            cand.append((si, seg))
+            if iattrs is not None:
+                iattrs["scanned"] += 1
+        def score_one(seg):
+            with _tracer().start_span(
+                    "segment.dispatch",
+                    {"segment": seg.seg_id, "index": self.index_name,
+                     "shard": self.shard_id}):
+                return plan.host_topk(  # engine-ok: host fast-path backend
+                    bind, seg, self.ctx.lives[id(seg)],
+                    min(k_want, seg.n_docs), min_score)
+
+        outs = query_engine().pool.run_all(
+            [(lambda seg=seg: score_one(seg)) for _si, seg in cand])
+        per_seg = []
+        total = 0
+        max_score = -np.inf
+        for (si, _seg), (vals, idx, tot, mx) in zip(cand, outs):
+            vals = np.asarray(vals)
+            idx = np.asarray(idx)
+            keep = vals > -np.inf
+            per_seg.append((vals[keep],
+                            np.full(int(keep.sum()), si, _I32),
+                            idx[keep]))
+            total += int(tot)
+            max_score = max(max_score, float(mx))
+        rows, total, max_score = self._merge_topk(per_seg, k_want,
+                                                  total, max_score)
+        return rows, total, max_score, False
 
     @staticmethod
     def _harvest_kth(launched, k_want, kth):

@@ -151,7 +151,10 @@ class _SignatureRollup:
                  "coalesced", "paths", "outcomes", "plan_cache_hits",
                  "request_cache_hits", "request_cache_total", "pruned",
                  "scanned", "cpu_nanos", "heap_peak", "clients",
-                 "batched_members", "transfer_bytes")
+                 "batched_members", "transfer_bytes",
+                 "batch_size_sum", "batch_size_max",
+                 "queue_wait_ms_sum", "queue_wait_ms_max",
+                 "queue_waits")
 
     def __init__(self, signature: str, source: str, scored: bool,
                  now: float):
@@ -180,6 +183,14 @@ class _SignatureRollup:
         # host↔device bytes (stage + fetch-back) the device ledger
         # attributed to this signature's executions
         self.transfer_bytes = 0
+        # continuous-batcher attribution: realized group sizes of the
+        # members this signature contributed, and the queue wait they
+        # paid parking for the shared dispatch (search/engine.py)
+        self.batch_size_sum = 0
+        self.batch_size_max = 0
+        self.queue_wait_ms_sum = 0.0
+        self.queue_wait_ms_max = 0.0
+        self.queue_waits = 0
 
     def add(self, rec: dict, now: float, coalesce_window_s: float) -> None:
         self.count += 1
@@ -212,6 +223,17 @@ class _SignatureRollup:
                              int(rec.get("heap_bytes") or 0))
         if rec.get("batched"):
             self.batched_members += 1
+            size = int(rec["batched"])
+            self.batch_size_sum += size
+            if size > self.batch_size_max:
+                self.batch_size_max = size
+        qw = rec.get("queue_wait_ms")
+        if qw is not None:
+            qw = float(qw)
+            self.queue_waits += 1
+            self.queue_wait_ms_sum += qw
+            if qw > self.queue_wait_ms_max:
+                self.queue_wait_ms_max = qw
         opaque = rec.get("opaque_id")
         if opaque:
             opaque = str(opaque)[:64]
@@ -250,6 +272,18 @@ class _SignatureRollup:
             "batched_members": self.batched_members,
             "device_transfer_bytes": self.transfer_bytes,
         }
+        if self.batched_members:
+            out["batched_group_size"] = {
+                "mean": round(self.batch_size_sum
+                              / self.batched_members, 3),
+                "max": self.batch_size_max,
+            }
+        if self.queue_waits:
+            out["queue_wait_ms"] = {
+                "mean": round(self.queue_wait_ms_sum
+                              / self.queue_waits, 3),
+                "max": round(self.queue_wait_ms_max, 3),
+            }
         if self.request_cache_total:
             out["request_cache"] = {
                 "hits": self.request_cache_hits,

@@ -282,18 +282,18 @@ class TermBagPlan(Plan):
         n_pad = A["live"].shape[0]
         if not self.scored:
             tids, active, required = ins
-            count = bm25_ops.match_count(
+            count = bm25_ops.match_count(  # engine-ok: TermBag filter lowering
                 p["offsets"], p["doc_ids"], p["tfs"], tids, active,
                 n_pad=n_pad, budget=budget)
             return jnp.zeros(n_pad, jnp.float32), count >= required
         tids, active, idfs, weights, impacts, required = ins
         if fast:
-            scores = bm25_ops.impact_scores(
+            scores = bm25_ops.impact_scores(  # engine-ok: TermBag scored lowering
                 p["offsets"], p["doc_ids"], impacts, tids, active,
                 idfs, weights, n_pad=n_pad, budget=budget)
             matched = scores > 0.0
         else:
-            scores, count = bm25_ops.impact_score_count(
+            scores, count = bm25_ops.impact_score_count(  # engine-ok: TermBag scored lowering
                 p["offsets"], p["doc_ids"], impacts, tids, active,
                 idfs, weights, n_pad=n_pad, budget=budget, scored=True)
             matched = count >= required
@@ -1218,7 +1218,7 @@ class TermsSetPlan(Plan):
         p = A["postings"][self.field]
         msm = A["numeric"][self.msm_field]
         n_pad = A["live"].shape[0]
-        scores, count = bm25_ops.impact_score_count(
+        scores, count = bm25_ops.impact_score_count(  # engine-ok: TermsSet lowering
             p["offsets"], p["doc_ids"], impacts, tids, active,
             idfs, weights, n_pad=n_pad, budget=budget,
             scored=self.scored)

@@ -34,8 +34,11 @@ import contextlib
 import time
 from typing import Optional
 
-# response-breakdown phase keys, in pipeline order
-PHASES = ("rewrite", "plan_cache", "compile", "prepare",
+# response-breakdown phase keys, in pipeline order.  ``queue`` is the
+# continuous batcher's wait window (search/engine.py): time a member
+# spent parked before its group's shared dispatch — it precedes every
+# execution phase and is never counted as query work.
+PHASES = ("queue", "rewrite", "plan_cache", "compile", "prepare",
           "can_match", "dispatch", "reduce", "fetch")
 
 # phases counted into the query section's time_in_nanos (the collector

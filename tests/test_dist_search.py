@@ -43,12 +43,15 @@ def test_sharded_topk_matches_single_shard():
 
     mesh = dist_search.make_mesh(8)
     stacked, meta = dist_search.prepare_match_query(segments, "body", terms)
+    assert "impacts" in stacked and "tfs" not in stacked \
+        and "doc_lens" not in stacked       # the port actually landed
     on_mesh = dist_search.put_on_mesh(stacked, mesh)
-    step = dist_search.sharded_bm25_topk(mesh, n_pad=meta["n_pad"],
-                                         budget=meta["budget"], k=k)
-    vals, gids = step(on_mesh["offsets"], on_mesh["doc_ids"], on_mesh["tfs"],
-                      on_mesh["doc_lens"], on_mesh["tids"], on_mesh["active"],
-                      on_mesh["idfs"], on_mesh["weights"], on_mesh["avgdl"])
+    step = dist_search.sharded_impact_topk(mesh, n_pad=meta["n_pad"],
+                                           budget=meta["budget"], k=k)
+    vals, gids = step(on_mesh["offsets"], on_mesh["doc_ids"],
+                      on_mesh["impacts"], on_mesh["tids"],
+                      on_mesh["active"], on_mesh["idfs"],
+                      on_mesh["weights"])
     vals = np.asarray(vals)
     gids = np.asarray(gids)
 
@@ -65,7 +68,11 @@ def test_sharded_topk_matches_single_shard():
         shard, local = divmod(int(gid), n_pad)
         got_ids.append(segments[shard].doc_ids[local])
     assert got_ids == [h["_id"] for h in ref]
-    np.testing.assert_allclose(vals, [h["_score"] for h in ref], rtol=1e-5)
+    # BYTE-parity with the host path: both read the same eager impact
+    # table in the same accumulation order (the PR-5 invariant extended
+    # to the mesh), so scores are bitwise equal, not merely close
+    assert [np.float32(v) for v in vals] \
+        == [np.float32(h["_score"]) for h in ref]
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
@@ -75,16 +82,17 @@ def test_sharded_topk_term_missing_on_some_shards():
     stacked, meta = dist_search.prepare_match_query(segments, "body",
                                                     ["juliet"])
     on_mesh = dist_search.put_on_mesh(stacked, mesh)
-    step = dist_search.sharded_bm25_topk(mesh, n_pad=meta["n_pad"],
-                                         budget=meta["budget"], k=5)
-    vals, gids = step(on_mesh["offsets"], on_mesh["doc_ids"], on_mesh["tfs"],
-                      on_mesh["doc_lens"], on_mesh["tids"], on_mesh["active"],
-                      on_mesh["idfs"], on_mesh["weights"], on_mesh["avgdl"])
+    step = dist_search.sharded_impact_topk(mesh, n_pad=meta["n_pad"],
+                                           budget=meta["budget"], k=5)
+    vals, gids = step(on_mesh["offsets"], on_mesh["doc_ids"],
+                      on_mesh["impacts"], on_mesh["tids"],
+                      on_mesh["active"], on_mesh["idfs"],
+                      on_mesh["weights"])
     searcher = ShardSearcher(segments, mapper)
     resp = searcher.search({"query": {"match": {"body": "juliet"}}, "size": 5})
-    exp_scores = [h["_score"] for h in resp["hits"]["hits"]]
-    got = [v for v in np.asarray(vals) if v > 0]
-    np.testing.assert_allclose(got, exp_scores, rtol=1e-5)
+    exp_scores = [np.float32(h["_score"]) for h in resp["hits"]["hits"]]
+    got = [np.float32(v) for v in np.asarray(vals) if v > 0]
+    assert got == exp_scores           # byte-parity, not approximate
 
 
 def _build_sharded_corpus(n_shards=8, per=40, seed=3):
