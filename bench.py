@@ -331,6 +331,14 @@ def main():
         phase_report("device", {"platform": platform,
                                 "error": f"{type(e).__name__}: {e}"})
 
+    # -- phase: tier (search-only replica fleet over the remote store) ----
+    if os.environ.get("OSTPU_BENCH_TIER", "1") != "0":
+        try:
+            run_tier_phase(platform)
+        except Exception as e:  # noqa: BLE001 — report, keep the bench
+            phase_report("tier", {"platform": platform,
+                                  "error": f"{type(e).__name__}: {e}"})
+
     # -- phase: soak (chaos SLO scenario over a 3-node cluster) -----------
     # runs LAST so a wedge here cannot cost the phases above; failures
     # are reported as a phase line, never swallowed
@@ -632,6 +640,125 @@ def run_device_phase(searcher, queries, seq_n: int, platform: str):
     finally:
         bm25_ops.HOST_SCORING = prev_host
         led.set_budget(prev_budget)
+
+
+def run_tier_phase(platform: str):
+    """Search-tier line: a 3-data-node cluster + a search-only replica
+    over the shared remote store serves the zipf query shape while the
+    primary publishes checkpoints; the phase measures (a) searcher
+    checkpoint lag across publishes (p99, ops), (b) the cold-refill
+    time for a FRESH searcher after killing the old one — the tier's
+    recovery story is cache refill, zero primary RPCs — and (c) the
+    remote-store bytes that refill pulled (ROADMAP item 4)."""
+    import shutil as _shutil
+    import tempfile
+    import time as _time
+
+    from opensearch_tpu.cluster.node import ClusterNode
+    from opensearch_tpu.common.telemetry import metrics
+    from opensearch_tpu.testing.workload import MixedWorkload, SoakConfig
+    from opensearch_tpu.transport.service import (LocalTransport,
+                                                  TransportService)
+
+    n_docs = int(os.environ.get("OSTPU_BENCH_TIER_DOCS", 2000))
+    n_batches = 8
+    root = tempfile.mkdtemp(prefix="bench-tier-")
+    remote = os.path.join(root, "remote")
+    voting = ["n0", "n1", "n2"]
+    t_phase = time.monotonic()
+
+    def build(nid, roles):
+        svc = TransportService(nid, LocalTransport(hub))
+        return ClusterNode(nid, os.path.join(root, nid), svc, voting,
+                           roles=roles, remote_store_path=remote)
+
+    def wait(pred, what, timeout=60.0):
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:    # deadline
+            if pred():
+                return
+            _time.sleep(0.02)                  # deadline
+        raise RuntimeError(f"tier phase: timed out waiting for {what}")
+
+    def searcher_ready(leader, nid):
+        routing = leader.coordinator.state().routing.get("tier", [])
+        return bool(routing) and all(
+            nid in (e.get("search_in_sync") or []) for e in routing)
+
+    hub = LocalTransport.Hub()
+    nodes = {nid: build(nid, ("master", "data")) for nid in voting}
+    searcher = build("s0", ("search",))
+    nodes["s0"] = searcher
+    try:
+        for n in nodes.values():
+            n.start()
+        assert nodes["n0"].start_election()
+        nodes["n0"].coordinator.add_node(
+            "s0", {"name": "s0", "roles": ["search"],
+                   "master_eligible": False})
+        nodes["n1"].create_index("tier", {
+            "settings": {"number_of_shards": 2,
+                         "number_of_replicas": 1,
+                         "number_of_search_replicas": 1},
+            "mappings": {"properties": {"body": {"type": "text"},
+                                        "v": {"type": "long"}}}})
+        wait(lambda: searcher_ready(nodes["n0"], "s0"),
+             "initial searcher refill")
+        workload = MixedWorkload(SoakConfig(n_docs=n_docs,
+                                            vocab_size=2000))
+        docs = workload.seed_docs()
+        lags = []
+        per_batch = max(1, len(docs) // n_batches)
+        for b in range(n_batches):
+            for doc_id, src in docs[b * per_batch:(b + 1) * per_batch]:
+                nodes["n1"].index_doc("tier", doc_id, src)
+            nodes["n1"].refresh("tier")
+            lags.append(searcher.search_lag())
+        wait(lambda: searcher.search_lag() == 0, "searcher catch-up")
+        searcher_docs = sum(e.doc_count()
+                            for e in searcher.indices["tier"].shards)
+        # the recovery story: kill the searcher, add a FRESH one, time
+        # its pure-remote-store refill and count the bytes it pulled
+        searcher.stop()
+        nodes.pop("s0")
+        pulled_before = metrics().counter("segrep.bytes_pulled").value
+        fresh = build("s1", ("search",))
+        nodes["s1"] = fresh
+        fresh.start()
+        t0 = time.monotonic()
+        nodes["n0"].coordinator.add_node(
+            "s1", {"name": "s1", "roles": ["search"],
+                   "master_eligible": False})
+        wait(lambda: searcher_ready(nodes["n0"], "s1"),
+             "fresh searcher refill")
+        refill_ms = (time.monotonic() - t0) * 1000.0
+        bytes_per_recovery = (metrics().counter(
+            "segrep.bytes_pulled").value - pulled_before)
+        from opensearch_tpu.cluster.node import (A_FETCH_SEGMENTS,
+                                                 A_START_RECOVERY)
+        primary_rpcs = (fresh.transport.requests_sent(
+            action=A_START_RECOVERY) + fresh.transport.requests_sent(
+            action=A_FETCH_SEGMENTS))
+        lag_arr = np.asarray(lags, dtype=np.float64)
+        data = {
+            "platform": platform,
+            "wall_s": round(time.monotonic() - t_phase, 1),
+            "docs": searcher_docs,
+            "publishes": n_batches,
+            "searcher_lag_p99_ops": float(np.percentile(lag_arr, 99))
+            if len(lag_arr) else 0.0,
+            "searcher_lag_max_ops": float(lag_arr.max())
+            if len(lag_arr) else 0.0,
+            "refill_ms": round(refill_ms, 1),
+            "remote_bytes_per_recovery": int(bytes_per_recovery),
+            "recovery_primary_rpcs": int(primary_rpcs),
+        }
+        phase_report("tier", data)
+        return data
+    finally:
+        for n in list(nodes.values()):
+            n.stop()
+        _shutil.rmtree(root, ignore_errors=True)
 
 
 def run_soak_phase(platform: str):

@@ -39,7 +39,7 @@ from opensearch_tpu.common.fshealth import FsHealthService
 from opensearch_tpu.common.retry import retry_call
 from opensearch_tpu.cluster.coordination import CoordinationError, Coordinator
 from opensearch_tpu.cluster.state import (ClusterState, allocate_shards,
-                                          copies_of)
+                                          copies_of, search_copies_of)
 from opensearch_tpu.index.store import CorruptIndexError
 from opensearch_tpu.indices.service import IndexService
 from opensearch_tpu.transport.service import (ReceiveTimeoutError,
@@ -96,6 +96,18 @@ A_SHARD_RECOVERED = "internal:cluster/shard/started"
 # remote shard tasks instead of leaving them running
 A_BAN_PARENT = "internal:admin/tasks/ban"
 A_INSIGHTS = "cluster:monitor/insights/top_queries"
+# search-replica tier (segment replication over the remote store):
+# primaries publish checkpoints NAMING remote blob digests; searchers
+# install by pulling from the blob store — never from the primary —
+# and report refill completion to the cluster manager
+A_PUBLISH_SEARCH_CKPT = "indices:admin/replication/search_checkpoint"
+A_SEARCH_SHARD_READY = "internal:cluster/shard/search_ready"
+A_UPDATE_SETTINGS = "cluster:admin/index/settings"
+
+#: transport actions that mutate shard state — a search-role node must
+#: reject (or leave unregistered) every one of them; enforced by
+#: tools/check_searcher_write_isolation.py (tier-1)
+WRITE_ACTIONS = (A_WRITE_SHARD, A_REPLICATE_OP)
 
 
 class NoMasterError(CoordinationError):
@@ -104,11 +116,47 @@ class NoMasterError(CoordinationError):
 
 class ClusterNode:
     def __init__(self, node_id: str, data_path: str,
-                 transport: TransportService, voting_nodes: list[str]):
+                 transport: TransportService, voting_nodes: list[str],
+                 roles: tuple = ("master", "data"),
+                 remote_store_path: Optional[str] = None,
+                 file_cache_bytes: int = 256 << 20):
         self.node_id = node_id
         self.data_path = data_path
         os.makedirs(data_path, exist_ok=True)
         self.transport = transport
+        # node roles (the reference's node.roles): "data" nodes hold
+        # write copies and serve replication; "search" nodes hold only
+        # search replicas refilled from the remote store; "master"
+        # grants election eligibility.  Search-only nodes are stateless
+        # over the blob store — kill one and its replacement recovers
+        # by cache refill, never by contacting a primary.
+        self.roles = tuple(roles)
+        self.is_data = "data" in self.roles
+        self.is_search = "search" in self.roles
+        # the shared blob repository backing the search tier (and any
+        # remote-store mirroring): every node of the cluster points at
+        # the same store, the way every reference node names the same
+        # S3 bucket
+        self.remote_store = None
+        self.file_cache = None
+        if remote_store_path:
+            from opensearch_tpu.snapshots.service import Repository
+            self.remote_store = Repository(
+                "cluster-remote", "fs", {"location": remote_store_path})
+            if self.is_search:
+                from opensearch_tpu.index.filecache import FileCache
+                self.file_cache = FileCache(
+                    os.path.join(data_path, "filecache"),
+                    file_cache_bytes)
+        # (index, shard) -> highest checkpoint seq published / installed
+        # on THIS searcher; the difference is the replication lag
+        # piggybacked on pings and bounded by search.replication.max_lag
+        self._search_published: dict[tuple, int] = {}
+        self._search_installed: dict[tuple, int] = {}
+        # peer-recovery / segment-fetch RPC budget (tests shrink it so
+        # timeout paths stay fast) — satellite fix: _h_publish_ckpt used
+        # to hardcode 30s with no retry
+        self.recovery_timeout = 30.0
         self.indices: dict[str, IndexService] = {}
         # every shard-level search runs as a registered, cancellable
         # task with a parent id (the coordinator's), so _tasks-style
@@ -150,9 +198,16 @@ class ClusterNode:
         self.fs_health_interval = 5.0
         from opensearch_tpu.cluster.gateway import GatewayStateStore
         self.gateway = GatewayStateStore(os.path.join(data_path, "_state"))
+        # legacy full-role nodes keep the bare info shape (states stay
+        # byte-identical for existing clusters); non-default roles are
+        # published so the allocator can tell tiers apart
+        node_info = {"name": node_id}
+        if set(self.roles) != {"master", "data"}:
+            node_info["roles"] = list(self.roles)
+            node_info["master_eligible"] = "master" in self.roles
         self.coordinator = Coordinator(
             node_id, transport, voting_nodes,
-            node_info={"name": node_id}, on_apply=self._apply_state,
+            node_info=node_info, on_apply=self._apply_state,
             gateway=self.gateway,
             load_provider=self._load_stats,
             on_node_load=self.response_collector.record_ping_load,
@@ -171,16 +226,17 @@ class ClusterNode:
         t = transport
         t.register_handler(A_CREATE_INDEX, self._h_create_index)
         t.register_handler(A_DELETE_INDEX, self._h_delete_index)
-        t.register_handler(A_WRITE_SHARD, self._h_write_shard)
+        t.register_handler(A_UPDATE_SETTINGS, self._h_update_settings)
         t.register_handler(A_GET_DOC, self._h_get_doc)
         t.register_handler(A_SEARCH_SHARDS, self._h_search_shards)
         t.register_handler(A_REFRESH, self._h_refresh)
-        t.register_handler(A_REPLICATE_OP, self._h_replicate_op)
-        t.register_handler(A_PUBLISH_CKPT, self._h_publish_ckpt)
-        t.register_handler(A_FETCH_SEGMENTS, self._h_fetch_segments)
-        t.register_handler(A_START_RECOVERY, self._h_start_recovery)
+        self._register_write_handlers(t)
         t.register_handler(A_FAIL_COPY, self._h_fail_copy)
         t.register_handler(A_SHARD_RECOVERED, self._h_shard_recovered)
+        t.register_handler(A_SEARCH_SHARD_READY,
+                           self._h_search_shard_ready)
+        t.register_handler(A_PUBLISH_SEARCH_CKPT,
+                           self._h_publish_search_ckpt)
         t.register_handler(A_BAN_PARENT, self._h_ban_parent)
         t.register_handler(A_INSIGHTS, self._h_insights)
         # restart: reopen local shards from the restored committed state
@@ -192,6 +248,40 @@ class ClusterNode:
         restored = self.coordinator.state()
         if restored.indices:
             self._apply_state(restored, recover=False)
+
+    # -- write-path isolation (search-role nodes) --------------------------
+
+    def _register_write_handlers(self, t: TransportService):
+        """The write/replication transport surface, registered ONLY on
+        data-role nodes.  A search-only node registers a rejecting stub
+        for every ``WRITE_ACTIONS`` entry — a misrouted write fails loud
+        with a clear verdict instead of silently mutating searcher
+        state — and leaves the peer-recovery / segment-fetch family
+        unregistered entirely (searchers are never a recovery source).
+        ``tools/check_searcher_write_isolation.py`` (tier-1) pins write
+        registrations to this method."""
+        write_handlers = {A_WRITE_SHARD: self._h_write_shard,
+                          A_REPLICATE_OP: self._h_replicate_op}
+        assert set(write_handlers) == set(WRITE_ACTIONS)
+        for action, handler in write_handlers.items():
+            if self.is_data:
+                t.register_handler(action, handler)
+            else:
+                t.register_handler(action, self._reject_write(action))
+        if self.is_data:
+            t.register_handler(A_PUBLISH_CKPT, self._h_publish_ckpt)
+            t.register_handler(A_FETCH_SEGMENTS, self._h_fetch_segments)
+            t.register_handler(A_START_RECOVERY, self._h_start_recovery)
+
+    def _reject_write(self, action: str):
+        from opensearch_tpu.common.errors import IllegalArgumentError
+
+        def handler(payload: dict) -> dict:
+            raise IllegalArgumentError(
+                f"node [{self.node_id}] has roles {list(self.roles)}: "
+                f"write action [{action}] is rejected on the search "
+                "tier")
+        return handler
 
     # -- state application (IndicesClusterStateService analog) ------------
 
@@ -212,6 +302,7 @@ class ClusterNode:
             self.response_collector.remove_node(gone)
         to_promote: list[tuple] = []
         to_recover: list[tuple] = []
+        to_refill: list[tuple] = []
         to_fail_corrupt: list[tuple] = []
         with self._lock:
             for index, meta in state.indices.items():
@@ -222,6 +313,9 @@ class ClusterNode:
                         mine[s] = "primary"
                     elif self.node_id in (entry.get("replicas") or []):
                         mine[s] = "replica"
+                    elif self.node_id in (entry.get("search_replicas")
+                                          or []):
+                        mine[s] = "search"
                 svc = self.indices.get(index)
                 if svc is None:
                     if mine:
@@ -241,11 +335,25 @@ class ClusterNode:
                         svc.remove_local_shard(s)
                         self._roles.pop((index, s), None)
                         self._recovered.discard((index, s))
+                        self._search_published.pop((index, s), None)
+                        self._search_installed.pop((index, s), None)
                 for s, role in mine.items():
                     entry = routing[s]
                     prev = self._roles.get((index, s))
                     self._roles[(index, s)] = role
                     engine = svc.local_shards.get(s)
+                    if role == "search":
+                        # search-only copy: stateless over the remote
+                        # store — every install path is a cache refill,
+                        # including the corruption case (_on_corruption
+                        # resets + re-pulls, no A_FAIL_COPY round-trip)
+                        if engine is not None:
+                            engine.search_only = True
+                        if ((index, s) not in self._recovered
+                                and (index, s) not in self._recovering):
+                            self._recovering.add((index, s))
+                            to_refill.append((index, s))
+                        continue
                     if (engine is not None
                             and engine.corruption is not None
                             and (index, s) not in self._corrupt_handling):
@@ -287,6 +395,11 @@ class ClusterNode:
                 target=self._run_recovery, args=(index, s, primary),
                 daemon=True,
                 name=f"recovery-{self.node_id}-{index}-{s}").start()
+        for index, s in to_refill:
+            threading.Thread(
+                target=self._run_searcher_recovery, args=(index, s),
+                daemon=True,
+                name=f"refill-{self.node_id}-{index}-{s}").start()
         for index, s, exc in to_fail_corrupt:
             threading.Thread(
                 target=self._on_corruption, args=(index, s, exc),
@@ -479,7 +592,8 @@ class ClusterNode:
         master = self._master()
         if master == self.node_id:
             handler = {A_CREATE_INDEX: self._h_create_index,
-                       A_DELETE_INDEX: self._h_delete_index}[action]
+                       A_DELETE_INDEX: self._h_delete_index,
+                       A_UPDATE_SETTINGS: self._h_update_settings}[action]
             return handler(payload)
         return self.transport.send_request(master, action, payload,
                                            timeout=10.0)
@@ -492,6 +606,43 @@ class ClusterNode:
 
     def delete_index(self, name: str) -> dict:
         return self._on_master(A_DELETE_INDEX, {"index": name})
+
+    def update_index_settings(self, name: str,
+                              settings: Optional[dict] = None) -> dict:
+        """Live index-settings update (the `_settings` API at cluster
+        scope).  ``number_of_search_replicas`` scales the searcher
+        fleet elastically: raising it allocates fresh search slots that
+        refill from the remote store (zero reindexing, zero primary
+        involvement); lowering it drops slots on the next applied
+        state.  ``number_of_replicas`` re-allocates the write tier the
+        same way; ``number_of_shards`` is immutable like the
+        reference's."""
+        return self._on_master(A_UPDATE_SETTINGS,
+                               {"index": name,
+                                "settings": settings or {}})
+
+    def _h_update_settings(self, payload: dict) -> dict:
+        from opensearch_tpu.common.errors import IllegalArgumentError
+
+        name = payload["index"]
+        ups = dict(payload.get("settings") or {})
+        if "index" in ups and isinstance(ups["index"], dict):
+            ups.update(ups.pop("index"))
+        if "number_of_shards" in ups:
+            raise IllegalArgumentError(
+                "final index setting [number_of_shards] cannot be "
+                "updated on a live index")
+
+        def update(state: ClusterState) -> ClusterState:
+            if name not in state.indices:
+                raise IndexNotFoundError(name)
+            indices = dict(state.indices)
+            meta = dict(indices[name])
+            meta["settings"] = {**(meta.get("settings") or {}), **ups}
+            indices[name] = meta
+            return allocate_shards(state.with_(indices=indices))
+        self.coordinator.submit_state_update(update)
+        return {"acknowledged": True}
 
     def _h_create_index(self, payload: dict) -> dict:
         from opensearch_tpu.common.errors import IndexAlreadyExistsError
@@ -750,6 +901,24 @@ class ClusterNode:
         role = self._roles.get((index, shard))
         if role is None:
             return
+        if role == "search":
+            # a corrupt search-only copy never runs the A_FAIL_COPY
+            # protocol (it holds no write state the master must fence):
+            # drop the local files and refill from the remote store
+            svc = self.indices.get(index)
+            if svc is None:
+                return
+            svc.reset_local_shard(shard)
+            with self._lock:
+                self._recovered.discard((index, shard))
+                if (index, shard) in self._recovering:
+                    return
+                self._recovering.add((index, shard))
+            threading.Thread(
+                target=self._run_searcher_recovery, args=(index, shard),
+                daemon=True,
+                name=f"re-refill-{self.node_id}-{index}-{shard}").start()
+            return
         if not self._report_failed_copy(index, shard, self.node_id,
                                         corrupted=True):
             return   # no master: keep the marker, stay read-refusing
@@ -839,19 +1008,75 @@ class ClusterNode:
             except OpenSearchTpuError:
                 continue
             replicas = entry.get("replicas") or []
-            if not replicas:
-                continue
-            ckpt = svc.engine_for(shard).checkpoint_info()
-            payload2 = {"index": index, "shard": shard, "ckpt": ckpt}
-            futures = [self.transport.submit_request(rep, A_PUBLISH_CKPT,
-                                                     payload2)
-                       for rep in replicas]
-            for fut in futures:
-                try:
-                    fut.result(timeout=30.0)
-                except Exception:
-                    pass   # replica will catch up on the next checkpoint
+            if replicas:
+                ckpt = svc.engine_for(shard).checkpoint_info()
+                payload2 = {"index": index, "shard": shard, "ckpt": ckpt}
+                futures = [self.transport.submit_request(
+                    rep, A_PUBLISH_CKPT, payload2) for rep in replicas]
+                for fut in futures:
+                    try:
+                        fut.result(timeout=self.recovery_timeout)
+                    except Exception:
+                        pass  # replica catches up on the next checkpoint
+            searchers = entry.get("search_replicas") or []
+            if searchers and self.remote_store is not None:
+                self._publish_search_checkpoint(svc, index, shard,
+                                                searchers)
         return {"ok": True}
+
+    def _publish_search_checkpoint(self, svc, index: str, shard: int,
+                                   searchers: list) -> None:
+        """Primary side of search-tier segment replication: commit the
+        shard, upload its segment files content-addressed into the
+        remote store (PR-8 manifests; the snapshot blob dedup space, so
+        unchanged segments upload nothing), then publish a checkpoint
+        NAMING the remote blob digests to every search replica.  The
+        searchers pull from the store — this RPC carries metadata only,
+        and a failed/unreachable searcher just lags (bounded by
+        ``search.replication.max_lag``) until the next publish or its
+        own refill."""
+        from opensearch_tpu.common.telemetry import metrics
+        from opensearch_tpu.index.remote_store import upload_shard
+        engine = svc.engine_for(shard)
+        try:
+            commit = engine.flush()
+            info = upload_shard(
+                self.remote_store, index, shard, engine, commit,
+                extra={"primary_term": engine.primary_term})
+        except (OpenSearchTpuError, OSError) as e:
+            # the remote store being down must never fail the refresh:
+            # searchers serve their last installed checkpoint and catch
+            # up when the store returns
+            import logging
+            logging.getLogger("opensearch_tpu.remote_store").warning(
+                "[%s][%s] search-checkpoint upload failed: %s",
+                index, shard, e)
+            metrics().counter("segrep.publish_failures").inc()
+            return
+        metrics().counter("segrep.publishes").inc()
+        ckpt = engine.checkpoint_info()
+        committed = set(commit["segments"])
+        # publish exactly the uploaded commit: a concurrent refresh may
+        # already have grown engine state past what the store holds
+        ckpt["segments"] = [sid for sid in ckpt["segments"]
+                            if sid in committed]
+        ckpt["max_seq_no"] = commit["max_seq_no"]
+        files: dict[str, list] = {}
+        for fmeta in info["file_metas"]:
+            for suffix in (".npz", ".json", ".src", ".liv"):
+                if fmeta["name"].endswith(suffix):
+                    files.setdefault(
+                        fmeta["name"][:-len(suffix)], []).append(fmeta)
+                    break
+        payload = {"index": index, "shard": shard, "ckpt": ckpt,
+                   "files": files}
+        futures = [self.transport.submit_request(
+            node, A_PUBLISH_SEARCH_CKPT, payload) for node in searchers]
+        for fut in futures:
+            try:
+                fut.result(timeout=self.recovery_timeout)
+            except Exception:
+                pass   # the searcher lags; bounded by max_lag deranking
 
     def _h_publish_ckpt(self, payload: dict) -> dict:
         """Replica: diff the checkpoint against local segments, pull the
@@ -866,10 +1091,22 @@ class ClusterNode:
         blobs = {}
         if missing:
             primary = self._entry(index, shard).get("primary")
-            resp = self.transport.send_request(
-                primary, A_FETCH_SEGMENTS,
-                {"index": index, "shard": shard, "seg_ids": missing},
-                timeout=30.0)
+            # transient drops/timeouts retry with bounded backoff under
+            # the configurable recovery budget instead of one bare
+            # 30s-hardcoded RPC; attempts/retries/exhaustions land in
+            # the retry.recovery.fetch.* counters (_nodes/stats
+            # `recovery`)
+            resp = retry_call(
+                "recovery.fetch",
+                lambda: self.transport.send_request(
+                    primary, A_FETCH_SEGMENTS,
+                    {"index": index, "shard": shard,
+                     "seg_ids": missing},
+                    timeout=self.recovery_timeout),
+                max_attempts=3, base_delay=0.05, max_delay=0.5,
+                budget_s=3.0 * self.recovery_timeout,
+                seed=zlib.crc32(
+                    f"{self.node_id}/{index}/{shard}/fetch".encode()))
             blobs = resp["blobs"]
         try:
             engine.install_checkpoint(ckpt, blobs)
@@ -889,6 +1126,293 @@ class ClusterNode:
                 f"[{payload['index']}][{payload['shard']}] not on this node")
         engine = svc.engine_for(payload["shard"])
         return {"blobs": engine.segments_blobs(payload["seg_ids"])}
+
+    # -- search-replica tier (segrep over the remote store) ----------------
+
+    def _fetch_blob_verified(self, fmeta: dict) -> bytes:
+        """Pull one content-addressed blob through the node FileCache
+        and verify its CRC against the checkpoint manifest BEFORE any
+        byte reaches an installable file.  A corrupt blob is dropped
+        from the cache and re-fetched once (counted); a second failure
+        raises so the caller can mark the segment."""
+        from opensearch_tpu.common.telemetry import metrics
+
+        blob = fmeta["blob"]
+        want_crc = fmeta.get("crc32")
+
+        def fetch() -> bytes:
+            data = self.remote_store.blobs.read_blob(blob)
+            m = metrics()
+            m.counter("segrep.fetches").inc()
+            m.counter("segrep.bytes_pulled").inc(len(data))
+            return data
+
+        for attempt in range(2):
+            path = self.file_cache.get(blob, fetch)
+            with open(path, "rb") as f:
+                data = f.read()
+            if want_crc is None or \
+                    (zlib.crc32(data) & 0xFFFFFFFF) == int(want_crc):
+                return data
+            metrics().counter("segrep.corrupt_blobs").inc()
+            self.file_cache.invalidate(blob)
+        # the cache holds nothing for this digest now: a repaired
+        # repository heals on the next fetch
+        raise CorruptIndexError(
+            f"remote blob [{blob}] for [{fmeta['name']}] failed CRC "
+            "verification after re-fetch")
+
+    def _fetch_remote_segment(self, engine, seg_id: str,
+                              fmetas: list):
+        """Materialize one segment from the remote store into the local
+        shard directory: every file pulled via the FileCache (stable
+        cache paths, symlinked like a searchable-snapshot mount, so an
+        evicted blob heals by re-fetch) and CRC-verified; the PR-8
+        commit manifest is regenerated from the verified bytes so the
+        store stays checksum-verifiable.  Repeated corruption writes a
+        marker naming the segment (``corrupted_<seg>.json``)."""
+        from opensearch_tpu.index.store import (file_checksum,
+                                                load_segment,
+                                                write_corruption_marker,
+                                                write_segment_manifest)
+        from opensearch_tpu.index.remote_store import \
+            validate_manifest_name
+
+        seg_dir = os.path.join(engine.data_path, "segments")
+        os.makedirs(seg_dir, exist_ok=True)
+        entries = {}
+        # the whole file set stays pinned until the segment is LOADED:
+        # fetching file N must not evict file 1 before the bytes are
+        # staged (materialize_shard's pin discipline)
+        with self.file_cache.pin({f["blob"] for f in fmetas}):
+            try:
+                for fmeta in sorted(fmetas, key=lambda f: f["name"]):
+                    name = fmeta["name"]
+                    validate_manifest_name(name)
+                    data = self._fetch_blob_verified(fmeta)
+                    link = os.path.join(seg_dir, name)
+                    if os.path.islink(link) or os.path.exists(link):
+                        os.remove(link)
+                    os.symlink(self.file_cache.path(fmeta["blob"]),
+                               link)
+                    if not name.endswith(".liv"):
+                        entries[name] = file_checksum(data)
+            except CorruptIndexError as e:
+                # marker on repeat: the refill/ckpt install that hits
+                # this again resets the copy instead of trusting the
+                # store
+                write_corruption_marker(seg_dir, seg_id, str(e))
+                raise
+            write_segment_manifest(seg_dir, seg_id, entries)
+            return load_segment(seg_dir, seg_id)
+
+    def _h_publish_search_ckpt(self, payload: dict) -> dict:
+        """Search replica: install a primary-published checkpoint by
+        pulling the named blob digests from the remote store — the
+        primary is NEVER contacted.  A failed install leaves the
+        recorded published seq ahead of the installed one: that gap IS
+        the replication lag the C3 selector bounds."""
+        from opensearch_tpu.common.telemetry import metrics
+
+        index, shard = payload["index"], payload["shard"]
+        ckpt, files = payload["ckpt"], payload.get("files") or {}
+        svc = self.indices.get(index)
+        if svc is None:
+            raise ShardNotFoundError(f"[{index}][{shard}] not on this node")
+        if self.remote_store is None or self.file_cache is None:
+            raise OpenSearchTpuError(
+                f"node [{self.node_id}] has no remote store / file "
+                "cache: cannot install search checkpoints")
+        engine = svc.engine_for(shard)
+        engine.search_only = True
+        key = (index, shard)
+        with self._lock:
+            self._search_published[key] = max(
+                self._search_published.get(key, -1),
+                int(ckpt["max_seq_no"]))
+        try:
+            if engine.corruption is not None:
+                # a marked copy re-pulls from scratch (cache refill is
+                # the searcher's only recovery path)
+                svc.reset_local_shard(shard)
+                engine = svc.engine_for(shard)
+                engine.search_only = True
+            have = {s.seg_id for s in engine.segments}
+            segs = {sid: self._fetch_remote_segment(
+                        engine, sid, files.get(sid) or [])
+                    for sid in ckpt["segments"] if sid not in have}
+            engine.install_remote_checkpoint(ckpt, segs)
+        except OpenSearchTpuError:
+            metrics().counter("segrep.install_failures").inc()
+            raise
+        svc.invalidate_searcher()
+        with self._lock:
+            self._search_installed[key] = max(
+                self._search_installed.get(key, -1),
+                int(ckpt["max_seq_no"]))
+        metrics().counter("segrep.installs").inc()
+        return {"acknowledged": True, "lag": self.search_lag()}
+
+    def _run_searcher_recovery(self, index: str, shard: int):
+        """Bootstrap (or re-bootstrap) a search-only copy purely from
+        the remote store: read the shard's manifest, pull every blob
+        through the FileCache, install.  Zero primary-directed RPCs —
+        the only transport traffic is the readiness report to the
+        cluster manager, so a primary failure never stalls searcher
+        recovery (the tier-separation point)."""
+        from opensearch_tpu.common.telemetry import metrics
+        from opensearch_tpu.index.remote_store import read_manifest
+
+        t0 = time.monotonic()
+        try:
+            svc = self.indices.get(index)
+            if svc is None:
+                return
+            engine = svc.engine_for(shard)
+            if engine.corruption is not None:
+                svc.reset_local_shard(shard)
+                engine = svc.engine_for(shard)
+            engine.search_only = True
+            manifest = None
+            if self.remote_store is not None:
+                try:
+                    manifest = read_manifest(self.remote_store, index,
+                                             shard)
+                except (OpenSearchTpuError, OSError, ValueError):
+                    # store unreachable: stay un-recovered; the next
+                    # applied state (or published checkpoint) retries
+                    metrics().counter("segrep.refill_failures").inc()
+                    return
+            installed_seq = -1
+            if manifest is not None:
+                files: dict[str, list] = {}
+                for fmeta in manifest["files"]:
+                    for suffix in (".npz", ".json", ".src", ".liv"):
+                        if fmeta["name"].endswith(suffix):
+                            files.setdefault(
+                                fmeta["name"][:-len(suffix)],
+                                []).append(fmeta)
+                            break
+                commit = manifest["commit"]
+                have = {s.seg_id for s in engine.segments}
+                try:
+                    segs = {sid: self._fetch_remote_segment(
+                                engine, sid, files.get(sid) or [])
+                            for sid in commit["segments"]
+                            if sid not in have}
+                except CorruptIndexError:
+                    metrics().counter("segrep.refill_failures").inc()
+                    return   # marker written; next attempt resets
+                installed_seq = int(commit["max_seq_no"])
+                engine.install_remote_checkpoint(
+                    {"segments": commit["segments"],
+                     "max_seq_no": installed_seq,
+                     "primary_term": int(manifest.get(
+                         "primary_term", 1))}, segs)
+                svc.invalidate_searcher()
+            key = (index, shard)
+            with self._lock:
+                self._search_published[key] = max(
+                    self._search_published.get(key, -1), installed_seq)
+                self._search_installed[key] = max(
+                    self._search_installed.get(key, -1), installed_seq)
+            master = self._master()
+            payload = {"index": index, "shard": shard,
+                       "node": self.node_id}
+            if master == self.node_id:
+                self._h_search_shard_ready(payload)
+            else:
+                retry_call(
+                    "recovery.report",
+                    lambda: self.transport.send_request(
+                        master, A_SEARCH_SHARD_READY, payload,
+                        timeout=10.0),
+                    max_attempts=2, base_delay=0.05,
+                    seed=zlib.crc32(self.node_id.encode()))
+            with self._lock:
+                self._recovered.add(key)
+            m = metrics()
+            m.counter("segrep.refills").inc()
+            m.histogram("segrep.refill_ms").observe(
+                (time.monotonic() - t0) * 1000.0)
+        except OpenSearchTpuError:
+            pass   # next cluster-state application retries
+        finally:
+            with self._lock:
+                self._recovering.discard((index, shard))
+
+    def _h_search_shard_ready(self, payload: dict) -> dict:
+        """Master: a search replica finished its remote-store refill —
+        admit it to the shard group's ``search_in_sync`` serving set."""
+        index, shard, node = (payload["index"], payload["shard"],
+                              payload["node"])
+
+        def update(state: ClusterState) -> ClusterState:
+            routing = {k: [dict(e) for e in v]
+                       for k, v in state.routing.items()}
+            entries = routing.get(index)
+            if entries is None or shard >= len(entries):
+                return state
+            e = entries[shard]
+            if node in (e.get("search_replicas") or []) \
+                    and node not in (e.get("search_in_sync") or []):
+                e["search_in_sync"] = \
+                    list(e.get("search_in_sync") or []) + [node]
+                return state.with_(routing=routing)
+            return state
+        self.coordinator.submit_state_update(update)
+        return {"acknowledged": True}
+
+    def search_lag(self) -> int:
+        """This searcher's replication lag: max over local search-only
+        shards of (last published checkpoint seq seen) - (last
+        installed seq) — 0 when fully caught up.  Piggybacked on every
+        search response and fault-detection ping (``node_load``)."""
+        with self._lock:
+            return max(
+                (max(0, p - self._search_installed.get(k, -1))
+                 for k, p in self._search_published.items()),
+                default=0)
+
+    def shard_search_lag(self, index: str, shard: int) -> Optional[int]:
+        key = (index, shard)
+        with self._lock:
+            if key not in self._search_published:
+                return None
+            return max(0, self._search_published[key]
+                       - self._search_installed.get(key, -1))
+
+    def search_installed_seq(self, index: str, shard: int) -> int:
+        """Highest checkpoint seq this searcher has installed for the
+        shard (-1 = nothing installed) — the harness's catch-up probe."""
+        with self._lock:
+            return self._search_installed.get((index, shard), -1)
+
+    def search_tier_stats(self) -> dict:
+        """The searcher-tier observability block (``_nodes/stats``-
+        style): role, per-shard lag, FileCache pressure, and the
+        segrep.* counter family."""
+        from opensearch_tpu.common.telemetry import metrics
+
+        m = metrics()
+        with self._lock:
+            lags = {f"{k[0]}/{k[1]}":
+                    max(0, p - self._search_installed.get(k, -1))
+                    for k, p in sorted(self._search_published.items())}
+        return {
+            "roles": list(self.roles),
+            "max_lag": max(lags.values(), default=0),
+            "shard_lag": lags,
+            "file_cache": (self.file_cache.stats()
+                           if self.file_cache is not None else None),
+            # metric-name-ok: bounded segrep counter family
+            "segrep": {name: m.counter(f"segrep.{name}").value
+                       for name in ("publishes", "publish_failures",
+                                    "installs", "install_failures",
+                                    "fetches", "bytes_pulled",
+                                    "corrupt_blobs", "refills",
+                                    "refill_failures")},
+        }
 
     # -- task cancellation propagation -------------------------------------
 
@@ -930,7 +1454,7 @@ class ClusterNode:
         tasks = self.task_manager.list()
         with self._lock:
             service_ewma = self._service_time_ewma.value
-        return {
+        out = {
             "node": self.node_id,
             "duress": self.search_backpressure.in_duress(),
             "fs_healthy": self.fs_health.healthy,
@@ -940,6 +1464,11 @@ class ClusterNode:
             "active_tasks": len(tasks),
             "service_time_ewma_nanos": int(service_ewma or 0),
         }
+        if self.is_search:
+            # checkpoint lag rides every ping/response so coordinators
+            # can bound searcher staleness (search.replication.max_lag)
+            out["search_lag"] = self.search_lag()
+        return out
 
     def _copy_candidates(self, entry: dict, spill: int = 0,
                          prov: "Optional[dict]" = None) -> list[str]:
@@ -970,6 +1499,25 @@ class ClusterNode:
         if self.node_id in order:
             order.remove(self.node_id)
             order.insert(0, self.node_id)
+        # search-replica tier: READY searchers lead the baseline order
+        # — even over a local write copy, the way the reference's
+        # search-role routing strictly prefers the serving tier (taking
+        # reads off the write path is the tier's point) — unless the
+        # coordinator has recorded them past the checkpoint-lag bound,
+        # in which case they fall to copy-of-last-resort like a duress
+        # node.  Write copies stay in the list, so the search tier
+        # failing wholesale degrades to the legacy read path instead of
+        # failing the shard.
+        searchers = search_copies_of(entry)
+        if searchers:
+            collector = self.response_collector
+            fresh = [n for n in searchers if not collector.lagging(n)]
+            stale = [n for n in searchers if collector.lagging(n)]
+            if self.node_id in fresh:      # local searcher copy first
+                fresh.remove(self.node_id)
+                fresh.insert(0, self.node_id)
+            order = (fresh + [n for n in order if n not in fresh]
+                     + [n for n in stale if n not in order])
         if prov is not None:
             prov["legacy_order"] = list(order)
             prov["spill"] = int(spill)
@@ -987,7 +1535,8 @@ class ClusterNode:
             # round-robin the healthy prefix: msearch batch member i
             # starts at healthy copy i % n (replica spill)
             healthy = [n for n in ranked
-                       if not collector.in_duress(n)]
+                       if not collector.in_duress(n)
+                       and not collector.lagging(n)]
             if len(healthy) > 1:
                 k = spill % len(healthy)
                 ranked = (healthy[k:] + healthy[:k]
@@ -1001,7 +1550,8 @@ class ClusterNode:
             pref = ranked[0]
             if collector.outstanding(pref) > rc.SPILL_OUTSTANDING:
                 alts = [n for n in ranked[1:]
-                        if not collector.in_duress(n)]
+                        if not collector.in_duress(n)
+                        and not collector.lagging(n)]
                 if alts:
                     alt = min(alts, key=collector.outstanding)
                     if collector.outstanding(alt) \
@@ -1569,6 +2119,44 @@ class ClusterNode:
         if local_markers:
             out["corruption_markers"] = local_markers
         return out
+
+    def cat_shards(self) -> list:
+        """Cluster-scope ``_cat/shards`` rows: one per shard copy,
+        including the search tier (``prirep`` "s") with its replication
+        lag — the coordinator's recorded lag for remote searchers, the
+        live value for this node's own copies."""
+        state = self.coordinator.state()
+        collector = self.response_collector
+        rows = []
+        for index in sorted(state.routing):
+            for s, e in enumerate(state.routing[index]):
+                if e.get("primary"):
+                    rows.append({"index": index, "shard": str(s),
+                                 "prirep": "p", "state": "STARTED",
+                                 "node": e["primary"]})
+                else:
+                    rows.append({"index": index, "shard": str(s),
+                                 "prirep": "p", "state": "UNASSIGNED",
+                                 "node": None})
+                in_sync = set(e.get("in_sync") or [])
+                for r in e.get("replicas") or []:
+                    rows.append({
+                        "index": index, "shard": str(s), "prirep": "r",
+                        "state": ("STARTED" if r in in_sync
+                                  else "INITIALIZING"),
+                        "node": r})
+                ready = set(e.get("search_in_sync") or [])
+                for r in e.get("search_replicas") or []:
+                    lag = (self.shard_search_lag(index, s)
+                           if r == self.node_id
+                           else collector.search_lag(r))
+                    rows.append({
+                        "index": index, "shard": str(s), "prirep": "s",
+                        "state": ("STARTED" if r in ready
+                                  else "INITIALIZING"),
+                        "node": r,
+                        "search.lag": "-" if lag is None else str(lag)})
+        return rows
 
     def cat_indices(self) -> list:
         """Cluster-scope ``_cat/indices`` rows with a real per-index

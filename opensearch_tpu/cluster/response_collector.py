@@ -56,6 +56,13 @@ SHED_ON_DURESS = True
 #: batch rotation is unaffected either way)
 SPILL_OUTSTANDING = 8
 
+#: checkpoint-lag bound for the search-replica tier (dynamic
+#: ``search.replication.max_lag``): a searcher whose piggybacked
+#: replication lag (ops behind the last published checkpoint it has
+#: seen) exceeds this is deranked like a duress node — retained as a
+#: copy of last resort, never the preferred copy
+SEARCH_MAX_LAG = 8
+
 #: duress sheds consult the coordinator's own admission-gate occupancy:
 #: a shard whose every copy reports duress is shed only when occupancy
 #: >= this fraction — below it the coordinator has capacity to try the
@@ -90,7 +97,7 @@ class NodeStatistics:
     __slots__ = ("node_id", "queue_size", "response_time_nanos",
                  "service_time_nanos", "duress", "duress_updated",
                  "last_update", "failure_count", "response_count",
-                 "outstanding")
+                 "outstanding", "search_lag")
 
     def __init__(self, node_id: str, now: float):
         self.node_id = node_id
@@ -103,6 +110,10 @@ class NodeStatistics:
         self.failure_count = 0
         self.response_count = 0
         self.outstanding = 0
+        # search-replica checkpoint lag (ops behind the last published
+        # checkpoint), piggybacked by searcher nodes; None = not a
+        # searcher / no evidence yet
+        self.search_lag = None
 
 
 class ResponseCollectorService:
@@ -139,6 +150,8 @@ class ResponseCollectorService:
         if "duress" in load:
             st.duress = bool(load["duress"])
             st.duress_updated = now
+        if "search_lag" in load:
+            st.search_lag = int(load["search_lag"])
         st.last_update = now
 
     def record_response(self, node: str, response_time_nanos: float,
@@ -218,6 +231,24 @@ class ResponseCollectorService:
         # stale flags expire: a shed copy must get re-probed eventually
         return (self._clock() - st.duress_updated) <= self.duress_ttl_s
 
+    def lagging(self, node: str) -> bool:
+        with self._lock:
+            return self._lagging_locked(node)
+
+    def _lagging_locked(self, node: str) -> bool:
+        """Search-replica checkpoint lag over the configured bound —
+        the C3 derank trigger for stale searchers (lag has no TTL: the
+        flag is refreshed by every ping/response the node answers, and
+        a node that stops answering fails over on its own)."""
+        st = self._nodes.get(node)
+        return (st is not None and st.search_lag is not None
+                and st.search_lag > SEARCH_MAX_LAG)
+
+    def search_lag(self, node: str):
+        with self._lock:
+            st = self._nodes.get(node)
+            return None if st is None else st.search_lag
+
     def _rank_locked(self, node: str, clients: int) -> Optional[float]:
         """C3 rank (lower = better); ``None`` until the coordinator has
         at least one measured response for the node."""
@@ -247,7 +278,13 @@ class ResponseCollectorService:
         with self._lock:
             clients = len(self._nodes)
             ranks = {n: self._rank_locked(n, clients) for n in candidates}
-            duress = {n: self._in_duress_locked(n) for n in candidates}
+            # a lagging search replica is penalized exactly like a node
+            # in duress: deranked behind every healthy copy, retained
+            # as a copy of last resort (stale results beat no results
+            # when nothing else answers under allow_partial)
+            duress = {n: (self._in_duress_locked(n)
+                          or self._lagging_locked(n))
+                      for n in candidates}
             # unranked candidates sit at the FLEET mean (every tracked
             # node, not just this shard's copies): an unprobed replica
             # must beat a copy the coordinator has watched fall behind,
@@ -280,6 +317,8 @@ class ResponseCollectorService:
                 out[node] = {
                     "rank": None if rank is None else round(rank, 3),
                     "in_duress": self._in_duress_locked(node),
+                    "search_lag": st.search_lag,
+                    "search_lagging": self._lagging_locked(node),
                     "outstanding_requests": st.outstanding,
                     "avg_queue_size":
                         None if st.queue_size.value is None

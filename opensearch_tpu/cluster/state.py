@@ -24,10 +24,29 @@ from typing import Optional
 
 
 def copies_of(entry: dict) -> list:
-    """All nodes holding a copy of the shard, primary first."""
+    """All nodes holding a WRITE copy of the shard, primary first.
+    Search-only replicas are deliberately excluded: they never ack
+    writes and never join the in-sync set (``search_copies_of``)."""
     out = [entry["primary"]] if entry.get("primary") else []
     out.extend(entry.get("replicas") or [])
     return out
+
+
+def search_copies_of(entry: dict) -> list:
+    """Search-only replica copies that completed their remote-store
+    refill (reported ready) — the searcher tier's serving set."""
+    ready = entry.get("search_in_sync") or []
+    return [n for n in (entry.get("search_replicas") or []) if n in ready]
+
+
+def node_roles(info: Optional[dict]) -> set:
+    """A node's role set from its discovery info.  Nodes that predate
+    roles (or joined with bare info) keep the legacy behavior: full
+    master-eligible data nodes."""
+    roles = (info or {}).get("roles")
+    if roles is None:
+        return {"master", "data"}
+    return set(roles)
 
 
 @dataclass(frozen=True)
@@ -182,12 +201,22 @@ def allocate_shards(state: ClusterState) -> ClusterState:
       in-sync set and join it when peer recovery completes
       (ReplicationTracker.markAllocationIdAsInSync analog);
     - a fresh primary with no surviving copy starts empty with an
-      in-sync set of just itself.
+      in-sync set of just itself;
+    - ``number_of_search_replicas`` slots are filled on search-role
+      nodes only (the ingest/search tier separation): search replicas
+      never hold write copies, start OUTSIDE ``search_in_sync`` and
+      join it when their remote-store refill completes.  Write copies
+      (primary/replicas) are conversely never placed on search-only
+      nodes.
     """
-    node_ids = sorted(state.nodes)
+    node_ids = sorted(n for n, info in state.nodes.items()
+                      if "data" in node_roles(info))
+    search_nodes = sorted(n for n, info in state.nodes.items()
+                          if "search" in node_roles(info))
     if not node_ids:
         return state
     counts = {n: 0 for n in node_ids}
+    s_counts = {n: 0 for n in search_nodes}
     routing: dict = {}
     # pass 1: retain what survives, decide promotions
     for index, meta in state.indices.items():
@@ -195,6 +224,9 @@ def allocate_shards(state: ClusterState) -> ClusterState:
         n_shards = int(settings.get("number_of_shards", 1))
         want_repl = min(int(settings.get("number_of_replicas", 0)),
                         len(node_ids) - 1)
+        want_search = min(
+            int(settings.get("number_of_search_replicas", 0) or 0),
+            len(search_nodes))
         old = state.routing.get(index, [])
         entries = []
         for s in range(n_shards):
@@ -221,9 +253,19 @@ def allocate_shards(state: ClusterState) -> ClusterState:
                     # not share a term with the new lineage, or replica
                     # term fencing cannot tell the two apart
                     term += 1
-            entries.append({"primary": primary, "replicas": replicas,
-                            "in_sync": in_sync, "primary_term": term,
-                            "_want": want_repl})
+            entry = {"primary": primary, "replicas": replicas,
+                     "in_sync": in_sync, "primary_term": term,
+                     "_want": want_repl, "_want_search": want_search}
+            # keep legacy entries byte-identical: the search-tier keys
+            # only appear once an index asks for (or held) searchers
+            s_repl = [r for r in (o.get("search_replicas") or [])
+                      if r in s_counts] if o else []
+            if want_search or s_repl:
+                entry["search_replicas"] = s_repl
+                entry["search_in_sync"] = [
+                    n for n in (o.get("search_in_sync") or [])
+                    if n in s_repl] if o else []
+            entries.append(entry)
         routing[index] = entries
     for entries in routing.values():
         for e in entries:
@@ -231,6 +273,8 @@ def allocate_shards(state: ClusterState) -> ClusterState:
                 counts[e["primary"]] += 1
             for r in e["replicas"]:
                 counts[r] += 1
+            for r in e.get("search_replicas") or []:
+                s_counts[r] += 1
     # pass 2: fill holes on least-loaded distinct nodes that the decider
     # chain allows (filter deciders + same-shard + shards-per-node —
     # cluster/routing/allocation/decider/)
@@ -277,4 +321,29 @@ def allocate_shards(state: ClusterState) -> ClusterState:
             e["in_sync"] = ([e["primary"]]
                             + [n for n in e["in_sync"]
                                if n != e["primary"] and n in holders])
+            # search-replica slots: trim past the (possibly shrunk)
+            # want, then fill holes on the least-loaded search nodes —
+            # a fresh slot starts outside search_in_sync until the
+            # searcher reports its remote refill done
+            want_search = e.pop("_want_search", 0)
+            if "search_replicas" in e or want_search:
+                s_repl = list(e.get("search_replicas") or [])
+                for gone in s_repl[want_search:]:
+                    s_counts[gone] -= 1
+                s_repl = s_repl[:want_search]
+                while len(s_repl) < want_search:
+                    # a dual-role node already holding a write copy of
+                    # this shard is skipped (SameShardAllocationDecider
+                    # across tiers)
+                    cands = [n for n in sorted(s_counts)
+                             if n not in s_repl and n not in holders]
+                    if not cands:
+                        break
+                    target = min(cands, key=lambda n: s_counts[n])
+                    s_repl.append(target)
+                    s_counts[target] += 1
+                e["search_replicas"] = s_repl
+                e["search_in_sync"] = [
+                    n for n in (e.get("search_in_sync") or [])
+                    if n in s_repl]
     return state.with_(routing=routing)

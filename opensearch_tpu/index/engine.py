@@ -78,6 +78,11 @@ class InternalEngine:
         self.primary_term = 1
         self._lock = threading.RLock()
         self._closed = False
+        # search-only replica engine (the ingest/search tier split):
+        # segments arrive exclusively via remote-store checkpoint
+        # installs — every write entry point refuses, keeping searchers
+        # stateless and out of the replication stream entirely
+        self.search_only = False
         # set when the on-disk store failed verification (marker found or
         # checksum mismatch): the engine refuses reads/writes so a corrupt
         # copy can never serve wrong data (Store.failIfCorrupted)
@@ -188,6 +193,14 @@ class InternalEngine:
         if self.corruption is not None:
             raise self.corruption
 
+    def _ensure_writeable(self):
+        self._ensure_open()
+        if self.search_only:
+            from opensearch_tpu.common.errors import IllegalArgumentError
+            raise IllegalArgumentError(
+                f"[{self.index_name}][{self.shard_id}] is a search-only "
+                "replica: writes are rejected on the search tier")
+
     def verify_store(self):
         """Full checksum pass over every persisted segment's on-disk
         files (Store.verify analog).  Detected corruption writes a
@@ -264,7 +277,7 @@ class InternalEngine:
         from opensearch_tpu.common.telemetry import metrics
         t0 = _time.monotonic()
         with self._lock:
-            self._ensure_open()
+            self._ensure_writeable()
             entry = self._current_entry(doc_id)
             self._check_conflicts(doc_id, entry, if_seq_no, if_primary_term,
                                   version, version_type)
@@ -330,7 +343,7 @@ class InternalEngine:
                version: Optional[int] = None,
                version_type: str = "internal") -> OpResult:
         with self._lock:
-            self._ensure_open()
+            self._ensure_writeable()
             entry = self._current_entry(doc_id)
             self._check_conflicts(doc_id, entry, if_seq_no, if_primary_term,
                                   version, version_type)
@@ -379,7 +392,7 @@ class InternalEngine:
         entry + op buffer.  Fenced by primary term (a stale primary's ops
         are rejected, ref IndexShard.applyIndexOperationOnReplica:954)."""
         with self._lock:
-            self._ensure_open()
+            self._ensure_writeable()
             term = int(op.get("primary_term", 1))
             if term < self.primary_term:
                 raise VersionConflictError(
@@ -490,6 +503,41 @@ class InternalEngine:
                                  if s > covered}
             self._version_map = {k: v for k, v in self._version_map.items()
                                  if v.seq_no > covered}
+            self._searcher = None
+
+    def install_remote_checkpoint(self, ckpt: dict,
+                                  new_segments: dict):
+        """Search-only replica side: adopt a primary-published segment
+        set whose missing segments were already materialized from the
+        remote store (CRC-verified ``Segment`` objects in
+        ``new_segments``).  Unlike ``install_checkpoint`` there is no
+        replica op buffer to reconcile — searchers hold no write state
+        at all; live bitmaps come from the checkpoint when present
+        (push path) or from the segments' own ``.liv`` sidecars (pull /
+        recovery path)."""
+        with self._lock:
+            self._ensure_open()
+            term = int(ckpt.get("primary_term", 1))
+            if term < self.primary_term:
+                raise VersionConflictError(
+                    "<checkpoint>", f"primary term >= {self.primary_term}",
+                    f"stale primary term {term}")
+            self.primary_term = term
+            have = {s.seg_id: s for s in self.segments}
+            segments = []
+            for sid in ckpt["segments"]:
+                seg = have.get(sid)
+                if seg is None:
+                    seg = new_segments[sid]
+                live = (ckpt.get("live") or {}).get(sid)
+                if live is not None:
+                    seg.live = np.frombuffer(live, dtype=bool).copy()
+                segments.append(seg)
+                # the files backing this segment are on disk (cache
+                # links + regenerated manifests): never re-save them
+                self._persisted_segments.add(sid)
+            self.segments = segments
+            self._seq_no = max(self._seq_no, int(ckpt["max_seq_no"]))
             self._searcher = None
 
     def promote_to_primary(self, term: int):

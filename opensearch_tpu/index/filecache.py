@@ -17,6 +17,19 @@ import threading
 from collections import OrderedDict
 
 
+class _InFlight:
+    """One pending fetch: waiters park on ``ev``; a failed fetch leaves
+    its exception in ``error`` so every waiter re-raises it instead of
+    silently turning into a fresh fetcher (a dead repository would
+    otherwise stampede: N waiters -> N sequential failing fetches)."""
+
+    __slots__ = ("ev", "error")
+
+    def __init__(self):
+        self.ev = threading.Event()
+        self.error = None
+
+
 class FileCache:
     """Bounded content-addressed file cache with LRU eviction.
 
@@ -33,14 +46,17 @@ class FileCache:
         os.makedirs(cache_dir, exist_ok=True)
         self._lock = threading.RLock()
         self._entries: "OrderedDict[str, int]" = OrderedDict()  # sha->bytes
-        self._in_flight: dict[str, threading.Event] = {}
+        self._in_flight: dict[str, _InFlight] = {}
         # sha -> pin count; pinned blobs are never evicted (mount in
         # progress); counted so nested/overlapping pins compose
         self._pinned: dict[str, int] = {}
         self.hits = self.misses = self.evictions = 0
         for name in sorted(os.listdir(cache_dir)):      # warm restart
             p = os.path.join(cache_dir, name)
-            if os.path.isfile(p) and not name.endswith(".tmp"):
+            # staging files are named <sha>.tmp.<thread-id> — match the
+            # marker anywhere, not just as a suffix, or a crashed
+            # fetch's leftover gets indexed as a (corrupt) cache entry
+            if os.path.isfile(p) and ".tmp" not in name:
                 self._entries[name] = os.path.getsize(p)
 
     def path(self, sha: str) -> str:
@@ -59,12 +75,18 @@ class FileCache:
                     self._entries.move_to_end(sha)
                     self.hits += 1
                     return self.path(sha)
-                ev = self._in_flight.get(sha)
-                if ev is None:
-                    self._in_flight[sha] = threading.Event()
+                inf = self._in_flight.get(sha)
+                if inf is None:
+                    self._in_flight[sha] = _InFlight()
                     self.misses += 1
                     break               # this thread fetches
-            ev.wait()                   # another thread is fetching it
+            inf.ev.wait()               # another thread is fetching it
+            if inf.error is not None:
+                # the fetch this thread deduped onto failed: propagate
+                # the SAME error to every waiter (never hang, never
+                # stampede the repository with N retries)
+                raise inf.error
+        inf = self._in_flight[sha]
         try:
             data = fetch()
             tmp = self.path(sha) + ".tmp." + str(threading.get_ident())
@@ -78,9 +100,23 @@ class FileCache:
                 self._entries[sha] = len(data)
                 self._evict(keep=sha)
             return self.path(sha)
+        except BaseException as e:
+            inf.error = e
+            raise
         finally:
             with self._lock:
-                self._in_flight.pop(sha).set()
+                self._in_flight.pop(sha, None)
+            inf.ev.set()
+
+    def invalidate(self, sha: str) -> None:
+        """Drop a cached blob (its bytes failed post-fetch verification):
+        the next ``get`` re-fetches from the repository."""
+        with self._lock:
+            self._entries.pop(sha, None)
+            try:
+                os.remove(self.path(sha))
+            except OSError:
+                pass
 
     def pin(self, shas):
         """Context manager: keep ``shas`` out of eviction while a mount
@@ -162,4 +198,10 @@ class FileCache:
                     "size_in_bytes": sum(self._entries.values()),
                     "max_size_in_bytes": self.max_bytes,
                     "hits": self.hits, "misses": self.misses,
-                    "evictions": self.evictions}
+                    "evictions": self.evictions,
+                    # mount/refill pressure: bytes pinned against
+                    # eviction and fetches currently in flight
+                    "pinned_entries": len(self._pinned),
+                    "pinned_bytes": sum(
+                        self._entries.get(s, 0) for s in self._pinned),
+                    "in_flight": len(self._in_flight)}

@@ -62,24 +62,31 @@ def upload_segment_files(repo, seg_dir: str, segments: list,
             else:
                 repo.blobs.write_blob(digest, data)
                 uploaded += 1
+            from opensearch_tpu.index.store import file_checksum
             files.append({"name": seg_id + suffix, "blob": digest,
-                          "size": len(data)})
+                          "size": len(data),
+                          # PR-8 integrity record: searchers pulling
+                          # this blob verify the CRC before install
+                          "crc32": file_checksum(data)["crc32"]})
     return files, uploaded, reused
 
 
 def upload_shard(repo, index_name: str, shard_id, engine,
-                 commit: dict) -> dict:
+                 commit: dict, extra: Optional[dict] = None) -> dict:
     """Mirror one shard's commit point into the repository.  Called
     after ``engine.flush()`` with its commit dict; incremental by
-    content hash (unchanged segments upload nothing)."""
+    content hash (unchanged segments upload nothing).  ``extra`` keys
+    (e.g. the search-tier checkpoint seq/term) ride in the manifest."""
     seg_dir = os.path.join(engine.data_path, "segments")
     files, uploaded, reused = upload_segment_files(
         repo, seg_dir, commit["segments"])
     manifest = {"commit": commit, "files": files}
+    if extra:
+        manifest.update(extra)
     shard_container(repo, index_name, shard_id).write_blob(
         "manifest.json", json.dumps(manifest).encode())
     return {"uploaded": uploaded, "reused": reused,
-            "files": len(files)}
+            "files": len(files), "file_metas": files}
 
 
 def read_manifest(repo, index_name: str, shard_id) -> Optional[dict]:

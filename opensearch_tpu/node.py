@@ -150,6 +150,11 @@ class Node:
         ars_shed_occ = Setting.float_setting(
             "search.replica_selection.shed_occupancy", 0.0,
             min_value=0.0, dynamic=True)
+        # search-replica tier: checkpoint lag (ops behind the last
+        # published checkpoint) past which a searcher is deranked by
+        # the C3 selector like a duress node
+        search_max_lag = Setting.int_setting(
+            "search.replication.max_lag", 8, min_value=0, dynamic=True)
         max_keep_alive = Setting.time_setting(
             "search.max_keep_alive", 24 * 3600.0, dynamic=True)
         default_keep_alive = Setting.time_setting(
@@ -189,6 +194,7 @@ class Node:
              identity_enabled, alloc_enable, backpressure_mode,
              bp_cpu, bp_heap, bp_queue, bp_streak, bp_max_cc,
              ars_enabled, ars_shed, ars_spill, ars_shed_occ,
+             search_max_lag,
              max_keep_alive, default_keep_alive, allow_partial,
              req_cache_size, ins_enabled, ins_top_n, ins_window,
              ins_coalesce, device_budget, batcher_enabled,
@@ -258,6 +264,11 @@ class Node:
         self.cluster_settings.add_settings_update_consumer(
             ars_shed_occ,
             lambda v: setattr(rc_mod, "SHED_OCCUPANCY", float(v)))
+        self.cluster_settings.add_settings_update_consumer(
+            search_max_lag,
+            lambda v: setattr(rc_mod, "SEARCH_MAX_LAG", int(v)))
+        rc_mod.SEARCH_MAX_LAG = int(
+            self.cluster_settings.get(search_max_lag))
         rc_mod.ADAPTIVE_ENABLED = bool(
             self.cluster_settings.get(ars_enabled))
         rc_mod.SHED_ON_DURESS = bool(self.cluster_settings.get(ars_shed))

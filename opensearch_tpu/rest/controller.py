@@ -782,7 +782,17 @@ class RestController:
                     # metric-name-ok: bounded recovery action names
                     "exhausted": m.counter(
                         f"retry.recovery.{name}.exhausted").value,
-                } for name in ("start", "report")},
+                } for name in ("start", "report", "fetch")},
+            # search-replica tier: remote-store segment replication
+            # accounting (publishes, searcher installs/refills, CRC
+            # re-fetches, bytes pulled through the FileCache)
+            "segment_replication": {
+                # metric-name-ok: bounded segrep counter family
+                name: m.counter(f"segrep.{name}").value
+                for name in ("publishes", "publish_failures",
+                             "installs", "install_failures", "fetches",
+                             "bytes_pulled", "corrupt_blobs",
+                             "refills", "refill_failures")},
             "shards": shards,
         }
 

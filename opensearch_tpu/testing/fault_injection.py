@@ -534,3 +534,68 @@ class DiskFaultInjector:
                                   "[fault_injection] injected fsync error",
                                   path)
         return self._real_fsync(fd)
+
+
+# ---------------------------------------------------------------------------
+# Remote blob-store fault injection (the search tier's "S3 is down")
+# ---------------------------------------------------------------------------
+
+
+class RemoteStoreFaultInjector:
+    """Deterministic remote-store outage: while active, the given
+    repositories' blob reads (searcher pulls) and/or writes (primary
+    uploads) raise ``RemoteStoreError`` — the blob-service-outage class
+    of fault the transport/disk injectors cannot reach, because the
+    store is accessed as a library, not over the cluster transport.
+
+    Each cluster node holds its OWN ``Repository`` object over the
+    shared location (every reference node names the same bucket), so
+    the injector patches the bound ``read_blob``/``write_blob`` of
+    every repo it is given.  Soak's ``stall_remote_store`` directive
+    stalls reads fleet-wide; ``release_remote_store`` restores."""
+
+    def __init__(self, repos):
+        self._repos = list(repos)
+        self._saved: list[tuple] = []
+        self.failed_reads = 0
+        self.failed_writes = 0
+        self._lock = threading.Lock()
+
+    def stall(self, reads: bool = True, writes: bool = False) -> None:
+        from opensearch_tpu.index.remote_store import RemoteStoreError
+        if self._saved:
+            return                       # already active
+        for repo in self._repos:
+            blobs = repo.blobs
+            self._saved.append(
+                (blobs, blobs.read_blob, blobs.write_blob))
+            if reads:
+                def failing_read(name, _inj=self, _repo=repo):
+                    with _inj._lock:
+                        _inj.failed_reads += 1
+                    raise RemoteStoreError(
+                        "[fault_injection] remote store stalled "
+                        f"(read of [{name}])")
+                blobs.read_blob = failing_read
+            if writes:
+                def failing_write(name, data, fail_if_exists=False,
+                                  _inj=self):
+                    with _inj._lock:
+                        _inj.failed_writes += 1
+                    raise RemoteStoreError(
+                        "[fault_injection] remote store stalled "
+                        f"(write of [{name}])")
+                blobs.write_blob = failing_write
+
+    def release(self) -> None:
+        for blobs, read, write in self._saved:
+            blobs.read_blob = read
+            blobs.write_blob = write
+        self._saved.clear()
+
+    def __enter__(self):
+        self.stall()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()

@@ -86,6 +86,8 @@ class SoakConfig:
                  vocab_size: int = 48, index: str = "soak",
                  shards: int = 2, replicas: int = 1,
                  node_ids: tuple = ("n0", "n1", "n2"),
+                 search_replicas: int = 0,
+                 searcher_ids: tuple = (),
                  client: str = "n1", concurrency: int = 1,
                  search_rpc_timeout: float = 0.5,
                  max_retries: int = 6,
@@ -102,6 +104,16 @@ class SoakConfig:
         self.shards = int(shards)
         self.replicas = int(replicas)
         self.node_ids = tuple(node_ids)
+        # search-only replica tier: ``searcher_ids`` name the
+        # search-role nodes (stateless over the shared remote store),
+        # ``search_replicas`` the per-shard searcher slots; > 0 enables
+        # the tier directive class (kill/add searcher, remote-store
+        # stall)
+        self.search_replicas = int(search_replicas)
+        self.searcher_ids = tuple(searcher_ids)
+        if self.search_replicas and not self.searcher_ids:
+            raise ValueError(
+                "search_replicas > 0 requires searcher_ids")
         self.client = client
         self.concurrency = int(concurrency)
         self.search_rpc_timeout = float(search_rpc_timeout)
@@ -132,6 +144,16 @@ class SoakConfig:
     def full(cls, **overrides) -> "SoakConfig":
         base = {"n_ops": 400, "n_docs": 400, "bulk_size": 10,
                 "vocab_size": 2000, "concurrency": 4}
+        base.update(overrides)
+        return cls(**base)
+
+    @classmethod
+    def tier(cls, **overrides) -> "SoakConfig":
+        """The search-tier scenario: 3 data nodes + 2 search-only
+        replicas per shard over the shared remote store, with the
+        searcher directive class (kill/add searcher mid-traffic,
+        remote-store stall) in the schedule."""
+        base = {"search_replicas": 2, "searcher_ids": ("s0", "s1")}
         base.update(overrides)
         return cls(**base)
 
@@ -261,7 +283,7 @@ class FaultSchedule:
                   0.60, 0.68, 0.76, 0.84, 0.90, 0.96):
             base = max(1, int(n * f)) + rng.randint(0, jitter)
             at.append(min(max(at[-1] if at else 1, base), n - 1))
-        return [
+        out = [
             {"step": at[0], "fault": "slow_node", "node": slow_victim,
              "seconds": 0.05, "times": 2},
             {"step": at[1], "fault": "drop_write", "node": drop_victim,
@@ -286,6 +308,28 @@ class FaultSchedule:
             {"step": at[11], "fault": "kill_leader"},
             {"step": at[12], "fault": "restart_killed"},
         ]
+        if config.search_replicas and config.searcher_ids:
+            # searcher-tier directive class: remote-store outage
+            # (stall + release), then kill a searcher mid-traffic and
+            # add a fresh one — SLOs must hold and doc-count+checksum
+            # convergence must survive the fleet rebalancing.  Seeded
+            # like the base schedule: paired directives stay ordered
+            # under the jitter.
+            s_at: list = []
+            for f in (0.20, 0.30, 0.44, 0.58):
+                base = max(1, int(n * f)) + rng.randint(0, jitter)
+                s_at.append(min(max(s_at[-1] if s_at else 1, base),
+                                n - 1))
+            victim = config.searcher_ids[0]
+            out += [
+                {"step": s_at[0], "fault": "stall_remote_store"},
+                {"step": s_at[1], "fault": "release_remote_store"},
+                {"step": s_at[2], "fault": "kill_searcher",
+                 "node": victim},
+                {"step": s_at[3], "fault": "add_searcher",
+                 "node": f"{victim}r"},
+            ]
+        return out
 
 
 class SoakRunner:
@@ -311,18 +355,64 @@ class SoakRunner:
             time.sleep(0.02)                 # deadline
         raise SoakHarnessError(f"soak harness: timed out waiting for {what}")
 
-    def _build_node(self, hub, nid: str, root: str):
+    def _build_node(self, hub, nid: str, root: str,
+                    roles: tuple = ("master", "data")):
         from opensearch_tpu.cluster.node import ClusterNode
         from opensearch_tpu.transport.service import (LocalTransport,
                                                       TransportService)
         svc = TransportService(nid, LocalTransport(hub))
+        # with a search tier configured, every node points at the same
+        # shared blob store (primaries upload, searchers refill)
+        remote = (f"{root}/remote" if self.config.search_replicas
+                  else None)
         node = ClusterNode(nid, f"{root}/{nid}", svc,
-                           list(self.config.node_ids))
+                           list(self.config.node_ids), roles=roles,
+                           remote_store_path=remote)
         # neutralize the real CPU probe: only SCHEDULED duress may fire
         # (a loaded CI host must not leak nondeterminism into verdicts)
         node.search_backpressure.trackers["cpu_usage"].probe = lambda: 0.0
         node.search_rpc_timeout = self.config.search_rpc_timeout
+        node.recovery_timeout = max(5.0, self.config.search_rpc_timeout)
         return node
+
+    def _searcher_info(self, nid: str) -> dict:
+        return {"name": nid, "roles": ["search"],
+                "master_eligible": False}
+
+    def _searchers_ready(self, ctx: dict) -> bool:
+        """Every shard's search slots are filled by live searcher nodes
+        and every filled slot has reported its remote refill done."""
+        nodes = ctx["nodes"]
+        state = nodes[ctx["leader"]].coordinator.state()
+        routing = state.routing.get(self.config.index, [])
+        alive = [nid for nid in ctx["searchers"] if nid in nodes
+                 and nid in state.nodes]
+        want = min(self.config.search_replicas, len(alive))
+        return bool(routing) and all(
+            len(e.get("search_replicas") or []) >= want
+            and set(e.get("search_replicas") or [])
+            == set(e.get("search_in_sync") or []) for e in routing)
+
+    def _searchers_caught_up(self, ctx: dict) -> bool:
+        """Post-drain: every ready searcher copy has installed a
+        checkpoint at (or past) its primary's current seq — the
+        precondition for doc-count/checksum parity with the write
+        tier."""
+        nodes = ctx["nodes"]
+        state = nodes[ctx["leader"]].coordinator.state()
+        for s, e in enumerate(state.routing.get(self.config.index, [])):
+            primary = e.get("primary")
+            if primary not in nodes:
+                return False
+            engine = nodes[primary].indices[
+                self.config.index].engine_for(s)
+            for r in e.get("search_replicas") or []:
+                if r not in nodes:
+                    return False
+                if nodes[r].search_installed_seq(
+                        self.config.index, s) < engine._seq_no:
+                    return False
+        return True
 
     def _in_sync_full(self, nodes, leader: str) -> bool:
         state = nodes[leader].coordinator.state()
@@ -446,6 +536,50 @@ class SoakRunner:
                 node = self._build_node(hub, victim, ctx["root"])
                 ctx["nodes"][victim] = node
                 self._readmit(ctx, victim)
+        elif fault == "kill_searcher":
+            victim = d.get("node") or next(iter(sorted(
+                ctx["searchers"])))
+            ctx["applied"][-1]["node"] = victim
+            if victim in nodes:
+                nodes[victim].stop()
+                nodes.pop(victim)
+                ctx["searchers"].discard(victim)
+                # the leader's checks evict the dead searcher; the
+                # surviving searcher keeps serving, traffic never stops
+                self._evict(ctx, victim)
+                self._wait(lambda: self._searchers_ready(ctx),
+                           timeout=30.0,
+                           what="tier rebalance after searcher kill")
+                _bump(ctx, "recoveries")
+        elif fault == "add_searcher":
+            nid = d["node"]
+            node = self._build_node(ctx["hub"], nid, ctx["root"],
+                                    roles=("search",))
+            ctx["nodes"][nid] = node
+            ctx["searchers"].add(nid)
+            leader = ctx["leader"]
+            nodes[leader].coordinator.add_node(
+                nid, self._searcher_info(nid))
+            # a FRESH searcher recovers purely by cache refill from the
+            # remote store — zero primary-directed RPCs (asserted by
+            # the acceptance test over transport accounting)
+            self._wait(lambda: self._searchers_ready(ctx),
+                       timeout=30.0,
+                       what=f"remote refill of fresh searcher [{nid}]")
+            _bump(ctx, "recoveries")
+        elif fault == "stall_remote_store":
+            from opensearch_tpu.testing.fault_injection import \
+                RemoteStoreFaultInjector
+            repos = [n.remote_store for n in nodes.values()
+                     if getattr(n, "is_search", False)
+                     and n.remote_store is not None]
+            inj = RemoteStoreFaultInjector(repos)
+            inj.stall()
+            ctx["remote_stall"] = inj
+        elif fault == "release_remote_store":
+            inj = ctx.pop("remote_stall", None)
+            if inj is not None:
+                inj.release()
         else:
             raise ValueError(f"unknown fault directive [{fault}]")
 
@@ -655,10 +789,14 @@ class SoakRunner:
         hub = LocalTransport.Hub()
         nodes = {nid: self._build_node(hub, nid, root)
                  for nid in cfg.node_ids}
+        for sid in cfg.searcher_ids:
+            nodes[sid] = self._build_node(hub, sid, root,
+                                          roles=("search",))
         ctx = {
             "lock": threading.Lock(),
             "hub": hub, "nodes": nodes, "root": root,
             "client": cfg.client, "leader": cfg.node_ids[0],
+            "searchers": set(cfg.searcher_ids),
             "faults": FaultInjector(hub, seed=cfg.seed),
             "applied": [], "saved_breaches": {},
             "rejected": 0, "partial_results": 0, "client_retries": 0,
@@ -680,10 +818,18 @@ class SoakRunner:
                 raise SoakHarnessError("initial election failed")
             self._wait(lambda: all(
                 nodes[i].coordinator.state().master_node == ctx["leader"]
-                for i in nodes), what="initial leader convergence")
+                for i in nodes if i not in ctx["searchers"]),
+                what="initial leader convergence")
+            for sid in sorted(ctx["searchers"]):
+                nodes[ctx["leader"]].coordinator.add_node(
+                    sid, self._searcher_info(sid))
+            settings = {"number_of_shards": cfg.shards,
+                        "number_of_replicas": cfg.replicas}
+            if cfg.search_replicas:
+                settings["number_of_search_replicas"] = \
+                    cfg.search_replicas
             nodes[ctx["client"]].create_index(cfg.index, {
-                "settings": {"number_of_shards": cfg.shards,
-                             "number_of_replicas": cfg.replicas},
+                "settings": settings,
                 "mappings": {"properties": {
                     "body": {"type": "text"},
                     "ts": {"type": "date"},
@@ -691,6 +837,9 @@ class SoakRunner:
                     "v": {"type": "long"}}}})
             self._wait(lambda: self._in_sync_full(nodes, ctx["leader"]),
                        what="initial shard allocation")
+            if ctx["searchers"]:
+                self._wait(lambda: self._searchers_ready(ctx),
+                           what="initial searcher refill")
             for doc_id, source in workload.seed_docs():
                 nodes[ctx["client"]].index_doc(cfg.index, doc_id, source)
             nodes[ctx["client"]].refresh(cfg.index)
@@ -709,6 +858,9 @@ class SoakRunner:
             stall = ctx.pop("stall", None)
             if stall is not None:
                 stall.release()
+            remote_stall = ctx.pop("remote_stall", None)
+            if remote_stall is not None:
+                remote_stall.release()
             ctx["faults"].clear()
             disk = ctx.pop("disk", None)
             if disk is not None:
@@ -732,6 +884,22 @@ class SoakRunner:
                        timeout=30.0, what="post-drain recovery")
             self._write_with_retry(
                 ctx, lambda: nodes[ctx["client"]].refresh(cfg.index))
+            if ctx["searchers"]:
+                # convergence must hold on the SEARCH tier too: every
+                # ready searcher installs the final checkpoint before
+                # the doc-count/checksum read (re-refreshing re-fires
+                # the publish for any copy that missed one mid-churn)
+                def tier_converged() -> bool:
+                    if not self._searchers_ready(ctx):
+                        return False
+                    if self._searchers_caught_up(ctx):
+                        return True
+                    self._write_with_retry(
+                        ctx, lambda: nodes[ctx["client"]].refresh(
+                            cfg.index))
+                    return self._searchers_caught_up(ctx)
+                self._wait(tier_converged, timeout=30.0,
+                           what="searcher-tier catch-up")
             final = self._final_state(ctx)
             # snapshot the client/coordinator node's query-insights
             # section while the cluster is still alive: an SLO breach
@@ -748,6 +916,9 @@ class SoakRunner:
             disk = ctx.pop("disk", None)
             if disk is not None:     # exception path: unpatch open/fsync
                 disk.deactivate()
+            remote_stall = ctx.pop("remote_stall", None)
+            if remote_stall is not None:   # exception path: unpatch reads
+                remote_stall.release()
             for n in list(nodes.values()):
                 n.stop()
         after = self._counter_snapshot()
@@ -771,6 +942,10 @@ class SoakRunner:
             "sheds": delta("search.replica_selection.sheds"),
             "reroutes": delta("search.replica_selection.reroutes"),
             "failovers": delta("search.shard_failover"),
+            # search-tier accounting (zeros when no tier configured)
+            "searcher_refills": delta("segrep.refills"),
+            "searcher_installs": delta("segrep.installs"),
+            "remote_bytes_pulled": delta("segrep.bytes_pulled"),
             "internal_retries": sum(
                 after.get(k, 0) - before.get(k, 0)
                 for k in after if k.startswith("retry.")

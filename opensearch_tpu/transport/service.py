@@ -116,6 +116,11 @@ class TransportService:
         # target -> negotiated protocol version (TransportHandshaker's
         # per-channel version); populated lazily on first contact
         self._peer_versions: dict[str, int] = {}
+        # outbound accounting: (action, target) -> requests sent.  The
+        # searcher-tier acceptance criterion ("zero primary-directed
+        # RPCs during searcher recovery") is asserted against this
+        # ledger; bounded by actions x peers
+        self.sent_counts: dict[tuple, int] = {}
         self._executor = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix=f"transport-{node_id}")
         self.register_handler(HANDSHAKE, self._on_handshake)
@@ -185,6 +190,8 @@ class TransportService:
             req_id = self._req_counter
             fut: Future = Future()
             self._pending[req_id] = fut
+            key = (action, target)
+            self.sent_counts[key] = self.sent_counts.get(key, 0) + 1
         try:
             self.transport.send(self.node_id, target,
                                 encode_frame(req_id, 0, action,
@@ -215,6 +222,17 @@ class TransportService:
                         break
             raise ReceiveTimeoutError(
                 f"[{target}][{action}] request timed out after {timeout}s")
+
+    def requests_sent(self, action: Optional[str] = None,
+                      target: Optional[str] = None) -> int:
+        """Outbound request count filtered by action and/or target
+        (None = any).  ``action`` matches by prefix so families like
+        ``indices:admin/replication/`` can be asserted on at once."""
+        with self._lock:
+            return sum(
+                n for (a, t), n in self.sent_counts.items()
+                if (action is None or a.startswith(action))
+                and (target is None or t == target))
 
     # -- inbound ----------------------------------------------------------
 

@@ -464,7 +464,7 @@ def test_cat_recovery_and_nodes_stats_recovery_section(node):
     s, r = call(node, "GET", "/_nodes/stats")
     rec = r["nodes"][node.node_id]["recovery"]
     assert rec["corrupt_blobs"] >= 2
-    assert set(rec["retries"]) == {"start", "report"}
+    assert set(rec["retries"]) == {"start", "report", "fetch"}
     assert {"attempts", "retries", "exhausted"} <= \
         set(rec["retries"]["start"])
     shards = [s_ for s_ in rec["shards"] if s_["index"] == "insrec"]

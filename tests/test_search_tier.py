@@ -1,0 +1,787 @@
+"""Search-only replica tier (ROADMAP item 4): stateless searchers over
+the remote store that survive kill/add churn under traffic.
+
+Covers the tier end to end — roles-aware allocation
+(``number_of_search_replicas`` over search-role nodes), primary
+publish-to-remote on refresh, searcher installs that pull blob digests
+through the FileCache with CRC verification, pure-remote refill
+recovery (zero primary-directed RPCs, pinned via transport accounting),
+checkpoint-lag deranking in the C3 selector, live fleet scaling, the
+soak directive class (kill/add searcher, remote-store stall), and the
+PR's satellites: the ``_h_publish_ckpt`` retry fix, FileCache
+concurrency semantics, the ``search.replication.max_lag`` setting, and
+the write-isolation lint."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from opensearch_tpu.cluster import response_collector as rc
+from opensearch_tpu.cluster.node import (A_FETCH_SEGMENTS,
+                                         A_PUBLISH_SEARCH_CKPT,
+                                         A_START_RECOVERY, ClusterNode)
+from opensearch_tpu.cluster.state import (ClusterState, allocate_shards,
+                                          search_copies_of)
+from opensearch_tpu.common.errors import OpenSearchTpuError
+from opensearch_tpu.common.telemetry import metrics
+from opensearch_tpu.index.filecache import FileCache
+from opensearch_tpu.testing.workload import (FaultSchedule, MixedWorkload,
+                                             SoakConfig, SoakRunner)
+from opensearch_tpu.transport.service import (LocalTransport,
+                                              TransportService)
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+LINT = REPO + "/tools/check_searcher_write_isolation.py"
+
+
+@pytest.fixture(autouse=True)
+def _restore_selector_globals():
+    saved = (rc.SEARCH_MAX_LAG, rc.ADAPTIVE_ENABLED)
+    yield
+    rc.SEARCH_MAX_LAG, rc.ADAPTIVE_ENABLED = saved
+
+
+def wait_until(pred, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# -- allocation (cluster/state.py) ------------------------------------------
+
+def _state(nodes, settings, routing=None):
+    return ClusterState(
+        nodes=nodes,
+        indices={"idx": {"settings": settings}},
+        routing={"idx": routing} if routing else {})
+
+
+def test_allocate_search_replicas_on_search_nodes_only():
+    st = allocate_shards(_state(
+        {"d0": {}, "d1": {},
+         "s0": {"name": "s0", "roles": ["search"]},
+         "s1": {"name": "s1", "roles": ["search"]}},
+        {"number_of_shards": 2, "number_of_replicas": 1,
+         "number_of_search_replicas": 2}))
+    for e in st.routing["idx"]:
+        # write copies never land on search-only nodes
+        assert e["primary"] in ("d0", "d1")
+        assert all(r in ("d0", "d1") for r in e["replicas"])
+        assert sorted(e["search_replicas"]) == ["s0", "s1"]
+        # fresh slots start OUTSIDE the serving set
+        assert e["search_in_sync"] == []
+        assert search_copies_of(e) == []
+
+
+def test_allocate_search_replicas_scale_and_dead_node_drop():
+    st = allocate_shards(_state(
+        {"d0": {}, "s0": {"name": "s0", "roles": ["search"]},
+         "s1": {"name": "s1", "roles": ["search"]}},
+        {"number_of_shards": 1, "number_of_search_replicas": 2}))
+    e = st.routing["idx"][0]
+    assert sorted(e["search_replicas"]) == ["s0", "s1"]
+    # scale down trims slots (and their serving-set membership)
+    e["search_in_sync"] = list(e["search_replicas"])
+    st2 = allocate_shards(st.with_(indices={"idx": {"settings": {
+        "number_of_shards": 1, "number_of_search_replicas": 1}}}))
+    e2 = st2.routing["idx"][0]
+    assert len(e2["search_replicas"]) == 1
+    assert set(e2["search_in_sync"]) <= set(e2["search_replicas"])
+    # a dead searcher leaves its slots; the survivor takes over
+    st3 = allocate_shards(st.with_(
+        nodes={"d0": {}, "s1": {"name": "s1", "roles": ["search"]}}))
+    assert st3.routing["idx"][0]["search_replicas"] == ["s1"]
+
+
+def test_entries_unchanged_without_search_setting():
+    st = allocate_shards(_state(
+        {"d0": {}, "d1": {}},
+        {"number_of_shards": 1, "number_of_replicas": 1}))
+    e = st.routing["idx"][0]
+    assert "search_replicas" not in e and "search_in_sync" not in e
+
+
+# -- FileCache concurrency (satellites 2 + 3) -------------------------------
+
+def test_filecache_fetch_failure_propagates_to_waiters(tmp_path):
+    cache = FileCache(str(tmp_path / "fc"))
+    gate = threading.Event()
+    calls = []
+
+    def failing_fetch():
+        calls.append(1)
+        gate.wait(timeout=5.0)
+        raise OSError("repository down")
+
+    errors = []
+
+    def get():
+        try:
+            cache.get("sha1", failing_fetch)
+        except OSError as e:
+            errors.append(str(e))
+
+    threads = [threading.Thread(target=get) for _ in range(4)]
+    for t in threads:
+        t.start()
+    wait_until(lambda: calls, what="fetcher started")
+    gate.set()
+    for t in threads:
+        t.join(timeout=5.0)
+        assert not t.is_alive(), "waiter hung on a failed fetch"
+    # ONE fetch ran; every thread observed the SAME error
+    assert len(calls) == 1
+    assert errors == ["repository down"] * 4
+    # the failure left no in-flight residue: a later get retries fresh
+    assert cache.stats()["in_flight"] == 0
+    path = cache.get("sha1", lambda: b"recovered")
+    with open(path, "rb") as f:
+        assert f.read() == b"recovered"
+
+
+def test_filecache_eviction_racing_get(tmp_path):
+    cache = FileCache(str(tmp_path / "fc"), max_bytes=32)
+    stop = threading.Event()
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            cache.get(f"bulk{i % 8}", lambda: b"y" * 24)
+            i += 1
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        for _ in range(200):
+            # the pin discipline every reader uses (materialize_shard,
+            # the searcher's _fetch_remote_segment): a pinned entry
+            # survives concurrent eviction churn between the get() and
+            # the read, no matter how small the budget
+            with cache.pin({"hot"}):
+                p = cache.get("hot", lambda: b"x" * 24)
+                with open(p, "rb") as f:
+                    assert f.read() == b"x" * 24
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+    # unpinned entries DID churn out around it the whole time
+    assert cache.evictions > 0
+
+
+def test_filecache_pin_unpin_composition(tmp_path):
+    cache = FileCache(str(tmp_path / "fc"), max_bytes=8)
+    cache.get("keep", lambda: b"k" * 8)
+    outer = cache.pin({"keep"})
+    inner = cache.pin({"keep"})
+    with outer:
+        with inner:
+            pass
+        # still pinned by the OUTER pin: pressure cannot evict it
+        cache.get("other", lambda: b"o" * 8)
+        assert os.path.exists(cache.path("keep"))
+        assert cache.stats()["pinned_bytes"] == 8
+    # both pins released: the entry is evictable again
+    cache.get("other2", lambda: b"p" * 8)
+    cache.set_max_bytes(8)
+    assert cache.stats()["pinned_bytes"] == 0
+
+
+def test_filecache_warm_restart_ignores_tmp(tmp_path):
+    d = tmp_path / "fc"
+    cache = FileCache(str(d))
+    cache.get("real", lambda: b"data")
+    # a crashed fetch leaves a .tmp behind; restart must not index it
+    with open(d / "ghost.tmp.123", "wb") as f:
+        f.write(b"partial")
+    reopened = FileCache(str(d))
+    st = reopened.stats()
+    assert st["entries"] == 1
+    assert st["size_in_bytes"] == 4
+    assert reopened.get("real", lambda: (_ for _ in ()).throw(
+        AssertionError("should hit"))) == reopened.path("real")
+
+
+def test_filecache_invalidate_forces_refetch(tmp_path):
+    cache = FileCache(str(tmp_path / "fc"))
+    fetched = []
+    cache.get("sha", lambda: fetched.append(1) or b"v1")
+    cache.invalidate("sha")
+    cache.get("sha", lambda: fetched.append(1) or b"v2")
+    assert len(fetched) == 2
+    with open(cache.path("sha"), "rb") as f:
+        assert f.read() == b"v2"
+
+
+# -- cluster tier plumbing --------------------------------------------------
+
+def build_cluster(root, data_nodes=("n0", "n1", "n2"),
+                  searchers=("s0",), shards=2, replicas=1,
+                  search_replicas=None, docs=0):
+    """3-data-node cluster + search tier over one shared remote store;
+    returns (nodes, hub).  Soak-style: no background timers — tests
+    drive checks explicitly."""
+    hub = LocalTransport.Hub()
+    remote = os.path.join(root, "remote")
+    nodes = {}
+
+    def build(nid, roles):
+        svc = TransportService(nid, LocalTransport(hub))
+        node = ClusterNode(nid, os.path.join(root, nid), svc,
+                           list(data_nodes), roles=roles,
+                           remote_store_path=remote)
+        node.search_backpressure.trackers["cpu_usage"].probe = \
+            lambda: 0.0
+        node.search_rpc_timeout = 2.0
+        node.recovery_timeout = 5.0
+        return node
+
+    for nid in data_nodes:
+        nodes[nid] = build(nid, ("master", "data"))
+    for sid in searchers:
+        nodes[sid] = build(sid, ("search",))
+    assert nodes[data_nodes[0]].start_election()
+    for sid in searchers:
+        nodes[data_nodes[0]].coordinator.add_node(
+            sid, {"name": sid, "roles": ["search"],
+                  "master_eligible": False})
+    if search_replicas is None:
+        search_replicas = len(searchers)
+    nodes[data_nodes[0]].create_index("tier", {
+        "settings": {"number_of_shards": shards,
+                     "number_of_replicas": replicas,
+                     "number_of_search_replicas": search_replicas},
+        "mappings": {"properties": {
+            "body": {"type": "text"}, "ts": {"type": "date"},
+            "tag": {"type": "keyword"}, "v": {"type": "long"}}}})
+    wait_until(lambda: searchers_ready(nodes[data_nodes[0]],
+                                       search_replicas),
+               what="initial searcher refill")
+    client = nodes[data_nodes[0]]
+    for i in range(docs):
+        client.index_doc("tier", str(i), {"body": f"hello t{i % 7}",
+                                          "ts": 1_700_000_000_000,
+                                          "tag": "t", "v": i})
+    if docs:
+        client.refresh("tier")
+        for sid in searchers:
+            wait_until(lambda s=sid: nodes[s].search_lag() == 0,
+                       what=f"[{sid}] catch-up")
+    return nodes, hub
+
+
+def searchers_ready(leader, want):
+    routing = leader.coordinator.state().routing.get("tier", [])
+    return bool(routing) and all(
+        len(e.get("search_replicas") or []) >= want
+        and set(e.get("search_replicas") or [])
+        == set(e.get("search_in_sync") or []) for e in routing)
+
+
+def stop_all(nodes):
+    for n in list(nodes.values()):
+        n.stop()
+
+
+def searcher_docs(node, index="tier"):
+    return sum(e.doc_count() for e in node.indices[index].shards)
+
+
+def test_searcher_installs_published_checkpoints(tmp_path):
+    nodes, _ = build_cluster(str(tmp_path), docs=30)
+    try:
+        s0 = nodes["s0"]
+        assert searcher_docs(s0) == 30
+        assert s0.search_lag() == 0
+        # searches from the searcher serve LOCALLY (tier offload)
+        resp = s0.search("tier", {"query": {"match": {"body": "hello"}},
+                                  "size": 5})
+        assert resp["_shards"]["failed"] == 0
+        assert resp["hits"]["total"]["value"] == 30
+        # deletes travel with the checkpoint
+        nodes["n0"].delete_doc("tier", "0")
+        nodes["n0"].refresh("tier")
+        wait_until(lambda: searcher_docs(s0) == 29,
+                   what="delete visible on the searcher")
+        # cat_shards reports the search tier with its lag
+        srows = [r for r in nodes["n0"].cat_shards()
+                 if r["prirep"] == "s"]
+        assert len(srows) == 2
+        assert all(r["state"] == "STARTED" for r in srows)
+        assert all(r["node"] == "s0" for r in srows)
+        # the searcher's own tier stats
+        st = s0.search_tier_stats()
+        assert st["max_lag"] == 0
+        assert st["segrep"]["installs"] > 0
+        assert st["file_cache"]["entries"] > 0
+    finally:
+        stop_all(nodes)
+
+
+def test_searcher_rejects_writes(tmp_path):
+    nodes, _ = build_cluster(str(tmp_path), docs=5)
+    try:
+        s0 = nodes["s0"]
+        # engine-level guard (bulk/index/translog chokepoint)
+        engine = s0.indices["tier"].shards[0]
+        assert engine.search_only
+        with pytest.raises(OpenSearchTpuError):
+            engine.index("x", {"body": "nope"})
+        with pytest.raises(OpenSearchTpuError):
+            engine.delete("0")
+        with pytest.raises(OpenSearchTpuError):
+            engine.apply_replica_op({"op": "index", "id": "x",
+                                     "source": {}, "seq_no": 99,
+                                     "version": 1, "primary_term": 1})
+        # transport-level rejection: a misrouted write action fails
+        # loud with the role verdict
+        from opensearch_tpu.cluster.node import A_WRITE_SHARD
+        with pytest.raises(OpenSearchTpuError, match="search"):
+            nodes["n1"].transport.send_request(
+                "s0", A_WRITE_SHARD,
+                {"index": "tier", "shard": 0, "op": "index", "id": "y",
+                 "source": {"body": "z"}}, timeout=5.0)
+    finally:
+        stop_all(nodes)
+
+
+def test_scale_search_replicas_live(tmp_path):
+    nodes, _ = build_cluster(str(tmp_path), searchers=("s0", "s1"),
+                             search_replicas=1, docs=12)
+    try:
+        leader = nodes["n0"]
+        for e in leader.coordinator.state().routing["tier"]:
+            assert len(e["search_replicas"]) == 1
+        # scale UP live: the new slots refill from the remote store
+        leader.update_index_settings(
+            "tier", {"number_of_search_replicas": 2})
+        wait_until(lambda: searchers_ready(leader, 2),
+                   what="scale-up refill")
+        for sid in ("s0", "s1"):
+            wait_until(lambda s=sid: searcher_docs(nodes[s]) == 12,
+                       what=f"[{sid}] docs after scale-up")
+        # scale DOWN live: slots trim on the next applied state
+        leader.update_index_settings(
+            "tier", {"number_of_search_replicas": 1})
+        wait_until(lambda: all(
+            len(e["search_replicas"]) == 1
+            for e in leader.coordinator.state().routing["tier"]),
+            what="scale-down trim")
+        # number_of_shards stays immutable
+        with pytest.raises(OpenSearchTpuError):
+            leader.update_index_settings("tier",
+                                         {"number_of_shards": 4})
+    finally:
+        stop_all(nodes)
+
+
+def test_corrupt_remote_blob_refetched_and_marked(tmp_path):
+    nodes, _ = build_cluster(str(tmp_path), docs=8)
+    try:
+        s0 = nodes["s0"]
+        before = metrics().counter("segrep.corrupt_blobs").value
+        # a repository serving bytes that do not match the checkpoint
+        # CRC: the blob is dropped from the cache, re-fetched once, and
+        # only a repeat failure raises (counted both times)
+        s0.remote_store.blobs.write_blob("deadbeef", b"garbage")
+        with pytest.raises(OpenSearchTpuError, match="CRC"):
+            s0._fetch_blob_verified({"name": "seg_x.npz",
+                                     "blob": "deadbeef", "crc32": 1234})
+        assert metrics().counter("segrep.corrupt_blobs").value \
+            == before + 2          # first mismatch + post-refetch
+        # ...and a repaired repository heals on the next fetch: the bad
+        # cache entry was invalidated, so the good bytes come through
+        import zlib as _zlib
+        good = b"repaired"
+        s0.remote_store.blobs.write_blob("deadbeef", good)
+        ok = s0._fetch_blob_verified({
+            "name": "seg_x.npz", "blob": "deadbeef",
+            "crc32": _zlib.crc32(good) & 0xFFFFFFFF})
+        assert ok == good
+    finally:
+        stop_all(nodes)
+
+
+def test_lagging_searcher_deranked_and_recovers():
+    collector = rc.ResponseCollectorService(clock=lambda: 100.0)
+    rc.SEARCH_MAX_LAG = 8
+    # evidence for all copies so ranks exist
+    for n in ("s0", "d0", "d1"):
+        collector.record_response(n, 1e6, {"queue_size": 0,
+                                           "service_time_ewma_nanos": 1e6})
+    collector.record_ping_load("s0", {"search_lag": 50})
+    assert collector.lagging("s0")
+    ordered, _ = collector.rank_copies(["s0", "d0", "d1"])
+    assert ordered[-1] == "s0"      # deranked like duress, retained
+    stats = collector.stats()
+    assert stats["s0"]["search_lag"] == 50
+    assert stats["s0"]["search_lagging"] is True
+    # the lag flag heals on the next piggybacked snapshot
+    collector.record_ping_load("s0", {"search_lag": 0})
+    assert not collector.lagging("s0")
+    ordered, _ = collector.rank_copies(["s0", "d0", "d1"])
+    assert ordered[0] == "s0"
+
+
+def test_copy_candidates_prefer_ready_searchers(tmp_path):
+    nodes, _ = build_cluster(str(tmp_path), docs=6)
+    try:
+        n1 = nodes["n1"]
+        entry = n1.coordinator.state().routing["tier"][0]
+        cands = n1._copy_candidates(entry)
+        # the ready searcher leads (tier offload); write copies remain
+        # as fallback so a dead tier degrades instead of failing
+        assert cands[0] == "s0"
+        assert set(cands) >= {"s0", entry["primary"]}
+        # a searcher past the lag bound falls to last resort
+        n1.response_collector.record_ping_load("s0", {"search_lag": 999})
+        cands = n1._copy_candidates(entry)
+        assert cands[0] != "s0" and "s0" in cands
+    finally:
+        stop_all(nodes)
+
+
+# -- the acceptance bar -----------------------------------------------------
+
+def _run_mixed_op(client, op):
+    if op["op"] in ("search", "agg"):
+        return client.search("tier", dict(op["body"]))
+    if op["op"] == "msearch":
+        return client.msearch("tier",
+                              [dict(b) for b in op["bodies"]])
+    if op["op"] == "bulk":
+        for doc_id, source in op["docs"]:
+            client.index_doc("tier", doc_id, source)
+        if op.get("delete"):
+            client.delete_doc("tier", op["delete"])
+        if op.get("refresh"):
+            client.refresh("tier")
+        return None
+    if op["op"] == "scroll":
+        return client.search("tier", {"query": {"match_all": {}},
+                                      "size": op["page_size"],
+                                      "sort": [{"v": "asc"}]})
+    raise AssertionError(op["op"])
+
+
+def _evict_via_checks(nodes, leader, victim):
+    retries = nodes[leader].coordinator.follower_checker.settings.retries
+
+    def gone():
+        for _ in range(retries + 1):
+            nodes[leader].coordinator.run_checks_once()
+        return victim not in nodes[leader].coordinator.state().nodes
+    wait_until(gone, timeout=20.0, what=f"eviction of [{victim}]")
+
+
+def _tier_docs(node, index="tier"):
+    """Live (shard, id, source) set straight from the node's engines —
+    the parity probe that bypasses routing entirely."""
+    out = set()
+    for sid, eng in sorted(node.indices[index].local_shards.items()):
+        for seg in eng.acquire_searcher().segments:
+            for doc_id, local in seg.id_to_local.items():
+                if seg.live[local]:
+                    out.add((sid, doc_id,
+                             json.dumps(seg.source(local),
+                                        sort_keys=True)))
+    return out
+
+
+def test_acceptance_searcher_churn_and_primary_failover(tmp_path):
+    """ISSUE 13 acceptance: 3-node cluster + 2 search replicas under
+    the mixed workload — kill a searcher mid-traffic, add a fresh one,
+    and separately kill a primary-holding data node; zero
+    primary-directed RPCs during searcher recovery (transport
+    accounting), searchers keep serving within the lag bound during
+    primary failover, and post-drain doc-count+checksum parity between
+    every primary and every searcher."""
+    nodes, hub = build_cluster(str(tmp_path), searchers=("s0", "s1"),
+                               docs=24)
+    leader = "n0"
+    client = nodes["n0"]
+    workload = MixedWorkload(SoakConfig(seed=1301, n_ops=36))
+    ops = workload.ops()
+    fresh = None
+    try:
+        for i, op in enumerate(ops):
+            if i == 8:
+                # kill a searcher mid-traffic
+                nodes["s0"].stop()
+                nodes.pop("s0")
+                _evict_via_checks(nodes, leader, "s0")
+            if i == 16:
+                # add a FRESH searcher: recovery is pure cache refill
+                svc = TransportService("s2", LocalTransport(hub))
+                fresh = ClusterNode(
+                    "s2", os.path.join(str(tmp_path), "s2"), svc,
+                    ["n0", "n1", "n2"], roles=("search",),
+                    remote_store_path=os.path.join(str(tmp_path),
+                                                   "remote"))
+                fresh.search_rpc_timeout = 2.0
+                nodes["s2"] = fresh
+                nodes[leader].coordinator.add_node(
+                    "s2", {"name": "s2", "roles": ["search"],
+                           "master_eligible": False})
+                wait_until(lambda: searchers_ready(nodes[leader], 2),
+                           timeout=30.0, what="fresh searcher refill")
+                # ZERO primary-directed recovery RPCs: the searcher
+                # never asked any node for segments or recovery
+                assert fresh.transport.requests_sent(
+                    action=A_START_RECOVERY) == 0
+                assert fresh.transport.requests_sent(
+                    action=A_FETCH_SEGMENTS) == 0
+                assert fresh.transport.requests_sent(
+                    action=A_PUBLISH_SEARCH_CKPT) == 0
+            if i == 24:
+                # separately: kill a primary-holding data node (not the
+                # leader/client) and let failover run
+                routing = nodes[leader].coordinator.state() \
+                    .routing["tier"]
+                victim = next(e["primary"] for e in routing
+                              if e["primary"] != leader)
+                nodes[victim].stop()
+                nodes.pop(victim)
+                # searchers keep serving DURING the failover window,
+                # within the lag bound
+                resp = nodes["s1"].search(
+                    "tier", {"query": {"match_all": {}}, "size": 3})
+                assert resp["_shards"]["failed"] == 0
+                assert nodes["s1"].search_lag() <= rc.SEARCH_MAX_LAG
+                _evict_via_checks(nodes, leader, victim)
+            try:
+                _run_mixed_op(client, op)
+            except OpenSearchTpuError as exc:
+                # allowed degradation classes only (429 / transient
+                # transport); anything else fails the acceptance
+                assert getattr(exc, "status", 0) in (429, 503), exc
+        # drain: converge the tier, then byte-level parity
+        def caught_up():
+            client.refresh("tier")
+            state = nodes[leader].coordinator.state()
+            for s, e in enumerate(state.routing["tier"]):
+                eng = nodes[e["primary"]].indices["tier"].engine_for(s)
+                for r in e.get("search_replicas") or []:
+                    if r not in nodes or nodes[r].search_installed_seq(
+                            "tier", s) < eng._seq_no:
+                        return False
+            return True
+        wait_until(caught_up, timeout=30.0, what="post-drain catch-up")
+        state = nodes[leader].coordinator.state()
+        primary_docs = set()
+        for s, e in enumerate(state.routing["tier"]):
+            primary_docs |= {
+                d for d in _tier_docs(nodes[e["primary"]])
+                if d[0] == s}
+        assert primary_docs, "write tier lost its documents"
+        for sid in ("s1", "s2"):
+            assert _tier_docs(nodes[sid]) == primary_docs, \
+                f"searcher [{sid}] diverged from the write tier"
+    finally:
+        stop_all(nodes)
+
+
+# -- soak directives --------------------------------------------------------
+
+def test_tier_schedule_is_seed_deterministic_with_directives():
+    cfg = SoakConfig.tier(seed=77)
+    s1 = FaultSchedule.generate(cfg)
+    s2 = FaultSchedule.generate(SoakConfig.tier(seed=77))
+    assert s1 == s2
+    faults = [d["fault"] for d in s1]
+    assert {"kill_searcher", "add_searcher", "stall_remote_store",
+            "release_remote_store"} <= set(faults)
+    # the legacy menu is untouched for non-tier configs
+    base = FaultSchedule.generate(SoakConfig(seed=77))
+    assert not {"kill_searcher", "add_searcher"} & {
+        d["fault"] for d in base}
+    # paired directives keep their order under the jitter
+    by = {d["fault"]: d["step"] for d in s1}
+    assert by["stall_remote_store"] <= by["release_remote_store"]
+    assert by["kill_searcher"] <= by["add_searcher"]
+
+
+def test_tier_soak_two_run_determinism(tmp_path):
+    """Satellite: the deterministic two-run seed check extended to the
+    searcher directive class — same seed, same schedule, same verdicts,
+    clean SLOs, convergence across the rebalancing fleet."""
+    r1 = SoakRunner(str(tmp_path / "a"),
+                    SoakConfig.tier(seed=1302)).run()
+    r2 = SoakRunner(str(tmp_path / "b"),
+                    SoakConfig.tier(seed=1302)).run()
+    assert r1["chaos"]["schedule"] == r2["chaos"]["schedule"]
+    v1 = [(v["slo"], v["ok"]) for v in r1["verdicts"]]
+    v2 = [(v["slo"], v["ok"]) for v in r2["verdicts"]]
+    assert v1 == v2
+    assert r1["slo_ok"] and r2["slo_ok"], (r1["verdicts"],
+                                           r1["chaos"]["unexpected_errors"])
+    applied = [d["fault"] for d in r1["chaos"]["applied"]]
+    assert {"kill_searcher", "add_searcher",
+            "stall_remote_store"} <= set(applied)
+    assert r1["chaos"]["searcher_refills"] > 0
+    assert r1["chaos"]["remote_bytes_pulled"] > 0
+    assert r1["chaos"]["final_state"] == r2["chaos"]["final_state"]
+
+
+# -- satellites -------------------------------------------------------------
+
+def test_publish_ckpt_fetch_goes_through_retry(tmp_path):
+    """Satellite 1: the replica's segment fetch retries transient drops
+    under the configurable recovery budget and counts into
+    retry.recovery.fetch.* instead of failing the install on one bare
+    RPC."""
+    from opensearch_tpu.cluster.node import A_PUBLISH_CKPT
+    from opensearch_tpu.testing.fault_injection import FaultInjector
+    hub = LocalTransport.Hub()
+    nodes = {}
+    for nid in ("n0", "n1"):
+        svc = TransportService(nid, LocalTransport(hub))
+        nodes[nid] = ClusterNode(nid, str(tmp_path / nid), svc,
+                                 ["n0", "n1"])
+        nodes[nid].recovery_timeout = 0.4
+    try:
+        assert nodes["n0"].start_election()
+        nodes["n0"].create_index("logs", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 1},
+            "mappings": {"properties": {"body": {"type": "text"}}}})
+        wait_until(lambda: all(
+            set(e["in_sync"]) == {"n0", "n1"}
+            for e in nodes["n0"].coordinator.state().routing["logs"]),
+            what="replica in-sync")
+        before = metrics().counter(
+            "retry.recovery.fetch.retries").value
+        faults = FaultInjector(hub, seed=3)
+        faults.drop(A_FETCH_SEGMENTS, times=1, silent=True)
+        owner = nodes["n0"].coordinator.state() \
+            .routing["logs"][0]["primary"]
+        other = "n1" if owner == "n0" else "n0"
+        nodes[owner].index_doc("logs", "1", {"body": "hello"})
+        nodes[owner].refresh("logs")   # publish -> replica fetch (drop)
+        # the replica's retried fetch runs async of the publish RPC:
+        # wait for the retry counter AND the recovered install
+        wait_until(lambda: metrics().counter(
+            "retry.recovery.fetch.retries").value > before,
+            what="retried segment fetch")
+        wait_until(lambda: nodes[other].indices["logs"]
+                   .shards[0].doc_count() == 1,
+                   what="replica installed after retried fetch")
+        faults.clear()
+    finally:
+        stop_all(nodes)
+
+
+def test_max_lag_dynamic_setting(tmp_path):
+    from opensearch_tpu.node import Node
+    node = Node(str(tmp_path / "node"), port=0)
+    try:
+        assert rc.SEARCH_MAX_LAG == 8
+        node.update_cluster_settings(
+            transient={"search.replication.max_lag": 3})
+        assert rc.SEARCH_MAX_LAG == 3
+        node.update_cluster_settings(
+            transient={"search.replication.max_lag": None})
+        assert rc.SEARCH_MAX_LAG == 8
+    finally:
+        node.stop()
+
+
+def test_nodes_stats_surfaces_filecache_and_segrep(tmp_path):
+    from opensearch_tpu.node import Node
+    node = Node(str(tmp_path / "node"), port=0)
+    try:
+        status, resp = node.rest.dispatch("GET", "/_nodes/stats", {},
+                                          None)
+        assert status == 200
+        stats = resp["nodes"][node.node_id]
+        fc = stats["file_cache"]
+        # satellite 2: mount/refill pressure is observable
+        assert {"pinned_bytes", "pinned_entries", "in_flight"} <= set(fc)
+        rec = stats["recovery"]
+        assert "fetch" in rec["retries"]
+        assert {"publishes", "installs", "bytes_pulled",
+                "corrupt_blobs", "refills"} <= set(
+            rec["segment_replication"])
+    finally:
+        node.stop()
+
+
+def test_transport_request_accounting():
+    hub = LocalTransport.Hub()
+    a = TransportService("a", LocalTransport(hub))
+    b = TransportService("b", LocalTransport(hub))
+    b.register_handler("x:action", lambda p: {"ok": True})
+    try:
+        a.send_request("b", "x:action", {}, timeout=5.0)
+        a.send_request("b", "x:action", {}, timeout=5.0)
+        assert a.requests_sent(action="x:action", target="b") == 2
+        assert a.requests_sent(action="x:action") == 2
+        assert a.requests_sent(target="nope") == 0
+        assert a.requests_sent(action="x:") == 2   # prefix match
+    finally:
+        a.close()
+        b.close()
+
+
+# -- bench phase ------------------------------------------------------------
+
+def test_bench_tier_phase_emits_line(tmp_path, monkeypatch):
+    import importlib.util
+    phases = tmp_path / "phases.jsonl"
+    monkeypatch.setenv("OSTPU_BENCH_PHASES", str(phases))
+    monkeypatch.setenv("OSTPU_BENCH_TIER_DOCS", "200")
+    spec = importlib.util.spec_from_file_location("bench_under_test",
+                                                  REPO + "/bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    data = bench.run_tier_phase("cpu")
+    assert data["docs"] == 200
+    assert data["refill_ms"] > 0
+    assert data["remote_bytes_per_recovery"] > 0
+    assert data["recovery_primary_rpcs"] == 0
+    line = json.loads(phases.read_text().splitlines()[-1])
+    assert line["phase"] == "tier"
+    assert {"searcher_lag_p99_ops", "refill_ms",
+            "remote_bytes_per_recovery"} <= set(line)
+
+
+# -- lint -------------------------------------------------------------------
+
+def test_write_isolation_lint_repo_clean():
+    proc = subprocess.run([sys.executable, LINT, REPO],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_write_isolation_lint_catches_violations(tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("wlint", LINT)
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    bad = tmp_path / "bad_cluster.py"
+    bad.write_text(
+        "def setup(t, self):\n"
+        "    t.register_handler(A_REPLICATE_OP, self._h)\n")
+    problems = lint.check_cluster_file(str(bad))
+    assert len(problems) == 1 and "role-gated" in problems[0]
+    ok = tmp_path / "ok_cluster.py"
+    ok.write_text(
+        "def setup(t, self):\n"
+        "    # searcher-ok: test fixture\n"
+        "    t.register_handler(A_WRITE_SHARD, self._h)\n")
+    assert lint.check_cluster_file(str(ok)) == []
+    # engine guard check: a write entry without _ensure_writeable fails
+    eng = tmp_path / "engine.py"
+    eng.write_text(
+        "class E:\n"
+        "    def index(self, doc_id):\n"
+        "        return doc_id\n")
+    problems = lint.check_engine_guards(str(eng))
+    assert problems and "_ensure_writeable" in problems[0]

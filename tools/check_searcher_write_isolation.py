@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Lint: search-role nodes must stay out of the write path.
+
+The search-replica tier (ingest/search separation) only holds if a
+search-only node can NEVER mutate shard state: write-path transport
+handlers must either be unregistered on search-role nodes or reject
+with a clear verdict, and the engine's write entry points must refuse
+on a search-only engine.  This check pins both invariants statically:
+
+1. In ``opensearch_tpu/cluster/``, any ``register_handler`` call whose
+   action is a write action (``A_WRITE_SHARD`` / ``A_REPLICATE_OP`` by
+   name, or their literal action strings) must live inside
+   ``_register_write_handlers`` — the one role-gated registration site
+   — or carry a ``# searcher-ok: <why>`` annotation on the same line or
+   the line above.
+2. ``ClusterNode._register_write_handlers`` itself must exist and
+   branch on the data role (``is_data``) with a rejection path.
+3. The engine's write entry points (``index``, ``delete``,
+   ``apply_replica_op`` — the chokepoint every bulk/index/translog
+   write flows through) must call ``_ensure_writeable`` (the
+   ``search_only`` guard) or carry the annotation.
+
+Sibling of ``check_execution_paths.py``; new un-annotated sites fail
+tier-1 (tests/test_search_tier.py runs this check).
+
+Usage: python tools/check_searcher_write_isolation.py [repo_root]
+(exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ANNOTATION = "# searcher-ok"
+
+WRITE_ACTION_NAMES = frozenset({"A_WRITE_SHARD", "A_REPLICATE_OP"})
+WRITE_ACTION_STRINGS = frozenset({
+    "indices:data/write/shard", "indices:data/write/shard[r]"})
+
+#: the single sanctioned (role-gated) registration site
+SANCTIONED_FN = "_register_write_handlers"
+
+ENGINE_WRITE_ENTRIES = ("index", "delete", "apply_replica_op")
+ENGINE_GUARD = "_ensure_writeable"
+
+
+def _is_write_action(arg: ast.AST) -> bool:
+    if isinstance(arg, ast.Name) and arg.id in WRITE_ACTION_NAMES:
+        return True
+    if isinstance(arg, ast.Attribute) and arg.attr in WRITE_ACTION_NAMES:
+        return True
+    if isinstance(arg, ast.Constant) and arg.value in WRITE_ACTION_STRINGS:
+        return True
+    return False
+
+
+def _annotated(lines: list, lineno: int) -> bool:
+    line = lines[lineno - 1] if lineno <= len(lines) else ""
+    prev = lines[lineno - 2] if lineno >= 2 else ""
+    return ANNOTATION in line or ANNOTATION in prev
+
+
+def check_cluster_file(path: str) -> list:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+    lines = src.splitlines()
+    # map every node to its enclosing function name
+    problems = []
+
+    def walk(node: ast.AST, fn_name: str):
+        for child in ast.iter_child_nodes(node):
+            child_fn = fn_name
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_fn = child.name
+            if isinstance(child, ast.Call):
+                callee = child.func
+                name = callee.attr if isinstance(callee, ast.Attribute) \
+                    else (callee.id if isinstance(callee, ast.Name)
+                          else None)
+                if (name == "register_handler" and child.args
+                        and _is_write_action(child.args[0])
+                        and fn_name != SANCTIONED_FN
+                        and not _annotated(lines, child.lineno)):
+                    problems.append(
+                        f"{path}:{child.lineno}: write-action handler "
+                        "registered outside the role-gated "
+                        f"{SANCTIONED_FN}() — a search-role node would "
+                        "serve writes; move it there or annotate with "
+                        f"'{ANNOTATION}: <why>'")
+            walk(child, child_fn)
+
+    walk(tree, "<module>")
+    return problems
+
+
+def check_registration_gate(node_path: str) -> list:
+    """``_register_write_handlers`` must exist and actually branch on
+    the data role with a rejection path."""
+    with open(node_path, encoding="utf-8") as f:
+        src = f.read()
+    tree = ast.parse(src)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and \
+                node.name == SANCTIONED_FN:
+            body = ast.get_source_segment(src, node) or ""
+            problems = []
+            if "is_data" not in body:
+                problems.append(
+                    f"{node_path}:{node.lineno}: {SANCTIONED_FN}() does "
+                    "not branch on the data role (is_data)")
+            if "_reject_write" not in body and "raise" not in body:
+                problems.append(
+                    f"{node_path}:{node.lineno}: {SANCTIONED_FN}() has "
+                    "no rejection path for search-role nodes")
+            return problems
+    return [f"{node_path}:1: {SANCTIONED_FN}() is missing — write "
+            "handlers have no role-gated registration site"]
+
+
+def check_engine_guards(engine_path: str) -> list:
+    with open(engine_path, encoding="utf-8") as f:
+        src = f.read()
+    tree = ast.parse(src)
+    lines = src.splitlines()
+    problems = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.FunctionDef)
+                and node.name in ENGINE_WRITE_ENTRIES):
+            continue
+        body = ast.get_source_segment(src, node) or ""
+        if ENGINE_GUARD not in body \
+                and not _annotated(lines, node.lineno):
+            problems.append(
+                f"{engine_path}:{node.lineno}: engine write entry "
+                f"[{node.name}] does not call {ENGINE_GUARD}() — a "
+                "search-only engine would accept writes; add the guard "
+                f"or annotate with '{ANNOTATION}: <why>'")
+    return problems
+
+
+def main(argv: list) -> int:
+    repo = argv[1] if len(argv) > 1 else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(repo, "opensearch_tpu")
+    problems = []
+    cluster_dir = os.path.join(pkg, "cluster")
+    for fname in sorted(os.listdir(cluster_dir)):
+        if fname.endswith(".py"):
+            problems.extend(
+                check_cluster_file(os.path.join(cluster_dir, fname)))
+    problems.extend(check_registration_gate(
+        os.path.join(cluster_dir, "node.py")))
+    problems.extend(check_engine_guards(
+        os.path.join(pkg, "index", "engine.py")))
+    for p in problems:
+        print(p)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
