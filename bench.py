@@ -339,6 +339,14 @@ def main():
             phase_report("tier", {"platform": platform,
                                   "error": f"{type(e).__name__}: {e}"})
 
+    # -- phase: qos (noisy-neighbor tenant isolation + adaptive control) --
+    if os.environ.get("OSTPU_BENCH_QOS", "1") != "0":
+        try:
+            run_qos_phase(platform)
+        except Exception as e:  # noqa: BLE001 — report, keep the bench
+            phase_report("qos", {"platform": platform,
+                                 "error": f"{type(e).__name__}: {e}"})
+
     # -- phase: soak (chaos SLO scenario over a 3-node cluster) -----------
     # runs LAST so a wedge here cannot cost the phases above; failures
     # are reported as a phase line, never swallowed
@@ -759,6 +767,44 @@ def run_tier_phase(platform: str):
         for n in list(nodes.values()):
             n.stop()
         _shutil.rmtree(root, ignore_errors=True)
+
+
+def run_qos_phase(platform: str):
+    """Noisy-neighbor QoS line: two tenants against one coordinator —
+    an aggressor flooding the zipf head in concurrent bursts far over
+    its carved admission share, a well-behaved victim issuing
+    sequential searches.  The line records the isolation outcome
+    (victim p99 + 429-rate vs the aggressor's shed rate) and the
+    adaptive controller's activity (adaptations recorded in the audit
+    ring) — ROADMAP item 7 as a bench trajectory."""
+    import tempfile
+    import shutil as _shutil
+
+    from opensearch_tpu.testing.workload import run_noisy_neighbor
+
+    n_ops = int(os.environ.get("OSTPU_BENCH_QOS_OPS", 16))
+    root = tempfile.mkdtemp(prefix="bench-qos-")
+    t0 = time.monotonic()
+    try:
+        report = run_noisy_neighbor(root, seed=42, n_ops=n_ops)
+    finally:
+        _shutil.rmtree(root, ignore_errors=True)
+    victim = report["tenants"]["tenant-victim"]
+    aggr = report["tenants"]["tenant-aggressor"]
+    phase_report("qos", {
+        "platform": platform, "wall_s": round(time.monotonic() - t0, 1),
+        "ops": report["ops"], "slo_ok": report["slo_ok"],
+        "victim_p99_ms": victim["p99_ms"],
+        "victim_429_rate": round(
+            victim["rejected"] / max(victim["ops"], 1), 4),
+        "aggressor_429_rate": round(
+            aggr["rejected"] / max(aggr["ops"], 1), 4),
+        "aggressor_ops": aggr["ops"],
+        "qos_adaptations": report["qos"]["adaptations"],
+        "knobs_adapted": sorted({a["knob"]
+                                 for a in report["qos"]["audit"]}),
+        "unexpected_errors": len(report["unexpected_errors"]),
+    })
 
 
 def run_soak_phase(platform: str):

@@ -185,6 +185,15 @@ class ClusterNode:
         # coordinates; top_queries() below fans the sections in
         from opensearch_tpu.search.insights import QueryInsightsService
         self.insights = QueryInsightsService(node_id=node_id)
+        # per-tenant QoS + adaptive overload control: the AIMD
+        # controller closing the loop between this node's admission
+        # ledger / flight-recorder breaches / insights coalescability
+        # and the shed-occupancy, batcher-window, and tenant-share
+        # knobs (search/qos.py; off until search.qos.adaptive)
+        from opensearch_tpu.search.qos import QosController
+        self.qos = QosController(
+            admission=self.search_backpressure.admission,
+            insights=self.insights)
         # data-node write admission (the same per-shard byte accounting
         # the single-node path gets from IndicesService)
         from opensearch_tpu.common.indexing_pressure import IndexingPressure
@@ -297,9 +306,14 @@ class ClusterNode:
                                  name=f"handshake-{self.node_id}-{peer}"
                                  ).start()
         # evicted nodes take their adaptive-selection stats with them —
-        # a rejoining node starts from a clean slate, not a stale EWMA
+        # a rejoining node starts from a clean slate, not a stale EWMA.
+        # remove_node leaves a tombstone so a late in-flight response
+        # cannot resurrect the evicted entry (stale duress flag with a
+        # refreshed TTL included); present nodes clear their tombstone
         for gone in self.response_collector.tracked() - set(state.nodes):
             self.response_collector.remove_node(gone)
+        for present in state.nodes:
+            self.response_collector.readmit(present)
         to_promote: list[tuple] = []
         to_recover: list[tuple] = []
         to_refill: list[tuple] = []
@@ -377,7 +391,8 @@ class ClusterNode:
                                 and entry.get("primary")):
                             self._recovering.add((index, s))
                             to_recover.append(
-                                (index, s, entry["primary"]))
+                                (index, s, entry["primary"],
+                                 self._recovery_source(entry)))
             for index in list(self.indices):
                 if index not in state.indices:
                     self.indices[index].close()
@@ -390,9 +405,10 @@ class ClusterNode:
                 self.indices[index].engine_for(s).promote_to_primary(term)
             except OpenSearchTpuError:
                 pass
-        for index, s, primary in to_recover:
+        for index, s, primary, source in to_recover:
             threading.Thread(
-                target=self._run_recovery, args=(index, s, primary),
+                target=self._run_recovery,
+                args=(index, s, primary, source),
                 daemon=True,
                 name=f"recovery-{self.node_id}-{index}-{s}").start()
         for index, s in to_refill:
@@ -408,14 +424,40 @@ class ClusterNode:
 
     # -- peer recovery (replica side) -------------------------------------
 
-    def _run_recovery(self, index: str, shard: int, primary: str):
-        """Bootstrap this node's replica copy from the primary: segment
-        file copy (phase 1; phase-2 op replay is subsumed by the live
-        A_REPLICATE_OP stream that started when the copy was assigned),
-        then report recovered so the master adds us to the in-sync set
-        (ref indices/recovery/RecoverySourceHandler.java:105,
-        ReplicationTracker.markAllocationIdAsInSync:1533)."""
+    def _recovery_source(self, entry: dict) -> str:
+        """Pick the recovery source by C3 rank: the least-loaded
+        in-sync copy (PR 6's explicit leftover — recovery file copy is
+        the heaviest read a copy can serve, so it should come off the
+        copy with the most headroom, not always the primary).  With no
+        response evidence the stable rank preserves the legacy order —
+        primary first; the primary stays the fallback either way (see
+        ``_run_recovery``)."""
+        primary = entry.get("primary")
+        in_sync = set(entry.get("in_sync") or [])
+        sources = [n for n in ([primary] if primary else [])
+                   + list(entry.get("replicas") or [])
+                   if n in in_sync and n != self.node_id]
+        if len(sources) < 2:
+            return primary
+        ranked, _ = self.response_collector.rank_copies(sources)
+        return ranked[0] if ranked else primary
+
+    def _run_recovery(self, index: str, shard: int, primary: str,
+                      source: Optional[str] = None):
+        """Bootstrap this node's replica copy from the C3-ranked
+        recovery source (least-loaded in-sync copy; the primary with no
+        evidence): segment file copy (phase 1; phase-2 op replay is
+        subsumed by the live A_REPLICATE_OP stream that started when
+        the copy was assigned), then report recovered so the master
+        adds us to the in-sync set (ref
+        indices/recovery/RecoverySourceHandler.java:105,
+        ReplicationTracker.markAllocationIdAsInSync:1533).  A ranked
+        non-primary source that fails falls back to the primary before
+        the recovery gives up to the next state application."""
         from opensearch_tpu.common.telemetry import metrics
+        # source order: the ranked pick first, the primary as fallback
+        sources = ([source] if source and source != primary else []) \
+            + [primary]
         try:
             svc = self.indices.get(index)
             local_ckpt = -1
@@ -427,16 +469,28 @@ class ClusterNode:
                 # restarting the whole recovery from the next
                 # cluster-state application is far more expensive than
                 # one more RPC
-                resp = retry_call(
-                    "recovery.start",
-                    lambda: self.transport.send_request(
-                        primary, A_START_RECOVERY,
-                        {"index": index, "shard": shard,
-                         "node": self.node_id,
-                         "local_checkpoint": local_ckpt}, timeout=30.0),
-                    max_attempts=3, base_delay=0.1, max_delay=1.0,
-                    budget_s=90.0, seed=zlib.crc32(
-                        f"{self.node_id}/{index}/{shard}".encode()))
+                src = sources[0]
+                try:
+                    resp = retry_call(
+                        "recovery.start",
+                        lambda src=src: self.transport.send_request(
+                            src, A_START_RECOVERY,
+                            {"index": index, "shard": shard,
+                             "node": self.node_id,
+                             "local_checkpoint": local_ckpt},
+                            timeout=30.0),
+                        max_attempts=3, base_delay=0.1, max_delay=1.0,
+                        budget_s=90.0, seed=zlib.crc32(
+                            f"{self.node_id}/{index}/{shard}".encode()))
+                except OpenSearchTpuError:
+                    if len(sources) > 1:
+                        # the ranked source failed its whole retry
+                        # budget: fall back to the primary, counted
+                        metrics().counter(
+                            "recovery.source_fallbacks").inc()
+                        sources.pop(0)
+                        continue
+                    raise
                 svc = self.indices.get(index)
                 if svc is None:
                     return
@@ -1617,8 +1671,16 @@ class ClusterNode:
         # the SAME gate the REST edge uses, so cluster searches and HTTP
         # searches share one concurrency budget (and one occupancy
         # signal for the shed decision below); saturation rejects with
-        # 429 here instead of queueing scatters unboundedly
-        with self.search_backpressure.admission.acquire("search"):
+        # 429 here instead of queueing scatters unboundedly.  The
+        # enclosing task's X-Opaque-Id is the tenant key, so a tenant
+        # over its carved share rejects here too (search.qos)
+        from opensearch_tpu.common import tasks as taskmod
+        outer = taskmod.current()
+        tenant = (outer.headers.get("X-Opaque-Id")
+                  if outer is not None else None)
+        self.qos.maybe_tick()
+        with self.search_backpressure.admission.acquire("search",
+                                                        tenant=tenant):
             return self._search_admitted(index, body, allow_partial,
                                          _spill)
 
@@ -1662,17 +1724,25 @@ class ClusterNode:
         # admission gate's occupancy: below the configured fraction the
         # coordinator still has capacity to try a duressed copy as a
         # last resort; at/above it the shed fails fast, and draws from
-        # the same rejection budget as the gate's 429s
+        # the same rejection budget as the gate's 429s.  The threshold
+        # is tenant-weighted: a QoS-penalized (noisy) tenant's requests
+        # shed at proportionally lower occupancy, so the aggressor's
+        # traffic degrades before the duressed copies see it
+        outer = taskmod.current()
+        outer_opaque = (outer.headers.get("X-Opaque-Id")
+                        if outer is not None else None)
         admission = self.search_backpressure.admission
+        shed_threshold = (rc.SHED_OCCUPANCY
+                          * admission.shed_priority(outer_opaque))
         if allow_partial and rc.SHED_ON_DURESS \
-                and admission.occupancy() >= rc.SHED_OCCUPANCY:
+                and admission.occupancy() >= shed_threshold:
             for shard in sorted(candidates):
                 cands = candidates[shard]
                 if not all(self.response_collector.in_duress(n)
                            for n in cands):
                     continue
                 metrics().counter("search.replica_selection.sheds").inc()
-                admission.record_shed()
+                admission.record_shed(tenant=outer_opaque)
                 failures.append(_exec.shard_failure_entry(
                     index, shard, cands[0], NodeDuressError(
                         f"[{index}][{shard}] shed: all in-sync copies "
@@ -1687,9 +1757,6 @@ class ClusterNode:
         # Client-attribution headers copy down from the enclosing task
         # (the reference's HEADERS_TO_COPY) so X-Opaque-Id reaches the
         # scatter payloads and this node's insight records
-        outer = taskmod.current()
-        outer_opaque = (outer.headers.get("X-Opaque-Id")
-                        if outer is not None else None)
         task = self.task_manager.register(
             "indices:data/read/search", f"search [{index}]",
             headers=({"X-Opaque-Id": outer_opaque}

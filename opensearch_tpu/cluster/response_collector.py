@@ -126,6 +126,14 @@ class ResponseCollectorService:
         self._clock = clock
         self.duress_ttl_s = float(duress_ttl_s)
         self._nodes: dict[str, NodeStatistics] = {}
+        # eviction tombstones: node id -> eviction time.  A node the
+        # cluster state just removed must not be resurrected by a LATE
+        # in-flight response/ping — the resurrected entry would carry
+        # the dead node's stale duress flag under a REFRESHED TTL (and
+        # stale EWMAs) until the next state application purged it
+        # again.  Tombstones expire after duress_ttl_s, or immediately
+        # when the node rejoins (``readmit``).
+        self._evicted: dict[str, float] = {}
         self._lock = threading.Lock()
 
     # -- ingestion ---------------------------------------------------------
@@ -135,6 +143,18 @@ class ResponseCollectorService:
         if st is None:
             st = self._nodes[node] = NodeStatistics(node, self._clock())
         return st
+
+    def _ingest_entry(self, node: str) -> Optional[NodeStatistics]:
+        """The entry for an ingestion path (response/failure/ping), or
+        None while the node sits under a live eviction tombstone —
+        samples from a removed node are dropped, never resurrected.
+        Caller holds the lock."""
+        ts = self._evicted.get(node)
+        if ts is not None:
+            if self._clock() - ts <= self.duress_ttl_s:
+                return None
+            del self._evicted[node]      # tombstone expired: new node
+        return self._entry(node)
 
     def _absorb_load(self, st: NodeStatistics, load: Optional[dict]):
         """Fold a piggybacked load snapshot (search response or fault-
@@ -159,7 +179,9 @@ class ResponseCollectorService:
         """One successful query-phase RPC: coordinator-measured response
         time plus whatever the node piggybacked."""
         with self._lock:
-            st = self._entry(node)
+            st = self._ingest_entry(node)
+            if st is None:
+                return
             st.response_time_nanos.add(float(response_time_nanos))
             st.response_count += 1
             st.last_update = self._clock()
@@ -171,7 +193,9 @@ class ResponseCollectorService:
         string of timeouts actually deranks the copy instead of
         averaging against stale fast samples)."""
         with self._lock:
-            st = self._entry(node)
+            st = self._ingest_entry(node)
+            if st is None:
+                return
             prev = st.response_time_nanos.value or 0.0
             st.response_time_nanos.add(max(2.0 * float(elapsed_nanos),
                                            2.0 * prev))
@@ -182,19 +206,25 @@ class ResponseCollectorService:
         """Freshness fallback: fault-detection pings carry the same load
         snapshot, so duress/queue stay current on idle coordinators."""
         with self._lock:
-            self._absorb_load(self._entry(node), load)
+            st = self._ingest_entry(node)
+            if st is not None:
+                self._absorb_load(st, load)
 
     def record_duress(self, node: str, in_duress: bool) -> None:
         """Direct seam (tests, local observations)."""
         with self._lock:
-            st = self._entry(node)
+            st = self._ingest_entry(node)
+            if st is None:
+                return
             st.duress = bool(in_duress)
             st.duress_updated = self._clock()
             st.last_update = st.duress_updated
 
     def incr_outstanding(self, node: str) -> None:
         with self._lock:
-            self._entry(node).outstanding += 1
+            st = self._ingest_entry(node)
+            if st is not None:
+                st.outstanding += 1
 
     def decr_outstanding(self, node: str) -> None:
         with self._lock:
@@ -210,9 +240,20 @@ class ResponseCollectorService:
             return 0 if st is None else st.outstanding
 
     def remove_node(self, node: str) -> None:
-        """A node that left the cluster takes its stats with it."""
+        """A node that left the cluster takes its stats with it — and
+        leaves a tombstone so a late in-flight sample cannot resurrect
+        the entry (stale duress flag and EWMAs) behind the state
+        apply's back."""
         with self._lock:
             self._nodes.pop(node, None)
+            self._evicted[node] = self._clock()
+
+    def readmit(self, node: str) -> None:
+        """Clear the eviction tombstone for a node present in the
+        applied cluster state (rejoin, or never-evicted): its samples
+        ingest normally again, starting from a clean slate."""
+        with self._lock:
+            self._evicted.pop(node, None)
 
     def tracked(self) -> set:
         with self._lock:

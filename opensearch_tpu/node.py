@@ -70,6 +70,14 @@ class Node:
         # query_insights, /_metrics labeled series)
         from opensearch_tpu.search.insights import QueryInsightsService
         self.insights = QueryInsightsService(node_id=self.node_id)
+        # per-tenant QoS + adaptive overload control (search/qos.py):
+        # the AIMD controller connecting the admission ledger / flight
+        # recorder / insights measurements to the shed-occupancy,
+        # batcher-window, and tenant-share knobs
+        from opensearch_tpu.search.qos import QosController
+        self.qos = QosController(
+            admission=self.search_backpressure.admission,
+            insights=self.insights)
         self._init_cluster_settings()
         from opensearch_tpu.common.persistent_tasks import \
             PersistentTasksService
@@ -113,6 +121,9 @@ class Node:
         allow_partial = Setting.bool_setting(
             "search.default_allow_partial_search_results", True,
             dynamic=True)
+        # compat-only: accepted and validated for client parity;
+        # single-node allocation has no routing decisions to gate
+        # knob-ok (tools/check_dead_settings.py)
         alloc_enable = Setting.str_setting(
             "cluster.routing.allocation.enable", "all", dynamic=True,
             choices=("all", "primaries", "new_primaries", "none"))
@@ -178,6 +189,24 @@ class Node:
             dynamic=True)
         batcher_max = Setting.int_setting(
             "search.batcher.max_batch", 64, min_value=2, dynamic=True)
+        # per-tenant QoS (search/qos.py): weighted admission shares per
+        # X-Opaque-Id ("tenantA:4,tenantB:1"; empty = one legacy pool),
+        # the default pool's weight for unlabeled traffic, and the
+        # adaptive AIMD controller's enable/pacing knobs
+        from opensearch_tpu.search.qos import parse_tenant_shares
+
+        def _shares_check(v: str):
+            parse_tenant_shares(v)
+        qos_shares = Setting(
+            "search.qos.tenant_shares", "", str,
+            validator=_shares_check, dynamic=True)
+        qos_default_share = Setting.float_setting(
+            "search.qos.default_share", 1.0, min_value=0.0,
+            dynamic=True)
+        qos_adaptive = Setting.bool_setting(
+            "search.qos.adaptive", False, dynamic=True)
+        qos_interval = Setting.float_setting(
+            "search.qos.interval_s", 1.0, min_value=0.01, dynamic=True)
         # measured device-memory budget: 0 = unlimited; exceeding it
         # unstages least-recently-dispatched segments (ROADMAP item 5's
         # host↔device paging seed, common/device_ledger.py)
@@ -198,7 +227,21 @@ class Node:
              max_keep_alive, default_keep_alive, allow_partial,
              req_cache_size, ins_enabled, ins_top_n, ins_window,
              ins_coalesce, device_budget, batcher_enabled,
-             batcher_window, batcher_max])
+             batcher_window, batcher_max, qos_shares,
+             qos_default_share, qos_adaptive, qos_interval])
+        # per-tenant QoS knobs reach the live admission gate and the
+        # controller immediately; persisted values replay at boot
+        adm = self.search_backpressure.admission
+        for setting, consumer in (
+                (qos_shares,
+                 lambda v: adm.set_tenant_shares(
+                     parse_tenant_shares(v))),
+                (qos_default_share, adm.set_default_share),
+                (qos_adaptive, self.qos.set_enabled),
+                (qos_interval, self.qos.set_interval_s)):
+            self.cluster_settings.add_settings_update_consumer(
+                setting, consumer)
+            consumer(self.cluster_settings.get(setting))
         # continuous-batcher knobs land on engine module globals (the
         # DEFAULT_ALLOW_PARTIAL_RESULTS idiom); the insights coalesce
         # window doubles as the batcher's auto window so the Δt always
@@ -291,6 +334,15 @@ class Node:
         self.cluster_settings.add_settings_update_consumer(
             max_keep_alive,
             lambda v: setattr(self.contexts, "max_keep_alive_s", v))
+        # search.default_keep_alive was registered-but-dead before this
+        # PR (tools/check_dead_settings.py caught it): it now sets the
+        # keepalive a PIT opened without an explicit keep_alive gets
+        self.cluster_settings.add_settings_update_consumer(
+            default_keep_alive,
+            lambda v: setattr(self.contexts, "default_keep_alive_s",
+                              float(v)))
+        self.contexts.default_keep_alive_s = float(
+            self.cluster_settings.get(default_keep_alive))
         # cluster-level slowlog threshold DEFAULTS (per-index settings
         # override; the reference layers index settings over node ones)
         from opensearch_tpu.indices import service as indices_mod

@@ -276,8 +276,13 @@ class RestController:
                     # saturated nodes reject (429 + Retry-After) instead
                     # of queueing unboundedly (the search_backpressure
                     # admission-control half)
+                    # the client's X-Opaque-Id doubles as the tenant
+                    # key: named tenants draw from their carved
+                    # admission share, everyone else from the default
+                    # pool (search.qos.tenant_shares)
                     bp = getattr(self.node, "search_backpressure", None)
-                    admission = (bp.admission.acquire(handler_name)
+                    admission = (bp.admission.acquire(handler_name,
+                                                      tenant=opaque_id)
                                  if bp is not None and action in (
                                      "indices:data/read/search",
                                      "indices:data/read/msearch")
@@ -302,6 +307,14 @@ class RestController:
                             # and the response-level outcome
                             self._record_insights(sink, resp, status,
                                                   task, opaque_id)
+                        if searchish:
+                            # close the loop: the QoS controller gets a
+                            # paced evaluation tick with the freshest
+                            # admission/insights evidence (no-op when
+                            # search.qos.adaptive is off)
+                            qos = getattr(self.node, "qos", None)
+                            if qos is not None:
+                                qos.maybe_tick()
                         if params.get("rest_total_hits_as_int") == "true" \
                                 and isinstance(resp, dict):
                             _total_hits_as_int(resp)
@@ -331,8 +344,8 @@ class RestController:
                 if insights is not None:
                     # rejected before any plan existed: counted in the
                     # insights totals (shed load is workload evidence),
-                    # never a ring entry
-                    insights.record_rejected()
+                    # never a ring entry — attributed to the tenant
+                    insights.record_rejected(opaque_id=opaque_id)
                 if response_headers is not None:
                     response_headers["Retry-After"] = str(
                         int(getattr(e, "retry_after_seconds", 1)))
@@ -731,6 +744,12 @@ class RestController:
                 # cardinality, and the coalescability fraction (full
                 # detail at GET /_insights/top_queries)
                 "query_insights": self.node.insights.stats(),
+                # per-tenant attribution (who sent what, at what cost,
+                # how often degraded) + the adaptive QoS controller's
+                # state: current knob values and the bounded audit ring
+                # of every adaptation with its triggering evidence
+                "tenants": self.node.insights.tenants(),
+                "qos": self.node.qos.stats(),
                 # the unified query engine: continuous-batcher
                 # accounting (members batched / bypasses / window
                 # waits / shared dispatches) + the bounded search
@@ -1840,7 +1859,11 @@ class RestController:
             raise ValidationError(
                 "point-in-time requires exactly one target index")
         svc = services[0]
-        ka = parse_keepalive(req.param("keep_alive"))
+        # no explicit keep_alive -> the dynamic search.default_keep_alive
+        ka = parse_keepalive(
+            req.param("keep_alive"),
+            default_ms=int(self.node.contexts.default_keep_alive_s
+                           * 1000))
         ctx = PitContext(svc.searcher(), svc.name)
         pit_id = self.node.contexts.open(ctx, ka)
         return 200, {"pit_id": pit_id,

@@ -61,7 +61,16 @@ class _Handler(BaseHTTPRequestHandler):
             # request line
             self.close_connection = True
             status, payload = 429, e.to_xcontent()
-            extra_headers["Retry-After"] = "1"
+            # Retry-After from the measured admission drain rate
+            # (permit-release EWMA, floor/ceiling clamped) instead of a
+            # hardcoded second — a wedged node tells clients to
+            # actually back off
+            hint = 1
+            bp = getattr(self.server.controller.node,
+                         "search_backpressure", None)
+            if bp is not None:
+                hint = bp.admission.retry_after_hint()
+            extra_headers["Retry-After"] = str(hint)
         else:
             try:
                 body = self.rfile.read(length) if length else b""

@@ -124,11 +124,38 @@ def _default_cpu_load() -> float:
         return 0.0
 
 
+#: bounded per-tenant accounting: the stats table never grows past this
+#: many labels (labels beyond the cap fold into the default pool's row)
+_TENANT_STAT_SLOTS = 64
+
+#: Retry-After bounds around the measured drain estimate
+RETRY_AFTER_FLOOR_S = 1
+RETRY_AFTER_CEILING_S = 30
+
+#: EWMA smoothing for the permit-release interval (drain rate)
+_RELEASE_ALPHA = 0.3
+
+
 class SearchAdmissionController:
     """Concurrent-search permit gate at the REST/coordinator edge: a
     request either gets a permit immediately or is rejected with 429 —
     never queued (the reference rejects from the search thread pool's
-    bounded queue; this gate fails faster and with Retry-After)."""
+    bounded queue; this gate fails faster and with Retry-After).
+
+    Multi-tenant: when ``search.qos.tenant_shares`` names tenants, the
+    global budget is carved into weighted per-tenant pools keyed by the
+    client's X-Opaque-Id (unlabeled traffic shares a default pool), so
+    one flooding tenant exhausts its OWN share and 429s while everyone
+    else's permits stay available.  The QoS controller can additionally
+    squeeze a noisy tenant's carved share via ``tenant_penalty`` — the
+    effective pool never drops below one permit (isolation, never
+    starvation).  With no shares configured the gate is the legacy
+    single pool; per-tenant accounting still records who used it.
+
+    ``Retry-After`` on rejections is derived from the measured drain
+    rate: an EWMA of the permit-release interval, clamped to
+    [``RETRY_AFTER_FLOOR_S``, ``RETRY_AFTER_CEILING_S``] — a fast gate
+    says "1", a wedged one tells clients to actually back off."""
 
     def __init__(self, service: "SearchBackpressureService",
                  max_concurrent: int = 256):
@@ -140,7 +167,111 @@ class SearchAdmissionController:
         # edge 429s: one client-visible-rejection ledger, one occupancy
         # signal (ROADMAP item 4's unified overload budget)
         self.shed_count = 0
+        # per-tenant QoS: configured weights, controller-set penalties,
+        # live per-pool inflight, and the bounded accounting table
+        self.tenant_shares: dict = {}
+        self.default_share = 1.0
+        self.tenant_penalty: dict = {}
+        self._tenant_inflight: dict = {}
+        self._tenant_stats: dict = {}
+        # measured drain rate: EWMA of seconds between permit releases
+        self._release_interval_ewma: "float | None" = None
+        self._last_release: "float | None" = None
         self._lock = threading.Lock()
+
+    # -- tenant plumbing (search.qos.* consumers) --------------------------
+
+    def set_tenant_shares(self, shares: dict) -> None:
+        with self._lock:
+            self.tenant_shares = dict(shares or {})
+
+    def set_default_share(self, share: float) -> None:
+        with self._lock:
+            self.default_share = max(0.0, float(share))
+
+    def set_tenant_penalty(self, label: str, penalty: float) -> None:
+        """QoS-controller seam: squeeze (or restore) one tenant's
+        carved share.  A penalty of 1.0 clears the entry."""
+        with self._lock:
+            if penalty >= 1.0:
+                self.tenant_penalty.pop(label, None)
+            else:
+                self.tenant_penalty[label] = float(penalty)
+
+    def _pool_label(self, tenant) -> str:
+        from opensearch_tpu.search.qos import DEFAULT_POOL, tenant_label
+        label = tenant_label(tenant)
+        if label != DEFAULT_POOL and label not in self.tenant_shares \
+                and len(self._tenant_stats) >= _TENANT_STAT_SLOTS \
+                and label not in self._tenant_stats:
+            return DEFAULT_POOL     # bounded table: overflow folds in
+        return label
+
+    def _tenant_limit_locked(self, label: str) -> "int | None":
+        """The carved permit cap for one pool; None = no carving (no
+        shares configured).  Caller holds the lock."""
+        if not self.tenant_shares:
+            return None
+        from opensearch_tpu.search.qos import DEFAULT_POOL
+        total = sum(self.tenant_shares.values()) + self.default_share
+        weight = (self.tenant_shares.get(label, self.default_share)
+                  if label != DEFAULT_POOL else self.default_share)
+        if total <= 0:
+            return self.max_concurrent
+        cap = max(1, int(self.max_concurrent * weight / total))
+        penalty = self.tenant_penalty.get(label)
+        if penalty is not None:
+            cap = max(1, int(cap * penalty))
+        return cap
+
+    def _tenant_stat_locked(self, label: str) -> dict:
+        st = self._tenant_stats.get(label)
+        if st is None:
+            st = self._tenant_stats[label] = {
+                "admitted": 0, "rejected": 0, "shed": 0}
+        return st
+
+    def shed_priority(self, tenant) -> float:
+        """Tenant-weighted shed bias for the coordinator duress path:
+        a penalized (noisy) tenant's requests shed at proportionally
+        lower admission occupancy than everyone else's."""
+        with self._lock:
+            label = self._pool_label(tenant)
+            return float(self.tenant_penalty.get(label, 1.0))
+
+    def cancellation_bias(self, opaque_id) -> float:
+        """Tenant weighting for backpressure victim election: tasks of
+        low-share (or penalized) tenants rank as proportionally bigger
+        resource consumers, so the noisy neighbor's runaway query is
+        cancelled before a premium tenant's equal-cost one.  1.0 when
+        no shares are configured (legacy election order)."""
+        with self._lock:
+            if not self.tenant_shares:
+                return 1.0
+            label = self._pool_label(opaque_id)
+            from opensearch_tpu.search.qos import DEFAULT_POOL
+            weight = (self.tenant_shares.get(label, self.default_share)
+                      if label != DEFAULT_POOL else self.default_share)
+            penalty = self.tenant_penalty.get(label, 1.0)
+            return (self.default_share / max(weight, 1e-9)) \
+                / max(penalty, 1e-9)
+
+    # -- drain-rate Retry-After --------------------------------------------
+
+    def _retry_after_locked(self) -> int:
+        ewma = self._release_interval_ewma
+        if ewma is None:
+            return RETRY_AFTER_FLOOR_S
+        import math
+        return min(RETRY_AFTER_CEILING_S,
+                   max(RETRY_AFTER_FLOOR_S, math.ceil(ewma)))
+
+    def retry_after_hint(self) -> int:
+        """Seconds until a permit plausibly frees, from the measured
+        permit-release EWMA (floor/ceiling clamped) — the Retry-After
+        every 429 on this node ships."""
+        with self._lock:
+            return self._retry_after_locked()
 
     def occupancy(self) -> float:
         """Permit-gate utilization in [0, 1] — the shared overload
@@ -150,17 +281,21 @@ class SearchAdmissionController:
                 return 1.0
             return self._inflight / self.max_concurrent
 
-    def record_shed(self, n: int = 1) -> None:
+    def record_shed(self, n: int = 1, tenant=None) -> None:
         """A coordinator-side duress shed counted against this gate's
         rejection budget (429s and sheds are the same client-visible
-        degradation, so they share one ledger)."""
+        degradation, so they share one ledger), attributed to the
+        tenant whose request was shed."""
         with self._lock:
             self.shed_count += int(n)
+            self._tenant_stat_locked(
+                self._pool_label(tenant))["shed"] += int(n)
 
     @contextlib.contextmanager
-    def acquire(self, kind: str = "search"):
+    def acquire(self, kind: str = "search", tenant=None):
         self._service.maybe_tick()
         with self._lock:
+            label = self._pool_label(tenant)
             reason = None
             if self._inflight >= self.max_concurrent:
                 reason = (f"too many concurrent searches "
@@ -169,19 +304,69 @@ class SearchAdmissionController:
             elif (self._service.mode == "enforced"
                     and self._service.in_duress()):
                 reason = "node is in duress"
+            else:
+                cap = self._tenant_limit_locked(label)
+                if cap is not None \
+                        and self._tenant_inflight.get(label, 0) >= cap:
+                    reason = (f"tenant [{label}] is over its admission "
+                              f"share [{self._tenant_inflight[label]}]"
+                              f" >= [{cap}]")
             if reason is not None:
                 self.rejected_count += 1
-                raise SearchRejectedError(
+                self._tenant_stat_locked(label)["rejected"] += 1
+                err = SearchRejectedError(
                     f"rejected execution of [{kind}]: {reason}; reduce "
                     "concurrency or retry after the Retry-After interval")
+                err.retry_after_seconds = self._retry_after_locked()
+                raise err
             self._inflight += 1
+            self._tenant_inflight[label] = \
+                self._tenant_inflight.get(label, 0) + 1
+            self._tenant_stat_locked(label)["admitted"] += 1
         try:
             yield
         finally:
             with self._lock:
                 self._inflight -= 1
+                left = self._tenant_inflight.get(label, 1) - 1
+                if left <= 0:
+                    self._tenant_inflight.pop(label, None)
+                else:
+                    self._tenant_inflight[label] = left
+                # measured drain rate: every release is one sample of
+                # "how fast do permits come back"
+                now = self._service._clock()
+                if self._last_release is not None:
+                    sample = max(0.0, now - self._last_release)
+                    if self._release_interval_ewma is None:
+                        self._release_interval_ewma = sample
+                    else:
+                        self._release_interval_ewma = (
+                            _RELEASE_ALPHA * sample
+                            + (1.0 - _RELEASE_ALPHA)
+                            * self._release_interval_ewma)
+                self._last_release = now
+
+    def tenant_stats(self) -> dict:
+        """Per-tenant budget accounting (the ``tenants`` block of the
+        admission stats): carved cap, live inflight, admitted /
+        rejected / shed tallies, and any controller penalty."""
+        with self._lock:
+            out = {}
+            for label in sorted(self._tenant_stats):
+                st = dict(self._tenant_stats[label])
+                st["inflight"] = self._tenant_inflight.get(label, 0)
+                cap = self._tenant_limit_locked(label)
+                if cap is not None:
+                    st["max_concurrent"] = cap
+                penalty = self.tenant_penalty.get(label)
+                if penalty is not None:
+                    st["penalty"] = penalty
+                out[label] = st
+            return out
 
     def stats(self) -> dict:
+        tenants = self.tenant_stats()
         with self._lock:
             occupancy = (self._inflight / self.max_concurrent
                          if self.max_concurrent > 0 else 1.0)
@@ -190,7 +375,9 @@ class SearchAdmissionController:
                     "occupancy": round(occupancy, 4),
                     "rejected_count": self.rejected_count,
                     "shed_count": self.shed_count,
-                    "rejected_total": self.rejected_count + self.shed_count}
+                    "rejected_total": self.rejected_count + self.shed_count,
+                    "retry_after_s": self._retry_after_locked(),
+                    "tenants": tenants}
 
 
 class SearchBackpressureService:
@@ -357,7 +544,11 @@ class SearchBackpressureService:
         """(task, dominant-tracker) pairs over every cancellable,
         not-yet-cancelled search task exceeding a per-task resource
         threshold, most expensive first (the reference's
-        TaskResourceUsageTrackers election)."""
+        TaskResourceUsageTrackers election).  With tenant shares
+        configured the overshoot is tenant-weighted: a low-share or
+        QoS-penalized tenant's task ranks as a proportionally bigger
+        consumer, so the noisy neighbor's runaway query is sacrificed
+        before a premium tenant's equal-cost one."""
         out = []
         for t in self.task_manager.list():
             if not t.cancellable or t.cancelled or not _is_search_task(t):
@@ -379,7 +570,9 @@ class SearchBackpressureService:
             # by that same measure so "the top resource consumer" is
             # well defined and deterministic
             dominant, score = max(over, key=lambda kv: kv[1])
-            out.append((score, t.id, t, dominant))
+            bias = self.admission.cancellation_bias(
+                getattr(t, "headers", {}).get("X-Opaque-Id"))
+            out.append((score * bias, t.id, t, dominant))
         out.sort(key=lambda e: (-e[0], e[1]))
         return [(t, dominant) for _s, _id, t, dominant in out]
 

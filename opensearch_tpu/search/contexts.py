@@ -134,6 +134,10 @@ class ReaderContextRegistry:
     # search.max_keep_alive (dynamic; node wires the consumer)
     max_keep_alive_s = 24 * 3600.0
 
+    # search.default_keep_alive (dynamic; node wires the consumer):
+    # the keepalive a PIT opened without an explicit keep_alive gets
+    default_keep_alive_s = 300.0
+
     def _check_keepalive(self, keepalive_ms: int):
         limit_ms = int(self.max_keep_alive_s * 1000)
         if keepalive_ms > limit_ms:

@@ -298,10 +298,68 @@ class _SignatureRollup:
         return out
 
 
+# -- per-tenant rollup -----------------------------------------------------
+
+_TENANT_SIG_SLOTS = 8        # top plan signatures kept per tenant
+
+
+class _TenantRollup:
+    """Aggregate workload statistics for ONE tenant (X-Opaque-Id) —
+    the attribution half of per-tenant QoS: who is sending what, how
+    much it costs, and how often it was degraded (429/shed/partial)."""
+
+    __slots__ = ("tenant", "count", "rejected", "took_sum", "took_max",
+                 "cpu_nanos", "outcomes", "signatures", "first_ts",
+                 "last_ts")
+
+    def __init__(self, tenant: str, now: float):
+        self.tenant = tenant
+        self.count = 0
+        self.rejected = 0          # admission 429s (no plan existed)
+        self.took_sum = 0.0
+        self.took_max = 0.0
+        self.cpu_nanos = 0
+        self.outcomes: dict[str, int] = {}
+        self.signatures: dict[str, int] = {}
+        self.first_ts = now
+        self.last_ts = now
+
+    def add(self, sig: str, took_ms: float, cpu_nanos: int,
+            outcome: str, now: float) -> None:
+        self.count += 1
+        self.took_sum += took_ms
+        if took_ms > self.took_max:
+            self.took_max = took_ms
+        self.cpu_nanos += cpu_nanos
+        self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+        if sig in self.signatures \
+                or len(self.signatures) < _TENANT_SIG_SLOTS:
+            self.signatures[sig] = self.signatures.get(sig, 0) + 1
+        self.last_ts = now
+
+    def to_dict(self) -> dict:
+        return {
+            "tenant": self.tenant,
+            "count": self.count,
+            "rejected": self.rejected,
+            "latency_ms": {
+                "avg": round(self.took_sum / self.count, 3)
+                if self.count else 0.0,
+                "max": round(self.took_max, 3),
+            },
+            "cpu_time_in_nanos": self.cpu_nanos,
+            "outcomes": dict(self.outcomes),
+            "top_signatures": dict(sorted(
+                self.signatures.items(),
+                key=lambda kv: (-kv[1], kv[0]))),
+        }
+
+
 # -- the service -----------------------------------------------------------
 
 _RECORD_OVERHEAD_BYTES = 400        # per-record bookkeeping estimate
 _ROLLUP_OVERHEAD_BYTES = 1200       # per-rollup (histogram + dicts)
+_TENANT_OVERHEAD_BYTES = 600        # per-tenant rollup (small dicts)
 
 
 class QueryInsightsService:
@@ -316,6 +374,7 @@ class QueryInsightsService:
                  window_s: float = 300.0,
                  coalesce_window_ms: float = 10.0,
                  ring_capacity: int = 256, max_signatures: int = 128,
+                 max_tenants: int = 64,
                  clock=time.monotonic, breaker: str = "request"):
         self.node_id = node_id
         self.enabled = True
@@ -324,11 +383,14 @@ class QueryInsightsService:
         self.coalesce_window_ms = float(coalesce_window_ms)
         self.ring_capacity = int(ring_capacity)
         self.max_signatures = int(max_signatures)
+        self.max_tenants = int(max_tenants)
         self.clock = clock
         self._breaker_name = breaker
         self._lock = threading.Lock()
         self._ring: "deque[dict]" = deque()
         self._rollups: dict[str, _SignatureRollup] = {}
+        self._tenants: dict[str, _TenantRollup] = {}
+        self._outcomes: dict[str, int] = {}
         self._ring_bytes = 0
         self._total = 0
         self._coalesced_total = 0
@@ -465,13 +527,43 @@ class QueryInsightsService:
             roll.add(rec, now, self.coalesce_window_ms / 1000.0)
             self._total += 1
             self._coalesced_total += roll.coalesced - was_coalesced
+            self._outcomes[rec["outcome"]] = \
+                self._outcomes.get(rec["outcome"], 0) + 1
+            tenant = self._tenant_locked(rec.get("opaque_id"), now)
+            if tenant is not None:
+                tenant.add(sig, float(rec.get("took_ms", 0.0)),
+                           int(rec.get("cpu_nanos") or 0),
+                           rec["outcome"], now)
 
-    def record_rejected(self) -> None:
+    def _tenant_locked(self, opaque_id, now: float):
+        """The tenant rollup for this record's X-Opaque-Id (the
+        anonymous default pool for unlabeled traffic) — same bounded
+        LRU + breaker discipline as the signature rollups.  Caller
+        holds the lock; None when the breaker refused the charge."""
+        from opensearch_tpu.search.qos import tenant_label
+        label = tenant_label(opaque_id)
+        roll = self._tenants.pop(label, None)
+        if roll is None:
+            if not self._charge(_TENANT_OVERHEAD_BYTES):
+                return None
+            if len(self._tenants) >= self.max_tenants:
+                victim = next(iter(self._tenants))
+                del self._tenants[victim]
+                self._release(_TENANT_OVERHEAD_BYTES)
+                self._evictions += 1
+            roll = _TenantRollup(label, now)
+        self._tenants[label] = roll            # move-to-end on touch
+        return roll
+
+    def record_rejected(self, opaque_id: Optional[str] = None) -> None:
         """An admission-gate 429 happened before any plan existed —
         counted (the shed load is workload evidence too) but never a
-        ring entry."""
+        ring entry; attributed to the rejected client's tenant."""
         with self._lock:
             self._rejected += 1
+            tenant = self._tenant_locked(opaque_id, self.clock())
+            if tenant is not None:
+                tenant.rejected += 1
 
     def _expire(self, now: float) -> None:
         cutoff = now - self.window_s
@@ -529,10 +621,29 @@ class QueryInsightsService:
                 for r in best],
         }
 
+    def tenants(self) -> dict:
+        """Per-tenant rollups keyed by tenant label (the QoS
+        attribution surface: ``?by=tenant``, ``_nodes/stats``, the
+        noisy-neighbor soak's evidence)."""
+        with self._lock:
+            return {label: r.to_dict()
+                    for label, r in sorted(self._tenants.items())}
+
+    def tenant_totals(self) -> dict:
+        """Compact per-tenant (count, rejected) — the QoS controller's
+        cheap per-tick signal."""
+        with self._lock:
+            return {label: {"count": r.count, "rejected": r.rejected}
+                    for label, r in self._tenants.items()}
+
     def section(self, by: str = "latency",
                 n: Optional[int] = None) -> dict:
         """The full per-node insights section (`_insights/top_queries`
-        fan-in unit and the flight-recorder snapshot)."""
+        fan-in unit and the flight-recorder snapshot).  ``by=tenant``
+        serves the same section with the latency top ranking — the
+        per-tenant rollups are always included; any other unknown
+        ranking still rejects (400) inside ``top``."""
+        rank_by = "latency" if by == "tenant" else by
         with self._lock:
             rollups = {sig: r.to_dict()
                        for sig, r in sorted(self._rollups.items())}
@@ -540,8 +651,9 @@ class QueryInsightsService:
             "node": self.node_id,
             "enabled": self.enabled,
             "window_s": self.window_s,
-            "top_queries": self.top(by=by, n=n),
+            "top_queries": self.top(by=rank_by, n=n),
             "signatures": rollups,
+            "tenants": self.tenants(),
             "coalescability": self.coalescability(),
             "totals": self.stats(),
         }
@@ -557,6 +669,8 @@ class QueryInsightsService:
                 "ring_size": len(self._ring),
                 "ring_bytes": self._ring_bytes,
                 "signatures": len(self._rollups),
+                "tenants": len(self._tenants),
+                "outcomes": dict(self._outcomes),
                 "coalesced": coalesced,
                 "coalescable_fraction": round(coalesced / total, 4)
                 if total else 0.0,
@@ -622,6 +736,34 @@ class QueryInsightsService:
             lines.append(
                 f'opensearch_tpu_insights_signature_coalescable_ratio'
                 f'{{signature="{sig}",node="{node}"}} {frac:.6g}')  # label-ok: signature hashes via the bounded top-N path
+        # per-tenant attribution: tenant is a LABEL from the bounded
+        # (max_tenants, then top-N-by-count) rollup table, never a name
+        with self._lock:
+            trolls = sorted(self._tenants.values(),
+                            key=lambda r: (-r.count, r.tenant))
+            trolls = trolls[: self.top_n]
+        lines.append(
+            "# HELP opensearch_tpu_insights_tenant_queries_total "
+            "Completed searches per tenant (X-Opaque-Id)")
+        lines.append(
+            "# TYPE opensearch_tpu_insights_tenant_queries_total "
+            "counter")
+        for r in trolls:
+            ten = self._label_value(r.tenant)
+            lines.append(
+                f'opensearch_tpu_insights_tenant_queries_total'
+                f'{{tenant="{ten}",node="{node}"}} {r.count}')  # label-ok: bounded tenant rollup slots via the top-N path
+        lines.append(
+            "# HELP opensearch_tpu_insights_tenant_rejected_total "
+            "Admission 429s per tenant (X-Opaque-Id)")
+        lines.append(
+            "# TYPE opensearch_tpu_insights_tenant_rejected_total "
+            "counter")
+        for r in trolls:
+            ten = self._label_value(r.tenant)
+            lines.append(
+                f'opensearch_tpu_insights_tenant_rejected_total'
+                f'{{tenant="{ten}",node="{node}"}} {r.rejected}')  # label-ok: bounded tenant rollup slots via the top-N path
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
@@ -630,6 +772,10 @@ class QueryInsightsService:
             for _ in range(len(self._rollups)):
                 self._release(_ROLLUP_OVERHEAD_BYTES)
             self._rollups.clear()
+            for _ in range(len(self._tenants)):
+                self._release(_TENANT_OVERHEAD_BYTES)
+            self._tenants.clear()
+            self._outcomes.clear()
             self._total = self._coalesced_total = 0
             self._dropped = self._rejected = self._evictions = 0
 
@@ -648,6 +794,7 @@ def merge_sections(sections: dict[str, dict], *, by: str = "latency",
     rank_key = QueryInsightsService._RANKS.get(by, "took_ms")
     merged_top: list[dict] = []
     merged_sigs: dict[str, dict] = {}
+    merged_tenants: dict[str, dict] = {}
     errors: dict[str, str] = {}
     total = coalesced = 0
     for node in sorted(sections):
@@ -672,6 +819,21 @@ def merge_sections(sections: dict[str, dict], *, by: str = "latency",
             m["count"] += int(roll.get("count", 0))
             m["coalesced"] += int(roll.get("coalesced", 0))
             m["nodes"][node] = roll
+        for tenant, roll in (sec.get("tenants") or {}).items():
+            m = merged_tenants.get(tenant)
+            if m is None:
+                m = {"tenant": tenant, "count": 0, "rejected": 0,
+                     "cpu_time_in_nanos": 0, "outcomes": {},
+                     "nodes": {}}
+                merged_tenants[tenant] = m
+            m["count"] += int(roll.get("count", 0))
+            m["rejected"] += int(roll.get("rejected", 0))
+            m["cpu_time_in_nanos"] += int(
+                roll.get("cpu_time_in_nanos", 0))
+            for outcome, c in (roll.get("outcomes") or {}).items():
+                m["outcomes"][outcome] = \
+                    m["outcomes"].get(outcome, 0) + int(c)
+            m["nodes"][node] = roll
     for m in merged_sigs.values():
         m["coalescable_fraction"] = round(
             m["coalesced"] / m["count"], 4) if m["count"] else 0.0
@@ -681,6 +843,7 @@ def merge_sections(sections: dict[str, dict], *, by: str = "latency",
     out = {
         "top_queries": merged_top[: max(1, int(n))],
         "signatures": dict(sorted(merged_sigs.items())),
+        "tenants": dict(sorted(merged_tenants.items())),
         "coalescability": {
             "arrivals": total,
             "coalesced": coalesced,

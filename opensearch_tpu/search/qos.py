@@ -1,0 +1,397 @@
+"""Per-tenant QoS + adaptive overload control: the loop closer.
+
+PRs 4/6/7 added the overload knobs (admission permits, duress shed +
+``search.replica_selection.shed_occupancy``, the PR-12 batcher window)
+and PRs 9/10 added the measurements (flight-recorder breaches,
+per-signature percentiles/interarrival/coalescability, per-client
+``X-Opaque-Id`` attribution).  This module connects them:
+
+- ``parse_tenant_shares`` turns the ``search.qos.tenant_shares``
+  setting ("tenantA:4,tenantB:1") into the weighted admission shares
+  ``SearchAdmissionController`` carves per tenant (unlabeled traffic
+  shares a default pool weighted by ``search.qos.default_share``).
+
+- ``QosController`` is the feedback half: an AIMD controller on an
+  injectable clock that reads the *measured* overload evidence each
+  tick — 429/shed deltas from the admission ledger, breach deltas from
+  the flight recorder, the coalescability fraction from query
+  insights, per-tenant attempt shares from the admission tenant
+  ledger — and adapts three knob families:
+
+  * ``search.replica_selection.shed_occupancy`` (the coordinator
+    duress-shed threshold): multiplicative decrease under sustained
+    client-visible rejections (shed earlier, relieve the collapse),
+    additive recovery toward a ceiling when healthy (stop shedding
+    traffic the fleet can absorb).
+  * the continuous batcher's auto Δt window (``engine.AUTO_WINDOW_MS``,
+    only while ``search.batcher.window_ms`` is 0 = auto): widened
+    under pressure when the workload is measurably coalescable (more
+    arrivals amortize into each dispatch), decayed back toward the
+    configured base when healthy.
+  * per-tenant admission penalties: the tenant dominating the window's
+    admission attempts far beyond its weighted fair share — the noisy
+    neighbor — gets its carved share multiplicatively squeezed (never
+    below one permit), recovering additively once the pressure clears.
+
+  Every adaptation appends an audit record (old -> new + the numeric
+  evidence that triggered it) to a bounded ring surfaced in
+  ``_nodes/stats`` ``qos`` and mirrored into the flight recorder, so a
+  3am "why did the batch window grow" has a recorded answer.
+
+Hysteresis: a knob only moves after ``hysteresis_ticks`` consecutive
+hot (or healthy) evaluations, and AIMD keeps every move bounded — the
+controller walks, it never jumps.  Deterministic under a seeded
+workload: all decisions are pure functions of counter deltas on an
+injectable clock (tests drive ``run_once`` directly on a fake clock).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from opensearch_tpu.common.errors import IllegalArgumentError
+
+#: tenant labels are bounded strings (they become stats keys and
+#: Prometheus label values via the bounded top-N path)
+TENANT_LABEL_CHARS = 64
+
+#: the pool every unlabeled (no X-Opaque-Id) or unlisted tenant draws
+#: from, weighted by ``search.qos.default_share``
+DEFAULT_POOL = "_default"
+
+
+def tenant_label(opaque_id: Optional[str]) -> str:
+    """Normalize an ``X-Opaque-Id`` into a bounded tenant label; the
+    anonymous pool for unlabeled traffic."""
+    if not opaque_id:
+        return DEFAULT_POOL
+    return str(opaque_id)[:TENANT_LABEL_CHARS]
+
+
+def parse_tenant_shares(spec) -> dict:
+    """``"tenantA:4,tenantB:1"`` -> ``{"tenantA": 4.0, "tenantB": 1.0}``
+    (already-parsed dicts pass through).  Raises IllegalArgumentError on
+    malformed entries so the settings validator rejects bad updates
+    before they land."""
+    if not spec:
+        return {}
+    if isinstance(spec, dict):
+        items = spec.items()
+    else:
+        items = []
+        for part in str(spec).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, sep, weight = part.rpartition(":")
+            if not sep or not name.strip():
+                raise IllegalArgumentError(
+                    f"malformed tenant share [{part}]; expected "
+                    "<tenant>:<weight>[,<tenant>:<weight>...]")
+            items.append((name.strip(), weight))
+    out = {}
+    for name, weight in items:
+        try:
+            w = float(weight)
+        except (TypeError, ValueError):
+            raise IllegalArgumentError(
+                f"tenant [{name}] share [{weight}] is not a number")
+        if w <= 0:
+            raise IllegalArgumentError(
+                f"tenant [{name}] share must be > 0, got [{w}]")
+        out[str(name)[:TENANT_LABEL_CHARS]] = w
+    return out
+
+
+class QosController:
+    """The AIMD feedback controller (module docstring).  ``run_once``
+    is one deterministic evaluation; production paces it via
+    ``maybe_tick()`` on the search dispatch path (the same pacing idiom
+    as ``SearchBackpressureService``)."""
+
+    def __init__(self, *, admission, insights,
+                 clock=time.monotonic, interval_s: float = 1.0,
+                 audit_capacity: int = 64):
+        self.admission = admission
+        self.insights = insights
+        self._clock = clock
+        self.enabled = False
+        self.interval_s = float(interval_s)
+        # watermarks on the window's client-visible rejection fraction
+        # (429s + coordinator sheds over admission attempts)
+        self.high_watermark = 0.10
+        self.low_watermark = 0.01
+        #: consecutive hot/healthy evaluations before a knob moves
+        self.hysteresis_ticks = 2
+        # AIMD bounds per knob family
+        self.shed_occupancy_floor = 0.0
+        self.shed_occupancy_ceiling = 0.95
+        self.shed_occupancy_step = 0.05      # additive increase
+        self.md_factor = 0.5                 # multiplicative decrease
+        self.window_ceiling_ms = 50.0
+        self.window_growth = 1.5
+        self.coalescable_gate = 0.25
+        self.penalty_floor = 0.25
+        self.penalty_step = 0.25             # additive recovery
+        #: a tenant is "noisy" when its share of the window's admission
+        #: attempts exceeds this multiple of its weighted fair share
+        self.noisy_multiple = 2.0
+        self._audit: "deque[dict]" = deque(maxlen=int(audit_capacity))
+        self._lock = threading.Lock()
+        self._last_tick: Optional[float] = None
+        self._snap: Optional[dict] = None
+        self._hot = 0
+        self._healthy = 0
+        self.ticks = 0
+        self.adaptations = 0
+
+    # -- settings consumers ------------------------------------------------
+
+    def set_enabled(self, v: bool) -> None:
+        self.enabled = bool(v)
+
+    def set_interval_s(self, v: float) -> None:
+        self.interval_s = max(0.01, float(v))
+
+    # -- signal collection -------------------------------------------------
+
+    def _signals(self) -> dict:
+        """One snapshot of every measured input: the admission ledger
+        (global + per-tenant), the flight recorder's breach counter,
+        and the insights coalescability report."""
+        from opensearch_tpu.common.telemetry import metrics
+        adm = self.admission.stats()
+        ins = self.insights.stats()
+        return {
+            "rejected": int(adm.get("rejected_count", 0)),
+            "shed": int(adm.get("shed_count", 0)),
+            "occupancy": float(adm.get("occupancy", 0.0)),
+            "tenants": {
+                label: {"admitted": int(t.get("admitted", 0)),
+                        "rejected": int(t.get("rejected", 0))}
+                for label, t in (adm.get("tenants") or {}).items()},
+            "arrivals": int(ins.get("records", 0)),
+            "coalescable_fraction": float(
+                ins.get("coalescable_fraction", 0.0)),
+            "captures": int(metrics().counter(
+                "flight_recorder.captures").value),
+            # the controller's OWN audit captures must not read back as
+            # breach evidence (a self-sustaining hot loop otherwise)
+            "own_captures": int(metrics().counter(
+                "qos.adaptations").value),
+        }
+
+    # -- pacing ------------------------------------------------------------
+
+    def maybe_tick(self) -> None:
+        """At most one evaluation per ``interval_s`` — called from the
+        search dispatch edge, so an idle node adapts nothing."""
+        if not self.enabled:
+            return
+        now = self._clock()
+        with self._lock:
+            if (self._last_tick is not None
+                    and now - self._last_tick < self.interval_s):
+                return
+            self._last_tick = now
+        self.run_once()
+
+    # -- the evaluation ----------------------------------------------------
+
+    def run_once(self) -> dict:
+        """One deterministic control evaluation over the counter deltas
+        since the previous one.  Returns what happened (tests/logs)."""
+        cur = self._signals()
+        with self._lock:
+            prev, self._snap = self._snap, cur
+            self.ticks += 1
+        if prev is None:
+            # first tick only establishes the delta baseline
+            return {"hot": False, "adapted": []}
+        d_rej = (max(0, cur["rejected"] - prev["rejected"])
+                 + max(0, cur["shed"] - prev["shed"]))
+        d_arr = max(0, cur["arrivals"] - prev["arrivals"])
+        d_breach = max(0, (cur["captures"] - prev["captures"])
+                       - (cur["own_captures"] - prev["own_captures"]))
+        attempts = d_arr + d_rej
+        reject_rate = d_rej / attempts if attempts else 0.0
+        hot = attempts > 0 and (reject_rate >= self.high_watermark
+                                or d_breach > 0)
+        healthy = d_breach == 0 and reject_rate <= self.low_watermark
+        with self._lock:
+            self._hot = self._hot + 1 if hot else 0
+            self._healthy = self._healthy + 1 if healthy else 0
+            act_hot = self._hot >= self.hysteresis_ticks
+            act_healthy = (not act_hot
+                           and self._healthy >= self.hysteresis_ticks)
+            if act_hot:
+                self._hot = 0
+            if act_healthy:
+                self._healthy = 0
+        evidence = {
+            "reject_rate": round(reject_rate, 4),
+            "rejected": d_rej, "attempts": attempts,
+            "breaches": d_breach,
+            "occupancy": cur["occupancy"],
+            "coalescable_fraction": cur["coalescable_fraction"],
+        }
+        adapted: list[dict] = []
+        if act_hot:
+            adapted += self._tighten(cur, prev, evidence)
+        elif act_healthy:
+            adapted += self._relax(evidence)
+        return {"hot": hot, "adapted": adapted}
+
+    # -- multiplicative decrease (pressure) --------------------------------
+
+    def _tighten(self, cur: dict, prev: dict,
+                 evidence: dict) -> list[dict]:
+        from opensearch_tpu.cluster import response_collector as rc_mod
+        from opensearch_tpu.search import engine as engine_mod
+        adapted = []
+        # 1) shed earlier: duress sheds fire at lower occupancy
+        old = rc_mod.SHED_OCCUPANCY
+        new = max(self.shed_occupancy_floor,
+                  round(old * self.md_factor, 4))
+        if new != old:
+            rc_mod.SHED_OCCUPANCY = new
+            adapted.append(self._record("shed_occupancy", old, new,
+                                        evidence))
+        # 2) coalesce harder: a measurably coalescable workload under
+        # pressure amortizes better with a wider batch window (only the
+        # AUTO window — an operator-pinned window_ms stays pinned)
+        if (cur["coalescable_fraction"] >= self.coalescable_gate
+                and engine_mod.BATCHER_WINDOW_MS == 0):
+            old_w = float(engine_mod.AUTO_WINDOW_MS)
+            new_w = min(self.window_ceiling_ms,
+                        round(max(old_w, 1.0) * self.window_growth, 3))
+            if new_w != old_w:
+                engine_mod.AUTO_WINDOW_MS = new_w
+                adapted.append(self._record(
+                    "batcher_auto_window_ms", old_w, new_w, evidence))
+        # 3) squeeze the noisy neighbor: the tenant dominating this
+        # window's admission attempts far beyond its weighted fair
+        # share loses carved share (floor: one permit — isolation,
+        # never starvation)
+        noisy = self._noisy_tenant(cur, prev)
+        if noisy is not None:
+            label, share, fair = noisy
+            old_p = float(self.admission.tenant_penalty.get(label, 1.0))
+            new_p = max(self.penalty_floor,
+                        round(old_p * self.md_factor, 4))
+            if new_p != old_p:
+                self.admission.set_tenant_penalty(label, new_p)
+                adapted.append(self._record(
+                    "tenant_penalty", old_p, new_p,
+                    dict(evidence, attempt_share=round(share, 4),
+                         fair_share=round(fair, 4)),
+                    tenant=label))
+        return adapted
+
+    def _noisy_tenant(self, cur: dict, prev: dict):
+        """(label, attempt_share, fair_share) of the dominant tenant
+        when it exceeds ``noisy_multiple`` x its weighted fair share —
+        and at least one OTHER tenant is known to the gate (with a
+        single tenant there is no neighbor to protect)."""
+        shares = dict(getattr(self.admission, "tenant_shares", {}) or {})
+        deltas = {}
+        for label, t in cur["tenants"].items():
+            p = prev["tenants"].get(label, {})
+            d = (max(0, t["admitted"] - int(p.get("admitted", 0)))
+                 + max(0, t["rejected"] - int(p.get("rejected", 0))))
+            if d > 0:
+                deltas[label] = d
+        if not deltas or len(cur["tenants"]) < 2:
+            return None         # no victim in evidence: nothing to weigh
+        total = sum(deltas.values())
+        default_share = float(getattr(self.admission, "default_share",
+                                      1.0))
+        weight_total = sum(shares.values()) + default_share
+        label = max(sorted(deltas), key=lambda t: deltas[t])
+        share = deltas[label] / total
+        fair = (shares.get(label, default_share) / weight_total
+                if weight_total > 0 else 1.0)
+        if share > self.noisy_multiple * fair:
+            return label, share, fair
+        return None
+
+    # -- additive increase (recovery) --------------------------------------
+
+    def _relax(self, evidence: dict) -> list[dict]:
+        from opensearch_tpu.cluster import response_collector as rc_mod
+        from opensearch_tpu.search import engine as engine_mod
+        adapted = []
+        old = rc_mod.SHED_OCCUPANCY
+        if 0 < old < self.shed_occupancy_ceiling:
+            new = min(self.shed_occupancy_ceiling,
+                      round(old + self.shed_occupancy_step, 4))
+            rc_mod.SHED_OCCUPANCY = new
+            adapted.append(self._record("shed_occupancy", old, new,
+                                        evidence))
+        base = float(self.insights.coalesce_window_ms)
+        old_w = float(engine_mod.AUTO_WINDOW_MS)
+        if engine_mod.BATCHER_WINDOW_MS == 0 and old_w > base:
+            new_w = max(base, round(old_w * self.md_factor, 3))
+            engine_mod.AUTO_WINDOW_MS = new_w
+            adapted.append(self._record(
+                "batcher_auto_window_ms", old_w, new_w, evidence))
+        for label in sorted(dict(self.admission.tenant_penalty)):
+            old_p = float(self.admission.tenant_penalty[label])
+            new_p = min(1.0, round(old_p + self.penalty_step, 4))
+            self.admission.set_tenant_penalty(label, new_p)
+            adapted.append(self._record("tenant_penalty", old_p, new_p,
+                                        evidence, tenant=label))
+        return adapted
+
+    # -- audit ring --------------------------------------------------------
+
+    def _record(self, knob: str, old, new, evidence: dict,
+                tenant: Optional[str] = None) -> dict:
+        from opensearch_tpu.common.telemetry import flight_recorder, \
+            metrics
+        rec = {"tick": self.ticks, "knob": knob, "old": old, "new": new,
+               "evidence": dict(evidence)}
+        if tenant is not None:
+            rec["tenant"] = tenant
+        with self._lock:
+            self._audit.append(rec)
+            self.adaptations += 1
+        metrics().counter("qos.adaptations").inc()
+        flight_recorder().record(
+            "qos_adaptation",
+            f"qos: [{knob}] {old} -> {new}"
+            + (f" tenant [{tenant}]" if tenant else ""),
+            detail=dict(rec))
+        return rec
+
+    def audit(self, limit: int = 64) -> list[dict]:
+        """Most recent adaptation records, newest first."""
+        with self._lock:
+            out = [dict(r) for r in self._audit]
+        out.reverse()
+        return out[: max(0, int(limit))]
+
+    def stats(self) -> dict:
+        from opensearch_tpu.cluster import response_collector as rc_mod
+        from opensearch_tpu.search import engine as engine_mod
+        with self._lock:
+            hot, healthy = self._hot, self._healthy
+            ticks, adaptations = self.ticks, self.adaptations
+        return {
+            "enabled": self.enabled,
+            "interval_s": self.interval_s,
+            "ticks": ticks,
+            "adaptations": adaptations,
+            "hot_streak": hot,
+            "healthy_streak": healthy,
+            "knobs": {
+                "shed_occupancy": rc_mod.SHED_OCCUPANCY,
+                "batcher_auto_window_ms": engine_mod.AUTO_WINDOW_MS,
+                "tenant_penalties":
+                    dict(self.admission.tenant_penalty),
+            },
+            "audit": self.audit(16),
+        }

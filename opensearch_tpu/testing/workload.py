@@ -31,6 +31,7 @@ enforced in tier-1 via ``tests/test_soak.py`` and recorded as a
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import random
@@ -1099,3 +1100,288 @@ def run_soak(data_path: Optional[str] = None, *,
     cfg = (SoakConfig.full(**overrides) if full
            else SoakConfig.smoke(**overrides))
     return SoakRunner(data_path, cfg).run()
+
+
+# -- noisy-neighbor QoS scenario -------------------------------------------
+
+
+class NoisyNeighborRunner(SoakRunner):
+    """The per-tenant QoS soak: two tenants against one coordinator —
+    a well-behaved victim issuing sequential zipf-tail searches, and an
+    aggressor flooding the zipf HEAD in concurrent bursts that exceed
+    its carved admission share many times over.  Every shard query
+    phase is slowed by a seeded delay so the bursts genuinely overlap
+    inside the admission window.
+
+    SLOs assert ISOLATION, not absence of overload: the victim's p99
+    and 429-rate hold while the aggressor's flood is shed at the
+    admission gate (its own 429s), and the adaptive QoS controller —
+    ticked deterministically once per op — records at least one
+    adaptation (with its triggering evidence) in the audit ring.
+    Same-seed runs produce identical verdicts (two-run determinism,
+    pinned in tests/test_qos.py)."""
+
+    VICTIM = "tenant-victim"
+    AGGRESSOR = "tenant-aggressor"
+
+    def __init__(self, data_path: Optional[str] = None,
+                 config: Optional[SoakConfig] = None, *,
+                 burst: int = 12, delay_s: float = 0.03,
+                 admission_permits: int = 8,
+                 victim_share: float = 6.0,
+                 aggressor_share: float = 1.0,
+                 slos: Optional[dict] = None):
+        super().__init__(data_path, config or SoakConfig(
+            seed=42, n_ops=16, n_docs=24, control_run=False))
+        self.burst = int(burst)
+        self.delay_s = float(delay_s)
+        self.admission_permits = int(admission_permits)
+        self.victim_share = float(victim_share)
+        self.aggressor_share = float(aggressor_share)
+        self.qos_slos = slos if slos is not None else {
+            # generous CI-safe bounds: verdicts must be deterministic
+            # across runs/hosts; observed values track the trajectory
+            "victim_p99_ms": 10_000.0,
+            "victim_max_429_rate": 0.0,
+            "aggressor_min_429": 1,
+            "min_qos_adaptations": 1,
+            "max_unexpected_errors": 0,
+        }
+
+    @contextlib.contextmanager
+    def _as_tenant(self, node, tenant: str):
+        """Run the enclosed client calls under a registered task whose
+        X-Opaque-Id names the tenant — the same header threading the
+        REST edge performs, so admission, sheds, and insights all
+        attribute to the tenant."""
+        from opensearch_tpu.common import tasks as taskmod
+        task = node.task_manager.register(
+            "rest:noisy_neighbor", f"[{tenant}]",
+            headers={"X-Opaque-Id": tenant})
+        token = taskmod.set_current(task)
+        try:
+            yield
+        finally:
+            taskmod.reset_current(token)
+            node.task_manager.unregister(task)
+
+    def _flood(self, coord, index: str, body: dict, ctx: dict) -> None:
+        """One aggressor burst: ``burst`` concurrent identical
+        zipf-head searches released by a barrier, each under the
+        aggressor tenant.  The per-tenant admission carve means most of
+        the burst 429s while the victim's permits stay untouched."""
+        barrier = threading.Barrier(self.burst)
+
+        def one():
+            barrier.wait(timeout=10.0)
+            t0 = time.monotonic()
+            try:
+                with self._as_tenant(coord, self.AGGRESSOR):
+                    coord.search(index, dict(body))
+                _bump(ctx, "aggr_ok")
+            except OpenSearchTpuError as exc:
+                if getattr(exc, "status", 0) == 429:
+                    _bump(ctx, "aggr_429")
+                elif self._retryable(exc):
+                    _bump(ctx, "client_retries")
+                else:
+                    with ctx["lock"]:
+                        ctx["unexpected"].append(
+                            f"aggressor: {type(exc).__name__}: {exc}")
+            finally:
+                ctx["hists"]["aggressor"].observe(
+                    (time.monotonic() - t0) * 1000.0)
+        threads = [threading.Thread(target=one,
+                                    name=f"noisy-aggr-{i}",
+                                    daemon=True)
+                   for i in range(self.burst)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+
+    def run(self) -> dict:    # noqa: C901 — one linear scenario
+        from opensearch_tpu.cluster import response_collector as rc_mod
+        from opensearch_tpu.search import engine as engine_mod
+        from opensearch_tpu.testing.fault_injection import FaultInjector
+        from opensearch_tpu.transport.service import LocalTransport
+
+        cfg = self.config
+        root = f"{self.data_path}/noisy"
+        hub = LocalTransport.Hub()
+        nodes = {nid: self._build_node(hub, nid, root)
+                 for nid in cfg.node_ids}
+        # the coordinator-only client: no shards, so every shard query
+        # phase crosses the transport hub and the seeded delay applies
+        coord_id = "c0"
+        nodes[coord_id] = self._build_node(hub, coord_id, root,
+                                           roles=("master",))
+        coord = nodes[coord_id]
+        ctx = {
+            "lock": threading.Lock(),
+            "hists": {"victim": Histogram("noisy.victim"),
+                      "aggressor": Histogram("noisy.aggressor")},
+            "victim_ok": 0, "victim_429": 0,
+            "aggr_ok": 0, "aggr_429": 0,
+            "client_retries": 0, "unexpected": [],
+        }
+        # adaptive knobs are process-global module settings: save and
+        # restore so the scenario leaves no trace in the suite
+        saved_shed = rc_mod.SHED_OCCUPANCY
+        saved_window = engine_mod.AUTO_WINDOW_MS
+        faults = FaultInjector(hub, seed=cfg.seed)
+        try:
+            leader = cfg.node_ids[0]
+            if not nodes[leader].start_election():
+                raise SoakHarnessError("initial election failed")
+            self._wait(lambda: all(
+                nodes[i].coordinator.state().master_node == leader
+                for i in cfg.node_ids), what="initial leader convergence")
+            nodes[leader].coordinator.add_node(
+                coord_id, {"name": coord_id, "roles": ["master"],
+                           "master_eligible": True})
+            self._wait(lambda: coord_id in
+                       coord.coordinator.state().nodes,
+                       what="coordinator-only node joining")
+            nodes[leader].create_index(cfg.index, {
+                "settings": {"number_of_shards": cfg.shards,
+                             "number_of_replicas": cfg.replicas},
+                "mappings": {"properties": {
+                    "body": {"type": "text"}, "v": {"type": "long"}}}})
+            self._wait(lambda: self._in_sync_full(nodes, leader),
+                       what="initial shard allocation")
+            workload = MixedWorkload(cfg)
+            for doc_id, source in workload.seed_docs():
+                nodes[leader].index_doc(cfg.index, doc_id,
+                                        {"body": source["body"],
+                                         "v": source["v"]})
+            nodes[leader].refresh(cfg.index)
+
+            # per-tenant QoS on the coordinator: a small carved budget
+            # (aggressor gets ~1 permit), the adaptive controller armed
+            # with single-tick hysteresis and a shed threshold it can
+            # demonstrably walk down
+            adm = coord.search_backpressure.admission
+            adm.max_concurrent = self.admission_permits
+            adm.set_tenant_shares({self.VICTIM: self.victim_share,
+                                   self.AGGRESSOR: self.aggressor_share})
+            coord.qos.set_enabled(True)
+            coord.qos.hysteresis_ticks = 1
+            rc_mod.SHED_OCCUPANCY = 0.5
+            # seeded slowdown on every data node's query phase so the
+            # aggressor's bursts genuinely overlap in the gate
+            for nid in cfg.node_ids:
+                faults.slow_search_node(nid, self.delay_s)
+
+            queries = zipf_query_log(max(16, cfg.n_ops), cfg.vocab_size,
+                                     seed=cfg.seed)
+            head_body = {"query": {"match": {"body": "t0 t1"}},
+                         "size": 10}
+            qi = 0
+            for i in range(cfg.n_ops):
+                if i % 4 == 3:
+                    self._flood(coord, cfg.index, head_body, ctx)
+                else:
+                    a, b = queries[qi % len(queries)]
+                    qi += 1
+                    body = {"query": {"match": {"body": f"t{a} t{b}"}},
+                            "size": 10}
+                    t0 = time.monotonic()
+                    try:
+                        with self._as_tenant(coord, self.VICTIM):
+                            coord.search(cfg.index, body)
+                        _bump(ctx, "victim_ok")
+                    except OpenSearchTpuError as exc:
+                        if getattr(exc, "status", 0) == 429:
+                            _bump(ctx, "victim_429")
+                        else:
+                            ctx["unexpected"].append(
+                                f"victim op {i}: "
+                                f"{type(exc).__name__}: {exc}")
+                    finally:
+                        ctx["hists"]["victim"].observe(
+                            (time.monotonic() - t0) * 1000.0)
+                # deterministic controller pacing: exactly one
+                # evaluation per op, so the adaptation count is a pure
+                # function of the op stream's admission evidence
+                coord.qos.run_once()
+
+            report = self._qos_report(coord, ctx)
+        finally:
+            rc_mod.SHED_OCCUPANCY = saved_shed
+            engine_mod.AUTO_WINDOW_MS = saved_window
+            faults.clear()
+            for n in list(nodes.values()):
+                n.stop()
+            if self._own_dir:
+                shutil.rmtree(self.data_path, ignore_errors=True)
+        return report
+
+    def _qos_report(self, coord, ctx: dict) -> dict:
+        slos = self.qos_slos
+        victim_ops = ctx["victim_ok"] + ctx["victim_429"]
+        victim_rate = (ctx["victim_429"] / victim_ops
+                       if victim_ops else 0.0)
+        victim_p99 = ctx["hists"]["victim"].percentile(99)
+        qos_stats = coord.qos.stats()
+        verdicts = [
+            {"slo": "victim_p99_ms", "limit": slos["victim_p99_ms"],
+             "observed": round(victim_p99, 3),
+             "ok": victim_p99 <= slos["victim_p99_ms"]},
+            {"slo": "victim_429_rate",
+             "limit": slos["victim_max_429_rate"],
+             "observed": round(victim_rate, 4),
+             "ok": victim_rate <= slos["victim_max_429_rate"]},
+            {"slo": "aggressor_shed",
+             "limit": slos["aggressor_min_429"],
+             "observed": ctx["aggr_429"],
+             "ok": ctx["aggr_429"] >= slos["aggressor_min_429"]},
+            {"slo": "qos_adaptations",
+             "limit": slos["min_qos_adaptations"],
+             "observed": qos_stats["adaptations"],
+             "ok": (qos_stats["adaptations"]
+                    >= slos["min_qos_adaptations"])},
+            {"slo": "unexpected_errors",
+             "limit": slos["max_unexpected_errors"],
+             "observed": len(ctx["unexpected"]),
+             "ok": (len(ctx["unexpected"])
+                    <= slos["max_unexpected_errors"])},
+        ]
+        return {
+            "seed": self.config.seed,
+            "ops": self.config.n_ops,
+            "burst": self.burst,
+            "tenants": {
+                self.VICTIM: {
+                    "ops": victim_ops, "ok": ctx["victim_ok"],
+                    "rejected": ctx["victim_429"],
+                    "p99_ms": round(victim_p99, 3)},
+                self.AGGRESSOR: {
+                    "ops": ctx["aggr_ok"] + ctx["aggr_429"],
+                    "ok": ctx["aggr_ok"],
+                    "rejected": ctx["aggr_429"],
+                    "p99_ms": round(
+                        ctx["hists"]["aggressor"].percentile(99), 3)},
+            },
+            "client_retries": ctx["client_retries"],
+            "unexpected_errors": list(ctx["unexpected"]),
+            "admission": coord.search_backpressure.admission.stats(),
+            "insights_tenants": coord.insights.tenants(),
+            "qos": qos_stats,
+            "verdicts": verdicts,
+            "slo_ok": all(v["ok"] for v in verdicts),
+        }
+
+
+def run_noisy_neighbor(data_path: Optional[str] = None,
+                       **overrides) -> dict:
+    """One-call entry point for the noisy-neighbor QoS scenario
+    (bench.py's ``qos`` phase, tests/test_qos.py's acceptance)."""
+    cfg_keys = {"seed", "n_ops", "n_docs", "shards", "replicas",
+                "vocab_size"}
+    cfg_over = {k: v for k, v in overrides.items() if k in cfg_keys}
+    run_over = {k: v for k, v in overrides.items() if k not in cfg_keys}
+    cfg = SoakConfig(control_run=False,
+                     **{"seed": 42, "n_ops": 16, "n_docs": 24,
+                        **cfg_over})
+    return NoisyNeighborRunner(data_path, cfg, **run_over).run()

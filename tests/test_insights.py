@@ -405,8 +405,10 @@ def test_metrics_exposition_carries_bounded_signature_labels(node):
     rx = re.compile(_PROM_LINE)
     for line in series:
         assert rx.match(line), f"invalid prometheus line: {line!r}"
-        # the signature is a LABEL (bounded 12-hex hash), never a name
-        assert re.search(r'\{signature="[0-9a-f_]{1,12}"', line), line
+        # the signature (or PR-14 tenant) is a LABEL drawn from a
+        # bounded path — never part of the metric name
+        assert re.search(r'\{signature="[0-9a-f_]{1,12}"', line) \
+            or re.search(r'\{tenant="[^"]{1,64}"', line), line
         assert "node=" in line
     counts = [l for l in series
               if l.startswith(
@@ -419,7 +421,7 @@ def test_rejected_searches_counted_without_ring_entries(node):
     node.insights.reset()
     orig = node.search_backpressure.admission.acquire
 
-    def rejecting(_name):
+    def rejecting(_name, tenant=None):
         raise SearchRejectedError("saturated", retry_after_seconds=1)
     node.search_backpressure.admission.acquire = rejecting
     try:

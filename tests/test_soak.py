@@ -173,6 +173,25 @@ def test_full_soak_configuration(tmp_path):
     assert r["slo_ok"], r["verdicts"]
 
 
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [7, 101, 202])
+def test_multi_seed_soak_sweep_verdicts_deterministic(tmp_path, seed):
+    """Multi-seed sweep (nightly; marked slow so tier-1 keeps its
+    budget — the fixed-seed smoke above stays the tier-1 gate): each
+    seed produces a DIFFERENT schedule but the two-run determinism
+    contract holds per seed — identical schedule, identical verdicts,
+    zero unexpected errors, convergence with the uninjected control."""
+    r1 = run_soak(str(tmp_path / "a"), seed=seed)
+    r2 = run_soak(str(tmp_path / "b"), seed=seed)
+    assert r1["chaos"]["schedule"] == r2["chaos"]["schedule"]
+    v1 = [(v["slo"], v["ok"]) for v in r1["verdicts"]]
+    v2 = [(v["slo"], v["ok"]) for v in r2["verdicts"]]
+    assert v1 == v2
+    assert r1["chaos"]["unexpected_errors"] == []
+    conv = next(v for v in r1["verdicts"] if v["slo"] == "convergence")
+    assert conv["ok"], conv
+
+
 # -- satellite: single-search replica spill ---------------------------------
 
 def test_single_search_spill_rotates_off_busy_preferred(tmp_path):

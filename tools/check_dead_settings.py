@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Lint: every dynamic setting registered in node.py must be ALIVE.
+
+A dynamic ``_cluster/settings`` knob that validates and persists but
+reaches no consumer is worse than a missing one: operators flip it, the
+API acknowledges, and nothing changes (this repo shipped two —
+``search_backpressure.mode`` pre-PR-4 and ``search.default_keep_alive``
+pre-PR-14).  So for every ``name = Setting...("key", ..., dynamic=True)``
+assignment in ``opensearch_tpu/node.py``, the assigned name must be
+USED beyond merely being listed in the ``SettingsRegistry(...)``
+constructor — an ``add_settings_update_consumer(name, ...)`` wiring, a
+module-global setter tuple, or any other read site in the file counts.
+A deliberately consumer-less setting (compat/validation-only) carries a
+``# knob-ok`` annotation on the assignment line or a line above it.
+
+Sibling of ``check_seeded_rng.py``/``check_metric_names.py``; new dead
+knobs fail tier-1 (tests/test_qos.py runs this check).
+
+Usage: python tools/check_dead_settings.py [file ...]   (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ANNOTATION = "# knob-ok"
+
+
+def _setting_assignments(tree: ast.AST) -> list[tuple[str, str, int]]:
+    """(var_name, setting_key, lineno) for every ``name = Setting...(
+    "key", ..., dynamic=True)`` assignment."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        call = node.value
+        if not isinstance(call, ast.Call):
+            continue
+        fn = call.func
+        # Setting(...) or Setting.int_setting(...) / .bool_setting(...)
+        is_setting = (isinstance(fn, ast.Name) and fn.id == "Setting") \
+            or (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "Setting")
+        if not is_setting:
+            continue
+        if not call.args or not isinstance(call.args[0], ast.Constant) \
+                or not isinstance(call.args[0].value, str):
+            continue
+        dynamic = any(kw.arg == "dynamic"
+                      and isinstance(kw.value, ast.Constant)
+                      and kw.value.value is True
+                      for kw in call.keywords)
+        if not dynamic:
+            continue
+        out.append((target.id, call.args[0].value, node.lineno))
+    return out
+
+
+def _registry_name_counts(tree: ast.AST) -> dict[str, int]:
+    """How many times each Name is loaded INSIDE a
+    ``SettingsRegistry(...)`` constructor call (those loads are mere
+    registration, not consumption)."""
+    counts: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if name != "SettingsRegistry":
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx,
+                                                        ast.Load):
+                counts[sub.id] = counts.get(sub.id, 0) + 1
+    return counts
+
+
+def _load_counts(tree: ast.AST) -> dict[str, int]:
+    counts: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            counts[node.id] = counts.get(node.id, 0) + 1
+    return counts
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error ({e.msg})"]
+    lines = src.splitlines()
+    loads = _load_counts(tree)
+    registry = _registry_name_counts(tree)
+    problems = []
+    for name, key, lineno in _setting_assignments(tree):
+        consumed = loads.get(name, 0) - registry.get(name, 0)
+        if consumed > 0:
+            continue
+        annotated = False
+        for ln in range(max(0, lineno - 2), min(len(lines), lineno)):
+            if ANNOTATION in lines[ln]:
+                annotated = True
+        if annotated:
+            continue
+        problems.append(
+            f"{path}:{lineno}: dynamic setting [{key}] (var [{name}]) "
+            "is registered but has no live consumer — wire an "
+            "add_settings_update_consumer / module-global setter / "
+            f"read site, or annotate '{ANNOTATION}'")
+    return problems
+
+
+def _default_roots() -> list[str]:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return [os.path.join(repo, "opensearch_tpu", "node.py")]
+
+
+def main(argv: list[str]) -> int:
+    roots = argv[1:] or _default_roots()
+    problems = []
+    for root in roots:
+        if os.path.isfile(root):
+            problems.extend(check_file(root))
+            continue
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    problems.extend(check_file(
+                        os.path.join(dirpath, name)))
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} dead dynamic setting(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
