@@ -331,6 +331,16 @@ def main():
         phase_report("device", {"platform": platform,
                                 "error": f"{type(e).__name__}: {e}"})
 
+    # -- phase: device_faults (breaker trip -> degraded qps -> probe
+    # recovery) -----------------------------------------------------------
+    if os.environ.get("OSTPU_BENCH_DEVFAULTS", "1") != "0":
+        try:
+            run_devfaults_phase(searcher, queries, seq_n, platform)
+        except Exception as e:  # noqa: BLE001 — report, keep the bench
+            phase_report("device_faults",
+                         {"platform": platform,
+                          "error": f"{type(e).__name__}: {e}"})
+
     # -- phase: tier (search-only replica fleet over the remote store) ----
     if os.environ.get("OSTPU_BENCH_TIER", "1") != "0":
         try:
@@ -648,6 +658,80 @@ def run_device_phase(searcher, queries, seq_n: int, platform: str):
     finally:
         bm25_ops.HOST_SCORING = prev_host
         led.set_budget(prev_budget)
+
+
+def run_devfaults_phase(searcher, queries, seq_n: int, platform: str):
+    """Accelerator fault-tolerance line: the same zipf sample runs (a)
+    healthy on the device kernels, (b) under a sticky injected dispatch
+    fault — the per-kernel circuit breaker trips and scored term-bags
+    degrade byte-identically to the host impact tables — and (c) after
+    the heal, where half-open probes re-close the breaker.  The line
+    records qps-under-trip, the degradation latency delta, and the
+    probe-recovery count, so 'what does a sick accelerator cost' is
+    measured, not asserted."""
+    from opensearch_tpu.common.device_health import device_health
+    from opensearch_tpu.common.telemetry import metrics
+    from opensearch_tpu.ops import bm25 as bm25_ops
+    from opensearch_tpu.testing.fault_injection import \
+        DeviceFaultInjector
+
+    dh = device_health()
+    prev_dh = (dh.enabled, dh.failure_threshold, dh.open_interval_s)
+    prev_host = bm25_ops.HOST_SCORING
+    bm25_ops.HOST_SCORING = False
+    dh.reset()
+    dh.set_failure_threshold(2)
+    dh.set_open_interval_s(0.0)
+    try:
+        sample = queries[: min(seq_n, 50)]
+        for q in sample:                    # stage + warm the kernels
+            searcher.search(q)
+        t0 = time.monotonic()
+        for q in sample:
+            searcher.search(q)
+        healthy_s = time.monotonic() - t0
+
+        trips0 = metrics().counter("device.breaker.trips").value
+        inj = DeviceFaultInjector(seed=1234)
+        inj.dispatch_error()                # sticky: every dispatch dies
+        with inj:
+            t0 = time.monotonic()
+            for q in sample:
+                searcher.search(q)
+            tripped_s = time.monotonic() - t0
+        trips = metrics().counter("device.breaker.trips").value - trips0
+
+        closes0 = metrics().counter("device.breaker.closes").value
+        t0 = time.monotonic()
+        for q in sample:                    # healed: probes re-close
+            searcher.search(q)
+        healed_s = time.monotonic() - t0
+        recoveries = metrics().counter(
+            "device.breaker.closes").value - closes0
+
+        n = len(sample)
+        data = {
+            "platform": platform,
+            "n_queries": n,
+            "qps_healthy": round(n / healthy_s, 1) if healthy_s else 0.0,
+            "qps_under_trip": round(n / tripped_s, 1) if tripped_s
+            else 0.0,
+            "qps_healed": round(n / healed_s, 1) if healed_s else 0.0,
+            "degradation_delta_ms": round(
+                (tripped_s - healthy_s) / n * 1000.0, 3) if n else 0.0,
+            "breaker_trips": int(trips),
+            "probe_recoveries": int(recoveries),
+            "breaker_states": device_health().breaker_states(),
+            "host_fallbacks": int(metrics().counter(
+                "device.host_fallback").value),
+            "poisoned_results": dh.stats()["poisoned_results"],
+        }
+        phase_report("device_faults", data)
+        return data
+    finally:
+        bm25_ops.HOST_SCORING = prev_host
+        dh.reset()
+        dh.enabled, dh.failure_threshold, dh.open_interval_s = prev_dh
 
 
 def run_tier_phase(platform: str):

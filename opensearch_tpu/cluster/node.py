@@ -59,6 +59,7 @@ def _degradable_search_error(exc: BaseException) -> bool:
     """Is this shard-level failure one the coordinator may paper over
     (retry the next copy / count in ``_shards.failed``)?"""
     from opensearch_tpu.common import breakers
+    from opensearch_tpu.common.device_health import DeviceDegradedError
     from opensearch_tpu.common.errors import CircuitBreakingError
     from opensearch_tpu.common.tasks import TaskCancelledException
 
@@ -66,11 +67,14 @@ def _degradable_search_error(exc: BaseException) -> bool:
     # degrades to a counted failure: the coordinator returns the partial
     # results it has instead of hanging or failing the whole search.
     # A locally-poisoned copy (CorruptIndexError) fails over the same
-    # way a remote one does — another copy has the data
+    # way a remote one does — another copy has the data.  A copy whose
+    # ACCELERATOR is misbehaving (DeviceDegradedError: open device
+    # breaker / dispatch fault with no host fallback) degrades the same
+    # way — another copy's device may be healthy
     if isinstance(exc, (NodeDisconnectedError, ReceiveTimeoutError,
                         ShardNotFoundError, CircuitBreakingError,
                         breakers.CircuitBreakingError,
-                        CorruptIndexError,
+                        CorruptIndexError, DeviceDegradedError,
                         TaskCancelledException)):
         return True
     if isinstance(exc, RemoteTransportError):
@@ -193,7 +197,8 @@ class ClusterNode:
         from opensearch_tpu.search.qos import QosController
         self.qos = QosController(
             admission=self.search_backpressure.admission,
-            insights=self.insights)
+            insights=self.insights,
+            backpressure=self.search_backpressure)
         # data-node write admission (the same per-shard byte accounting
         # the single-node path gets from IndicesService)
         from opensearch_tpu.common.indexing_pressure import IndexingPressure

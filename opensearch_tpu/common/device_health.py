@@ -1,0 +1,348 @@
+"""Accelerator fault tolerance: per-kernel-class circuit breakers.
+
+The entire hot path of this engine runs through ONE accelerator —
+staging (H2D), XLA dispatch, and the D2H result fetch — which makes the
+device a single fault domain none of the cluster-level fault tolerance
+(PRs 2-8) ever covered: a staging RESOURCE_EXHAUSTED, a compile
+failure, a wedged dispatch, or a NaN-poisoned result used to surface as
+a 500 or silent garbage.  This module is the degradation brain:
+
+- ``DeviceHealthService`` keeps one circuit breaker per KERNEL CLASS
+  (``staging`` = H2D transfers, ``dispatch`` = the per-segment query
+  programs, ``batch`` = the msearch/continuous-batch kernel, ``mesh`` =
+  the device-collective scatter-gather).  Each breaker walks the
+  classic state machine: *closed* (healthy) -> *open* after
+  ``failure_threshold`` consecutive device errors (counted in
+  ``device.breaker.trips``) -> *half_open* once ``open_interval_s`` has
+  elapsed (probe traffic allowed) -> *closed* again on a successful
+  probe (``device.breaker.closes``) or back to *open* on a failed one.
+
+- While a breaker is open, callers degrade instead of dispatching:
+  scored term-bags score on the host impact tables BYTE-IDENTICALLY
+  (the PR-5/PR-11 invariant — ``use_host`` in ``ShardSearcher._topk``),
+  batch groups fall back to ``BatchGroup._run_host`` (same invariant),
+  the mesh demotes to the counted ``_host_scatter_search`` fallback,
+  and plans with no host fallback degrade into PR-2-style partial
+  ``_shards.failures[]`` via ``DeviceDegradedError`` instead of 500s.
+
+- ``is_device_error`` is the classifier: jax/jaxlib runtime errors
+  (``XlaRuntimeError`` et al.), allocator ``MemoryError``, and the
+  seeded faults ``testing/fault_injection.py::DeviceFaultInjector``
+  injects (marked ``__device_fault__``).  Client errors (parsing,
+  validation) and the request-breaker's ``CircuitBreakingError`` are
+  NOT device errors — they must keep their own semantics.
+
+- ``check_finite`` is the result-sanity guard used at the D2H sync
+  regions: non-finite scores other than the ``-inf`` empty-slot
+  sentinel mean the device returned poison; the caller discards them,
+  recomputes on the host, counts ``device.poisoned_results`` and files
+  a flight-recorder capture (``record_poison``).
+
+The service is process-global like the residency ledger (in-process
+multi-node tests share one device, so they honestly share one health
+view); tests reset via ``device_health().reset()``.  Dynamic settings:
+``device.health.{enabled,failure_threshold,open_interval_s}``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+from opensearch_tpu.common.telemetry import metrics as _metrics
+
+#: the kernel classes with their own breaker (callers may use others;
+#: breakers are created on first record)
+KERNEL_CLASSES = ("staging", "dispatch", "batch", "mesh")
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class DeviceDegradedError(OpenSearchTpuError):
+    """A device-side failure with no byte-identical host fallback: the
+    search DEGRADES — partial ``_shards.failures[]`` at the coordinator
+    (this error is in the PR-2 degradable class), never a 500."""
+
+    status = 503
+
+
+class DevicePoisonError(OpenSearchTpuError):
+    """Non-finite scores read back from the device (the result-sanity
+    guard's finding) — recorded as a dispatch failure so consecutive
+    poison trips the breaker like any other device misbehavior."""
+
+    status = 503
+    __device_fault__ = True
+
+
+def is_device_error(exc: BaseException) -> bool:
+    """Device-fault classifier (module docstring).  Intentionally does
+    NOT match the request/fielddata breaker's CircuitBreakingError (an
+    admission decision, not a device fault) or client errors."""
+    if getattr(exc, "__device_fault__", False):
+        return True                # injected faults + DevicePoisonError
+    if isinstance(exc, MemoryError):
+        return True                # allocator exhaustion during staging
+    for klass in type(exc).__mro__:
+        mod = getattr(klass, "__module__", "") or ""
+        if mod.startswith(("jaxlib", "jax.")) or mod == "jax":
+            return True
+        if klass.__name__ == "XlaRuntimeError":
+            return True
+    return False
+
+
+def check_finite(vals) -> int:
+    """Result-sanity guard for a device score array already synced to
+    the host: returns the count of POISONED entries — NaN or +inf
+    (``-inf`` is the legitimate empty-slot sentinel of every top-k
+    kernel here).  0 means the result is sane."""
+    import numpy as np
+
+    a = np.asarray(vals)
+    if a.dtype.kind not in "fc":
+        return 0
+    bad = ~np.isfinite(a) & ~np.isneginf(a)
+    return int(bad.sum())
+
+
+class _Breaker:
+    """One kernel class's circuit-breaker state."""
+
+    __slots__ = ("kind", "state", "streak", "trips", "closes",
+                 "failures", "successes", "opened_at", "last_error")
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self.state = CLOSED
+        self.streak = 0            # consecutive failures while closed
+        self.trips = 0
+        self.closes = 0
+        self.failures = 0
+        self.successes = 0
+        self.opened_at: Optional[float] = None
+        self.last_error: Optional[str] = None
+
+    def to_dict(self, now: float) -> dict:
+        out = {"state": self.state, "consecutive_failures": self.streak,
+               "trips": self.trips, "closes": self.closes,
+               "failures": self.failures, "successes": self.successes}
+        if self.opened_at is not None and self.state != CLOSED:
+            out["open_for_ms"] = round((now - self.opened_at) * 1000.0, 3)
+        if self.last_error:
+            out["last_error"] = self.last_error
+        return out
+
+
+class DeviceHealthService:
+    """Per-kernel-class circuit breakers over an injectable clock
+    (module docstring).  ``allow`` / ``record_success`` /
+    ``record_failure`` are the whole caller contract."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.enabled = True
+        self.failure_threshold = 3
+        self.open_interval_s = 30.0
+        self.poisoned_results = 0
+        self._breakers: dict[str, _Breaker] = {
+            k: _Breaker(k) for k in KERNEL_CLASSES}
+
+    # -- settings consumers ------------------------------------------------
+
+    def set_enabled(self, v: bool) -> None:
+        self.enabled = bool(v)
+
+    def set_failure_threshold(self, v: int) -> None:
+        self.failure_threshold = max(1, int(v))
+
+    def set_open_interval_s(self, v: float) -> None:
+        self.open_interval_s = max(0.0, float(v))
+
+    # -- the caller contract -----------------------------------------------
+
+    def _breaker(self, kind: str) -> _Breaker:
+        b = self._breakers.get(kind)
+        if b is None:
+            with self._lock:
+                b = self._breakers.setdefault(kind, _Breaker(kind))
+        return b
+
+    def allow(self, kind: str) -> bool:
+        """May this kernel class dispatch to the device right now?
+        False only while the breaker is OPEN inside its cooldown; once
+        ``open_interval_s`` elapses the breaker moves to half-open and
+        the next requests run as probes (their outcome closes or
+        re-opens it)."""
+        if not self.enabled:
+            return True
+        b = self._breaker(kind)
+        if b.state == CLOSED:
+            return True
+        with self._lock:
+            if b.state == OPEN:
+                if (b.opened_at is not None
+                        and self._clock() - b.opened_at
+                        >= self.open_interval_s):
+                    b.state = HALF_OPEN
+                else:
+                    return False
+            return b.state == HALF_OPEN
+
+    def record_success(self, kind: str) -> None:
+        """A device operation of this class completed sane: resets the
+        failure streak; a half-open probe success re-closes the
+        breaker."""
+        b = self._breaker(kind)
+        if b.state == CLOSED and b.streak == 0:
+            b.successes += 1       # hot path: no lock, plain increment
+            return
+        with self._lock:
+            b.successes += 1
+            b.streak = 0
+            if b.state != CLOSED:
+                b.state = CLOSED
+                b.opened_at = None
+                b.closes += 1
+        _metrics().counter("device.breaker.closes").inc()
+
+    def record_failure(self, kind: str,
+                       exc: Optional[BaseException] = None) -> None:
+        """One device error of this class.  ``failure_threshold``
+        consecutive errors trip the breaker open; a failure during
+        half-open re-opens it immediately.  Marks ``exc`` so layered
+        handlers (staging error re-caught at the dispatch site) don't
+        double-count one fault."""
+        if exc is not None:
+            if getattr(exc, "_dh_recorded", False):
+                return
+            try:
+                exc._dh_recorded = True
+            except Exception:      # frozen/slotted exception: count anyway
+                pass
+        b = self._breaker(kind)
+        now = self._clock()
+        tripped = False
+        with self._lock:
+            b.failures += 1
+            b.streak += 1
+            if exc is not None:
+                b.last_error = f"{type(exc).__name__}: {exc}"[:200]
+            if self.enabled and b.state == HALF_OPEN:
+                b.state = OPEN     # failed probe: back to cooldown
+                b.opened_at = now
+            elif self.enabled and b.state == CLOSED \
+                    and b.streak >= self.failure_threshold:
+                b.state = OPEN
+                b.opened_at = now
+                b.trips += 1
+                tripped = True
+        _metrics().counter("device.errors").inc()
+        if tripped:
+            _metrics().counter("device.breaker.trips").inc()
+            from opensearch_tpu.common.telemetry import flight_recorder
+            flight_recorder().record(
+                "device_breaker_trip",
+                f"device [{kind}] circuit breaker tripped after "
+                f"{self.failure_threshold} consecutive errors",
+                detail={"kernel_class": kind,
+                        "failure_threshold": self.failure_threshold,
+                        "last_error": b.last_error})
+
+    def record_poison(self, *, kernel: str, segment: str = "-",
+                      index: str = "-", shard=0, bad: int = 0) -> None:
+        """The result-sanity guard found non-finite device scores: the
+        caller has discarded them and is recomputing on the host; this
+        files the evidence (counter + flight capture) and feeds the
+        dispatch breaker so sustained poison trips it."""
+        with self._lock:
+            self.poisoned_results += 1
+        _metrics().counter("device.poisoned_results").inc()
+        from opensearch_tpu.common.telemetry import flight_recorder
+        flight_recorder().record(
+            "device_poisoned_result",
+            f"non-finite scores from device kernel [{kernel}] on "
+            f"[{index}][{shard}] segment [{segment}]: discarded and "
+            "recomputed on host",
+            detail={"kernel": kernel, "segment": segment, "index": index,
+                    "shard": shard, "non_finite_values": int(bad)})
+        self.record_failure(
+            "batch" if kernel.startswith("batch") else "dispatch",
+            DevicePoisonError(
+                f"[{kernel}] returned {bad} non-finite scores"))
+
+    # -- readout -----------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The ``_nodes/stats`` ``device.health`` block."""
+        now = self._clock()
+        with self._lock:
+            breakers = {k: b.to_dict(now)
+                        for k, b in sorted(self._breakers.items())}
+            poisoned = self.poisoned_results
+        return {
+            "enabled": self.enabled,
+            "failure_threshold": self.failure_threshold,
+            "open_interval_s": self.open_interval_s,
+            "poisoned_results": poisoned,
+            "breakers": breakers,
+        }
+
+    def breaker_states(self) -> dict:
+        """{kind: state} snapshot (soak SLO assertions)."""
+        with self._lock:
+            return {k: b.state for k, b in self._breakers.items()}
+
+    def tripped_kinds(self) -> list:
+        """Kernel classes whose breaker tripped at least once."""
+        with self._lock:
+            return sorted(k for k, b in self._breakers.items()
+                          if b.trips > 0)
+
+    def prometheus_text(self) -> str:
+        """Breaker-state gauges for the ``/_metrics`` scrape (trip and
+        close counters already flow through the MetricsRegistry)."""
+        s = self.stats()
+        lines = [
+            "# HELP opensearch_tpu_device_breaker_open Device kernel-"
+            "class circuit breaker state (0 closed, 1 open, "
+            "0.5 half-open)",
+            "# TYPE opensearch_tpu_device_breaker_open gauge",
+        ]
+        val = {CLOSED: "0", HALF_OPEN: "0.5", OPEN: "1"}
+        for kind, b in s["breakers"].items():
+            kv = (str(kind).replace("\\", "\\\\").replace('"', '\\"'))
+            lines.append(
+                f'opensearch_tpu_device_breaker_open{{kernel="{kv}"}} '  # label-ok: bounded kernel classes
+                f'{val.get(b["state"], "1")}')
+        lines.append(
+            "# HELP opensearch_tpu_device_poisoned_results_gauge "
+            "Non-finite device results discarded by the sanity guard")
+        lines.append(
+            "# TYPE opensearch_tpu_device_poisoned_results_gauge gauge")
+        lines.append(
+            f"opensearch_tpu_device_poisoned_results_gauge "
+            f"{s['poisoned_results']}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        """Test hook: all breakers back to closed, counters zeroed,
+        thresholds back to defaults."""
+        with self._lock:
+            self._breakers = {k: _Breaker(k) for k in KERNEL_CLASSES}
+            self.poisoned_results = 0
+            self.enabled = True
+            self.failure_threshold = 3
+            self.open_interval_s = 30.0
+
+
+_health = DeviceHealthService()
+
+
+def device_health() -> DeviceHealthService:
+    return _health

@@ -289,15 +289,35 @@ class Segment:
 
     def device(self) -> "DeviceSegment":
         if self._device is None:
-            if self._device_evicted:
+            was_evicted = self._device_evicted
+            try:
+                self._device = DeviceSegment(self)
+            except Exception as exc:
+                from opensearch_tpu.common.device_health import (
+                    device_health, is_device_error)
+                if not is_device_error(exc):
+                    raise
+                # staging failed (device OOM et al.): the segment is
+                # treated as budget-evicted — scored term-bags take the
+                # byte-identical host impact-table fallback instead of
+                # failing the search; plans that truly need the device
+                # degrade via their own dispatch-site handlers
+                self._device = None
+                self._device_evicted = True
+                from opensearch_tpu.common.telemetry import metrics
+                metrics().counter("device.restage_failures").inc()
+                device_health().record_failure("staging", exc)
+                raise
+            if was_evicted:
                 # demand paging's fault path: a budget-evicted segment
-                # is being staged again (a plan without a host fallback
-                # needs the device arrays back)
+                # was staged again (a plan without a host fallback
+                # needed the device arrays back)
                 from opensearch_tpu.common.device_ledger import \
                     device_ledger
                 device_ledger().record_restage()
                 self._device_evicted = False
-            self._device = DeviceSegment(self)
+            from opensearch_tpu.common.device_health import device_health
+            device_health().record_success("staging")
         return self._device
 
 

@@ -713,14 +713,54 @@ class IndexService:
         # are decisions inside QueryEngine.execute, not separately-wired
         # code paths here (search/engine.py; tools/check_execution_paths
         # keeps new paths from bypassing it)
+        from opensearch_tpu.common.device_health import \
+            DeviceDegradedError
         from opensearch_tpu.search.engine import query_engine
-        resp = query_engine().execute(self.searcher(), body,
-                                      agg_partials=agg_partials,
-                                      service=self)
+        try:
+            resp = query_engine().execute(self.searcher(), body,
+                                          agg_partials=agg_partials,
+                                          service=self)
+        except DeviceDegradedError as exc:
+            # an accelerator fault with no byte-identical host fallback
+            # degrades to PR-2-style partial results (the same shape a
+            # dead shard copy produces) instead of a 500 — unless the
+            # client asked for all-or-nothing semantics
+            if body.get("allow_partial_search_results") is False:
+                raise
+            return self._device_degraded_response(body, exc)
         resp["_shards"] = {"total": self.num_shards,
                            "successful": self.num_shards,
                            "skipped": 0, "failed": 0}
         return resp
+
+    def _device_degraded_response(self, body: dict,
+                                  exc: BaseException) -> dict:
+        """Partial-results response for a device-degraded search: every
+        local shard reports the device failure in ``_shards.failures[]``
+        (ShardSearchFailure shape), hits are empty, and the insight
+        record carries outcome ``device_degraded`` so the workload
+        attribution shows WHO was degraded."""
+        from opensearch_tpu.common.telemetry import metrics
+        from opensearch_tpu.search import insights
+        from opensearch_tpu.search.executor import (shard_failure_entry,
+                                                    shards_section)
+        metrics().counter("device.degraded_searches").inc()
+        with self._lock:
+            shard_ids = sorted(self.local_shards) or [0]
+        failures = [shard_failure_entry(self.name, s, None, exc)
+                    for s in shard_ids]
+        insights.emit(
+            signature=insights.canonical_query(body.get("query")),
+            scored=insights.scored_for_body(body),
+            took_ms=0.0, execution_path="device",
+            plan_cache="miss", outcome="device_degraded")
+        return {
+            "took": 0,
+            "timed_out": False,
+            "_shards": shards_section(len(shard_ids), failures=failures),
+            "hits": {"total": {"value": 0, "relation": "gte"},
+                     "max_score": None, "hits": []},
+        }
 
     def should_cache_request(self, body: dict, explicit,
                              agg_partials: bool = False) -> bool:
@@ -834,8 +874,29 @@ class IndexService:
 
         return len(jax.devices()) >= len(self.local_shards)
 
-    def _mesh_search(self, body: dict) -> dict:
+    def _mesh_degrade(self, body: dict, reason: str) -> dict:
+        """Demote a mesh request to the counted host scatter fallback:
+        an unavailable shard_map, a mesh that cannot be built (member
+        loss / too few devices), an open ``mesh`` circuit breaker, or a
+        device error mid-collective all land here — the request
+        degrades (same per-shard scoring stats, coordinator-order
+        merge), never 500s."""
+        from opensearch_tpu.common.telemetry import metrics
         from opensearch_tpu.search import insights
+        metrics().counter("search.mesh.fallback").inc()
+        with insights.suppressed():
+            resp = self._host_scatter_search(body)
+        insights.emit(
+            signature=insights.canonical_query(body.get("query")),
+            scored=True, took_ms=float(resp.get("took", 0)),
+            execution_path="mesh_fallback", plan_cache="miss")
+        return resp
+
+    def _mesh_search(self, body: dict) -> dict:
+        from opensearch_tpu.common.device_health import (device_health,
+                                                         is_device_error)
+        from opensearch_tpu.search import insights
+        health = device_health()
         try:
             from opensearch_tpu.parallel import dist_search
             if not dist_search.MESH_AVAILABLE:
@@ -844,33 +905,55 @@ class IndexService:
         except ImportError:
             # graceful degradation: a jax without any shard_map spelling
             # (see parallel/dist_search.py) must not 500 the request —
-            # the host scatter below preserves mesh semantics (per-shard
-            # scoring stats, coordinator-order merge) minus the ICI
+            # the host scatter preserves mesh semantics minus the ICI
             # collective, and the fallback is a counted, alertable event
-            from opensearch_tpu.common.telemetry import metrics
-            metrics().counter("search.mesh.fallback").inc()
-            with insights.suppressed():
-                resp = self._host_scatter_search(body)
-            insights.emit(
-                signature=insights.canonical_query(body.get("query")),
-                scored=True, took_ms=float(resp.get("took", 0)),
-                execution_path="mesh_fallback", plan_cache="miss")
-            return resp
+            return self._mesh_degrade(body, "shard_map unavailable")
+        if not health.allow("mesh"):
+            # open mesh breaker: don't re-attempt a failing collective
+            # per request — demote until a half-open probe re-closes it
+            return self._mesh_degrade(body, "mesh circuit breaker open")
 
-        with self._lock:
-            shards = [self.local_shards[s].acquire_searcher()
-                      for s in sorted(self.local_shards)]
-            if (self._mesh_searcher is None
-                    or len(self._mesh_searcher.shards) != len(shards)):
-                self._mesh_searcher = MeshSearcher(shards)
-            else:
-                # keep the per-device staging + compiled merge caches
-                # across refreshes; only the searcher snapshots change
-                self._mesh_searcher.update_shards(shards)
-            ms = self._mesh_searcher
+        try:
+            with self._lock:
+                shards = [self.local_shards[s].acquire_searcher()
+                          for s in sorted(self.local_shards)]
+                if (self._mesh_searcher is None
+                        or len(self._mesh_searcher.shards)
+                        != len(shards)):
+                    self._mesh_searcher = MeshSearcher(shards)
+                else:
+                    # keep the per-device staging + compiled merge
+                    # caches across refreshes; only the searcher
+                    # snapshots change
+                    self._mesh_searcher.update_shards(shards)
+                ms = self._mesh_searcher
+        except Exception as exc:
+            # a mesh that cannot be BUILT (fewer live devices than
+            # shards = member loss) is a mesh fault, not a query fault
+            with self._lock:
+                self._mesh_searcher = None
+            health.record_failure("mesh", exc)   # counted: device.errors
+            return self._mesh_degrade(
+                body, f"mesh construction failed: {exc}")
+
+        def collective(fn):
+            """Run one mesh collective; device errors demote to the
+            host scatter fallback (counted) instead of raising."""
+            try:
+                out = fn()
+            except Exception as exc:
+                if not is_device_error(exc):
+                    raise
+                health.record_failure("mesh", exc)  # counted: device.errors
+                return None
+            health.record_success("mesh")
+            return out
+
         aggs_json = body.get("aggs") or body.get("aggregations")
         if not aggs_json and not body.get("suggest"):
-            resp = ms.search(body)
+            resp = collective(lambda: ms.search(body))
+            if resp is None:
+                return self._mesh_degrade(body, "mesh collective failed")
             insights.emit(
                 signature=insights.canonical_query(body.get("query")),
                 scored=True, took_ms=float(resp.get("took", 0)),
@@ -882,7 +965,10 @@ class IndexService:
                 and ms.supports_mesh_aggs(aggs_json)):
             # the metric-agg family reduces ON the mesh (one ICI
             # collective), never serializing per-shard partials
-            resp = ms.mesh_metric_aggs(body, aggs_json)
+            resp = collective(lambda: ms.mesh_metric_aggs(body,
+                                                          aggs_json))
+            if resp is None:
+                return self._mesh_degrade(body, "mesh collective failed")
             insights.emit(
                 signature=insights.canonical_query(body.get("query")),
                 scored=False, took_ms=float(resp.get("took", 0)),
@@ -918,9 +1004,11 @@ class IndexService:
                     "hits": {"total": {"value": total, "relation": "eq"},
                              "max_score": None, "hits": []}}
         else:
-            resp = ms.search({k: v for k, v in body.items()
-                              if k not in ("aggs", "aggregations",
-                                           "suggest")})
+            resp = collective(lambda: ms.search(
+                {k: v for k, v in body.items()
+                 if k not in ("aggs", "aggregations", "suggest")}))
+            if resp is None:
+                return self._mesh_degrade(body, "mesh collective failed")
         if aggs_json:
             resp["aggregations"] = reduce_aggs(aggs_json, partials)
         if body.get("suggest"):

@@ -77,7 +77,8 @@ class Node:
         from opensearch_tpu.search.qos import QosController
         self.qos = QosController(
             admission=self.search_backpressure.admission,
-            insights=self.insights)
+            insights=self.insights,
+            backpressure=self.search_backpressure)
         self._init_cluster_settings()
         from opensearch_tpu.common.persistent_tasks import \
             PersistentTasksService
@@ -212,6 +213,17 @@ class Node:
         # host↔device paging seed, common/device_ledger.py)
         device_budget = Setting.byte_size_setting(
             "device.memory.budget_bytes", 0, dynamic=True)
+        # accelerator fault tolerance (common/device_health.py): the
+        # per-kernel-class circuit breakers' trip threshold and the
+        # open-state cooldown before a half-open probe is allowed
+        dh_enabled = Setting.bool_setting(
+            "device.health.enabled", True, dynamic=True)
+        dh_threshold = Setting.int_setting(
+            "device.health.failure_threshold", 3, min_value=1,
+            dynamic=True)
+        dh_interval = Setting.float_setting(
+            "device.health.open_interval_s", 30.0, min_value=0.0,
+            dynamic=True)
         from opensearch_tpu.indices.request_cache import (
             DEFAULT_MAX_BYTES, request_cache)
         req_cache_size = Setting.byte_size_setting(
@@ -226,7 +238,8 @@ class Node:
              search_max_lag,
              max_keep_alive, default_keep_alive, allow_partial,
              req_cache_size, ins_enabled, ins_top_n, ins_window,
-             ins_coalesce, device_budget, batcher_enabled,
+             ins_coalesce, device_budget, dh_enabled, dh_threshold,
+             dh_interval, batcher_enabled,
              batcher_window, batcher_max, qos_shares,
              qos_default_share, qos_adaptive, qos_interval])
         # per-tenant QoS knobs reach the live admission gate and the
@@ -265,6 +278,17 @@ class Node:
             lambda v: device_ledger().set_budget(int(v or 0)))
         device_ledger().set_budget(
             int(self.cluster_settings.get(device_budget) or 0))
+        # device-health breaker knobs reach the process-global service
+        # immediately (and persisted values replay at boot)
+        from opensearch_tpu.common.device_health import device_health
+        dh = device_health()
+        for setting, consumer in (
+                (dh_enabled, dh.set_enabled),
+                (dh_threshold, dh.set_failure_threshold),
+                (dh_interval, dh.set_open_interval_s)):
+            self.cluster_settings.add_settings_update_consumer(
+                setting, consumer)
+            consumer(self.cluster_settings.get(setting))
         # query-insights knobs reach the live service immediately and
         # persisted values replay at boot
         ins = self.insights

@@ -76,9 +76,13 @@ def _device_stats() -> dict:
     index, host↔device transfer counters split stage vs fetch-back,
     budget/eviction/restage accounting, and the per-kernel XLA compile
     registry, next to the jax backend's own ``memory_stats()`` where
-    the platform provides it."""
+    the platform provides it — plus the ``health`` block: the
+    per-kernel-class circuit breakers' states, trip/close counters and
+    the result-sanity guard's poisoned-result count
+    (common/device_health.py)."""
+    from opensearch_tpu.common.device_health import device_health
     from opensearch_tpu.common.device_ledger import device_ledger
-    return device_ledger().stats()
+    return {**device_ledger().stats(), "health": device_health().stats()}
 
 
 def _query_engine_stats() -> dict:
@@ -387,10 +391,15 @@ class RestController:
                 outcome = "timeout"
             elif shards.get("failed"):
                 failures = shards.get("failures") or []
-                outcome = ("shed" if any(
-                    (f.get("reason") or {}).get("type")
-                    == "node_duress_exception" for f in failures)
-                    else "partial")
+                types = {(f.get("reason") or {}).get("type")
+                         for f in failures}
+                # duress sheds and device degradation get their own
+                # outcome classes (workload attribution must show WHO
+                # the breaker/shed degraded, not a generic "partial")
+                outcome = ("shed" if "node_duress_exception" in types
+                           else "device_degraded"
+                           if "device_degraded_exception" in types
+                           else "partial")
         n = len(sink) or 1
         for rec in sink:
             service.record(rec, opaque_id=opaque_id,
@@ -662,22 +671,30 @@ class RestController:
         }
 
     def h_cluster_stats(self, req):
+        from opensearch_tpu.common.device_health import device_health
         from opensearch_tpu.common.device_ledger import device_ledger
         indices = self.node.indices.indices
         dev = device_ledger().stats()
+        health = device_health().stats()
         return 200, {
             "cluster_name": self.node.cluster_name,
             "indices": {"count": len(indices),
                         "docs": {"count": sum(s.doc_count()
                                               for s in indices.values())}},
             "nodes": {"count": {"total": 1, "data": 1}},
-            # compact device-residency rollup (full detail per node in
-            # _nodes/stats `device`)
+            # compact device-residency + fault-tolerance rollup (full
+            # detail per node in _nodes/stats `device`)
             "device": {
                 "resident_bytes": dev["resident_bytes"],
                 "resident_segments": dev["resident_segments"],
                 "budget_bytes": dev["budget"]["budget_bytes"],
                 "evictions": dev["budget"]["evictions"],
+                "breaker_trips": sum(
+                    b["trips"] for b in health["breakers"].values()),
+                "breakers_open": sum(
+                    1 for b in health["breakers"].values()
+                    if b["state"] != "closed"),
+                "poisoned_results": health["poisoned_results"],
             },
         }
 
@@ -843,6 +860,10 @@ class RestController:
         # device residency gauges (transfer/eviction counters already
         # flow through the MetricsRegistry exposition above)
         text += device_ledger().prometheus_text()
+        # device breaker-state gauges (trip/close/poison counters flow
+        # through the MetricsRegistry exposition above)
+        from opensearch_tpu.common.device_health import device_health
+        text += device_health().prometheus_text()
         return 200, PlainText(
             text,
             content_type="text/plain; version=0.0.4; charset=utf-8")

@@ -361,13 +361,36 @@ class BatchGroup:
         per query per segment — the tunnel's RTT makes tiny per-query
         transfers the next bottleneck).  ``prof`` is the shared GROUP
         profiler (see ShardSearcher.msearch)."""
+        from opensearch_tpu.common.device_health import (device_health,
+                                                         is_device_error)
+
+        health = device_health()
+        if bm25_ops.host_scoring_enabled():
+            return self._run_host(searcher, prof=prof)
+        if not (health.allow("batch") and health.allow("staging")):
+            # open device breaker: the whole group scores on the host
+            # impact tables — byte-identical (the PR-5 invariant)
+            _device_ledger().record_host_fallback()
+            return self._run_host(searcher, prof=prof)
+        try:
+            return self._run_device(searcher, health, prof=prof)
+        except Exception as exc:
+            if not is_device_error(exc):
+                raise
+            # counted: record_failure -> device.errors; the byte-
+            # identical host path serves the group instead of failing
+            # the whole msearch/continuous batch
+            health.record_failure("batch", exc)
+            _device_ledger().record_host_fallback()
+            return self._run_host(searcher, prof=prof)
+
+    def _run_device(self, searcher, health, prof=None) -> dict:
         import time
 
         from opensearch_tpu.common.cache import attached_cache
+        from opensearch_tpu.common.device_health import check_finite
         from opensearch_tpu.common.tasks import check_current
 
-        if bm25_ops.host_scoring_enabled():
-            return self._run_host(searcher, prof=prof)
         if prof is not None:
             prof.set("execution_path", "device_batched")
             t_prep = time.monotonic()
@@ -427,6 +450,23 @@ class BatchGroup:
                 sum(v.nbytes + i.nbytes + t.nbytes + m.nbytes
                     for _so, v, i, t, m in synced),
                 time.monotonic() - t_sync)
+        # result-sanity guard at the batch sync region: non-finite
+        # scores mean the device returned poison — discard the whole
+        # group's device results and recompute on the byte-identical
+        # host path (files a flight-recorder capture + feeds the
+        # batch breaker via record_poison)
+        from opensearch_tpu.common.device_health import check_finite
+        for so, v, _i, _t, _m in synced:
+            bad = check_finite(v)
+            if bad:
+                seg = searcher.segments[so]
+                health.record_poison(
+                    kernel="batch_impact_union_topk",
+                    segment=seg.seg_id, index=searcher.index_name,
+                    shard=searcher.shard_id, bad=bad)
+                _device_ledger().record_host_fallback()
+                return self._run_host(searcher, prof=prof)
+        health.record_success("batch")
         out = {}
         for qi, pos in enumerate(self.positions):
             rows_v, rows_s, rows_l = [], [], []

@@ -239,6 +239,11 @@ def _ledger():
     return device_ledger()
 
 
+def _health():
+    from opensearch_tpu.common.device_health import device_health
+    return device_health()
+
+
 class ShardSearcher:
     """Immutable point-in-time view over a shard's segments (the
     Engine.Searcher / reader-context analog, ref search/SearchService.java:986)."""
@@ -804,8 +809,19 @@ class ShardSearcher:
         self.segments and must see every segment).  An expired
         ``deadline`` stops the scan at the next segment boundary — the
         same granularity as cancellation."""
+        from opensearch_tpu.common.device_health import (
+            DeviceDegradedError, is_device_error)
         from opensearch_tpu.common.tasks import check_current
 
+        health = _health()
+        if not (health.allow("dispatch") and health.allow("staging")):
+            # full-scores plans have no host fallback: while the device
+            # breaker is open they degrade into PR-2-style partial
+            # _shards.failures[] at the caller instead of dispatching
+            # onto a failing accelerator (or returning a 500)
+            raise DeviceDegradedError(
+                "device circuit breaker open: full-scores plan "
+                f"[{type(plan).__name__}] has no host fallback")
         ms = _min_score_scalar(min_score)
         for seg in self.segments:
             check_current()        # cancellation point per segment program
@@ -828,12 +844,23 @@ class ShardSearcher:
                     "segment.dispatch",
                     {"segment": seg.seg_id, "index": self.index_name,
                      "shard": self.shard_id}):
-                dseg = seg.device()
-                A = build_arrays(dseg, needed, self.mapper,
-                                 live=self.ctx.live_jnp(seg, dseg))
-                dims, ins = self._prepared(plan, bind, seg, dseg, ckey,
-                                           prof=prof)
-                scores, matched = P.run_full(plan, dims, A, ins, ms)
+                try:
+                    dseg = seg.device()
+                    A = build_arrays(dseg, needed, self.mapper,
+                                     live=self.ctx.live_jnp(seg, dseg))
+                    dims, ins = self._prepared(plan, bind, seg, dseg,
+                                               ckey, prof=prof)
+                    scores, matched = P.run_full(plan, dims, A, ins, ms)
+                except Exception as exc:
+                    if not is_device_error(exc):
+                        raise
+                    # counted via record_failure -> device.errors (and
+                    # device.restage_failures at the staging site)
+                    health.record_failure("dispatch", exc)
+                    raise DeviceDegradedError(
+                        f"device failure on segment [{seg.seg_id}]: "
+                        f"{type(exc).__name__}: {exc}") from exc
+            health.record_success("dispatch")
             _ledger().record_dispatch(
                 getattr(dseg, "_ledger_group", None))
             if iattrs is not None:
@@ -874,7 +901,11 @@ class ShardSearcher:
         — the k-th score is harvested opportunistically from programs
         that already finished, never blocking the async dispatch
         pipeline."""
+        from opensearch_tpu.common.device_health import (
+            DeviceDegradedError, is_device_error)
         from opensearch_tpu.common.tasks import check_current
+
+        health = _health()
 
         if k_want == 0:            # size=0: counts only (aggs-style request)
             inner = ("can_match", "dispatch", "prepare")
@@ -973,14 +1004,18 @@ class ShardSearcher:
                     "segment.dispatch",
                     {"segment": seg.seg_id, "index": self.index_name,
                      "shard": self.shard_id}):
-                # budget-evicted segments degrade to the SAME host
-                # impact-table scoring the CPU fast path uses — byte-
-                # identical to the device kernel (the PR-5 invariant),
-                # so eviction never changes results, only where they
-                # are computed (device_ledger host↔device paging seed)
+                # budget-evicted segments — and segments behind an OPEN
+                # device circuit breaker (common/device_health.py) —
+                # degrade to the SAME host impact-table scoring the CPU
+                # fast path uses: byte-identical to the device kernel
+                # (the PR-5 invariant), so eviction/breaker-open never
+                # changes results, only where they are computed
+                device_ok = (health.allow("dispatch")
+                             and health.allow("staging"))
                 use_host = host_fast or (
                     host_capable
-                    and getattr(seg, "_device_evicted", False))
+                    and (getattr(seg, "_device_evicted", False)
+                         or not device_ok))
                 if use_host:
                     if not host_fast:
                         _ledger().record_host_fallback()
@@ -988,17 +1023,43 @@ class ShardSearcher:
                         bind, seg, self.ctx.lives[id(seg)],
                         min(k_want, seg.n_docs), min_score)
                     launched.append([si, vals, idx, tot, mx, vals])
+                elif not device_ok:
+                    raise DeviceDegradedError(
+                        "device circuit breaker open: plan "
+                        f"[{type(plan).__name__}] has no host fallback")
                 else:
-                    dseg = seg.device()
-                    A = build_arrays(dseg, needed, self.mapper,
-                                     live=self.ctx.live_jnp(seg, dseg))
-                    dims, ins = self._prepared(plan, bind, seg, dseg,
-                                               ckey, prof=prof)
-                    k = min(k_want, dseg.n_pad)
-                    launched.append([si, *P.run_topk(plan, dims, k, A,
-                                                     ins, ms), None])
-                    _ledger().record_dispatch(
-                        getattr(dseg, "_ledger_group", None))
+                    try:
+                        dseg = seg.device()
+                        A = build_arrays(dseg, needed, self.mapper,
+                                         live=self.ctx.live_jnp(seg,
+                                                                dseg))
+                        dims, ins = self._prepared(plan, bind, seg,
+                                                   dseg, ckey, prof=prof)
+                        k = min(k_want, dseg.n_pad)
+                        launched.append([si, *P.run_topk(plan, dims, k,
+                                                         A, ins, ms),
+                                         None])
+                        _ledger().record_dispatch(
+                            getattr(dseg, "_ledger_group", None))
+                    except Exception as exc:
+                        if not is_device_error(exc):
+                            raise
+                        # counted: record_failure -> device.errors (the
+                        # staging site also counts restage_failures)
+                        health.record_failure("dispatch", exc)
+                        if not host_capable:
+                            raise DeviceDegradedError(
+                                "device failure on segment "
+                                f"[{seg.seg_id}]: "
+                                f"{type(exc).__name__}: {exc}") from exc
+                        # degrade THIS segment to the byte-identical
+                        # host impact-table path; the breaker decides
+                        # whether later segments even try the device
+                        _ledger().record_host_fallback()
+                        vals, idx, tot, mx = plan.host_topk(  # engine-ok: host degrade backend
+                            bind, seg, self.ctx.lives[id(seg)],
+                            min(k_want, seg.n_docs), min_score)
+                        launched.append([si, vals, idx, tot, mx, vals])
             if iattrs is not None:
                 iattrs["scanned"] += 1
             if prof is not None:
@@ -1008,7 +1069,12 @@ class ShardSearcher:
             if allow_kth_prune and len(launched) >= 1 \
                     and si + 1 < len(self.segments):
                 kth = self._harvest_kth(launched, k_want, kth)
-        # phase 2: ONE host-sync region over all segments' results
+        # phase 2: ONE host-sync region over all segments' results —
+        # also the result-sanity guard: non-finite device scores are
+        # poison (a misbehaving accelerator, not a query property);
+        # they are discarded, recomputed on the host byte-identically,
+        # and filed as flight-recorder evidence
+        from opensearch_tpu.common.device_health import check_finite
         t_sync = time.monotonic()
         t_red = t_sync if prof is not None else 0.0
         per_seg = []
@@ -1017,9 +1083,41 @@ class ShardSearcher:
         fetched_bytes = 0
         for si, vals, idx, tot, mx, synced in launched:
             if synced is None:                 # device result: D2H fetch
-                vals = np.asarray(vals)
-                idx = np.asarray(idx)
-                fetched_bytes += vals.nbytes + idx.nbytes + 16
+                seg = self.segments[si]
+                try:
+                    vals = np.asarray(vals)
+                    idx = np.asarray(idx)
+                    bad = check_finite(vals)
+                except Exception as exc:       # fault surfaced at sync
+                    if not is_device_error(exc):
+                        raise
+                    health.record_failure("dispatch", exc)
+                    if not host_capable:
+                        raise DeviceDegradedError(
+                            "device failure syncing segment "
+                            f"[{seg.seg_id}]: "
+                            f"{type(exc).__name__}: {exc}") from exc
+                    bad = -1                   # recompute below
+                if bad:
+                    if bad > 0:
+                        health.record_poison(
+                            kernel="run_topk", segment=seg.seg_id,
+                            index=self.index_name, shard=self.shard_id,
+                            bad=bad)
+                        if not host_capable:
+                            raise DeviceDegradedError(
+                                "non-finite device scores on segment "
+                                f"[{seg.seg_id}] and the plan has no "
+                                "host fallback")
+                    _ledger().record_host_fallback()
+                    vals, idx, tot, mx = plan.host_topk(  # engine-ok: poison-recompute backend
+                        bind, seg, self.ctx.lives[id(seg)],
+                        min(k_want, seg.n_docs), min_score)
+                    vals = np.asarray(vals)
+                    idx = np.asarray(idx)
+                else:
+                    health.record_success("dispatch")
+                    fetched_bytes += vals.nbytes + idx.nbytes + 16
             else:
                 vals = synced
                 idx = np.asarray(idx)

@@ -112,11 +112,15 @@ class QosController:
     ``maybe_tick()`` on the search dispatch path (the same pacing idiom
     as ``SearchBackpressureService``)."""
 
-    def __init__(self, *, admission, insights,
+    def __init__(self, *, admission, insights, backpressure=None,
                  clock=time.monotonic, interval_s: float = 1.0,
                  audit_capacity: int = 64):
         self.admission = admission
         self.insights = insights
+        #: SearchBackpressureService whose node_duress thresholds the
+        #: controller may adapt under DEVICE duress (breaker trips /
+        #: poisoned results) — the ROADMAP-7 leftover
+        self.backpressure = backpressure
         self._clock = clock
         self.enabled = False
         self.interval_s = float(interval_s)
@@ -136,6 +140,14 @@ class QosController:
         self.coalescable_gate = 0.25
         self.penalty_floor = 0.25
         self.penalty_step = 0.25             # additive recovery
+        # device-duress adaptation of search_backpressure.node_duress:
+        # under breaker trips / poisoned results the cpu+heap duress
+        # thresholds tighten multiplicatively (duress detection fires
+        # earlier while the accelerator misbehaves), recovering
+        # additively toward their configured base once clean
+        self.duress_threshold_floor = 0.3
+        self.duress_threshold_step = 0.05    # additive recovery
+        self._duress_base: Optional[dict] = None
         #: a tenant is "noisy" when its share of the window's admission
         #: attempts exceeds this multiple of its weighted fair share
         self.noisy_multiple = 2.0
@@ -182,6 +194,12 @@ class QosController:
             # breach evidence (a self-sustaining hot loop otherwise)
             "own_captures": int(metrics().counter(
                 "qos.adaptations").value),
+            # accelerator duress evidence (common/device_health.py):
+            # kernel-class breaker trips + sanity-guard discards
+            "device_trips": int(metrics().counter(
+                "device.breaker.trips").value),
+            "device_poisoned": int(metrics().counter(
+                "device.poisoned_results").value),
         }
 
     # -- pacing ------------------------------------------------------------
@@ -216,11 +234,21 @@ class QosController:
         d_arr = max(0, cur["arrivals"] - prev["arrivals"])
         d_breach = max(0, (cur["captures"] - prev["captures"])
                        - (cur["own_captures"] - prev["own_captures"]))
+        # device duress: breaker trips and poisoned-result discards
+        # since the previous evaluation are first-class hot evidence —
+        # a misbehaving accelerator overloads the node (host fallbacks
+        # burn CPU) before the admission ledger notices
+        d_trips = max(0, cur["device_trips"] - prev["device_trips"])
+        d_poison = max(0, (cur["device_poisoned"]
+                           - prev["device_poisoned"]))
         attempts = d_arr + d_rej
         reject_rate = d_rej / attempts if attempts else 0.0
-        hot = attempts > 0 and (reject_rate >= self.high_watermark
-                                or d_breach > 0)
-        healthy = d_breach == 0 and reject_rate <= self.low_watermark
+        device_hot = (d_trips + d_poison) > 0
+        hot = device_hot or (attempts > 0
+                             and (reject_rate >= self.high_watermark
+                                  or d_breach > 0))
+        healthy = (d_breach == 0 and not device_hot
+                   and reject_rate <= self.low_watermark)
         with self._lock:
             self._hot = self._hot + 1 if hot else 0
             self._healthy = self._healthy + 1 if healthy else 0
@@ -237,6 +265,8 @@ class QosController:
             "breaches": d_breach,
             "occupancy": cur["occupancy"],
             "coalescable_fraction": cur["coalescable_fraction"],
+            "device_trips": d_trips,
+            "poisoned_results": d_poison,
         }
         adapted: list[dict] = []
         if act_hot:
@@ -289,6 +319,54 @@ class QosController:
                     dict(evidence, attempt_share=round(share, 4),
                          fair_share=round(fair, 4)),
                     tenant=label))
+        # 4) device duress tightens the node_duress thresholds
+        # themselves: while the accelerator trips breakers / returns
+        # poison, every search it degrades burns host CPU — lowering
+        # the cpu/heap duress thresholds makes the C3 selector derank
+        # and the coordinator shed THIS node's copies earlier (the
+        # audit record carries the trip/poison counts as evidence)
+        if (self.backpressure is not None
+                and (evidence.get("device_trips", 0)
+                     + evidence.get("poisoned_results", 0)) > 0):
+            adapted += self._tighten_duress_thresholds(evidence)
+        return adapted
+
+    def _duress_trackers(self) -> dict:
+        return {"cpu_threshold":
+                self.backpressure.trackers["cpu_usage"],
+                "heap_threshold":
+                self.backpressure.trackers["heap_usage"]}
+
+    def _tighten_duress_thresholds(self, evidence: dict) -> list[dict]:
+        adapted = []
+        trackers = self._duress_trackers()
+        if self._duress_base is None:
+            # the configured values are the recovery ceiling
+            self._duress_base = {k: float(t.threshold)
+                                 for k, t in trackers.items()}
+        for name, tracker in sorted(trackers.items()):
+            old = float(tracker.threshold)
+            new = max(self.duress_threshold_floor,
+                      round(old * self.md_factor, 4))
+            if new != old:
+                tracker.threshold = new
+                adapted.append(self._record(
+                    f"node_duress.{name}", old, new, evidence))
+        return adapted
+
+    def _relax_duress_thresholds(self, evidence: dict) -> list[dict]:
+        if self.backpressure is None or self._duress_base is None:
+            return []
+        adapted = []
+        for name, tracker in sorted(self._duress_trackers().items()):
+            base = self._duress_base.get(name)
+            old = float(tracker.threshold)
+            if base is None or old >= base:
+                continue
+            new = min(base, round(old + self.duress_threshold_step, 4))
+            tracker.threshold = new
+            adapted.append(self._record(
+                f"node_duress.{name}", old, new, evidence))
         return adapted
 
     def _noisy_tenant(self, cur: dict, prev: dict):
@@ -344,6 +422,7 @@ class QosController:
             self.admission.set_tenant_penalty(label, new_p)
             adapted.append(self._record("tenant_penalty", old_p, new_p,
                                         evidence, tenant=label))
+        adapted += self._relax_duress_thresholds(evidence)
         return adapted
 
     # -- audit ring --------------------------------------------------------
@@ -392,6 +471,11 @@ class QosController:
                 "batcher_auto_window_ms": engine_mod.AUTO_WINDOW_MS,
                 "tenant_penalties":
                     dict(self.admission.tenant_penalty),
+                **({"node_duress": {
+                    name: float(t.threshold)
+                    for name, t in sorted(
+                        self._duress_trackers().items())}}
+                   if self.backpressure is not None else {}),
             },
             "audit": self.audit(16),
         }

@@ -537,6 +537,317 @@ class DiskFaultInjector:
 
 
 # ---------------------------------------------------------------------------
+# Device fault injection (the accelerator's failure modes)
+# ---------------------------------------------------------------------------
+
+
+class InjectedDeviceError(RuntimeError):
+    """Base for injected accelerator faults; ``__device_fault__`` is
+    what ``common/device_health.py::is_device_error`` classifies on, so
+    the degradation paths treat these exactly like real jax/XLA
+    runtime errors."""
+
+    __device_fault__ = True
+
+
+class InjectedOOMError(InjectedDeviceError):
+    """Staging RESOURCE_EXHAUSTED (the device allocator's OOM shape)."""
+
+
+class InjectedCompileError(InjectedDeviceError):
+    """XLA compilation failure at dispatch time."""
+
+
+class InjectedDispatchError(InjectedDeviceError):
+    """A launched device program failing mid-execution."""
+
+
+class InjectedMeshLossError(InjectedDeviceError):
+    """A mesh member dropping out of the device collective."""
+
+
+class _DeviceRule:
+    """One installed device fault: matches (op, names...) by fnmatch
+    pattern against any of the site's name candidates (kernel name,
+    segment id, staging kind), ``times``-bounded or sticky, probability
+    drawn from the injector's seeded stream — the same Directive idioms
+    as the transport and disk rules above."""
+
+    def __init__(self, injector: "DeviceFaultInjector", op: str,
+                 pattern: str, probability: float, times: Optional[int],
+                 **params):
+        self.injector = injector
+        self.op = op               # stage | dispatch | mesh
+        self.pattern = pattern
+        self.probability = float(probability)
+        self.remaining = times     # None = sticky
+        self.params = params
+        self.fired = 0
+        self._lock = threading.Lock()
+
+    def matches(self, op: str, names: tuple) -> bool:
+        if op != self.op:
+            return False
+        if self.pattern not in ("*", None):
+            for name in names:
+                # exact first (fnmatch metachars can appear in segment
+                # ids), then glob
+                if name == self.pattern \
+                        or fnmatch.fnmatch(str(name), self.pattern):
+                    break
+            else:
+                return False
+        with self._lock:
+            if self.remaining is not None and self.remaining <= 0:
+                return False
+            if self.probability < 1.0 \
+                    and self.injector._random() >= self.probability:
+                return False
+            if self.remaining is not None:
+                self.remaining -= 1
+            self.fired += 1
+        return True
+
+
+class DeviceFaultInjector:
+    """Deterministic accelerator fault injection: while active, wraps
+    the sanctioned device entry points — the residency ledger's
+    ``stage``/``device_put`` (every H2D transfer flows through them,
+    enforced by tools/check_device_staging.py), the query-path kernels
+    ``plan.run_topk``/``plan.run_full``, the batched kernel
+    ``batch.batch_impact_union_topk``, and the mesh collective
+    ``MeshSearcher.search``/``mesh_metric_aggs`` — so matching calls
+    misbehave: staging RESOURCE_EXHAUSTED, XLA compile failure,
+    dispatch exceptions, slow-device latency, NaN-poisoned top-k
+    scores, mesh-member loss.  One-shot or sticky, matched by kernel /
+    segment / staging-kind pattern; every probabilistic choice comes
+    from one seeded stream, so a fixed seed replays the same faults.
+
+    Usage::
+
+        dev = DeviceFaultInjector(seed=7)
+        dev.oom("seg_*")                 # sticky staging OOM
+        dev.poison_topk(times=3)         # 3 NaN-poisoned results
+        dev.slow_device(0.05, times=2)
+        dev.lose_mesh_member()
+        with dev:                        # activate() / deactivate()
+            ...
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
+        self._rules: list[_DeviceRule] = []
+        self._rules_lock = threading.Lock()
+        self._active = False
+        self._saved: list[tuple] = []
+
+    def _random(self) -> float:
+        with self._rng_lock:
+            return self._rng.random()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def activate(self) -> "DeviceFaultInjector":
+        if self._active:
+            return self
+        self._active = True
+        from opensearch_tpu.common.device_ledger import device_ledger
+        from opensearch_tpu.parallel import dist_search
+        from opensearch_tpu.search import batch as batch_mod
+        from opensearch_tpu.search import plan as plan_mod
+
+        led = device_ledger()
+        inj = self
+
+        real_stage = led.stage
+
+        def stage(group, host_array, *, kind: str, field: str = "",
+                  name: str = ""):
+            seg = getattr(group, "segment", "-") if group is not None \
+                else "-"
+            inj._check("stage", (seg, kind, field))
+            return real_stage(group, host_array, kind=kind, field=field,
+                              name=name)
+
+        real_put = led.device_put
+
+        def device_put(group, value, sharding=None, *, kind: str = "mesh",
+                       field: str = "", name: str = ""):
+            seg = getattr(group, "segment", "-") if group is not None \
+                else "-"
+            inj._check("stage", (seg, kind, name))
+            return real_put(group, value, sharding, kind=kind,
+                            field=field, name=name)
+
+        self._saved.append((led, "stage", led.__dict__.get("stage")))
+        self._saved.append((led, "device_put",
+                            led.__dict__.get("device_put")))
+        led.stage = stage
+        led.device_put = device_put
+
+        def wrap_kernel(mod, attr):
+            real = getattr(mod, attr)
+
+            def kernel(*args, **kwargs):
+                inj._check("dispatch", (attr,))
+                out = real(*args, **kwargs)
+                return inj._maybe_poison(attr, out)
+            self._saved.append((mod, attr, real))
+            setattr(mod, attr, kernel)
+
+        wrap_kernel(plan_mod, "run_topk")
+        wrap_kernel(plan_mod, "run_full")
+        wrap_kernel(batch_mod, "batch_impact_union_topk")
+
+        def wrap_mesh(attr):
+            real = getattr(dist_search.MeshSearcher, attr)
+
+            def mesh_entry(ms_self, *args, **kwargs):
+                inj._check("mesh", (attr,))
+                return real(ms_self, *args, **kwargs)
+            self._saved.append((dist_search.MeshSearcher, attr, real))
+            setattr(dist_search.MeshSearcher, attr, mesh_entry)
+
+        wrap_mesh("search")
+        wrap_mesh("mesh_metric_aggs")
+        return self
+
+    def deactivate(self):
+        if not self._active:
+            return
+        for owner, attr, prev in reversed(self._saved):
+            if isinstance(owner, type) or hasattr(owner, "__name__"):
+                setattr(owner, attr, prev)
+            elif prev is None:
+                owner.__dict__.pop(attr, None)   # restore the bound method
+            else:
+                setattr(owner, attr, prev)
+        self._saved.clear()
+        self._active = False
+
+    __enter__ = activate
+
+    def __exit__(self, *exc):
+        self.deactivate()
+        return False
+
+    # -- the interception core ---------------------------------------------
+
+    def _match(self, op: str, names: tuple) -> Optional[_DeviceRule]:
+        with self._rules_lock:
+            rules = list(self._rules)
+        for rule in rules:
+            if rule.matches(op, names):
+                return rule
+        return None
+
+    def _check(self, op: str, names: tuple) -> None:
+        rule = self._match(op, names)
+        if rule is None:
+            return
+        if "seconds" in rule.params:
+            time.sleep(rule.params["seconds"])
+            return
+        err = rule.params.get("err")
+        if err is not None:
+            raise err(rule.params["message"].format(names=names))
+
+    def _maybe_poison(self, kernel: str, out):
+        """NaN-poison the score component of a top-k kernel result (the
+        first array of the tuple) — the silent-corruption failure shape
+        the result-sanity guard exists to catch."""
+        rule = self._match("poison", (kernel,))
+        if rule is None:
+            return out
+        import jax.numpy as jnp
+        vals = out[0]
+        return (jnp.full_like(vals, jnp.nan), *out[1:])
+
+    # -- rules -------------------------------------------------------------
+
+    def _install(self, rule: _DeviceRule) -> _DeviceRule:
+        with self._rules_lock:
+            self._rules.append(rule)
+        return rule
+
+    def oom(self, pattern: str = "*", times: Optional[int] = None,
+            probability: float = 1.0) -> _DeviceRule:
+        """RESOURCE_EXHAUSTED on matching H2D stagings (pattern matches
+        segment id, staging kind, or field)."""
+        return self._install(_DeviceRule(
+            self, "stage", pattern, probability, times,
+            err=InjectedOOMError,
+            message="RESOURCE_EXHAUSTED: out of memory while staging "
+                    "{names} (injected)"))
+
+    def compile_failure(self, pattern: str = "*",
+                        times: Optional[int] = None,
+                        probability: float = 1.0) -> _DeviceRule:
+        """XLA compile failure on matching kernel dispatches."""
+        return self._install(_DeviceRule(
+            self, "dispatch", pattern, probability, times,
+            err=InjectedCompileError,
+            message="INTERNAL: XLA compilation of {names} failed "
+                    "(injected)"))
+
+    def dispatch_error(self, pattern: str = "*",
+                       times: Optional[int] = None,
+                       probability: float = 1.0) -> _DeviceRule:
+        """A matching device program fails at launch."""
+        return self._install(_DeviceRule(
+            self, "dispatch", pattern, probability, times,
+            err=InjectedDispatchError,
+            message="INTERNAL: device program {names} failed "
+                    "(injected)"))
+
+    def slow_device(self, seconds: float, pattern: str = "*",
+                    times: Optional[int] = None,
+                    probability: float = 1.0) -> _DeviceRule:
+        """Matching dispatches stall ``seconds`` before launching (the
+        degrading-accelerator latency shape)."""
+        return self._install(_DeviceRule(
+            self, "dispatch", pattern, probability, times,
+            seconds=float(seconds)))
+
+    def poison_topk(self, pattern: str = "*",
+                    times: Optional[int] = None,
+                    probability: float = 1.0) -> _DeviceRule:
+        """Matching top-k kernels return NaN scores instead of real
+        ones — caught by the result-sanity guard at the D2H sync, which
+        discards and recomputes on the host."""
+        return self._install(_DeviceRule(
+            self, "poison", pattern, probability, times))
+
+    def lose_mesh_member(self, times: Optional[int] = None,
+                         probability: float = 1.0) -> _DeviceRule:
+        """The mesh collective loses a member mid-dispatch; the engine
+        must demote to the counted host scatter fallback."""
+        return self._install(_DeviceRule(
+            self, "mesh", "*", probability, times,
+            err=InjectedMeshLossError,
+            message="UNAVAILABLE: mesh member lost during {names} "
+                    "(injected)"))
+
+    def remove(self, rule: _DeviceRule) -> bool:
+        with self._rules_lock:
+            if rule in self._rules:
+                self._rules.remove(rule)
+                return True
+        return False
+
+    def clear(self):
+        with self._rules_lock:
+            self._rules.clear()
+
+    def stats(self) -> dict:
+        with self._rules_lock:
+            return {"rules": len(self._rules),
+                    "fired": sum(r.fired for r in self._rules)}
+
+
+# ---------------------------------------------------------------------------
 # Remote blob-store fault injection (the search tier's "S3 is down")
 # ---------------------------------------------------------------------------
 

@@ -94,6 +94,7 @@ class SoakConfig:
                  max_retries: int = 6,
                  faults_enabled: bool = True,
                  control_run: bool = True,
+                 device_faults: bool = False,
                  schedule: Optional[list] = None,
                  slos: Optional[dict] = None):
         self.seed = int(seed)
@@ -115,6 +116,12 @@ class SoakConfig:
         if self.search_replicas and not self.searcher_ids:
             raise ValueError(
                 "search_replicas > 0 requires searcher_ids")
+        # accelerator fault class: the pass forces device kernels on
+        # (bm25_ops.HOST_SCORING=False) and the schedule gains the
+        # device_oom / device_poison / device_slow / device_mesh_loss /
+        # device_heal directives (testing/fault_injection.py
+        # DeviceFaultInjector + common/device_health.py breakers)
+        self.device_faults = bool(device_faults)
         self.client = client
         self.concurrency = int(concurrency)
         self.search_rpc_timeout = float(search_rpc_timeout)
@@ -157,6 +164,23 @@ class SoakConfig:
         base = {"search_replicas": 2, "searcher_ids": ("s0", "s1")}
         base.update(overrides)
         return cls(**base)
+
+    @classmethod
+    def device(cls, **overrides) -> "SoakConfig":
+        """The accelerator-fault scenario: device kernels forced on,
+        the device fault directive class in the schedule, and the
+        device SLOs — zero unexpected 5xx, convergence vs the
+        uninjected control, >= 1 breaker trip visible, breakers
+        re-closed after heal (mesh exempt: on a 1-device CPU host the
+        mesh stays legitimately demoted), and >= 1 poisoned result
+        caught by the sanity guard."""
+        base = {"device_faults": True}
+        base.update(overrides)
+        cfg = cls(**base)
+        cfg.slos.setdefault("require_breaker_trip", True)
+        cfg.slos.setdefault("require_breaker_reclose", True)
+        cfg.slos.setdefault("require_poison_detected", True)
+        return cfg
 
 
 class MixedWorkload:
@@ -329,6 +353,30 @@ class FaultSchedule:
                  "node": victim},
                 {"step": s_at[3], "fault": "add_searcher",
                  "node": f"{victim}r"},
+            ]
+        if config.device_faults:
+            # accelerator fault class (the single fault domain the
+            # cluster directives above never touch): slow device, then
+            # NaN-poisoned top-k (sanity guard + dispatch breaker),
+            # heal, then sticky staging OOM over force-evicted
+            # segments (restage failures + host fallbacks), mesh
+            # member loss probes, final heal with breaker-re-close
+            # probes.  Seeded like the rest: paired windows stay
+            # ordered under the jitter.
+            d_at: list = []
+            for f in (0.10, 0.22, 0.34, 0.48, 0.62, 0.76):
+                base = max(1, int(n * f)) + rng.randint(0, jitter)
+                d_at.append(min(max(d_at[-1] if d_at else 1, base),
+                                n - 1))
+            out += [
+                {"step": d_at[0], "fault": "device_slow",
+                 "seconds": 0.02, "times": 3},
+                {"step": d_at[1], "fault": "device_poison", "times": 3},
+                {"step": d_at[2], "fault": "device_heal"},
+                {"step": d_at[3], "fault": "device_oom"},
+                {"step": d_at[4], "fault": "device_mesh_loss",
+                 "probes": 3},
+                {"step": d_at[5], "fault": "device_heal"},
             ]
         return out
 
@@ -568,6 +616,59 @@ class SoakRunner:
                        timeout=30.0,
                        what=f"remote refill of fresh searcher [{nid}]")
             _bump(ctx, "recoveries")
+        elif fault == "device_slow":
+            self._devfaults(ctx).slow_device(d.get("seconds", 0.02),
+                                             times=d.get("times"))
+        elif fault == "device_poison":
+            self._devfaults(ctx).poison_topk(times=d.get("times", 3))
+        elif fault == "device_oom":
+            from opensearch_tpu.common.device_ledger import device_ledger
+            # sticky staging RESOURCE_EXHAUSTED over force-evicted
+            # segments: every restage attempt fails, scored term-bags
+            # take the byte-identical host fallback, full-scores plans
+            # degrade to partial shard failures
+            self._devfaults(ctx).oom()
+            led = device_ledger()
+            led.set_budget(1)
+            led.set_budget(0)
+        elif fault == "device_mesh_loss":
+            from opensearch_tpu.common.telemetry import metrics as _m
+            inj = self._devfaults(ctx)
+            rule = inj.lose_mesh_member()
+            svc = nodes[ctx["client"]].indices.get(self.config.index)
+            before_fb = _m().counter("search.mesh.fallback").value
+            for _ in range(int(d.get("probes", 3))):
+                # drive the mesh entry directly: member loss (or a mesh
+                # that cannot build on this host) must demote to the
+                # counted host scatter fallback, never raise
+                resp = svc._mesh_search(
+                    {"query": {"match": {"body": "t0 t1"}}, "size": 5})
+                if resp.get("hits") is None:
+                    raise SoakHarnessError(
+                        "mesh probe returned a malformed response")
+            inj.remove(rule)
+            ctx["applied"][-1]["mesh_fallbacks"] = int(
+                _m().counter("search.mesh.fallback").value - before_fb)
+        elif fault == "device_heal":
+            from opensearch_tpu.common.device_health import device_health
+            inj = ctx.get("devfaults")
+            if inj is not None:
+                inj.clear()
+            # deterministic breaker-re-close probes: a sorted scan
+            # restages every evicted segment on the selected copies
+            # (staging + dispatch classes), then a scored term-bag runs
+            # the device kernel path again
+            client = nodes[ctx["client"]]
+            self._write_with_retry(ctx, lambda: client.search(
+                self.config.index,
+                {"query": {"match_all": {}}, "size": 1,
+                 "sort": [{"v": "asc"}]}))
+            self._write_with_retry(ctx, lambda: client.search(
+                self.config.index,
+                {"query": {"match": {"body": "t0"}}, "size": 1}))
+            ctx["applied"][-1]["breaker_states"] = \
+                device_health().breaker_states()
+            _bump(ctx, "recoveries")
         elif fault == "stall_remote_store":
             from opensearch_tpu.testing.fault_injection import \
                 RemoteStoreFaultInjector
@@ -583,6 +684,18 @@ class SoakRunner:
                 inj.release()
         else:
             raise ValueError(f"unknown fault directive [{fault}]")
+
+    def _devfaults(self, ctx: dict):
+        """Lazily activate the pass's DeviceFaultInjector (seeded from
+        the soak seed, so the whole fault schedule replays)."""
+        from opensearch_tpu.testing.fault_injection import \
+            DeviceFaultInjector
+        inj = ctx.get("devfaults")
+        if inj is None:
+            inj = DeviceFaultInjector(
+                seed=self.config.seed ^ 0xDE7).activate()
+            ctx["devfaults"] = inj
+        return inj
 
     def _corrupt_segment(self, ctx: dict, d: dict) -> None:
         """Disk-fault directive: flush one in-sync replica copy, flip a
@@ -806,6 +919,24 @@ class SoakRunner:
                       for k in ("search", "msearch", "bulk", "agg",
                                 "scroll")},
         }
+        host_scoring_saved = None
+        dh_saved = None
+        if cfg.device_faults:
+            # both passes run the DEVICE kernels (control included, so
+            # convergence compares like with like) on a freshly-reset
+            # health service with a snappy breaker: threshold 2, zero
+            # cooldown (open -> half-open probe on the next request —
+            # wall-clock-free, so verdicts stay deterministic)
+            from opensearch_tpu.common.device_health import device_health
+            from opensearch_tpu.ops import bm25 as bm25_ops
+            dh = device_health()
+            dh_saved = (dh.enabled, dh.failure_threshold,
+                        dh.open_interval_s)
+            dh.reset()
+            dh.set_failure_threshold(2)
+            dh.set_open_interval_s(0.0)
+            host_scoring_saved = bm25_ops.HOST_SCORING
+            bm25_ops.HOST_SCORING = False
         before = self._counter_snapshot()
         workload = MixedWorkload(cfg)
         schedule = ((cfg.schedule if cfg.schedule is not None
@@ -862,6 +993,10 @@ class SoakRunner:
             remote_stall = ctx.pop("remote_stall", None)
             if remote_stall is not None:
                 remote_stall.release()
+            devfaults = ctx.get("devfaults")
+            if devfaults is not None:
+                devfaults.clear()       # schedule should have healed;
+                #                         the drain lifts stragglers
             ctx["faults"].clear()
             disk = ctx.pop("disk", None)
             if disk is not None:
@@ -902,6 +1037,20 @@ class SoakRunner:
                 self._wait(tier_converged, timeout=30.0,
                            what="searcher-tier catch-up")
             final = self._final_state(ctx)
+            device_report = None
+            if cfg.device_faults:
+                # the breaker-state snapshot AFTER the drain + final
+                # convergence search: the re-close SLO reads it (mesh
+                # exempt — a 1-device CPU host can never rebuild the
+                # mesh, so its breaker legitimately stays open)
+                from opensearch_tpu.common.device_health import \
+                    device_health
+                dh = device_health()
+                device_report = {
+                    "breaker_states": dh.breaker_states(),
+                    "tripped": dh.tripped_kinds(),
+                    "poisoned_results": dh.stats()["poisoned_results"],
+                }
             # snapshot the client/coordinator node's query-insights
             # section while the cluster is still alive: an SLO breach
             # capture below ships WITH the workload evidence (which
@@ -920,6 +1069,19 @@ class SoakRunner:
             remote_stall = ctx.pop("remote_stall", None)
             if remote_stall is not None:   # exception path: unpatch reads
                 remote_stall.release()
+            devfaults = ctx.pop("devfaults", None)
+            if devfaults is not None:   # unpatch the device entry points
+                devfaults.deactivate()
+            if cfg.device_faults:
+                from opensearch_tpu.common.device_health import \
+                    device_health
+                from opensearch_tpu.ops import bm25 as bm25_ops
+                bm25_ops.HOST_SCORING = host_scoring_saved
+                dh = device_health()
+                dh.reset()
+                if dh_saved is not None:
+                    dh.enabled, dh.failure_threshold, \
+                        dh.open_interval_s = dh_saved
             for n in list(nodes.values()):
                 n.stop()
         after = self._counter_snapshot()
@@ -953,6 +1115,20 @@ class SoakRunner:
                 and k.endswith(".retries")),
             "final_state": final,
             "query_insights": query_insights,
+            # accelerator fault accounting (present only for device
+            # soaks): breaker trips/states, sanity-guard discards, and
+            # every degradation path's counters
+            **({"device": {
+                **device_report,
+                "breaker_trips": delta("device.breaker.trips"),
+                "breaker_closes": delta("device.breaker.closes"),
+                "device_errors": delta("device.errors"),
+                "poisoned": delta("device.poisoned_results"),
+                "restage_failures": delta("device.restage_failures"),
+                "host_fallbacks": delta("device.host_fallback"),
+                "mesh_fallbacks": delta("search.mesh.fallback"),
+                "degraded_searches": delta("device.degraded_searches"),
+            }} if device_report is not None else {}),
         }
 
     def _run_concurrent(self, ops, by_step, ctx) -> None:
@@ -1022,6 +1198,27 @@ class SoakRunner:
                 "slo": "convergence",
                 "limit": control["final_state"],
                 "observed": chaos["final_state"], "ok": ok})
+        dev = chaos.get("device") or {}
+        if slos.get("require_breaker_trip"):
+            trips = int(dev.get("breaker_trips", 0))
+            verdicts.append({"slo": "device_breaker_trip", "limit": 1,
+                             "observed": trips, "ok": trips >= 1})
+        if slos.get("require_breaker_reclose"):
+            # every breaker that tripped must be closed again after the
+            # heal — except the mesh, which on a 1-device CPU host can
+            # never rebuild and stays legitimately demoted
+            states = dev.get("breaker_states") or {}
+            stuck = sorted(k for k in dev.get("tripped", [])
+                           if k != "mesh"
+                           and states.get(k) != "closed")
+            verdicts.append({"slo": "device_breaker_reclose",
+                             "limit": [], "observed": stuck,
+                             "ok": not stuck})
+        if slos.get("require_poison_detected"):
+            poisoned = int(dev.get("poisoned", 0))
+            verdicts.append({"slo": "device_poison_detected",
+                             "limit": 1, "observed": poisoned,
+                             "ok": poisoned >= 1})
         return verdicts
 
     def _capture_breaches(self, verdicts: list, chaos: dict) -> None:
@@ -1100,6 +1297,13 @@ def run_soak(data_path: Optional[str] = None, *,
     cfg = (SoakConfig.full(**overrides) if full
            else SoakConfig.smoke(**overrides))
     return SoakRunner(data_path, cfg).run()
+
+
+def run_device_soak(data_path: Optional[str] = None,
+                    **overrides) -> dict:
+    """One-call entry point for the accelerator-fault soak (bench.py's
+    ``device_faults`` phase, tests/test_device_faults.py acceptance)."""
+    return SoakRunner(data_path, SoakConfig.device(**overrides)).run()
 
 
 # -- noisy-neighbor QoS scenario -------------------------------------------

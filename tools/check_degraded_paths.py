@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Lint: device faults are never silently swallowed.
+
+The accelerator fault-tolerance layer (common/device_health.py) only
+works if every handler that catches a jax/XLA/device error leaves
+EVIDENCE: a ``device.*`` metric increment, or a DeviceHealthService
+record call (``record_failure`` / ``record_poison`` — which increment
+``device.errors`` / ``device.poisoned_results`` internally), or one of
+the ledger's counted degradations (``record_host_fallback`` /
+``record_restage``).  An ``except`` that catches a device error and
+does none of those turns a misbehaving accelerator into silent garbage
+— exactly the failure mode the breakers, the soak SLOs, and the
+``_nodes/stats`` ``device.health`` surface exist to prevent.
+
+Scope: ``opensearch_tpu/{search,index,parallel,ops}/``.  A handler is
+IN SCOPE when its exception clause names a device-error type
+(``XlaRuntimeError``, ``InjectedDeviceError``, ``DeviceDegradedError``,
+``DevicePoisonError``, ``MemoryError``) OR its body consults the
+classifier ``is_device_error`` (the broad-catch-then-classify idiom the
+executor uses).  In-scope handlers must contain one of the evidence
+calls above, or carry a ``# degrade-ok`` annotation on the ``except``
+line or the line above (for handlers that re-raise into an already-
+counted path).
+
+Sibling of check_device_staging.py et al.; new un-annotated sites fail
+tier-1 (tests/test_device_faults.py runs this check).
+
+Usage: python tools/check_degraded_paths.py [root]   (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ANNOTATION = "# degrade-ok"
+
+# directories (relative to the package root) whose handlers are linted
+SCOPES = ("search", "index", "parallel", "ops")
+
+#: exception type names whose except-clauses are device-fault handlers
+DEVICE_ERROR_NAMES = frozenset({
+    "XlaRuntimeError", "InjectedDeviceError", "InjectedOOMError",
+    "InjectedCompileError", "InjectedDispatchError",
+    "InjectedMeshLossError", "DeviceDegradedError", "DevicePoisonError",
+    "MemoryError",
+})
+
+#: calls inside a handler that count as degradation evidence
+EVIDENCE_CALLS = frozenset({
+    "record_failure", "record_success", "record_poison",
+    "record_host_fallback", "record_restage", "is_device_error",
+})
+
+
+def _names_of(expr) -> set:
+    """Flatten an except clause's type expression into bare names."""
+    out: set = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.add(node.attr)
+    return out
+
+
+def _handler_evidence(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body (or its guard) carries evidence: a
+    DeviceHealthService/ledger record call, a ``device.*`` metric
+    increment, or the is_device_error classifier (whose False branch
+    re-raises the non-device error)."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if name in EVIDENCE_CALLS:
+                return True
+            if name == "counter" and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str) \
+                        and arg.value.startswith("device."):
+                    return True
+    return False
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as exc:
+        return [f"{path}:{exc.lineno}: syntax error: {exc.msg}"]
+    lines = src.splitlines()
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        in_scope = False
+        if node.type is not None \
+                and _names_of(node.type) & DEVICE_ERROR_NAMES:
+            in_scope = True
+        elif any(isinstance(c, ast.Name) and c.id == "is_device_error"
+                 or isinstance(c, ast.Attribute)
+                 and c.attr == "is_device_error"
+                 for b in node.body for c in ast.walk(b)):
+            in_scope = True
+        if not in_scope:
+            continue
+        lineno = node.lineno
+        this = lines[lineno - 1] if lineno - 1 < len(lines) else ""
+        prev = lines[lineno - 2] if lineno >= 2 else ""
+        if ANNOTATION in this or ANNOTATION in prev:
+            continue
+        if _handler_evidence(node):
+            continue
+        problems.append(
+            f"{path}:{lineno}: except handler catches device/XLA "
+            "errors without evidence — increment a 'device.*' metric "
+            "or call DeviceHealthService.record_failure/record_poison "
+            "(common/device_health.py) so the fault is counted and "
+            "the breakers see it, or annotate with "
+            f"'{ANNOTATION}' on this or the previous line")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    root = argv[1] if len(argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "opensearch_tpu")
+    problems = []
+    for scope in SCOPES:
+        scope_dir = os.path.join(root, scope)
+        if not os.path.isdir(scope_dir):
+            # linting a sample tree (the lint's own tests): scan root
+            scope_dir = root if scope == SCOPES[0] else None
+        if scope_dir is None:
+            continue
+        for dirpath, _dirs, files in os.walk(scope_dir):
+            if "__pycache__" in dirpath:
+                continue
+            for fname in sorted(files):
+                if not fname.endswith(".py"):
+                    continue
+                problems.extend(check_file(os.path.join(dirpath, fname)))
+    for p in sorted(set(problems)):
+        print(p)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
