@@ -118,6 +118,56 @@ def make_segment(raw):
     return seg
 
 
+def make_segments(raw, n_segments: int):
+    """Split the raw CSR corpus into ``n_segments`` doc-range segments
+    (realistic multi-segment shard geometry, vs the single monolith
+    ``make_segment`` builds).  With zipf traffic most tail terms live
+    in few segments, so block-max can-match pruning
+    (``search.segments_pruned``) finally has something to skip — the
+    monolith pinned that counter to 0 on every bench phase."""
+    from opensearch_tpu.index.segment import PostingsField, Segment
+
+    n_docs = raw["n_docs"]
+    n_segments = max(1, min(int(n_segments), n_docs))
+    offsets, df = raw["offsets"], raw["df"]
+    doc_ids, tfs, doc_lens = raw["doc_ids"], raw["tfs"], raw["doc_lens"]
+    # CSR rows are terms; tag every posting with its term id so a
+    # doc-range mask can rebuild per-segment CSR in one bincount pass
+    term_of = np.repeat(np.arange(VOCAB_SIZE, dtype=np.int32), df)
+    bounds = np.linspace(0, n_docs, n_segments + 1).astype(np.int64)
+    segs = []
+    for s in range(n_segments):
+        lo, hi = int(bounds[s]), int(bounds[s + 1])
+        n_local = hi - lo
+        mask = (doc_ids >= lo) & (doc_ids < hi)
+        seg_df = np.bincount(term_of[mask],
+                             minlength=VOCAB_SIZE).astype(np.int32)
+        seg_offsets = np.zeros(VOCAB_SIZE + 1, dtype=np.int32)
+        seg_offsets[1:] = np.cumsum(seg_df)
+        local_lens = doc_lens[lo:hi]
+        seg = Segment(f"bench_{s}", n_local)
+        seg.doc_ids = [str(i) for i in range(lo, hi)]
+        seg.id_to_local = {str(i): i - lo for i in range(lo, hi)}
+        seg.sources = [b"{}"] * n_local
+        # only terms that actually occur here get a dictionary entry:
+        # term_id() returning -1 for the rest is what lets can-match
+        # prune this segment (the CSR keeps full-vocab rows, so present
+        # term ids stay global)
+        seg.postings["body"] = PostingsField(
+            terms={f"t{int(t)}": int(t)
+                   for t in np.nonzero(seg_df)[0]}, df=seg_df,
+            offsets=seg_offsets,
+            doc_ids=(doc_ids[mask] - lo).astype(np.int32),
+            tfs=tfs[mask],
+            pos_offsets=np.zeros(int(mask.sum()) + 1, dtype=np.int32),
+            positions=np.zeros(0, dtype=np.int32),
+            doc_lens=local_lens, total_len=float(local_lens.sum()),
+            docs_with_field=n_local, has_norms=True,
+            present=np.ones(n_local, dtype=bool))
+        segs.append(seg)
+    return segs
+
+
 def gen_query_terms(n_queries: int, seed: int = 7):
     # the seeded zipf query log lives in the soak harness now (the soak
     # workload and this bench measure the SAME traffic shape); identical
@@ -234,6 +284,7 @@ def main():
 
         m = metrics()
         return {
+            "n_segments": n_segments,
             "plan_cache_hits": m.counter("search.plan_cache.hits").value,
             "plan_cache_misses":
                 m.counter("search.plan_cache.misses").value,
@@ -244,9 +295,10 @@ def main():
             "seq_programs": plan_mod.run_topk._cache_size(),
         }
 
-    seg = make_segment(raw)
+    n_segments = int(os.environ.get("OSTPU_BENCH_SEGMENTS", 8))
+    segs = make_segments(raw, n_segments)
     mapper = DocumentMapper({"properties": {"body": {"type": "text"}}})
-    searcher = ShardSearcher([seg], mapper, index_name="bench")
+    searcher = ShardSearcher(segs, mapper, index_name="bench")
     queries = [{"query": {"match": {"body": f"t{a} t{b}"}}, "size": 10}
                for a, b in pairs]
 
@@ -276,17 +328,23 @@ def main():
         **hot_path_counters()})
 
     # -- phase: sequential (latency path; ~4 budget-bucket compiles) ------
+    # half the queries send track_total_hits:false (head traffic rarely
+    # needs exact totals), which arms the running-kth block-max prune —
+    # over the multi-segment corpus that makes segments_pruned a live
+    # number on this line instead of a pinned 0
+    seq_n = min(n_queries, 100)
+    seq_queries = [dict(q, track_total_hits=False) if i % 2 else q
+                   for i, q in enumerate(queries[:seq_n])]
     t0 = time.monotonic()
-    for q in queries[:32]:
-        searcher.search(q)
+    for q in seq_queries[:32]:
+        searcher.search(dict(q))
     log(f"sequential warmup: {time.monotonic() - t0:.1f}s")
     lat = []
-    seq_n = min(n_queries, 100)
     t0 = time.monotonic()
-    for q in queries[:seq_n]:
+    for q in seq_queries:
         qt = time.monotonic()
-        searcher.search(q)
-        lat.append(time.monotonic() - qt)
+        searcher.search(dict(q))
+        lat.append(time.monotonic() - qt)  # closed-loop-ok
     seq_wall = time.monotonic() - t0
     qps_seq = seq_n / seq_wall
     lat_ms = np.asarray(lat) * 1e3
@@ -356,6 +414,16 @@ def main():
         except Exception as e:  # noqa: BLE001 — report, keep the bench
             phase_report("qos", {"platform": platform,
                                  "error": f"{type(e).__name__}: {e}"})
+
+    # -- phase: latency_under_load (open-loop offered-qps sweep over the
+    # real REST edge; coordinated-omission-free) --------------------------
+    if os.environ.get("OSTPU_BENCH_LOAD", "1") != "0":
+        try:
+            run_latency_under_load_phase(platform)
+        except Exception as e:  # noqa: BLE001 — report, keep the bench
+            phase_report("latency_under_load",
+                         {"platform": platform,
+                          "error": f"{type(e).__name__}: {e}"})
 
     # -- phase: soak (chaos SLO scenario over a 3-node cluster) -----------
     # runs LAST so a wedge here cannot cost the phases above; failures
@@ -431,7 +499,7 @@ def run_continuous_phase(searcher, queries, p50_plain: float,
             for q in mine:
                 t0 = time.monotonic()
                 eng.execute(searcher, dict(q), service=svc)
-                dt = time.monotonic() - t0
+                dt = time.monotonic() - t0  # closed-loop-ok
                 with lat_lock:
                     lat.append(dt)
 
@@ -464,13 +532,13 @@ def run_continuous_phase(searcher, queries, p50_plain: float,
         for q in sample[:n_off]:
             t0 = time.monotonic()
             searcher.search(dict(q))
-            plain.append(time.monotonic() - t0)
+            plain.append(time.monotonic() - t0)  # closed-loop-ok
         p50_plain_now = float(np.percentile(np.asarray(plain) * 1e3, 50))
         off = []
         for q in sample[:n_off]:
             t0 = time.monotonic()
             eng.execute(searcher, dict(q), service=svc)
-            off.append(time.monotonic() - t0)
+            off.append(time.monotonic() - t0)  # closed-loop-ok
         p50_off = float(np.percentile(np.asarray(off) * 1e3, 50))
 
         phase_report("continuous", {
@@ -514,7 +582,7 @@ def run_profile_phase(searcher, queries, seq_n: int, p50_plain: float,
     for q in queries[:seq_n]:
         t0 = time.monotonic()
         resp = searcher.search(dict(q, profile=True))
-        lat.append(time.monotonic() - t0)
+        lat.append(time.monotonic() - t0)  # closed-loop-ok
         bd = resp["profile"]["shards"][0]["searches"][0]["query"][0][
             "breakdown"]
         for key, v in bd.items():
@@ -559,7 +627,7 @@ def run_insights_phase(searcher, queries, seq_n: int,
     for q in queries[:seq_n]:
         t0 = time.monotonic()
         searcher.search(q)
-        plain.append(time.monotonic() - t0)
+        plain.append(time.monotonic() - t0)  # closed-loop-ok
     p50_plain = float(np.percentile(np.asarray(plain) * 1e3, 50))
     lat = []
     for q in queries[:seq_n]:
@@ -568,7 +636,7 @@ def run_insights_phase(searcher, queries, seq_n: int,
             searcher.search(q)
         for rec in sink:
             svc.record(rec)
-        lat.append(time.monotonic() - t0)
+        lat.append(time.monotonic() - t0)  # closed-loop-ok
     # one recorded msearch batch rides along: the batched-member records
     # carry the coalesced group size the report below surfaces
     with insights_mod.collecting() as sink:
@@ -929,6 +997,54 @@ def run_soak_phase(platform: str):
         "convergence": bool(conv.get("ok")),
         "doc_count": chaos["final_state"].get("doc_count"),
     })
+
+
+def run_latency_under_load_phase(platform: str):
+    """Open-loop latency-under-load curve (ROADMAP item 6): the
+    ``testing/loadgen.py`` harness boots a real node, drives the
+    per-tenant scenario packs (zipf lexical / RAG hybrid / analytics
+    aggs / paging walks / bulk side-traffic) at seeded Poisson+envelope
+    arrivals across >= 3 offered-qps points, and charges latency from
+    the SCHEDULED arrival — coordinated-omission-free, unlike every
+    closed-loop phase above.  One phase line per (pack, offered-load
+    point) carries p50/p99/p999 + the outcome ledger; the summary line
+    carries per-pack max_sustainable_qps and the admission/insights
+    attribution verdicts."""
+    import tempfile
+    import shutil as _shutil
+
+    from opensearch_tpu.testing.loadgen import run_latency_under_load
+
+    points = tuple(
+        float(x) for x in os.environ.get(
+            "OSTPU_BENCH_LOAD_QPS", "15,45,120").split(","))
+    duration_s = float(os.environ.get("OSTPU_BENCH_LOAD_DURATION", 3.0))
+    n_docs = int(os.environ.get("OSTPU_BENCH_LOAD_DOCS", 600))
+    root = tempfile.mkdtemp(prefix="bench-load-")
+    t0 = time.monotonic()
+    try:
+        report = run_latency_under_load(
+            root, seed=42, points=points, duration_s=duration_s,
+            n_docs=n_docs, retry_wait_cap_s=duration_s)
+    finally:
+        _shutil.rmtree(root, ignore_errors=True)
+    for point in report["points"]:
+        for pack, pr in sorted(point["packs"].items()):
+            phase_report("latency_under_load", {
+                "platform": platform, "pack": pack, **pr})
+    bad_verdicts = [v["slo"] for v in report["verdicts"]
+                    if not v["ok"]]
+    phase_report("latency_under_load_summary", {
+        "platform": platform,
+        "wall_s": round(time.monotonic() - t0, 1),
+        "points_qps": list(points), "duration_s": duration_s,
+        "n_docs": n_docs, "slo_ok": report["slo_ok"],
+        "failed_verdicts": bad_verdicts,
+        "max_sustainable_qps": {
+            name: p["max_sustainable_qps"]
+            for name, p in sorted(report["packs"].items())},
+    })
+    return report
 
 
 def final_line(*, qps, baseline_qps, platform, extra=None):

@@ -22,7 +22,13 @@ from typing import Any, Optional
 
 class TransportError(Exception):
     """Base client error; mirrors opensearchpy.exceptions.TransportError
-    (status_code, error, info)."""
+    (status_code, error, info).  ``headers`` carries the error
+    response's HTTP headers and ``retry_after`` the parsed Retry-After
+    hint (seconds, None when absent) — backpressure-aware callers like
+    the open-loop load harness schedule 429 retries from it."""
+
+    headers: dict = {}
+    retry_after = None
 
     def __init__(self, status_code, error, info=None):
         super().__init__(status_code, error)
@@ -123,7 +129,20 @@ class Transport:
                 reason = (err.get("reason") if isinstance(err, dict)
                           else str(err)) or str(e)
                 cls = _HTTP_EXCEPTIONS.get(e.code, TransportError)
-                raise cls(e.code, reason, info) from None
+                exc = cls(e.code, reason, info)
+                exc.headers = dict(e.headers.items())
+                ra = e.headers.get("Retry-After")
+                if ra is None and isinstance(err, dict):
+                    # msearch-style sub-errors surface the hint in the
+                    # body instead (the overall response is 200, so
+                    # callers raising per-item errors land here too)
+                    ra = err.get("retry_after_seconds")
+                try:
+                    exc.retry_after = float(ra) if ra is not None \
+                        else None
+                except (TypeError, ValueError):
+                    exc.retry_after = None
+                raise exc from None
             except (urllib.error.URLError, OSError) as e:
                 last_err = e                   # try the next host
         raise ConnectionError("N/A", str(last_err), last_err)

@@ -350,9 +350,13 @@ class RestController:
                     # insights totals (shed load is workload evidence),
                     # never a ring entry — attributed to the tenant
                     insights.record_rejected(opaque_id=opaque_id)
-                if response_headers is not None:
-                    response_headers["Retry-After"] = str(
-                        int(getattr(e, "retry_after_seconds", 1)))
+            if getattr(e, "status", None) == 429 \
+                    and response_headers is not None:
+                # EVERY 429 carries the hint — duress and circuit-
+                # breaker rejections are as retryable as admission
+                # ones, and a hintless 429 leaves clients guessing
+                response_headers["Retry-After"] = str(
+                    int(getattr(e, "retry_after_seconds", 1)))
             # transport-layer failures (NodeDisconnectedError /
             # ReceiveTimeoutError / NoMasterError) carry status 503 on
             # the class: the condition is retryable and the serialized
@@ -1776,8 +1780,14 @@ class RestController:
             by_index.setdefault(index, []).append(pos)
 
         def err_of(e):
-            return {"error": {"type": e.error_type, "reason": e.reason},
-                    "status": e.status}
+            err = {"error": {"type": e.error_type, "reason": e.reason},
+                   "status": e.status}
+            if e.status == 429:
+                # sub-responses can't carry headers (the envelope is
+                # 200), so the Retry-After hint rides in the body
+                err["error"]["retry_after_seconds"] = int(
+                    getattr(e, "retry_after_seconds", 1))
+            return err
 
         for index, positions in by_index.items():
             try:

@@ -130,9 +130,18 @@ class _Handler(BaseHTTPRequestHandler):
     do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _handle
 
 
+class _Server(ThreadingHTTPServer):
+    # accept backlog sized like the reference's netty transport, not the
+    # stdlib default (5): the open-loop load harness showed bursts of
+    # concurrent connects overflowing the backlog — the kernel then
+    # refuses/resets, which clients see as transport errors rather than
+    # an honest 429 with Retry-After.  The OS clamps to somaxconn.
+    request_queue_size = 1024
+
+
 class HttpServer:
     def __init__(self, controller, host: str = "127.0.0.1", port: int = 9200):
-        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd = _Server((host, port), _Handler)
         self.httpd.controller = controller
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None

@@ -479,3 +479,56 @@ class QosController:
             },
             "audit": self.audit(16),
         }
+
+
+def check_tenant_attribution(admission_tenants: dict,
+                             insights_tenants,
+                             client_ledger: dict) -> dict:
+    """Cross-check the node's per-tenant accounting against an external
+    client's own outcome ledger (the open-loop load harness,
+    ``testing/loadgen.py``).  For every search-path tenant the client
+    drove, three invariants must hold:
+
+    - every 2xx search held an admission permit, so the admission
+      block's ``admitted`` must cover the client's served count;
+    - every admission ``rejected`` surfaced to a client as a 429, so
+      the node may not claim more rejections than clients observed;
+    - every served search landed in insights, so the tenant's insights
+      rollup ``count`` must cover the client's served count (skipped
+      when ``insights_tenants`` is None — e.g. insights disabled).
+
+    Returns ``{tenant: [discrepancy strings]}`` — empty lists mean the
+    tenant's books balance; the harness turns each entry into an
+    ``attribution.<tenant>`` verdict.
+    """
+    problems: dict = {}
+    for tenant, led in sorted(client_ledger.items()):
+        probs: list = []
+        if led.get("searchish", True):
+            adm = admission_tenants.get(tenant)
+            served = int(led.get("ok", 0))
+            seen_429 = int(led.get("status_429", 0))
+            if adm is None:
+                if served or seen_429:
+                    probs.append("tenant missing from admission stats")
+            else:
+                admitted = int(adm.get("admitted", 0))
+                rejected = int(adm.get("rejected", 0)) + int(
+                    adm.get("shed", 0))
+                if admitted < served:
+                    probs.append(
+                        f"admission admitted {admitted} < client "
+                        f"served {served}")
+                if rejected > seen_429:
+                    probs.append(
+                        f"admission rejected+shed {rejected} > client "
+                        f"429s {seen_429}")
+            if insights_tenants is not None:
+                roll = insights_tenants.get(tenant) or {}
+                count = int(roll.get("count", 0))
+                if count < served:
+                    probs.append(
+                        f"insights count {count} < client served "
+                        f"{served}")
+        problems[tenant] = probs
+    return problems

@@ -1,0 +1,652 @@
+"""Open-loop load harness: latency-under-load curves against the real
+REST edge, coordinated-omission-free (ROADMAP item 6).
+
+Closed-loop harnesses (send, wait, send again) understate tail latency
+under overload: while the server stalls, the client simply stops
+offering load, so one stall charges ONE request instead of every
+request that would have arrived meanwhile — coordinated omission.
+This harness is open-loop:
+
+- every request gets a *scheduled* arrival time drawn up front from a
+  seeded Poisson process modulated by a deterministic diurnal/burst
+  envelope (``arrival_schedule`` — Lewis/Shedler thinning, so the
+  whole schedule is a pure function of (rate, duration, seed,
+  envelope) and the two-run determinism tests can pin it);
+- a dispatcher fires each request at its scheduled time regardless of
+  how many are still in flight (backlog queues, it never gates);
+- latency is charged from the SCHEDULED arrival, not the send — the
+  queue time a lagging server causes IS the measurement
+  (``tools/check_open_loop.py`` lints this module against
+  post-send-timestamp backsliding).
+
+Traffic comes as per-tenant **scenario packs** mapped to X-Opaque-Id
+tenants (the PR-14 QoS tenant key): zipf lexical head/tail search
+(sharing ``zipf_query_log`` with the soak harness and bench.py),
+RAG/hybrid kNN, analytics aggregations, sorted paging walks, and
+bulk-ingest side traffic.  Each pack's outcome ledger (ok / 429 with
+Retry-After honored / partial / 5xx) is cross-checked against the
+node's own ``_nodes/stats`` admission tenants block and the insights
+per-tenant rollups (``qos.check_tenant_attribution``).
+
+``LoadgenRunner.sweep`` walks offered-load points to produce the
+latency-under-load curve (p50/p99/p999 vs offered qps per pack) and a
+measured ``max_sustainable_qps`` per pack; ``run_latency_under_load``
+is the boot-a-node-and-sweep entry bench.py's ``latency_under_load``
+phase and the tests share.  429 responses are retried no earlier than
+their Retry-After hint plus seeded jitter, and per-tenant hint
+presence is a recorded verdict — a 429 without a hint is a bug this
+harness exists to catch.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import threading
+import time
+import zlib
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+import numpy as np
+
+from opensearch_tpu.testing.workload import corpus_doc, zipf_query_log
+
+#: default index + vector geometry for the seeded corpus
+LOAD_INDEX = "loadgen"
+VEC_DIM = 8
+
+_TWO_PI = 2.0 * math.pi
+
+
+# -- arrival processes ------------------------------------------------------
+
+def _flat(u: float) -> float:
+    return 1.0
+
+
+def _diurnal(u: float) -> float:
+    """One sinusoidal 'day' across the run: trough 0.55x, peak 1.0x."""
+    return 0.55 + 0.225 * (1.0 - math.cos(_TWO_PI * u))
+
+
+def _burst(u: float) -> float:
+    """Four square-wave bursts across the run: 1.0x inside a burst
+    window, 0.4x between them."""
+    return 1.0 if (u * 4.0) % 1.0 < 0.25 else 0.4
+
+
+#: name -> (intensity over run-phase u in [0,1), analytic mean) — the
+#: mean normalizes thinning so the realized average rate equals the
+#: offered rate whatever the envelope shape
+ENVELOPES: dict = {"flat": (_flat, 1.0),
+                   "diurnal": (_diurnal, 0.775),
+                   "burst": (_burst, 0.55)}
+
+
+def arrival_schedule(rate_qps: float, duration_s: float, seed: int,
+                     envelope: str = "flat") -> list:
+    """Sorted scheduled-arrival offsets (seconds) for one pack: a
+    homogeneous Poisson process at the envelope-normalized peak rate,
+    thinned by the deterministic envelope (Lewis/Shedler), so the mean
+    realized rate is ``rate_qps`` and the schedule is a pure function
+    of its arguments."""
+    try:
+        fn, mean = ENVELOPES[envelope]
+    except KeyError:
+        raise ValueError(f"unknown arrival envelope [{envelope}]; one "
+                         f"of {sorted(ENVELOPES)}") from None
+    if rate_qps <= 0 or duration_s <= 0:
+        return []
+    rng = random.Random(seed)
+    peak = rate_qps / mean
+    out, t = [], 0.0
+    while True:
+        t += rng.expovariate(peak)
+        if t >= duration_s:
+            break
+        if rng.random() <= fn(t / duration_s):
+            out.append(round(t, 9))
+    return out
+
+
+# -- scenario packs ---------------------------------------------------------
+
+class ScenarioPack:
+    """One tenant's traffic shape: a weight (its share of the total
+    offered qps), an arrival envelope, and a seeded request generator.
+    ``requests(seed, n)`` is a pure function — the determinism tests
+    pin the sequence."""
+
+    def __init__(self, name: str, tenant: str, weight: float,
+                 envelope: str, gen: Callable, *,
+                 searchish: bool = True):
+        self.name = name
+        self.tenant = tenant
+        self.weight = float(weight)
+        self.envelope = envelope
+        self._gen = gen
+        #: search-path traffic holds admission permits and lands in
+        #: insights; bulk side-traffic does neither
+        self.searchish = searchish
+
+    def stream_seed(self, seed: int) -> int:
+        """Per-pack derived seed: stable across processes (crc32, not
+        ``hash``, which is salted per interpreter)."""
+        return (int(seed) << 16) ^ zlib.crc32(self.name.encode())
+
+    def requests(self, seed: int, n: int) -> list:
+        return self._gen(random.Random(self.stream_seed(seed)), n)
+
+
+def _lexical_gen(index: str, vocab_size: int) -> Callable:
+    def gen(rng: random.Random, n: int) -> list:
+        pairs = zipf_query_log(n, vocab_size, seed=rng.randrange(2**31))
+        out = []
+        for a, b in pairs:
+            body = {"query": {"match": {"body": f"t{a} t{b}"}},
+                    "size": 10}
+            if rng.random() < 0.5:
+                # head traffic rarely needs exact totals — and
+                # track_total_hits:false arms the kth block-max prune
+                body["track_total_hits"] = False
+            out.append({"op": "search", "index": index, "body": body})
+        return out
+    return gen
+
+
+def _rag_gen(index: str, vocab_size: int, dim: int) -> Callable:
+    def gen(rng: random.Random, n: int) -> list:
+        out = []
+        for _ in range(n):
+            t = min(int(rng.paretovariate(1.3)) - 1, vocab_size - 1)
+            qv = [round(rng.random(), 4) for _ in range(dim)]
+            out.append({"op": "search", "index": index, "body": {
+                "query": {"hybrid": {"queries": [
+                    {"match": {"body": f"t{t}"}},
+                    {"knn": {"vec": {"vector": qv, "k": 10}}}]}},
+                "size": 10}})
+        return out
+    return gen
+
+
+def _analytics_gen(index: str, n_docs: int) -> Callable:
+    def gen(rng: random.Random, n: int) -> list:
+        out = []
+        for _ in range(n):
+            if rng.random() < 0.5:
+                aggs = {"per_hour": {"date_histogram": {
+                    "field": "ts", "fixed_interval": "1h"}}}
+            else:
+                aggs = {"tags": {"terms": {"field": "tag", "size": 8}}}
+            lo = rng.randrange(max(n_docs, 1))
+            out.append({"op": "search", "index": index, "body": {
+                "size": 0, "aggs": aggs,
+                "query": {"range": {"v": {"gte": lo // 2}}}}})
+        return out
+    return gen
+
+
+def _paging_gen(index: str, n_docs: int, pages: int = 3,
+                page_size: int = 10) -> Callable:
+    def gen(rng: random.Random, n: int) -> list:
+        out = []
+        page, start = 0, 0
+        for _ in range(n):
+            if page == 0:
+                start = rng.randrange(
+                    max(n_docs - pages * page_size, 1))
+            out.append({"op": "search", "index": index, "body": {
+                "query": {"match_all": {}}, "sort": [{"v": "asc"}],
+                "from": start + page * page_size, "size": page_size}})
+            page = (page + 1) % pages
+        return out
+    return gen
+
+
+def _bulk_gen(index: str, vocab_size: int, dim: int,
+              batch: int = 4) -> Callable:
+    tags = [f"tag{i}" for i in range(8)]
+
+    def gen(rng: random.Random, n: int) -> list:
+        out = []
+        for i in range(n):
+            docs = []
+            for j in range(batch):
+                doc_seed = rng.randrange(2**31)
+                src = corpus_doc(doc_seed, j, vocab_size, tags)
+                vrng = random.Random(doc_seed ^ 0x5EC)
+                src["vec"] = [round(vrng.random(), 4)
+                              for _ in range(dim)]
+                docs.append((f"lg-{i}-{j}", src))
+            out.append({"op": "bulk", "index": index, "docs": docs})
+        return out
+    return gen
+
+
+def default_packs(*, index: str = LOAD_INDEX, vocab_size: int = 2000,
+                  n_docs: int = 600, dim: int = VEC_DIM) -> list:
+    """The standard per-tenant scenario-pack set: zipf lexical head/
+    tail traffic (BM25S-style; shares ``zipf_query_log`` with bench.py
+    and the soak), RAG/hybrid kNN term-bags, analytics aggregations,
+    sorted paging walks, and bulk-ingest side traffic."""
+    return [
+        ScenarioPack("zipf_lexical", "lg-lexical", 4.0, "diurnal",
+                     _lexical_gen(index, vocab_size)),
+        ScenarioPack("rag_hybrid", "lg-rag", 2.0, "flat",
+                     _rag_gen(index, vocab_size, dim)),
+        ScenarioPack("analytics_aggs", "lg-analytics", 1.0, "flat",
+                     _analytics_gen(index, n_docs)),
+        ScenarioPack("paging_walk", "lg-paging", 1.0, "burst",
+                     _paging_gen(index, n_docs)),
+        ScenarioPack("bulk_ingest", "lg-ingest", 1.0, "burst",
+                     _bulk_gen(index, vocab_size, dim),
+                     searchish=False),
+    ]
+
+
+# -- corpus -----------------------------------------------------------------
+
+def corpus_docs(n_docs: int, *, seed: int = 42, vocab_size: int = 2000,
+                dim: int = VEC_DIM) -> list:
+    """Deterministic corpus: the soak harness's doc shape
+    (``workload.corpus_doc``) plus a seeded ``vec`` kNN field for the
+    RAG pack."""
+    tags = [f"tag{i}" for i in range(8)]
+    out = []
+    for i in range(n_docs):
+        src = corpus_doc(seed, i, vocab_size, tags)
+        vrng = random.Random((seed << 21) ^ i ^ 0x5EC)
+        src["vec"] = [round(vrng.random(), 4) for _ in range(dim)]
+        out.append((f"d{i}", src))
+    return out
+
+
+def seed_corpus(client, *, index: str = LOAD_INDEX, n_docs: int = 600,
+                seed: int = 42, vocab_size: int = 2000,
+                dim: int = VEC_DIM, shards: int = 1,
+                chunk: int = 200) -> int:
+    """Create the loadgen index over REST and bulk-load the seeded
+    corpus; returns the doc count."""
+    client.indices.create(index, {
+        "settings": {"number_of_shards": shards,
+                     "number_of_replicas": 0},
+        "mappings": {"properties": {
+            "body": {"type": "text"},
+            "ts": {"type": "date"},
+            "tag": {"type": "keyword"},
+            "v": {"type": "long"},
+            "vec": {"type": "knn_vector", "dimension": dim}}}})
+    docs = corpus_docs(n_docs, seed=seed, vocab_size=vocab_size,
+                       dim=dim)
+    for start in range(0, len(docs), chunk):
+        lines: list = []
+        for doc_id, src in docs[start:start + chunk]:
+            lines.append({"index": {"_id": doc_id}})
+            lines.append(src)
+        client.bulk(lines, index=index)
+    client.indices.refresh(index)
+    return len(docs)
+
+
+# -- execution --------------------------------------------------------------
+
+class RestExecutor:
+    """Executes pack ops against a node's real HTTP edge via the
+    bundled client — one client per tenant so every request carries
+    that tenant's ``X-Opaque-Id`` default header.  Returns the
+    harness's outcome dict: status, Retry-After hint (the client
+    surfaces the response header on 429 errors), partial flag."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self._base = base_url
+        self._timeout = timeout
+        self._clients: dict = {}
+        self._lock = threading.Lock()
+
+    def client(self, tenant: str):
+        from opensearch_tpu.client import OpenSearch
+        with self._lock:
+            cli = self._clients.get(tenant)
+            if cli is None:
+                cli = OpenSearch([self._base], timeout=self._timeout,
+                                 headers={"X-Opaque-Id": tenant})
+                self._clients[tenant] = cli
+            return cli
+
+    def __call__(self, op: dict, tenant: str) -> dict:
+        from opensearch_tpu.client import ConnectionError as CliConnError
+        from opensearch_tpu.client import TransportError
+        cli = self.client(tenant)
+        try:
+            if op["op"] == "search":
+                resp = cli.search(index=op["index"], body=op["body"])
+                shards = resp.get("_shards") or {}
+                return {"status": 200,
+                        "partial": bool(shards.get("failed"))}
+            if op["op"] == "bulk":
+                lines: list = []
+                for doc_id, src in op["docs"]:
+                    lines.append({"index": {"_id": doc_id}})
+                    lines.append(src)
+                resp = cli.bulk(lines, index=op["index"])
+                return {"status": 200,
+                        "partial": bool(resp.get("errors"))}
+            raise ValueError(f"unknown loadgen op [{op['op']}]")
+        except CliConnError:
+            return {"status": 599}
+        except TransportError as e:
+            status = e.status_code if isinstance(e.status_code, int) \
+                else 599
+            return {"status": status,
+                    "retry_after": getattr(e, "retry_after", None)}
+
+
+# -- the runner -------------------------------------------------------------
+
+class LoadgenRunner:
+    """Open-loop sweep driver.  ``execute(op, tenant) -> outcome`` is
+    injectable so tests can stand in a stalled or fake server; the
+    production executor is ``RestExecutor``."""
+
+    def __init__(self, packs: list, execute: Callable, *,
+                 seed: int = 42, duration_s: float = 3.0,
+                 max_workers: int = 48, retry_limit: int = 2,
+                 retry_jitter_s: float = 0.25,
+                 retry_wait_cap_s: Optional[float] = None):
+        self.packs = list(packs)
+        self.execute = execute
+        self.seed = int(seed)
+        self.duration_s = float(duration_s)
+        self.max_workers = int(max_workers)
+        self.retry_limit = int(retry_limit)
+        self.retry_jitter_s = float(retry_jitter_s)
+        #: None = honor the server's Retry-After in full; a cap is for
+        #: tests that must stay fast (capping below the hint is a
+        #: deliberate compliance violation the ledger still records)
+        self.retry_wait_cap_s = retry_wait_cap_s
+
+    # -- pure schedule (the determinism contract) --------------------------
+
+    def pack_rates(self, offered_qps: float) -> dict:
+        total = sum(p.weight for p in self.packs) or 1.0
+        return {p.name: offered_qps * p.weight / total
+                for p in self.packs}
+
+    def schedule(self, offered_qps: float) -> list:
+        """Merged (offset_s, pack_name, request_index) events, sorted —
+        a pure function of (packs, seed, offered_qps, duration)."""
+        rates = self.pack_rates(offered_qps)
+        events = []
+        for p in self.packs:
+            ts = arrival_schedule(rates[p.name], self.duration_s,
+                                  p.stream_seed(self.seed), p.envelope)
+            events.extend((t, p.name, i) for i, t in enumerate(ts))
+        events.sort()
+        return events
+
+    # -- one offered-load point --------------------------------------------
+
+    def run_point(self, offered_qps: float) -> dict:
+        events = self.schedule(offered_qps)
+        by_pack = {p.name: p for p in self.packs}
+        counts: dict = {}
+        for _t, name, _i in events:
+            counts[name] = counts.get(name, 0) + 1
+        reqs = {p.name: p.requests(self.seed, counts.get(p.name, 0))
+                for p in self.packs}
+        jitters = {}
+        for p in self.packs:
+            jrng = random.Random(p.stream_seed(self.seed) ^ 0x9E3779B9)
+            jitters[p.name] = [jrng.random() * self.retry_jitter_s
+                               for _ in range(counts.get(p.name, 0))]
+        recs: list = []
+        lock = threading.Lock()
+        base = time.monotonic() + 0.02
+        with ThreadPoolExecutor(
+                max_workers=self.max_workers,
+                thread_name_prefix="loadgen") as pool:
+            futs = []
+            for t, name, i in events:
+                delay = base + t - time.monotonic()
+                if delay > 0:
+                    # open-loop pacing: the dispatcher sleeps to the
+                    # NEXT scheduled arrival; total sleep is bounded by
+                    # the schedule's duration
+                    time.sleep(delay)              # deadline
+                futs.append(pool.submit(
+                    self._fire, by_pack[name], reqs[name][i], base + t,
+                    jitters[name][i], base, recs, lock))
+            for f in futs:
+                f.result()
+        elapsed = max([r["done_rel_s"] for r in recs]
+                      + [self.duration_s])
+        return self._summarize(offered_qps, recs, elapsed)
+
+    def _fire(self, pack: ScenarioPack, op: dict, scheduled_abs: float,
+              jitter_s: float, base: float, recs: list,
+              lock: threading.Lock) -> None:
+        tries = 0
+        status = 0
+        hints_present = hints_missing = 0
+        out: dict = {}
+        while True:
+            out = self.execute(op, pack.tenant)
+            status = int(out.get("status", 0))
+            if status == 429:
+                hint = out.get("retry_after")
+                if hint is None:
+                    hints_missing += 1
+                else:
+                    hints_present += 1
+                if tries < self.retry_limit:
+                    tries += 1
+                    wait = 1.0 if hint is None else float(hint)
+                    if self.retry_wait_cap_s is not None:
+                        wait = min(wait, self.retry_wait_cap_s)
+                    # Retry-After honored: never before the hint, plus
+                    # seeded jitter so retries decorrelate; bounded by
+                    # retry_limit iterations
+                    time.sleep(wait + jitter_s)    # backoff
+                    continue
+            break
+        # the coordinated-omission-free charge: completion minus the
+        # SCHEDULED arrival, so dispatcher/pool/server queueing all
+        # count against the request that suffered them
+        latency_s = time.monotonic() - scheduled_abs
+        outcome = ("rejected" if status == 429 else
+                   "server_error" if 500 <= status < 599 else
+                   "transport_error" if status == 599 or status <= 0
+                   else "partial" if out.get("partial") else "ok")
+        with lock:
+            recs.append({"pack": pack.name, "latency_s": latency_s,
+                         "outcome": outcome, "tries_429": tries,
+                         "hints_present": hints_present,
+                         "hints_missing": hints_missing,
+                         "done_rel_s": time.monotonic() - base})
+
+    def _summarize(self, offered_qps: float, recs: list,
+                   elapsed_s: float) -> dict:
+        rates = self.pack_rates(offered_qps)
+        packs = {}
+        for p in self.packs:
+            mine = [r for r in recs if r["pack"] == p.name]
+            n_of = {o: sum(1 for r in mine if r["outcome"] == o)
+                    for o in ("ok", "partial", "rejected",
+                              "server_error", "transport_error")}
+            lat_ms = np.asarray(
+                [r["latency_s"] for r in mine
+                 if r["outcome"] in ("ok", "partial")]) * 1e3
+            def pct(q):
+                return (round(float(np.percentile(lat_ms, q)), 3)
+                        if len(lat_ms) else 0.0)
+            served = n_of["ok"] + n_of["partial"]
+            packs[p.name] = {
+                "tenant": p.tenant,
+                "offered_qps": round(rates[p.name], 2),
+                "sent": len(mine),
+                **n_of,
+                "retries_429": sum(r["tries_429"] for r in mine),
+                "retry_after_present": sum(r["hints_present"]
+                                           for r in mine),
+                "retry_after_missing": sum(r["hints_missing"]
+                                           for r in mine),
+                "p50_ms": pct(50), "p99_ms": pct(99),
+                "p999_ms": pct(99.9),
+                "achieved_qps": round(served / elapsed_s, 2)
+                if elapsed_s else 0.0,
+            }
+        return {"offered_qps": float(offered_qps),
+                "duration_s": self.duration_s,
+                "elapsed_s": round(elapsed_s, 3), "packs": packs}
+
+    # -- the sweep ---------------------------------------------------------
+
+    def sweep(self, points) -> dict:
+        """Run every offered-load point (ascending) and derive the
+        per-pack ``max_sustainable_qps``: the highest offered rate the
+        pack served with >= 99% non-degraded outcomes AND >= 80% of the
+        offered throughput actually achieved."""
+        results = [self.run_point(q) for q in sorted(points)]
+        per_pack = {}
+        for p in self.packs:
+            sustained = 0.0
+            for r in results:
+                pr = r["packs"][p.name]
+                if not pr["sent"]:
+                    continue
+                served = pr["ok"] + pr["partial"]
+                if (served / pr["sent"] >= 0.99
+                        and pr["achieved_qps"]
+                        >= 0.8 * pr["offered_qps"]):
+                    sustained = max(sustained, pr["offered_qps"])
+            per_pack[p.name] = {"tenant": p.tenant,
+                                "max_sustainable_qps": sustained}
+        return {"seed": self.seed, "points": results,
+                "packs": per_pack}
+
+    # -- verdicts + attribution cross-check --------------------------------
+
+    def client_ledger(self, sweep_result: dict) -> dict:
+        """Per-tenant client-side outcome ledger for the attribution
+        cross-check (``qos.check_tenant_attribution``)."""
+        led = {}
+        for p in self.packs:
+            ok = s429 = 0
+            for r in sweep_result["points"]:
+                pr = r["packs"][p.name]
+                ok += pr["ok"] + pr["partial"]
+                s429 += (pr["retry_after_present"]
+                         + pr["retry_after_missing"])
+            led[p.tenant] = {"ok": ok, "status_429": s429,
+                             "searchish": p.searchish}
+        return led
+
+    def verdicts(self, sweep_result: dict,
+                 attribution: Optional[dict] = None) -> list:
+        """SLO-verdict list in the soak runner's shape.  The verdict
+        KEY SET is a pure function of the pack set (every pack gets its
+        hint/transport rows whether or not it saw a 429), so identical
+        seeds pin identical keys."""
+        v = []
+        points = sweep_result["points"]
+        lowest = points[0] if points else {"packs": {}}
+        n5 = sum(pr["server_error"]
+                 for pr in lowest["packs"].values())
+        v.append({"slo": "server_errors_at_lowest_load", "limit": 0,
+                  "observed": n5, "ok": n5 == 0})
+        for p in self.packs:
+            present = sum(r["packs"][p.name]["retry_after_present"]
+                          for r in points)
+            missing = sum(r["packs"][p.name]["retry_after_missing"]
+                          for r in points)
+            frac = (present / (present + missing)
+                    if present + missing else 1.0)
+            v.append({"slo": f"retry_after_hint.{p.name}",
+                      "limit": 1.0, "observed": round(frac, 4),
+                      "ok": missing == 0})
+            te = sum(r["packs"][p.name]["transport_error"]
+                     for r in points)
+            v.append({"slo": f"transport_errors.{p.name}", "limit": 0,
+                      "observed": te, "ok": te == 0})
+        if attribution is not None:
+            for tenant in sorted(attribution):
+                probs = attribution[tenant]
+                row = {"slo": f"attribution.{tenant}", "limit": 0,
+                       "observed": len(probs), "ok": not probs}
+                if probs:
+                    row["detail"] = probs
+                v.append(row)
+        return v
+
+
+# -- node-side attribution fetch -------------------------------------------
+
+def rest_attribution(client) -> tuple:
+    """(admission_tenants, insights_tenants) fetched over REST: the
+    ``_nodes/stats`` admission-control tenants block summed across
+    nodes, and the ``_insights/top_queries?by=tenant`` rollups."""
+    adm: dict = {}
+    stats = client.nodes.stats()
+    for n in (stats.get("nodes") or {}).values():
+        tenants = (((n.get("search_backpressure") or {})
+                    .get("admission_control") or {})
+                   .get("tenants") or {})
+        for label, t in tenants.items():
+            m = adm.setdefault(label, {"admitted": 0, "rejected": 0,
+                                       "shed": 0})
+            for k in m:
+                m[k] += int(t.get(k, 0))
+    top = client.insights_top_queries({"by": "tenant"})
+    ins = dict(top.get("tenants") or {})
+    return adm, ins
+
+
+# -- end-to-end entry -------------------------------------------------------
+
+def run_latency_under_load(data_path: str, *, seed: int = 42,
+                           points=(15, 45, 120),
+                           duration_s: float = 3.0, n_docs: int = 600,
+                           vocab_size: int = 2000,
+                           admission_max_concurrent: Optional[int] = None,
+                           tenant_shares: Optional[str] = None,
+                           retry_limit: int = 2,
+                           retry_wait_cap_s: Optional[float] = None) -> dict:
+    """Boot a real node (HTTP on an ephemeral port), seed the corpus,
+    sweep the offered-load points with the default scenario packs, and
+    return the curve + per-pack ``max_sustainable_qps`` + verdicts
+    (including the admission/insights attribution cross-check).  The
+    shared entry for bench.py's ``latency_under_load`` phase and the
+    harness tests."""
+    from opensearch_tpu.client import OpenSearch
+    from opensearch_tpu.node import Node
+    from opensearch_tpu.search.qos import check_tenant_attribution
+
+    node = Node(data_path, port=0).start()
+    try:
+        admin = OpenSearch([f"http://127.0.0.1:{node.port}"])
+        seed_corpus(admin, n_docs=n_docs, seed=seed,
+                    vocab_size=vocab_size)
+        transient: dict = {}
+        if tenant_shares is not None:
+            transient["search.qos.tenant_shares"] = tenant_shares
+        if admission_max_concurrent is not None:
+            transient["search_backpressure.max_concurrent_searches"] = \
+                int(admission_max_concurrent)
+        if transient:
+            admin.cluster.put_settings({"transient": transient})
+        packs = default_packs(vocab_size=vocab_size, n_docs=n_docs)
+        runner = LoadgenRunner(
+            packs, RestExecutor(f"http://127.0.0.1:{node.port}"),
+            seed=seed, duration_s=duration_s, retry_limit=retry_limit,
+            retry_wait_cap_s=retry_wait_cap_s)
+        result = runner.sweep(points)
+        adm, ins = rest_attribution(admin)
+        attribution = check_tenant_attribution(
+            adm, ins, runner.client_ledger(result))
+        result["verdicts"] = runner.verdicts(result,
+                                             attribution=attribution)
+        result["slo_ok"] = all(v["ok"] for v in result["verdicts"])
+        return result
+    finally:
+        node.stop()

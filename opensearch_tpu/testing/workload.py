@@ -76,6 +76,27 @@ def zipf_query_log(n_queries: int, vocab_size: int,
     return pairs
 
 
+def corpus_doc(seed: int, i: int, vocab_size: int, tags: list) -> dict:
+    """Deterministic per-id document: zipf text body, a timestamp
+    walking forward one minute per doc (date_histogram fodder), a
+    zipf-ish tag (terms-agg fodder), and a sortable long.  Module-level
+    so the open-loop harness (``testing/loadgen.py``) seeds its corpus
+    with the exact same doc shape the soak exercises; the RNG
+    construction and draw order are part of the determinism contract —
+    ``MixedWorkload.make_doc`` delegates here and tests pin its
+    output."""
+    rng = random.Random((seed << 20) ^ i)
+    n_terms = rng.randint(4, 10)
+    body = " ".join(
+        f"t{min(int(rng.paretovariate(1.3)) - 1, vocab_size - 1)}"
+        for _ in range(n_terms))
+    return {"body": body,
+            "ts": 1_700_000_000_000 + i * 60_000,
+            "tag": tags[min(int(rng.paretovariate(1.5)) - 1,
+                            len(tags) - 1)],
+            "v": i}
+
+
 class SoakConfig:
     """Declarative soak scenario: workload mix, cluster shape, fault
     schedule knobs, and SLOs.  ``smoke()`` is the fixed-seed tier-1
@@ -203,19 +224,11 @@ class MixedWorkload:
     # -- documents ---------------------------------------------------------
 
     def make_doc(self, i: int) -> dict:
-        """Deterministic per-id document: zipf text body, a timestamp
-        walking forward one minute per doc (date_histogram fodder), a
-        zipf-ish tag (terms-agg fodder), and a sortable long."""
-        rng = random.Random((self.config.seed << 20) ^ i)
-        n_terms = rng.randint(4, 10)
-        body = " ".join(
-            f"t{min(int(rng.paretovariate(1.3)) - 1, self.config.vocab_size - 1)}"
-            for _ in range(n_terms))
-        return {"body": body,
-                "ts": 1_700_000_000_000 + i * 60_000,
-                "tag": self.tags[min(int(rng.paretovariate(1.5)) - 1,
-                                     len(self.tags) - 1)],
-                "v": i}
+        """Deterministic per-id document — delegates to the shared
+        ``corpus_doc`` so soak and loadgen corpora stay byte-identical
+        for the same seed."""
+        return corpus_doc(self.config.seed, i, self.config.vocab_size,
+                          self.tags)
 
     def seed_docs(self) -> list:
         return [(str(i), self.make_doc(i)) for i in range(self.config.n_docs)]

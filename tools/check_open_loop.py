@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Lint: load-measurement code must be coordinated-omission-safe.
+
+The open-loop harness (``opensearch_tpu/testing/loadgen.py``) exists
+because closed-loop measurement lies under overload: latencies taken
+as ``monotonic() - t_sent`` inside a send-wait-send loop charge a
+server stall to ONE request instead of every request scheduled to
+arrive during it.  Two rules keep that from creeping back into the
+measurement layer:
+
+1. timing must use ``time.monotonic``-family clocks — ``time.time()``
+   / ``datetime.now()`` timestamps jump on NTP steps and corrupt
+   latency math (annotate ``# wall-clock`` only for genuinely
+   wall-clock output, same convention as ``check_monotonic.py``);
+2. inside a loop body, subtracting a loop-local "start" timestamp
+   from a fresh clock call (``monotonic() - t0`` where ``t0`` was
+   taken from the clock in the same loop body) is the closed-loop
+   per-request pattern — in the harness it must be the SCHEDULED
+   arrival that is subtracted, never a post-send timestamp.  bench.py
+   keeps several deliberate closed-loop *service-time* measurements
+   (the batched/sequential phases measure the engine, not the edge);
+   those carry a ``# closed-loop-ok`` annotation on the same line or
+   the line above.
+
+Sibling of ``check_seeded_rng.py``/``check_sleep_loops.py``; new
+violations fail tier-1 (tests/test_loadgen.py runs this check).
+
+Usage: python tools/check_open_loop.py [root ...]   (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ANNOTATION = "# closed-loop-ok"
+WALL_ANNOTATION = "# wall-clock"
+
+#: monotonic-family clock attribute/function names
+MONO_CLOCKS = ("monotonic", "monotonic_ns", "perf_counter",
+               "perf_counter_ns")
+#: clocks that must not time anything (wall clocks / removed APIs)
+BAD_CLOCKS = ("time", "now", "utcnow", "clock")
+
+
+def _call_name(node: ast.AST):
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _is_mono_call(node: ast.AST) -> bool:
+    return _call_name(node) in MONO_CLOCKS
+
+
+def _bad_clock_calls(tree: ast.AST) -> list[int]:
+    """Line numbers of wall-clock / non-monotonic clock calls."""
+    out = []
+    for node in ast.walk(tree):
+        name = _call_name(node)
+        if name not in BAD_CLOCKS:
+            continue
+        fn = node.func
+        # only time.time()/time.clock() and datetime.now()/utcnow();
+        # an arbitrary method named .now()/.time() on another object
+        # is not a clock read
+        if isinstance(fn, ast.Attribute):
+            base = fn.value
+            base_name = base.id if isinstance(base, ast.Name) else (
+                base.attr if isinstance(base, ast.Attribute) else None)
+            if base_name not in ("time", "datetime", "dt"):
+                continue
+        out.append(node.lineno)
+    return out
+
+
+def _closed_loop_subs(tree: ast.AST) -> list[int]:
+    """Line numbers of ``monotonic() - start`` subtractions where
+    ``start`` is assigned from a monotonic-family call inside the same
+    loop body — the closed-loop per-iteration latency pattern."""
+    out = []
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+            continue
+        starts = set()
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Assign) and _is_mono_call(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        starts.add(tgt.id)
+        if not starts:
+            continue
+        for node in ast.walk(loop):
+            if (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)
+                    and _is_mono_call(node.left)
+                    and isinstance(node.right, ast.Name)
+                    and node.right.id in starts):
+                out.append(node.lineno)
+    return sorted(set(out))
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error ({e.msg})"]
+    lines = src.splitlines()
+
+    def annotated(lineno: int, marker: str) -> bool:
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        prev = lines[lineno - 2] if lineno >= 2 else ""
+        return marker in line or marker in prev
+
+    problems = []
+    for lineno in _bad_clock_calls(tree):
+        if annotated(lineno, WALL_ANNOTATION):
+            continue
+        problems.append(
+            f"{path}:{lineno}: non-monotonic clock in measurement code "
+            "— use time.monotonic()/perf_counter(), or annotate "
+            f"'{WALL_ANNOTATION}' for genuinely wall-clock output")
+    for lineno in _closed_loop_subs(tree):
+        if annotated(lineno, ANNOTATION):
+            continue
+        problems.append(
+            f"{path}:{lineno}: closed-loop latency measurement (clock "
+            "minus a post-send timestamp taken in the same loop) — "
+            "charge from the SCHEDULED arrival instead, or annotate "
+            f"'{ANNOTATION}' for a deliberate service-time measurement")
+    return problems
+
+
+def _default_roots() -> list[str]:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return [os.path.join(repo, "opensearch_tpu", "testing",
+                         "loadgen.py"),
+            os.path.join(repo, "bench.py")]
+
+
+def main(argv: list[str]) -> int:
+    roots = argv[1:] or _default_roots()
+    problems = []
+    for root in roots:
+        if os.path.isfile(root):
+            problems.extend(check_file(root))
+            continue
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    problems.extend(check_file(
+                        os.path.join(dirpath, name)))
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} open-loop violation(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -3,13 +3,17 @@
 
 The soak harness's whole contract is replayability: the same seed must
 produce the same op stream, the same fault schedule, and the same SLO
-verdicts (tests/test_soak.py asserts it).  One unseeded
+verdicts (tests/test_soak.py asserts it), and the open-loop load
+harness (``testing/loadgen.py``) extends the same contract to arrival
+schedules, per-pack request streams, and retry jitter
+(tests/test_loadgen.py pins those).  One unseeded
 ``random.Random()`` or ``np.random.default_rng()`` anywhere in the
 harness silently breaks that — the run still "works", it just stops
-being a regression gate.  So under ``opensearch_tpu/testing/`` and in
-``bench.py``, every RNG construction must pass an explicit seed
-argument, or carry a ``# seeded-elsewhere`` annotation on the same line
-or the line above (for RNGs that are re-seeded before use).
+being a regression gate.  So under ``opensearch_tpu/testing/`` (which
+includes ``loadgen.py``) and in ``bench.py``, every RNG construction
+must pass an explicit seed argument, or carry a ``# seeded-elsewhere``
+annotation on the same line or the line above (for RNGs that are
+re-seeded before use).
 
 Sibling of ``check_monotonic.py``/``check_sleep_loops.py``; new
 un-seeded sites fail tier-1 (tests/test_soak.py runs this check).
