@@ -425,6 +425,16 @@ def main():
                          {"platform": platform,
                           "error": f"{type(e).__name__}: {e}"})
 
+    # -- phase: autoscale (QoS-driven searcher elasticity: scale-up
+    # under pressure, drain-safe retirement when idle) --------------------
+    if os.environ.get("OSTPU_BENCH_AUTOSCALE", "1") != "0":
+        try:
+            run_autoscale_phase(platform)
+        except Exception as e:  # noqa: BLE001 — report, keep the bench
+            phase_report("autoscale",
+                         {"platform": platform,
+                          "error": f"{type(e).__name__}: {e}"})
+
     # -- phase: soak (chaos SLO scenario over a 3-node cluster) -----------
     # runs LAST so a wedge here cannot cost the phases above; failures
     # are reported as a phase line, never swallowed
@@ -1043,6 +1053,51 @@ def run_latency_under_load_phase(platform: str):
         "max_sustainable_qps": {
             name: p["max_sustainable_qps"]
             for name, p in sorted(report["packs"].items())},
+    })
+    return report
+
+
+def run_autoscale_phase(platform: str):
+    """Elasticity trajectory (ROADMAP item 5, PR 17): the autoscale
+    churn soak drives the QoS-hot window that scales the searcher
+    fleet up and the idle window that drains it back, and this phase
+    line records the loop's quality numbers — time from pressure to a
+    serving searcher, drain duration on retirement, p99 across both
+    transitions, and that every fleet decision landed in the audit
+    ring with its evidence."""
+    import tempfile
+    import shutil as _shutil
+
+    from opensearch_tpu.testing.workload import run_autoscale_soak
+
+    root = tempfile.mkdtemp(prefix="bench-autoscale-")
+    t0 = time.monotonic()
+    try:
+        report = run_autoscale_soak(root)
+    finally:
+        _shutil.rmtree(root, ignore_errors=True)
+    chaos = report["chaos"]
+    asr = chaos.get("autoscale") or {}
+    applied = {d.get("fault"): d for d in chaos.get("applied", [])}
+    up = applied.get("scale_up_pressure", {})
+    down = applied.get("scale_down_idle", {})
+    phase_report("autoscale", {
+        "platform": platform,
+        "wall_s": round(time.monotonic() - t0, 1),
+        "slo_ok": report["slo_ok"],
+        "scale_ups": asr.get("scale_ups"),
+        "scale_downs": asr.get("scale_downs"),
+        "hard_kills": asr.get("hard_kills"),
+        "abandoned": asr.get("abandoned"),
+        "drains_completed": asr.get("drains_completed"),
+        "decisions_audited": asr.get("decisions_audited"),
+        "time_to_scale_up_s": up.get("time_to_scale_up_s"),
+        "drain_s": down.get("drain_s"),
+        # transition p99: ops keep flowing while the fleet mutates, so
+        # the run-wide search tail IS the across-the-transition tail
+        "p99_search_ms": chaos["p99_ms"].get("search"),
+        "searchers_final": asr.get("searchers_final"),
+        "unexpected_errors": len(chaos["unexpected_errors"]),
     })
     return report
 

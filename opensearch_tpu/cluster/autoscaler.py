@@ -1,0 +1,515 @@
+"""QoS-driven searcher autoscaling: the elasticity control loop.
+
+The cluster manager owns the routing table and (through PR 15) reacts
+to *failure*; this module makes it react to *load*.  The
+``SearcherAutoscaler`` runs on the elected leader and closes the loop
+from QoS evidence (admission occupancy, measured Retry-After EWMAs —
+the same signals the ``QosController`` adapts knobs from) to fleet
+mutation: provision a search-only replica node when the evidence stays
+hot past a dwell window, retire one through a drain protocol when it
+stays cold.  Hysteresis (separate hot/cold thresholds), the dwell
+window, and per-direction cooldowns keep the fleet from flapping.
+
+Drain protocol (``retire_searcher``) — the ONLY sanctioned way to take
+a searcher out of service; both the autoscaler and the soak's
+``kill_searcher`` directive route through it:
+
+1. Commit a state update marking the node ``draining`` — allocation
+   excludes draining nodes from the searcher pool, so the same
+   committed state removes the victim from every ``search_replicas`` /
+   ``search_in_sync`` set.  No new scatters route to it.
+2. Tombstone the victim in the coordinator-side C3 collector so the
+   adaptive selector stops considering it immediately (before the
+   state round-trips).
+3. Wait for in-flight shard RPCs to complete (collector ``outstanding``
+   drains to zero) and FileCache pins to release.
+4. Stop the node, then remove it from the cluster state entirely.
+
+``cluster.autoscale.drain_timeout_s`` bounds step 3: past the deadline
+the retirement escalates to a hard kill and the partial-results path
+absorbs any straggler responses.
+
+Crash safety: every fleet mutation is a single committed state update
+(node + search-slot settings + allocation in one publish), so the
+cluster state never contains a half-admitted node.  A leader that dies
+after committing ``draining`` but before finishing the drain leaves a
+durable marker; the next leader's ``run_once`` finds it and completes
+the retirement (``resume_drain``).  A provisioned-but-never-committed
+node is abandoned by the provisioning leader itself (the publish
+raised), and never becomes cluster state.
+
+Every decision appends to the QosController's audit ring (PR 14) with
+its numeric evidence and files a flight-recorder capture.
+
+Module globals below are dynamic-setting targets
+(``cluster.autoscale.*``, registered in ``opensearch_tpu/node.py``);
+per-instance attributes override them when set (the soak pins its own
+thresholds without touching global knobs).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Callable, Optional
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+from opensearch_tpu.cluster.state import allocate_shards, node_roles
+
+# -- dynamic settings (cluster.autoscale.*) -------------------------------
+AUTOSCALE_ENABLED = False
+MIN_SEARCHERS = 1
+MAX_SEARCHERS = 4
+DWELL_S = 3.0
+COOLDOWN_S = 10.0
+DRAIN_TIMEOUT_S = 5.0
+
+# -- decision thresholds (the hysteresis band) ----------------------------
+HOT_OCCUPANCY = 0.75      # tenant-weighted occupancy at/above -> hot
+COLD_OCCUPANCY = 0.10     # at/below (and retry quiet) -> cold
+HOT_RETRY_AFTER_S = 2.0   # measured Retry-After EWMA at/above -> hot
+
+#: node-id prefix for autoscaler-provisioned searchers; retirement
+#: prefers these (LIFO) so operator-placed searchers survive churn
+NODE_ID_PREFIX = "as"
+
+
+def retire_searcher(coordinator, victim: str, *,
+                    collector=None, node=None,
+                    drain_timeout_s: Optional[float] = None,
+                    poll_s: float = 0.005,
+                    audit: Optional[Callable] = None,
+                    rank: Optional[Callable] = None) -> dict:
+    """Drain-safe searcher retirement (see module docstring, steps 1-4).
+
+    ``collector`` is the leader's ResponseCollectorService (C3) — used
+    both to tombstone the victim and as the in-flight-RPC drain
+    barrier.  ``node`` is the victim's in-process node object when the
+    caller can resolve it (soak / autoscaler provisioned it); ``None``
+    skips the local stop (a real remote node stops itself on eviction).
+    ``audit(knob, old, new, evidence)`` receives the retirement record.
+    Returns ``{"node", "drained", "hard_kill", "drain_s"}``.
+    """
+    timeout = DRAIN_TIMEOUT_S if drain_timeout_s is None else \
+        float(drain_timeout_s)
+    t0 = time.monotonic()
+    deadline = t0 + max(0.0, timeout)
+
+    def mark_draining(state):
+        info = state.nodes.get(victim)
+        if info is None:
+            return state
+        if not info.get("draining"):
+            nodes = dict(state.nodes)
+            nodes[victim] = dict(info, draining=True)
+            state = state.with_(nodes=nodes)
+        # allocation sees the draining flag and vacates the victim's
+        # search slots in this same committed update
+        return allocate_shards(state, rank=rank)
+
+    coordinator.submit_state_update(mark_draining)
+    if collector is not None:
+        collector.remove_node(victim)  # C3 tombstone: stop selecting NOW
+
+    hard_kill = False
+
+    def _wait(pred) -> bool:
+        nonlocal hard_kill
+        while not pred():
+            if time.monotonic() >= deadline:
+                hard_kill = True
+                return False
+            time.sleep(poll_s)  # deadline (drain_timeout_s hard-kill above)
+        return True
+
+    if collector is not None:
+        _wait(lambda: collector.outstanding(victim) <= 0)
+    fc = getattr(node, "file_cache", None)
+    if fc is not None:
+        _wait(lambda: fc.stats().get("pinned_entries", 0) == 0)
+    if node is not None:
+        node.stop()
+    coordinator.remove_node(victim)
+    out = {"node": victim, "drained": not hard_kill,
+           "hard_kill": hard_kill,
+           "drain_s": round(time.monotonic() - t0, 6)}
+    if audit is not None:
+        audit("autoscale.drain", "serving",
+              "hard_killed" if hard_kill else "retired", dict(out))
+    return out
+
+
+class SearcherAutoscaler:
+    """Leader-driven searcher fleet controller.
+
+    Tick-driven like the QosController: ``maybe_tick()`` is called from
+    the search hot path and self-paces on an injectable clock; no
+    background thread, so soak runs stay deterministic.  All limits
+    (``enabled``, ``min_searchers``, ...) are instance attributes that
+    default to ``None`` meaning "use the module global" (the dynamic
+    setting); the soak pins instance values directly.
+
+    ``provision(node_id) -> info-dict|None`` must build AND start the
+    new searcher node, returning its discovery info (``None`` for the
+    default searcher info).  ``resolve(node_id) -> node|None`` maps ids
+    to in-process node objects for drain/stop.  ``on_retired(node_id)``
+    fires after a retirement or abandon so the harness can drop its
+    references.  Without a provisioner, scale-up decisions are recorded
+    as skipped — the controller never half-acts.
+    """
+
+    def __init__(self, coordinator, *, admission, collector=None,
+                 qos=None, clock: Callable[[], float] = time.monotonic,
+                 interval_s: float = 1.0,
+                 provision: Optional[Callable] = None,
+                 resolve: Optional[Callable] = None,
+                 on_retired: Optional[Callable] = None):
+        self.coordinator = coordinator
+        self.admission = admission
+        self.collector = collector
+        self.qos = qos
+        self.clock = clock
+        self.interval_s = float(interval_s)
+        self.provision = provision
+        self.resolve = resolve
+        self.on_retired = on_retired
+        # None -> defer to the module global (dynamic setting)
+        self.enabled: Optional[bool] = None
+        self.min_searchers: Optional[int] = None
+        self.max_searchers: Optional[int] = None
+        self.dwell_s: Optional[float] = None
+        self.cooldown_s: Optional[float] = None
+        self.drain_timeout_s: Optional[float] = None
+        self.hot_occupancy = HOT_OCCUPANCY
+        self.cold_occupancy = COLD_OCCUPANCY
+        self.hot_retry_after_s = HOT_RETRY_AFTER_S
+        #: optional capacity link: admission max_concurrent tracks the
+        #: fleet (= per_searcher * n_searchers) after each scale event
+        self.concurrency_per_searcher: Optional[int] = None
+        self._hot_since: Optional[float] = None
+        self._cold_since: Optional[float] = None
+        self._last_scale: Optional[float] = None
+        self._last_tick: Optional[float] = None
+        self._tick_lock = threading.Lock()
+        self._run_lock = threading.Lock()
+        self._stopped = False
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.hard_kills = 0
+        self.abandoned = 0
+        self.ticks = 0
+        self.last_decision: dict = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._stopped = False
+        self._hot_since = self._cold_since = None
+        self._last_tick = None
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    # -- effective limits --------------------------------------------------
+
+    def _on(self) -> bool:
+        v = self.enabled
+        return AUTOSCALE_ENABLED if v is None else bool(v)
+
+    def _min(self) -> int:
+        v = self.min_searchers
+        return int(MIN_SEARCHERS if v is None else v)
+
+    def _max(self) -> int:
+        v = self.max_searchers
+        return int(MAX_SEARCHERS if v is None else v)
+
+    def _dwell(self) -> float:
+        v = self.dwell_s
+        return float(DWELL_S if v is None else v)
+
+    def _cooldown(self) -> float:
+        v = self.cooldown_s
+        return float(COOLDOWN_S if v is None else v)
+
+    def _drain_timeout(self) -> float:
+        v = self.drain_timeout_s
+        return float(DRAIN_TIMEOUT_S if v is None else v)
+
+    # -- fleet view (rebuilt from cluster state every tick: a new
+    # -- leader inherits decision state for free) --------------------------
+
+    @staticmethod
+    def _searchers(state) -> list:
+        return sorted(n for n, info in state.nodes.items()
+                      if "search" in node_roles(info)
+                      and not (info or {}).get("draining"))
+
+    @staticmethod
+    def _draining(state) -> list:
+        return sorted(n for n, info in state.nodes.items()
+                      if (info or {}).get("draining"))
+
+    def _next_id(self, state) -> str:
+        for i in itertools.count():
+            nid = f"{NODE_ID_PREFIX}{i}"
+            if nid not in state.nodes:
+                return nid
+        raise AssertionError("unreachable")
+
+    # -- evidence ----------------------------------------------------------
+
+    def _evidence(self) -> dict:
+        stats = self.admission.stats()
+        occ = float(stats.get("occupancy") or 0.0)
+        weighted = occ
+        for label, row in sorted((stats.get("tenants") or {}).items()):
+            cap = row.get("max_concurrent")
+            if cap:
+                weighted = max(weighted,
+                               float(row.get("inflight", 0)) / float(cap))
+        retry = float(stats.get("retry_after_s") or 0.0)
+        hot = (weighted >= self.hot_occupancy
+               or retry >= self.hot_retry_after_s)
+        cold = (weighted <= self.cold_occupancy
+                and retry < self.hot_retry_after_s)
+        return {"occupancy": round(occ, 4),
+                "weighted_occupancy": round(weighted, 4),
+                "retry_after_s": round(retry, 4),
+                "hot": hot, "cold": cold}
+
+    # -- audit -------------------------------------------------------------
+
+    def _audit(self, knob: str, old, new, evidence: dict) -> None:
+        if self.qos is not None:
+            self.qos.record_adaptation(knob, old, new, evidence)
+
+    # -- ticking -----------------------------------------------------------
+
+    def maybe_tick(self) -> Optional[dict]:
+        """Self-paced tick for the search hot path: cheap when disabled
+        or off-leader, at most one evaluation per ``interval_s``."""
+        if self._stopped or not self._on():
+            return None
+        now = self.clock()
+        with self._tick_lock:
+            if (self._last_tick is not None
+                    and now - self._last_tick < self.interval_s):
+                return None
+            self._last_tick = now
+        try:
+            return self.run_once()
+        except OpenSearchTpuError:
+            return None  # lost leadership mid-tick; next leader resumes
+
+    def run_once(self) -> dict:
+        """One deterministic control-loop evaluation.  Returns the
+        decision record (also kept as ``last_decision``)."""
+        if not self._run_lock.acquire(blocking=False):
+            # a drain in progress ticks the search path re-entrantly;
+            # never start a second actuation underneath it
+            return {"action": "none", "reason": "tick_in_progress"}
+        try:
+            return self._run_once_locked()
+        finally:
+            self._run_lock.release()
+
+    def _run_once_locked(self) -> dict:
+        self.ticks += 1
+        now = self.clock()
+        if self._stopped or not self._on():
+            self._hot_since = self._cold_since = None
+            return self._done({"action": "none", "reason": "disabled"})
+        if not self.coordinator.is_leader():
+            self._hot_since = self._cold_since = None
+            return self._done({"action": "none", "reason": "not_leader"})
+        state = self.coordinator.state()
+        draining = self._draining(state)
+        if draining:
+            # a previous leader committed the drain marker but never
+            # finished: complete the retirement from durable state
+            return self._done(self._resume_drain(state, draining[0]))
+        searchers = self._searchers(state)
+        n = len(searchers)
+        ev = self._evidence()
+        if ev["hot"] and n < self._max():
+            self._cold_since = None
+            if self._hot_since is None:
+                self._hot_since = now
+            dwelled = now - self._hot_since
+            if dwelled >= self._dwell() and self._cooled(now):
+                return self._done(self._scale_up(state, searchers, ev))
+            return self._done({"action": "none", "reason": "dwell_up",
+                               "dwell_s": round(dwelled, 4),
+                               "evidence": ev})
+        if ev["cold"] and n > self._min():
+            self._hot_since = None
+            if self._cold_since is None:
+                self._cold_since = now
+            dwelled = now - self._cold_since
+            if dwelled >= self._dwell() and self._cooled(now):
+                return self._done(self._scale_down(state, searchers, ev))
+            return self._done({"action": "none", "reason": "dwell_down",
+                               "dwell_s": round(dwelled, 4),
+                               "evidence": ev})
+        self._hot_since = self._cold_since = None
+        return self._done({"action": "none", "reason": "steady",
+                           "searchers": n, "evidence": ev})
+
+    def _cooled(self, now: float) -> bool:
+        # one cooldown clock for both directions: a scale event in
+        # EITHER direction opens a quiet window, which is exactly the
+        # anti-flap guard (up->down->up churn pays two cooldowns)
+        return (self._last_scale is None
+                or now - self._last_scale >= self._cooldown())
+
+    def _done(self, decision: dict) -> dict:
+        self.last_decision = decision
+        return decision
+
+    # -- actuation ---------------------------------------------------------
+
+    def _scale_up(self, state, searchers: list, evidence: dict) -> dict:
+        if self.provision is None:
+            return {"action": "none", "reason": "no_provisioner",
+                    "evidence": evidence}
+        nid = self._next_id(state)
+        info = self.provision(nid) or {
+            "name": nid, "roles": ["search"], "master_eligible": False}
+        n_after = len(searchers) + 1
+        reconf = self.coordinator._reconfigure
+
+        def admit(st):
+            if nid in st.nodes:
+                return st
+            nodes = dict(st.nodes)
+            nodes[nid] = dict(info)
+            # search slots track the fleet: any index that opted into
+            # the tier gets one slot per live searcher, so the new node
+            # actually serves (and the drain path's min() shrinks it
+            # back without a second update)
+            indices = {}
+            for name, meta in st.indices.items():
+                settings = dict((meta or {}).get("settings") or {})
+                if int(settings.get("number_of_search_replicas", 0)
+                       or 0) > 0:
+                    settings["number_of_search_replicas"] = n_after
+                    meta = dict(meta, settings=settings)
+                indices[name] = meta
+            return allocate_shards(
+                st.with_(nodes=nodes, indices=indices,
+                         voting=reconf(nodes)),
+                rank=getattr(self.coordinator, "rank_fn", None))
+
+        try:
+            self.coordinator.submit_state_update(admit)
+        except OpenSearchTpuError as exc:
+            return self._abandon(nid, evidence, str(exc))
+        self.scale_ups += 1
+        self._last_scale = self.clock()
+        self._hot_since = None
+        self._sync_concurrency(n_after, evidence)
+        self._audit("autoscale.searchers", len(searchers), n_after,
+                    dict(evidence, node=nid, decision="scale_up",
+                         dwell_s=self._dwell()))
+        return {"action": "scale_up", "node": nid,
+                "searchers": n_after, "evidence": evidence}
+
+    def _abandon(self, nid: str, evidence: dict, reason: str) -> dict:
+        """The admit publish failed (lost quorum / leadership): the
+        provisioned node never became cluster state — stop it so
+        nothing half-added keeps running."""
+        node = self.resolve(nid) if self.resolve is not None else None
+        if node is not None:
+            node.stop()
+        if self.on_retired is not None:
+            self.on_retired(nid)
+        self.abandoned += 1
+        self._audit("autoscale.searchers", "provisioned", "abandoned",
+                    dict(evidence, node=nid, decision="abandon_scale_up",
+                         error=reason))
+        return {"action": "abandoned", "node": nid, "reason": reason,
+                "evidence": evidence}
+
+    def _pick_victim(self, searchers: list) -> str:
+        ours = [n for n in searchers if n.startswith(NODE_ID_PREFIX)]
+        return max(ours or searchers)  # LIFO: newest autoscaled first
+
+    def _scale_down(self, state, searchers: list, evidence: dict) -> dict:
+        victim = self._pick_victim(searchers)
+        node = self.resolve(victim) if self.resolve is not None else None
+        res = retire_searcher(
+            self.coordinator, victim, collector=self.collector,
+            node=node, drain_timeout_s=self._drain_timeout(),
+            audit=self._audit,
+            rank=getattr(self.coordinator, "rank_fn", None))
+        self.scale_downs += 1
+        if res["hard_kill"]:
+            self.hard_kills += 1
+        self._last_scale = self.clock()
+        self._cold_since = None
+        if self.on_retired is not None:
+            self.on_retired(victim)
+        n_after = len(searchers) - 1
+        self._sync_concurrency(n_after, evidence)
+        self._audit("autoscale.searchers", len(searchers), n_after,
+                    dict(evidence, node=victim, decision="scale_down",
+                         drained=res["drained"],
+                         hard_kill=res["hard_kill"],
+                         drain_s=res["drain_s"]))
+        return {"action": "scale_down", "node": victim,
+                "searchers": n_after, "drain": res, "evidence": evidence}
+
+    def _resume_drain(self, state, victim: str) -> dict:
+        node = self.resolve(victim) if self.resolve is not None else None
+        res = retire_searcher(
+            self.coordinator, victim, collector=self.collector,
+            node=node, drain_timeout_s=self._drain_timeout(),
+            audit=self._audit,
+            rank=getattr(self.coordinator, "rank_fn", None))
+        self.scale_downs += 1
+        if res["hard_kill"]:
+            self.hard_kills += 1
+        self._last_scale = self.clock()
+        if self.on_retired is not None:
+            self.on_retired(victim)
+        self._audit("autoscale.searchers", "draining", "retired",
+                    dict(decision="resume_drain", **res))
+        return {"action": "resume_drain", "node": victim, "drain": res}
+
+    def _sync_concurrency(self, n_searchers: int, evidence: dict) -> None:
+        per = self.concurrency_per_searcher
+        if not per:
+            return
+        old = self.admission.max_concurrent
+        new = max(1, int(per) * max(1, int(n_searchers)))
+        if new != old:
+            self.admission.max_concurrent = new
+            self._audit("autoscale.max_concurrent", old, new,
+                        dict(evidence, searchers=n_searchers))
+
+    # -- stats -------------------------------------------------------------
+
+    def stats(self) -> dict:
+        try:
+            state = self.coordinator.state()
+            searchers = self._searchers(state)
+            draining = self._draining(state)
+        except Exception:
+            searchers, draining = [], []
+        return {"enabled": self._on(),
+                "leader": bool(self.coordinator.is_leader()),
+                "min_searchers": self._min(),
+                "max_searchers": self._max(),
+                "dwell_s": self._dwell(),
+                "cooldown_s": self._cooldown(),
+                "drain_timeout_s": self._drain_timeout(),
+                "searchers": searchers,
+                "draining": draining,
+                "ticks": self.ticks,
+                "scale_ups": self.scale_ups,
+                "scale_downs": self.scale_downs,
+                "hard_kills": self.hard_kills,
+                "abandoned": self.abandoned,
+                "last_decision": dict(self.last_decision)}

@@ -83,6 +83,10 @@ class Coordinator:
         # must neither stand for election nor keep the lead — the
         # reference's NodeHealthService veto in Coordinator/PreVote
         self.health_provider = health_provider
+        # optional node_id -> float|None (the C3 collector's adaptive
+        # rank): threaded into allocate_shards so write-copy hole
+        # filling prefers healthier nodes when evidence exists
+        self.rank_fn = None
 
         self.mode = Mode.CANDIDATE
         self.current_term = 0
@@ -307,24 +311,26 @@ class Coordinator:
 
     # -- node membership (leader side) ------------------------------------
 
-    def add_node(self, node_id: str, info: dict):
+    def add_node(self, node_id: str, info: dict):  # actuator-ok (membership primitive; callers audit)
         """Leader: admit a node; master-eligible joiners grow the voting
         configuration (dynamic reconfiguration)."""
         def update(state: ClusterState) -> ClusterState:
             nodes = dict(state.nodes)
             nodes[node_id] = info
             return allocate_shards(state.with_(
-                nodes=nodes, voting=self._reconfigure(nodes)))
+                nodes=nodes, voting=self._reconfigure(nodes)),
+                rank=self.rank_fn)
         self.submit_state_update(update)
 
-    def remove_node(self, node_id: str):
+    def remove_node(self, node_id: str):  # actuator-ok (membership primitive; callers audit)
         def update(state: ClusterState) -> ClusterState:
             if node_id not in state.nodes:
                 return state
             nodes = dict(state.nodes)
             del nodes[node_id]
             return allocate_shards(state.with_(
-                nodes=nodes, voting=self._reconfigure(nodes)))
+                nodes=nodes, voting=self._reconfigure(nodes)),
+                rank=self.rank_fn)
         self.submit_state_update(update)
 
     # -- publication ------------------------------------------------------
@@ -492,7 +498,7 @@ class Coordinator:
                 is_follower=self.mode == Mode.FOLLOWER,
                 applied_version=self.committed.version)
 
-    def _on_follower_failure(self, peer: str, reason: str):
+    def _on_follower_failure(self, peer: str, reason: str):  # actuator-ok (fault eviction, not a policy decision)
         """FollowerChecker verdict: publish a state removing the node
         (allocate_shards promotes its replicas on the way out)."""
         try:

@@ -226,6 +226,20 @@ class ClusterNode:
             load_provider=self._load_stats,
             on_node_load=self.response_collector.record_ping_load,
             health_provider=lambda: self.fs_health.healthy)
+        # C3 ranks into write routing: allocation closures executed by
+        # this node (as leader) break least-loaded ties with the local
+        # collector's health evidence
+        self.coordinator.rank_fn = self.response_collector.rank
+        # QoS-driven searcher elasticity: the leader-side control loop
+        # from admission/Retry-After evidence to fleet mutation
+        # (cluster/autoscaler.py; inert until cluster.autoscale.enabled
+        # and a provisioner is wired by the environment)
+        from opensearch_tpu.cluster.autoscaler import SearcherAutoscaler
+        self.autoscaler = SearcherAutoscaler(
+            self.coordinator,
+            admission=self.search_backpressure.admission,
+            collector=self.response_collector,
+            qos=self.qos)
         # (index, shard) -> "primary" | "replica" as applied locally
         self._roles: dict[tuple, str] = {}
         # (index, shard) replica copies that completed peer recovery in
@@ -299,6 +313,8 @@ class ClusterNode:
 
     # -- state application (IndicesClusterStateService analog) ------------
 
+    # remove_node below is the C3 stats tombstone, not fleet membership;
+    # actuator-ok (reacting to a membership change its committer audited)
     def _apply_state(self, state: ClusterState, recover: bool = True):
         # handshake newly-seen peers in the background: the negotiated
         # protocol version is cached per peer and an incompatible major
@@ -568,7 +584,7 @@ class ClusterNode:
         ckpt = engine.checkpoint_info()
         return {"ckpt": ckpt, "blobs": engine.segments_blobs(ckpt["segments"])}
 
-    def _h_shard_recovered(self, payload: dict) -> dict:
+    def _h_shard_recovered(self, payload: dict) -> dict:  # actuator-ok (in-sync bookkeeping, not fleet/QoS actuation)
         index, shard, node = (payload["index"], payload["shard"],
                               payload["node"])
 
@@ -586,7 +602,7 @@ class ClusterNode:
         self.coordinator.submit_state_update(update)
         return {"acknowledged": True}
 
-    def _h_fail_copy(self, payload: dict) -> dict:
+    def _h_fail_copy(self, payload: dict) -> dict:  # actuator-ok (fault eviction of a shard copy, not a policy decision)
         """Master: drop a failed shard copy from the group and
         re-allocate a replacement (ReplicationOperation's fail-shard call
         to the cluster manager).  A failed PRIMARY (corruption) promotes
@@ -622,12 +638,14 @@ class ClusterNode:
                                     [promo] + e["replicas"])]
                 e["primary_term"] = int(e.get("primary_term", 1)) + 1
                 e.pop("corrupted", None)
-                return allocate_shards(state.with_(routing=routing))
+                return allocate_shards(state.with_(routing=routing),
+                                       rank=self.response_collector.rank)
             if node not in (e.get("replicas") or []):
                 return state
             e["replicas"] = [r for r in e["replicas"] if r != node]
             e["in_sync"] = [n for n in e["in_sync"] if n != node]
-            return allocate_shards(state.with_(routing=routing))
+            return allocate_shards(state.with_(routing=routing),
+                                   rank=self.response_collector.rank)
         self.coordinator.submit_state_update(update)
         # a permanently-failed copy releases its retention lease so the
         # primary's translog can trim again (RetentionLease expiry)
@@ -680,7 +698,7 @@ class ClusterNode:
                                {"index": name,
                                 "settings": settings or {}})
 
-    def _h_update_settings(self, payload: dict) -> dict:
+    def _h_update_settings(self, payload: dict) -> dict:  # actuator-ok (operator-initiated settings, not fleet/QoS actuation)
         from opensearch_tpu.common.errors import IllegalArgumentError
 
         name = payload["index"]
@@ -699,11 +717,12 @@ class ClusterNode:
             meta = dict(indices[name])
             meta["settings"] = {**(meta.get("settings") or {}), **ups}
             indices[name] = meta
-            return allocate_shards(state.with_(indices=indices))
+            return allocate_shards(state.with_(indices=indices),
+                                   rank=self.response_collector.rank)
         self.coordinator.submit_state_update(update)
         return {"acknowledged": True}
 
-    def _h_create_index(self, payload: dict) -> dict:
+    def _h_create_index(self, payload: dict) -> dict:  # actuator-ok (operator-initiated metadata, not fleet/QoS actuation)
         from opensearch_tpu.common.errors import IndexAlreadyExistsError
 
         name = payload["index"]
@@ -718,11 +737,12 @@ class ClusterNode:
             indices = dict(state.indices)
             indices[name] = {"settings": settings,
                              "mappings": body.get("mappings")}
-            return allocate_shards(state.with_(indices=indices))
+            return allocate_shards(state.with_(indices=indices),
+                                   rank=self.response_collector.rank)
         self.coordinator.submit_state_update(update)
         return {"acknowledged": True, "index": name}
 
-    def _h_delete_index(self, payload: dict) -> dict:
+    def _h_delete_index(self, payload: dict) -> dict:  # actuator-ok (operator-initiated metadata, not fleet/QoS actuation)
         name = payload["index"]
 
         def update(state: ClusterState) -> ClusterState:
@@ -1400,7 +1420,7 @@ class ClusterNode:
             with self._lock:
                 self._recovering.discard((index, shard))
 
-    def _h_search_shard_ready(self, payload: dict) -> dict:
+    def _h_search_shard_ready(self, payload: dict) -> dict:  # actuator-ok (in-sync bookkeeping, not fleet/QoS actuation)
         """Master: a search replica finished its remote-store refill —
         admit it to the shard group's ``search_in_sync`` serving set."""
         index, shard, node = (payload["index"], payload["shard"],
@@ -1471,6 +1491,7 @@ class ClusterNode:
                                     "fetches", "bytes_pulled",
                                     "corrupt_blobs", "refills",
                                     "refill_failures")},
+            "autoscale": self.autoscaler.stats(),
         }
 
     # -- task cancellation propagation -------------------------------------
@@ -1684,6 +1705,9 @@ class ClusterNode:
         tenant = (outer.headers.get("X-Opaque-Id")
                   if outer is not None else None)
         self.qos.maybe_tick()
+        # the elasticity loop ticks on the same cadence source as QoS:
+        # traffic (no background thread — deterministic under the soak)
+        self.autoscaler.maybe_tick()
         with self.search_backpressure.admission.acquire("search",
                                                         tenant=tenant):
             return self._search_admitted(index, body, allow_partial,
@@ -2264,6 +2288,7 @@ class ClusterNode:
 
     def start(self):
         self.coordinator.start()
+        self.autoscaler.start()
         # duress must be detected BETWEEN admissions too: the monitor
         # thread evaluates the trackers on a cadence even when no new
         # searches arrive to tick them (previously admission-path-only,
@@ -2294,6 +2319,7 @@ class ClusterNode:
             self._node_stopped = True
         # bounded join (stop_monitor joins with a timeout): node teardown
         # must never hang on the backpressure monitor thread
+        self.autoscaler.stop()
         self.search_backpressure.stop_monitor()
         self.fs_health.stop_probe()
         # quiesce the (process-global) query-engine workers with a

@@ -187,7 +187,8 @@ def _shards_per_node_cap(index_settings: dict):
     return None if v is None else int(v)
 
 
-def allocate_shards(state: ClusterState) -> ClusterState:
+def allocate_shards(state: ClusterState, *,
+                    rank=None) -> ClusterState:
     """Shard-group allocation over data nodes — the BalancedShardsAllocator
     + in-sync-promotion logic at the fidelity this needs:
 
@@ -207,14 +208,31 @@ def allocate_shards(state: ClusterState) -> ClusterState:
       never hold write copies, start OUTSIDE ``search_in_sync`` and
       join it when their remote-store refill completes.  Write copies
       (primary/replicas) are conversely never placed on search-only
-      nodes.
+      nodes;
+    - a node marked ``draining`` (the autoscaler's retirement marker)
+      is excluded from the searcher pool, so committing the marker
+      vacates its ``search_replicas``/``search_in_sync`` slots in the
+      same state update;
+    - ``rank`` (optional ``node_id -> float|None``, the C3 collector's
+      adaptive rank) breaks least-loaded ties when filling write-copy
+      holes: among equally-loaded candidates the healthiest
+      (lowest-ranked) node wins.  With no samples every rank is None
+      and the routing table is byte-identical to the legacy order.
     """
     node_ids = sorted(n for n, info in state.nodes.items()
                       if "data" in node_roles(info))
     search_nodes = sorted(n for n, info in state.nodes.items()
-                          if "search" in node_roles(info))
+                          if "search" in node_roles(info)
+                          and not (info or {}).get("draining"))
     if not node_ids:
         return state
+
+    def health(n):
+        if rank is None:
+            return 0.0
+        r = rank(n)
+        return float("inf") if r is None else float(r)
+
     counts = {n: 0 for n in node_ids}
     s_counts = {n: 0 for n in search_nodes}
     routing: dict = {}
@@ -301,7 +319,8 @@ def allocate_shards(state: ClusterState) -> ClusterState:
                 cands = [n for n in sorted(counts) if allowed(n, set())]
                 if not cands:
                     cands = sorted(counts)  # a primary MUST live somewhere
-                target = min(cands, key=lambda n: counts[n])
+                target = min(cands,
+                             key=lambda n: (counts[n], health(n), n))
                 e["primary"] = target
                 counts[target] += 1
                 e["in_sync"] = []              # fresh shard: no history
@@ -311,7 +330,8 @@ def allocate_shards(state: ClusterState) -> ClusterState:
                          if allowed(n, holders)]
                 if not cands:
                     break
-                target = min(cands, key=lambda n: counts[n])
+                target = min(cands,
+                             key=lambda n: (counts[n], health(n), n))
                 e["replicas"].append(target)
                 holders.add(target)
                 counts[target] += 1

@@ -88,6 +88,7 @@ class Node:
             "indices:data/write/reindex", self.rest._do_reindex)
         self.http = HttpServer(self.rest, host=host, port=port)
 
+    # actuator-ok (knob writes replay operator-set settings at boot)
     def _init_cluster_settings(self):
         """Dynamic cluster-settings registry + persistence
         (ClusterSettings / the _cluster/settings update API; consumers
@@ -229,6 +230,29 @@ class Node:
         req_cache_size = Setting.byte_size_setting(
             "indices.requests.cache.size", DEFAULT_MAX_BYTES,
             dynamic=True)
+        # QoS-driven searcher elasticity (cluster/autoscaler.py): the
+        # leader's control loop from admission/Retry-After evidence to
+        # fleet mutation — enable gate, fleet bounds, the dwell window
+        # hot/cold evidence must persist before an actuation, the
+        # anti-flap cooldown between scale events, and the drain
+        # deadline past which retirement escalates to hard-kill
+        as_enabled = Setting.bool_setting(
+            "cluster.autoscale.enabled", False, dynamic=True)
+        as_min = Setting.int_setting(
+            "cluster.autoscale.min_searchers", 1, min_value=0,
+            dynamic=True)
+        as_max = Setting.int_setting(
+            "cluster.autoscale.max_searchers", 4, min_value=0,
+            dynamic=True)
+        as_dwell = Setting.float_setting(
+            "cluster.autoscale.dwell_s", 3.0, min_value=0.0,
+            dynamic=True)
+        as_cooldown = Setting.float_setting(
+            "cluster.autoscale.cooldown_s", 10.0, min_value=0.0,
+            dynamic=True)
+        as_drain_timeout = Setting.float_setting(
+            "cluster.autoscale.drain_timeout_s", 5.0, min_value=0.0,
+            dynamic=True)
         self.cluster_settings = SettingsRegistry(
             Settings(stored),
             [max_buckets, auto_create, max_scroll, cache_size,
@@ -241,7 +265,9 @@ class Node:
              ins_coalesce, device_budget, dh_enabled, dh_threshold,
              dh_interval, batcher_enabled,
              batcher_window, batcher_max, qos_shares,
-             qos_default_share, qos_adaptive, qos_interval])
+             qos_default_share, qos_adaptive, qos_interval,
+             as_enabled, as_min, as_max, as_dwell, as_cooldown,
+             as_drain_timeout])
         # per-tenant QoS knobs reach the live admission gate and the
         # controller immediately; persisted values replay at boot
         adm = self.search_backpressure.admission
@@ -270,6 +296,22 @@ class Node:
             self.cluster_settings.add_settings_update_consumer(
                 setting, _apply_eng)
             _apply_eng(self.cluster_settings.get(setting))
+        # autoscale knobs land on the autoscaler module globals: every
+        # SearcherAutoscaler instance without a pinned override reads
+        # them at tick time, so dynamic updates apply live
+        from opensearch_tpu.cluster import autoscaler as asc_mod  # actuator-ok (operator-set knobs; the autoscaler audits its own decisions)
+        for setting, attr, conv in (
+                (as_enabled, "AUTOSCALE_ENABLED", bool),
+                (as_min, "MIN_SEARCHERS", int),
+                (as_max, "MAX_SEARCHERS", int),
+                (as_dwell, "DWELL_S", float),
+                (as_cooldown, "COOLDOWN_S", float),
+                (as_drain_timeout, "DRAIN_TIMEOUT_S", float)):
+            def _apply_asc(v, attr=attr, conv=conv):
+                setattr(asc_mod, attr, conv(v))
+            self.cluster_settings.add_settings_update_consumer(
+                setting, _apply_asc)
+            _apply_asc(self.cluster_settings.get(setting))
         # device-memory budget reaches the residency ledger immediately
         # (and persisted values replay at boot)
         from opensearch_tpu.common.device_ledger import device_ledger

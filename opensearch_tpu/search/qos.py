@@ -446,6 +446,14 @@ class QosController:
             detail=dict(rec))
         return rec
 
+    def record_adaptation(self, knob: str, old, new, evidence: dict,
+                          tenant: Optional[str] = None) -> dict:
+        """Public audit-ring append for external controllers that act
+        on QoS evidence (the searcher autoscaler): same record shape,
+        same ring, same flight-recorder capture — one audit surface for
+        every adaptive decision in the system."""
+        return self._record(knob, old, new, evidence, tenant=tenant)
+
     def audit(self, limit: int = 64) -> list[dict]:
         """Most recent adaptation records, newest first."""
         with self._lock:

@@ -41,6 +41,7 @@ harness exists to catch.
 from __future__ import annotations
 
 import math
+import os
 import random
 import threading
 import time
@@ -650,3 +651,205 @@ def run_latency_under_load(data_path: str, *, seed: int = 42,
         return result
     finally:
         node.stop()
+
+
+# -- elasticity sweep (PR 17: autoscaling moves the curve) ------------------
+
+def _tier_search_pack(index: str = "tier", tenant: str = "tenant-sweep",
+                      vocab: int = 7) -> ScenarioPack:
+    """Single seeded lexical pack over the cluster tier's corpus
+    (``build``-style docs carry ``body: hello t{i % 7}``)."""
+    def gen(rng: random.Random, n: int) -> list:
+        return [{"op": "search", "index": index,
+                 "body": {"query": {"match":
+                                    {"body": f"t{rng.randrange(vocab)}"}},
+                          "size": 3}}
+                for _ in range(n)]
+    return ScenarioPack("search", tenant, 1.0, "flat", gen)
+
+
+def _fleet_executor(leader, index: str) -> Callable:
+    """Execute ops against an in-process ClusterNode coordinator under
+    a registered tenant task (the X-Opaque-Id threading the REST edge
+    performs), mapping admission 429s to the harness outcome dict."""
+    from opensearch_tpu.common import tasks as taskmod
+    from opensearch_tpu.common.errors import OpenSearchTpuError
+
+    def execute(op: dict, tenant: str) -> dict:
+        task = leader.task_manager.register(
+            "rest:loadgen", f"[{tenant}]",
+            headers={"X-Opaque-Id": tenant})
+        token = taskmod.set_current(task)
+        try:
+            out = leader.search(op.get("index") or index,
+                                dict(op.get("body") or {}))
+            shards = out.get("_shards") or {}
+            return {"status": 200,
+                    "partial": bool(shards.get("failed"))}
+        except OpenSearchTpuError as exc:
+            return {"status": int(getattr(exc, "status", 500) or 500),
+                    "retry_after": getattr(exc, "retry_after_seconds",
+                                           None)}
+        finally:
+            taskmod.reset_current(token)
+            leader.task_manager.unregister(task)
+    return execute
+
+
+def _elastic_fleet(root: str, *, service_delay_s: float,
+                   n_docs: int = 21, fault_seed: int = 7) -> dict:
+    """One data/master node + one searcher over a shared remote store,
+    with every searcher's shard query phase delayed by
+    ``service_delay_s`` (the fault injector's adaptive-replica-
+    selection scenario) so admission concurrency — not CPU — is the
+    binding capacity.  Returns a ctx dict whose ``build`` closure the
+    autoscaler's provision hook reuses for elastic searchers."""
+    from opensearch_tpu.cluster.node import ClusterNode
+    from opensearch_tpu.testing.fault_injection import FaultInjector
+    from opensearch_tpu.transport.service import (LocalTransport,
+                                                  TransportService)
+
+    hub = LocalTransport.Hub()
+    remote = os.path.join(root, "remote")
+
+    def build(nid: str, roles: tuple):
+        svc = TransportService(nid, LocalTransport(hub))
+        node = ClusterNode(nid, os.path.join(root, nid), svc, ["n0"],
+                           roles=roles, remote_store_path=remote)
+        # scheduled delays only: a loaded CI host's real CPU probe must
+        # not leak nondeterminism into the capacity model
+        node.search_backpressure.trackers["cpu_usage"].probe = \
+            lambda: 0.0
+        node.search_rpc_timeout = 2.0
+        node.recovery_timeout = 5.0
+        return node
+
+    nodes = {"n0": build("n0", ("master", "data")),
+             "s0": build("s0", ("search",))}
+    leader = nodes["n0"]
+    if not leader.start_election():
+        raise RuntimeError("loadgen fleet: election failed")
+    leader.coordinator.add_node("s0", {"name": "s0",
+                                       "roles": ["search"],
+                                       "master_eligible": False})
+    leader.create_index("tier", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0,
+                     "number_of_search_replicas": 1},
+        "mappings": {"properties": {"body": {"type": "text"}}}})
+
+    def ready() -> bool:
+        routing = leader.coordinator.state().routing.get("tier", [])
+        return bool(routing) and all(
+            len(e.get("search_replicas") or []) >= 1
+            and set(e.get("search_replicas") or [])
+            == set(e.get("search_in_sync") or []) for e in routing)
+
+    deadline = time.monotonic() + 10.0
+    while not ready():                       # deadline
+        if time.monotonic() > deadline:
+            raise RuntimeError("loadgen fleet: searcher never ready")
+        time.sleep(0.02)                     # deadline
+    for i in range(n_docs):
+        leader.index_doc("tier", str(i), {"body": f"hello t{i % 7}"})
+    leader.refresh("tier")
+    deadline = time.monotonic() + 10.0
+    while nodes["s0"].search_lag() != 0:     # deadline
+        if time.monotonic() > deadline:
+            raise RuntimeError("loadgen fleet: searcher catch-up")
+        time.sleep(0.02)                     # deadline
+    faults = FaultInjector(hub, seed=fault_seed)
+    faults.slow_search_node("s0", service_delay_s)
+    return {"hub": hub, "nodes": nodes, "leader": leader,
+            "faults": faults, "build": build}
+
+
+def run_autoscale_sweep(data_path: str, *, seed: int = 42,
+                        points=(8, 40, 70, 100),
+                        duration_s: float = 1.5,
+                        per_searcher: int = 8,
+                        max_searchers: int = 3,
+                        service_delay_s: float = 0.1) -> dict:
+    """The elasticity curve (ROADMAP item 5): run the SAME offered-load
+    ramp twice — searcher fleet pinned at min vs the QoS-driven
+    autoscaler closing the loop — and compare ``max_sustainable_qps``.
+
+    The capacity model: every search holds a coordinator admission
+    permit for ~``service_delay_s`` (the injected searcher delay), so
+    sustainable throughput is ``max_concurrent / service_delay_s`` and
+    the autoscaler's ``concurrency_per_searcher`` link converts fleet
+    size into admission capacity.  Pinned, the ramp's upper points
+    saturate the permit pool and reject; autoscaled, admission
+    occupancy goes hot past the dwell window mid-ramp, the fleet grows
+    toward ``max_searchers``, and the later points clear.  429s are
+    terminal here (``retry_limit=0``) so saturation shows up as
+    rejected outcomes, not retry-shifted latency."""
+    results: dict = {}
+    for mode in ("pinned", "autoscaled"):
+        ctx = _elastic_fleet(os.path.join(data_path, mode),
+                             service_delay_s=service_delay_s)
+        leader, nodes, faults = (ctx["leader"], ctx["nodes"],
+                                 ctx["faults"])
+        asc = leader.autoscaler
+        adm = leader.search_backpressure.admission
+        adm.max_concurrent = per_searcher
+        if mode == "autoscaled":
+            asc.enabled = True
+            asc.min_searchers = 1
+            asc.max_searchers = max_searchers
+            asc.dwell_s = 0.15
+            asc.cooldown_s = 0.4
+            asc.drain_timeout_s = 2.0
+            asc.interval_s = 0.04
+            # occupancy rides a fast instantaneous signal here; a low
+            # hot threshold keeps the dwell streak robust to sampling
+            asc.hot_occupancy = 0.3
+            asc.cold_occupancy = 0.0
+            asc.concurrency_per_searcher = per_searcher
+
+            def provision(nid: str, _ctx=ctx) -> dict:
+                node = _ctx["build"](nid, ("search",))
+                _ctx["nodes"][nid] = node
+                _ctx["faults"].slow_search_node(nid, service_delay_s)
+                return {"name": nid, "roles": ["search"],
+                        "master_eligible": False}
+            asc.provision = provision
+            asc.resolve = nodes.get
+            asc.on_retired = lambda nid: nodes.pop(nid, None)
+        else:
+            asc.enabled = False
+        try:
+            runner = LoadgenRunner(
+                [_tier_search_pack()], _fleet_executor(leader, "tier"),
+                seed=seed, duration_s=duration_s, retry_limit=0)
+            res = runner.sweep(points)
+            res["autoscale"] = asc.stats()
+            res["audit"] = [r for r in leader.qos.audit(50)
+                            if str(r.get("knob", ""))
+                            .startswith("autoscale.")]
+            results[mode] = res
+        finally:
+            for n in list(nodes.values()):
+                n.stop()
+    pinned_max = results["pinned"]["packs"]["search"][
+        "max_sustainable_qps"]
+    auto_max = results["autoscaled"]["packs"]["search"][
+        "max_sustainable_qps"]
+    ups = results["autoscaled"]["autoscale"]["scale_ups"]
+    audited = len(results["autoscaled"]["audit"])
+    verdicts = [
+        {"slo": "autoscale_raises_max_sustainable_qps",
+         "limit": pinned_max, "observed": auto_max,
+         "ok": auto_max > pinned_max},
+        {"slo": "autoscale_scale_up_fired", "limit": 1,
+         "observed": ups, "ok": ups >= 1},
+        {"slo": "autoscale_decisions_audited", "limit": 1,
+         "observed": audited, "ok": audited >= 1},
+    ]
+    return {"seed": seed, "points": list(points),
+            "duration_s": duration_s,
+            "pinned": results["pinned"],
+            "autoscaled": results["autoscaled"],
+            "max_sustainable_qps": {"pinned": pinned_max,
+                                    "autoscaled": auto_max},
+            "verdicts": verdicts,
+            "slo_ok": all(v["ok"] for v in verdicts)}

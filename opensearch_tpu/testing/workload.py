@@ -116,6 +116,7 @@ class SoakConfig:
                  faults_enabled: bool = True,
                  control_run: bool = True,
                  device_faults: bool = False,
+                 autoscale: bool = False,
                  schedule: Optional[list] = None,
                  slos: Optional[dict] = None):
         self.seed = int(seed)
@@ -143,6 +144,11 @@ class SoakConfig:
         # device_heal directives (testing/fault_injection.py
         # DeviceFaultInjector + common/device_health.py breakers)
         self.device_faults = bool(device_faults)
+        # elasticity class: the leader gets a SearcherAutoscaler on an
+        # injectable clock (advanced only by the scale_up_pressure /
+        # scale_down_idle directives, so ticks are deterministic) wired
+        # to provision/retire soak searcher nodes
+        self.autoscale = bool(autoscale)
         self.client = client
         self.concurrency = int(concurrency)
         self.search_rpc_timeout = float(search_rpc_timeout)
@@ -185,6 +191,27 @@ class SoakConfig:
         base = {"search_replicas": 2, "searcher_ids": ("s0", "s1")}
         base.update(overrides)
         return cls(**base)
+
+    @classmethod
+    def autoscale_churn(cls, **overrides) -> "SoakConfig":
+        """The elasticity scenario: one seed searcher, the autoscaler
+        on the leader (= the client, so admission evidence and
+        actuation share a node), and an explicit schedule driving one
+        hot window (held admission permits past the dwell) and one idle
+        window.  SLOs require >= 1 audited scale-up and >= 1
+        drain-complete retirement with the standard p99 / unexpected-
+        error / convergence bounds holding across both transitions."""
+        base = {"search_replicas": 1, "searcher_ids": ("s0",),
+                "client": "n0", "autoscale": True, "n_ops": 32,
+                "schedule": [
+                    {"step": 8, "fault": "scale_up_pressure"},
+                    {"step": 20, "fault": "scale_down_idle"},
+                ]}
+        base.update(overrides)
+        cfg = cls(**base)
+        cfg.slos.setdefault("require_scale_up", True)
+        cfg.slos.setdefault("require_drain_complete", True)
+        return cfg
 
     @classmethod
     def device(cls, **overrides) -> "SoakConfig":
@@ -603,15 +630,28 @@ class SoakRunner:
                 ctx["searchers"])))
             ctx["applied"][-1]["node"] = victim
             if victim in nodes:
-                nodes[victim].stop()
-                nodes.pop(victim)
+                # drain-safe retirement through the ONE sanctioned path
+                # (cluster/autoscaler.py): the victim leaves the C3
+                # candidate sets and search_in_sync BEFORE it stops, so
+                # no late scatter burns a failover attempt on a dead
+                # searcher
+                from opensearch_tpu.cluster.autoscaler import \
+                    retire_searcher
+                leader = nodes[ctx["leader"]]
+                res = retire_searcher(
+                    leader.coordinator, victim,
+                    collector=leader.response_collector,
+                    node=nodes[victim],
+                    drain_timeout_s=d.get("drain_timeout_s", 5.0),
+                    audit=leader.qos.record_adaptation,
+                    rank=leader.response_collector.rank)
+                nodes.pop(victim, None)
                 ctx["searchers"].discard(victim)
-                # the leader's checks evict the dead searcher; the
-                # surviving searcher keeps serving, traffic never stops
-                self._evict(ctx, victim)
+                ctx["applied"][-1]["drain"] = res
                 self._wait(lambda: self._searchers_ready(ctx),
                            timeout=30.0,
-                           what="tier rebalance after searcher kill")
+                           what="tier rebalance after searcher "
+                                "retirement")
                 _bump(ctx, "recoveries")
         elif fault == "add_searcher":
             nid = d["node"]
@@ -629,6 +669,10 @@ class SoakRunner:
                        timeout=30.0,
                        what=f"remote refill of fresh searcher [{nid}]")
             _bump(ctx, "recoveries")
+        elif fault == "scale_up_pressure":
+            self._scale_up_pressure(ctx, d)
+        elif fault == "scale_down_idle":
+            self._scale_down_idle(ctx, d)
         elif fault == "device_slow":
             self._devfaults(ctx).slow_device(d.get("seconds", 0.02),
                                              times=d.get("times"))
@@ -756,6 +800,100 @@ class SoakRunner:
         self._wait(lambda: self._in_sync_full(nodes, ctx["leader"]),
                    timeout=30.0,
                    what=f"re-recovery after corrupting [{victim}]")
+        _bump(ctx, "recoveries")
+
+    # -- elasticity directives ---------------------------------------------
+
+    def _wire_autoscaler(self, ctx: dict) -> None:
+        """Attach the leader's autoscaler to the harness: fake clock
+        (advanced only by the scale directives — ticks from the search
+        path see a frozen clock and stay pure evidence updates),
+        provisioner/resolver over the soak's in-process node map, and
+        bounds pinned per-instance so global knobs stay untouched."""
+        nodes = ctx["nodes"]
+        asc = nodes[ctx["leader"]].autoscaler
+        clock = {"t": 0.0}
+        asc.clock = lambda: clock["t"]
+        asc.enabled = True
+        asc.min_searchers = max(1, len(ctx["searchers"]))
+        asc.max_searchers = asc.min_searchers + 2
+        asc.dwell_s = 2.0
+        asc.cooldown_s = 5.0
+        asc.drain_timeout_s = 5.0
+
+        def provision(nid: str) -> dict:
+            node = self._build_node(ctx["hub"], nid, ctx["root"],
+                                    roles=("search",))
+            nodes[nid] = node
+            ctx["searchers"].add(nid)
+            return self._searcher_info(nid)
+
+        def retired(nid: str) -> None:
+            nodes.pop(nid, None)
+            ctx["searchers"].discard(nid)
+
+        asc.provision = provision
+        asc.resolve = nodes.get
+        asc.on_retired = retired
+        ctx["scale_clock"] = clock
+        ctx["autoscaler"] = asc
+
+    def _scale_up_pressure(self, ctx: dict, d: dict) -> None:
+        """Hold admission permits as a hot tenant until occupancy
+        evidence crosses the scale-up threshold, advance the fake clock
+        past the dwell, and let the autoscaler provision + admit a
+        fresh searcher — then wait for its remote refill so SLOs are
+        measured THROUGH the transition."""
+        import contextlib as _ctl
+        nodes = ctx["nodes"]
+        asc = ctx["autoscaler"]
+        clock = ctx["scale_clock"]
+        adm = nodes[ctx["leader"]].search_backpressure.admission
+        tenant = d.get("tenant", "tenant-hot")
+        permits = int(d.get("permits") or adm.max_concurrent)
+        t0 = time.monotonic()
+        with _ctl.ExitStack() as stack:
+            for _ in range(permits):
+                stack.enter_context(
+                    adm.acquire("search", tenant=tenant))
+            asc.run_once()                      # hot evidence observed
+            clock["t"] += asc.dwell_s + 0.001   # dwell passes
+            decision = asc.run_once()           # actuation
+        if decision.get("action") != "scale_up":
+            raise SoakHarnessError(
+                f"scale_up_pressure did not scale: {decision}")
+        self._wait(lambda: self._searchers_ready(ctx), timeout=30.0,
+                   what="fresh autoscaled searcher refill")
+        ctx["applied"][-1].update(
+            node=decision.get("node"),
+            searchers=sorted(ctx["searchers"]),
+            time_to_scale_up_s=round(time.monotonic() - t0, 3))
+        _bump(ctx, "recoveries")
+
+    def _scale_down_idle(self, ctx: dict, d: dict) -> None:
+        """Advance the fake clock past the cooldown with zero admission
+        occupancy: the cold dwell (begun by the first post-scale-up
+        tick from the traffic path, or by this directive's first
+        evaluation) expires and the autoscaler retires the newest
+        autoscaled searcher through the drain protocol."""
+        asc = ctx["autoscaler"]
+        clock = ctx["scale_clock"]
+        t0 = time.monotonic()
+        clock["t"] += asc.cooldown_s + 0.001
+        decision = asc.run_once()
+        if decision.get("action") not in ("scale_down", "resume_drain"):
+            clock["t"] += asc.dwell_s + 0.001
+            decision = asc.run_once()
+        if decision.get("action") not in ("scale_down", "resume_drain"):
+            raise SoakHarnessError(
+                f"scale_down_idle did not drain: {decision}")
+        self._wait(lambda: self._searchers_ready(ctx), timeout=30.0,
+                   what="tier rebalance after autoscaled drain")
+        ctx["applied"][-1].update(
+            node=decision.get("node"),
+            drain=decision.get("drain"),
+            searchers=sorted(ctx["searchers"]),
+            drain_s=round(time.monotonic() - t0, 3))
         _bump(ctx, "recoveries")
 
     def _evict(self, ctx: dict, victim: str) -> None:
@@ -985,6 +1123,8 @@ class SoakRunner:
             if ctx["searchers"]:
                 self._wait(lambda: self._searchers_ready(ctx),
                            what="initial searcher refill")
+            if cfg.autoscale:
+                self._wire_autoscaler(ctx)
             for doc_id, source in workload.seed_docs():
                 nodes[ctx["client"]].index_doc(cfg.index, doc_id, source)
             nodes[ctx["client"]].refresh(cfg.index)
@@ -1075,6 +1215,25 @@ class SoakRunner:
                     nodes[ctx["client"]].insights.coalescability(),
                 "totals": nodes[ctx["client"]].insights.stats(),
             }
+            autoscale_report = None
+            if cfg.autoscale and ctx.get("autoscaler") is not None:
+                asc = ctx["autoscaler"]
+                audit = (nodes[ctx["leader"]].qos.audit(64)
+                         if ctx["leader"] in nodes else [])
+                scale_audit = [
+                    r for r in audit
+                    if str(r.get("knob", "")).startswith("autoscale.")]
+                autoscale_report = {
+                    "scale_ups": asc.scale_ups,
+                    "scale_downs": asc.scale_downs,
+                    "hard_kills": asc.hard_kills,
+                    "abandoned": asc.abandoned,
+                    "drains_completed":
+                        asc.scale_downs - asc.hard_kills,
+                    "decisions_audited": len(scale_audit),
+                    "audit": scale_audit[:8],
+                    "searchers_final": sorted(ctx["searchers"]),
+                }
         finally:
             disk = ctx.pop("disk", None)
             if disk is not None:     # exception path: unpatch open/fsync
@@ -1131,6 +1290,10 @@ class SoakRunner:
             # accelerator fault accounting (present only for device
             # soaks): breaker trips/states, sanity-guard discards, and
             # every degradation path's counters
+            # elasticity accounting (present only for autoscale soaks)
+            **({"autoscale": autoscale_report}
+               if cfg.autoscale and autoscale_report is not None
+               else {}),
             **({"device": {
                 **device_report,
                 "breaker_trips": delta("device.breaker.trips"),
@@ -1232,6 +1395,20 @@ class SoakRunner:
             verdicts.append({"slo": "device_poison_detected",
                              "limit": 1, "observed": poisoned,
                              "ok": poisoned >= 1})
+        auto = chaos.get("autoscale") or {}
+        if slos.get("require_scale_up"):
+            # >= 1 scale-up that ALSO appended to the audit ring — an
+            # unaudited fleet mutation fails the SLO even if it scaled
+            ups = int(auto.get("scale_ups", 0))
+            audited = int(auto.get("decisions_audited", 0))
+            verdicts.append({"slo": "autoscale_scale_up_audited",
+                             "limit": 1, "observed": min(ups, audited),
+                             "ok": ups >= 1 and audited >= 1})
+        if slos.get("require_drain_complete"):
+            done = int(auto.get("drains_completed", 0))
+            verdicts.append({"slo": "autoscale_drain_complete",
+                             "limit": 1, "observed": done,
+                             "ok": done >= 1})
         return verdicts
 
     def _capture_breaches(self, verdicts: list, chaos: dict) -> None:
@@ -1317,6 +1494,16 @@ def run_device_soak(data_path: Optional[str] = None,
     """One-call entry point for the accelerator-fault soak (bench.py's
     ``device_faults`` phase, tests/test_device_faults.py acceptance)."""
     return SoakRunner(data_path, SoakConfig.device(**overrides)).run()
+
+
+def run_autoscale_soak(data_path: Optional[str] = None,
+                       **overrides) -> dict:
+    """One-call entry point for the elasticity soak (bench.py's
+    ``autoscale`` phase, tests/test_autoscaler.py acceptance): hot-
+    tenant pressure scales the fleet up, the idle window drains it
+    back, SLOs hold through both transitions."""
+    return SoakRunner(
+        data_path, SoakConfig.autoscale_churn(**overrides)).run()
 
 
 # -- noisy-neighbor QoS scenario -------------------------------------------

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Lint: every fleet/QoS actuator must append to the audit ring.
+
+PR 14 gave adaptive decisions an audit ring (``QosController._record``)
+and PR 17 made the fleet itself an actuated surface (the searcher
+autoscaler).  The invariant worth linting: any function that mutates
+fleet membership (``add_node`` / ``remove_node`` / a raw
+``submit_state_update``) or adapts a QoS knob (assigns
+``SHED_OCCUPANCY`` / ``AUTO_WINDOW_MS`` on a module) must, in the same
+function, append to the audit ring (call ``_record`` /
+``record_adaptation`` / an ``audit`` / ``_audit`` hook) — otherwise the
+system changes its own topology or knobs with no evidence trail, and
+the next operator debugging a 3am scale event has nothing to read.
+
+Functions that are legitimately unaudited — membership *primitives*
+whose callers audit, operator-initiated admin handlers, fault-eviction
+paths — carry a ``# actuator-ok`` annotation on the ``def`` line (or a
+line above it), stating why.
+
+Scanned roots default to ``opensearch_tpu/cluster`` and
+``opensearch_tpu/search`` — the harness (``opensearch_tpu/testing``)
+IS the operator in its scenarios, so it is deliberately out of scope.
+
+Sibling of ``check_dead_settings.py``; unaudited actuators fail tier-1
+(tests/test_autoscaler.py runs this check).
+
+Usage: python tools/check_audited_actuators.py [path ...]  (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ANNOTATION = "# actuator-ok"
+
+#: calls (by attribute or bare name) that mutate fleet membership or
+#: publish a cluster-state change
+ACTUATOR_CALLS = {"add_node", "remove_node", "submit_state_update"}
+
+#: attribute targets whose assignment adapts a live QoS knob
+KNOB_TARGETS = {"SHED_OCCUPANCY", "AUTO_WINDOW_MS"}
+
+#: calls that append to the audit ring (directly or via a hook)
+AUDIT_CALLS = {"_record", "record_adaptation", "audit", "_audit"}
+
+
+def _call_name(node: ast.Call):
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _actuates(func: ast.AST) -> list[tuple[str, int]]:
+    """(what, lineno) for every actuator site inside ``func`` (not
+    descending into nested function defs — they are checked on their
+    own)."""
+    out = []
+    for node in _walk_shallow(func):
+        if isinstance(node, ast.Call):
+            name = _call_name(node)
+            if name in ACTUATOR_CALLS:
+                out.append((name, node.lineno))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets
+                       if isinstance(node, ast.Assign) else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Attribute) and t.attr in KNOB_TARGETS:
+                    out.append((t.attr, node.lineno))
+    return out
+
+
+def _audits(func: ast.AST) -> bool:
+    return any(isinstance(node, ast.Call)
+               and _call_name(node) in AUDIT_CALLS
+               for node in _walk_shallow(func))
+
+
+def _walk_shallow(func: ast.AST):
+    """Walk a function body without crossing into nested defs or
+    classes — a nested function is a distinct scope checked on its
+    own (a ``submit_state_update(update)`` closure's *call site* is in
+    the enclosing function, which is where the audit belongs)."""
+    for child in ast.iter_child_nodes(func):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            continue
+        yield child
+        yield from _walk_shallow(child)
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error ({e.msg})"]
+    lines = src.splitlines()
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        sites = _actuates(node)
+        if not sites or _audits(node):
+            continue
+        annotated = any(
+            ANNOTATION in lines[ln]
+            for ln in range(max(0, node.lineno - 2),
+                            min(len(lines), node.lineno)))
+        if annotated:
+            continue
+        what = ", ".join(sorted({w for w, _ in sites}))
+        problems.append(
+            f"{path}:{node.lineno}: [{node.name}] actuates "
+            f"[{what}] without appending to the audit ring — call "
+            "record_adaptation/_record (or an audit hook), or "
+            f"annotate '{ANNOTATION} (<why>)'")
+    return problems
+
+
+def _default_roots() -> list[str]:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return [os.path.join(repo, "opensearch_tpu", "cluster"),
+            os.path.join(repo, "opensearch_tpu", "search"),
+            os.path.join(repo, "opensearch_tpu", "node.py")]
+
+
+def main(argv: list[str]) -> int:
+    roots = argv[1:] or _default_roots()
+    problems = []
+    for root in roots:
+        if os.path.isfile(root):
+            problems.extend(check_file(root))
+            continue
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    problems.extend(check_file(
+                        os.path.join(dirpath, name)))
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} unaudited actuator(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
