@@ -25,7 +25,9 @@ synthesizes the final single JSON line from the phases file when the
 child times out.
 
 Env knobs: OSTPU_BENCH_DOCS (default 100000), OSTPU_BENCH_QUERIES (200),
-OSTPU_BENCH_BATCH (64), OSTPU_BENCH_PHASES (phases file path).
+OSTPU_BENCH_BATCH (64), OSTPU_BENCH_PHASES (phases file path),
+OSTPU_BENCH_SCALE_DOCS (default 1000000; the quantized paged-index
+phase), OSTPU_BENCH_SCALE_10M=1 (the 10M-doc point).
 """
 
 from __future__ import annotations
@@ -434,6 +436,15 @@ def main():
             phase_report("autoscale",
                          {"platform": platform,
                           "error": f"{type(e).__name__}: {e}"})
+
+    # -- phase: scale (1M-doc quantized paged index: footprint vs qps
+    # vs rank parity under a halved device budget, + open-loop sweep) -----
+    if os.environ.get("OSTPU_BENCH_SCALE", "1") != "0":
+        try:
+            run_scale_phase(platform)
+        except Exception as e:  # noqa: BLE001 — report, keep the bench
+            phase_report("scale", {"platform": platform,
+                                   "error": f"{type(e).__name__}: {e}"})
 
     # -- phase: soak (chaos SLO scenario over a 3-node cluster) -----------
     # runs LAST so a wedge here cannot cost the phases above; failures
@@ -1100,6 +1111,211 @@ def run_autoscale_phase(platform: str):
         "unexpected_errors": len(chaos["unexpected_errors"]),
     })
     return report
+
+
+def _scale_load_point(searcher, queries, rate_qps: float,
+                      duration_s: float) -> list:
+    """One open-loop offered-load point against an in-process searcher:
+    every request fires at its scheduled Poisson arrival and latency is
+    charged from that SCHEDULED instant (absolute, fixed before the
+    dispatch loop), so queue delay under overload counts against the
+    request that suffered it — no coordinated omission."""
+    import threading
+
+    from opensearch_tpu.testing.loadgen import arrival_schedule
+
+    sched = arrival_schedule(rate_qps, duration_s, seed=42)
+    lats, lock, threads = [], threading.Lock(), []
+    base = time.monotonic() + 0.01
+
+    def fire(scheduled_abs, q):
+        delay = scheduled_abs - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        searcher.search(dict(q))
+        with lock:
+            lats.append(time.monotonic() - scheduled_abs)
+
+    for i, off in enumerate(sched):
+        th = threading.Thread(
+            target=fire, args=(base + off, queries[i % len(queries)]),
+            daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=duration_s + 60)
+    return lats
+
+
+def run_scale_phase(platform: str):
+    """Quantized paged device index at the 1M-doc scale (ROADMAP item
+    2): footprint vs qps vs rank parity for the int8 + bit-packed
+    lowering (index/codec.py), measured under two device budgets — one
+    that fits the quantized tables but NOT the f32 tables, and one at
+    HALF the quantized footprint so the pager demonstrably pages
+    (misses/evictions/prefetches all nonzero).  The latency story is an
+    open-loop offered-qps sweep (``arrival_schedule``; latency charged
+    from the SCHEDULED arrival, so it is coordinated-omission-free like
+    the latency_under_load phase, pointed at this corpus instead of the
+    node-scale one).  ``OSTPU_BENCH_SCALE_DOCS`` sizes the corpus
+    (default 1M); ``OSTPU_BENCH_SCALE_10M=1`` runs the 10M point."""
+    import threading
+
+    from opensearch_tpu.common.device_ledger import (device_ledger,
+                                                     device_pager)
+    from opensearch_tpu.index import codec
+    from opensearch_tpu.mapping.mapper import DocumentMapper
+    from opensearch_tpu.ops import bm25 as bm25_ops
+    from opensearch_tpu.search.executor import ShardSearcher
+    from opensearch_tpu.testing.loadgen import arrival_schedule
+
+    n_docs = int(os.environ.get("OSTPU_BENCH_SCALE_DOCS", 1_000_000))
+    if os.environ.get("OSTPU_BENCH_SCALE_10M") == "1":
+        n_docs = 10_000_000
+    n_segments = int(os.environ.get("OSTPU_BENCH_SCALE_SEGMENTS", 8))
+    n_q = int(os.environ.get("OSTPU_BENCH_SCALE_QUERIES", 40))
+
+    t0 = time.monotonic()
+    raw = build_raw_corpus(n_docs, seed=42)
+    segs = make_segments(raw, n_segments)
+    mapper = DocumentMapper({"properties": {"body": {"type": "text"}}})
+    searcher = ShardSearcher(segs, mapper, index_name="bench_scale")
+    pairs = gen_query_terms(n_q, seed=11)
+    queries = [{"query": {"match": {"body": f"t{a} t{b}"}}, "size": 10}
+               for a, b in pairs]
+    build_s = time.monotonic() - t0
+    log(f"scale corpus: {n_docs} docs, {len(raw['doc_ids'])} postings, "
+        f"{n_segments} segments, {build_s:.1f}s")
+
+    led = device_ledger()
+    pager = device_pager()
+    # earlier phases (device, device_faults) leave residency and
+    # counters behind; the budget geometry below must describe THIS
+    # corpus only, so start from a forgotten ledger (their searchers
+    # are dead objects by now — nothing re-dispatches those groups)
+    led.reset()
+    prev_budget = led.budget_bytes
+    prev_host = bm25_ops.HOST_SCORING
+    prev_mode = codec.QUANTIZED_MODE
+    try:
+        # f32 reference ranking: host lowering with quantization off —
+        # computed from the f32 impact tables, no device staging at all
+        # (the host path never constructs a DeviceSegment), so the f32
+        # tables never have to fit on the device to get the reference
+        codec.QUANTIZED_MODE = "off"
+        bm25_ops.HOST_SCORING = True
+        ref = [[h["_id"] for h in
+                searcher.search(dict(q))["hits"]["hits"]]
+               for q in queries]
+
+        # the production "auto" policy quantizes segments at/above
+        # QUANTIZED_MIN_DOCS; force "on" only when an env-shrunk corpus
+        # drops below it (so small smoke runs still exercise the path)
+        codec.QUANTIZED_MODE = ("auto" if n_docs // n_segments
+                                >= codec.QUANTIZED_MIN_DOCS else "on")
+        avgdl = searcher.ctx.field_stats("body").avgdl
+        t0 = time.monotonic()
+        agg = {k: 0 for k in ("f32_bytes", "quant_bytes", "terms",
+                              "postings", "exact_terms",
+                              "exact_postings")}
+        width = 0
+        for seg in segs:
+            qt = seg.quantized_table("body", avgdl)
+            for k in agg:
+                agg[k] += int(qt.stats[k])
+            width = max(width, int(qt.width))
+        quantize_s = time.monotonic() - t0
+
+        bm25_ops.HOST_SCORING = False
+        for q in queries:                      # compile + stage warm
+            searcher.search(dict(q))
+        p0 = pager.stats()
+        quant_resident = int(p0["resident_bytes"])
+        total_resident = int(led.stats()["resident_bytes"])
+
+        # budget point A: exactly the quantized working set — fits the
+        # int8 tables but NOT the f32 tables (the acceptance geometry)
+        budget_fit = max(1, total_resident)
+        led.set_budget(budget_fit)
+        t0 = time.monotonic()
+        got = [[h["_id"] for h in
+                searcher.search(dict(q))["hits"]["hits"]]
+               for q in queries]
+        fit_s = time.monotonic() - t0
+        p_fit = pager.stats()
+        parity = sum(1 for a, b in zip(got, ref) if a == b)
+
+        # budget point B: half the quantized footprint — the pager must
+        # page (LRU-evict + demand-restage) to serve the same queries
+        led.set_budget(max(1, total_resident // 2))
+        t0 = time.monotonic()
+        got_half = [[h["_id"] for h in
+                     searcher.search(dict(q))["hits"]["hits"]]
+                    for q in queries]
+        half_s = time.monotonic() - t0
+        p_half = pager.stats()
+        led_half = led.stats()
+        parity_half = sum(1 for a, b in zip(got_half, ref) if a == b)
+
+        # open-loop offered-qps sweep at budget point A: every request
+        # fires at its scheduled Poisson arrival and latency is charged
+        # from that SCHEDULED instant (no coordinated omission)
+        led.set_budget(budget_fit)
+        points = [float(x) for x in os.environ.get(
+            "OSTPU_BENCH_SCALE_LOAD_QPS", "4,10,25").split(",")]
+        duration_s = float(os.environ.get(
+            "OSTPU_BENCH_SCALE_LOAD_DURATION", 4.0))
+        load = []
+        for rate in points:
+            lats = _scale_load_point(searcher, queries, rate, duration_s)
+            ms = np.asarray(lats, dtype=np.float64) * 1e3
+            load.append({
+                "offered_qps": rate, "n": len(lats),
+                "p50_ms": round(float(np.percentile(ms, 50)), 2)
+                if len(ms) else None,
+                "p99_ms": round(float(np.percentile(ms, 99)), 2)
+                if len(ms) else None,
+            })
+
+        data = {
+            "platform": platform, "n_docs": n_docs,
+            "n_segments": n_segments, "n_queries": n_q,
+            "build_s": round(build_s, 1),
+            "quantize_s": round(quantize_s, 1),
+            "dtype": codec.QUANTIZED_DTYPE, "pack_width_bits": width,
+            "f32_bytes": agg["f32_bytes"],
+            "quant_bytes": agg["quant_bytes"],
+            "compression_ratio": round(
+                agg["f32_bytes"] / agg["quant_bytes"], 2)
+            if agg["quant_bytes"] else None,
+            "quant_resident_bytes": quant_resident,
+            "device_resident_bytes": total_resident,
+            "exact_terms": agg["exact_terms"],
+            "exact_postings": agg["exact_postings"],
+            "terms": agg["terms"], "postings": agg["postings"],
+            "budget_fit_bytes": budget_fit,
+            "budget_fit_lt_f32": budget_fit < agg["f32_bytes"],
+            "qps_budget_fit": round(n_q / fit_s, 1) if fit_s else 0.0,
+            "rank_parity_fraction": round(parity / n_q, 3),
+            "budget_half_bytes": max(1, total_resident // 2),
+            "qps_budget_half": round(n_q / half_s, 1) if half_s
+            else 0.0,
+            "rank_parity_fraction_half": round(parity_half / n_q, 3),
+            "pager_prefetches": p_half["prefetches"],
+            "pager_hits": p_half["hits"],
+            "pager_misses": p_half["misses"],
+            "pager_evictions": p_half["evictions"],
+            "pager_misses_at_fit": p_fit["misses"],
+            "pager_resident_pages": p_half["resident_pages"],
+            "host_fallbacks": led_half["budget"]["host_fallbacks"],
+            "open_loop": load,
+        }
+        phase_report("scale", data)
+        return data
+    finally:
+        bm25_ops.HOST_SCORING = prev_host
+        codec.QUANTIZED_MODE = prev_mode
+        led.set_budget(prev_budget)
 
 
 def final_line(*, qps, baseline_qps, platform, extra=None):

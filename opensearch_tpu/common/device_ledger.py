@@ -180,10 +180,11 @@ class _Group:
 
     __slots__ = ("index", "shard", "segment", "entries", "staged_tick",
                  "dispatches", "last_dispatch_tick", "sealed",
-                 "evict_cb", "_gid", "__weakref__")
+                 "evict_cb", "evict_class", "_gid", "__weakref__")
 
     def __init__(self, index: str, shard, segment: str,
-                 evict_cb: Optional[Callable] = None):
+                 evict_cb: Optional[Callable] = None,
+                 evict_class: str = "segment"):
         self.index = index
         self.shard = shard
         self.segment = segment
@@ -193,6 +194,7 @@ class _Group:
         self.last_dispatch_tick = 0
         self.sealed = False                   # unsealed groups never evict
         self.evict_cb = evict_cb              # None -> not evictable
+        self.evict_class = evict_class        # "page" evicts before "segment"
 
     def nbytes(self) -> int:
         return sum(self.entries.values())
@@ -250,12 +252,17 @@ class DeviceResidencyLedger:
     # -- group lifecycle ---------------------------------------------------
 
     def open_group(self, *, index: str = "-", shard=0, segment: str = "-",
-                   evict: Optional[Callable] = None) -> _Group:
+                   evict: Optional[Callable] = None,
+                   evict_class: str = "segment") -> _Group:
         """New (unsealed) staging group.  ``evict`` is the unstage
         callback the budget enforcer may call; groups without one are
         accounted but never evicted (batch/mesh stagings whose lifetime
-        is owned by their caches)."""
-        g = _Group(index, shard, segment, evict_cb=evict)
+        is owned by their caches).  ``evict_class="page"`` marks a
+        cheap-to-restage group (the pager's quantized tables rebuild
+        from host codec tables, not from a full segment restage) —
+        budget enforcement spends pages before whole segments."""
+        g = _Group(index, shard, segment, evict_cb=evict,
+                   evict_class=evict_class)
         g.staged_tick = next(self._tick)
         gid = next(self._next_id)
         with self._lock:
@@ -420,8 +427,13 @@ class DeviceResidencyLedger:
                            and g is not protect]
                 if not victims:
                     return          # nothing evictable: stay over budget
+                # cheap-to-restage pages go before whole segments
+                # (a page rebuilds from host codec tables; a segment
+                # eviction forces host fallback or a full restage);
+                # within a class, least-recently-dispatched first
                 victim = min(victims,
-                             key=lambda g: (g.last_dispatch_tick,
+                             key=lambda g: (g.evict_class != "page",
+                                            g.last_dispatch_tick,
                                             g.staged_tick))
                 freed = victim.nbytes()
                 self.evictions += 1
@@ -495,6 +507,7 @@ class DeviceResidencyLedger:
                 "host_fallbacks": hf,
             },
             "transfers": transfers,
+            "pager": device_pager().stats(),
             "indices": dict(sorted(per_index.items())),
             "compile_registry": kernel_registry().counts(),
             "backend": _backend_memory_stats(),
@@ -528,6 +541,16 @@ class DeviceResidencyLedger:
             "# TYPE opensearch_tpu_device_resident_segments gauge",
             "opensearch_tpu_device_resident_segments "
             f"{s['resident_segments']}",
+            "# HELP opensearch_tpu_device_pager_resident_pages "
+            "Quantized-index pager resident pages",
+            "# TYPE opensearch_tpu_device_pager_resident_pages gauge",
+            "opensearch_tpu_device_pager_resident_pages "
+            f"{s['pager']['resident_pages']}",
+            "# HELP opensearch_tpu_device_pager_capacity_pages "
+            "Quantized-index pager page capacity (-1 = unlimited)",
+            "# TYPE opensearch_tpu_device_pager_capacity_pages gauge",
+            "opensearch_tpu_device_pager_capacity_pages "
+            f"{s['pager']['capacity_pages'] if s['pager']['capacity_pages'] is not None else -1}",
         ]
         lines.append(
             "# HELP opensearch_tpu_device_index_resident_bytes "
@@ -553,6 +576,217 @@ class DeviceResidencyLedger:
             for t in self._transfers.values():
                 t["bytes"] = t["ops"] = 0
                 t["seconds"] = 0.0
+        device_pager().reset()
+
+
+class _PageEntry:
+    """One pager residency unit: the staged device arrays of one
+    quantized (segment, field, avgdl) table set, accounted in fixed-size
+    pages."""
+
+    __slots__ = ("key", "arrays", "group", "nbytes", "pages",
+                 "last_use_tick")
+
+    def __init__(self, key, arrays, group, nbytes, pages, tick):
+        self.key = key
+        self.arrays = arrays
+        self.group = group
+        self.nbytes = nbytes
+        self.pages = pages
+        self.last_use_tick = tick
+
+
+class DevicePager:
+    """Host↔device pager for quantized segment groups (ROADMAP item 2's
+    paging half).
+
+    Quantized table sets (index/codec.py) are staged as fixed-size
+    *pages* under the same ``device.memory.budget_bytes`` the ledger
+    enforces: capacity is ``budget_bytes // page_bytes``; an ``acquire``
+    that doesn't fit evicts the least-recently-used resident entry
+    first (pager-level LRU — finer-grained and cheaper to restage than
+    whole-segment ledger eviction, because a quantized page rebuilds
+    from the host codec tables, not from a full segment restage).
+    ``prefetch`` stages ahead of the dispatch loop but only into FREE
+    pages — the prefetch oracle (per-term block-max score bounds, see
+    ``TermBagPlan.prefetch_quantized``) ranks what is worth staging; it
+    never thrashes demand-paged residents.
+
+    Every staging flows through the owning ledger, so pager pages also
+    show up in residency/transfer accounting, and the ledger's own
+    budget enforcement can evict a pager group like any other sealed
+    group (the pager is told via the evict callback and keeps its book
+    straight).  Miss/evict/prefetch counters feed ``_nodes/stats``
+    ``device.pager`` and ``/_metrics``.
+    """
+
+    DEFAULT_PAGE_BYTES = 1 << 20
+
+    def __init__(self, ledger: DeviceResidencyLedger):
+        self._led = ledger
+        self._lock = threading.Lock()
+        self.page_bytes = self.DEFAULT_PAGE_BYTES
+        self._entries: dict[tuple, _PageEntry] = {}
+        self._tick = itertools.count(1)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.evicted_pages = 0
+        self.prefetches = 0
+
+    def set_page_bytes(self, n) -> None:
+        """Dynamic ``device.pager.page_bytes`` consumer (0/None keeps
+        the default)."""
+        n = int(n) if n else 0
+        self.page_bytes = n if n > 0 else self.DEFAULT_PAGE_BYTES
+
+    def capacity_pages(self):
+        """None = unlimited (no device budget configured)."""
+        budget = self._led.budget_bytes
+        if budget is None:
+            return None
+        return max(1, budget // self.page_bytes)
+
+    def resident_pages(self) -> int:
+        with self._lock:
+            return sum(e.pages for e in self._entries.values())
+
+    def _pages_of(self, nbytes: int) -> int:
+        return max(1, -(-int(nbytes) // self.page_bytes))
+
+    def acquire(self, key, loader, *, index: str = "-", shard=0,
+                segment: str = "-"):
+        """Resident arrays for ``key``, staging (and evicting LRU pages
+        to fit) on miss.  ``loader()`` returns the host payload as a
+        list of ``(name, kind, np_array)``; the staged dict is keyed by
+        name."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                self.hits += 1
+                e.last_use_tick = next(self._tick)
+                group = e.group
+                arrays = e.arrays
+        if e is not None:
+            self._led.record_dispatch(group)
+            _metrics().counter("device.pager.hits").inc()
+            return arrays
+        with self._lock:
+            self.misses += 1
+        _metrics().counter("device.pager.misses").inc()
+        return self._stage(key, loader(), index=index, shard=shard,
+                           segment=segment, prefetched=False)
+
+    def prefetch(self, key, loader, nbytes_hint: int, *,
+                 index: str = "-", shard=0, segment: str = "-") -> bool:
+        """Stage ``key`` ahead of demand IF it fits in free pages —
+        prefetch never evicts a resident entry, so a bad oracle ranking
+        costs nothing but spare capacity.  Returns True when staged."""
+        cap = self.capacity_pages()
+        need = self._pages_of(nbytes_hint)
+        with self._lock:
+            if key in self._entries:
+                return False
+            if cap is not None:
+                free = cap - sum(e.pages for e in self._entries.values())
+                if free < need:
+                    return False
+        self._stage(key, loader(), index=index, shard=shard,
+                    segment=segment, prefetched=True)
+        return True
+
+    def _stage(self, key, items, *, index, shard, segment, prefetched):
+        field = key[3] if len(key) > 3 else ""
+        cb = lambda: self._on_ledger_evict(key)  # noqa: E731
+        group = self._led.open_group(index=index, shard=shard,
+                                     segment=segment, evict=cb,
+                                     evict_class="page")
+        arrays = {}
+        nbytes = 0
+        for name, kind, arr in items:
+            arrays[name] = self._led.stage(group, arr, kind=kind,
+                                           field=field, name=name)
+            nbytes += int(getattr(arr, "nbytes", 0))
+        pages = self._pages_of(nbytes)
+        entry = _PageEntry(key, arrays, group, nbytes, pages,
+                           next(self._tick))
+        evict_keys = []
+        with self._lock:
+            prior = self._entries.get(key)   # benign load race: keep ours
+            self._entries[key] = entry
+            cap = self.capacity_pages()
+            if cap is not None:
+                while sum(e.pages
+                          for e in self._entries.values()) > cap:
+                    victims = [e for e in self._entries.values()
+                               if e is not entry]
+                    if not victims:
+                        break                # one entry over capacity
+                    v = min(victims, key=lambda e: e.last_use_tick)
+                    del self._entries[v.key]
+                    self.evictions += 1
+                    self.evicted_pages += v.pages
+                    evict_keys.append(v)
+            if prefetched:
+                self.prefetches += 1
+        if prior is not None:
+            self._led.close_group(prior.group)
+        for v in evict_keys:
+            _metrics().counter("device.pager.evictions").inc()
+            self._led.close_group(v.group)
+        if prefetched:
+            _metrics().counter("device.pager.prefetches").inc()
+        # seal AFTER the pager's own eviction pass so ledger budget
+        # enforcement sees the post-eviction footprint
+        self._led.seal(group)
+        return arrays
+
+    def _on_ledger_evict(self, key) -> None:
+        """The owning ledger's budget enforcement chose this pager group
+        as its LRU victim — drop the entry and count it here too."""
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is None:
+                return
+            self.evictions += 1
+            self.evicted_pages += e.pages
+        _metrics().counter("device.pager.evictions").inc()
+
+    def invalidate(self, key) -> None:
+        """Owner teardown (segment merged away / GC'd)."""
+        with self._lock:
+            e = self._entries.pop(key, None)
+        if e is not None:
+            self._led.close_group(e.group)
+
+    def stats(self) -> dict:
+        with self._lock:
+            resident = sum(e.pages for e in self._entries.values())
+            resident_bytes = sum(e.nbytes
+                                 for e in self._entries.values())
+            out = {
+                "page_bytes": self.page_bytes,
+                "capacity_pages": self.capacity_pages(),
+                "resident_pages": resident,
+                "resident_entries": len(self._entries),
+                "resident_bytes": resident_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "evicted_pages": self.evicted_pages,
+                "prefetches": self.prefetches,
+            }
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            entries = list(self._entries.values())
+            self._entries.clear()
+            self.hits = self.misses = self.evictions = 0
+            self.evicted_pages = self.prefetches = 0
+            self.page_bytes = self.DEFAULT_PAGE_BYTES
+        for e in entries:
+            self._led.close_group(e.group)
 
 
 def _backend_memory_stats() -> dict:
@@ -575,10 +809,15 @@ def _backend_memory_stats() -> dict:
 
 _ledger = DeviceResidencyLedger()
 _registry = KernelCompileRegistry()
+_pager = DevicePager(_ledger)
 
 
 def device_ledger() -> DeviceResidencyLedger:
     return _ledger
+
+
+def device_pager() -> DevicePager:
+    return _pager
 
 
 def kernel_registry() -> KernelCompileRegistry:

@@ -287,6 +287,47 @@ class Segment:
         table = self.impact_table(field, avgdl, k1, b)
         return None if table is None else table[1]
 
+    def quantized_table(self, field: str, avgdl: float):
+        """Quantized + bit-packed tables for ``field`` at this avgdl
+        (``index/codec.py``), host-side and cached like
+        ``impact_table``.  When ``self.quant_dir`` is set (the store
+        attaches it on load), the persisted ``.quant`` sidecar is tried
+        first — a CRC mismatch degrades to recompute-and-rewrite, never
+        a failed search — and fresh builds are written back so the next
+        process skips the quantization pass."""
+        pf = self.postings.get(field)
+        if pf is None:
+            return None
+        from opensearch_tpu.common.cache import attached_cache
+        cache = attached_cache(self, "_quant_table_cache",
+                               name="segment.quantized_table",
+                               max_weight=256 << 20,
+                               breaker="fielddata")
+        key = (field, float(np.float32(avgdl)))
+        qt = cache.get(key)
+        if qt is None:
+            from opensearch_tpu.index import codec as codec_mod
+            qdir = getattr(self, "quant_dir", None)
+            if qdir is not None:
+                from opensearch_tpu.index import store as store_mod
+                try:
+                    qt = store_mod.load_quantized_tables(
+                        qdir, self.seg_id, field, avgdl=key[1])
+                except store_mod.CorruptIndexError:
+                    qt = None       # degrade: recompute + rewrite
+            if qt is None:
+                imp, mx = self.impact_table(field, avgdl)
+                qt = codec_mod.quantize_postings(pf, imp, mx, avgdl)
+                if qdir is not None:
+                    try:
+                        store_mod.save_quantized_tables(
+                            qdir, self.seg_id, field, qt)
+                    except OSError:
+                        pass        # sidecar is a cache, not a commit
+            qt._offsets = pf.offsets
+            cache.put(key, qt)
+        return qt
+
     def device(self) -> "DeviceSegment":
         if self._device is None:
             was_evicted = self._device_evicted
@@ -385,35 +426,30 @@ class DeviceSegment:
             return led.stage(group, arr, kind=kind, field=field,
                              name=name)
 
+        # Lowering decision (index/codec.py): quantized segments stage
+        # only offsets/doc_lens/field_exists eagerly — the heavy
+        # per-posting columns either flow through the pager in
+        # compressed form (scored term-bags) or stage lazily on first
+        # demand (``ensure_postings``, for phrase/span/filter plans the
+        # quantized kernels don't cover).
+        from opensearch_tpu.index import codec as codec_mod
+        self.quantized_mode = codec_mod.use_quantized(seg)
         self.postings: dict[str, dict] = {}
         for name, pf in seg.postings.items():
-            p_pad = pad_pow2(len(pf.doc_ids))
             # offsets padded by repeating the final cumulative value so
             # padded term ids decode as empty ranges and the array shape
             # stays bucketed (compile-cache sharing across segments).
             t_pad = pad_pow2(len(pf.offsets))
-            pos_pad = pad_pow2(len(pf.positions))
             self.postings[name] = {
                 "offsets": stage(pad1(pf.offsets, t_pad, pf.offsets[-1]),
                                  "postings", name, "offsets"),
-                "doc_ids": stage(pad1(pf.doc_ids, p_pad, self.n_docs),
-                                 "postings", name, "doc_ids"),
-                "tfs": stage(pad1(pf.tfs, p_pad, 0.0),
-                             "postings", name, "tfs"),
                 "doc_lens": stage(pad1(pf.doc_lens, n_pad, 1.0),
                                   "postings", name, "doc_lens"),
-                # positions CSR for phrase matching (pos_offsets is per
-                # posting entry, so a term's positions are one contiguous
-                # slice of ``positions``).
-                "pos_offsets": stage(
-                    pad1(pf.pos_offsets, pad_pow2(len(pf.pos_offsets)),
-                         pf.pos_offsets[-1] if len(pf.pos_offsets) else 0),
-                    "postings", name, "pos_offsets"),
-                "positions": stage(pad1(pf.positions, pos_pad, 0),
-                                   "postings", name, "positions"),
                 "field_exists": stage(pad1(pf.present, n_pad, False),
                                       "postings", name, "field_exists"),
             }
+            if not self.quantized_mode:
+                self.ensure_postings(name)
         self.numeric: dict[str, dict] = {}
         for name, dv in seg.numeric_dv.items():
             v_pad = pad_pow2(len(dv.values))
@@ -486,6 +522,74 @@ class DeviceSegment:
         # accruing into it)
         led.seal(group)
 
+    def ensure_postings(self, field: str) -> Optional[dict]:
+        """Full per-posting device arrays (doc_ids/tfs/positions) for
+        ``field``, staged on demand.
+
+        On quantized segments these are skipped at construction — that
+        skip IS the footprint win — but plans outside the quantized
+        lowering (phrase, span, filter-context term bags, the batched
+        union kernel) still need them; they stage here on first use and
+        join the segment's ledger group like any eager array."""
+        p = self.postings.get(field)
+        if p is None or "doc_ids" in p:
+            return p
+        pf = self.seg.postings[field]
+        p_pad = pad_pow2(len(pf.doc_ids))
+        pos_pad = pad_pow2(len(pf.positions))
+        led = self._ledger
+        group = self._ledger_group
+
+        def pad1(a: np.ndarray, size: int, fill) -> np.ndarray:
+            out = np.full(size, fill, dtype=a.dtype)
+            out[: len(a)] = a
+            return out
+
+        def stage(arr, name):
+            return led.stage(group, arr, kind="postings", field=field,
+                             name=name)
+
+        p["doc_ids"] = stage(pad1(pf.doc_ids, p_pad, self.n_docs),
+                             "doc_ids")
+        p["tfs"] = stage(pad1(pf.tfs, p_pad, 0.0), "tfs")
+        # positions CSR for phrase matching (pos_offsets is per posting
+        # entry, so a term's positions are one contiguous slice of
+        # ``positions``).
+        p["pos_offsets"] = stage(
+            pad1(pf.pos_offsets, pad_pow2(len(pf.pos_offsets)),
+                 pf.pos_offsets[-1] if len(pf.pos_offsets) else 0),
+            "pos_offsets")
+        p["positions"] = stage(pad1(pf.positions, pos_pad, 0),
+                               "positions")
+        if self.quantized_mode:
+            # diagnostic: how often the compressed layout had to pull
+            # the full f32 arrays in anyway (plan mix dependent)
+            from opensearch_tpu.common.telemetry import metrics
+            metrics().counter("device.quantized.full_postings").inc()
+        return p
+
+    def quantized(self, field: str, avgdl: float):
+        """Quantized device arrays for ``field`` (index/codec.py),
+        staged through the device pager under the page budget.
+
+        Returns the staged dict (qvals/scales/exact_vals/exact_offsets/
+        packed/base) or None if the field has no postings.  Pager
+        entries are keyed by (index, shard, segment, field, avgdl) and
+        deliberately OUTLIVE this DeviceSegment: a budget eviction of
+        the segment group doesn't drop the compressed pages, so the
+        restage path only re-stages the cheap eager arrays."""
+        if self.postings.get(field) is None:
+            return None
+        seg = self.seg
+        key = _quant_key(seg, field, avgdl)
+        from opensearch_tpu.common.device_ledger import device_pager
+        _register_pager_invalidation(seg, key)
+        return device_pager().acquire(
+            key, lambda: _quant_items(seg, field, avgdl),
+            index=getattr(seg, "index_name", "-"),
+            shard=getattr(seg, "shard_id", 0),
+            segment=seg.seg_id)
+
     def impacts(self, field: str, avgdl: float):
         """Staged per-posting BM25 impact column for ``field``, indexed
         exactly like ``postings[field]["tfs"]`` (padded slots are 0).
@@ -510,9 +614,12 @@ class DeviceSegment:
                 imp = jnp.zeros(8, jnp.float32)
             else:
                 host_imp, _mx = self.seg.impact_table(field, avgdl)
-                padded = np.zeros(p["tfs"].shape[0], np.float32)
+                # padded like doc_ids/tfs even when those are lazily
+                # staged (quantized segments): same bucketed shape
+                p_pad = pad_pow2(len(self.seg.postings[field].doc_ids))
+                padded = np.zeros(p_pad, np.float32)
                 padded[: len(host_imp)] = host_imp
-                imp = self._ledger.stage(
+                imp = self._ledger.stage(       # quantize-ok
                     self._ledger_group, padded, kind="impacts",
                     field=field, name=f"avgdl={key[1]:.6g}")
             cache.put(key, imp)
@@ -607,6 +714,87 @@ class DeviceSegment:
                                   name=str(old))
             self._live_cache[key] = cached
         return cached[1]
+
+
+def _quant_key(seg: Segment, field: str, avgdl: float) -> tuple:
+    """Pager key for one quantized table set — stable across
+    DeviceSegment restages so compressed pages survive segment-group
+    eviction."""
+    return (getattr(seg, "index_name", "-"),
+            getattr(seg, "shard_id", 0),
+            seg.seg_id, field, float(np.float32(avgdl)))
+
+
+def _quant_items(seg: Segment, field: str, avgdl: float) -> list:
+    """Pager loader: one quantized table set as padded host arrays,
+    shape-bucketed exactly like the eager staging so XLA programs are
+    shared across same-bucket segments."""
+    qt = seg.quantized_table(field, avgdl)
+    pf = seg.postings[field]
+    t_pad = pad_pow2(len(pf.offsets))
+
+    def pad1(a: np.ndarray, size: int, fill) -> np.ndarray:
+        out = np.full(size, fill, dtype=a.dtype)
+        out[: len(a)] = a
+        return out
+
+    return [
+        ("qvals", "impacts_q",
+         pad1(qt.qvals, pad_pow2(len(qt.qvals)), 0)),
+        # padded term slots are inactive in every gather; scale 1 keeps
+        # a stray read finite
+        ("scales", "impacts_q", pad1(qt.scales, t_pad, 1.0)),
+        ("exact_vals", "impacts_q",
+         pad1(qt.exact_vals, pad_pow2(len(qt.exact_vals)), 0.0)),
+        ("exact_offsets", "impacts_q",
+         pad1(qt.exact_offsets, t_pad,
+              qt.exact_offsets[-1] if len(qt.exact_offsets) else 0)),
+        # packed keeps its own guard word; zero padding beyond it is
+        # never addressed (w+1 <= word count of the real payload)
+        ("packed", "postings_q",
+         pad1(qt.packed, pad_pow2(len(qt.packed)), 0)),
+        ("base", "postings_q", pad1(qt.base, t_pad, 0)),
+    ]
+
+
+def _pager_invalidate(key: tuple) -> None:
+    from opensearch_tpu.common.device_ledger import device_pager
+    device_pager().invalidate(key)
+
+
+def _register_pager_invalidation(seg: Segment, key: tuple) -> None:
+    """One finalizer per (segment, pager key): a merged-away/GC'd
+    segment drops its compressed pages instead of squatting in the
+    budget until LRU."""
+    import weakref
+    reg = getattr(seg, "_quant_pager_keys", None)
+    if reg is None:
+        reg = seg._quant_pager_keys = set()
+    if key not in reg:
+        reg.add(key)
+        weakref.finalize(seg, _pager_invalidate, key)
+
+
+def prefetch_quantized(seg: Segment, field: str, avgdl: float) -> bool:
+    """Prefetch-oracle entry point: stage a segment's quantized tables
+    into FREE pager pages ahead of the dispatch loop (never evicts —
+    see ``DevicePager.prefetch``).  The footprint hint is an estimate
+    so a skipped prefetch costs no quantization work."""
+    pf = seg.postings.get(field)
+    if pf is None:
+        return False
+    key = _quant_key(seg, field, avgdl)
+    # ~1B/posting quantized impacts + <=4B/posting packed ids + per-term
+    # scale/base/offset columns; close enough for page-granular fit
+    hint = (len(pf.doc_ids) * 5
+            + len(pf.offsets) * 12 + 4096)
+    from opensearch_tpu.common.device_ledger import device_pager
+    _register_pager_invalidation(seg, key)
+    return device_pager().prefetch(
+        key, lambda: _quant_items(seg, field, avgdl), hint,
+        index=getattr(seg, "index_name", "-"),
+        shard=getattr(seg, "shard_id", 0),
+        segment=seg.seg_id)
 
 
 class SegmentWriter:

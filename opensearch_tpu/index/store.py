@@ -214,6 +214,76 @@ def _decode_liv(seg_id: str, data: bytes) -> np.ndarray:
             f"segment file [{seg_id}.liv] is undecodable: {e}") from e
 
 
+def quant_sidecar_name(seg_id: str, field: str) -> str:
+    return f"{seg_id}.{field}.quant"
+
+
+def _encode_quant(qt) -> bytes:
+    buf = io.BytesIO()
+    meta = json.dumps({"width": int(qt.width), "dtype": qt.dtype,
+                       "avgdl": float(qt.avgdl), "stats": qt.stats},
+                      sort_keys=True).encode()
+    np.savez(buf, qvals=qt.qvals, scales=qt.scales,
+             exact_vals=qt.exact_vals, exact_offsets=qt.exact_offsets,
+             packed=qt.packed, base=qt.base,
+             meta=np.frombuffer(meta, dtype=np.uint8))
+    payload = buf.getvalue()
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    return f"{crc:08x}".encode() + payload
+
+
+def save_quantized_tables(dirpath: str, seg_id: str, field: str, qt):
+    """Persist one field's quantized tables (index/codec.py) as a
+    ``<seg_id>.<field>.quant`` sidecar.  Like ``.liv`` it lives OUTSIDE
+    the immutable commit manifest — it is an avgdl-dependent cache a
+    refresh/merge can obsolete — so it carries its own CRC32 header and
+    the reader treats any mismatch as 'absent', never as a failure."""
+    os.makedirs(dirpath, exist_ok=True)
+    write_durable(
+        os.path.join(dirpath, quant_sidecar_name(seg_id, field)),
+        _encode_quant(qt))
+
+
+def load_quantized_tables(dirpath: str, seg_id: str, field: str,
+                          avgdl: float | None = None):
+    """Load a ``.quant`` sidecar.  Returns None when the file is absent
+    or was built for a different avgdl (stale — the caller rebuilds);
+    raises ``CorruptIndexError`` naming the file on checksum/decode
+    failure (the caller degrades to recompute-and-rewrite)."""
+    from opensearch_tpu.index.codec import QuantizedPostings
+    name = quant_sidecar_name(seg_id, field)
+    path = os.path.join(dirpath, name)
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        data = f.read()
+    try:
+        expected = int(data[:8], 16)
+    except ValueError as e:
+        raise CorruptIndexError(
+            f"segment file [{name}] has no checksum header") from e
+    payload = data[8:]
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != expected:
+        raise CorruptIndexError(
+            f"segment file [{name}] checksum mismatch")
+    try:
+        z = np.load(io.BytesIO(payload))
+        meta = json.loads(z["meta"].tobytes().decode())
+        qt = QuantizedPostings(
+            qvals=z["qvals"], scales=z["scales"],
+            exact_vals=z["exact_vals"], exact_offsets=z["exact_offsets"],
+            packed=z["packed"], base=z["base"],
+            width=int(meta["width"]), dtype=meta["dtype"],
+            avgdl=float(meta["avgdl"]),
+            stats=dict(meta.get("stats") or {}))
+    except (ValueError, KeyError) as e:
+        raise CorruptIndexError(
+            f"segment file [{name}] is undecodable: {e}") from e
+    if avgdl is not None and float(np.float32(avgdl)) != qt.avgdl:
+        return None        # stale (avgdl moved under a refresh/merge)
+    return qt
+
+
 def save_segment(seg: Segment, dirpath: str, codec: str = "default"):
     """``codec`` mirrors the reference's two stored-field codecs (ref
     index/codec/CodecService.java:46 — LZ4 "default" vs zstd/DEFLATE
@@ -242,6 +312,9 @@ def save_segment(seg: Segment, dirpath: str, codec: str = "default"):
         write_durable(os.path.join(dirpath, name), data)
         entries[name] = file_checksum(data)
     write_segment_manifest(dirpath, seg.seg_id, entries)
+    # freshly-saved segments persist quantized sidecars here too, not
+    # only after a load (mirrors load_segment)
+    seg.quant_dir = dirpath
 
 
 def save_live(seg: Segment, dirpath: str):
@@ -273,6 +346,9 @@ def load_segment(dirpath: str, seg_id: str) -> Segment:
     if os.path.exists(liv_path):
         with open(liv_path, "rb") as f:
             seg.live = _decode_liv(seg_id, f.read())
+    # quantized-table sidecars load lazily from here (and fresh builds
+    # write back) — see Segment.quantized_table
+    seg.quant_dir = dirpath
     return seg
 
 
@@ -287,6 +363,12 @@ def verify_segment(dirpath: str, seg_id: str) -> bool:
     if os.path.exists(liv_path):
         with open(liv_path, "rb") as f:
             _decode_liv(seg_id, f.read())
+    if os.path.isdir(dirpath):
+        # self-verified sidecars (CRC header, outside the manifest)
+        for fname in sorted(os.listdir(dirpath)):
+            if fname.startswith(seg_id + ".") and fname.endswith(".quant"):
+                field = fname[len(seg_id) + 1: -len(".quant")]
+                load_quantized_tables(dirpath, seg_id, field)
     if manifest is None:
         return False
     for name in sorted(manifest["files"]):
@@ -439,3 +521,7 @@ def delete_segment_files(dirpath: str, seg_id: str):
         p = os.path.join(dirpath, seg_id + ext)
         if os.path.exists(p):
             os.remove(p)
+    if os.path.isdir(dirpath):
+        for fname in list(os.listdir(dirpath)):
+            if fname.startswith(seg_id + ".") and fname.endswith(".quant"):
+                os.remove(os.path.join(dirpath, fname))

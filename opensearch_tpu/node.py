@@ -214,6 +214,14 @@ class Node:
         # host↔device paging seed, common/device_ledger.py)
         device_budget = Setting.byte_size_setting(
             "device.memory.budget_bytes", 0, dynamic=True)
+        # paged quantized index (index/codec.py + the device pager):
+        # page accounting granularity, and the per-segment lowering
+        # policy ("auto" quantizes segments >= QUANTIZED_MIN_DOCS)
+        pager_page_bytes = Setting.byte_size_setting(
+            "device.pager.page_bytes", 0, dynamic=True)
+        quantized_mode = Setting.str_setting(
+            "index.device.quantized", "auto", dynamic=True,
+            choices=("auto", "on", "off"))
         # accelerator fault tolerance (common/device_health.py): the
         # per-kernel-class circuit breakers' trip threshold and the
         # open-state cooldown before a half-open probe is allowed
@@ -262,7 +270,8 @@ class Node:
              search_max_lag,
              max_keep_alive, default_keep_alive, allow_partial,
              req_cache_size, ins_enabled, ins_top_n, ins_window,
-             ins_coalesce, device_budget, dh_enabled, dh_threshold,
+             ins_coalesce, device_budget, pager_page_bytes,
+             quantized_mode, dh_enabled, dh_threshold,
              dh_interval, batcher_enabled,
              batcher_window, batcher_max, qos_shares,
              qos_default_share, qos_adaptive, qos_interval,
@@ -314,12 +323,28 @@ class Node:
             _apply_asc(self.cluster_settings.get(setting))
         # device-memory budget reaches the residency ledger immediately
         # (and persisted values replay at boot)
-        from opensearch_tpu.common.device_ledger import device_ledger
+        from opensearch_tpu.common.device_ledger import (device_ledger,
+                                                         device_pager)
         self.cluster_settings.add_settings_update_consumer(
             device_budget,
             lambda v: device_ledger().set_budget(int(v or 0)))
         device_ledger().set_budget(
             int(self.cluster_settings.get(device_budget) or 0))
+        # pager page size reaches the process-global pager immediately;
+        # the quantized-mode knob lands on the codec module global (the
+        # DEFAULT_ALLOW_PARTIAL_RESULTS idiom) so the lowering decision
+        # and the host parity fallback read one source of truth
+        from opensearch_tpu.index import codec as codec_mod
+        self.cluster_settings.add_settings_update_consumer(
+            pager_page_bytes,
+            lambda v: device_pager().set_page_bytes(int(v or 0)))
+        device_pager().set_page_bytes(
+            int(self.cluster_settings.get(pager_page_bytes) or 0))
+        self.cluster_settings.add_settings_update_consumer(
+            quantized_mode,
+            lambda v: setattr(codec_mod, "QUANTIZED_MODE", str(v)))
+        codec_mod.QUANTIZED_MODE = str(
+            self.cluster_settings.get(quantized_mode))
         # device-health breaker knobs reach the process-global service
         # immediately (and persisted values replay at boot)
         from opensearch_tpu.common.device_health import device_health

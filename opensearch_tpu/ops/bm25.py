@@ -111,6 +111,43 @@ def gather_postings(offsets, doc_ids, tfs, term_ids, term_active, *,
     return d, tf, slot, valid
 
 
+def gather_postings_packed(offsets, packed, base, term_ids, term_active,
+                           *, width: int, budget: int, pad_doc: int):
+    """``gather_postings`` over BIT-PACKED doc ids (index/codec.py):
+    postings store ``doc - base[term]`` deltas at a fixed ``width`` bits,
+    and each lane decodes its delta with two aligned uint32 reads — no
+    prefix-sum chain, so random access (and therefore the shape-static
+    CSR gather) is preserved.
+
+    Returns (docs[B], idx[B], slot[B], valid[B]): ``idx`` is the flat
+    posting index (for the quantized-impact gather) and ``slot`` the
+    query-term slot, exactly like ``gather_postings``.
+    """
+    starts = offsets[term_ids]
+    lens = jnp.where(term_active, offsets[term_ids + 1] - starts, 0)
+    cum = jnp.cumsum(lens)
+    total = cum[-1]
+    i = jnp.arange(budget, dtype=jnp.int32)
+    slot = jnp.searchsorted(cum, i, side="right").astype(jnp.int32)
+    slot = jnp.minimum(slot, term_ids.shape[0] - 1)
+    prev = jnp.where(slot > 0, cum[slot - 1], 0)
+    valid = i < total
+    idx = jnp.where(valid, starts[slot] + i - prev, 0)
+    # bitpos = idx * width decomposed as idx = 32a + b so the word/bit
+    # math never overflows int32 at 10M-doc posting counts
+    a, b = idx >> 5, idx & 31
+    bit = b * width
+    w = a * width + (bit >> 5)
+    off = (bit & 31).astype(jnp.uint32)
+    pair = (packed[w].astype(jnp.uint64)
+            | (packed[w + 1].astype(jnp.uint64) << jnp.uint64(32)))
+    mask = jnp.uint64((1 << width) - 1)
+    delta = ((pair >> off.astype(jnp.uint64)) & mask).astype(jnp.int32)
+    tid = term_ids[slot]
+    d = jnp.where(valid, base[tid] + delta, pad_doc)
+    return d, idx, slot, valid
+
+
 def bm25_scores(offsets, doc_ids, tfs, doc_lens, term_ids, term_active,
                 idfs, weights, avgdl, *, n_pad: int, budget: int,
                 k1: float = K1_DEFAULT, b: float = B_DEFAULT):

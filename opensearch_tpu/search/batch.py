@@ -424,7 +424,11 @@ class BatchGroup:
             t_seg = time.monotonic() if prof is not None else 0.0
             seg = searcher.segments[seg_order]
             dseg = seg.device()
-            impacts = dseg.impacts(self.field, self.avgdl)
+            # the batched union kernel stays on the f32 lowering: on
+            # quantized segments the full posting columns demand-stage
+            # here (DeviceSegment.ensure_postings)
+            dseg.ensure_postings(self.field)
+            impacts = dseg.impacts(self.field, self.avgdl)  # quantize-ok: batch union stays on the f32 lowering
             live = searcher.ctx.live_jnp(seg, dseg)
             kk = min(self.k, dseg.n_pad)
             vals, idx, tot, mx = batch_impact_union_topk(  # engine-ok: batch device backend

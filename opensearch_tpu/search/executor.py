@@ -159,11 +159,18 @@ def _dummy_for(group: str, field: str, dseg: DeviceSegment, mapper):
     raise IllegalArgumentError(f"unknown array group [{group}]")
 
 
-def build_arrays(dseg: DeviceSegment, needed, mapper, live=None):
+def build_arrays(dseg: DeviceSegment, needed, mapper, live=None,
+                 partial_ok=frozenset()):
     """Assemble the ``A`` pytree a plan reads: live mask + requested field
     array groups (absent fields get all-inactive dummies).  ``live`` is the
     caller's point-in-time staged live mask (defaults to the segment's
-    construction-time state)."""
+    construction-time state).
+
+    ``partial_ok`` is the plan's ``skip_arrays(dims)`` — (group, field)
+    pairs whose partial staging is fine as-is.  Quantized segments stage
+    only offsets/doc_lens/field_exists eagerly; any OTHER plan touching
+    their postings demand-stages the full f32 columns here
+    (``DeviceSegment.ensure_postings``)."""
     from opensearch_tpu.common.cache import attached_cache
 
     A = {"live": dseg.live if live is None else live}
@@ -184,6 +191,9 @@ def build_arrays(dseg: DeviceSegment, needed, mapper, live=None):
             if entry is None:
                 entry = _dummy_for(group, field, dseg, mapper)
                 cache.put((group, field), entry)
+        elif (group == "postings" and "doc_ids" not in entry
+                and (group, field) not in partial_ok):
+            entry = dseg.ensure_postings(field)
         A.setdefault(group, {})[field] = {
             k: v for k, v in entry.items() if k != "n_ords"}
     return A
@@ -846,10 +856,14 @@ class ShardSearcher:
                      "shard": self.shard_id}):
                 try:
                     dseg = seg.device()
-                    A = build_arrays(dseg, needed, self.mapper,
-                                     live=self.ctx.live_jnp(seg, dseg))
+                    # prepare FIRST: dims tells build_arrays which
+                    # array groups the lowering left deliberately
+                    # partial (quantized segments)
                     dims, ins = self._prepared(plan, bind, seg, dseg,
                                                ckey, prof=prof)
+                    A = build_arrays(dseg, needed, self.mapper,
+                                     live=self.ctx.live_jnp(seg, dseg),
+                                     partial_ok=plan.skip_arrays(dims))
                     scores, matched = P.run_full(plan, dims, A, ins, ms)
                 except Exception as exc:
                     if not is_device_error(exc):
@@ -955,6 +969,15 @@ class ShardSearcher:
             # attribution) — those keep the sequential loop below.
             return self._topk_host_parallel(plan, bind, k_want,
                                             min_score, ms_host, iattrs)
+        if not host_fast and hasattr(plan, "prefetch_quantized"):
+            # pager prefetch oracle: best-bound-first staging of
+            # quantized pages into FREE capacity before the dispatch
+            # loop.  Best-effort by construction — a prefetch failure
+            # surfaces (and is handled) at the segment's own dispatch
+            try:
+                plan.prefetch_quantized(bind, self.segments)
+            except Exception:
+                pass
         launched = []              # [si, vals, idx, tot, mx, synced_vals]
         kth = None                 # running k-th best (harvested, host)
         total_is_lower_bound = False
@@ -1030,11 +1053,15 @@ class ShardSearcher:
                 else:
                     try:
                         dseg = seg.device()
-                        A = build_arrays(dseg, needed, self.mapper,
-                                         live=self.ctx.live_jnp(seg,
-                                                                dseg))
+                        # prepare FIRST so dims can mark the quantized
+                        # lowering's deliberately-partial array groups
                         dims, ins = self._prepared(plan, bind, seg,
                                                    dseg, ckey, prof=prof)
+                        A = build_arrays(dseg, needed, self.mapper,
+                                         live=self.ctx.live_jnp(seg,
+                                                                dseg),
+                                         partial_ok=plan.skip_arrays(
+                                             dims))
                         k = min(k_want, dseg.n_pad)
                         launched.append([si, *P.run_topk(plan, dims, k,
                                                          A, ins, ms),

@@ -42,6 +42,7 @@ from opensearch_tpu.index.segment import (LONG_MISSING_MAX, pad_bucket,
 from opensearch_tpu.ops import bm25 as bm25_ops
 from opensearch_tpu.ops import filters as filter_ops
 from opensearch_tpu.ops import phrase as phrase_ops
+from opensearch_tpu.ops import quantized as quantized_ops
 from opensearch_tpu.ops import span as span_ops
 
 _I32 = np.int32
@@ -80,6 +81,15 @@ class Plan:
         that can't match never dispatch a device program.  Must stay
         conservative: returning True is always safe."""
         return True
+
+    def skip_arrays(self, dims) -> frozenset:
+        """Subset of ``arrays()`` this plan does NOT need fully staged
+        for the dims ``prepare`` returned — the executor passes it to
+        ``build_arrays`` so a quantized lowering (which carries its
+        compressed arrays through ``ins``) doesn't force the f32
+        posting columns onto the device.  Composites keep the default
+        (empty): only lowerings that opt in skip anything."""
+        return frozenset()
 
     def max_score_bound(self, bind, seg) -> float:
         """Safe UPPER bound on any single doc's score in this segment —
@@ -207,7 +217,17 @@ class TermBagPlan(Plan):
         pf = seg.postings.get(self.field)
         if pf is None:
             return (np.empty(0, _F32), np.empty(0, _I32), 0, -np.inf)
-        imp, _mx = seg.impact_table(self.field, bind["avgdl"])
+        from opensearch_tpu.index import codec as codec_mod
+        if codec_mod.use_quantized(seg):
+            # parity with the QUANTIZED device kernel: reconstruct
+            # impacts exactly as ops/quantized.py does (q * scale,
+            # exact-guard blocks overridden) so budget-eviction /
+            # breaker degradation stays byte-identical on compressed
+            # segments too
+            imp = seg.quantized_table(self.field,
+                                      bind["avgdl"]).dequantized()
+        else:
+            imp, _mx = seg.impact_table(self.field, bind["avgdl"])
         idfs = np.asarray(bind["idfs"], _F32)
         weights = np.asarray(bind["weights"], _F32)
         required = int(bind["required"])
@@ -269,17 +289,82 @@ class TermBagPlan(Plan):
         # kernel's scatter traffic) is skipped entirely
         fast = (int(bind["required"]) == 1
                 and bool((weights > 0).all()) and bool((idfs > 0).all()))
+        if getattr(dseg, "quantized_mode", False):
+            # QUANTIZED lowering (index/codec.py): the compressed
+            # columns ride in ``ins`` via the pager, the f32 posting
+            # arrays are never staged (see ``skip_arrays``), and dims
+            # grows a 4th element — width is a static shape input to
+            # the packed gather, and the arity keeps compiled f32
+            # programs distinct from quantized ones.
+            qarrs = dseg.quantized(self.field, bind["avgdl"])
+            qt = seg.quantized_table(self.field, bind["avgdl"])
+            ins = (jnp.asarray(tids), jnp.asarray(active),  # staging-ok: per-query input (prep-cache owned)
+                   _pad_np(idfs, t_pad, 0.0, _F32),
+                   _pad_np(weights, t_pad, 0.0, _F32),
+                   qarrs["qvals"], qarrs["scales"],
+                   qarrs["exact_vals"], qarrs["exact_offsets"],
+                   qarrs["packed"], qarrs["base"],
+                   _scalar(bind["required"], _I32))
+            return (t_pad, pad_bucket(budget), fast, int(qt.width)), ins
         ins = (jnp.asarray(tids), jnp.asarray(active),  # staging-ok: per-query input (prep-cache owned)
                _pad_np(idfs, t_pad, 0.0, _F32),
                _pad_np(weights, t_pad, 0.0, _F32),
-               dseg.impacts(self.field, bind["avgdl"]),
+               dseg.impacts(self.field, bind["avgdl"]),  # quantize-ok: f32 lowering (non-quantized segments)
                _scalar(bind["required"], _I32))
         return (t_pad, pad_bucket(budget), fast), ins
 
+    def skip_arrays(self, dims) -> frozenset:
+        # 4-tuple dims = quantized lowering: eval only needs the
+        # (always-staged) offsets from the postings entry, so the
+        # executor must NOT demand-stage the full f32 columns
+        if len(dims) == 4:
+            return frozenset({("postings", self.field)})
+        return frozenset()
+
+    def prefetch_quantized(self, bind, segments) -> int:
+        """Prefetch oracle for the pager: rank candidate segments by
+        their per-term block-max score bound — the best any of their
+        docs could contribute, exactly the MaxScore pruning surface —
+        and prefetch quantized pages best-first into FREE pager
+        capacity (never evicting residents).  Returns segments staged."""
+        from opensearch_tpu.index import codec as codec_mod
+        from opensearch_tpu.index.segment import prefetch_quantized
+        ranked = []
+        for seg in segments:
+            if not codec_mod.use_quantized(seg):
+                continue
+            if not self.can_match(bind, seg):
+                continue
+            ranked.append((self.max_score_bound(bind, seg), seg))
+        ranked.sort(key=lambda pair: -pair[0])
+        staged = 0
+        for _bound, seg in ranked:
+            if prefetch_quantized(seg, self.field, bind["avgdl"]):
+                staged += 1
+        return staged
+
     def eval(self, A, dims, ins):
-        t_pad, budget, fast = dims
         p = A["postings"][self.field]
         n_pad = A["live"].shape[0]
+        if self.scored and len(dims) == 4:
+            t_pad, budget, fast, width = dims
+            (tids, active, idfs, weights, qvals, scales, exact_vals,
+             exact_offsets, packed, base, required) = ins
+            if fast:
+                scores = quantized_ops.quantized_impact_scores(  # engine-ok: TermBag quantized lowering
+                    p["offsets"], packed, base, qvals, scales,
+                    exact_vals, exact_offsets, tids, active, idfs,
+                    weights, width=width, n_pad=n_pad, budget=budget)
+                matched = scores > 0.0
+            else:
+                scores, count = quantized_ops.quantized_impact_score_count(  # engine-ok: TermBag quantized lowering
+                    p["offsets"], packed, base, qvals, scales,
+                    exact_vals, exact_offsets, tids, active, idfs,
+                    weights, width=width, n_pad=n_pad, budget=budget,
+                    scored=True)
+                matched = count >= required
+            return jnp.where(matched, scores, 0.0), matched
+        t_pad, budget, fast = dims
         if not self.scored:
             tids, active, required = ins
             count = bm25_ops.match_count(  # engine-ok: TermBag filter lowering
@@ -1209,7 +1294,7 @@ class TermsSetPlan(Plan):
         ins = (jnp.asarray(tids), jnp.asarray(active),  # staging-ok: per-query input (prep-cache owned)
                _pad_np(bind["idfs"], t_pad, 0.0, _F32),
                _pad_np(bind["weights"], t_pad, 0.0, _F32),
-               dseg.impacts(self.field, bind["avgdl"]))
+               dseg.impacts(self.field, bind["avgdl"]))  # quantize-ok: TermsSet stays on the f32 lowering
         return (t_pad, pad_bucket(budget)), ins
 
     def eval(self, A, dims, ins):

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Lint: impact tables reach the device through the quantized codec.
+
+``index/codec.py`` + the pager in ``common/device_ledger.py`` are the
+ONE path by which per-posting impact tables become device-resident on
+large segments: quantized to int8/int16 with per-term scales, staged in
+fixed-size pages under ``device.memory.budget_bytes``, and accounted
+(hits/misses/evictions/prefetches) in `_nodes/stats` ``device.pager``.
+A raw f32 impact-table staging elsewhere silently quadruples the
+per-segment footprint and bypasses the page budget — exactly the
+regression the quantized subsystem exists to prevent.
+
+Scope: ``opensearch_tpu/index/``, ``search/``, ``parallel/``, ``ops/``.
+Flagged call patterns (line-based, like check_device_staging.py):
+
+- ``kind="impacts"`` — staging a full-precision impact table into the
+  segment ledger group
+- ``.impacts(`` — requesting the f32 device impact lowering from a
+  ``DeviceSegment``
+
+A deliberate f32 lowering — small segments below the quantization
+threshold, filter-context/phrase paths that never read impacts, or the
+codec/pager entry points themselves — carries a ``# quantize-ok``
+annotation on the same line or the line above.  ``index/codec.py`` is
+exempt wholesale: it IS the codec.
+
+Sibling of ``check_device_staging.py`` et al.; new un-annotated sites
+fail tier-1 (tests/test_quantized.py runs this check).
+
+Usage: python tools/check_quantized_staging.py [root]   (exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+ANNOTATION = "# quantize-ok"
+
+# directories (relative to the package root) whose impact staging is linted
+SCOPES = ("index", "search", "parallel", "ops")
+
+# files allowed to touch the raw f32 impact path without annotation
+EXEMPT = ("codec.py",)
+
+_PATTERNS = (
+    (re.compile(r"kind\s*=\s*[\"']impacts[\"']"), 'kind="impacts" staging'),
+    (re.compile(r"\.impacts\s*\("), ".impacts(...) f32 lowering"),
+)
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    problems = []
+    for i, line in enumerate(lines):
+        for pat, what in _PATTERNS:
+            if not pat.search(line):
+                continue
+            prev = lines[i - 1] if i else ""
+            if ANNOTATION in line or ANNOTATION in prev:
+                continue
+            problems.append(
+                f"{path}:{i + 1}: raw {what} — impact tables must reach "
+                "the device through index/codec.py (quantize_postings) "
+                "and the device pager so the page budget and footprint "
+                f"accounting stay exact, or carry a '{ANNOTATION}' "
+                "annotation on this or the previous line")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    root = argv[1] if len(argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "opensearch_tpu")
+    problems = []
+    for scope in SCOPES:
+        scope_dir = os.path.join(root, scope)
+        if not os.path.isdir(scope_dir):
+            # linting a sample tree (the lint's own tests): scan root
+            scope_dir = root if scope == SCOPES[0] else None
+        if scope_dir is None:
+            continue
+        for dirpath, _dirs, files in os.walk(scope_dir):
+            if "__pycache__" in dirpath:
+                continue
+            for fname in sorted(files):
+                if not fname.endswith(".py") or fname in EXEMPT:
+                    continue
+                problems.extend(check_file(os.path.join(dirpath, fname)))
+    for p in sorted(set(problems)):
+        print(p)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
