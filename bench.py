@@ -1017,6 +1017,10 @@ def run_soak_phase(platform: str):
         "unexpected_errors": len(chaos["unexpected_errors"]),
         "convergence": bool(conv.get("ok")),
         "doc_count": chaos["final_state"].get("doc_count"),
+        "fenced_ops": chaos["fenced_ops"],
+        "stale_primary_rejections": chaos["stale_primary_rejections"],
+        "durability_checked_ops":
+            chaos["durability"].get("checked_ops", 0),
     })
 
 

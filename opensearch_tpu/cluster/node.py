@@ -32,6 +32,7 @@ from opensearch_tpu.common.errors import (
     IndexNotFoundError,
     NodeDisconnectedError,
     OpenSearchTpuError,
+    PrimaryFencedError,
     ShardNotFoundError,
     VersionConflictError,
 )
@@ -90,6 +91,10 @@ A_REFRESH = "indices:admin/refresh"
 # replication + recovery (ReplicationOperation / SegmentReplication /
 # PeerRecovery action families)
 A_REPLICATE_OP = "indices:data/write/shard[r]"
+# promotion resync: the new primary rolls in-sync peers back above the
+# old global checkpoint and replays its retained ops under the bumped
+# term (PrimaryReplicaSyncer / TransportResyncReplicationAction)
+A_RESYNC = "indices:data/write/shard[resync]"
 A_PUBLISH_CKPT = "indices:admin/replication/checkpoint"
 A_FETCH_SEGMENTS = "indices:admin/replication/segments"
 A_START_RECOVERY = "internal:index/shard/recovery/start"
@@ -110,8 +115,10 @@ A_UPDATE_SETTINGS = "cluster:admin/index/settings"
 
 #: transport actions that mutate shard state — a search-role node must
 #: reject (or leave unregistered) every one of them; enforced by
-#: tools/check_searcher_write_isolation.py (tier-1)
-WRITE_ACTIONS = (A_WRITE_SHARD, A_REPLICATE_OP)
+#: tools/check_searcher_write_isolation.py (tier-1).  Every handler
+#: registered under these actions must also fence by primary term
+#: against cluster state (tools/check_term_fencing.py, tier-1)
+WRITE_ACTIONS = (A_WRITE_SHARD, A_REPLICATE_OP, A_RESYNC)
 
 
 class NoMasterError(CoordinationError):
@@ -242,6 +249,11 @@ class ClusterNode:
             qos=self.qos)
         # (index, shard) -> "primary" | "replica" as applied locally
         self._roles: dict[tuple, str] = {}
+        # primary-side per-copy local checkpoints, (index, shard) ->
+        # {replica node -> highest reported local checkpoint}
+        # (ReplicationTracker's CheckpointState): min over the in-sync
+        # set is the global checkpoint piggybacked on replication ops
+        self._local_ckpts: dict[tuple, dict[str, int]] = {}
         # (index, shard) replica copies that completed peer recovery in
         # THIS process (an engine reopened after restart must re-recover)
         self._recovered: set[tuple] = set()
@@ -289,7 +301,8 @@ class ClusterNode:
         ``tools/check_searcher_write_isolation.py`` (tier-1) pins write
         registrations to this method."""
         write_handlers = {A_WRITE_SHARD: self._h_write_shard,
-                          A_REPLICATE_OP: self._h_replicate_op}
+                          A_REPLICATE_OP: self._h_replicate_op,
+                          A_RESYNC: self._h_resync}
         assert set(write_handlers) == set(WRITE_ACTIONS)
         for action, handler in write_handlers.items():
             if self.is_data:
@@ -336,6 +349,7 @@ class ClusterNode:
         for present in state.nodes:
             self.response_collector.readmit(present)
         to_promote: list[tuple] = []
+        to_demote: list[tuple] = []
         to_recover: list[tuple] = []
         to_refill: list[tuple] = []
         to_fail_corrupt: list[tuple] = []
@@ -370,6 +384,7 @@ class ClusterNode:
                         svc.remove_local_shard(s)
                         self._roles.pop((index, s), None)
                         self._recovered.discard((index, s))
+                        self._local_ckpts.pop((index, s), None)
                         self._search_published.pop((index, s), None)
                         self._search_installed.pop((index, s), None)
                 for s, role in mine.items():
@@ -406,6 +421,16 @@ class ClusterNode:
                                 (index, s, entry["primary_term"]))
                         self._recovered.add((index, s))
                     elif role == "replica":
+                        if prev == "primary":
+                            # deposed primary rejoining as a replica:
+                            # its ops above the global checkpoint may
+                            # diverge from the new lineage — roll them
+                            # back (below, before recovery threads
+                            # start) and force a fresh peer recovery
+                            # under the new term
+                            self._recovered.discard((index, s))
+                            self._local_ckpts.pop((index, s), None)
+                            to_demote.append((index, s))
                         if (recover
                                 and (index, s) not in self._recovered
                                 and (index, s) not in self._recovering
@@ -421,11 +446,37 @@ class ClusterNode:
                     for key in [k for k in self._roles if k[0] == index]:
                         del self._roles[key]
                         self._recovered.discard(key)
+        for index, s in to_demote:
+            # rollback BEFORE recovery threads start: ops-mode recovery
+            # from an inflated _seq_no would otherwise freeze the
+            # divergence in forever (trimOperationsOfPreviousPrimaryTerms)
+            try:
+                eng = self.indices[index].engine_for(s)
+                rolled = eng.rollback_above(eng.global_checkpoint)
+                if rolled:
+                    from opensearch_tpu.common.telemetry import (
+                        flight_recorder, metrics)
+                    metrics().counter("replication.rollbacks").inc()
+                    flight_recorder().record(
+                        "demotion_rollback",
+                        f"[{index}][{s}] deposed primary rolled back "
+                        f"{rolled} divergent op(s) above global "
+                        f"checkpoint {eng.global_checkpoint}",
+                        detail={"index": index, "shard": s,
+                                "rolled_back": rolled,
+                                "global_checkpoint":
+                                    eng.global_checkpoint})
+            except OpenSearchTpuError:
+                pass
         for index, s, term in to_promote:
             try:
                 self.indices[index].engine_for(s).promote_to_primary(term)
             except OpenSearchTpuError:
                 pass
+            threading.Thread(
+                target=self._run_primary_resync, args=(index, s, term),
+                daemon=True,
+                name=f"resync-{self.node_id}-{index}-{s}").start()
         for index, s, primary, source in to_recover:
             threading.Thread(
                 target=self._run_recovery,
@@ -574,13 +625,18 @@ class ClusterNode:
             if ops is not None:
                 # renew the lease at the replica's NEW checkpoint
                 engine.add_retention_lease(replica, engine._seq_no)
+                self._track_replica_ckpt(payload["index"],
+                                         payload["shard"], replica,
+                                         engine._seq_no)
                 return {"mode": "ops", "ops": ops,
                         "max_seq_no": engine._seq_no}
         engine.refresh()
         if replica is not None:
             # track the copy from here on so its next recovery can be
-            # ops-based
+            # ops-based; seed its local checkpoint at what we ship
             engine.add_retention_lease(replica, engine._seq_no)
+            self._track_replica_ckpt(payload["index"], payload["shard"],
+                                     replica, engine._seq_no)
         ckpt = engine.checkpoint_info()
         return {"ckpt": ckpt, "blobs": engine.segments_blobs(ckpt["segments"])}
 
@@ -605,10 +661,14 @@ class ClusterNode:
     def _h_fail_copy(self, payload: dict) -> dict:  # actuator-ok (fault eviction of a shard copy, not a policy decision)
         """Master: drop a failed shard copy from the group and
         re-allocate a replacement (ReplicationOperation's fail-shard call
-        to the cluster manager).  A failed PRIMARY (corruption) promotes
-        an in-sync replica under a bumped term — the old lineage is
-        fenced out; with no safe copy to promote the group is flagged
-        corrupted and surfaces red in cluster health."""
+        to the cluster manager).  A failed PRIMARY promotes an in-sync
+        replica under a bumped term — the old lineage is fenced out —
+        in two cases: corruption (copy dropped entirely) and a
+        ``deposed`` self-report (the primary saw a fence rejection and
+        stopped acking; its copy stays assigned as an OUT-of-sync
+        replica that rolls back and re-recovers).  With no safe copy to
+        promote, corruption flags the group red; a deposed report is a
+        no-op (the reporter may in fact be the only viable primary)."""
         index, shard, node = (payload["index"], payload["shard"],
                               payload["node"])
 
@@ -620,11 +680,15 @@ class ClusterNode:
                 return state
             e = entries[shard]
             if node == e.get("primary"):
-                if not payload.get("corrupted"):
-                    return state   # only corruption fails a live primary
+                deposed = bool(payload.get("deposed"))
+                if not payload.get("corrupted") and not deposed:
+                    # only corruption/deposition fails a live primary
+                    return state
                 safe = [r for r in (e.get("replicas") or [])
                         if r in (e.get("in_sync") or []) and r != node]
                 if not safe:
+                    if deposed:
+                        return state
                     # nothing safe to promote: keep the copy (its data,
                     # corrupt as it is, is all that exists) but mark the
                     # group so health goes red instead of lying green
@@ -633,6 +697,11 @@ class ClusterNode:
                 promo = safe[0]
                 e["primary"] = promo
                 e["replicas"] = [r for r in e["replicas"] if r != promo]
+                if deposed:
+                    # the deposed copy keeps a slot, out of in-sync: it
+                    # rolls back above the global checkpoint on applying
+                    # this state and peer-recovers under the new term
+                    e["replicas"] = list(e["replicas"]) + [node]
                 e["in_sync"] = [n for n in e["in_sync"]
                                 if n != node and n in (
                                     [promo] + e["replicas"])]
@@ -825,7 +894,17 @@ class ClusterNode:
         """Primary write: execute locally, then fan the op out to every
         assigned replica and wait — an in-sync replica that fails is
         reported to the master, which drops it from the group
-        (ReplicationOperation.execute:139 / performOnReplicas:221)."""
+        (ReplicationOperation.execute:139 / performOnReplicas:221).
+
+        Replication safety: the op is stamped with the routing entry's
+        primary term captured BEFORE executing; a replica that fences it
+        (its entry moved to a higher term) means this node was deposed —
+        it stops acking, self-reports via A_FAIL_COPY, and surfaces a
+        retryable 503, never a false ack.  Before the ack the entry is
+        re-read: the node must still hold the primary slot at the same
+        term (the reference's isPrimaryMode / primary-term re-check)."""
+        from opensearch_tpu.common.telemetry import metrics
+
         index, shard = payload["index"], payload["shard"]
         svc = self.indices.get(index)
         if svc is None:
@@ -833,6 +912,16 @@ class ClusterNode:
                 f"[{index}][{shard}] not on this node")
         engine = svc.engine_for(shard)
         entry = self._entry(index, shard)
+        term = int(entry.get("primary_term", 1))
+        if entry.get("primary") != self.node_id:
+            # misrouted (or raced a failover): refuse before touching the
+            # engine — a non-primary executing a write is the split-brain
+            # seed the whole fencing layer exists to prevent
+            metrics().counter("replication.fenced_ops").inc()
+            raise PrimaryFencedError(
+                f"[{index}][{shard}] node [{self.node_id}] does not hold "
+                f"the primary slot at term [{term}] — retry routes to "
+                "the current primary")
         if payload["op"] == "index":
             import json as _json
             n_bytes = len(_json.dumps(payload["source"],
@@ -845,27 +934,30 @@ class ClusterNode:
             r = engine.delete(payload["id"])
         engine.ensure_synced()
         replicas = list(entry.get("replicas") or [])
+        in_sync = set(entry.get("in_sync") or [])
         if replicas:
             rep_op = {"op": payload["op"], "id": r.doc_id,
                       "source": payload.get("source"),
                       "routing": payload.get("routing"),
                       "seq_no": r.seq_no, "version": r.version,
-                      "primary_term": int(entry.get("primary_term", 1))}
+                      "primary_term": term,
+                      # the primary's global checkpoint rides every
+                      # replication op (ReplicationOperation piggyback)
+                      "global_checkpoint": engine.global_checkpoint}
             rep_payload = {"index": index, "shard": shard, "rep_op": rep_op}
             futures = [(rep, self.transport.submit_request(
                 rep, A_REPLICATE_OP, rep_payload)) for rep in replicas]
-            in_sync = set(entry.get("in_sync") or [])
             for rep, fut in futures:
                 try:
                     try:
-                        fut.result(timeout=10.0)
+                        resp = fut.result(timeout=10.0)
                     except (NodeDisconnectedError, ReceiveTimeoutError,
                             FuturesTimeout):
                         # transient blip: re-send with bounded backoff
                         # before evicting the copy — replica ops are
                         # seq-no idempotent, so a duplicate of a frame
                         # that DID land is harmless
-                        retry_call(
+                        resp = retry_call(
                             "replication",
                             lambda rep=rep: self.transport.send_request(
                                 rep, A_REPLICATE_OP, rep_payload,
@@ -875,21 +967,37 @@ class ClusterNode:
                             seed=zlib.crc32(rep.encode()))
                     # the ack advances the replica's retention lease —
                     # translog history stays bounded by the slowest
-                    # replica's checkpoint (RetentionLease renewal)
+                    # replica's checkpoint (RetentionLease renewal) —
+                    # and its reported local checkpoint feeds the
+                    # global-checkpoint computation below
                     engine.add_retention_lease(rep, r.seq_no)
+                    lc = (resp.get("local_checkpoint")
+                          if isinstance(resp, dict) else None)
+                    self._track_replica_ckpt(
+                        index, shard, rep,
+                        lc if lc is not None else r.seq_no)
                 except Exception as exc:
-                    if getattr(exc, "remote_type", None) == \
-                            "version_conflict_engine_exception":
+                    if getattr(exc, "remote_type", None) in (
+                            "version_conflict_engine_exception",
+                            "primary_fenced_exception"):
                         # the replica fenced US for a stale primary term:
                         # the replica is ahead, not broken.  Failing it
-                        # would evict an up-to-date copy; instead refuse
-                        # the write so the client retries against the new
-                        # primary (ReplicationOperation fails the primary
-                        # itself on fencing rejections).
-                        raise VersionConflictError(
-                            r.doc_id, "current primary term",
-                            "stale primary (fenced by replica "
-                            f"[{rep}])") from exc
+                        # would evict an up-to-date copy; instead THIS
+                        # node is the deposed one — stop acking, report
+                        # ourselves failed so the master promotes a safe
+                        # copy if it hasn't already, and refuse with a
+                        # retryable 503 so the client re-routes to the
+                        # new primary (ReplicationOperation fails the
+                        # primary itself on fencing rejections).
+                        self._on_primary_fenced(
+                            index, shard, term,
+                            f"fenced by replica [{rep}] while "
+                            f"replicating seq [{r.seq_no}]")
+                        raise PrimaryFencedError(
+                            f"[{index}][{shard}] primary term [{term}] "
+                            f"was fenced by replica [{rep}] — this node "
+                            "no longer holds the primary slot; write "
+                            "not acknowledged") from exc
                     if rep in in_sync:
                         # the copy must leave the in-sync set BEFORE we ack,
                         # or a later promotion could elect a copy missing
@@ -902,19 +1010,128 @@ class ClusterNode:
                                 "could not be reported to the cluster "
                                 "manager — write not acknowledged")
                     # non-in-sync copies are still recovering: best effort
+        # advance the global checkpoint: min over the in-sync copies'
+        # local checkpoints (ReplicationTracker.computeGlobalCheckpoint)
+        self._update_global_ckpt(index, shard, in_sync, engine)
+        # pre-ack re-validation: this node must STILL hold the primary
+        # slot at the term the op executed under — a concurrent failover
+        # (eviction + promotion elsewhere) means the op may never reach
+        # the new lineage, so acking it would be a durability lie
+        try:
+            cur = self._entry(index, shard)
+        except OpenSearchTpuError:
+            cur = None
+        if cur is None or cur.get("primary") != self.node_id \
+                or int(cur.get("primary_term", 1)) != term:
+            self._on_primary_fenced(
+                index, shard, term,
+                "primary slot re-validation failed before ack: entry is "
+                f"now [{(cur or {}).get('primary')}] at term "
+                f"[{(cur or {}).get('primary_term')}]")
+            raise PrimaryFencedError(
+                f"[{index}][{shard}] lost the primary slot at term "
+                f"[{term}] before the ack — write not acknowledged")
         return {"_index": index, "_id": r.doc_id,
                 "_version": r.version, "_seq_no": r.seq_no,
                 # the ROUTING entry's term, not a hardcoded 1: fencing
                 # (promotions bump it) is observable to clients
-                "_primary_term": int(entry.get("primary_term", 1)),
+                "_primary_term": term,
                 "result": r.result, "_shard": shard}
 
+    def _on_primary_fenced(self, index: str, shard: int, term: int,
+                           why: str):
+        """Common exit for every stop-acking path: count, capture, and
+        self-report deposed (best effort — if no master is reachable the
+        refused ack already keeps clients safe)."""
+        from opensearch_tpu.common.telemetry import flight_recorder, metrics
+
+        metrics().counter("replication.fenced_ops").inc()
+        flight_recorder().record(
+            "primary_fenced",
+            f"[{index}][{shard}] primary [{self.node_id}] at term "
+            f"[{term}] stopped acking: {why}",
+            detail={"index": index, "shard": shard,
+                    "node": self.node_id, "term": term, "why": why})
+        self._report_failed_copy(index, shard, self.node_id,
+                                 deposed=True)
+
+    def _track_replica_ckpt(self, index: str, shard: int, node: str,
+                            ckpt: int):
+        with self._lock:
+            m = self._local_ckpts.setdefault((index, shard), {})
+            m[node] = max(int(ckpt), m.get(node, -1))
+
+    def _update_global_ckpt(self, index: str, shard: int, in_sync: set,
+                            engine) -> None:
+        """Global checkpoint = min local checkpoint over the in-sync set
+        (this primary included).  An in-sync copy we have no report from
+        yet pins the computation at -1 — conservative, never unsafe."""
+        with self._lock:
+            tracked = dict(self._local_ckpts.get((index, shard), {}))
+        vals = [engine.local_checkpoint]
+        vals += [tracked.get(n, -1) for n in in_sync if n != self.node_id]
+        engine.update_global_checkpoint(min(vals))
+
+    def replication_stats(self) -> dict:
+        """The replication-safety observability block (``_nodes/stats``
+        ``replication``): per-local-shard term/checkpoint positions, the
+        primary's tracked per-copy local checkpoints, and the
+        replication.* counter family."""
+        from opensearch_tpu.common.telemetry import metrics
+
+        m = metrics()
+        shards = []
+        try:
+            state = self.coordinator.state()
+        except Exception:  # noqa: BLE001 — stats must not throw pre-join
+            state = None
+        for name, svc in sorted(self.indices.items()):
+            for sid, engine in sorted(svc.local_shards.items()):
+                role = "unassigned"
+                term = None
+                if state is not None:
+                    try:
+                        e = state.routing[name][sid]
+                        term = int(e.get("primary_term", 1))
+                        if e.get("primary") == self.node_id:
+                            role = "primary"
+                        elif self.node_id in (e.get("replicas") or []):
+                            role = "replica"
+                        elif self.node_id in (e.get("search_replicas")
+                                              or []):
+                            role = "search"
+                    except (KeyError, IndexError):
+                        pass
+                shards.append({
+                    "index": name, "shard": sid, "role": role,
+                    "routing_primary_term": term,
+                    "engine_primary_term": engine.primary_term,
+                    "max_seq_no": engine._seq_no,
+                    "local_checkpoint": engine.local_checkpoint,
+                    "global_checkpoint": engine.global_checkpoint,
+                })
+        with self._lock:
+            tracked = {f"{k[0]}/{k[1]}": dict(v)
+                       for k, v in sorted(self._local_ckpts.items())}
+        return {
+            "shards": shards,
+            "tracked_local_checkpoints": tracked,
+            # metric-name-ok: bounded replication counter family
+            "counters": {name: m.counter(f"replication.{name}").value
+                         for name in ("fenced_ops",
+                                      "stale_primary_rejections",
+                                      "rollbacks", "resyncs",
+                                      "resync_failures",
+                                      "durability_checked_ops")},
+        }
+
     def _report_failed_copy(self, index: str, shard: int,
-                            node: str, corrupted: bool = False) -> bool:
+                            node: str, corrupted: bool = False,
+                            deposed: bool = False) -> bool:
         try:
             master = self._master()
             payload = {"index": index, "shard": shard, "node": node,
-                       "corrupted": corrupted}
+                       "corrupted": corrupted, "deposed": deposed}
             if master == self.node_id:
                 self._h_fail_copy(payload)
             else:
@@ -1036,14 +1253,149 @@ class ClusterNode:
                 name=f"re-recovery-{self.node_id}-{index}-{shard}").start()
 
     def _h_replicate_op(self, payload: dict) -> dict:
-        svc = self.indices.get(payload["index"])
+        """Replica write: FENCE FIRST — an op stamped below the routing
+        entry's current primary term comes from a deposed primary that
+        doesn't know it yet (split brain); applying it would diverge
+        this copy from the lineage the new primary is building.  The
+        routing entry hears about promotions before the engine does
+        (the engine's own term only advances with applied ops), so the
+        fence floor is the max of both views (ReplicationTracker term
+        fencing / IndexShard.applyIndexOperationOnReplica).  An apply
+        failure propagates to the primary — which fails this copy out of
+        in-sync BEFORE the client ack — never into a silent local skip."""
+        index, shard = payload["index"], payload["shard"]
+        svc = self.indices.get(index)
         if svc is None:
             raise ShardNotFoundError(
-                f"[{payload['index']}][{payload['shard']}] not on this node")
-        engine = svc.engine_for(payload["shard"])
-        engine.apply_replica_op(payload["rep_op"])
+                f"[{index}][{shard}] not on this node")
+        engine = svc.engine_for(shard)
+        rep_op = payload["rep_op"]
+        op_term = int(rep_op.get("primary_term", 1))
+        floor = self._fence_floor(index, shard, engine)
+        if op_term < floor:
+            self._record_stale_primary(index, shard, op_term, floor,
+                                       rep_op.get("id"))
+            raise VersionConflictError(
+                str(rep_op.get("id")), f"primary term >= {floor}",
+                f"stale primary term {op_term}")
+        engine.apply_replica_op(rep_op)
         engine.ensure_synced()
-        return {"acknowledged": True}
+        # the reported local checkpoint feeds the primary's global-
+        # checkpoint computation (ReplicationResponse piggyback)
+        return {"acknowledged": True,
+                "local_checkpoint": engine.local_checkpoint}
+
+    def _fence_floor(self, index: str, shard: int, engine) -> int:
+        """The minimum primary term this copy accepts ops under: the
+        routing entry's term when cluster state is available (it knows
+        about promotions the engine hasn't seen an op under yet), the
+        engine's own term always."""
+        floor = int(engine.primary_term)
+        try:
+            entry = self._entry(index, shard)
+        except OpenSearchTpuError:
+            return floor   # no routing yet (recovery races)
+        return max(floor, int(entry.get("primary_term", 1)))
+
+    def _record_stale_primary(self, index: str, shard: int, op_term: int,
+                              floor: int, doc_id):
+        from opensearch_tpu.common.telemetry import flight_recorder, metrics
+
+        metrics().counter("replication.stale_primary_rejections").inc()
+        flight_recorder().record(
+            "stale_primary_fenced",
+            f"[{index}][{shard}] fenced op at term [{op_term}] below "
+            f"current term [{floor}] on [{self.node_id}]",
+            detail={"index": index, "shard": shard, "node": self.node_id,
+                    "op_term": op_term, "current_term": floor,
+                    "doc_id": str(doc_id)})
+
+    def _h_resync(self, payload: dict) -> dict:
+        """Replica side of the promotion resync (PrimaryReplicaSyncer /
+        TransportResyncReplicationAction): validate the NEW primary's
+        term against the routing entry — a stale 'primary' cannot roll
+        anyone back — then drop local ops above the old global
+        checkpoint and apply the promoted lineage's retained ops (which
+        keep their ORIGINAL terms, like the reference's translog-sourced
+        resync)."""
+        index, shard = payload["index"], payload["shard"]
+        svc = self.indices.get(index)
+        if svc is None:
+            raise ShardNotFoundError(
+                f"[{index}][{shard}] not on this node")
+        engine = svc.engine_for(shard)
+        term = int(payload.get("primary_term", 1))
+        floor = self._fence_floor(index, shard, engine)
+        if term < floor:
+            self._record_stale_primary(index, shard, term, floor,
+                                       "<resync>")
+            raise VersionConflictError(
+                "<resync>", f"primary term >= {floor}",
+                f"stale primary term {term}")
+        rolled = engine.rollback_above(int(payload.get("above", -1)))
+        if rolled:
+            from opensearch_tpu.common.telemetry import metrics
+            metrics().counter("replication.rollbacks").inc()
+        for op in payload.get("ops") or []:
+            # ops keep their original terms: the engine's term may
+            # already be past them (the promotion bumped it), so the
+            # per-op fence is waived — the RESYNC term was validated
+            engine.apply_replica_op(op, fence=False)
+        engine.advance_primary_term(term)
+        engine.ensure_synced()
+        return {"acknowledged": True, "rolled_back": rolled,
+                "local_checkpoint": engine.local_checkpoint}
+
+    def _run_primary_resync(self, index: str, shard: int, term: int):
+        """New-primary side: after promotion, bring every in-sync peer
+        onto this copy's lineage — peers roll back above the old global
+        checkpoint and replay our retained ops above it.  Best effort
+        per peer: an unreachable one is the fault detector's problem
+        (it leaves in-sync and re-recovers under the new term)."""
+        from opensearch_tpu.common.telemetry import flight_recorder, metrics
+
+        try:
+            svc = self.indices.get(index)
+            if svc is None:
+                return
+            engine = svc.engine_for(shard)
+            gckpt = int(engine.global_checkpoint)
+            ops = engine.ops_since(gckpt)
+            entry = self._entry(index, shard)
+        except OpenSearchTpuError:
+            return
+        if ops is None:
+            # no contiguous history above the checkpoint: rolling peers
+            # back without the ops to replay could CANCEL acked writes —
+            # leave them; file-copy recovery re-bootstraps stragglers
+            flight_recorder().record(
+                "resync_skipped",
+                f"[{index}][{shard}] promotion resync skipped: no "
+                f"contiguous op history above checkpoint [{gckpt}]",
+                detail={"index": index, "shard": shard, "above": gckpt})
+            return
+        targets = [n for n in (entry.get("replicas") or [])
+                   if n in (entry.get("in_sync") or [])
+                   and n != self.node_id]
+        payload = {"index": index, "shard": shard,
+                   "primary_term": int(term), "above": gckpt, "ops": ops}
+        for rep in targets:
+            try:
+                resp = self.transport.send_request(
+                    rep, A_RESYNC, payload, timeout=self.recovery_timeout)
+                metrics().counter("replication.resyncs").inc()
+                lc = resp.get("local_checkpoint") \
+                    if isinstance(resp, dict) else None
+                if lc is not None:
+                    self._track_replica_ckpt(index, shard, rep, lc)
+            except OpenSearchTpuError:
+                metrics().counter("replication.resync_failures").inc()
+                flight_recorder().record(
+                    "resync_failed",
+                    f"[{index}][{shard}] promotion resync to [{rep}] "
+                    "failed",
+                    detail={"index": index, "shard": shard,
+                            "target": rep, "above": gckpt})
 
     def _h_get_doc(self, payload: dict) -> dict:
         svc = self.indices.get(payload["index"])

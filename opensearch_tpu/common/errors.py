@@ -125,6 +125,21 @@ class VersionConflictError(OpenSearchTpuError):
         )
 
 
+class PrimaryFencedError(OpenSearchTpuError):
+    """The node executing a write no longer holds the primary slot at the
+    current primary term — a replica fenced its replication op, or the
+    routing entry moved on before the ack (index/shard/ShardNotInPrimaryMode
+    / the reference's isPrimaryMode fencing).
+
+    503, not 409: the WRITE may well succeed against the new primary — the
+    coordinator/client should re-route and retry, never treat the fence as
+    a document-level conflict.  Critically this is raised INSTEAD of an
+    ack: an op that was fenced is not durable and must not be reported as
+    such."""
+
+    status = 503
+
+
 class CircuitBreakingError(OpenSearchTpuError):
     """Memory budget exceeded (common/breaker/CircuitBreakingException)."""
 

@@ -92,6 +92,22 @@ class InternalEngine:
         self._version_map: dict[str, VersionEntry] = {}
         self._pending_deletes: list[tuple[Segment, int]] = []
         self._seq_no = -1
+        # local checkpoint: highest seq_no below which EVERY op has been
+        # processed on this copy (LocalCheckpointTracker analog) — the
+        # value replicas report back so the primary can compute the
+        # global checkpoint.  Non-contiguous arrivals park in
+        # _pending_seqs until the gap fills.
+        self._local_ckpt = -1
+        self._pending_seqs: set[int] = set()
+        # global checkpoint: highest seq_no known durable on EVERY
+        # in-sync copy (GlobalCheckpointTracker analog).  Computed by the
+        # primary, piggybacked to replicas on replication ops; ops above
+        # it are the rollback set on demotion.
+        self.global_checkpoint = -1
+        # doc id -> primary term of the op that last touched it; the
+        # (primary_term, seq_no) half of the durability audit's per-copy
+        # digest.  Terms == 1 are implicit (kept out of commits).
+        self._doc_terms: dict[str, int] = {}
         # replica mode: primary-replicated ops not yet covered by an
         # installed segment checkpoint, keyed by seq_no
         self._replica_ops: dict[int, dict] = {}
@@ -145,6 +161,10 @@ class InternalEngine:
                 commit = json.load(f)
             committed_seq = commit["max_seq_no"]
             self._seg_counter = commit.get("seg_counter", 0)
+            self.primary_term = max(self.primary_term,
+                                    int(commit.get("primary_term", 1)))
+            self._doc_terms = {str(k): int(v) for k, v in
+                               (commit.get("doc_terms") or {}).items()}
             for seg_id in commit["segments"]:
                 try:
                     seg = load_segment(seg_dir, seg_id)
@@ -157,6 +177,7 @@ class InternalEngine:
                 self.segments.append(seg)
                 self._persisted_segments.add(seg_id)
             self._seq_no = committed_seq
+            self._advance_local_ckpt_to(committed_seq)
             # GC segment files the commit doesn't reference (a crash
             # between commit write and obsolete-file deletion leaks them)
             if os.path.isdir(seg_dir):
@@ -178,7 +199,48 @@ class InternalEngine:
         elif op["op"] == "delete":
             self._do_delete(op["id"], seq_no=op["seq_no"],
                             version=op["version"], record=False)
+        # an op recorded under an older primary keeps that term across
+        # replay — replayed history must digest identically on every copy
+        if op.get("primary_term") is not None:
+            self._doc_terms[str(op["id"])] = int(op["primary_term"])
         self._seq_no = max(self._seq_no, op["seq_no"])
+        self._mark_seq_processed(int(op["seq_no"]))
+
+    # -- checkpoint trackers ----------------------------------------------
+
+    def _mark_seq_processed(self, seq: int):
+        """Advance the local checkpoint past ``seq`` once contiguous
+        (LocalCheckpointTracker.markSeqNoAsProcessed analog)."""
+        if seq == self._local_ckpt + 1:
+            self._local_ckpt = seq
+            while self._local_ckpt + 1 in self._pending_seqs:
+                self._local_ckpt += 1
+                self._pending_seqs.discard(self._local_ckpt)
+        elif seq > self._local_ckpt:
+            self._pending_seqs.add(seq)
+
+    def _advance_local_ckpt_to(self, seq: int):
+        """A checkpoint install covers EVERY op <= seq: jump the tracker
+        forward even over gaps this copy never saw individually."""
+        if seq > self._local_ckpt:
+            self._local_ckpt = int(seq)
+        self._pending_seqs = {s for s in self._pending_seqs
+                              if s > self._local_ckpt}
+        while self._local_ckpt + 1 in self._pending_seqs:
+            self._local_ckpt += 1
+            self._pending_seqs.discard(self._local_ckpt)
+
+    @property
+    def local_checkpoint(self) -> int:
+        with self._lock:
+            return self._local_ckpt
+
+    def update_global_checkpoint(self, gckpt: int):
+        """Monotonic: the global checkpoint only advances (the primary
+        recomputes it as min over in-sync local checkpoints; replicas
+        learn it piggybacked on replication ops)."""
+        with self._lock:
+            self.global_checkpoint = max(self.global_checkpoint, int(gckpt))
 
     def close(self):
         with self._lock:
@@ -291,6 +353,7 @@ class InternalEngine:
                                     seq_no=seq, version=new_version,
                                     record=True)
             self._seq_no = seq
+            self._mark_seq_processed(seq)
         m = metrics()
         m.counter("indexing.ops").inc()
         m.histogram("indexing.index_ms").observe(
@@ -310,7 +373,8 @@ class InternalEngine:
                 encoded = self.translog.encode(
                     {"op": "index", "id": str(doc_id), "source": source,
                      "routing": routing, "seq_no": seq_no,
-                     "version": version})
+                     "version": version,
+                     "primary_term": self.primary_term})
             except (TypeError, ValueError) as e:
                 raise MapperParsingError(
                     f"source for [{doc_id}] is not JSON-serializable: {e}")
@@ -327,6 +391,7 @@ class InternalEngine:
             hot_idx=len(self._hot) - 1)
         if record:
             self.translog.add_encoded(encoded)
+        self._doc_terms[str(doc_id)] = self.primary_term
         return OpResult(str(doc_id), seq_no, version,
                         "updated" if existed else "created",
                         primary_term=self.primary_term)
@@ -357,6 +422,7 @@ class InternalEngine:
             result = self._do_delete(doc_id, seq_no=seq, version=new_version,
                                      record=True)
             self._seq_no = seq
+            self._mark_seq_processed(seq)
             return result
 
     def _do_delete(self, doc_id, seq_no, version, record: bool) -> OpResult:
@@ -369,7 +435,9 @@ class InternalEngine:
             seq_no=seq_no, version=version, deleted=True)
         if record:
             self.translog.add({"op": "delete", "id": str(doc_id),
-                               "seq_no": seq_no, "version": version})
+                               "seq_no": seq_no, "version": version,
+                               "primary_term": self.primary_term})
+        self._doc_terms[str(doc_id)] = self.primary_term
         return OpResult(str(doc_id), seq_no, version, "deleted",
                         primary_term=self.primary_term)
 
@@ -387,18 +455,22 @@ class InternalEngine:
     # installs the copied segments (ref index/engine/NRTReplicationEngine.java,
     # indices/replication/SegmentReplicationTargetService.java:208).
 
-    def apply_replica_op(self, op: dict):
+    def apply_replica_op(self, op: dict, fence: bool = True):
         """Apply one primary-replicated op: translog append + version-map
         entry + op buffer.  Fenced by primary term (a stale primary's ops
-        are rejected, ref IndexShard.applyIndexOperationOnReplica:954)."""
+        are rejected, ref IndexShard.applyIndexOperationOnReplica:954).
+        ``fence=False`` is for promotion-resync replay only: resync ops
+        keep their ORIGINAL terms (which may be below this engine's,
+        already bumped by the promotion) — the resync request itself was
+        term-validated by the transport handler."""
         with self._lock:
             self._ensure_writeable()
             term = int(op.get("primary_term", 1))
-            if term < self.primary_term:
+            if fence and term < self.primary_term:
                 raise VersionConflictError(
                     str(op.get("id")), f"primary term >= {self.primary_term}",
                     f"stale primary term {term}")
-            self.primary_term = term
+            self.primary_term = max(self.primary_term, term)
             seq = int(op["seq_no"])
             encoded = self.translog.encode(op)
             self.translog.add_encoded(encoded)
@@ -408,7 +480,14 @@ class InternalEngine:
                 self._version_map[str(op["id"])] = VersionEntry(
                     seq_no=seq, version=int(op["version"]),
                     deleted=op["op"] == "delete", hot_idx=-1)
+                self._doc_terms[str(op["id"])] = term
             self._seq_no = max(self._seq_no, seq)
+            self._mark_seq_processed(seq)
+            # the primary's view of the global checkpoint rides every
+            # replication op (ReplicationOperation piggyback)
+            if op.get("global_checkpoint") is not None:
+                self.global_checkpoint = max(
+                    self.global_checkpoint, int(op["global_checkpoint"]))
 
     # -- retention leases (index/seqno/RetentionLease.java analog) --------
 
@@ -457,7 +536,12 @@ class InternalEngine:
                     "live": {s.seg_id: s.live.tobytes()
                              for s in self.segments},
                     "max_seq_no": self._seq_no,
-                    "primary_term": self.primary_term}
+                    "primary_term": self.primary_term,
+                    # per-doc terms ride the checkpoint so replica and
+                    # search-tier digests stay term-comparable (term 1
+                    # is implicit)
+                    "doc_terms": {k: v for k, v in self._doc_terms.items()
+                                  if v > 1}}
 
     def segments_blobs(self, seg_ids: list) -> dict:
         """Serialize the requested segments for wire copy (recovery
@@ -499,6 +583,9 @@ class InternalEngine:
             self.segments = new_segments
             covered = int(ckpt["max_seq_no"])
             self._seq_no = max(self._seq_no, covered)
+            self._advance_local_ckpt_to(covered)
+            for k, v in (ckpt.get("doc_terms") or {}).items():
+                self._doc_terms[str(k)] = int(v)
             self._replica_ops = {s: op for s, op in self._replica_ops.items()
                                  if s > covered}
             self._version_map = {k: v for k, v in self._version_map.items()
@@ -538,6 +625,9 @@ class InternalEngine:
                 self._persisted_segments.add(sid)
             self.segments = segments
             self._seq_no = max(self._seq_no, int(ckpt["max_seq_no"]))
+            self._advance_local_ckpt_to(int(ckpt["max_seq_no"]))
+            for k, v in (ckpt.get("doc_terms") or {}).items():
+                self._doc_terms[str(k)] = int(v)
             self._searcher = None
 
     def promote_to_primary(self, term: int):
@@ -555,6 +645,141 @@ class InternalEngine:
                 self._version_map.pop(str(op["id"]), None)
             for op in ops:
                 self._replay(op)
+
+    def advance_primary_term(self, term: int):
+        """Monotonically adopt a (validated) new primary term — the
+        replica side of a promotion resync bumps its engine term here
+        after replaying the resync ops, which keep their original
+        (older) terms."""
+        with self._lock:
+            self.primary_term = max(self.primary_term, int(term))
+
+    def rollback_above(self, seq: int) -> int:
+        """Drop every op with seq_no above ``seq`` from this copy — the
+        deposed-primary / divergent-replica rollback (the reference's
+        resetEngineToGlobalCheckpoint +
+        trimOperationsOfPreviousPrimaryTerms).  Ops above the global
+        checkpoint were never acked against a full in-sync set, so
+        cancelling them cannot lose an acked write; a doc UPDATED above
+        the cut resurrects its newest retained version at or below it.
+        Durable: the translog gets a trim marker before in-memory state
+        changes, so a restart replays the post-rollback history.
+        Returns the number of ops rolled back."""
+        with self._lock:
+            self._ensure_open()
+            seq = int(seq)
+            if self._seq_no <= seq:
+                return 0
+            self.translog.trim_above(seq)
+            dropped = len([s for s in self._replica_ops if s > seq])
+            self._replica_ops = {s: op for s, op in
+                                 self._replica_ops.items() if s <= seq}
+            removed: list[str] = []
+            for doc_id, e in list(self._version_map.items()):
+                if e.seq_no > seq:
+                    if e.hot_idx >= 0 and self._hot[e.hot_idx] is not None:
+                        self._hot[e.hot_idx] = None
+                        dropped += 1
+                    del self._version_map[doc_id]
+                    self._doc_terms.pop(doc_id, None)
+                    removed.append(doc_id)
+            # already-refreshed divergent docs: clear their live bits so
+            # the newest retained copy (an older segment doc) resurfaces
+            for seg in self.segments:
+                locals_ = [i for i in range(seg.n_docs)
+                           if seg.live[i] and int(seg.seq_nos[i]) > seq]
+                if locals_:
+                    seg.apply_deletes(locals_)
+                    self._live_dirty.add(seg.seg_id)
+                    dropped += len(locals_)
+            # a rolled-back update/delete queued a tombstone against the
+            # doc's OLDER copy — keep it only if a live newer version of
+            # that doc still exists, else the old copy must stay live
+            kept = []
+            for seg, local in self._pending_deletes:
+                did = str(seg.doc_ids[local])
+                cur = self._current_entry(did)
+                if cur is not None and not cur.deleted \
+                        and cur.seq_no > int(seg.seq_nos[local]):
+                    kept.append((seg, local))
+            self._pending_deletes = kept
+            # a doc written twice above+below the cut lost its retained
+            # in-memory copy when the second write nulled the first's hot
+            # slot — re-apply the newest retained translog op for it
+            for doc_id in removed:
+                best = None
+                for op in self.translog.read_ops(-1):
+                    if str(op.get("id")) == doc_id and \
+                            (best is None
+                             or op["seq_no"] > best["seq_no"]):
+                        best = op
+                cur = self._current_entry(doc_id)
+                if best is not None and (cur is None
+                                         or cur.seq_no < best["seq_no"]):
+                    self._replay(best)
+            self._seq_no = seq
+            self._local_ckpt = min(self._local_ckpt, seq)
+            self._pending_seqs = {s for s in self._pending_seqs
+                                  if s <= seq}
+            self._searcher = None
+            return dropped
+
+    def replication_digest(self) -> dict:
+        """Per-doc ``(seq_no, primary_term, version, content-crc)`` over
+        every live doc on this copy, plus rolled-up digests — the
+        durability audit's cross-copy parity probe.  ``digest`` covers the
+        full tuple; ``seq_digest`` leaves the term out, for search-tier
+        copies whose pull-path refill cannot recover per-doc terms."""
+        import zlib as _zlib
+        with self._lock:
+            self._ensure_open()
+            ids = set(self._version_map)
+            for seg in self.segments:
+                ids.update(str(i) for i in seg.id_to_local)
+            docs: dict[str, list] = {}
+            for doc_id in sorted(ids):
+                e = self._version_map.get(doc_id)
+                src = None
+                if e is not None:
+                    if e.deleted:
+                        continue
+                    if e.hot_idx >= 0:
+                        d = self._hot[e.hot_idx]
+                        src = d.source if d is not None else None
+                    else:
+                        rop = self._replica_ops.get(e.seq_no)
+                        if rop is not None and str(rop["id"]) == doc_id:
+                            src = rop["source"]
+                if e is None or src is None:
+                    for seg in reversed(self.segments):
+                        local = seg.id_to_local.get(doc_id)
+                        if local is not None and seg.live[local]:
+                            if e is None:
+                                e = VersionEntry(
+                                    seq_no=int(seg.seq_nos[local]),
+                                    version=int(seg.versions[local]),
+                                    deleted=False)
+                            src = seg.source(local)
+                            break
+                    if e is None:
+                        continue
+                crc = 0
+                if src is not None:
+                    crc = _zlib.crc32(json.dumps(
+                        src, sort_keys=True,
+                        separators=(",", ":")).encode()) & 0xFFFFFFFF
+                docs[doc_id] = [int(e.seq_no),
+                                int(self._doc_terms.get(doc_id, 1)),
+                                int(e.version), crc]
+            blob = json.dumps(sorted(docs.items()),
+                              separators=(",", ":")).encode()
+            seq_blob = json.dumps(
+                sorted((k, [v[0], v[2], v[3]]) for k, v in docs.items()),
+                separators=(",", ":")).encode()
+            return {"docs": docs,
+                    "doc_count": len(docs),
+                    "digest": _zlib.crc32(blob) & 0xFFFFFFFF,
+                    "seq_digest": _zlib.crc32(seq_blob) & 0xFFFFFFFF}
 
     # -- read path --------------------------------------------------------
 
@@ -695,7 +920,12 @@ class InternalEngine:
             commit = {"segments": [s.seg_id for s in self.segments],
                       "max_seq_no": self._seq_no,
                       "seg_counter": self._seg_counter,
-                      "translog_generation": self.translog.generation}
+                      "translog_generation": self.translog.generation,
+                      "primary_term": self.primary_term,
+                      # per-doc terms survive restart so the durability
+                      # digest stays copy-comparable (term 1 implicit)
+                      "doc_terms": {k: v for k, v in
+                                    self._doc_terms.items() if v > 1}}
             tmp = os.path.join(self.data_path, self.COMMIT_FILE + ".tmp")
             with open(tmp, "w") as f:
                 json.dump(commit, f)
@@ -801,6 +1031,8 @@ class InternalEngine:
                 "docs": {"count": self.doc_count()},
                 "segments": {"count": len(self.segments)},
                 "seq_no": {"max_seq_no": self._seq_no,
-                           "local_checkpoint": self._seq_no},
+                           "local_checkpoint": self._local_ckpt,
+                           "global_checkpoint": self.global_checkpoint,
+                           "primary_term": self.primary_term},
                 "translog": {"generation": self.translog.generation},
             }

@@ -205,6 +205,17 @@ class Translog:
         self._synced_offset = 0
         self._write_checkpoint()
 
+    def trim_above(self, seq_no: int):
+        """Append a trim marker: retained ops with ``seq_no`` ABOVE the cut
+        are dropped on replay (Translog.trimOperations /
+        trimOperationsOfPreviousPrimaryTerms analog).  Used when a deposed
+        primary (or a divergent replica) rolls back ops above the global
+        checkpoint before rejoining the new primary's lineage — the WAL
+        stays append-only, so the rollback itself is as durable as the ops
+        it cancels."""
+        self.add({"_trim_above": int(seq_no)})
+        self.sync()
+
     def trim(self, min_generation: int):
         """Delete generations below ``min_generation`` (post-commit)."""
         min_generation = min(min_generation, self.generation)
@@ -225,7 +236,12 @@ class Translog:
     def read_ops(self, min_seq_no: int = -1) -> Iterator[dict]:
         """Replay all retained ops with seq_no > min_seq_no, oldest first.
         A corrupt NON-tail line raises; a corrupt tail (torn final write)
-        is discarded silently, matching reference recovery semantics."""
+        is discarded silently, matching reference recovery semantics.
+        ``_trim_above`` markers (see trim_above) cancel earlier retained
+        ops above their cut and are never yielded themselves — a resync op
+        re-written at the same seq under the new term lands after the
+        marker, so replay converges on the post-rollback state."""
+        buffered: list[dict] = []
         for gen in range(self.min_generation, self.generation + 1):
             p = self._gen_path(gen)
             if not os.path.exists(p):
@@ -257,8 +273,15 @@ class Translog:
                     raise TranslogCorruptedError(
                         f"translog generation [{gen}] line [{i}] checksum mismatch")
                 op = json.loads(payload)
-                if op.get("seq_no", -1) > min_seq_no:
-                    yield op
+                if "_trim_above" in op:
+                    cut = int(op["_trim_above"])
+                    buffered = [o for o in buffered
+                                if o.get("seq_no", -1) <= cut]
+                    continue
+                buffered.append(op)
+        for op in buffered:
+            if op.get("seq_no", -1) > min_seq_no:
+                yield op
 
     def ops_count(self) -> int:
         return sum(1 for _ in self.read_ops())

@@ -47,6 +47,20 @@ class RestRequest:
     def param(self, name: str, default=None):
         return self.params.get(name, self.path_params.get(name, default))
 
+    def int_param(self, name: str):
+        """Integer query param, or None when absent — garbage is a
+        typed 400 (the reference's number_format_exception), never a
+        raw ValueError 500."""
+        v = self.param(name)
+        if v is None:
+            return None
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            from opensearch_tpu.common.errors import IllegalArgumentError
+            raise IllegalArgumentError(
+                f"[{name}] must be an integer, got [{v}]")
+
     def flag(self, name: str) -> bool:
         v = self.params.get(name)
         return v is not None and str(v).lower() in ("", "true", "1")
@@ -786,6 +800,10 @@ class RestController:
                 # (incl. PR 8's corrupt-blob re-requests) + per-shard
                 # store state, the JSON face of GET /_cat/recovery
                 "recovery": self._recovery_stats(),
+                # replication safety: per-shard (term, checkpoint)
+                # positions + the fencing / rollback / resync counter
+                # family (the write-path durability ledger)
+                "replication": self._replication_stats(),
                 "os": _os_stats(),
                 "process": _process_stats(),
                 # counters + latency histograms with p50/p90/p99 readout
@@ -834,6 +852,37 @@ class RestController:
                              "bytes_pulled", "corrupt_blobs",
                              "refills", "refill_failures")},
             "shards": shards,
+        }
+
+    def _replication_stats(self) -> dict:
+        """Single-node face of the cluster nodes' ``replication_stats()``
+        block: every local shard is its own primary, so the interesting
+        signal here is the (term, local/global checkpoint) positions
+        plus the process-wide replication.* counters (which a cluster
+        test sharing the process also feeds)."""
+        from opensearch_tpu.common.telemetry import metrics
+
+        m = metrics()
+        shards = []
+        for svc in sorted(self.node.indices.indices.values(),
+                          key=lambda s: s.name):
+            for shard_id, engine in sorted(svc.local_shards.items()):
+                shards.append({
+                    "index": svc.name, "shard": shard_id,
+                    "primary_term": engine.primary_term,
+                    "max_seq_no": engine._seq_no,
+                    "local_checkpoint": engine.local_checkpoint,
+                    "global_checkpoint": engine.global_checkpoint,
+                })
+        return {
+            "shards": shards,
+            # metric-name-ok: bounded replication counter family
+            "counters": {name: m.counter(f"replication.{name}").value
+                         for name in ("fenced_ops",
+                                      "stale_primary_rejections",
+                                      "rollbacks", "resyncs",
+                                      "resync_failures",
+                                      "durability_checked_ops")},
         }
 
     def h_nodes_trace(self, req):
@@ -1336,11 +1385,11 @@ class RestController:
                              "result": "noop"}
         kw = {}
         if req.param("if_seq_no") is not None:
-            kw["if_seq_no"] = int(req.param("if_seq_no"))
+            kw["if_seq_no"] = req.int_param("if_seq_no")
         if req.param("if_primary_term") is not None:
-            kw["if_primary_term"] = int(req.param("if_primary_term"))
+            kw["if_primary_term"] = req.int_param("if_primary_term")
         if req.param("version") is not None:
-            kw["version"] = int(req.param("version"))
+            kw["version"] = req.int_param("version")
             kw["version_type"] = req.param("version_type", "internal")
         if ((op_type or req.param("op_type")) == "create"
                 and kw.get("version_type", "internal") != "internal"):
@@ -1381,7 +1430,7 @@ class RestController:
             return 404, {"_index": name, "_id": req.path_params["id"],
                          "found": False}
         if req.param("version") is not None \
-                and int(req.param("version")) != doc["_version"]:
+                and req.int_param("version") != doc["_version"]:
             from opensearch_tpu.common.errors import VersionConflictError
             raise VersionConflictError(req.path_params["id"],
                                        req.param("version"),
@@ -1411,11 +1460,11 @@ class RestController:
         svc = self._single_index(name)
         kw = {}
         if req.param("if_seq_no") is not None:
-            kw["if_seq_no"] = int(req.param("if_seq_no"))
+            kw["if_seq_no"] = req.int_param("if_seq_no")
         if req.param("if_primary_term") is not None:
-            kw["if_primary_term"] = int(req.param("if_primary_term"))
+            kw["if_primary_term"] = req.int_param("if_primary_term")
         if req.param("version") is not None:
-            kw["version"] = int(req.param("version"))
+            kw["version"] = req.int_param("version")
             kw["version_type"] = req.param("version_type", "internal")
         r = svc.delete_doc(req.path_params["id"],
                            routing=req.param("routing"), **kw)
@@ -1444,9 +1493,9 @@ class RestController:
         created = cur is None
         kw = {}
         if req.param("if_seq_no") is not None:
-            kw["if_seq_no"] = int(req.param("if_seq_no"))
+            kw["if_seq_no"] = req.int_param("if_seq_no")
         if req.param("if_primary_term") is not None:
-            kw["if_primary_term"] = int(req.param("if_primary_term"))
+            kw["if_primary_term"] = req.int_param("if_primary_term")
         if kw and cur is None and "upsert" not in body \
                 and not body.get("doc_as_upsert"):
             # CAS on a missing doc is document_missing, not a conflict

@@ -1,0 +1,240 @@
+"""Acked-write durability audit: a history-recording CRUD client plus
+the post-drain checker.
+
+Analog of the Jepsen-style histories the reference's replication work
+was validated against (and of its own ``AbstractDisruptionTestCase``
+acked-write assertions): every write the workload issues is recorded as
+an interval — ``invoke`` when it leaves the client, then exactly one of
+
+- ``ok``       the cluster ACKED it (with the ``(primary_term, seq_no,
+               version)`` triple from the response): a durability
+               promise that must survive every later failover,
+- ``fail``     the cluster DEFINITELY rejected it (a fence 503 raised
+               instead of an ack, a version conflict): the write must
+               never become visible,
+- ``unknown``  the outcome is indeterminate (timeout, partition,
+               retries exhausted): the write may or may not survive —
+               both final states are legal.
+
+After the soak drains, ``DurabilityChecker`` replays the history
+against the cluster's final visible state and the per-copy replication
+digests (``InternalEngine.replication_digest``) and asserts the
+replication-safety contract:
+
+- **no lost acked writes** — a doc whose last settled op was an acked
+  index (with no later-starting op that could supersede it) is present
+  with exactly the acked content; an acked delete stays deleted,
+- **no stale acks / failed writes visible** — content recorded only
+  under ``fail`` outcomes never appears in the final state,
+- **per-doc ``(primary_term, seq_no)`` monotonicity** — over
+  non-overlapping acked ops on one doc, the term-seq pair never goes
+  backwards (a fenced old primary cannot re-ack under its stale term),
+- **cross-copy parity** — no two copies hold the same ``(seq_no,
+  primary_term)`` for a doc with different content (the split-brain
+  signature fencing exists to prevent).
+
+The recorder is deliberately dumb and thread-safe: a list of dicts
+under a lock, a global monotone event counter for interval ordering.
+Everything here is deterministic given a deterministic workload — the
+checker's report feeds the soak's ``no_lost_acked_writes`` /
+``no_stale_acks`` SLO verdicts, which tier-1 replays seed-for-seed.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+__all__ = ["HistoryRecorder", "DurabilityChecker", "canonical"]
+
+
+def canonical(source: Optional[dict]) -> str:
+    """Canonical content key: sorted compact JSON (None for deletes)."""
+    if source is None:
+        return "<deleted>"
+    return json.dumps(source, sort_keys=True, separators=(",", ":"))
+
+
+class HistoryRecorder:
+    """Interval history of CRUD ops (invoke → ok | fail | unknown)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = 0          # global monotone interval clock
+        self.ops: list[dict] = []
+
+    def _tick(self) -> int:
+        self._events += 1
+        return self._events
+
+    def invoke(self, op: str, doc_id: str,
+               source: Optional[dict] = None) -> int:
+        """Record an outbound write; returns the op id to settle with.
+        ``op`` is ``index`` or ``delete``; ``source`` the exact body."""
+        with self._lock:
+            op_id = len(self.ops)
+            self.ops.append({
+                "op_id": op_id, "op": op, "doc_id": str(doc_id),
+                "content": canonical(source if op == "index" else None),
+                "outcome": None, "invoked_at": self._tick(),
+                "settled_at": None, "seq_no": None,
+                "primary_term": None, "version": None, "detail": None,
+            })
+            return op_id
+
+    def _settle(self, op_id: int, outcome: str, detail=None,
+                resp: Optional[dict] = None):
+        with self._lock:
+            rec = self.ops[op_id]
+            if rec["outcome"] is not None:      # first settle wins
+                return
+            rec["outcome"] = outcome
+            rec["settled_at"] = self._tick()
+            rec["detail"] = detail
+            if resp:
+                for k, field in (("_seq_no", "seq_no"),
+                                 ("_primary_term", "primary_term"),
+                                 ("_version", "version")):
+                    if resp.get(k) is not None:
+                        rec[field] = int(resp[k])
+
+    def ok(self, op_id: int, resp: Optional[dict] = None):
+        self._settle(op_id, "ok", resp=resp or {})
+
+    def fail(self, op_id: int, why: str = ""):
+        self._settle(op_id, "fail", detail=why)
+
+    def unknown(self, op_id: int, why: str = ""):
+        self._settle(op_id, "unknown", detail=why)
+
+    def settle_open_as_unknown(self, why: str = "run ended mid-flight"):
+        """Drain hygiene: any interval never settled (worker died, run
+        aborted) is UNKNOWN, never silently dropped."""
+        with self._lock:
+            pending = [r["op_id"] for r in self.ops
+                       if r["outcome"] is None]
+        for op_id in pending:
+            self.unknown(op_id, why)
+
+    @property
+    def checked_ops(self) -> int:
+        with self._lock:
+            return len(self.ops)
+
+    def counts(self) -> dict:
+        with self._lock:
+            out = {"ok": 0, "fail": 0, "unknown": 0}
+            for r in self.ops:
+                out[r["outcome"] or "unknown"] += 1
+            out["total"] = len(self.ops)
+            return out
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return [dict(r) for r in self.ops]
+
+
+class DurabilityChecker:
+    """Post-drain audit of a ``HistoryRecorder`` against final state."""
+
+    def __init__(self, history: HistoryRecorder):
+        self.history = history
+
+    def check(self, final_docs: dict,
+              copy_digests: Optional[list] = None) -> dict:
+        """``final_docs``: doc_id → source from the post-drain search
+        (the client-visible final state).  ``copy_digests``: optional
+        ``[(label, digest_docs), ...]`` where digest_docs is the
+        ``docs`` map of ``replication_digest()`` — used for the
+        duplicate-``(term, seq)``-differing-content cross-copy check.
+        Returns the report; ``ok`` is the single verdict bit and every
+        violation ships with its evidence."""
+        ops = self.history.snapshot()
+        final = {str(k): canonical(v) for k, v in final_docs.items()}
+        by_doc: dict[str, list] = {}
+        for r in ops:
+            by_doc.setdefault(r["doc_id"], []).append(r)
+
+        lost_acked: list[dict] = []
+        stale_acks: list[dict] = []
+        monotonicity: list[dict] = []
+        for doc_id, recs in sorted(by_doc.items()):
+            recs = sorted(recs, key=lambda r: r["invoked_at"])
+            acked = [r for r in recs if r["outcome"] == "ok"]
+            # -- lost acked writes: the LAST acked op, unless an op that
+            # could supersede it (ok or unknown) was invoked after it
+            # settled, pins the doc's final state
+            if acked:
+                last = max(acked, key=lambda r: r["settled_at"])
+                superseded = any(
+                    r["invoked_at"] > last["settled_at"] for r in recs
+                    if r["outcome"] in ("ok", "unknown")
+                    and r is not last)
+                if not superseded:
+                    want = (last["content"] if last["op"] == "index"
+                            else "<deleted>")
+                    got = final.get(doc_id, "<deleted>")
+                    if got != want:
+                        lost_acked.append({
+                            "doc_id": doc_id, "op": last["op"],
+                            "acked": want, "final": got,
+                            "seq_no": last["seq_no"],
+                            "primary_term": last["primary_term"]})
+            # -- stale acks: content visible in the final state that was
+            # only ever written by ops recorded as DEFINITE failures
+            got = final.get(doc_id)
+            if got is not None:
+                could_have_written = {
+                    r["content"] for r in recs
+                    if r["op"] == "index"
+                    and r["outcome"] in ("ok", "unknown")}
+                failed_wrote = {r["content"] for r in recs
+                                if r["op"] == "index"
+                                and r["outcome"] == "fail"}
+                if got in failed_wrote and got not in could_have_written:
+                    stale_acks.append({
+                        "doc_id": doc_id, "final": got,
+                        "failed_ops": [r["op_id"] for r in recs
+                                       if r["outcome"] == "fail"
+                                       and r["content"] == got]})
+            # -- (primary_term, seq_no) monotone over non-overlapping
+            # acked ops (B invoked after A settled must not ack behind A)
+            with_pos = [r for r in acked if r["seq_no"] is not None]
+            for i, a in enumerate(with_pos):
+                for b in with_pos[i + 1:]:
+                    if b["invoked_at"] <= a["settled_at"]:
+                        continue            # concurrent: order unknowable
+                    pa = (a["primary_term"] or 1, a["seq_no"])
+                    pb = (b["primary_term"] or 1, b["seq_no"])
+                    if pb <= pa:
+                        monotonicity.append({
+                            "doc_id": doc_id,
+                            "earlier": {"op_id": a["op_id"], "pos": pa},
+                            "later": {"op_id": b["op_id"], "pos": pb}})
+
+        # -- cross-copy duplicate (seq, term) with differing content:
+        # two copies serving the same position with different bytes is
+        # the split-brain divergence signature
+        copy_conflicts: list[dict] = []
+        for i, (la, da) in enumerate(copy_digests or []):
+            for lb, db in (copy_digests or [])[i + 1:]:
+                for doc_id in sorted(set(da) & set(db)):
+                    a, b = da[doc_id], db[doc_id]
+                    # digest rows are [seq, term, version, crc]
+                    if tuple(a[:2]) == tuple(b[:2]) and a != b:
+                        copy_conflicts.append({
+                            "doc_id": doc_id, "pos": list(a[:2]),
+                            "copies": {la: list(a), lb: list(b)}})
+
+        counts = self.history.counts()
+        return {
+            "checked_ops": counts["total"],
+            "outcomes": counts,
+            "lost_acked_writes": lost_acked,
+            "stale_acks": stale_acks,
+            "monotonicity_violations": monotonicity,
+            "copy_conflicts": copy_conflicts,
+            "ok": not (lost_acked or stale_acks or monotonicity
+                       or copy_conflicts),
+        }

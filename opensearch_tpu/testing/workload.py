@@ -49,10 +49,15 @@ from opensearch_tpu.common.telemetry import Histogram, metrics
 
 #: transport failures a real client retries (retryable 503 class);
 #: anything else client-visible above 399 that is not a 429 counts
-#: against the zero-unexpected-error budget
+#: against the zero-unexpected-error budget.  ``primary_fenced`` is the
+#: replication-safety 503: the write was NOT acked, the slot moved —
+#: retry routes to the current primary.  It arrives as a REMOTE type
+#: (status 500 on the wire), so the name must be listed here — the
+#: status==503 fallback only covers locally-raised fences.
 _RETRYABLE_TYPES = ("node_disconnected_exception",
                     "receive_timeout_transport_exception",
-                    "no_master_exception", "coordination_exception")
+                    "no_master_exception", "coordination_exception",
+                    "primary_fenced_exception")
 
 
 def _bump(ctx: dict, key: str, n: int = 1) -> None:
@@ -169,6 +174,14 @@ class SoakConfig:
             "max_rejection_rate": 0.5,
             "max_unexpected_errors": 0,
             "require_convergence": True,
+            # replication-safety SLOs (testing/history.py): the
+            # post-drain durability audit must find zero lost acked
+            # writes / zero stale acks, and every write copy (plus the
+            # search tier) must serve an identical per-doc
+            # (seq_no, primary_term, version) digest
+            "no_lost_acked_writes": True,
+            "no_stale_acks": True,
+            "require_copy_parity": True,
         }
 
     @classmethod
@@ -418,6 +431,16 @@ class FaultSchedule:
                  "probes": 3},
                 {"step": d_at[5], "fault": "device_heal"},
             ]
+        # split-brain manufacture (self-contained: partition -> writes
+        # -> election -> heal -> fenced writes -> readmit, all inside
+        # one directive) runs LAST, after the cluster is whole again —
+        # and its rng draw comes after every other directive class's
+        # draws, so every pre-existing schedule stays byte-identical
+        sb = min(max(at[-1],
+                     max(1, int(n * 0.98)) + rng.randint(0, jitter)),
+                 n - 1)
+        out.append({"step": sb, "fault": "isolate_primary_with_writes",
+                    "writes": 2})
         return out
 
 
@@ -586,6 +609,8 @@ class SoakRunner:
             if victim is not None and victim in nodes:
                 nodes[victim].fs_health.check()  # healthy again
                 self._readmit(ctx, victim)
+        elif fault == "isolate_primary_with_writes":
+            self._isolate_primary_with_writes(ctx, d)
         elif fault == "partition":
             victim = d["node"]
             sides = ([victim],
@@ -741,6 +766,136 @@ class SoakRunner:
                 inj.release()
         else:
             raise ValueError(f"unknown fault directive [{fault}]")
+
+    def _isolate_primary_with_writes(self, ctx: dict, d: dict) -> None:
+        """Split-brain manufacture, end to end inside one directive so
+        the interleaving is seed-pure: fully partition one shard's
+        primary, drive writes at it (indeterminate outcomes — the
+        partition eats them), let the leader evict it and promote a
+        replica under a bumped term, HEAL the partition, then drive
+        more writes through the deposed primary's stale routing state.
+        Every late replication op must be fenced by the promoted
+        lineage (``stale_primary_rejections``) and the deposed primary
+        must raise the retryable 503 instead of acking — those writes
+        are recorded as DEFINITE failures, so if one ever becomes
+        visible the durability audit turns ``no_stale_acks`` red.
+        Finally the deposed node readmits: its divergent copy rolls
+        back above the global checkpoint and re-recovers, leaving the
+        final state byte-identical to the control run's."""
+        from opensearch_tpu.indices.service import shard_id_for
+        cfg = self.config
+        nodes = ctx["nodes"]
+        hist = ctx["history"]
+        faults = ctx["faults"]
+        victim = shard = None
+        for attempt in range(2):
+            state = nodes[ctx["leader"]].coordinator.state()
+            routing = state.routing.get(cfg.index, [])
+            for s, e in enumerate(routing):
+                p = e.get("primary")
+                if (p and p not in (ctx["leader"], ctx["client"])
+                        and p in nodes and (e.get("replicas") or [])):
+                    victim, shard = p, s
+                    break
+            if victim is not None or attempt > 0:
+                break
+            # the preceding failover chain tends to park every primary
+            # on the survivor-of-everything (the leader/client): force
+            # a PLANNED failover through the real deposed-primary path
+            # — promote an eligible in-sync replica under a bumped
+            # term — then rescan, so the fence is exercised on every
+            # seeded schedule, not only topology-lucky ones
+            moved = False
+            for s, e in enumerate(routing):
+                safe = [r for r in (e.get("replicas") or [])
+                        if r in (e.get("in_sync") or []) and r in nodes
+                        and r not in (ctx["leader"], ctx["client"])]
+                if safe and e.get("primary"):
+                    nodes[ctx["leader"]]._h_fail_copy({
+                        "index": cfg.index, "shard": s,
+                        "node": e["primary"], "deposed": True})
+                    moved = True
+                    break
+            if not moved:
+                break
+            ctx["applied"][-1]["planned_failover"] = True
+            self._wait(lambda: self._in_sync_full(nodes,
+                                                  ctx["leader"]),
+                       timeout=30.0,
+                       what="planned failover before split-brain "
+                            "directive")
+        ctx["applied"][-1].update(node=victim, shard=shard)
+        if victim is None:
+            # no movable primary either; degrade to a no-op — LOUDLY
+            # (the applied record says so), never to a half-run
+            ctx["applied"][-1]["skipped"] = "no eligible primary"
+            return
+        n_shards = len(routing)
+
+        def ids_for(prefix: str, k: int) -> list:
+            out, i = [], 0
+            while len(out) < k:       # deterministic: murmur3 routing
+                did = f"{prefix}{i}"
+                if shard_id_for(did, None, n_shards) == shard:
+                    out.append(did)
+                i += 1
+            return out
+
+        writes = int(d.get("writes", 2))
+        rule = faults.partition(
+            [victim], [n for n in nodes if n != victim])
+        # phase A: writes INTO the partition — each fails fast at the
+        # cut; the outcome is indeterminate from the client's side
+        # (recorded UNKNOWN: absent and present are both legal ends)
+        for did in ids_for(f"sb-a-{cfg.seed}-", writes):
+            src = {"body": "split brain phase a", "tag": "sb",
+                   "ts": 1_700_000_000_000, "v": -1, "nonce": did}
+            op_id = hist.invoke("index", did, src)
+            try:
+                resp = nodes[ctx["client"]].index_doc(cfg.index, did,
+                                                      src)
+                hist.ok(op_id, resp)
+            except OpenSearchTpuError as exc:
+                hist.unknown(op_id, f"{type(exc).__name__}: {exc}")
+        # the leader evicts the unreachable primary; a surviving
+        # in-sync replica is promoted under a bumped primary term
+        self._evict(ctx, victim)
+        # heal: the deposed primary can reach everyone again but still
+        # BELIEVES it holds the primary slot at the old term
+        faults.heal_partition(rule)
+        fenced = 0
+        # phase B: writes through the deposed primary's stale state —
+        # its replication ops carry the old term, the promoted
+        # lineage's copies fence them, and the 503 (instead of an ack)
+        # makes these DEFINITE failures: unique per-attempt content, so
+        # any survivor is caught as a stale ack
+        for did in ids_for(f"sb-b-{cfg.seed}-", writes):
+            src = {"body": "split brain phase b", "tag": "sb",
+                   "ts": 1_700_000_000_000, "v": -2, "nonce": did}
+            op_id = hist.invoke("index", did, src)
+            try:
+                resp = nodes[victim].index_doc(cfg.index, did, src)
+                # an ack from a deposed primary IS the bug class this
+                # directive exists to catch — record it faithfully and
+                # let the durability verdict go red
+                hist.ok(op_id, resp)
+            except OpenSearchTpuError as exc:
+                # ONLY the fence (raised instead of an ack, local to
+                # the deposed owner) is a definite failure; any other
+                # error (disconnect, timeout) leaves the fate open
+                from opensearch_tpu.common.errors import \
+                    PrimaryFencedError
+                if isinstance(exc, PrimaryFencedError):
+                    fenced += 1
+                    hist.fail(op_id,
+                              f"fenced: {type(exc).__name__}: {exc}")
+                else:
+                    hist.unknown(op_id,
+                                 f"{type(exc).__name__}: {exc}")
+        ctx["applied"][-1]["fenced_writes"] = fenced
+        # readmit: the deposed copy rolls back its divergence above the
+        # global checkpoint and peer-recovers under the current term
+        self._readmit(ctx, victim)
 
     def _devfaults(self, ctx: dict):
         """Lazily activate the pass's DeviceFaultInjector (seeded from
@@ -952,12 +1107,14 @@ class SoakRunner:
             return {"partial": partial}
         if kind == "bulk":
             for doc_id, source in op["docs"]:
-                self._write_with_retry(
-                    ctx, lambda d=doc_id, s=source:
+                self._recorded_write(
+                    ctx, "index", doc_id, source,
+                    lambda d=doc_id, s=source:
                     client.index_doc(index, d, s))
             if op.get("delete"):
-                self._write_with_retry(
-                    ctx, lambda: client.delete_doc(index, op["delete"]))
+                self._recorded_write(
+                    ctx, "delete", op["delete"], None,
+                    lambda: client.delete_doc(index, op["delete"]))
             if op.get("refresh"):
                 self._write_with_retry(
                     ctx, lambda: client.refresh(index))
@@ -1010,6 +1167,36 @@ class SoakRunner:
         raise SoakUnexpectedError(
             f"write retries exhausted: {type(last).__name__}: {last}")
 
+    def _recorded_write(self, ctx: dict, op: str, doc_id: str,
+                        source: Optional[dict], fn: Callable[[], dict]):
+        """A ``_write_with_retry`` with its interval recorded in the
+        durability history: an ack is OK (with the response's
+        ``(seq_no, primary_term, version)``), exhausted retries are
+        UNKNOWN (an earlier attempt may have landed), and a
+        first-attempt hard rejection is a definite FAIL."""
+        hist = ctx["history"]
+        op_id = hist.invoke(op, doc_id, source)
+        attempts = {"n": 0}
+
+        def counted():
+            attempts["n"] += 1
+            return fn()
+        try:
+            resp = self._write_with_retry(ctx, counted)
+        except SoakUnexpectedError as exc:
+            hist.unknown(op_id, f"retries exhausted: {exc}")
+            raise
+        except OpenSearchTpuError as exc:
+            if attempts["n"] <= 1:
+                # rejected outright — the write never applied anywhere
+                hist.fail(op_id, f"{type(exc).__name__}: {exc}")
+            else:
+                # a retried attempt may have landed before this error
+                hist.unknown(op_id, f"{type(exc).__name__}: {exc}")
+            raise
+        hist.ok(op_id, resp if isinstance(resp, dict) else {})
+        return resp
+
     def _run_op(self, i: int, op: dict, ctx: dict) -> None:
         hist = ctx["hists"][op["op"]]
         t0 = time.monotonic()
@@ -1057,12 +1244,19 @@ class SoakRunner:
         for sid in cfg.searcher_ids:
             nodes[sid] = self._build_node(hub, sid, root,
                                           roles=("search",))
+        from opensearch_tpu.testing.history import HistoryRecorder
         ctx = {
             "lock": threading.Lock(),
             "hub": hub, "nodes": nodes, "root": root,
             "client": cfg.client, "leader": cfg.node_ids[0],
             "searchers": set(cfg.searcher_ids),
             "faults": FaultInjector(hub, seed=cfg.seed),
+            # acked-write durability audit (testing/history.py): every
+            # CRUD write records an invoke/ok|fail|unknown interval;
+            # the post-drain DurabilityChecker replays it against the
+            # final state + per-copy digests (both passes record, so
+            # the checker is validated on the happy path too)
+            "history": HistoryRecorder(),
             "applied": [], "saved_breaches": {},
             "rejected": 0, "partial_results": 0, "client_retries": 0,
             "recoveries": 0, "unexpected": [],
@@ -1126,7 +1320,10 @@ class SoakRunner:
             if cfg.autoscale:
                 self._wire_autoscaler(ctx)
             for doc_id, source in workload.seed_docs():
-                nodes[ctx["client"]].index_doc(cfg.index, doc_id, source)
+                self._recorded_write(
+                    ctx, "index", doc_id, source,
+                    lambda d=doc_id, s=source:
+                    nodes[ctx["client"]].index_doc(cfg.index, d, s))
             nodes[ctx["client"]].refresh(cfg.index)
 
             ops = workload.ops()
@@ -1190,6 +1387,12 @@ class SoakRunner:
                 self._wait(tier_converged, timeout=30.0,
                            what="searcher-tier catch-up")
             final = self._final_state(ctx)
+            # replication-safety audit, while the cluster is alive:
+            # per-copy digest parity, then the acked-write history
+            # replayed against the final state + those digests
+            parity = self._copy_parity(ctx)
+            durability = self._durability_report(
+                ctx, parity.pop("copy_digests"))
             device_report = None
             if cfg.device_faults:
                 # the breaker-state snapshot AFTER the drain + final
@@ -1285,6 +1488,17 @@ class SoakRunner:
                 after.get(k, 0) - before.get(k, 0)
                 for k in after if k.startswith("retry.")
                 and k.endswith(".retries")),
+            # replication-safety accounting: fence activity on both
+            # sides (the deposed primary's refused acks, the replicas'
+            # stale-op rejections), rollbacks/resyncs, and the
+            # post-drain durability + copy-parity audit reports
+            "fenced_ops": delta("replication.fenced_ops"),
+            "stale_primary_rejections":
+                delta("replication.stale_primary_rejections"),
+            "replication_rollbacks": delta("replication.rollbacks"),
+            "resyncs": delta("replication.resyncs"),
+            "durability": durability,
+            "copy_parity": parity,
             "final_state": final,
             "query_insights": query_insights,
             # accelerator fault accounting (present only for device
@@ -1331,20 +1545,117 @@ class SoakRunner:
     def _final_state(self, ctx: dict) -> dict:
         """Post-drain doc count + content checksum via the normal search
         path, all-or-nothing (a shard that cannot answer here is a
-        convergence failure, reported as such)."""
+        convergence failure, reported as such).  The raw id → source
+        map is stashed in ``ctx["final_docs"]`` for the durability
+        audit (it replays the write history against exactly this
+        client-visible state)."""
         client = ctx["nodes"][ctx["client"]]
         try:
             resp = client.search(self.config.index, {
                 "query": {"match_all": {}}, "size": 10_000,
                 "allow_partial_search_results": False})
         except OpenSearchTpuError as exc:
+            ctx["final_docs"] = None
             return {"error": f"{type(exc).__name__}: {exc}"}
+        ctx["final_docs"] = {h["_id"]: h["_source"]
+                             for h in resp["hits"]["hits"]}
         docs = sorted(
             (h["_id"], json.dumps(h["_source"], sort_keys=True))
             for h in resp["hits"]["hits"])
         return {"doc_count": resp["hits"]["total"]["value"],
                 "checksum": zlib.crc32(
                     json.dumps(docs).encode("utf-8"))}
+
+    def _copy_parity(self, ctx: dict) -> dict:
+        """Per-copy convergence: after the drain, the primary, every
+        in-sync replica, and every ready searcher of each shard must
+        serve the same per-doc ``(seq_no, primary_term, version)``
+        digest (``InternalEngine.replication_digest``).  Write copies
+        compare the full term-aware digest; the search tier compares
+        the termless ``seq_digest`` (its copies are rebuilt from
+        segment checkpoints, same seq/version lineage).  Retries
+        briefly — replicas install published checkpoints
+        asynchronously — then reports the LAST snapshot; a persistent
+        mismatch is an SLO breach, not a harness error."""
+        cfg = self.config
+        nodes = ctx["nodes"]
+
+        def snapshot():
+            state = nodes[ctx["leader"]].coordinator.state()
+            shards, digests, all_ok = [], [], True
+            for s, e in enumerate(state.routing.get(cfg.index, [])):
+                primary = e.get("primary")
+                copies, searchers = [], []
+                try:
+                    if primary not in nodes:
+                        raise SoakHarnessError(f"primary [{primary}] gone")
+                    copies.append((f"{primary}:primary", nodes[
+                        primary].indices[cfg.index].engine_for(
+                        s).replication_digest()))
+                    for r in (e.get("replicas") or []):
+                        if r in (e.get("in_sync") or []) and r in nodes:
+                            copies.append((f"{r}:replica", nodes[
+                                r].indices[cfg.index].engine_for(
+                                s).replication_digest()))
+                    for r in (e.get("search_in_sync") or []):
+                        if r in nodes:
+                            searchers.append((f"{r}:search", nodes[
+                                r].indices[cfg.index].engine_for(
+                                s).replication_digest()))
+                except (OpenSearchTpuError, KeyError) as exc:
+                    shards.append({"shard": s, "ok": False,
+                                   "error": f"{type(exc).__name__}: "
+                                            f"{exc}"})
+                    all_ok = False
+                    continue
+                pdig = copies[0][1]
+                write_ok = len({d["digest"] for _, d in copies}) == 1
+                search_ok = all(d["seq_digest"] == pdig["seq_digest"]
+                                for _, d in searchers)
+                row = {"shard": s, "ok": write_ok and search_ok,
+                       "copies": {lbl: {"digest": d["digest"],
+                                        "seq_digest": d["seq_digest"],
+                                        "doc_count": d["doc_count"]}
+                                  for lbl, d in copies + searchers}}
+                if not (write_ok and search_ok):
+                    # diagnosable evidence: which doc positions differ
+                    base = copies[0][1]["docs"]
+                    for lbl, d in copies[1:] + searchers:
+                        diff = sorted(
+                            k for k in set(base) | set(d["docs"])
+                            if base.get(k) != d["docs"].get(k))[:10]
+                        if diff:
+                            row.setdefault("diverged", {})[lbl] = diff
+                shards.append(row)
+                all_ok = all_ok and row["ok"]
+                digests += [(f"{lbl}/s{s}", d["docs"])
+                            for lbl, d in copies + searchers]
+            return {"ok": all_ok, "shards": shards,
+                    "copy_digests": digests}
+
+        report = snapshot()
+        deadline = time.monotonic() + 10.0
+        while not report["ok"] and time.monotonic() < deadline:  # deadline
+            time.sleep(0.05)                                     # deadline
+            report = snapshot()
+        return report
+
+    def _durability_report(self, ctx: dict, copy_digests: list) -> dict:
+        """Run the ``DurabilityChecker`` over the recorded history,
+        the final client-visible state, and the per-copy digests; bump
+        the audit counter so ``_nodes/stats`` / ``/_metrics`` show how
+        many acked-write promises were actually verified."""
+        from opensearch_tpu.testing.history import DurabilityChecker
+        hist = ctx["history"]
+        hist.settle_open_as_unknown("soak drain")
+        final_docs = ctx.get("final_docs")
+        if final_docs is None:
+            return {"ok": False, "checked_ops": hist.checked_ops,
+                    "error": "final state unavailable"}
+        report = DurabilityChecker(hist).check(final_docs, copy_digests)
+        metrics().counter("replication.durability_checked_ops").inc(
+            report["checked_ops"])
+        return report
 
     # -- SLO evaluation ----------------------------------------------------
 
@@ -1374,6 +1685,41 @@ class SoakRunner:
                 "slo": "convergence",
                 "limit": control["final_state"],
                 "observed": chaos["final_state"], "ok": ok})
+        dur = chaos.get("durability") or {}
+        if slos.get("no_lost_acked_writes"):
+            lost = dur.get("lost_acked_writes", [])
+            checked = int(dur.get("checked_ops", 0))
+            verdicts.append({
+                "slo": "no_lost_acked_writes", "limit": 0,
+                "observed": {"lost": len(lost),
+                             "checked_ops": checked,
+                             **({"evidence": lost[:5]} if lost else {})},
+                # an audit that checked NOTHING (or errored) is a
+                # breach, not a free pass
+                "ok": (not lost and checked > 0
+                       and "error" not in dur)})
+        if slos.get("no_stale_acks"):
+            stale = dur.get("stale_acks", [])
+            mono = dur.get("monotonicity_violations", [])
+            conflicts = dur.get("copy_conflicts", [])
+            bad = len(stale) + len(mono) + len(conflicts)
+            verdicts.append({
+                "slo": "no_stale_acks", "limit": 0,
+                "observed": {"stale_acks": len(stale),
+                             "monotonicity": len(mono),
+                             "copy_conflicts": len(conflicts),
+                             **({"evidence":
+                                 (stale + mono + conflicts)[:5]}
+                                if bad else {})},
+                "ok": bad == 0 and "error" not in dur})
+        if slos.get("require_copy_parity"):
+            par = chaos.get("copy_parity") or {}
+            mismatched = [s for s in par.get("shards", [])
+                          if not s.get("ok")]
+            verdicts.append({
+                "slo": "copy_parity", "limit": [],
+                "observed": mismatched,
+                "ok": par.get("ok", False)})
         dev = chaos.get("device") or {}
         if slos.get("require_breaker_trip"):
             trips = int(dev.get("breaker_trips", 0))

@@ -1,0 +1,475 @@
+"""Replication safety (PR 19): primary-term fencing of the whole write
+transport surface, global-checkpoint tracking + promotion resync and
+divergence rollback, and the acked-write durability audit
+(testing/history.py) that turns Jepsen-style history checking into soak
+SLO verdicts — plus the REST/client optimistic-concurrency 409 surface
+and the tier-1 ``check_term_fencing`` lint."""
+
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+from opensearch_tpu.client import ConflictError, OpenSearch
+from opensearch_tpu.cluster.node import ClusterNode
+from opensearch_tpu.common.errors import (PrimaryFencedError,
+                                          VersionConflictError)
+from opensearch_tpu.common.telemetry import metrics
+from opensearch_tpu.index.engine import InternalEngine
+from opensearch_tpu.mapping.mapper import DocumentMapper
+from opensearch_tpu.node import Node
+from opensearch_tpu.testing.history import (DurabilityChecker,
+                                            HistoryRecorder, canonical)
+from opensearch_tpu.testing.workload import SoakConfig, SoakRunner
+from opensearch_tpu.transport.service import (LocalTransport,
+                                              TransportService)
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+TOOLS = REPO + "/tools"
+
+MAPPING = {"properties": {"body": {"type": "text"},
+                          "n": {"type": "long"}}}
+
+
+def new_engine(path):
+    return InternalEngine(str(path), DocumentMapper(MAPPING),
+                          index_name="idx")
+
+
+def wait_until(pred, timeout=8.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:   # deadline-bounded poll
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    hub = LocalTransport.Hub()
+    ids = ["n0", "n1", "n2"]
+    nodes = {}
+    for nid in ids:
+        svc = TransportService(nid, LocalTransport(hub))
+        nodes[nid] = ClusterNode(nid, str(tmp_path / nid), svc, ids)
+    assert nodes["n0"].start_election()
+    wait_until(lambda: all(
+        nodes[i].coordinator.state().master_node == "n0" for i in ids))
+    yield hub, ids, nodes
+    for n in nodes.values():
+        n.stop()
+
+
+# -- engine-level fencing, rollback, digests -------------------------------
+
+def test_apply_replica_op_fences_stale_primary_term(tmp_path):
+    """The engine-level fence: an op stamped below the engine's current
+    primary term is rejected (the deposed-primary signature); the
+    ``fence=False`` bypass exists ONLY for promotion-resync replay,
+    where ops legitimately keep their original (older) terms."""
+    prim = new_engine(tmp_path / "p")
+    prim.index("1", {"body": "a", "n": 1})
+    prim.index("2", {"body": "b", "n": 2})
+    ops = prim.ops_since(-1)
+    assert [o["seq_no"] for o in ops] == [0, 1]
+
+    rep = new_engine(tmp_path / "r")
+    newer = dict(ops[0], primary_term=2)
+    rep.apply_replica_op(newer)
+    assert rep.primary_term == 2          # term advances with the op
+    stale = dict(ops[1], primary_term=1)
+    with pytest.raises(VersionConflictError):
+        rep.apply_replica_op(stale)
+    # resync replay: same op is legal when the transport handler already
+    # validated the resync's term
+    rep.apply_replica_op(stale, fence=False)
+    assert rep.primary_term == 2          # never moves backwards
+    prim.close()
+    rep.close()
+
+
+def test_local_checkpoint_advances_contiguously(tmp_path):
+    """Local checkpoint = highest seq below which NO gaps exist — an
+    out-of-order replica apply parks it until the hole fills (the
+    LocalCheckpointTracker contract the global checkpoint builds on)."""
+    prim = new_engine(tmp_path / "p")
+    for i in range(3):
+        prim.index(str(i), {"body": f"d{i}", "n": i})
+    ops = prim.ops_since(-1)
+    rep = new_engine(tmp_path / "r")
+    rep.apply_replica_op(ops[0])
+    rep.apply_replica_op(ops[2])          # gap at seq 1
+    assert rep.local_checkpoint == 0
+    rep.apply_replica_op(ops[1])          # hole filled
+    assert rep.local_checkpoint == 2
+    prim.close()
+    rep.close()
+
+
+def test_rollback_above_discards_divergence_durably(tmp_path):
+    """``rollback_above`` (the deposed copy's divergence discard): ops
+    above the global checkpoint vanish, the newest RETAINED op per
+    affected doc is re-exposed, and the trim survives restart via the
+    translog trim marker."""
+    path = tmp_path / "e"
+    eng = new_engine(path)
+    eng.index("1", {"body": "keep", "n": 1})       # seq 0
+    eng.index("2", {"body": "keep", "n": 2})       # seq 1
+    eng.index("1", {"body": "divergent", "n": 9})  # seq 2
+    eng.index("3", {"body": "divergent", "n": 3})  # seq 3
+    dropped = eng.rollback_above(1)
+    assert dropped == 2
+    assert eng.get("1")["_source"]["n"] == 1       # retained op re-wins
+    assert eng.get("3") is None                    # divergent doc gone
+    d = eng.replication_digest()
+    assert max(row[0] for row in d["docs"].values()) <= 1
+    eng.close()
+    # the trim marker is durable: replaying the translog after restart
+    # must NOT resurrect the rolled-back ops
+    eng2 = new_engine(path)
+    assert eng2.get("1")["_source"]["n"] == 1
+    assert eng2.get("3") is None
+    eng2.close()
+
+
+def test_replication_digest_copy_parity(tmp_path):
+    """Two copies that applied the same ops produce the identical
+    term-aware digest; the termless ``seq_digest`` is what the
+    (term-agnostic) search tier is compared against."""
+    prim = new_engine(tmp_path / "p")
+    for i in range(5):
+        prim.index(str(i), {"body": f"d{i}", "n": i})
+    prim.delete("3")
+    rep = new_engine(tmp_path / "r")
+    for op in prim.ops_since(-1):
+        rep.apply_replica_op(op)
+    dp, dr = prim.replication_digest(), rep.replication_digest()
+    assert dp["digest"] == dr["digest"]
+    assert dp["seq_digest"] == dr["seq_digest"]
+    assert dp["doc_count"] == dr["doc_count"] == 4
+    prim.close()
+    rep.close()
+
+
+# -- the durability audit (testing/history.py) -----------------------------
+
+def _acked_index(hist, doc_id, src, seq, term=1, version=1):
+    op_id = hist.invoke("index", doc_id, src)
+    hist.ok(op_id, {"_seq_no": seq, "_primary_term": term,
+                    "_version": version})
+    return op_id
+
+
+def test_history_green_path_passes():
+    hist = HistoryRecorder()
+    _acked_index(hist, "a", {"n": 1}, seq=0)
+    _acked_index(hist, "a", {"n": 2}, seq=1, version=2)
+    op = hist.invoke("delete", "b")
+    hist.ok(op, {"_seq_no": 2, "_primary_term": 1})
+    op = hist.invoke("index", "c", {"n": 3})
+    hist.unknown(op, "timeout")            # either final state is legal
+    report = DurabilityChecker(hist).check({"a": {"n": 2}})
+    assert report["ok"], report
+    assert report["checked_ops"] == 4
+    assert report["outcomes"]["ok"] == 3
+
+
+def test_checker_catches_lost_acked_write():
+    hist = HistoryRecorder()
+    _acked_index(hist, "a", {"n": 1}, seq=0)
+    report = DurabilityChecker(hist).check({})     # acked doc vanished
+    assert not report["ok"]
+    assert report["lost_acked_writes"][0]["doc_id"] == "a"
+    assert report["lost_acked_writes"][0]["acked"] == \
+        canonical({"n": 1})
+    # ...but a LATER unknown-outcome op un-pins the final state: the
+    # lost-write claim must not fire when a racing op may have deleted it
+    hist2 = HistoryRecorder()
+    _acked_index(hist2, "a", {"n": 1}, seq=0)
+    op = hist2.invoke("delete", "a")
+    hist2.unknown(op, "partition")
+    assert DurabilityChecker(hist2).check({})["ok"]
+
+
+def test_checker_catches_stale_ack():
+    """Content only ever written by DEFINITE failures (the fenced
+    deposed-primary writes) becoming visible is the stale-ack bug."""
+    hist = HistoryRecorder()
+    op = hist.invoke("index", "a", {"n": 666})
+    hist.fail(op, "fenced")
+    report = DurabilityChecker(hist).check({"a": {"n": 666}})
+    assert not report["ok"]
+    assert report["stale_acks"][0]["doc_id"] == "a"
+
+
+def test_checker_catches_term_seq_regression():
+    hist = HistoryRecorder()
+    _acked_index(hist, "a", {"n": 1}, seq=5, term=2)
+    # settled strictly before the next invoke, yet acked BEHIND it
+    _acked_index(hist, "a", {"n": 2}, seq=3, term=1, version=2)
+    report = DurabilityChecker(hist).check({"a": {"n": 2}})
+    assert report["monotonicity_violations"], report
+    assert not report["ok"]
+
+
+def test_checker_catches_cross_copy_conflict():
+    """Two copies serving the same (seq, term) with different bytes is
+    the split-brain divergence signature fencing exists to prevent."""
+    hist = HistoryRecorder()
+    _acked_index(hist, "a", {"n": 1}, seq=0)
+    report = DurabilityChecker(hist).check(
+        {"a": {"n": 1}},
+        copy_digests=[("n0/s0", {"a": [0, 1, 1, 111]}),
+                      ("n1/s0", {"a": [0, 1, 1, 222]})])
+    assert report["copy_conflicts"][0]["doc_id"] == "a"
+    assert not report["ok"]
+
+
+def test_open_intervals_settle_unknown_never_dropped():
+    hist = HistoryRecorder()
+    hist.invoke("index", "a", {"n": 1})    # worker died mid-flight
+    hist.settle_open_as_unknown("drain")
+    counts = hist.counts()
+    assert counts == {"ok": 0, "fail": 0, "unknown": 1, "total": 1}
+    assert DurabilityChecker(hist).check({})["ok"]
+
+
+# -- cluster-level fencing + deposed-primary failover ----------------------
+
+def test_deposed_primary_promotes_and_fences_old_lineage(cluster):
+    """The deposed-primary flow end to end: a ``deposed`` fail-copy
+    promotes an in-sync replica under a bumped term (old copy keeps an
+    OUT-of-sync slot), replication ops stamped with the old term are
+    fenced with a counted rejection, and a non-primary asked to execute
+    a primary write refuses with the retryable 503 instead of acking."""
+    hub, ids, nodes = cluster
+    nodes["n0"].create_index("fence", {"settings": {
+        "number_of_shards": 3, "number_of_replicas": 1}})
+    wait_until(lambda: all("fence" in nodes[i].indices for i in ids))
+    for i in range(12):
+        nodes[ids[i % 3]].index_doc("fence", str(i),
+                                    {"body": f"doc {i}", "n": i})
+
+    def entry(shard):
+        return nodes["n0"].coordinator.state().routing["fence"][shard]
+
+    assert wait_until(lambda: all(
+        set([entry(s)["primary"]] + entry(s)["replicas"])
+        == set(entry(s)["in_sync"]) for s in range(3)))
+    old_primary = entry(0)["primary"]
+    old_term = int(entry(0).get("primary_term", 1))
+
+    stale_before = metrics().counter(
+        "replication.stale_primary_rejections").value
+    nodes["n0"]._h_fail_copy({"index": "fence", "shard": 0,
+                              "node": old_primary, "deposed": True})
+    assert wait_until(lambda: entry(0)["primary"] != old_primary)
+    e = entry(0)
+    new_primary = e["primary"]
+    assert int(e["primary_term"]) == old_term + 1
+    assert old_primary in e["replicas"]    # deposed copy keeps a slot
+
+    # a late replication op from the old lineage is fenced — rejected
+    # loudly, counted, and never applied
+    with pytest.raises(VersionConflictError):
+        nodes[new_primary]._h_replicate_op({
+            "index": "fence", "shard": 0,
+            "rep_op": {"op": "index", "id": "stale-doc",
+                       "source": {"body": "stale", "n": -1},
+                       "seq_no": 999, "version": 1,
+                       "primary_term": old_term}})
+    assert metrics().counter(
+        "replication.stale_primary_rejections").value > stale_before
+    assert nodes[new_primary].get_doc("fence", "stale-doc") is None
+
+    # a primary write landing on a copy that does NOT hold the primary
+    # slot refuses before touching the engine — no false ack
+    fenced_before = metrics().counter("replication.fenced_ops").value
+    bystander = next(n for n in e["replicas"]
+                     if "fence" in nodes[n].indices
+                     and 0 in nodes[n].indices["fence"].local_shards)
+    with pytest.raises(PrimaryFencedError):
+        nodes[bystander]._h_write_shard({
+            "index": "fence", "shard": 0, "op": "index",
+            "id": "misrouted", "source": {"body": "x", "n": 0}})
+    assert metrics().counter("replication.fenced_ops").value \
+        == fenced_before + 1
+
+    # the promoted lineage resyncs: the new primary's ENGINE term
+    # catches up to the routing term and writes flow again
+    def row():
+        st = nodes[new_primary].replication_stats()
+        return next(r for r in st["shards"]
+                    if r["index"] == "fence" and r["shard"] == 0)
+    assert wait_until(
+        lambda: row()["engine_primary_term"] == old_term + 1)
+    assert row()["role"] == "primary"
+    r = nodes[new_primary].index_doc("fence", "post-failover",
+                                     {"body": "alive", "n": 100})
+    assert r["result"] == "created"
+    assert r["_primary_term"] >= 1
+    # the deposed copy recovers back into sync under the new term
+    assert wait_until(lambda: old_primary in entry(0)["in_sync"],
+                      timeout=20.0)
+
+
+def test_nodes_stats_exposes_replication_block(tmp_path):
+    """Single-node observability face: ``_nodes/stats`` carries the
+    per-shard term/checkpoint positions and the replication.* counter
+    family (same names the cluster nodes' ``replication_stats`` uses)."""
+    node = Node(str(tmp_path / "node"), port=0)
+    try:
+        node.rest.dispatch("PUT", "/rsafe", {}, json.dumps(
+            {"settings": {"number_of_shards": 1}}).encode())
+        for i in range(3):
+            node.rest.dispatch("PUT", f"/rsafe/_doc/{i}", {},
+                               json.dumps({"n": i}).encode())
+        status, resp = node.rest.dispatch("GET", "/_nodes/stats", {},
+                                          None)
+        assert status == 200
+        block = resp["nodes"][node.node_id]["replication"]
+        row = next(s for s in block["shards"] if s["index"] == "rsafe")
+        assert row["primary_term"] >= 1
+        assert row["max_seq_no"] == 2
+        assert row["local_checkpoint"] == 2
+        assert set(block["counters"]) == {
+            "fenced_ops", "stale_primary_rejections", "rollbacks",
+            "resyncs", "resync_failures", "durability_checked_ops"}
+    finally:
+        node.stop()
+
+
+# -- the acceptance bar: deterministic split-brain under chaos -------------
+
+def test_split_brain_directive_fences_and_loses_nothing(tmp_path):
+    """The PR's acceptance test: a seeded ``isolate_primary_with_writes``
+    directive manufactures split brain (partition the primary → writes
+    into the cut → eviction + promotion under a bumped term → heal →
+    writes through the deposed node's stale state).  Deterministic
+    across two runs; the stale lineage is fenced (counters move, the
+    old primary stops acking), and the durability audit proves zero
+    lost acked writes and zero stale acks after the heal."""
+    def cfg():
+        return SoakConfig(seed=77, n_ops=24, schedule=[
+            {"step": 6, "fault": "isolate_primary_with_writes",
+             "writes": 2}])
+
+    r1 = SoakRunner(str(tmp_path / "a"), cfg()).run()
+    r2 = SoakRunner(str(tmp_path / "b"), cfg()).run()
+    v1 = [(v["slo"], v["ok"]) for v in r1["verdicts"]]
+    v2 = [(v["slo"], v["ok"]) for v in r2["verdicts"]]
+    assert v1 == v2                        # seed-pure, replayable
+
+    d = next(a for a in r1["chaos"]["applied"]
+             if a["fault"] == "isolate_primary_with_writes")
+    assert "skipped" not in d, d
+    # the old primary STOPPED ACKING: its post-heal writes fenced into
+    # definite failures instead of false acks
+    assert d["fenced_writes"] > 0, d
+    assert r1["chaos"]["fenced_ops"] > 0
+    assert r1["chaos"]["stale_primary_rejections"] > 0
+
+    dur = r1["chaos"]["durability"]
+    assert dur["checked_ops"] > 0
+    assert dur["lost_acked_writes"] == []
+    assert dur["stale_acks"] == []
+    assert dur["monotonicity_violations"] == []
+    assert dur["copy_conflicts"] == []
+    assert dur["ok"]
+    # per-copy parity: primary/replica digests identical per shard
+    assert r1["chaos"]["copy_parity"]["ok"], r1["chaos"]["copy_parity"]
+    for slo in ("no_lost_acked_writes", "no_stale_acks", "copy_parity"):
+        v = next(x for x in r1["verdicts"] if x["slo"] == slo)
+        assert v["ok"], v
+    assert r1["slo_ok"], r1["verdicts"]
+
+
+# -- REST + client optimistic concurrency (if_seq_no/if_primary_term) ------
+
+def test_occ_conflicts_over_rest_and_client(tmp_path):
+    """End-to-end 409 surface: a stale ``if_seq_no``/``if_primary_term``
+    on index AND delete returns ``version_conflict_engine_exception``
+    over REST, the matching pair succeeds, and the bundled client maps
+    the 409 to ``ConflictError`` with params passed through."""
+    node = Node(str(tmp_path / "node"), port=0).start()
+    client = OpenSearch(hosts=[{"host": "127.0.0.1",
+                                "port": node.port}])
+    try:
+        r = client.index("occ", {"n": 1}, id="1")
+        seq, term = r["_seq_no"], r["_primary_term"]
+        with pytest.raises(ConflictError) as ei:
+            client.index("occ", {"n": 2}, id="1",
+                         params={"if_seq_no": 999,
+                                 "if_primary_term": term})
+        assert ei.value.status_code == 409
+        assert ei.value.info["error"]["type"] == \
+            "version_conflict_engine_exception"
+        with pytest.raises(ConflictError):
+            client.delete("occ", "1", params={"if_seq_no": seq,
+                                              "if_primary_term": 99})
+        assert client.get("occ", "1")["_source"] == {"n": 1}
+        r2 = client.index("occ", {"n": 2}, id="1",
+                          params={"if_seq_no": seq,
+                                  "if_primary_term": term})
+        assert r2["result"] == "updated" and r2["_seq_no"] > seq
+        r3 = client.delete("occ", "1",
+                           params={"if_seq_no": r2["_seq_no"],
+                                   "if_primary_term":
+                                       r2["_primary_term"]})
+        assert r3["result"] == "deleted"
+        # garbage OCC params are a typed 400, never a ValueError 500
+        status, body = node.rest.dispatch(
+            "PUT", "/occ/_doc/1", {"if_seq_no": "banana"},
+            json.dumps({"n": 9}).encode())
+        assert status == 400
+        assert body["error"]["type"] == "illegal_argument_exception"
+    finally:
+        node.stop()
+
+
+# -- tier-1 lint: every write handler must fence ---------------------------
+
+def _run_lint(repo):
+    return subprocess.run(
+        [sys.executable, TOOLS + "/check_term_fencing.py", str(repo)],
+        capture_output=True, text=True)
+
+
+def test_term_fencing_lint_is_clean():
+    r = _run_lint(REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_term_fencing_lint_catches_unfenced_handler(tmp_path):
+    """A write-action handler with no primary_term validation and no
+    waiver must fail the lint; the explicit ``# fencing-ok (<why>)``
+    annotation silences it."""
+    pkg = tmp_path / "opensearch_tpu" / "cluster"
+    pkg.mkdir(parents=True)
+    unfenced = '''A_X = "indices:data/write/x"
+WRITE_ACTIONS = (A_X,)
+
+
+class N:
+    def _register_write_handlers(self, t):
+        write_handlers = {A_X: self._h_x}
+        for a, h in write_handlers.items():
+            t.register_handler(a, h)
+
+    def _h_x(self, payload):
+        return {"acknowledged": True}
+'''
+    (pkg / "node.py").write_text(unfenced)
+    r = _run_lint(tmp_path)
+    assert r.returncode == 1
+    assert "_h_x" in r.stdout and "primary_term" in r.stdout
+
+    (pkg / "node.py").write_text(unfenced.replace(
+        "    def _h_x(self, payload):",
+        "    # fencing-ok (test fixture: replies only, never applies)\n"
+        "    def _h_x(self, payload):"))
+    r = _run_lint(tmp_path)
+    assert r.returncode == 0, r.stdout

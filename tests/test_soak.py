@@ -156,7 +156,10 @@ def test_bench_soak_phase_emits_slo_line(tmp_path, monkeypatch):
     assert line["phase"] == "soak"
     assert {"slo_ok", "rejection_rate", "sheds", "reroutes",
             "recoveries", "convergence",
-            "p99_search_ms", "p99_bulk_ms"} <= set(line)
+            "p99_search_ms", "p99_bulk_ms",
+            "fenced_ops", "stale_primary_rejections",
+            "durability_checked_ops"} <= set(line)
+    assert line["durability_checked_ops"] > 0
     assert line["unexpected_errors"] == 0
     assert line["convergence"] is True
 

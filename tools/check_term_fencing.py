@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Lint: every write-path transport handler must fence on primary term.
+
+Primary-term fencing (the reference's ReplicationTracker / in-sync
+machinery) only protects acked writes if EVERY transport entry point
+that mutates shard state validates the op's ``primary_term`` against
+cluster state before applying it.  A new write-path handler added
+without the check re-opens the split-brain hole PR 19 closed — so this
+check pins the invariant statically:
+
+1. ``opensearch_tpu/cluster/node.py`` must define a non-empty
+   ``WRITE_ACTIONS`` tuple and map every entry to a handler inside
+   ``_register_write_handlers`` (the role-gated registration site that
+   ``check_searcher_write_isolation.py`` already pins).
+2. Every handler so registered must validate the primary term: either
+   call ``_fence_floor`` (the entry-vs-engine term floor helper) or
+   reference ``primary_term`` together with a fencing rejection
+   (``PrimaryFencedError`` / ``VersionConflictError`` /
+   ``_record_stale_primary``) — or carry an explicit
+   ``# fencing-ok (<why>)`` annotation on its ``def`` line or the line
+   above.
+
+tests/test_replication_safety.py runs this check; new un-annotated
+write handlers fail tier-1.
+
+Usage: python tools/check_term_fencing.py [repo_root]
+(exit 0 = clean)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ANNOTATION = "# fencing-ok"
+
+REGISTRATION_FN = "_register_write_handlers"
+
+#: any of these inside a handler body counts as a fencing rejection
+FENCE_REJECTIONS = ("PrimaryFencedError", "VersionConflictError",
+                    "_record_stale_primary")
+
+
+def _annotated(lines: list, lineno: int) -> bool:
+    line = lines[lineno - 1] if lineno <= len(lines) else ""
+    prev = lines[lineno - 2] if lineno >= 2 else ""
+    return ANNOTATION in line or ANNOTATION in prev
+
+
+def _write_action_names(tree: ast.AST, path: str, problems: list):
+    """The names bound in the ``WRITE_ACTIONS = (...)`` tuple."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "WRITE_ACTIONS"
+                for t in node.targets):
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                names = [e.id for e in node.value.elts
+                         if isinstance(e, ast.Name)]
+                if names:
+                    return names
+            problems.append(
+                f"{path}:{node.lineno}: WRITE_ACTIONS is not a "
+                "non-empty tuple of action-name constants")
+            return []
+    problems.append(f"{path}:1: WRITE_ACTIONS tuple is missing — the "
+                    "write-path surface is unpinned")
+    return []
+
+
+def _registered_handlers(tree: ast.AST, actions: list, path: str,
+                         problems: list) -> dict:
+    """action name -> handler method name, from the dict literal in
+    ``_register_write_handlers``."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.FunctionDef)
+                and node.name == REGISTRATION_FN):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Dict):
+                continue
+            mapping = {}
+            for k, v in zip(sub.keys, sub.values):
+                if isinstance(k, ast.Name) and \
+                        isinstance(v, ast.Attribute):
+                    mapping[k.id] = v.attr
+            if mapping:
+                for a in actions:
+                    if a not in mapping:
+                        problems.append(
+                            f"{path}:{sub.lineno}: write action [{a}] "
+                            f"has no handler in {REGISTRATION_FN}()")
+                return mapping
+        problems.append(
+            f"{path}:{node.lineno}: {REGISTRATION_FN}() has no "
+            "action -> handler dict literal")
+        return {}
+    problems.append(f"{path}:1: {REGISTRATION_FN}() is missing")
+    return {}
+
+
+def _check_handler_fences(tree: ast.AST, src: str, lines: list,
+                          handler: str, action: str, path: str,
+                          problems: list):
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.FunctionDef)
+                and node.name == handler):
+            continue
+        if _annotated(lines, node.lineno):
+            return
+        body = ast.get_source_segment(src, node) or ""
+        fenced = "_fence_floor" in body or (
+            "primary_term" in body
+            and any(r in body for r in FENCE_REJECTIONS))
+        if not fenced:
+            problems.append(
+                f"{path}:{node.lineno}: write handler [{handler}] "
+                f"(action {action}) does not validate primary_term "
+                "against cluster state — a stale primary's op would "
+                "apply unfenced; call _fence_floor()/raise "
+                "PrimaryFencedError, or annotate with "
+                f"'{ANNOTATION} (<why>)'")
+        return
+    problems.append(f"{path}:1: registered handler [{handler}] "
+                    f"(action {action}) not found")
+
+
+def main(argv: list) -> int:
+    repo = argv[1] if len(argv) > 1 else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "opensearch_tpu", "cluster", "node.py")
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    problems: list = []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        problems.append(f"{path}:{e.lineno}: syntax error: {e.msg}")
+        tree = None
+    if tree is not None:
+        lines = src.splitlines()
+        actions = _write_action_names(tree, path, problems)
+        handlers = _registered_handlers(tree, actions, path, problems)
+        for action, handler in sorted(handlers.items()):
+            _check_handler_fences(tree, src, lines, handler, action,
+                                  path, problems)
+    for p in problems:
+        print(p)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
